@@ -1,0 +1,27 @@
+"""Typed columns and schemas bridging host Arrow data to TPU device tensors.
+
+Equivalent of the reference's ``src/datatypes`` (Vector wrappers over Arrow,
+ConcreteDataType, schema + column metadata — see SURVEY.md §2.9), re-based for
+TPU: the host side stays Arrow/numpy columnar; the device side is a
+``DeviceBatch`` of padded, validity-masked jnp arrays where every tag/string
+column has been dictionary-encoded to dense int32 ids.
+"""
+
+from greptimedb_tpu.datatypes.types import (
+    ConcreteDataType,
+    SemanticType,
+    TimeUnit,
+)
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+from greptimedb_tpu.datatypes.batch import RecordBatch, DeviceBatch, pad_rows
+
+__all__ = [
+    "ConcreteDataType",
+    "SemanticType",
+    "TimeUnit",
+    "ColumnSchema",
+    "Schema",
+    "RecordBatch",
+    "DeviceBatch",
+    "pad_rows",
+]

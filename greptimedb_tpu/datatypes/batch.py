@@ -1,0 +1,354 @@
+"""Host RecordBatch and device-side DeviceBatch.
+
+The TPU analog of the reference's RecordBatch stream
+(src/common/recordbatch, SURVEY.md §2.9) under XLA's static-shape regime
+(SURVEY.md §7.3 item 1):
+
+- ``RecordBatch`` — host columnar data: numpy arrays per column (strings as
+  object arrays), a Schema, optional per-column null masks. Converts to/from
+  pyarrow for Parquet IO and wire formats.
+- ``DeviceBatch`` — what lands in HBM: per-column jnp arrays in device
+  dtypes, rows padded to a shape-class bucket with a validity ``row_mask``.
+  String columns must already be dictionary-encoded (int32 codes + host-side
+  ``dicts``). All query kernels consume/produce DeviceBatch.
+
+Shape classes: row counts are padded to the next power of two (min 128) so
+repeated queries over growing data reuse a bounded set of compiled programs
+instead of recompiling per row count.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from greptimedb_tpu.errors import ColumnNotFound, InvalidArguments
+from greptimedb_tpu.datatypes.schema import Schema, ColumnSchema
+from greptimedb_tpu.datatypes.types import ConcreteDataType
+
+_MIN_BUCKET = 128
+
+
+def pad_rows(n: int, min_bucket: int = _MIN_BUCKET) -> int:
+    """Shape-class bucket for n rows: next power of two, at least min_bucket."""
+    if n <= min_bucket:
+        return min_bucket
+    return 1 << (n - 1).bit_length()
+
+
+class RecordBatch:
+    """Immutable host-side columnar batch."""
+
+    def __init__(
+        self,
+        schema: Schema,
+        columns: dict[str, np.ndarray],
+        nulls: dict[str, np.ndarray] | None = None,
+    ):
+        self.schema = schema
+        self.columns = columns
+        self.nulls = nulls or {}
+        lens = {name: len(a) for name, a in columns.items()}
+        if len(set(lens.values())) > 1:
+            raise InvalidArguments(f"ragged column lengths: {lens}")
+        self.num_rows = next(iter(lens.values())) if lens else 0
+        for c in schema:
+            if c.name not in columns:
+                raise ColumnNotFound(c.name)
+
+    # ---- constructors ---------------------------------------------------
+    @staticmethod
+    def from_pydict(schema: Schema, data: dict[str, list]) -> "RecordBatch":
+        cols = {}
+        nulls = {}
+        for c in schema:
+            vals = data.get(c.name)
+            if vals is None:
+                raise ColumnNotFound(c.name)
+            arr = np.asarray(vals, dtype=None if c.dtype.is_string_like else c.dtype.to_numpy())
+            null = np.array([v is None for v in vals], dtype=bool)
+            if c.dtype.is_string_like:
+                arr = np.array(["" if v is None else v for v in vals], dtype=object)
+            elif null.any():
+                tmp = np.asarray(
+                    [c.dtype.default_value() if v is None else v for v in vals],
+                    dtype=c.dtype.to_numpy(),
+                )
+                arr = tmp
+            cols[c.name] = arr
+            if null.any():
+                nulls[c.name] = null
+        return RecordBatch(schema, cols, nulls)
+
+    @staticmethod
+    def from_arrow(table: pa.Table, schema: Schema | None = None) -> "RecordBatch":
+        if schema is None:
+            cols_schema = []
+            for f in table.schema:
+                dt = ConcreteDataType.from_numpy(
+                    np.dtype(f.type.to_pandas_dtype())
+                    if not pa.types.is_string(f.type) and not pa.types.is_binary(f.type)
+                    else np.dtype(object)
+                )
+                if pa.types.is_timestamp(f.type):
+                    dt = {
+                        "s": ConcreteDataType.TIMESTAMP_SECOND,
+                        "ms": ConcreteDataType.TIMESTAMP_MILLISECOND,
+                        "us": ConcreteDataType.TIMESTAMP_MICROSECOND,
+                        "ns": ConcreteDataType.TIMESTAMP_NANOSECOND,
+                    }[f.type.unit]
+                cols_schema.append(ColumnSchema(f.name, dt))
+            schema = Schema(tuple(cols_schema))
+        cols: dict[str, np.ndarray] = {}
+        nulls: dict[str, np.ndarray] = {}
+        for c in schema:
+            arr = table.column(c.name).combine_chunks()
+            if arr.null_count:
+                nulls[c.name] = np.asarray(arr.is_null())
+            if c.dtype.is_string_like:
+                py = arr.to_pylist()
+                cols[c.name] = np.array(["" if v is None else v for v in py], dtype=object)
+            else:
+                np_arr = arr.to_numpy(zero_copy_only=False)
+                if c.dtype.is_timestamp:
+                    np_arr = np_arr.astype(c.dtype.to_numpy())
+                cols[c.name] = np.ascontiguousarray(
+                    np.nan_to_num(np_arr, copy=False) if False else np_arr
+                )
+        return RecordBatch(schema, cols, nulls)
+
+    @staticmethod
+    def empty(schema: Schema) -> "RecordBatch":
+        return RecordBatch(schema, schema.empty_columns())
+
+    @staticmethod
+    def concat(batches: list["RecordBatch"]) -> "RecordBatch":
+        if not batches:
+            raise InvalidArguments("concat of zero batches")
+        schema = batches[0].schema
+        cols = {
+            name: np.concatenate([b.columns[name] for b in batches])
+            for name in schema.names
+        }
+        nulls = {}
+        for name in schema.names:
+            if any(name in b.nulls for b in batches):
+                nulls[name] = np.concatenate(
+                    [
+                        b.nulls.get(name, np.zeros(b.num_rows, dtype=bool))
+                        for b in batches
+                    ]
+                )
+        return RecordBatch(schema, cols, nulls)
+
+    # ---- ops ------------------------------------------------------------
+    def column(self, name: str) -> np.ndarray:
+        if name not in self.columns:
+            raise ColumnNotFound(name)
+        return self.columns[name]
+
+    def null_mask(self, name: str) -> np.ndarray:
+        return self.nulls.get(name, np.zeros(self.num_rows, dtype=bool))
+
+    def take(self, indices: np.ndarray) -> "RecordBatch":
+        return RecordBatch(
+            self.schema,
+            {k: v[indices] for k, v in self.columns.items()},
+            {k: v[indices] for k, v in self.nulls.items()},
+        )
+
+    def slice(self, start: int, length: int) -> "RecordBatch":
+        sl = slice(start, start + length)
+        return RecordBatch(
+            self.schema,
+            {k: v[sl] for k, v in self.columns.items()},
+            {k: v[sl] for k, v in self.nulls.items()},
+        )
+
+    def filter(self, mask: np.ndarray) -> "RecordBatch":
+        return self.take(np.nonzero(mask)[0])
+
+    def select(self, names: list[str]) -> "RecordBatch":
+        sub = Schema(tuple(self.schema.column(n) for n in names))
+        return RecordBatch(
+            sub,
+            {n: self.columns[n] for n in names},
+            {n: self.nulls[n] for n in names if n in self.nulls},
+        )
+
+    def to_arrow(self) -> pa.Table:
+        arrays = []
+        for c in self.schema:
+            col = self.columns[c.name]
+            mask = self.nulls.get(c.name)
+            if c.dtype.is_string_like:
+                py = [None if (mask is not None and mask[i]) else col[i] for i in range(len(col))]
+                arrays.append(pa.array(py, type=c.to_arrow().type))
+            else:
+                arrays.append(pa.array(col, type=c.to_arrow().type, mask=mask))
+        return pa.Table.from_arrays(arrays, schema=self.schema.to_arrow())
+
+    def to_pydict(self) -> dict[str, list]:
+        out = {}
+        for c in self.schema:
+            col = self.columns[c.name]
+            mask = self.nulls.get(c.name)
+            if c.dtype.is_timestamp:
+                col = col.astype(np.int64)
+            vals = col.tolist()
+            if mask is not None:
+                vals = [None if m else v for v, m in zip(vals, mask)]
+            out[c.name] = vals
+        return out
+
+    def __repr__(self) -> str:
+        return f"RecordBatch[{self.num_rows} rows x {len(self.schema)} cols]"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DeviceBatch:
+    """Padded, masked columnar batch resident on device.
+
+    ``columns`` maps column name → jnp array of shape [padded_rows] (device
+    dtype). ``row_mask`` is bool [padded_rows]; padding rows are False.
+    ``dicts`` maps dictionary-encoded column name → code→string list (host
+    side, static). Registered as a pytree so DeviceBatch flows through jit.
+    """
+
+    columns: dict[str, jnp.ndarray]
+    row_mask: jnp.ndarray
+    dicts: dict[str, list] = field(default_factory=dict)
+
+    @property
+    def padded_rows(self) -> int:
+        return int(self.row_mask.shape[0])
+
+    def num_rows(self) -> jnp.ndarray:
+        """Traced count of valid rows."""
+        return jnp.sum(self.row_mask.astype(jnp.int32))
+
+    def tree_flatten(self):
+        names = sorted(self.columns)
+        children = tuple(self.columns[n] for n in names) + (self.row_mask,)
+        aux = (tuple(names), tuple(sorted(self.dicts.items(), key=lambda kv: kv[0])))
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        names, dict_items = aux
+        cols = dict(zip(names, children[:-1]))
+        return cls(columns=cols, row_mask=children[-1], dicts=dict(dict_items))
+
+    # ---- host <-> device -------------------------------------------------
+    @staticmethod
+    def from_host(
+        batch: RecordBatch,
+        bucket: int | None = None,
+        encoders: dict[str, "DictionaryEncoder"] | None = None,
+    ) -> "DeviceBatch":
+        """Upload a host batch: dictionary-encode strings, pad, mask.
+
+        ``encoders`` supplies shared dictionaries (e.g. region-wide tag
+        dictionaries) so codes are stable across batches.
+        """
+        n = batch.num_rows
+        padded = bucket or pad_rows(n)
+        if padded < n:
+            raise InvalidArguments(f"bucket {padded} < rows {n}")
+        cols: dict[str, jnp.ndarray] = {}
+        dicts: dict[str, list] = {}
+        encoders = encoders or {}
+        for c in batch.schema:
+            host = batch.columns[c.name]
+            if c.dtype.is_string_like:
+                enc = encoders.get(c.name)
+                if enc is None:
+                    enc = DictionaryEncoder()
+                codes = enc.encode(host)
+                dicts[c.name] = enc.values()
+                host = codes
+            dev_dtype = c.dtype.to_device_dtype()
+            if c.dtype.is_timestamp:
+                host = host.astype(np.int64)
+            host = np.asarray(host).astype(dev_dtype, copy=False)
+            pad_val = np.nan if np.issubdtype(dev_dtype, np.floating) else 0
+            out = np.full(padded, pad_val, dtype=dev_dtype)
+            out[:n] = host
+            # nulls: floats → NaN; ints keep 0 but row-level nulls tracked by caller
+            null = batch.nulls.get(c.name)
+            if null is not None and np.issubdtype(dev_dtype, np.floating):
+                out[:n][null] = np.nan
+            cols[c.name] = jnp.asarray(out)
+        mask = np.zeros(padded, dtype=bool)
+        mask[:n] = True
+        return DeviceBatch(cols, jnp.asarray(mask), dicts)
+
+    def to_host(self, schema: Schema) -> RecordBatch:
+        mask = np.asarray(self.row_mask)
+        n = int(mask.sum())
+        idx = np.nonzero(mask)[0]
+        cols: dict[str, np.ndarray] = {}
+        nulls: dict[str, np.ndarray] = {}
+        for c in schema:
+            dev = np.asarray(self.columns[c.name])[idx]
+            if c.name in self.dicts:
+                table = np.array(self.dicts[c.name] + [""], dtype=object)
+                codes = dev.astype(np.int64)
+                bad = (codes < 0) | (codes >= len(self.dicts[c.name]))
+                codes = np.where(bad, len(self.dicts[c.name]), codes)
+                cols[c.name] = table[codes]
+                if bad.any():
+                    nulls[c.name] = bad
+            elif c.dtype.is_timestamp:
+                cols[c.name] = dev.astype(c.dtype.to_numpy())
+            elif c.dtype.is_string_like:
+                cols[c.name] = dev.astype(object)
+            else:
+                host = dev.astype(c.dtype.to_numpy(), copy=False)
+                if np.issubdtype(dev.dtype, np.floating):
+                    isnan = np.isnan(dev)
+                    if isnan.any() and not c.dtype.is_float:
+                        nulls[c.name] = isnan
+                cols[c.name] = host
+        return RecordBatch(schema, cols, nulls)
+
+
+class DictionaryEncoder:
+    """Stable string→int32 dictionary (the metric-engine ``__tsid`` idea,
+    reference src/metric-engine/src/row_modifier.rs: label values become
+    dense ids early so the device only sees ints)."""
+
+    def __init__(self, initial: list | None = None):
+        self._map: dict = {}
+        self._values: list = []
+        if initial:
+            for v in initial:
+                self.get_or_insert(v)
+
+    def get_or_insert(self, v) -> int:
+        code = self._map.get(v)
+        if code is None:
+            code = len(self._values)
+            self._map[v] = code
+            self._values.append(v)
+        return code
+
+    def get(self, v) -> int:
+        """Code for v, or -1 if unseen (encodes to 'no match' on device)."""
+        return self._map.get(v, -1)
+
+    def encode(self, arr: np.ndarray) -> np.ndarray:
+        return np.fromiter(
+            (self.get_or_insert(v) for v in arr), dtype=np.int32, count=len(arr)
+        )
+
+    def values(self) -> list:
+        return list(self._values)
+
+    def __len__(self) -> int:
+        return len(self._values)

@@ -1,0 +1,33 @@
+"""Test harness: force an 8-device virtual CPU platform before jax imports.
+
+Mirrors the reference's in-process mock-cluster strategy
+(tests-integration/src/cluster.rs — N in-process datanodes, no containers):
+we fake an 8-chip TPU slice with XLA's host-platform device count so all
+mesh/sharding/collective paths run in CI without TPU hardware.
+"""
+
+import os
+
+if not os.environ.get("GREPTIME_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tmp_data_dir(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    return str(d)

@@ -1,0 +1,179 @@
+"""Tests for the datatypes layer: types, schema, host/device batches."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.datatypes import (
+    ColumnSchema,
+    ConcreteDataType as T,
+    DeviceBatch,
+    RecordBatch,
+    Schema,
+    SemanticType as S,
+    pad_rows,
+)
+from greptimedb_tpu.datatypes.batch import DictionaryEncoder
+from greptimedb_tpu.errors import InvalidArguments
+
+
+def make_schema():
+    return Schema(
+        (
+            ColumnSchema("host", T.STRING, S.TAG),
+            ColumnSchema("ts", T.TIMESTAMP_MILLISECOND, S.TIMESTAMP),
+            ColumnSchema("usage", T.FLOAT64, S.FIELD),
+            ColumnSchema("count", T.INT64, S.FIELD),
+        )
+    )
+
+
+class TestTypes:
+    def test_parse_aliases(self):
+        assert T.parse("double") is T.FLOAT64
+        assert T.parse("BIGINT") is T.INT64
+        assert T.parse("varchar") is T.STRING
+        assert T.parse("timestamp(3)") is T.TIMESTAMP_MILLISECOND
+        assert T.parse("timestamp(9)") is T.TIMESTAMP_NANOSECOND
+        with pytest.raises(ValueError):
+            T.parse("frobnicate")
+
+    def test_time_unit_convert(self):
+        from greptimedb_tpu.datatypes.types import TimeUnit
+
+        assert TimeUnit.SECOND.convert(5, TimeUnit.MILLISECOND) == 5000
+        assert TimeUnit.MILLISECOND.convert(5999, TimeUnit.SECOND) == 5
+        assert TimeUnit.NANOSECOND.convert(10**9, TimeUnit.SECOND) == 1
+
+    def test_device_dtype(self):
+        assert T.FLOAT64.to_device_dtype() == np.float32
+        assert T.STRING.to_device_dtype() == np.int32
+        assert T.TIMESTAMP_MILLISECOND.to_device_dtype() == np.int64
+        assert T.BOOL.to_device_dtype() == np.int8
+
+
+class TestSchema:
+    def test_roles(self):
+        s = make_schema()
+        assert [c.name for c in s.tag_columns] == ["host"]
+        assert s.time_index.name == "ts"
+        assert [c.name for c in s.field_columns] == ["usage", "count"]
+
+    def test_two_time_indexes_rejected(self):
+        with pytest.raises(InvalidArguments):
+            Schema(
+                (
+                    ColumnSchema("a", T.TIMESTAMP_MILLISECOND, S.TIMESTAMP),
+                    ColumnSchema("b", T.TIMESTAMP_MILLISECOND, S.TIMESTAMP),
+                )
+            )
+
+    def test_evolution(self):
+        s = make_schema()
+        s2 = s.with_added_column(ColumnSchema("mem", T.FLOAT64))
+        assert s2.has_column("mem") and s2.version == 1
+        s3 = s2.with_dropped_column("mem")
+        assert not s3.has_column("mem")
+        with pytest.raises(InvalidArguments):
+            s.with_dropped_column("ts")
+
+    def test_serde_roundtrip(self):
+        s = make_schema()
+        assert Schema.from_dict(s.to_dict()) == s
+
+
+class TestRecordBatch:
+    def test_from_pydict_and_arrow_roundtrip(self):
+        s = make_schema()
+        rb = RecordBatch.from_pydict(
+            s,
+            {
+                "host": ["a", "b", None],
+                "ts": [1000, 2000, 3000],
+                "usage": [1.5, None, 3.5],
+                "count": [1, 2, 3],
+            },
+        )
+        assert rb.num_rows == 3
+        t = rb.to_arrow()
+        rb2 = RecordBatch.from_arrow(t, s)
+        assert rb2.to_pydict()["usage"] == [1.5, None, 3.5]
+        assert rb2.to_pydict()["count"] == [1, 2, 3]
+
+    def test_ops(self):
+        s = make_schema()
+        rb = RecordBatch.from_pydict(
+            s,
+            {
+                "host": ["a", "b", "c", "d"],
+                "ts": [1, 2, 3, 4],
+                "usage": [1.0, 2.0, 3.0, 4.0],
+                "count": [1, 2, 3, 4],
+            },
+        )
+        assert rb.slice(1, 2).to_pydict()["host"] == ["b", "c"]
+        assert rb.filter(np.array([True, False, True, False])).num_rows == 2
+        cat = RecordBatch.concat([rb, rb])
+        assert cat.num_rows == 8
+        sel = rb.select(["ts", "usage"])
+        assert sel.schema.names == ["ts", "usage"]
+
+
+class TestDeviceBatch:
+    def test_pad_rows(self):
+        assert pad_rows(1) == 128
+        assert pad_rows(128) == 128
+        assert pad_rows(129) == 256
+        assert pad_rows(1000) == 1024
+
+    def test_roundtrip(self):
+        s = make_schema()
+        rb = RecordBatch.from_pydict(
+            s,
+            {
+                "host": ["a", "b", "a"],
+                "ts": [1000, 2000, 3000],
+                "usage": [1.5, 2.5, 3.5],
+                "count": [10, 20, 30],
+            },
+        )
+        db = DeviceBatch.from_host(rb)
+        assert db.padded_rows == 128
+        assert int(db.num_rows()) == 3
+        # dictionary encoding: same tag -> same code
+        codes = np.asarray(db.columns["host"])
+        assert codes[0] == codes[2] != codes[1]
+        back = db.to_host(s)
+        assert back.to_pydict()["host"] == ["a", "b", "a"]
+        assert back.to_pydict()["ts"] == [1000, 2000, 3000]
+        np.testing.assert_allclose(back.columns["usage"], [1.5, 2.5, 3.5])
+
+    def test_shared_encoder(self):
+        s = make_schema()
+        enc = DictionaryEncoder(["a", "b"])
+        rb = RecordBatch.from_pydict(
+            s,
+            {"host": ["b", "c"], "ts": [1, 2], "usage": [0.0, 0.0], "count": [0, 0]},
+        )
+        db = DeviceBatch.from_host(rb, encoders={"host": enc})
+        codes = np.asarray(db.columns["host"])[:2]
+        assert list(codes) == [1, 2]
+        assert enc.values() == ["a", "b", "c"]
+
+    def test_jit_pytree(self):
+        import jax
+
+        s = make_schema()
+        rb = RecordBatch.from_pydict(
+            s,
+            {"host": ["a"], "ts": [1], "usage": [2.0], "count": [3]},
+        )
+        db = DeviceBatch.from_host(rb)
+
+        @jax.jit
+        def double_usage(b: DeviceBatch) -> DeviceBatch:
+            cols = dict(b.columns)
+            cols["usage"] = cols["usage"] * 2
+            return DeviceBatch(cols, b.row_mask, b.dicts)
+
+        out = double_usage(db)
+        assert float(np.asarray(out.columns["usage"])[0]) == 4.0
