@@ -20,3 +20,11 @@ Layer map (mirrors SURVEY.md §1, re-based on TPU):
 """
 
 __version__ = "0.1.0"
+
+# int64 timestamps are load-bearing across the whole stack (epoch-ms exceeds
+# int32); x64 mode must be on before any array is built. Done here because
+# the runtime image preimports jax (plugin registration), making env vars
+# too late.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)

@@ -69,16 +69,16 @@ class RecordBatch:
             vals = data.get(c.name)
             if vals is None:
                 raise ColumnNotFound(c.name)
-            arr = np.asarray(vals, dtype=None if c.dtype.is_string_like else c.dtype.to_numpy())
             null = np.array([v is None for v in vals], dtype=bool)
             if c.dtype.is_string_like:
                 arr = np.array(["" if v is None else v for v in vals], dtype=object)
             elif null.any():
-                tmp = np.asarray(
+                arr = np.asarray(
                     [c.dtype.default_value() if v is None else v for v in vals],
                     dtype=c.dtype.to_numpy(),
                 )
-                arr = tmp
+            else:
+                arr = np.asarray(vals, dtype=c.dtype.to_numpy())
             cols[c.name] = arr
             if null.any():
                 nulls[c.name] = null
@@ -114,11 +114,19 @@ class RecordBatch:
                 cols[c.name] = np.array(["" if v is None else v for v in py], dtype=object)
             else:
                 np_arr = arr.to_numpy(zero_copy_only=False)
-                if c.dtype.is_timestamp:
-                    np_arr = np_arr.astype(c.dtype.to_numpy())
-                cols[c.name] = np.ascontiguousarray(
-                    np.nan_to_num(np_arr, copy=False) if False else np_arr
-                )
+                target = c.dtype.to_numpy()
+                if np_arr.dtype != target:
+                    # pyarrow widens nullable ints to float64 (nulls→NaN);
+                    # route nulls through the mask and restore the dtype.
+                    if np.issubdtype(np_arr.dtype, np.floating) and not c.dtype.is_float:
+                        isnan = np.isnan(np_arr)
+                        if isnan.any():
+                            nulls[c.name] = isnan | nulls.get(
+                                c.name, np.zeros(len(np_arr), bool)
+                            )
+                            np_arr = np.where(isnan, 0, np_arr)
+                    np_arr = np_arr.astype(target)
+                cols[c.name] = np.ascontiguousarray(np_arr)
         return RecordBatch(schema, cols, nulls)
 
     @staticmethod
@@ -309,12 +317,15 @@ class DeviceBatch:
             elif c.dtype.is_string_like:
                 cols[c.name] = dev.astype(object)
             else:
-                host = dev.astype(c.dtype.to_numpy(), copy=False)
                 if np.issubdtype(dev.dtype, np.floating):
+                    # device NaN encodes null (from_host wrote NaN for null
+                    # rows); restore the null mask for SQL/JSON output.
                     isnan = np.isnan(dev)
-                    if isnan.any() and not c.dtype.is_float:
+                    if isnan.any():
                         nulls[c.name] = isnan
-                cols[c.name] = host
+                        if not c.dtype.is_float:
+                            dev = np.where(isnan, 0, dev)
+                cols[c.name] = dev.astype(c.dtype.to_numpy(), copy=False)
         return RecordBatch(schema, cols, nulls)
 
 

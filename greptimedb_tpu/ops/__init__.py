@@ -1,0 +1,41 @@
+"""TPU kernel library: the device-side primitives query operators lower to.
+
+This is the TPU replacement for the reference's CPU Arrow compute kernels
+(DataFusion physical operators + mito2 merge/dedup iterators). Design rules
+(SURVEY.md §7.1):
+
+- group-by = segment reduction over dense int ids, never hash tables;
+- filters = masks, compaction only at materialization boundaries;
+- NaN doubles as the null/absent value for float fields;
+- every entry point is shape-polymorphic only over a bounded set of
+  power-of-two shape classes (see datatypes.batch.pad_rows).
+"""
+
+from greptimedb_tpu.ops.segment import (
+    segment_reduce,
+    segment_mean,
+    segment_count,
+    segment_first_last,
+    combine_keys,
+    compact_groups,
+)
+from greptimedb_tpu.ops.masks import (
+    masked_reduce,
+    valid_mask,
+    compact_rows,
+)
+from greptimedb_tpu.ops.time import time_bucket, date_trunc_bucket
+
+__all__ = [
+    "segment_reduce",
+    "segment_mean",
+    "segment_count",
+    "segment_first_last",
+    "combine_keys",
+    "compact_groups",
+    "masked_reduce",
+    "valid_mask",
+    "compact_rows",
+    "time_bucket",
+    "date_trunc_bucket",
+]

@@ -1,0 +1,220 @@
+"""Segment reductions: the TPU group-by engine.
+
+Replaces DataFusion's hash aggregate (reference: RowHash in its GroupBy
+exec) with segment ops over dense integer group ids — the TPU-friendly
+formulation (SURVEY.md §7.3 item 3): tags are already dictionary codes, so a
+GROUP BY is (combine key codes) → (segment_sum/min/max) → (decompose codes).
+
+Two group-id strategies:
+
+- **dense grid** — total key cardinality is bounded (e.g. hosts × hours in
+  TSBS double-groupby-all): group id = row-major mix of key codes; empty
+  cells masked out after reduction. Sort-free, one scatter pass.
+- **sort-based** — unbounded/sparse key space: sort rows by combined key,
+  dense-rank by change points, reduce over ranks. Still static-shape.
+
+Dtype rules mirror ops.masks: float aggs in the input float dtype, integer
+sum/min/max in int64 (no float round-trip), mean always float. Empty
+segments: float min/max/mean → NaN, int min/max → 0 (consult count).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.ops.masks import valid_mask
+
+_I64_MIN = np.int64(np.iinfo(np.int64).min)
+_I64_MAX = np.int64(np.iinfo(np.int64).max)
+
+
+def combine_keys(
+    keys: list[jnp.ndarray], cards: list[int]
+) -> tuple[jnp.ndarray, int]:
+    """Row-major combine of dense key codes into one int64 id per row.
+
+    ``cards[i]`` is the (static) cardinality bound of ``keys[i]``. Codes
+    outside [0, card) (e.g. -1 for "unseen") poison the row id to -1 so the
+    caller's mask can drop it.
+    """
+    total = 1
+    for c in cards:
+        total *= int(c)
+    out = jnp.zeros_like(keys[0], dtype=jnp.int64)
+    bad = jnp.zeros(keys[0].shape, dtype=bool)
+    for k, c in zip(keys, cards):
+        k64 = k.astype(jnp.int64)
+        bad = bad | (k64 < 0) | (k64 >= c)
+        out = out * c + jnp.clip(k64, 0, c - 1)
+    return jnp.where(bad, -1, out), total
+
+
+def decompose_keys(seg_ids: jnp.ndarray, cards: list[int]) -> list[jnp.ndarray]:
+    """Invert combine_keys for a dense grid: group id → per-key codes."""
+    out = []
+    rem = seg_ids.astype(jnp.int64)
+    for c in reversed(cards):
+        out.append((rem % c).astype(jnp.int32))
+        rem = rem // c
+    return list(reversed(out))
+
+
+def _prep(values, seg_ids, num_segments, mask):
+    """Shared validity/overflow-routing: returns (m, ids) with invalid rows
+    routed to segment num_segments (sliced off by callers)."""
+    m = valid_mask(values, mask if mask is not None else jnp.ones(values.shape, bool))
+    m = m & (seg_ids >= 0) & (seg_ids < num_segments)
+    ids = jnp.where(m, seg_ids, num_segments).astype(jnp.int32)
+    return m, ids
+
+
+def _seg_count(m, ids, ns, sorted_):
+    return jax.ops.segment_sum(
+        m.astype(jnp.int64), ids, num_segments=ns, indices_are_sorted=sorted_
+    )
+
+
+def segment_reduce(
+    values: jnp.ndarray,
+    seg_ids: jnp.ndarray,
+    num_segments: int,
+    op: str,
+    mask: jnp.ndarray | None = None,
+    indices_are_sorted: bool = False,
+) -> jnp.ndarray:
+    """Masked, NaN-aware segment reduction.
+
+    Invalid rows (mask False, NaN value, or seg_id outside [0,num_segments))
+    contribute nothing.
+    """
+    m, ids = _prep(values, seg_ids, num_segments, mask)
+    ns = num_segments + 1
+    srt = indices_are_sorted
+    is_float = jnp.issubdtype(values.dtype, jnp.floating)
+
+    if op == "count":
+        return _seg_count(m, ids, ns, srt)[:num_segments]
+
+    if op == "sum":
+        v = values if is_float else values.astype(jnp.int64)
+        return jax.ops.segment_sum(
+            jnp.where(m, v, 0), ids, num_segments=ns, indices_are_sorted=srt
+        )[:num_segments]
+
+    if op in ("min", "max"):
+        fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        if is_float:
+            fill = jnp.inf if op == "min" else -jnp.inf
+            out = fn(jnp.where(m, values, fill), ids, num_segments=ns,
+                     indices_are_sorted=srt)[:num_segments]
+            cnt = _seg_count(m, ids, ns, srt)[:num_segments]
+            return jnp.where(cnt > 0, out, jnp.nan)
+        fill = _I64_MAX if op == "min" else _I64_MIN
+        v = values.astype(jnp.int64)
+        out = fn(jnp.where(m, v, fill), ids, num_segments=ns,
+                 indices_are_sorted=srt)[:num_segments]
+        cnt = _seg_count(m, ids, ns, srt)[:num_segments]
+        return jnp.where(cnt > 0, out, 0)
+
+    if op == "mean":
+        v = values if is_float else values.astype(jnp.float32)
+        s = jax.ops.segment_sum(
+            jnp.where(m, v, 0), ids, num_segments=ns, indices_are_sorted=srt
+        )[:num_segments]
+        cnt = _seg_count(m, ids, ns, srt)[:num_segments]
+        return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1).astype(s.dtype), jnp.nan)
+
+    raise ValueError(f"unknown segment op: {op}")
+
+
+def segment_mean(
+    values: jnp.ndarray,
+    seg_ids: jnp.ndarray,
+    num_segments: int,
+    mask: jnp.ndarray | None = None,
+    indices_are_sorted: bool = False,
+) -> jnp.ndarray:
+    return segment_reduce(values, seg_ids, num_segments, "mean", mask,
+                          indices_are_sorted)
+
+
+def segment_count(
+    values: jnp.ndarray,
+    seg_ids: jnp.ndarray,
+    num_segments: int,
+    mask: jnp.ndarray | None = None,
+    indices_are_sorted: bool = False,
+) -> jnp.ndarray:
+    return segment_reduce(values, seg_ids, num_segments, "count", mask,
+                          indices_are_sorted)
+
+
+def segment_first_last(
+    ts: jnp.ndarray,
+    values: jnp.ndarray,
+    seg_ids: jnp.ndarray,
+    num_segments: int,
+    mask: jnp.ndarray | None = None,
+    last: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-segment (timestamp, value) of the newest (or oldest) valid row.
+
+    Two-pass, overflow-safe formulation (packing ts*N+idx can overflow
+    int64 at high cardinality): pass 1 finds the extreme ts per segment;
+    pass 2 picks the lowest row index achieving it and gathers the value.
+    Reference semantics: TSBS `lastpoint` / mito2 last_row dedup
+    (src/mito2/src/read/last_row.rs).
+    """
+    n = ts.shape[0]
+    m, ids = _prep(values, seg_ids, num_segments, mask)
+    ns = num_segments + 1
+
+    if last:
+        ext = jax.ops.segment_max(jnp.where(m, ts, _I64_MIN), ids, num_segments=ns)
+    else:
+        ext = jax.ops.segment_min(jnp.where(m, ts, _I64_MAX), ids, num_segments=ns)
+    winner = m & (ts == ext[ids])
+    idx = jnp.arange(n, dtype=jnp.int64)
+    win_idx = jax.ops.segment_min(
+        jnp.where(winner, idx, _I64_MAX), ids, num_segments=ns
+    )[:num_segments]
+    has = win_idx < _I64_MAX
+    safe_idx = jnp.where(has, win_idx, 0)
+    out_ts = jnp.where(has, ts[safe_idx], 0)
+    out_val = jnp.where(has, values[safe_idx], jnp.nan)
+    return out_ts, out_val
+
+
+def compact_groups(
+    combined_ids: jnp.ndarray, mask: jnp.ndarray, num_groups: int
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort-based dense ranking for sparse key spaces.
+
+    Returns (dense_ids [N] — rank of each row's group in sorted key order,
+    group_keys [num_groups] — the combined key per rank, group_mask
+    [num_groups]). ``num_groups`` is a static bound (≤ padded rows).
+    Rows with mask False or a poisoned (-1) key get dense id num_groups
+    (overflow, caller slices).
+    """
+    valid_row = mask & (combined_ids >= 0)
+    key = jnp.where(valid_row, combined_ids, _I64_MAX)
+    order = jnp.argsort(key)
+    sorted_key = key[order]
+    new_grp = jnp.concatenate(
+        [jnp.array([0], jnp.int32),
+         (sorted_key[1:] != sorted_key[:-1]).astype(jnp.int32)]
+    )
+    rank_sorted = jnp.cumsum(new_grp)
+    # scatter ranks back to original row order
+    dense = jnp.zeros_like(rank_sorted).at[order].set(rank_sorted)
+    dense = jnp.where(valid_row, dense, num_groups)
+    # representative key per rank
+    group_keys = jnp.full((num_groups + 1,), _I64_MAX, dtype=jnp.int64)
+    group_keys = group_keys.at[
+        jnp.where(sorted_key != _I64_MAX, rank_sorted, num_groups)
+    ].set(jnp.where(sorted_key != _I64_MAX, sorted_key, _I64_MAX))
+    group_keys = group_keys[:num_groups]
+    group_mask = group_keys != _I64_MAX
+    return dense, group_keys, group_mask

@@ -1,0 +1,67 @@
+"""Time bucketing on device: date_bin / date_trunc / PromQL step alignment.
+
+Pure integer arithmetic over epoch timestamps — the device never sees
+calendars. Calendar-aware truncation (month/year) is precomputed on host as
+bucket edges and lowered to a searchsorted here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Fixed-width truncation units expressible as integer modulo in ms.
+_FIXED_MS = {
+    "second": 1_000,
+    "minute": 60_000,
+    "hour": 3_600_000,
+    "day": 86_400_000,
+    "week": 7 * 86_400_000,
+}
+
+
+def time_bucket(
+    ts: jnp.ndarray, interval: int, origin: int = 0
+) -> jnp.ndarray:
+    """Floor timestamps to interval-aligned buckets (date_bin semantics).
+
+    Works in the timestamp's own unit; handles negative timestamps with
+    floor (not truncate-toward-zero) division.
+    """
+    shifted = ts.astype(jnp.int64) - origin
+    return (shifted // interval) * interval + origin
+
+
+def bucket_index(
+    ts: jnp.ndarray, interval: int, start: int
+) -> jnp.ndarray:
+    """Bucket ordinal relative to a range start — the dense group code for
+    time axes (negative → -1, poisoning combine_keys)."""
+    idx = (ts.astype(jnp.int64) - start) // interval
+    return jnp.where(ts >= start, idx, -1)
+
+
+def date_trunc_bucket(ts_ms: jnp.ndarray, unit: str) -> jnp.ndarray:
+    """date_trunc for fixed-width units over ms timestamps (UTC).
+
+    Week truncation aligns to Monday (epoch day 0 was a Thursday, offset 3).
+    Month/year need host-computed edges — see query planner.
+    """
+    u = unit.lower()
+    if u == "week":
+        w = _FIXED_MS["week"]
+        return ((ts_ms.astype(jnp.int64) + 3 * 86_400_000) // w) * w - 3 * 86_400_000
+    if u in _FIXED_MS:
+        return time_bucket(ts_ms, _FIXED_MS[u])
+    raise ValueError(f"date_trunc unit needs host edges: {unit}")
+
+
+def searchsorted_bucket(ts: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """Variable-width buckets (calendar months, custom ranges).
+
+    ``edges`` must include a terminal end edge: k edges define k-1 buckets
+    [edges[i], edges[i+1]). Out-of-range timestamps (before the first or at/
+    after the last edge) map to -1, poisoning combine_keys.
+    """
+    idx = jnp.searchsorted(edges, ts, side="right") - 1
+    oob = (ts < edges[0]) | (ts >= edges[-1])
+    return jnp.where(oob, -1, idx).astype(jnp.int64)

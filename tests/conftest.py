@@ -8,14 +8,21 @@ mesh/sharding/collective paths run in CI without TPU hardware.
 
 import os
 
-if not os.environ.get("GREPTIME_TEST_ON_TPU"):
-    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+# The runtime image preimports jax (plugin registration), so env vars set
+# here can be too late — use jax.config directly.
+import jax  # noqa: E402
+
+if not os.environ.get("GREPTIME_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

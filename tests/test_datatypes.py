@@ -177,3 +177,40 @@ class TestDeviceBatch:
 
         out = double_usage(db)
         assert float(np.asarray(out.columns["usage"])[0]) == 4.0
+
+
+class TestNullHandlingRegressions:
+    """Regressions from code review: nullable ints, arrow widening, NaN→null."""
+
+    def test_nullable_int_from_pydict(self):
+        s = make_schema()
+        rb = RecordBatch.from_pydict(
+            s,
+            {"host": ["a"], "ts": [1], "usage": [1.0], "count": [None]},
+        )
+        assert rb.to_pydict()["count"] == [None]
+
+    def test_nullable_int_from_arrow_keeps_dtype(self):
+        import pyarrow as pa
+
+        s = make_schema()
+        t = pa.table(
+            {
+                "host": pa.array(["a", "b"]),
+                "ts": pa.array([1, 2], pa.timestamp("ms")),
+                "usage": pa.array([1.0, 2.0]),
+                "count": pa.array([1, None], pa.int64()),
+            }
+        )
+        rb = RecordBatch.from_arrow(t, s)
+        assert rb.columns["count"].dtype == np.int64
+        assert rb.to_pydict()["count"] == [1, None]
+
+    def test_float_null_roundtrips_via_device(self):
+        s = make_schema()
+        rb = RecordBatch.from_pydict(
+            s,
+            {"host": ["a", "b"], "ts": [1, 2], "usage": [1.5, None], "count": [0, 0]},
+        )
+        back = DeviceBatch.from_host(rb).to_host(s)
+        assert back.to_pydict()["usage"] == [1.5, None]

@@ -1,0 +1,214 @@
+"""Tests for the TPU ops library against numpy reference implementations."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from greptimedb_tpu.ops import (
+    combine_keys,
+    compact_groups,
+    masked_reduce,
+    segment_first_last,
+    segment_mean,
+    segment_reduce,
+    time_bucket,
+    date_trunc_bucket,
+)
+from greptimedb_tpu.ops.segment import decompose_keys
+from greptimedb_tpu.ops.masks import compact_rows
+
+
+class TestMaskedReduce:
+    def test_ops_with_nulls(self):
+        v = jnp.array([1.0, np.nan, 3.0, 100.0])
+        m = jnp.array([True, True, True, False])  # 100.0 is padding
+        assert float(masked_reduce(v, m, "sum")) == 4.0
+        assert int(masked_reduce(v, m, "count")) == 2
+        assert float(masked_reduce(v, m, "min")) == 1.0
+        assert float(masked_reduce(v, m, "max")) == 3.0
+        assert float(masked_reduce(v, m, "mean")) == 2.0
+
+    def test_empty(self):
+        v = jnp.array([1.0, 2.0])
+        m = jnp.array([False, False])
+        assert float(masked_reduce(v, m, "sum")) == 0.0
+        assert int(masked_reduce(v, m, "count")) == 0
+        assert np.isnan(float(masked_reduce(v, m, "max")))
+        assert np.isnan(float(masked_reduce(v, m, "mean")))
+
+    def test_int_count(self):
+        v = jnp.array([5, 6, 7], dtype=jnp.int64)
+        m = jnp.array([True, False, True])
+        assert int(masked_reduce(v, m, "count")) == 2
+        assert float(masked_reduce(v, m, "sum")) == 12.0
+
+
+class TestSegmentReduce:
+    def test_basic_vs_numpy(self, rng):
+        n, s = 1000, 17
+        ids = jnp.array(rng.integers(0, s, n), dtype=jnp.int32)
+        vals = jnp.array(rng.normal(size=n), dtype=jnp.float32)
+        mask = jnp.array(rng.random(n) > 0.1)
+        for op, npop in [("sum", np.sum), ("min", np.min), ("max", np.max),
+                         ("mean", np.mean)]:
+            got = np.asarray(segment_reduce(vals, ids, s, op, mask))
+            for g in range(s):
+                sel = (np.asarray(ids) == g) & np.asarray(mask)
+                if sel.any():
+                    np.testing.assert_allclose(
+                        got[g], npop(np.asarray(vals)[sel]), rtol=1e-5
+                    )
+                else:
+                    assert np.isnan(got[g])
+
+    def test_empty_segment_fills(self):
+        ids = jnp.array([0, 0, 2], dtype=jnp.int32)
+        vals = jnp.array([1.0, 2.0, 3.0])
+        got_sum = np.asarray(segment_reduce(vals, ids, 4, "sum"))
+        np.testing.assert_allclose(got_sum, [3.0, 0.0, 3.0, 0.0])
+        got_max = np.asarray(segment_reduce(vals, ids, 4, "max"))
+        assert np.isnan(got_max[1]) and np.isnan(got_max[3])
+        got_cnt = np.asarray(segment_reduce(vals, ids, 4, "count"))
+        np.testing.assert_array_equal(got_cnt, [2, 0, 1, 0])
+
+    def test_out_of_range_ids_dropped(self):
+        ids = jnp.array([0, -1, 5, 1], dtype=jnp.int32)
+        vals = jnp.array([1.0, 2.0, 3.0, 4.0])
+        got = np.asarray(segment_reduce(vals, ids, 2, "sum"))
+        np.testing.assert_allclose(got, [1.0, 4.0])
+
+    def test_nan_is_null(self):
+        ids = jnp.array([0, 0, 1], dtype=jnp.int32)
+        vals = jnp.array([1.0, np.nan, np.nan])
+        np.testing.assert_allclose(
+            np.asarray(segment_mean(vals, ids, 2))[0], 1.0
+        )
+        assert np.isnan(np.asarray(segment_mean(vals, ids, 2))[1])
+        cnt = np.asarray(segment_reduce(vals, ids, 2, "count"))
+        np.testing.assert_array_equal(cnt, [1, 0])
+
+
+class TestCombineKeys:
+    def test_roundtrip(self):
+        a = jnp.array([0, 1, 2, 1], dtype=jnp.int32)
+        b = jnp.array([3, 0, 2, 2], dtype=jnp.int32)
+        combined, total = combine_keys([a, b], [3, 4])
+        assert total == 12
+        back = decompose_keys(combined, [3, 4])
+        np.testing.assert_array_equal(np.asarray(back[0]), np.asarray(a))
+        np.testing.assert_array_equal(np.asarray(back[1]), np.asarray(b))
+
+    def test_bad_code_poisons(self):
+        a = jnp.array([0, -1], dtype=jnp.int32)
+        b = jnp.array([1, 1], dtype=jnp.int32)
+        combined, _ = combine_keys([a, b], [2, 2])
+        assert int(combined[1]) == -1
+
+
+class TestCompactGroups:
+    def test_sparse_ranking(self, rng):
+        # sparse 64-bit-ish key space
+        raw = rng.choice([10**12, 5, 999999999, 10**12, 5, 7], size=64)
+        mask = np.ones(64, bool)
+        mask[10:] = False
+        ids = jnp.array(raw, dtype=jnp.int64)
+        dense, gkeys, gmask = compact_groups(ids, jnp.array(mask), 64)
+        dense, gkeys, gmask = map(np.asarray, (dense, gkeys, gmask))
+        uniq = sorted(set(raw[:10]))
+        assert gmask.sum() == len(uniq)
+        np.testing.assert_array_equal(gkeys[: len(uniq)], uniq)
+        for i in range(10):
+            assert gkeys[dense[i]] == raw[i]
+        assert (dense[~mask] == 64).all()
+
+    def test_with_segment_reduce(self):
+        ids = jnp.array([100, 7, 100, 7, 42], dtype=jnp.int64)
+        vals = jnp.array([1.0, 2.0, 3.0, 4.0, 5.0])
+        mask = jnp.ones(5, dtype=bool)
+        dense, gkeys, gmask = compact_groups(ids, mask, 5)
+        sums = np.asarray(segment_reduce(vals, dense, 5, "sum", mask))
+        gk = np.asarray(gkeys)
+        assert sums[list(gk).index(7)] == 6.0
+        assert sums[list(gk).index(100)] == 4.0
+        assert sums[list(gk).index(42)] == 5.0
+
+
+class TestFirstLast:
+    def test_last(self):
+        ts = jnp.array([10, 20, 30, 5, 99], dtype=jnp.int64)
+        vals = jnp.array([1.0, 2.0, 3.0, 4.0, 9.0])
+        ids = jnp.array([0, 0, 0, 1, 2], dtype=jnp.int32)
+        mask = jnp.array([True, True, True, True, False])
+        out_ts, out_val = segment_first_last(ts, vals, ids, 4, mask, last=True)
+        np.testing.assert_array_equal(np.asarray(out_ts), [30, 5, 0, 0])
+        got = np.asarray(out_val)
+        assert got[0] == 3.0 and got[1] == 4.0
+        assert np.isnan(got[2]) and np.isnan(got[3])
+
+    def test_first_and_ties(self):
+        ts = jnp.array([10, 10, 20], dtype=jnp.int64)
+        vals = jnp.array([1.0, 2.0, 3.0])
+        ids = jnp.array([0, 0, 0], dtype=jnp.int32)
+        out_ts, out_val = segment_first_last(ts, vals, ids, 1, last=False)
+        # tie at ts=10 → lowest row index wins
+        assert int(out_ts[0]) == 10 and float(out_val[0]) == 1.0
+
+
+class TestTime:
+    def test_time_bucket(self):
+        ts = jnp.array([0, 999, 1000, 1500, -1], dtype=jnp.int64)
+        got = np.asarray(time_bucket(ts, 1000))
+        np.testing.assert_array_equal(got, [0, 0, 1000, 1000, -1000])
+
+    def test_origin(self):
+        ts = jnp.array([10, 12], dtype=jnp.int64)
+        np.testing.assert_array_equal(np.asarray(time_bucket(ts, 5, origin=2)),
+                                      [7, 12])
+
+    def test_date_trunc(self):
+        # 2021-01-01T13:45:10Z = 1609508710000 ms
+        t = jnp.array([1609508710000], dtype=jnp.int64)
+        assert int(date_trunc_bucket(t, "hour")[0]) == (1609508710000 // 3600000) * 3600000
+        assert int(date_trunc_bucket(t, "day")[0]) == (1609508710000 // 86400000) * 86400000
+        # week: 2021-01-01 is a Friday; Monday of that week is 2020-12-28
+        import datetime
+        monday = datetime.datetime(2020, 12, 28, tzinfo=datetime.timezone.utc)
+        assert int(date_trunc_bucket(t, "week")[0]) == int(monday.timestamp() * 1000)
+
+
+class TestCompactRows:
+    def test_stable_compact(self):
+        cols = {"a": jnp.array([1, 2, 3, 4, 5])}
+        mask = jnp.array([False, True, False, True, True])
+        out, m = compact_rows(cols, mask)
+        np.testing.assert_array_equal(np.asarray(out["a"])[:3], [2, 4, 5])
+        np.testing.assert_array_equal(np.asarray(m), [True, True, True, False, False])
+
+
+class TestIntPrecisionRegressions:
+    """Regression: integer aggregates must not round-trip through f32."""
+
+    def test_int64_sum_exact(self):
+        big = 2**53
+        v = jnp.array([big, 1, 1], dtype=jnp.int64)
+        m = jnp.ones(3, bool)
+        assert int(masked_reduce(v, m, "sum")) == big + 2
+        ids = jnp.zeros(3, dtype=jnp.int32)
+        assert int(np.asarray(segment_reduce(v, ids, 1, "sum"))[0]) == big + 2
+
+    def test_int_minmax_dtype_and_empty(self):
+        v = jnp.array([5, 3], dtype=jnp.int64)
+        ids = jnp.array([0, 0], dtype=jnp.int32)
+        mn = segment_reduce(v, ids, 2, "min")
+        assert mn.dtype == jnp.int64
+        assert int(mn[0]) == 3 and int(mn[1]) == 0  # empty int segment -> 0
+        cnt = segment_reduce(v, ids, 2, "count")
+        assert int(cnt[1]) == 0  # caller uses count to detect NULL
+
+    def test_searchsorted_bucket_oob(self):
+        from greptimedb_tpu.ops.time import searchsorted_bucket
+
+        edges = jnp.array([0, 100, 200], dtype=jnp.int64)
+        ts = jnp.array([-5, 0, 150, 200, 250], dtype=jnp.int64)
+        got = np.asarray(searchsorted_bucket(ts, edges))
+        np.testing.assert_array_equal(got, [-1, 0, 1, -1, -1])
