@@ -113,18 +113,14 @@ class RecordBatch:
                 py = arr.to_pylist()
                 cols[c.name] = np.array(["" if v is None else v for v in py], dtype=object)
             else:
-                np_arr = arr.to_numpy(zero_copy_only=False)
                 target = c.dtype.to_numpy()
+                if arr.null_count and not c.dtype.is_float:
+                    # fill nulls BEFORE to_numpy: pyarrow otherwise widens
+                    # ints to float64, corrupting values above 2^53 (nulls
+                    # are already recorded in the mask above)
+                    arr = arr.fill_null(0)
+                np_arr = arr.to_numpy(zero_copy_only=False)
                 if np_arr.dtype != target:
-                    # pyarrow widens nullable ints to float64 (nulls→NaN);
-                    # route nulls through the mask and restore the dtype.
-                    if np.issubdtype(np_arr.dtype, np.floating) and not c.dtype.is_float:
-                        isnan = np.isnan(np_arr)
-                        if isnan.any():
-                            nulls[c.name] = isnan | nulls.get(
-                                c.name, np.zeros(len(np_arr), bool)
-                            )
-                            np_arr = np.where(isnan, 0, np_arr)
                     np_arr = np_arr.astype(target)
                 cols[c.name] = np.ascontiguousarray(np_arr)
         return RecordBatch(schema, cols, nulls)

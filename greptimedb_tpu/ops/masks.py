@@ -6,20 +6,13 @@ SURVEY.md §7.3 item 1). Rows are physically compacted only at
 materialization boundaries (LIMIT, host download) via a stable
 argsort-on-mask gather.
 
-Dtype rules: float reductions stay in the input float dtype (f32 on
-device); integer sum/min/max accumulate in int64 — never through float
-(large BIGINT counters must not lose low bits). Empty result encoding:
-float min/max/mean → NaN; int min/max → 0 with the caller consulting
-``count`` for SQL NULL (ints have no NaN).
+Dtype/empty-encoding rules live in ops.segment (single source of truth);
+masked_reduce is the num_segments=1 special case.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
-import numpy as np
-
-_I64_MIN = np.int64(np.iinfo(np.int64).min)
-_I64_MAX = np.int64(np.iinfo(np.int64).max)
 
 
 def valid_mask(values: jnp.ndarray, row_mask: jnp.ndarray) -> jnp.ndarray:
@@ -30,41 +23,15 @@ def valid_mask(values: jnp.ndarray, row_mask: jnp.ndarray) -> jnp.ndarray:
 
 
 def masked_reduce(values: jnp.ndarray, row_mask: jnp.ndarray, op: str) -> jnp.ndarray:
-    """Whole-column reduction honoring mask/null discipline."""
-    m = valid_mask(values, row_mask)
-    cnt = jnp.sum(m.astype(jnp.int64))
-    if op == "count":
-        return cnt
-    is_float = jnp.issubdtype(values.dtype, jnp.floating)
+    """Whole-column reduction honoring mask/null discipline.
 
-    if not is_float:
-        v = values.astype(jnp.int64)
-        if op == "sum":
-            return jnp.sum(jnp.where(m, v, 0))
-        if op == "min":
-            out = jnp.min(jnp.where(m, v, _I64_MAX))
-            return jnp.where(cnt > 0, out, 0)
-        if op == "max":
-            out = jnp.max(jnp.where(m, v, _I64_MIN))
-            return jnp.where(cnt > 0, out, 0)
-        if op == "mean":
-            s = jnp.sum(jnp.where(m, v, 0)).astype(jnp.float32)
-            return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1).astype(jnp.float32),
-                             jnp.nan)
-        raise ValueError(f"unknown reduce op: {op}")
+    Delegates to segment_reduce with a single segment so the dtype and
+    empty-result conventions cannot diverge between the two entry points.
+    """
+    from greptimedb_tpu.ops.segment import segment_reduce
 
-    v = values
-    empty_nan = jnp.where(cnt > 0, 0.0, jnp.nan).astype(v.dtype)
-    if op == "sum":
-        return jnp.sum(jnp.where(m, v, 0))
-    if op == "mean":
-        s = jnp.sum(jnp.where(m, v, 0))
-        return s / jnp.maximum(cnt, 1).astype(v.dtype) + empty_nan
-    if op == "min":
-        return jnp.min(jnp.where(m, v, jnp.inf)) + empty_nan
-    if op == "max":
-        return jnp.max(jnp.where(m, v, -jnp.inf)) + empty_nan
-    raise ValueError(f"unknown reduce op: {op}")
+    ids = jnp.zeros(values.shape, dtype=jnp.int32)
+    return segment_reduce(values, ids, 1, op, row_mask)[0]
 
 
 def compact_rows(
@@ -80,7 +47,3 @@ def compact_rows(
     out = {k: v[order] for k, v in columns.items()}
     new_mask = row_mask[order]
     return out, new_mask
-
-
-def nan_to_null_count(values: jnp.ndarray, row_mask: jnp.ndarray) -> jnp.ndarray:
-    return jnp.sum((row_mask & ~valid_mask(values, row_mask)).astype(jnp.int32))

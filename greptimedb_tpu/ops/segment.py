@@ -119,10 +119,13 @@ def segment_reduce(
         return jnp.where(cnt > 0, out, 0)
 
     if op == "mean":
-        v = values if is_float else values.astype(jnp.float32)
+        # ints: sum exactly in int64, divide in float (matches masked_reduce)
+        v = values if is_float else values.astype(jnp.int64)
         s = jax.ops.segment_sum(
             jnp.where(m, v, 0), ids, num_segments=ns, indices_are_sorted=srt
         )[:num_segments]
+        if not is_float:
+            s = s.astype(jnp.float32)
         cnt = _seg_count(m, ids, ns, srt)[:num_segments]
         return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1).astype(s.dtype), jnp.nan)
 
@@ -183,7 +186,12 @@ def segment_first_last(
     has = win_idx < _I64_MAX
     safe_idx = jnp.where(has, win_idx, 0)
     out_ts = jnp.where(has, ts[safe_idx], 0)
-    out_val = jnp.where(has, values[safe_idx], jnp.nan)
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        out_val = jnp.where(has, values[safe_idx], jnp.nan)
+    else:
+        # int values keep their dtype exactly; empty segment -> 0, caller
+        # consults a count for SQL NULL (module dtype convention)
+        out_val = jnp.where(has, values[safe_idx], 0)
     return out_ts, out_val
 
 

@@ -1,0 +1,21 @@
+"""Storage engine: the mito2-equivalent region store, CPU-side by design.
+
+Parquet/WAL/manifest are I/O-bound — they stay host code (SURVEY.md §7.1
+"storage stays CPU-side"); the engine's job is to land query-ready columnar
+data in TPU HBM fast. Layout per region:
+
+    <data_home>/<region_id>/
+        wal/          segmented write-ahead log (replayed on open)
+        sst/          Parquet files, time-sorted within series
+        manifest/     action log + checkpoints (schema, SST list, dicts)
+
+Write path (reference src/mito2/src/worker/handle_write.rs): WAL append →
+memtable insert; flush freezes the memtable into a sorted, deduped Parquet
+SST and records a manifest edit. Read path (reference scan_region.rs):
+prune SSTs by time range → merge with memtable → dedup by (series, ts, seq)
+→ upload to the device-resident RegionCache consumed by the query engine.
+"""
+
+from greptimedb_tpu.storage.region import RegionEngine, Region, RegionOptions
+
+__all__ = ["RegionEngine", "Region", "RegionOptions"]

@@ -1,0 +1,151 @@
+"""Region manifest: versioned action log + checkpoints.
+
+Equivalent of the reference's manifest (src/mito2/src/manifest/{action.rs,
+checkpointer.rs,manager.rs}, SURVEY.md §5.4 mechanism 2): every metadata
+mutation (SST add/remove, schema change, flushed-sequence advance, dict
+growth) is an appended JSON action file; a checkpoint collapses the prefix
+so region open replays O(recent) actions, not history.
+
+Layout under <region>/manifest/:
+    checkpoint-<version>.json   full state at version
+    delta-<version>.json        one action, applied in version order
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.storage.object_store import ObjectStore
+from greptimedb_tpu.storage.sst import SstMeta
+
+CHECKPOINT_EVERY = 16
+
+
+@dataclass
+class ManifestState:
+    schema: Schema | None = None
+    files: dict[str, SstMeta] = field(default_factory=dict)
+    flushed_seq: int = 0
+    truncated_seq: int = 0
+    # tag dictionaries: column -> list of values (code = index); series
+    # registry: list of tuples of tag codes (tsid = index)
+    dicts: dict[str, list] = field(default_factory=dict)
+    series: list[list[int]] = field(default_factory=list)
+    options: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": self.schema.to_dict() if self.schema else None,
+            "files": {k: v.to_dict() for k, v in self.files.items()},
+            "flushed_seq": self.flushed_seq,
+            "truncated_seq": self.truncated_seq,
+            "dicts": self.dicts,
+            "series": self.series,
+            "options": self.options,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ManifestState":
+        return ManifestState(
+            schema=Schema.from_dict(d["schema"]) if d.get("schema") else None,
+            files={k: SstMeta.from_dict(v) for k, v in d.get("files", {}).items()},
+            flushed_seq=d.get("flushed_seq", 0),
+            truncated_seq=d.get("truncated_seq", 0),
+            dicts=d.get("dicts", {}),
+            series=d.get("series", []),
+            options=d.get("options", {}),
+        )
+
+    def apply(self, action: dict) -> None:
+        kind = action["kind"]
+        if kind == "edit":
+            for f in action.get("add", []):
+                m = SstMeta.from_dict(f)
+                self.files[m.file_id] = m
+            for fid in action.get("remove", []):
+                self.files.pop(fid, None)
+            if "flushed_seq" in action:
+                self.flushed_seq = max(self.flushed_seq, action["flushed_seq"])
+        elif kind == "schema":
+            self.schema = Schema.from_dict(action["schema"])
+        elif kind == "dicts":
+            # append-only growth of tag dictionaries / series registry
+            for col, vals in action.get("dicts", {}).items():
+                cur = self.dicts.setdefault(col, [])
+                cur.extend(vals[len(cur):])
+            self.series.extend(action.get("series", [])[len(self.series):])
+        elif kind == "truncate":
+            self.files.clear()
+            self.truncated_seq = action["truncated_seq"]
+            self.flushed_seq = max(self.flushed_seq, action["truncated_seq"])
+        elif kind == "options":
+            self.options.update(action["options"])
+        else:
+            raise ValueError(f"unknown manifest action kind: {kind}")
+
+
+class Manifest:
+    def __init__(self, store: ObjectStore, manifest_dir: str):
+        self.store = store
+        self.dir = manifest_dir
+        self.version = 0
+        self.state = ManifestState()
+        self._actions_since_checkpoint = 0
+
+    # ---- open/replay ----------------------------------------------------
+    @staticmethod
+    def open(store: ObjectStore, manifest_dir: str) -> "Manifest":
+        m = Manifest(store, manifest_dir)
+        entries = store.list(manifest_dir)
+        ckpt_versions = []
+        delta_versions = []
+        for p in entries:
+            fn = p.rsplit("/", 1)[-1]
+            if fn.startswith("checkpoint-"):
+                ckpt_versions.append(int(fn[len("checkpoint-"):-len(".json")]))
+            elif fn.startswith("delta-"):
+                delta_versions.append(int(fn[len("delta-"):-len(".json")]))
+        base = 0
+        if ckpt_versions:
+            base = max(ckpt_versions)
+            raw = json.loads(store.read(f"{manifest_dir}/checkpoint-{base:020d}.json"))
+            m.state = ManifestState.from_dict(raw)
+            m.version = base
+        for v in sorted(x for x in delta_versions if x > base):
+            action = json.loads(store.read(f"{manifest_dir}/delta-{v:020d}.json"))
+            m.state.apply(action)
+            m.version = v
+        return m
+
+    @property
+    def exists(self) -> bool:
+        return self.state.schema is not None
+
+    # ---- mutation -------------------------------------------------------
+    def commit(self, action: dict) -> int:
+        self.state.apply(action)
+        self.version += 1
+        self.store.write(
+            f"{self.dir}/delta-{self.version:020d}.json",
+            json.dumps(action).encode(),
+        )
+        self._actions_since_checkpoint += 1
+        if self._actions_since_checkpoint >= CHECKPOINT_EVERY:
+            self.checkpoint()
+        return self.version
+
+    def checkpoint(self) -> None:
+        self.store.write(
+            f"{self.dir}/checkpoint-{self.version:020d}.json",
+            json.dumps(self.state.to_dict()).encode(),
+        )
+        self._actions_since_checkpoint = 0
+        # GC superseded deltas/checkpoints
+        for p in self.store.list(self.dir):
+            fn = p.rsplit("/", 1)[-1]
+            if fn.startswith("delta-") and int(fn[6:-5]) <= self.version:
+                self.store.delete(p)
+            elif fn.startswith("checkpoint-") and int(fn[11:-5]) < self.version:
+                self.store.delete(p)

@@ -1,0 +1,112 @@
+"""Object store abstraction (reference: src/object-store over OpenDAL).
+
+Only the operations the engine needs: atomic write, read, list, delete.
+``FsObjectStore`` is the local-disk backend; the interface is narrow enough
+that an S3/GCS backend is a drop-in (multipart + rename-free atomic write
+via temp object + copy).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+class ObjectStore:
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def local_path(self, path: str) -> str | None:
+        """Filesystem path if this store is disk-backed (lets pyarrow mmap),
+        else None and callers fall back to read()."""
+        return None
+
+
+class FsObjectStore(ObjectStore):
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _abs(self, path: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, path.lstrip("/")))
+        if not p.startswith(self.root):
+            raise ValueError(f"path escapes store root: {path}")
+        return p
+
+    def write(self, path: str, data: bytes) -> None:
+        p = self._abs(path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        # atomic: temp file + rename
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(p))
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, p)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def read(self, path: str) -> bytes:
+        with open(self._abs(path), "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._abs(path))
+
+    def list(self, prefix: str) -> list[str]:
+        base = self._abs(prefix)
+        if not os.path.isdir(base):
+            return []
+        out = []
+        for dirpath, _dirs, files in os.walk(base):
+            for fn in files:
+                full = os.path.join(dirpath, fn)
+                out.append(os.path.relpath(full, self.root))
+        return sorted(out)
+
+    def delete(self, path: str) -> None:
+        p = self._abs(path)
+        if os.path.exists(p):
+            os.unlink(p)
+
+    def local_path(self, path: str) -> str | None:
+        return self._abs(path)
+
+
+class MemoryObjectStore(ObjectStore):
+    """In-memory backend for tests (reference uses OpenDAL's memory service
+    the same way, src/object-store/Cargo.toml:12)."""
+
+    def __init__(self):
+        self._data: dict[str, bytes] = {}
+
+    def write(self, path: str, data: bytes) -> None:
+        self._data[path.lstrip("/")] = bytes(data)
+
+    def read(self, path: str) -> bytes:
+        return self._data[path.lstrip("/")]
+
+    def exists(self, path: str) -> bool:
+        return path.lstrip("/") in self._data
+
+    def list(self, prefix: str) -> list[str]:
+        p = prefix.lstrip("/")
+        return sorted(k for k in self._data if k.startswith(p))
+
+    def delete(self, path: str) -> None:
+        self._data.pop(path.lstrip("/"), None)

@@ -1,0 +1,166 @@
+"""SST layer: sorted Parquet files with pruning stats.
+
+Equivalent of the reference's flat SST format
+(src/mito2/src/sst/parquet/flat_format.rs: raw key columns + __primary_key/
+__sequence/__op_type internal columns): each SST stores the table's columns
+(tags dictionary-encoded by Parquet itself) plus __tsid__/__seq__/__op__,
+sorted by (tsid, ts, seq). File-level stats (time range, row count, seq
+range) live in the manifest for pruning; row-group stats inside the Parquet
+footer give a second pruning level (reference reader.rs row-group pruning).
+"""
+
+from __future__ import annotations
+
+import io
+import uuid
+from dataclasses import dataclass, field
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.storage.memtable import OP, SEQ, TSID
+from greptimedb_tpu.storage.object_store import ObjectStore
+
+
+@dataclass(frozen=True)
+class SstMeta:
+    file_id: str
+    path: str
+    num_rows: int
+    ts_min: int
+    ts_max: int
+    seq_min: int
+    seq_max: int
+    size_bytes: int
+    level: int = 0
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+    @staticmethod
+    def from_dict(d: dict) -> "SstMeta":
+        return SstMeta(**d)
+
+    def overlaps(self, ts_start: int | None, ts_end: int | None) -> bool:
+        """Half-open [ts_start, ts_end) vs this file's closed [min,max]."""
+        if ts_start is not None and self.ts_max < ts_start:
+            return False
+        if ts_end is not None and self.ts_min >= ts_end:
+            return False
+        return True
+
+
+def _arrow_schema(schema: Schema) -> pa.Schema:
+    fields = []
+    for c in schema:
+        f = c.to_arrow()
+        if c.is_tag and pa.types.is_string(f.type):
+            f = pa.field(f.name, pa.dictionary(pa.int32(), pa.utf8()), nullable=f.nullable)
+        fields.append(f)
+    fields.append(pa.field(TSID, pa.int64(), nullable=False))
+    fields.append(pa.field(SEQ, pa.int64(), nullable=False))
+    fields.append(pa.field(OP, pa.int8(), nullable=False))
+    return pa.schema(fields)
+
+
+def write_sst(
+    store: ObjectStore,
+    sst_dir: str,
+    schema: Schema,
+    columns: dict[str, np.ndarray],
+    level: int = 0,
+    row_group_size: int = 256 * 1024,
+) -> SstMeta:
+    """Write one sorted SST; caller guarantees (tsid, ts, seq) order."""
+    ts_col = schema.time_index.name
+    n = len(columns[SEQ])
+    file_id = uuid.uuid4().hex
+    path = f"{sst_dir}/{file_id}.parquet"
+
+    target = _arrow_schema(schema)
+    arrays = []
+    for f in target:
+        col = columns[f.name]
+        if pa.types.is_dictionary(f.type):
+            arrays.append(
+                pa.array(col.astype(object), type=pa.utf8()).dictionary_encode()
+            )
+        else:
+            arrays.append(pa.array(col, type=f.type))
+    table = pa.Table.from_arrays(arrays, schema=target)
+
+    sink = io.BytesIO()
+    pq.write_table(
+        table,
+        sink,
+        row_group_size=row_group_size,
+        compression="zstd",
+        compression_level=1,
+        use_dictionary=True,
+        write_statistics=True,
+    )
+    data = sink.getvalue()
+    store.write(path, data)
+    ts = columns[ts_col]
+    seq = columns[SEQ]
+    return SstMeta(
+        file_id=file_id,
+        path=path,
+        num_rows=n,
+        ts_min=int(ts.min()),
+        ts_max=int(ts.max()),
+        seq_min=int(seq.min()),
+        seq_max=int(seq.max()),
+        size_bytes=len(data),
+        level=level,
+    )
+
+
+def read_sst(
+    store: ObjectStore,
+    meta: SstMeta,
+    schema: Schema,
+    ts_range: tuple[int | None, int | None] = (None, None),
+    columns: list[str] | None = None,
+) -> dict[str, np.ndarray]:
+    """Read an SST back into numpy columns, pruning row groups by time.
+
+    Tag dictionary columns come back as raw values (object arrays);
+    re-encoding to region codes happens in the cache layer against the
+    region dictionaries.
+    """
+    ts_idx = schema.time_index
+    ts_col = ts_idx.name
+    ts_type = pa.timestamp(ts_idx.dtype.time_unit.value)
+    filters = None
+    lo, hi = ts_range
+    if lo is not None or hi is not None:
+        conj = []
+        if lo is not None:
+            conj.append((ts_col, ">=", pa.scalar(int(lo), type=ts_type)))
+        if hi is not None:
+            conj.append((ts_col, "<", pa.scalar(int(hi), type=ts_type)))
+        filters = conj
+
+    local = store.local_path(meta.path)
+    src = local if local else io.BytesIO(store.read(meta.path))
+    want = columns
+    table = pq.read_table(src, columns=want, filters=filters)
+
+    out: dict[str, np.ndarray] = {}
+    for name in table.column_names:
+        arr = table.column(name).combine_chunks()
+        if pa.types.is_dictionary(arr.type):
+            # decode via the (small) dictionary, not per-row python objects
+            dict_vals = np.asarray(arr.dictionary.to_pylist(), dtype=object)
+            indices = arr.indices.to_numpy(zero_copy_only=False)
+            out[name] = dict_vals[indices]
+        elif pa.types.is_string(arr.type) or pa.types.is_binary(arr.type):
+            out[name] = np.asarray(arr.to_pylist(), dtype=object)
+        elif pa.types.is_timestamp(arr.type):
+            out[name] = arr.to_numpy(zero_copy_only=False).astype("int64")
+        else:
+            out[name] = arr.to_numpy(zero_copy_only=False)
+    return out

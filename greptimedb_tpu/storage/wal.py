@@ -1,0 +1,158 @@
+"""Write-ahead log: segmented, CRC-checked, per-region append log.
+
+Equivalent of the reference's raft-engine local WAL
+(src/log-store/src/raft_engine/) behind the LogStore trait
+(src/store-api/src/logstore.rs:51): entries are (region, sequence, payload)
+appended durably before memtable writes; region open replays entries past
+the flushed sequence (SURVEY.md §5.4 mechanism 1). A Kafka-style remote WAL
+can implement the same LogStore interface later.
+
+Record format (little-endian): [u32 len][u32 crc32(payload)][u64 sequence]
+[payload]. Torn tails (crash mid-append) are detected by length/CRC and
+truncated on replay. Payloads are columnar row groups serialized with
+Arrow IPC — portable and fast, no pickle.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+import zlib
+
+import pyarrow as pa
+import pyarrow.ipc
+
+_HDR = struct.Struct("<IIQ")
+_SEGMENT_TARGET = 64 * 1024 * 1024
+
+
+class LogStore:
+    """Interface (reference store-api logstore.rs:51)."""
+
+    def append(self, sequence: int, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def replay(self, from_sequence: int):
+        raise NotImplementedError
+
+    def truncate(self, up_to_sequence: int) -> None:
+        raise NotImplementedError
+
+
+class FileLogStore(LogStore):
+    """One directory of numbered segment files per region."""
+
+    def __init__(self, wal_dir: str, sync: bool = False):
+        self.dir = wal_dir
+        self.sync = sync
+        os.makedirs(wal_dir, exist_ok=True)
+        segs = self._segments()
+        self._current_id = segs[-1] if segs else 0
+        self._fh = open(self._seg_path(self._current_id), "ab")
+
+    def _seg_path(self, seg_id: int) -> str:
+        return os.path.join(self.dir, f"{seg_id:020d}.wal")
+
+    def _segments(self) -> list[int]:
+        out = []
+        for fn in os.listdir(self.dir):
+            if fn.endswith(".wal"):
+                out.append(int(fn[:-4]))
+        return sorted(out)
+
+    def append(self, sequence: int, payload: bytes) -> None:
+        rec = _HDR.pack(len(payload), zlib.crc32(payload), sequence) + payload
+        self._fh.write(rec)
+        self._fh.flush()
+        if self.sync:
+            os.fsync(self._fh.fileno())
+        if self._fh.tell() >= _SEGMENT_TARGET:
+            self._roll()
+
+    def _roll(self) -> None:
+        self._fh.close()
+        self._current_id += 1
+        self._fh = open(self._seg_path(self._current_id), "ab")
+
+    def replay(self, from_sequence: int = 0):
+        """Yield (sequence, payload) for entries with sequence >= from_sequence.
+        Stops (and truncates) at the first torn/corrupt record."""
+        for seg in self._segments():
+            path = self._seg_path(seg)
+            with open(path, "rb") as f:
+                data = f.read()
+            off = 0
+            good_end = 0
+            while off + _HDR.size <= len(data):
+                ln, crc, seq = _HDR.unpack_from(data, off)
+                end = off + _HDR.size + ln
+                if end > len(data):
+                    break
+                payload = data[off + _HDR.size : end]
+                if zlib.crc32(payload) != crc:
+                    break
+                good_end = end
+                off = end
+                if seq >= from_sequence:
+                    yield seq, payload
+            if good_end < len(data):
+                # torn tail: truncate so future appends start clean
+                with open(path, "r+b") as f:
+                    f.truncate(good_end)
+                if seg == self._current_id:
+                    self._fh.close()
+                    self._fh = open(path, "ab")
+                break
+
+    def truncate(self, up_to_sequence: int) -> None:
+        """Drop whole segments whose every entry is below up_to_sequence."""
+        for seg in self._segments()[:-1]:  # never drop the active segment
+            path = self._seg_path(seg)
+            keep = False
+            with open(path, "rb") as f:
+                data = f.read()
+            off = 0
+            while off + _HDR.size <= len(data):
+                ln, _crc, seq = _HDR.unpack_from(data, off)
+                if seq >= up_to_sequence:
+                    keep = True
+                    break
+                off += _HDR.size + ln
+            if not keep:
+                os.unlink(path)
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class NoopLogStore(LogStore):
+    """WAL-less mode for benchmarks (reference src/log-store/src/noop/)."""
+
+    def append(self, sequence: int, payload: bytes) -> None:
+        pass
+
+    def replay(self, from_sequence: int = 0):
+        return iter(())
+
+    def truncate(self, up_to_sequence: int) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+# ---- payload codec: Arrow IPC over the write columns -----------------------
+
+def encode_write(columns: dict) -> bytes:
+    table = pa.table(columns)
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue()
+
+
+def decode_write(payload: bytes) -> dict:
+    with pa.ipc.open_stream(io.BytesIO(payload)) as r:
+        table = r.read_all()
+    return {name: table.column(name).combine_chunks() for name in table.column_names}

@@ -214,3 +214,19 @@ class TestNullHandlingRegressions:
         )
         back = DeviceBatch.from_host(rb).to_host(s)
         assert back.to_pydict()["usage"] == [1.5, None]
+
+    def test_big_int64_null_from_arrow_exact(self):
+        import pyarrow as pa
+
+        s = make_schema()
+        big = 2**53 + 1
+        t = pa.table(
+            {
+                "host": pa.array(["a", "b"]),
+                "ts": pa.array([1, 2], pa.timestamp("ms")),
+                "usage": pa.array([0.0, 0.0]),
+                "count": pa.array([big, None], pa.int64()),
+            }
+        )
+        rb = RecordBatch.from_arrow(t, s)
+        assert rb.to_pydict()["count"] == [big, None]

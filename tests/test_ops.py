@@ -212,3 +212,28 @@ class TestIntPrecisionRegressions:
         ts = jnp.array([-5, 0, 150, 200, 250], dtype=jnp.int64)
         got = np.asarray(searchsorted_bucket(ts, edges))
         np.testing.assert_array_equal(got, [-1, 0, 1, -1, -1])
+
+
+class TestReviewRound2Regressions:
+    def test_int_mean_exact_sum(self):
+        v = jnp.array([2**31, 1], dtype=jnp.int64)
+        ids = jnp.zeros(2, dtype=jnp.int32)
+        got = float(np.asarray(segment_reduce(v, ids, 1, "mean"))[0])
+        # int64 sum then float divide: (2^31+1)/2
+        assert got == pytest.approx((2**31 + 1) / 2, rel=1e-7)
+        assert float(masked_reduce(v, jnp.ones(2, bool), "mean")) == got
+
+    def test_first_last_int_dtype_preserved(self):
+        ts = jnp.array([1, 2], dtype=jnp.int64)
+        vals = jnp.array([2**53 + 1, 7], dtype=jnp.int64)
+        out_ts, out_val = segment_first_last(ts, vals, jnp.zeros(2, jnp.int32), 2,
+                                             last=False)
+        assert out_val.dtype == jnp.int64
+        assert int(out_val[0]) == 2**53 + 1
+        assert int(out_val[1]) == 0  # empty int segment -> 0
+
+    def test_masked_reduce_int_sum_empty(self):
+        v = jnp.array([3, 4], dtype=jnp.int64)
+        m = jnp.zeros(2, bool)
+        assert int(masked_reduce(v, m, "sum")) == 0
+        assert int(masked_reduce(v, m, "min")) == 0
