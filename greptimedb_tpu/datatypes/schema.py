@@ -169,3 +169,20 @@ class Schema:
 
     def empty_columns(self) -> dict[str, np.ndarray]:
         return {c.name: np.empty(0, dtype=c.dtype.to_numpy()) for c in self.columns}
+
+
+def default_fill_array(col: ColumnSchema, n: int) -> np.ndarray:
+    """n rows of a column's fill value: declared default, else null encoding
+    (NaN for floats, ""/0 otherwise). Single source for every path that
+    materializes rows predating a column (write fill, SST backfill)."""
+    if col.dtype.is_string_like:
+        fill = col.default if col.default is not None else ""
+        return np.full(n, fill, dtype=object)
+    if col.default is not None:
+        dt = np.int64 if col.dtype.is_timestamp else col.dtype.to_numpy()
+        return np.full(n, col.default, dtype=dt)
+    if col.dtype.is_float:
+        return np.full(n, np.nan, dtype=col.dtype.to_numpy())
+    if col.dtype.is_timestamp:
+        return np.zeros(n, dtype=np.int64)
+    return np.zeros(n, dtype=col.dtype.to_numpy())

@@ -1,0 +1,6 @@
+"""Metadata & cluster control plane (reference SURVEY.md §2.8/§2.9 layer 9).
+
+CPU-side by design: kv backend, catalog, procedures, heartbeats, failure
+detection port nearly verbatim from the reference's architecture — no TPU
+involvement (SURVEY.md §7.1).
+"""

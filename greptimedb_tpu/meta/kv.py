@@ -1,0 +1,105 @@
+"""KvBackend: the metadata substrate (reference src/common/meta/src/kv_backend.rs:53).
+
+Range scans over sorted keys, atomic compare-and-put for the txn uses the
+reference makes (metadata transactions RFC), and a file-backed
+implementation standing in for etcd in standalone mode (the reference
+embeds raft-engine kv the same way, src/standalone/src/metadata.rs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class KvBackend:
+    def get(self, key: str) -> bytes | None:
+        raise NotImplementedError
+
+    def put(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def range(self, prefix: str) -> list[tuple[str, bytes]]:
+        raise NotImplementedError
+
+    def compare_and_put(
+        self, key: str, expect: bytes | None, value: bytes
+    ) -> bool:
+        raise NotImplementedError
+
+    # convenience json codecs
+    def get_json(self, key: str):
+        raw = self.get(key)
+        return None if raw is None else json.loads(raw)
+
+    def put_json(self, key: str, value) -> None:
+        self.put(key, json.dumps(value).encode())
+
+
+class MemoryKv(KvBackend):
+    def __init__(self):
+        self._data: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: str) -> bytes | None:
+        return self._data.get(key)
+
+    def put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._data[key] = bytes(value)
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._data.pop(key, None) is not None
+
+    def range(self, prefix: str) -> list[tuple[str, bytes]]:
+        return sorted(
+            (k, v) for k, v in self._data.items() if k.startswith(prefix)
+        )
+
+    def compare_and_put(self, key: str, expect: bytes | None, value: bytes) -> bool:
+        with self._lock:
+            cur = self._data.get(key)
+            if cur != expect:
+                return False
+            self._data[key] = bytes(value)
+            return True
+
+
+class FileKv(MemoryKv):
+    """Write-through JSON file persistence (standalone embedded metadata)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                raw = json.load(f)
+            self._data = {k: v.encode("utf-8") for k, v in raw.items()}
+
+    def _persist(self) -> None:
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({k: v.decode("utf-8") for k, v in self._data.items()}, f)
+        os.replace(tmp, self.path)
+
+    def put(self, key: str, value: bytes) -> None:
+        super().put(key, value)
+        self._persist()
+
+    def delete(self, key: str) -> bool:
+        ok = super().delete(key)
+        if ok:
+            self._persist()
+        return ok
+
+    def compare_and_put(self, key: str, expect: bytes | None, value: bytes) -> bool:
+        ok = super().compare_and_put(key, expect, value)
+        if ok:
+            self._persist()
+        return ok

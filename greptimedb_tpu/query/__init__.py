@@ -1,0 +1,14 @@
+"""Query engine: SQL → logical plan → XLA execution.
+
+The TPU re-design of the reference's query stack (SURVEY.md §2.3):
+sqlparser-rs + DataFusion become a hand-rolled SQL front-end and a lowering
+from logical plans to jitted JAX programs over DeviceTables. CPU keeps what
+is control logic (parsing, planning, optimization, result shaping); the
+device runs what is data (filter masks, segment aggregation, windowed
+evaluation) — one fused XLA computation per (plan fingerprint, shape
+class), cached across queries.
+"""
+
+from greptimedb_tpu.query.engine import QueryEngine
+
+__all__ = ["QueryEngine"]

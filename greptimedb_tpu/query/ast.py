@@ -1,0 +1,316 @@
+"""SQL AST: expressions and statements.
+
+Statement coverage mirrors the reference's sql crate surface that matters
+for round-trip compatibility (src/sql/src/statements/): query, DML, DDL
+(tables/databases/flows), SHOW/DESCRIBE introspection, TQL (PromQL-in-SQL,
+src/sql/src/statements/tql.rs), EXPLAIN, COPY, and admin function calls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+# ---- expressions -----------------------------------------------------------
+
+class Expr:
+    pass
+
+
+@dataclass(frozen=True)
+class Column(Expr):
+    name: str
+    table: str | None = None
+
+    def __str__(self):
+        return f"{self.table}.{self.name}" if self.table else self.name
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: object  # int | float | str | bool | None
+
+    def __str__(self):
+        if isinstance(self.value, str):
+            return "'" + self.value.replace("'", "''") + "'"
+        if self.value is None:
+            return "NULL"
+        return str(self.value)
+
+
+@dataclass(frozen=True)
+class IntervalLit(Expr):
+    """Interval normalized to milliseconds (fixed-width units only)."""
+
+    ms: int
+    raw: str = ""
+
+    def __str__(self):
+        return f"INTERVAL '{self.raw}'"
+
+
+@dataclass(frozen=True)
+class BinaryOp(Expr):
+    op: str  # + - * / % = != < <= > >= AND OR LIKE IN ...
+    left: Expr
+    right: Expr
+
+    def __str__(self):
+        return f"{self.left} {self.op} {self.right}"
+
+
+@dataclass(frozen=True)
+class UnaryOp(Expr):
+    op: str  # NOT, -
+    operand: Expr
+
+    def __str__(self):
+        return f"{self.op} {self.operand}"
+
+
+@dataclass(frozen=True)
+class FuncCall(Expr):
+    name: str  # lowercase
+    args: tuple[Expr, ...] = ()
+    distinct: bool = False
+
+    def __str__(self):
+        inner = ", ".join(str(a) for a in self.args)
+        if self.distinct:
+            inner = "DISTINCT " + inner
+        return f"{self.name}({inner})"
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    table: str | None = None
+
+    def __str__(self):
+        return "*"
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    expr: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+    def __str__(self):
+        n = " NOT" if self.negated else ""
+        return f"{self.expr}{n} BETWEEN {self.low} AND {self.high}"
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    expr: Expr
+    items: tuple[Expr, ...]
+    negated: bool = False
+
+    def __str__(self):
+        n = " NOT" if self.negated else ""
+        return f"{self.expr}{n} IN ({', '.join(map(str, self.items))})"
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    expr: Expr
+    negated: bool = False
+
+    def __str__(self):
+        return f"{self.expr} IS {'NOT ' if self.negated else ''}NULL"
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    expr: Expr
+    type_name: str
+
+    def __str__(self):
+        return f"CAST({self.expr} AS {self.type_name})"
+
+
+@dataclass(frozen=True)
+class Case(Expr):
+    operand: Expr | None
+    whens: tuple[tuple[Expr, Expr], ...]
+    else_: Expr | None
+
+    def __str__(self):
+        return "CASE ... END"
+
+
+# ---- statements ------------------------------------------------------------
+
+class Statement:
+    pass
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Expr
+    alias: str | None = None
+    # range-select extension: `agg(x) RANGE '5m' [FILL ...]` per item
+    # (reference src/query/src/range_select/plan.rs)
+    range_: "IntervalLit | None" = None
+    fill: str | None = None
+
+    @property
+    def output_name(self) -> str:
+        return self.alias if self.alias else str(self.expr)
+
+
+@dataclass(frozen=True)
+class OrderByItem:
+    expr: Expr
+    asc: bool = True
+    nulls_first: bool | None = None
+
+
+@dataclass
+class Select(Statement):
+    items: list[SelectItem]
+    table: str | None = None  # None for SELECT 1 / SELECT now()
+    table_alias: str | None = None
+    where: Expr | None = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: Expr | None = None
+    order_by: list[OrderByItem] = field(default_factory=list)
+    limit: int | None = None
+    offset: int | None = None
+    distinct: bool = False
+    # range-select extension (reference RANGE queries, range_select/plan.rs)
+    align: IntervalLit | None = None
+    align_by: list[Expr] = field(default_factory=list)
+    range_: IntervalLit | None = None
+    fill: str | None = None
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str
+    nullable: bool = True
+    default: object = None
+    comment: str | None = None
+
+
+@dataclass
+class CreateTable(Statement):
+    name: str
+    columns: list[ColumnDef]
+    time_index: str | None
+    primary_keys: list[str]
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)
+    partitions: list[str] = field(default_factory=list)
+    engine: str = "mito"
+
+
+@dataclass
+class CreateDatabase(Statement):
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class Insert(Statement):
+    table: str
+    columns: list[str]
+    rows: list[list[object]]
+
+
+@dataclass
+class Delete(Statement):
+    table: str
+    where: Expr | None
+
+
+@dataclass
+class DropTable(Statement):
+    names: list[str]
+    if_exists: bool = False
+
+
+@dataclass
+class DropDatabase(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class AlterTable(Statement):
+    table: str
+    action: str  # add_column | drop_column | rename
+    column: ColumnDef | None = None
+    name: str | None = None  # drop column name / rename target
+
+
+@dataclass
+class ShowTables(Statement):
+    database: str | None = None
+    like: str | None = None
+
+
+@dataclass
+class ShowDatabases(Statement):
+    like: str | None = None
+
+
+@dataclass
+class ShowCreateTable(Statement):
+    table: str
+
+
+@dataclass
+class DescribeTable(Statement):
+    table: str
+
+
+@dataclass
+class Use(Statement):
+    database: str
+
+
+@dataclass
+class Tql(Statement):
+    """TQL EVAL (start, end, step) <promql> — reference statements/tql.rs."""
+
+    command: str  # EVAL | ANALYZE | EXPLAIN
+    start: float
+    end: float
+    step: float
+    query: str
+    lookback: float | None = None
+
+
+@dataclass
+class Explain(Statement):
+    inner: Statement
+    analyze: bool = False
+
+
+@dataclass
+class TruncateTable(Statement):
+    table: str
+
+
+@dataclass
+class CreateFlow(Statement):
+    name: str
+    sink_table: str
+    query: Select
+    expire_after: IntervalLit | None = None
+    comment: str | None = None
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropFlow(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowFlows(Statement):
+    pass

@@ -1,0 +1,558 @@
+"""Expression compilation: SQL AST → device (jnp) and host (numpy) evaluators.
+
+Device compilation rules (SURVEY.md §7.1/7.3): the TPU sees only numeric
+tensors, so string semantics are resolved at COMPILE time against the tag
+dictionaries — `host = 'web-1'` becomes `codes == 17`, `host LIKE 'us-%'`
+becomes membership in a host-computed code set. Unseen values compile to
+code -1, which matches nothing.
+
+The host evaluator covers post-aggregation shaping (HAVING, ORDER BY
+expressions, final projections incl. strings) over small numpy columns.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.datatypes.batch import DictionaryEncoder
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.errors import ColumnNotFound, PlanError, Unsupported
+from greptimedb_tpu.ops.time import date_trunc_bucket, time_bucket
+from greptimedb_tpu.query.ast import (
+    Between, BinaryOp, Case, Cast, Column, Expr, FuncCall, InList, IntervalLit,
+    IsNull, Literal, Star, UnaryOp,
+)
+from greptimedb_tpu.query.parser import parse_timestamp_str
+
+AGG_FUNCS = {
+    "count", "sum", "min", "max", "avg", "mean", "first_value", "last_value",
+    "stddev", "stddev_pop", "var", "var_pop", "count_distinct",
+}
+
+
+def is_aggregate(e: Expr) -> bool:
+    if isinstance(e, FuncCall):
+        if e.name in AGG_FUNCS:
+            return True
+        return any(is_aggregate(a) for a in e.args)
+    if isinstance(e, BinaryOp):
+        return is_aggregate(e.left) or is_aggregate(e.right)
+    if isinstance(e, UnaryOp):
+        return is_aggregate(e.operand)
+    if isinstance(e, (Between,)):
+        return is_aggregate(e.expr)
+    if isinstance(e, Cast):
+        return is_aggregate(e.expr)
+    return False
+
+
+def collect_aggs(e: Expr, out: list[FuncCall]) -> None:
+    """All aggregate FuncCall nodes inside e (dedup by str)."""
+    if isinstance(e, FuncCall):
+        if e.name in AGG_FUNCS:
+            if str(e) not in {str(x) for x in out}:
+                out.append(e)
+            return
+        for a in e.args:
+            collect_aggs(a, out)
+    elif isinstance(e, BinaryOp):
+        collect_aggs(e.left, out)
+        collect_aggs(e.right, out)
+    elif isinstance(e, UnaryOp):
+        collect_aggs(e.operand, out)
+    elif isinstance(e, Between):
+        collect_aggs(e.expr, out)
+    elif isinstance(e, Cast):
+        collect_aggs(e.expr, out)
+    elif isinstance(e, Case):
+        for c, v in e.whens:
+            collect_aggs(c, out)
+            collect_aggs(v, out)
+        if e.else_ is not None:
+            collect_aggs(e.else_, out)
+
+
+class TableContext:
+    """Static planning context for one table: schema + tag dictionaries."""
+
+    def __init__(self, schema: Schema, encoders: dict[str, DictionaryEncoder]):
+        self.schema = schema
+        self.encoders = encoders
+        self._lower = {c.name.lower(): c.name for c in schema}
+
+    def resolve(self, name: str) -> str:
+        real = self._lower.get(name.lower())
+        if real is None:
+            raise ColumnNotFound(name)
+        return real
+
+    def is_tag(self, name: str) -> bool:
+        return self.schema.column(self.resolve(name)).is_tag
+
+    def is_ts(self, name: str) -> bool:
+        return self.schema.column(self.resolve(name)).is_time_index
+
+    def ts_unit_ms_factor(self) -> float:
+        unit = self.schema.time_index.dtype.time_unit
+        return unit.per_second / 1000.0
+
+    def ts_literal(self, v: object) -> int:
+        """Literal compared against the time index → epoch int in ts unit."""
+        if isinstance(v, str):
+            ms = parse_timestamp_str(v)
+            return int(ms * self.ts_unit_ms_factor())
+        if isinstance(v, (int, float)):
+            return int(v)
+        raise PlanError(f"bad timestamp literal {v!r}")
+
+
+# ---------------------------------------------------------------------------
+# Device compiler
+# ---------------------------------------------------------------------------
+
+def _like_to_regex(pattern: str) -> str:
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "^" + "".join(out) + "$"
+
+
+def _code_set(enc: DictionaryEncoder, pred) -> np.ndarray:
+    return np.array(
+        [i for i, v in enumerate(enc.values()) if pred(v)], dtype=np.int32
+    )
+
+
+def compile_device(e: Expr, ctx: TableContext):
+    """Compile to fn(env) -> jnp array, env maps column name → device array.
+
+    env must also contain '__mask__' (row validity). Boolean results are
+    bool arrays; tag columns evaluate to their code arrays (comparisons are
+    rewritten to code space).
+    """
+    if isinstance(e, Literal):
+        v = e.value
+        if v is None:
+            return lambda env: jnp.nan
+        if isinstance(v, bool):
+            return lambda env: jnp.bool_(v)
+        if isinstance(v, str):
+            raise PlanError(f"string literal {v!r} outside tag comparison")
+        return lambda env: v
+
+    if isinstance(e, IntervalLit):
+        ms = e.ms
+        factor = ctx.ts_unit_ms_factor()
+        return lambda env: int(ms * factor)
+
+    if isinstance(e, Column):
+        real = ctx.resolve(e.name)
+        return lambda env: env[real]
+
+    if isinstance(e, Cast):
+        inner = compile_device(e.expr, ctx)
+        tn = e.type_name.upper()
+        if "INT" in tn:
+            return lambda env: jnp.asarray(inner(env)).astype(jnp.int64)
+        return lambda env: jnp.asarray(inner(env)).astype(jnp.float32)
+
+    if isinstance(e, UnaryOp):
+        inner = compile_device(e.operand, ctx)
+        if e.op == "NOT":
+            return lambda env: ~inner(env)
+        if e.op == "-":
+            return lambda env: -inner(env)
+        raise Unsupported(f"unary {e.op}")
+
+    if isinstance(e, IsNull):
+        if isinstance(e.expr, Column):
+            real = ctx.resolve(e.expr.name)
+            col = ctx.schema.column(real)
+            if col.is_tag:
+                fn = lambda env: env[real] < 0
+            elif col.dtype.is_float:
+                fn = lambda env: jnp.isnan(env[real])
+            else:
+                fn = lambda env: jnp.zeros(env[real].shape, bool)
+        else:
+            inner = compile_device(e.expr, ctx)
+            fn = lambda env: jnp.isnan(inner(env).astype(jnp.float32))
+        if e.negated:
+            pos = fn
+            return lambda env: ~pos(env)
+        return fn
+
+    if isinstance(e, Between):
+        lo = BinaryOp(">=", e.expr, e.low)
+        hi = BinaryOp("<=", e.expr, e.high)
+        node = BinaryOp("AND", lo, hi)
+        if e.negated:
+            node = UnaryOp("NOT", node)
+        return compile_device(node, ctx)
+
+    if isinstance(e, InList):
+        if isinstance(e.expr, Column) and ctx.is_tag(e.expr.name):
+            real = ctx.resolve(e.expr.name)
+            enc = ctx.encoders[real]
+            values = []
+            for item in e.items:
+                if not isinstance(item, Literal):
+                    raise Unsupported("non-literal IN item on tag")
+                values.append(item.value)
+            codes = np.array(
+                sorted(c for c in (enc.get(v) for v in values) if c >= 0),
+                dtype=np.int32,
+            )
+            neg = e.negated
+
+            def fn(env, codes=codes, real=real, neg=neg):
+                col = env[real]
+                hit = (
+                    jnp.zeros(col.shape, bool)
+                    if codes.size == 0
+                    else jnp.isin(col, jnp.asarray(codes))
+                )
+                return ~hit if neg else hit
+
+            return fn
+        # numeric IN list
+        inner = compile_device(e.expr, ctx)
+        lits = []
+        for item in e.items:
+            if not isinstance(item, Literal):
+                raise Unsupported("non-literal IN item")
+            lits.append(item.value)
+        arr = np.asarray(lits)
+        neg = e.negated
+
+        def fn(env, inner=inner, arr=arr, neg=neg):
+            v = inner(env)
+            hit = jnp.isin(v, jnp.asarray(arr))
+            return ~hit if neg else hit
+
+        return fn
+
+    if isinstance(e, Case):
+        if e.operand is not None:
+            whens = tuple(
+                (BinaryOp("=", e.operand, c), v) for c, v in e.whens
+            )
+        else:
+            whens = e.whens
+        conds = [compile_device(c, ctx) for c, _ in whens]
+        vals = [compile_device(v, ctx) for _, v in whens]
+        els = compile_device(e.else_, ctx) if e.else_ is not None else None
+
+        def fn(env):
+            out = els(env) if els is not None else jnp.nan
+            for c, v in zip(reversed(conds), reversed(vals)):
+                out = jnp.where(c(env), v(env), out)
+            return out
+
+        return fn
+
+    if isinstance(e, BinaryOp):
+        op = e.op.upper()
+        # --- tag-column string semantics resolved at compile time ---
+        tag_side = None
+        if isinstance(e.left, Column) and ctx.is_tag(e.left.name):
+            tag_side, other = e.left, e.right
+        elif isinstance(e.right, Column) and ctx.is_tag(e.right.name):
+            tag_side, other = e.right, e.left
+        if tag_side is not None and op in ("=", "!=", "LIKE", "ILIKE", "~", "!~"):
+            real = ctx.resolve(tag_side.name)
+            enc = ctx.encoders[real]
+            if isinstance(other, Literal) and isinstance(other.value, str):
+                if op in ("=", "!="):
+                    code = enc.get(other.value)
+                    if op == "=":
+                        return lambda env: env[real] == code
+                    return lambda env: (env[real] != code) & (env[real] >= 0)
+                if op in ("LIKE", "ILIKE"):
+                    rx = re.compile(
+                        _like_to_regex(other.value),
+                        re.IGNORECASE if op == "ILIKE" else 0,
+                    )
+                    codes = _code_set(enc, lambda v: rx.match(str(v)) is not None)
+                else:  # ~ / !~ regex
+                    rx = re.compile(other.value)
+                    codes = _code_set(enc, lambda v: rx.search(str(v)) is not None)
+                negate = op == "!~"
+
+                def fn(env, codes=codes, real=real, negate=negate):
+                    col = env[real]
+                    hit = (
+                        jnp.zeros(col.shape, bool)
+                        if codes.size == 0
+                        else jnp.isin(col, jnp.asarray(codes))
+                    )
+                    return (~hit & (col >= 0)) if negate else hit
+
+                return fn
+            if isinstance(other, Column) and ctx.is_tag(other.name):
+                # tag = tag comparison only sound if same dictionary; compare
+                # decoded equality via code-translation table
+                r1 = ctx.resolve(tag_side.name)
+                r2 = ctx.resolve(other.name)
+                e1, e2 = ctx.encoders[r1], ctx.encoders[r2]
+                trans = np.array([e2.get(v) for v in e1.values()], dtype=np.int32)
+
+                def fn(env, trans=trans, r1=r1, r2=r2, eq=(op == "=")):
+                    t = jnp.asarray(trans)
+                    c1 = env[r1]
+                    mapped = jnp.where(
+                        (c1 >= 0) & (c1 < t.shape[0]), t[jnp.clip(c1, 0, max(t.shape[0] - 1, 0))], -2
+                    ) if t.shape[0] else jnp.full(c1.shape, -2, jnp.int32)
+                    res = mapped == env[r2]
+                    return res if eq else ~res
+
+                return fn
+        # --- time-index comparisons with string timestamps ---
+        ts_side = None
+        if isinstance(e.left, Column) and ctx.is_ts(e.left.name):
+            ts_side, other, flipped = e.left, e.right, False
+        elif isinstance(e.right, Column) and ctx.is_ts(e.right.name):
+            ts_side, other, flipped = e.right, e.left, True
+        if (
+            ts_side is not None
+            and isinstance(other, Literal)
+            and op in ("=", "!=", "<", "<=", ">", ">=")
+        ):
+            real = ctx.resolve(ts_side.name)
+            lit = ctx.ts_literal(other.value)
+            ops = {
+                "=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+                "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+                ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+            }
+            if flipped:
+                return lambda env: ops[op](lit, env[real])
+            return lambda env: ops[op](env[real], lit)
+
+        if op in ("AND", "OR"):
+            l = compile_device(e.left, ctx)
+            r = compile_device(e.right, ctx)
+            if op == "AND":
+                return lambda env: l(env) & r(env)
+            return lambda env: l(env) | r(env)
+
+        l = compile_device(e.left, ctx)
+        r = compile_device(e.right, ctx)
+        table = {
+            "+": lambda a, b: a + b,
+            "-": lambda a, b: a - b,
+            "*": lambda a, b: a * b,
+            "/": lambda a, b: a / b,
+            "%": lambda a, b: a % b,
+            "=": lambda a, b: a == b,
+            "!=": lambda a, b: a != b,
+            "<": lambda a, b: a < b,
+            "<=": lambda a, b: a <= b,
+            ">": lambda a, b: a > b,
+            ">=": lambda a, b: a >= b,
+        }
+        if op not in table:
+            raise Unsupported(f"operator {op} on device")
+        f = table[op]
+        return lambda env: f(l(env), r(env))
+
+    if isinstance(e, FuncCall):
+        return compile_device_func(e, ctx)
+
+    raise Unsupported(f"cannot compile {type(e).__name__} for device")
+
+
+def compile_device_func(e: FuncCall, ctx: TableContext):
+    name = e.name
+    if name in AGG_FUNCS:
+        raise PlanError(f"aggregate {name} in scalar context")
+    if name == "date_bin":
+        if len(e.args) < 2:
+            raise PlanError("date_bin(interval, ts)")
+        iv = e.args[0]
+        if not isinstance(iv, IntervalLit):
+            raise Unsupported("date_bin needs interval literal")
+        step = int(iv.ms * ctx.ts_unit_ms_factor())
+        inner = compile_device(e.args[1], ctx)
+        origin = 0
+        if len(e.args) > 2 and isinstance(e.args[2], Literal):
+            origin = ctx.ts_literal(e.args[2].value)
+        return lambda env: time_bucket(inner(env), step, origin)
+    if name == "date_trunc":
+        unit = e.args[0]
+        if not isinstance(unit, Literal):
+            raise Unsupported("date_trunc needs unit literal")
+        inner = compile_device(e.args[1], ctx)
+        factor = ctx.ts_unit_ms_factor()
+        u = str(unit.value)
+
+        def fn(env):
+            ts = inner(env)
+            ms = (ts / factor).astype(jnp.int64) if factor != 1.0 else ts
+            out = date_trunc_bucket(ms, u)
+            return (out * factor).astype(jnp.int64) if factor != 1.0 else out
+
+        return fn
+    if name == "abs":
+        inner = compile_device(e.args[0], ctx)
+        return lambda env: jnp.abs(inner(env))
+    if name in ("ln", "log", "log2", "log10", "sqrt", "exp", "floor", "ceil",
+                "round", "sin", "cos", "tan"):
+        inner = compile_device(e.args[0], ctx)
+        f = {
+            "ln": jnp.log, "log": jnp.log10, "log2": jnp.log2,
+            "log10": jnp.log10, "sqrt": jnp.sqrt, "exp": jnp.exp,
+            "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+            "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+        }[name]
+        return lambda env: f(inner(env))
+    if name == "clamp":
+        a = compile_device(e.args[0], ctx)
+        lo = compile_device(e.args[1], ctx)
+        hi = compile_device(e.args[2], ctx)
+        return lambda env: jnp.clip(a(env), lo(env), hi(env))
+    if name == "coalesce":
+        parts = [compile_device(a, ctx) for a in e.args]
+
+        def fn(env):
+            out = parts[-1](env)
+            for p in reversed(parts[:-1]):
+                v = p(env)
+                out = jnp.where(jnp.isnan(v), out, v)
+            return out
+
+        return fn
+    if name == "to_unixtime":
+        inner = compile_device(e.args[0], ctx)
+        factor = ctx.ts_unit_ms_factor() * 1000.0
+        return lambda env: (inner(env) / factor).astype(jnp.int64)
+    if name == "now":
+        import time as _time
+
+        v = int(_time.time() * 1000 * ctx.ts_unit_ms_factor())
+        return lambda env: v
+    raise Unsupported(f"device function {name}")
+
+
+# ---------------------------------------------------------------------------
+# Host evaluator (post-aggregation shaping; numpy over small columns)
+# ---------------------------------------------------------------------------
+
+def eval_host(e: Expr, env: dict[str, np.ndarray], n: int):
+    """Evaluate over host columns; env keys are output column names."""
+    if isinstance(e, Literal):
+        return e.value
+    if isinstance(e, IntervalLit):
+        return e.ms
+    if isinstance(e, Column):
+        for k in (str(e), e.name):
+            if k in env:
+                return env[k]
+        lower = {k.lower(): k for k in env}
+        if e.name.lower() in lower:
+            return env[lower[e.name.lower()]]
+        raise ColumnNotFound(e.name)
+    if isinstance(e, FuncCall):
+        key = str(e)
+        if key in env:
+            return env[key]
+        if e.name in AGG_FUNCS:
+            raise ColumnNotFound(key)
+        args = [eval_host(a, env, n) for a in e.args]
+        table = {
+            "abs": np.abs, "sqrt": np.sqrt, "ln": np.log, "log10": np.log10,
+            "log2": np.log2, "exp": np.exp, "floor": np.floor,
+            "ceil": np.ceil, "round": np.round,
+        }
+        if e.name in table:
+            return table[e.name](np.asarray(args[0], dtype=float))
+        raise Unsupported(f"host function {e.name}")
+    if isinstance(e, UnaryOp):
+        v = eval_host(e.operand, env, n)
+        if e.op == "NOT":
+            return ~np.asarray(v, dtype=bool)
+        return -np.asarray(v)
+    if isinstance(e, BinaryOp):
+        key = str(e)
+        if key in env:
+            return env[key]
+        l = eval_host(e.left, env, n)
+        r = eval_host(e.right, env, n)
+        op = e.op.upper()
+        if op in ("AND", "OR"):
+            l = np.asarray(l, dtype=bool)
+            r = np.asarray(r, dtype=bool)
+            return (l & r) if op == "AND" else (l | r)
+        if op in ("LIKE", "ILIKE"):
+            rx = re.compile(
+                _like_to_regex(str(r)), re.IGNORECASE if op == "ILIKE" else 0
+            )
+            return np.array([rx.match(str(x)) is not None for x in np.atleast_1d(l)])
+        table = {
+            "+": np.add, "-": np.subtract, "*": np.multiply,
+            "/": np.divide, "%": np.mod,
+            "=": np.equal, "!=": np.not_equal, "<": np.less,
+            "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal,
+        }
+        if op not in table:
+            raise Unsupported(f"host operator {op}")
+        return table[op](l, r)
+    if isinstance(e, Between):
+        v = eval_host(e.expr, env, n)
+        lo = eval_host(e.low, env, n)
+        hi = eval_host(e.high, env, n)
+        res = (np.asarray(v) >= lo) & (np.asarray(v) <= hi)
+        return ~res if e.negated else res
+    if isinstance(e, InList):
+        v = np.asarray(eval_host(e.expr, env, n))
+        items = [eval_host(i, env, n) for i in e.items]
+        res = np.isin(v, np.asarray(items, dtype=v.dtype if v.dtype != object else object))
+        return ~res if e.negated else res
+    if isinstance(e, IsNull):
+        v = eval_host(e.expr, env, n)
+        arr = np.asarray(v)
+        if arr.dtype == object:
+            res = np.array([x is None for x in arr])
+        elif np.issubdtype(arr.dtype, np.floating):
+            res = np.isnan(arr)
+        else:
+            res = np.zeros(arr.shape, bool)
+        return ~res if e.negated else res
+    if isinstance(e, Case):
+        if e.operand is not None:
+            whens = tuple((BinaryOp("=", e.operand, c), v) for c, v in e.whens)
+        else:
+            whens = e.whens
+        out = np.full(n, None, dtype=object) if e.else_ is None else np.broadcast_to(
+            np.asarray(eval_host(e.else_, env, n), dtype=object), (n,)
+        ).copy()
+        done = np.zeros(n, dtype=bool)
+        for c, v in whens:
+            cond = np.asarray(eval_host(c, env, n), dtype=bool)
+            cond = np.broadcast_to(cond, (n,))
+            val = eval_host(v, env, n)
+            val = np.broadcast_to(np.asarray(val, dtype=object), (n,))
+            pick = cond & ~done
+            out[pick] = val[pick]
+            done |= cond
+        return out
+    if isinstance(e, Cast):
+        v = eval_host(e.expr, env, n)
+        tn = e.type_name.upper()
+        if "INT" in tn:
+            return np.asarray(v).astype(np.int64)
+        if "DOUBLE" in tn or "FLOAT" in tn or "REAL" in tn:
+            return np.asarray(v).astype(np.float64)
+        if "STRING" in tn or "VARCHAR" in tn or "TEXT" in tn:
+            return np.asarray([str(x) for x in np.atleast_1d(np.asarray(v, dtype=object))], dtype=object)
+        raise Unsupported(f"host cast to {e.type_name}")
+    raise Unsupported(f"host eval {type(e).__name__}")

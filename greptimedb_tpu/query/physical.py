@@ -1,0 +1,366 @@
+"""Physical execution: SelectPlan → jitted XLA kernel → host result columns.
+
+The TPU replacement for DataFusion's physical operators (SURVEY.md §7.1
+"physical plan = XLA computation"): one fused jit program per (plan
+fingerprint, shape class) computes WHERE mask → group ids → segment
+aggregates entirely on device; the host then shapes the (small) result:
+decode tag codes, HAVING, ORDER BY, LIMIT, final projections.
+
+Group-by strategies (ops/segment.py): dense key grid when every key is a
+tag or time bucket and the grid fits; otherwise iterative sort-ranking,
+collision-free, still static-shape.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.errors import ExecutionError, PlanError, Unsupported
+from greptimedb_tpu.ops.masks import compact_rows, valid_mask
+from greptimedb_tpu.ops.segment import (
+    combine_keys, compact_groups, segment_first_last, segment_reduce,
+)
+from greptimedb_tpu.ops.time import bucket_index
+from greptimedb_tpu.query.ast import Column, Expr, FuncCall, Star
+from greptimedb_tpu.query.exprs import compile_device, eval_host
+from greptimedb_tpu.query.planner import GroupKey, SelectPlan, referenced_columns
+from greptimedb_tpu.storage.cache import DeviceTable
+from greptimedb_tpu.storage.memtable import TSID
+
+DENSE_LIMIT = 1 << 22
+
+_I64_MAX = np.int64(np.iinfo(np.int64).max)
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length() if n > 1 else 1
+
+
+class Executor:
+    """Caches jitted kernels by (fingerprint, shape-class) keys."""
+
+    def __init__(self):
+        self._cache: dict[tuple, object] = {}
+
+    # ------------------------------------------------------------------
+    def execute(
+        self,
+        plan: SelectPlan,
+        table: DeviceTable,
+        ts_bounds: tuple[int, int],
+    ) -> tuple[dict[str, np.ndarray], int]:
+        """Run the device part; returns (host env of result columns, nrows)."""
+        if plan.is_agg:
+            return self._execute_agg(plan, table, ts_bounds)
+        return self._execute_raw(plan, table)
+
+    # ---- aggregate path ----------------------------------------------
+    def _time_key_params(
+        self, key: GroupKey, plan: SelectPlan, ts_bounds: tuple[int, int]
+    ) -> tuple[int, int, int]:
+        lo, hi = plan.time_range
+        data_lo, data_hi = ts_bounds
+        lo = data_lo if lo is None else max(lo, data_lo)
+        hi = data_hi + 1 if hi is None else min(hi, data_hi + 1)
+        if hi <= lo:
+            hi = lo + 1
+        step = key.step or 1
+        origin = key.origin
+        start = origin + ((lo - origin) // step) * step
+        nb = max(1, -(-(hi - start) // step))
+        return step, start, _pow2(nb)
+
+    def _execute_agg(
+        self, plan: SelectPlan, table: DeviceTable, ts_bounds: tuple[int, int]
+    ) -> tuple[dict[str, np.ndarray], int]:
+        ctx = plan.ctx
+        ts_name = ctx.schema.time_index.name if ctx.schema.time_index else None
+
+        key_specs: list[tuple] = []
+        dense_ok = True
+        cards: list[int] = []
+        for k in plan.group_keys:
+            if k.kind == "tag":
+                card = _pow2(max(len(ctx.encoders[k.column]), 1))
+                key_specs.append(("tag", k.column, card))
+                cards.append(card)
+            elif k.kind == "time":
+                step, start, nb = self._time_key_params(k, plan, ts_bounds)
+                key_specs.append(("time", (step, start, nb)))
+                cards.append(nb)
+            else:
+                key_specs.append(("expr", compile_device(k.expr, ctx)))
+                dense_ok = False
+        grid = 1
+        for c in cards:
+            grid *= c
+        if key_specs and (not dense_ok or grid > DENSE_LIMIT):
+            dense_ok = False
+
+        where_fn = compile_device(plan.where, ctx) if plan.where is not None else None
+        lo, hi = plan.time_range
+
+        agg_specs = []
+        for agg in plan.aggs:
+            agg_specs.append((str(agg), self._compile_agg(agg, ctx, ts_name)))
+
+        padded = table.padded_rows
+        num_groups = (
+            grid if (dense_ok and key_specs) else (1 if not key_specs else padded)
+        )
+        dict_ver = tuple(len(ctx.encoders[c.name]) for c in ctx.schema.tag_columns)
+        cache_key = (
+            plan.fingerprint(), padded, tuple(cards), dense_ok, num_groups,
+            dict_ver, lo, hi,
+            tuple(spec[1] if spec[0] == "time" else spec[0:2] for spec in key_specs if spec[0] != "expr"),
+        )
+        kernel = self._cache.get(cache_key)
+        if kernel is None:
+            kernel = self._build_agg_kernel(
+                key_specs, dense_ok, num_groups, cards, where_fn, agg_specs,
+                ts_name, lo, hi,
+            )
+            self._cache[cache_key] = kernel
+        out = kernel(table)
+        out = {k: np.asarray(v) for k, v in out.items()}
+
+        gmask = out.pop("__gmask__").astype(bool)
+        n = int(gmask.sum())
+        env: dict[str, np.ndarray] = {}
+        for i, k in enumerate(plan.group_keys):
+            raw = out[f"__key{i}__"][gmask]
+            if k.kind == "tag":
+                vals = ctx.encoders[k.column].values()
+                lookup = np.array(vals + [None], dtype=object)
+                codes = raw.astype(np.int64)
+                codes = np.where((codes < 0) | (codes >= len(vals)), len(vals), codes)
+                col = lookup[codes]
+            else:
+                col = raw
+            env[k.name] = col
+            env[str(k.expr)] = col
+        for name, _ in agg_specs:
+            env[name] = out[name][gmask]
+        return env, n
+
+    def _compile_agg(self, agg: FuncCall, ctx, ts_name: str | None):
+        name = agg.name
+        if agg.distinct or name == "count_distinct":
+            raise Unsupported("DISTINCT aggregates not yet implemented")
+        if name == "count" and (not agg.args or isinstance(agg.args[0], Star)):
+            def fn(env, gid, ng, mask):
+                ones = jnp.ones(mask.shape, dtype=jnp.int32)
+                return segment_reduce(ones, gid, ng, "count", mask)
+            return fn
+        if not agg.args:
+            raise PlanError(f"{name}() needs an argument")
+        arg = agg.args[0]
+        if (
+            isinstance(arg, Column)
+            and ctx.is_tag(arg.name)
+            and name not in ("count", "first_value", "last_value")
+        ):
+            # tag columns are dictionary codes on device; numeric aggregation
+            # over them would sum codes, and lexicographic min/max needs a
+            # sorted dictionary — neither is implemented yet
+            raise Unsupported(f"{name}() over string tag column {arg.name}")
+        arg_fn = compile_device(arg, ctx)
+        if name == "count":
+            return lambda env, gid, ng, mask: segment_reduce(
+                arg_fn(env), gid, ng, "count", mask
+            )
+        if name in ("sum", "min", "max"):
+            return lambda env, gid, ng, mask, op=name: segment_reduce(
+                arg_fn(env), gid, ng, op, mask
+            )
+        if name in ("avg", "mean"):
+            return lambda env, gid, ng, mask: segment_reduce(
+                arg_fn(env), gid, ng, "mean", mask
+            )
+        if name in ("first_value", "last_value"):
+            if ts_name is None:
+                raise PlanError(f"{name} needs a time index")
+            last = name == "last_value"
+
+            def fn(env, gid, ng, mask, last=last):
+                _ts, val = segment_first_last(
+                    env[ts_name], arg_fn(env), gid, ng, mask, last=last
+                )
+                return val
+
+            return fn
+        if name in ("stddev", "stddev_pop", "var", "var_pop"):
+            pop = name.endswith("_pop")
+
+            def fn(env, gid, ng, mask, pop=pop, std=name.startswith("std")):
+                v = arg_fn(env)
+                m = segment_reduce(v, gid, ng, "mean", mask)
+                cnt = segment_reduce(v, gid, ng, "count", mask)
+                centered = (v - m[jnp.clip(gid, 0, ng - 1)]) ** 2
+                ss = segment_reduce(centered, gid, ng, "sum", mask)
+                denom = cnt if pop else jnp.maximum(cnt - 1, 1)
+                var = jnp.where(cnt > (0 if pop else 1), ss / denom, jnp.nan)
+                return jnp.sqrt(var) if std else var
+
+            return fn
+        raise Unsupported(f"aggregate {name}")
+
+    def _build_agg_kernel(
+        self, key_specs, dense_ok, num_groups, cards, where_fn, agg_specs,
+        ts_name, lo, hi,
+    ):
+        @jax.jit
+        def kernel(table: DeviceTable):
+            env = dict(table.columns)
+            mask = table.row_mask
+            if lo is not None and ts_name is not None:
+                mask = mask & (env[ts_name] >= lo)
+            if hi is not None and ts_name is not None:
+                mask = mask & (env[ts_name] < hi)
+            if where_fn is not None:
+                mask = mask & where_fn(env)
+
+            n = mask.shape[0]
+            if not key_specs:
+                gid = jnp.zeros(n, dtype=jnp.int32)
+                ng = 1
+                gmask_init = None
+            elif dense_ok:
+                codes = []
+                for spec in key_specs:
+                    if spec[0] == "tag":
+                        codes.append(env[spec[1]])
+                    else:
+                        step, start, nb = spec[1]
+                        codes.append(bucket_index(env[ts_name], step, start))
+                combined, _tot = combine_keys(codes, cards)
+                gid = combined.astype(jnp.int32)
+                ng = num_groups
+                gmask_init = None
+            else:
+                # iterative collision-free ranking
+                combined = None
+                for spec in key_specs:
+                    if spec[0] == "tag":
+                        vals = env[spec[1]].astype(jnp.int64)
+                    elif spec[0] == "time":
+                        step, start, nb = spec[1]
+                        vals = bucket_index(env[ts_name], step, start)
+                    else:
+                        vals = spec[1](env).astype(jnp.int64)
+                    if combined is None:
+                        combined = vals
+                    else:
+                        prev_rank, _gk, _gm = compact_groups(
+                            combined, mask, num_groups
+                        )
+                        # prev_rank ≤ n, vals ranked next step; mix safely
+                        r2, _gk2, _gm2 = compact_groups(vals, mask, num_groups)
+                        combined = prev_rank.astype(jnp.int64) * (num_groups + 1) + r2
+                gid_r, _gkeys, gmask_sp = compact_groups(combined, mask, num_groups)
+                gid = gid_r.astype(jnp.int32)
+                ng = num_groups
+                gmask_init = gmask_sp
+
+            cnt_all = segment_reduce(
+                jnp.ones(n, dtype=jnp.int32), gid, ng, "count", mask
+            )
+            if not key_specs:
+                # global aggregate: SQL returns exactly one row even when
+                # zero rows matched (count()=0, min/max=NULL)
+                gmask = jnp.ones(1, dtype=bool)
+            else:
+                gmask = cnt_all > 0
+                if gmask_init is not None:
+                    gmask = gmask & gmask_init
+
+            out = {"__gmask__": gmask}
+            # representative row per group for key materialization
+            if key_specs:
+                ridx = jnp.arange(n, dtype=jnp.int64)
+                prep_ids = jnp.where(
+                    mask & (gid >= 0) & (gid < ng), gid, ng
+                ).astype(jnp.int32)
+                rep = jax.ops.segment_min(
+                    jnp.where(mask, ridx, _I64_MAX), prep_ids, num_segments=ng + 1
+                )[:ng]
+                safe_rep = jnp.where(rep < _I64_MAX, rep, 0)
+                for i, spec in enumerate(key_specs):
+                    if spec[0] == "tag":
+                        kv = env[spec[1]][safe_rep]
+                    elif spec[0] == "time":
+                        step, start, nb = spec[1]
+                        bucket = bucket_index(env[ts_name], step, start)
+                        kv = (bucket * step + start)[safe_rep]
+                    else:
+                        kv = spec[1](env).astype(jnp.int64)[safe_rep]
+                    out[f"__key{i}__"] = kv
+            for name, fn in agg_specs:
+                out[name] = fn(env, gid, ng, mask)
+            return out
+
+        return kernel
+
+    # ---- raw (non-aggregate) path -------------------------------------
+    def _execute_raw(
+        self, plan: SelectPlan, table: DeviceTable
+    ) -> tuple[dict[str, np.ndarray], int]:
+        ctx = plan.ctx
+        ts_name = ctx.schema.time_index.name if ctx.schema.time_index else None
+        where_fn = compile_device(plan.where, ctx) if plan.where is not None else None
+        lo, hi = plan.time_range
+
+        needed: set[str] = set()
+        has_star = any(isinstance(i.expr, Star) for i in plan.items)
+        if has_star:
+            needed = {c.name for c in ctx.schema}
+        for item in plan.items:
+            if not isinstance(item.expr, Star):
+                referenced_columns(item.expr, ctx, needed)
+        for o in plan.order_by:
+            referenced_columns(o.expr, ctx, needed)
+        cols = sorted(needed & set(table.columns.keys()))
+
+        dict_ver = tuple(len(ctx.encoders[c.name]) for c in ctx.schema.tag_columns)
+        cache_key = (
+            "raw", plan.fingerprint(), table.padded_rows, tuple(cols), dict_ver,
+            lo, hi,
+        )
+        kernel = self._cache.get(cache_key)
+        if kernel is None:
+
+            @jax.jit
+            def kernel(t: DeviceTable):
+                env = dict(t.columns)
+                mask = t.row_mask
+                if lo is not None and ts_name is not None:
+                    mask = mask & (env[ts_name] >= lo)
+                if hi is not None and ts_name is not None:
+                    mask = mask & (env[ts_name] < hi)
+                if where_fn is not None:
+                    mask = mask & where_fn(env)
+                sub = {c: env[c] for c in cols}
+                packed, new_mask = compact_rows(sub, mask)
+                packed["__n__"] = jnp.sum(mask.astype(jnp.int64))
+                return packed
+
+            self._cache[cache_key] = kernel
+        out = kernel(table)
+        n = int(out.pop("__n__"))
+        env: dict[str, np.ndarray] = {}
+        for c in cols:
+            arr = np.asarray(out[c])[:n]
+            col = ctx.schema.column(c) if ctx.schema.has_column(c) else None
+            if col is not None and col.is_tag:
+                vals = ctx.encoders[c].values()
+                lookup = np.array(vals + [None], dtype=object)
+                codes = arr.astype(np.int64)
+                codes = np.where((codes < 0) | (codes >= len(vals)), len(vals), codes)
+                env[c] = lookup[codes]
+            else:
+                env[c] = arr
+        return env, n

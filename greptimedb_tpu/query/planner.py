@@ -1,0 +1,291 @@
+"""Logical planning: resolve a parsed Select against a table context.
+
+Performs what the reference splits across DataFusion's sql-to-rel +
+optimizer rules that matter here (SURVEY.md §2.3): alias/ordinal
+resolution, aggregate extraction, time-range pushdown extraction
+(scan_hint/type_conversion equivalents), and group-key classification for
+the TPU group-by strategy choice (dense grid vs sort-ranked sparse).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from greptimedb_tpu.errors import PlanError, Unsupported
+from greptimedb_tpu.query.ast import (
+    Between, BinaryOp, Case, Cast, Column, Expr, FuncCall, InList, IntervalLit,
+    IsNull, Literal, OrderByItem, Select, SelectItem, Star, UnaryOp,
+)
+from greptimedb_tpu.query.exprs import (
+    AGG_FUNCS, TableContext, collect_aggs, is_aggregate,
+)
+
+
+@dataclass
+class GroupKey:
+    expr: Expr
+    kind: str  # "tag" | "time" | "expr"
+    name: str  # output column name
+    column: str | None = None  # tag column
+    step: int | None = None  # time bucket step (ts units)
+    origin: int = 0
+
+
+@dataclass
+class SelectPlan:
+    select: Select
+    ctx: TableContext
+    table: str
+    items: list[SelectItem]
+    where: Expr | None
+    time_range: tuple[int | None, int | None]
+    is_agg: bool
+    group_keys: list[GroupKey] = field(default_factory=list)
+    aggs: list[FuncCall] = field(default_factory=list)
+    having: Expr | None = None
+    order_by: list[OrderByItem] = field(default_factory=list)
+    limit: int | None = None
+    offset: int | None = None
+    distinct: bool = False
+
+    def fingerprint(self) -> str:
+        gk = ";".join(f"{k.kind}:{k.expr}" for k in self.group_keys)
+        return (
+            f"t={self.table}|w={self.where}|g=[{gk}]|a=[{','.join(map(str, self.aggs))}]"
+        )
+
+
+def _substitute_aliases(e: Expr, aliases: dict[str, Expr]) -> Expr:
+    """Replace bare columns that are actually select aliases."""
+    if isinstance(e, Column) and e.table is None:
+        target = aliases.get(e.name) or aliases.get(e.name.lower())
+        if target is not None:
+            return target
+        return e
+    if isinstance(e, BinaryOp):
+        return BinaryOp(e.op, _substitute_aliases(e.left, aliases),
+                        _substitute_aliases(e.right, aliases))
+    if isinstance(e, UnaryOp):
+        return UnaryOp(e.op, _substitute_aliases(e.operand, aliases))
+    if isinstance(e, FuncCall):
+        return FuncCall(e.name, tuple(_substitute_aliases(a, aliases) for a in e.args),
+                        e.distinct)
+    if isinstance(e, Between):
+        return Between(_substitute_aliases(e.expr, aliases),
+                       _substitute_aliases(e.low, aliases),
+                       _substitute_aliases(e.high, aliases), e.negated)
+    if isinstance(e, InList):
+        return InList(_substitute_aliases(e.expr, aliases),
+                      tuple(_substitute_aliases(i, aliases) for i in e.items),
+                      e.negated)
+    if isinstance(e, IsNull):
+        return IsNull(_substitute_aliases(e.expr, aliases), e.negated)
+    if isinstance(e, Cast):
+        return Cast(_substitute_aliases(e.expr, aliases), e.type_name)
+    if isinstance(e, Case):
+        return Case(
+            _substitute_aliases(e.operand, aliases) if e.operand else None,
+            tuple((_substitute_aliases(c, aliases), _substitute_aliases(v, aliases))
+                  for c, v in e.whens),
+            _substitute_aliases(e.else_, aliases) if e.else_ else None,
+        )
+    return e
+
+
+def extract_time_range(
+    where: Expr | None, ctx: TableContext
+) -> tuple[int | None, int | None]:
+    """Conjunctive time bounds on the time index for scan pruning.
+
+    Only top-level AND conjuncts are considered (reference: scan-hint
+    optimizer extracts the same). Returns half-open [lo, hi)."""
+    lo: int | None = None
+    hi: int | None = None
+
+    def visit(e: Expr) -> None:
+        nonlocal lo, hi
+        if isinstance(e, BinaryOp) and e.op == "AND":
+            visit(e.left)
+            visit(e.right)
+            return
+        if isinstance(e, Between) and not e.negated:
+            if isinstance(e.expr, Column) and ctx.is_ts(e.expr.name):
+                if isinstance(e.low, Literal) and isinstance(e.high, Literal):
+                    l = ctx.ts_literal(e.low.value)
+                    h = ctx.ts_literal(e.high.value) + 1  # BETWEEN inclusive
+                    lo = l if lo is None else max(lo, l)
+                    hi = h if hi is None else min(hi, h)
+            return
+        if isinstance(e, BinaryOp) and e.op in ("<", "<=", ">", ">=", "="):
+            col, lit, op = None, None, e.op
+            if isinstance(e.left, Column) and isinstance(e.right, Literal):
+                col, lit = e.left, e.right
+            elif isinstance(e.right, Column) and isinstance(e.left, Literal):
+                col, lit = e.right, e.left
+                op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+            if col is None or not ctx.is_ts(col.name):
+                return
+            v = ctx.ts_literal(lit.value)
+            if op == ">=":
+                lo = v if lo is None else max(lo, v)
+            elif op == ">":
+                lo = v + 1 if lo is None else max(lo, v + 1)
+            elif op == "<":
+                hi = v if hi is None else min(hi, v)
+            elif op == "<=":
+                hi = v + 1 if hi is None else min(hi, v + 1)
+            elif op == "=":
+                lo = v if lo is None else max(lo, v)
+                hi = v + 1 if hi is None else min(hi, v + 1)
+
+    if where is not None:
+        visit(where)
+    return lo, hi
+
+
+def plan_select(sel: Select, ctx: TableContext) -> SelectPlan:
+    aliases: dict[str, Expr] = {}
+    for item in sel.items:
+        if item.alias and not isinstance(item.expr, Star):
+            aliases[item.alias] = item.expr
+
+    where = _substitute_aliases(sel.where, {}) if sel.where else None
+
+    # range-select sugar: `agg(x) RANGE 'r' ... ALIGN 'a' BY (k)` becomes
+    # group by (time_bucket(align), keys) with windowed aggs; round 1 maps
+    # RANGE == ALIGN (tumbling windows); sliding windows arrive with promql.
+    items = list(sel.items)
+    group_by = list(sel.group_by)
+    if sel.align is not None:
+        ts_col = Column(ctx.schema.time_index.name)
+        bucket = FuncCall("date_bin", (sel.align, ts_col))
+        new_items: list[SelectItem] = []
+        for item in items:
+            if isinstance(item.expr, Column) and ctx.is_ts(item.expr.name):
+                new_items.append(SelectItem(bucket, item.alias or str(item.expr)))
+            else:
+                new_items.append(item)
+        items = new_items
+        group_by = [bucket] + list(sel.align_by)
+
+    resolved_group: list[Expr] = []
+    for g in group_by:
+        if isinstance(g, Literal) and isinstance(g.value, int):
+            idx = g.value - 1
+            if idx < 0 or idx >= len(items):
+                raise PlanError(f"GROUP BY ordinal {g.value} out of range")
+            resolved_group.append(items[idx].expr)
+        else:
+            resolved_group.append(_substitute_aliases(g, aliases))
+
+    aggs: list[FuncCall] = []
+    for item in items:
+        if not isinstance(item.expr, Star):
+            collect_aggs(item.expr, aggs)
+    if sel.having is not None:
+        collect_aggs(_substitute_aliases(sel.having, aliases), aggs)
+    order_by = [
+        OrderByItem(_substitute_aliases(o.expr, aliases), o.asc, o.nulls_first)
+        for o in sel.order_by
+    ]
+    for o in order_by:
+        collect_aggs(o.expr, aggs)
+
+    is_agg = bool(aggs) or bool(resolved_group)
+
+    group_keys: list[GroupKey] = []
+    for g in resolved_group:
+        name = None
+        for item in items:
+            if str(item.expr) == str(g):
+                name = item.output_name
+                break
+        name = name or str(g)
+        if isinstance(g, Column) and ctx.is_tag(g.name):
+            group_keys.append(GroupKey(g, "tag", name, column=ctx.resolve(g.name)))
+        elif (
+            isinstance(g, FuncCall)
+            and g.name in ("date_bin", "date_trunc")
+        ):
+            if g.name == "date_bin" and isinstance(g.args[0], IntervalLit):
+                step = int(g.args[0].ms * ctx.ts_unit_ms_factor())
+                origin = 0
+                if len(g.args) > 2 and isinstance(g.args[2], Literal):
+                    origin = ctx.ts_literal(g.args[2].value)
+                group_keys.append(GroupKey(g, "time", name, step=step, origin=origin))
+            elif g.name == "date_trunc" and isinstance(g.args[0], Literal):
+                unit = str(g.args[0].value).lower()
+                fixed = {
+                    "second": 1000, "minute": 60_000, "hour": 3_600_000,
+                    "day": 86_400_000, "week": 604_800_000,
+                }
+                if unit in fixed:
+                    step = int(fixed[unit] * ctx.ts_unit_ms_factor())
+                    origin = (
+                        int(-3 * 86_400_000 * ctx.ts_unit_ms_factor())
+                        if unit == "week" else 0
+                    )
+                    group_keys.append(
+                        GroupKey(g, "time", name, step=step, origin=origin)
+                    )
+                else:
+                    group_keys.append(GroupKey(g, "expr", name))
+            else:
+                group_keys.append(GroupKey(g, "expr", name))
+        elif isinstance(g, Column) and ctx.is_ts(g.name):
+            group_keys.append(GroupKey(g, "time", name, step=1, origin=0))
+        else:
+            group_keys.append(GroupKey(g, "expr", name))
+
+    having = _substitute_aliases(sel.having, aliases) if sel.having else None
+
+    return SelectPlan(
+        select=sel,
+        ctx=ctx,
+        table=sel.table or "",
+        items=items,
+        where=where,
+        time_range=extract_time_range(where, ctx),
+        is_agg=is_agg,
+        group_keys=group_keys,
+        aggs=aggs,
+        having=having,
+        order_by=order_by,
+        limit=sel.limit,
+        offset=sel.offset,
+        distinct=sel.distinct,
+    )
+
+
+def referenced_columns(e: Expr, ctx: TableContext, out: set[str]) -> None:
+    if isinstance(e, Column):
+        try:
+            out.add(ctx.resolve(e.name))
+        except Exception:
+            pass
+    elif isinstance(e, BinaryOp):
+        referenced_columns(e.left, ctx, out)
+        referenced_columns(e.right, ctx, out)
+    elif isinstance(e, UnaryOp):
+        referenced_columns(e.operand, ctx, out)
+    elif isinstance(e, FuncCall):
+        for a in e.args:
+            referenced_columns(a, ctx, out)
+    elif isinstance(e, Between):
+        referenced_columns(e.expr, ctx, out)
+        referenced_columns(e.low, ctx, out)
+        referenced_columns(e.high, ctx, out)
+    elif isinstance(e, InList):
+        referenced_columns(e.expr, ctx, out)
+    elif isinstance(e, IsNull):
+        referenced_columns(e.expr, ctx, out)
+    elif isinstance(e, Cast):
+        referenced_columns(e.expr, ctx, out)
+    elif isinstance(e, Case):
+        if e.operand:
+            referenced_columns(e.operand, ctx, out)
+        for c, v in e.whens:
+            referenced_columns(c, ctx, out)
+            referenced_columns(v, ctx, out)
+        if e.else_:
+            referenced_columns(e.else_, ctx, out)

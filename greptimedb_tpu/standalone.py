@@ -1,0 +1,375 @@
+"""Standalone database: all components wired in one process.
+
+Equivalent of `greptime standalone start` composition
+(src/cmd/src/standalone.rs:367 Instance::build_with): embedded kv metadata,
+catalog, region engine, query engine and (later) protocol servers — no
+process boundaries. This is also the StatementExecutor
+(src/operator/src/statement.rs:211): every SQL statement dispatches here.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+from greptimedb_tpu.datatypes.types import ConcreteDataType, SemanticType
+from greptimedb_tpu.errors import (
+    InvalidArguments, PlanError, TableNotFound, Unsupported,
+)
+from greptimedb_tpu.meta.catalog import DEFAULT_DB, CatalogManager, TableInfo
+from greptimedb_tpu.meta.kv import FileKv, KvBackend, MemoryKv
+from greptimedb_tpu.query.ast import (
+    AlterTable, ColumnDef, CreateDatabase, CreateFlow, CreateTable, Delete,
+    DescribeTable, DropDatabase, DropFlow, DropTable, Explain, Insert, Select,
+    ShowCreateTable, ShowDatabases, ShowFlows, ShowTables, Statement, Tql,
+    TruncateTable, Use,
+)
+from greptimedb_tpu.query.engine import QueryEngine, QueryResult, TableProvider
+from greptimedb_tpu.query.exprs import TableContext
+from greptimedb_tpu.query.parser import parse_sql
+from greptimedb_tpu.query.planner import SelectPlan
+from greptimedb_tpu.storage.cache import RegionCacheManager
+from greptimedb_tpu.storage.region import RegionEngine, RegionOptions
+
+
+class GreptimeDB(TableProvider):
+    """The standalone instance: SQL in, results out."""
+
+    def __init__(
+        self,
+        data_home: str | None = None,
+        *,
+        region_options: RegionOptions | None = None,
+        cache_capacity_bytes: int = 8 << 30,
+    ):
+        self.memory_mode = data_home is None
+        if data_home is None:
+            import tempfile
+
+            self._tmp = tempfile.TemporaryDirectory(prefix="greptimedb_tpu_")
+            data_home = self._tmp.name
+        self.data_home = data_home
+        os.makedirs(data_home, exist_ok=True)
+        self.kv: KvBackend = (
+            MemoryKv()
+            if self.memory_mode
+            else FileKv(os.path.join(data_home, "metadata", "kv.json"))
+        )
+        self.catalog = CatalogManager(self.kv)
+        self.regions = RegionEngine(
+            os.path.join(data_home, "data"), region_options
+        )
+        self.cache = RegionCacheManager(cache_capacity_bytes)
+        self.engine = QueryEngine(self)
+        self.current_db = DEFAULT_DB
+        self.flows: dict[str, object] = {}
+
+    def close(self) -> None:
+        self.regions.close()
+
+    # ---- TableProvider -------------------------------------------------
+    def _split_name(self, table: str) -> tuple[str, str]:
+        if "." in table:
+            db, name = table.rsplit(".", 1)
+            return db, name
+        return self.current_db, table
+
+    def _region_of(self, table: str):
+        db, name = self._split_name(table)
+        info = self.catalog.get_table(db, name)
+        region_id = info.region_ids[0]
+        try:
+            return self.regions.open_region(region_id)
+        except Exception:
+            return self.regions.create_region(region_id, info.schema)
+
+    def table_context(self, table: str) -> TableContext:
+        region = self._region_of(table)
+        return TableContext(region.schema, region.encoders)
+
+    def device_table(self, table: str, plan: SelectPlan):
+        region = self._region_of(table)
+        dt = self.cache.get(region)
+        lo = region.memtable.ts_min
+        hi = region.memtable.ts_max
+        for m in region.sst_files:
+            lo = m.ts_min if lo is None else min(lo, m.ts_min)
+            hi = m.ts_max if hi is None else max(hi, m.ts_max)
+        return dt, (lo if lo is not None else 0, hi if hi is not None else 0)
+
+    # ---- SQL entry -----------------------------------------------------
+    def sql(self, query: str) -> QueryResult:
+        """Execute one or more statements; returns the LAST result."""
+        stmts = parse_sql(query)
+        if not stmts:
+            return QueryResult([], [])
+        result = QueryResult([], [])
+        for stmt in stmts:
+            result = self.execute_statement(stmt)
+        return result
+
+    def execute_statement(self, stmt: Statement) -> QueryResult:
+        if isinstance(stmt, Select):
+            return self.engine.execute_select(stmt)
+        if isinstance(stmt, Tql):
+            return self._execute_tql(stmt)
+        if isinstance(stmt, Explain):
+            return self._explain(stmt)
+        if isinstance(stmt, CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, CreateDatabase):
+            self.catalog.create_database(stmt.name, stmt.if_not_exists)
+            return QueryResult([], [], affected_rows=1)
+        if isinstance(stmt, Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, Delete):
+            return self._delete(stmt)
+        if isinstance(stmt, DropTable):
+            return self._drop_table(stmt)
+        if isinstance(stmt, DropDatabase):
+            tables = self.catalog.drop_database(stmt.name, stmt.if_exists)
+            for t in tables:
+                for rid in t.region_ids:
+                    self.regions.drop_region(rid)
+            return QueryResult([], [], affected_rows=1)
+        if isinstance(stmt, AlterTable):
+            return self._alter_table(stmt)
+        if isinstance(stmt, ShowDatabases):
+            rows = [[d] for d in self.catalog.list_databases()
+                    if _like(d, stmt.like)]
+            return QueryResult(["Databases"], rows)
+        if isinstance(stmt, ShowTables):
+            db = stmt.database or self.current_db
+            rows = [[t.name] for t in self.catalog.list_tables(db)
+                    if _like(t.name, stmt.like)]
+            return QueryResult(["Tables"], rows)
+        if isinstance(stmt, ShowCreateTable):
+            return self._show_create(stmt)
+        if isinstance(stmt, DescribeTable):
+            return self._describe(stmt)
+        if isinstance(stmt, Use):
+            if not self.catalog.database_exists(stmt.database):
+                from greptimedb_tpu.errors import DatabaseNotFound
+
+                raise DatabaseNotFound(stmt.database)
+            self.current_db = stmt.database
+            return QueryResult([], [])
+        if isinstance(stmt, TruncateTable):
+            region = self._region_of(stmt.table)
+            region.truncate()
+            return QueryResult([], [], affected_rows=0)
+        if isinstance(stmt, (CreateFlow, DropFlow, ShowFlows)):
+            return self._flow_statement(stmt)
+        raise Unsupported(f"statement {type(stmt).__name__}")
+
+    # ---- DDL -----------------------------------------------------------
+    def _create_table(self, stmt: CreateTable) -> QueryResult:
+        db, name = self._split_name(stmt.name)
+        time_index = stmt.time_index
+        cols: list[ColumnSchema] = []
+        for cd in stmt.columns:
+            dtype = ConcreteDataType.parse(cd.type_name)
+            if cd.name == time_index:
+                semantic = SemanticType.TIMESTAMP
+                if not dtype.is_timestamp:
+                    raise InvalidArguments(
+                        f"time index {cd.name} must be a timestamp, got {cd.type_name}"
+                    )
+            elif cd.name in stmt.primary_keys:
+                semantic = SemanticType.TAG
+            else:
+                semantic = SemanticType.FIELD
+            cols.append(
+                ColumnSchema(
+                    cd.name, dtype, semantic,
+                    nullable=cd.nullable and semantic is not SemanticType.TIMESTAMP,
+                    default=cd.default,
+                )
+            )
+        schema = Schema(tuple(cols))
+        if schema.time_index is None:
+            raise InvalidArguments("missing TIME INDEX")
+        info = self.catalog.create_table(
+            db, name, schema,
+            engine=stmt.engine,
+            options=stmt.options,
+            partition_exprs=stmt.partitions,
+            if_not_exists=stmt.if_not_exists,
+        )
+        if info is not None:
+            self.regions.create_region(info.region_ids[0], schema)
+        return QueryResult([], [], affected_rows=0)
+
+    def _drop_table(self, stmt: DropTable) -> QueryResult:
+        for full in stmt.names:
+            db, name = self._split_name(full)
+            info = self.catalog.drop_table(db, name, stmt.if_exists)
+            if info is not None:
+                for rid in info.region_ids:
+                    self.regions.drop_region(rid)
+                    self.cache.invalidate_region(rid)
+        return QueryResult([], [], affected_rows=1)
+
+    def _alter_table(self, stmt: AlterTable) -> QueryResult:
+        db, name = self._split_name(stmt.table)
+        info = self.catalog.get_table(db, name)
+        if stmt.action == "add_column":
+            cd = stmt.column
+            dtype = ConcreteDataType.parse(cd.type_name)
+            new_schema = info.schema.with_added_column(
+                ColumnSchema(cd.name, dtype, SemanticType.FIELD, cd.nullable)
+            )
+        elif stmt.action == "drop_column":
+            new_schema = info.schema.with_dropped_column(stmt.name)
+        elif stmt.action == "rename":
+            self.catalog.rename_table(db, name, stmt.name)
+            return QueryResult([], [], affected_rows=0)
+        else:
+            raise Unsupported(f"alter {stmt.action}")
+        info.schema = new_schema
+        self.catalog.update_table(info)
+        # region schema change: flush current data then swap schema
+        region = self.regions.regions.get(info.region_ids[0])
+        if region is not None:
+            region.flush()
+            region.schema = new_schema
+            region.manifest.commit({"kind": "schema", "schema": new_schema.to_dict()})
+            region.memtable.schema = new_schema
+            self.cache.invalidate_region(region.region_id)
+        return QueryResult([], [], affected_rows=0)
+
+    # ---- DML -----------------------------------------------------------
+    def _insert(self, stmt: Insert) -> QueryResult:
+        region = self._region_of(stmt.table)
+        schema = region.schema
+        columns = stmt.columns or [c.name for c in schema]
+        if any(not schema.has_column(c) for c in columns):
+            bad = [c for c in columns if not schema.has_column(c)]
+            raise InvalidArguments(f"unknown insert columns {bad}")
+        data: dict[str, list] = {c: [] for c in columns}
+        for row in stmt.rows:
+            if len(row) != len(columns):
+                raise InvalidArguments(
+                    f"row has {len(row)} values, expected {len(columns)}"
+                )
+            for c, v in zip(columns, row):
+                data[c].append(v)
+        # timestamp strings → epoch ints
+        ts_name = schema.time_index.name
+        if ts_name in data:
+            ctx = TableContext(schema, region.encoders)
+            data[ts_name] = [ctx.ts_literal(v) for v in data[ts_name]]
+        region.write(data)
+        return QueryResult([], [], affected_rows=len(stmt.rows))
+
+    def _delete(self, stmt: Delete) -> QueryResult:
+        """DELETE by exact key conjunction (tags + ts), the mito semantic."""
+        region = self._region_of(stmt.table)
+        ctx = TableContext(region.schema, region.encoders)
+        from greptimedb_tpu.query.ast import BinaryOp, Column, Literal
+
+        eq: dict[str, object] = {}
+
+        def visit(e):
+            if isinstance(e, BinaryOp) and e.op == "AND":
+                visit(e.left)
+                visit(e.right)
+            elif (
+                isinstance(e, BinaryOp)
+                and e.op == "="
+                and isinstance(e.left, Column)
+                and isinstance(e.right, Literal)
+            ):
+                eq[ctx.resolve(e.left.name)] = e.right.value
+            else:
+                raise Unsupported(
+                    "DELETE supports tag=value AND ts=value conjunctions"
+                )
+
+        if stmt.where is None:
+            raise Unsupported("DELETE without WHERE (use TRUNCATE)")
+        visit(stmt.where)
+        ts_name = region.schema.time_index.name
+        if ts_name not in eq:
+            raise Unsupported("DELETE needs ts = <value>")
+        data = {k: [ctx.ts_literal(v) if k == ts_name else v] for k, v in eq.items()}
+        region.delete(data)
+        return QueryResult([], [], affected_rows=1)
+
+    # ---- introspection -------------------------------------------------
+    def _describe(self, stmt: DescribeTable) -> QueryResult:
+        db, name = self._split_name(stmt.table)
+        info = self.catalog.get_table(db, name)
+        rows = []
+        for c in info.schema:
+            semantic = {
+                SemanticType.TAG: "TAG",
+                SemanticType.FIELD: "FIELD",
+                SemanticType.TIMESTAMP: "TIMESTAMP",
+            }[c.semantic]
+            rows.append([
+                c.name, c.dtype.value,
+                "PRI" if c.semantic in (SemanticType.TAG, SemanticType.TIMESTAMP) else "",
+                "YES" if c.nullable else "NO",
+                c.default, semantic,
+            ])
+        return QueryResult(
+            ["Column", "Type", "Key", "Null", "Default", "Semantic Type"], rows
+        )
+
+    def _show_create(self, stmt: ShowCreateTable) -> QueryResult:
+        db, name = self._split_name(stmt.table)
+        info = self.catalog.get_table(db, name)
+        lines = [f"CREATE TABLE IF NOT EXISTS \"{info.name}\" ("]
+        defs = []
+        for c in info.schema:
+            d = f'  "{c.name}" {c.dtype.value.upper()}'
+            if not c.nullable:
+                d += " NOT NULL"
+            defs.append(d)
+        ti = info.schema.time_index
+        if ti is not None:
+            defs.append(f'  TIME INDEX ("{ti.name}")')
+        tags = [c.name for c in info.schema.tag_columns]
+        if tags:
+            defs.append("  PRIMARY KEY (" + ", ".join(f'"{t}"' for t in tags) + ")")
+        lines.append(",\n".join(defs))
+        lines.append(")")
+        lines.append(f"ENGINE={info.engine}")
+        if info.options:
+            opts = ", ".join(f"{k}='{v}'" for k, v in info.options.items())
+            lines.append(f"WITH ({opts})")
+        return QueryResult(["Table", "Create Table"], [[info.name, "\n".join(lines)]])
+
+    def _explain(self, stmt: Explain) -> QueryResult:
+        if isinstance(stmt.inner, Select):
+            text = self.engine.explain(stmt.inner)
+        elif isinstance(stmt.inner, Tql):
+            text = f"TQL {stmt.inner.command} (promql planning)"
+        else:
+            text = f"{type(stmt.inner).__name__}"
+        return QueryResult(
+            ["plan_type", "plan"],
+            [["logical_plan (tpu)", text]],
+        )
+
+    # ---- TQL / flows (wired in later milestones) -----------------------
+    def _execute_tql(self, stmt: Tql) -> QueryResult:
+        from greptimedb_tpu.promql.engine import execute_tql
+
+        return execute_tql(self, stmt)
+
+    def _flow_statement(self, stmt) -> QueryResult:
+        from greptimedb_tpu.flow.engine import handle_flow_statement
+
+        return handle_flow_statement(self, stmt)
+
+
+def _like(name: str, pattern: str | None) -> bool:
+    if pattern is None:
+        return True
+    import fnmatch
+
+    return fnmatch.fnmatch(name, pattern.replace("%", "*").replace("_", "?"))

@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from greptimedb_tpu.datatypes.batch import DictionaryEncoder
-from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.datatypes.schema import Schema, default_fill_array
 from greptimedb_tpu.errors import InvalidArguments, RegionNotFound, StorageError
 from greptimedb_tpu.storage.manifest import Manifest
 from greptimedb_tpu.storage.memtable import Memtable, OP, OP_DELETE, OP_PUT, SEQ, TSID
@@ -155,13 +155,7 @@ class Region:
             if c.name not in data:
                 if not c.nullable and c.default is None:
                     raise InvalidArguments(f"missing column {c.name}")
-                fill = c.default if c.default is not None else (
-                    np.nan if c.dtype.is_float else c.dtype.default_value()
-                )
-                if c.dtype.is_string_like:
-                    cols[c.name] = np.full(n, fill if fill is not None else "", dtype=object)
-                else:
-                    cols[c.name] = np.full(n, fill, dtype=c.dtype.to_numpy())
+                cols[c.name] = default_fill_array(c, n)
             else:
                 v = data[c.name]
                 if c.dtype.is_string_like:
@@ -339,6 +333,9 @@ class Region:
         for m in self.sst_files:
             if m.overlaps(*ts_range):
                 parts.append(read_sst(self.store, m, self.schema, ts_range, want))
+        internal = (TSID, SEQ, OP)
+        schema_cols = {c.name for c in self.schema}
+        eff_want = want if want is not None else list(schema_cols) + list(internal)
         if not self.memtable.is_empty:
             lo, hi = ts_range
             for chunk in self.memtable.snapshot_chunks():
@@ -350,8 +347,14 @@ class Region:
                     sel &= ts < hi
                 if sel.any():
                     part = {
-                        k: v[sel] for k, v in chunk.items() if want is None or k in want
+                        k: v[sel]
+                        for k, v in chunk.items()
+                        if k in eff_want and (k in schema_cols or k in internal)
                     }
+                    n = int(sel.sum())
+                    for c in self.schema:  # chunks predating ALTER ADD
+                        if c.name in eff_want and c.name not in part:
+                            part[c.name] = default_fill_array(c, n)
                     parts.append(part)
         if not parts:
             empty = {}

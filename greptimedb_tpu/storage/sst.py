@@ -19,7 +19,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.datatypes.schema import Schema, default_fill_array
 from greptimedb_tpu.storage.memtable import OP, SEQ, TSID
 from greptimedb_tpu.storage.object_store import ObjectStore
 
@@ -35,12 +35,20 @@ class SstMeta:
     seq_max: int
     size_bytes: int
     level: int = 0
+    # column names present in the file (schema evolution: old SSTs may lack
+    # later-added columns); None only for metas persisted before this field
+    columns: tuple[str, ...] | None = None
 
     def to_dict(self) -> dict:
-        return dict(self.__dict__)
+        d = dict(self.__dict__)
+        d["columns"] = list(self.columns) if self.columns is not None else None
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "SstMeta":
+        cols = d.get("columns")
+        d = dict(d)
+        d["columns"] = tuple(cols) if cols is not None else None
         return SstMeta(**d)
 
     def overlaps(self, ts_start: int | None, ts_end: int | None) -> bool:
@@ -115,6 +123,7 @@ def write_sst(
         seq_max=int(seq.max()),
         size_bytes=len(data),
         level=level,
+        columns=tuple(f.name for f in target),
     )
 
 
@@ -146,11 +155,23 @@ def read_sst(
 
     local = store.local_path(meta.path)
     src = local if local else io.BytesIO(store.read(meta.path))
-    want = columns
-    table = pq.read_table(src, columns=want, filters=filters)
+    internal = (TSID, SEQ, OP)
+    schema_cols = {c.name for c in schema}
+    if meta.columns is not None:
+        present = set(meta.columns)
+    else:  # legacy meta: one footer read to learn the file's columns
+        present = set(pq.read_schema(src).names)
+        if isinstance(src, io.BytesIO):
+            src.seek(0)
+    want = columns if columns is not None else (list(schema_cols) + list(internal))
+    want = list(dict.fromkeys(want))
+    read_cols = [c for c in want if c in present]
+    table = pq.read_table(src, columns=read_cols, filters=filters)
 
     out: dict[str, np.ndarray] = {}
     for name in table.column_names:
+        if name not in schema_cols and name not in internal:
+            continue  # dropped by ALTER; dead weight in old SSTs
         arr = table.column(name).combine_chunks()
         if pa.types.is_dictionary(arr.type):
             # decode via the (small) dictionary, not per-row python objects
@@ -163,4 +184,9 @@ def read_sst(
             out[name] = arr.to_numpy(zero_copy_only=False).astype("int64")
         else:
             out[name] = arr.to_numpy(zero_copy_only=False)
+    # schema evolution: backfill columns added after this SST was written
+    n = len(out[SEQ]) if SEQ in out else (table.num_rows)
+    for c in schema:
+        if c.name in want and c.name not in out:
+            out[c.name] = default_fill_array(c, n)
     return out

@@ -1,0 +1,347 @@
+"""End-to-end SQL tests against the standalone database.
+
+Modeled on the reference's sqlness golden cases (tests/cases/standalone):
+DDL, DML, aggregates, time bucketing, range select, introspection.
+"""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.errors import (
+    GreptimeError, InvalidArguments, SyntaxError_, TableNotFound, Unsupported,
+)
+from greptimedb_tpu.standalone import GreptimeDB
+
+
+@pytest.fixture
+def db():
+    d = GreptimeDB()
+    yield d
+    d.close()
+
+
+@pytest.fixture
+def cpu(db):
+    db.sql(
+        """CREATE TABLE cpu (
+             hostname STRING,
+             region STRING,
+             ts TIMESTAMP(3) TIME INDEX,
+             usage_user DOUBLE,
+             usage_system DOUBLE,
+             PRIMARY KEY (hostname, region))"""
+    )
+    db.sql(
+        "INSERT INTO cpu (hostname, region, ts, usage_user, usage_system) VALUES "
+        "('h1','us-east',0,10.0,1.0),"
+        "('h2','us-east',0,20.0,2.0),"
+        "('h3','eu-west',0,30.0,3.0),"
+        "('h1','us-east',60000,40.0,4.0),"
+        "('h2','us-east',60000,50.0,5.0),"
+        "('h3','eu-west',60000,60.0,6.0),"
+        "('h1','us-east',120000,70.0,7.0)"
+    )
+    return db
+
+
+class TestDDL:
+    def test_create_show_describe(self, db):
+        db.sql("CREATE TABLE t1 (a STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(a))")
+        assert db.sql("SHOW TABLES").rows == [["t1"]]
+        desc = db.sql("DESC TABLE t1")
+        assert [r[0] for r in desc.rows] == ["a", "ts", "v"]
+        assert desc.rows[0][5] == "TAG"
+        assert desc.rows[1][5] == "TIMESTAMP"
+        assert desc.rows[2][5] == "FIELD"
+        sc = db.sql("SHOW CREATE TABLE t1")
+        assert "TIME INDEX" in sc.rows[0][1]
+
+    def test_create_if_not_exists(self, db):
+        db.sql("CREATE TABLE t (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        db.sql("CREATE TABLE IF NOT EXISTS t (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        with pytest.raises(GreptimeError):
+            db.sql("CREATE TABLE t (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+
+    def test_time_index_required_and_typed(self, db):
+        with pytest.raises(InvalidArguments):
+            db.sql("CREATE TABLE bad (a STRING, v DOUBLE)")
+        with pytest.raises(InvalidArguments):
+            db.sql("CREATE TABLE bad2 (a STRING, ts DOUBLE TIME INDEX)")
+
+    def test_drop(self, db):
+        db.sql("CREATE TABLE t (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        db.sql("DROP TABLE t")
+        assert db.sql("SHOW TABLES").rows == []
+        with pytest.raises(TableNotFound):
+            db.sql("SELECT * FROM t")
+        db.sql("DROP TABLE IF EXISTS t")
+
+    def test_databases(self, db):
+        db.sql("CREATE DATABASE mydb")
+        assert ["mydb"] in db.sql("SHOW DATABASES").rows
+        db.sql("USE mydb")
+        db.sql("CREATE TABLE t (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        assert db.sql("SHOW TABLES").rows == [["t"]]
+        db.sql("USE public")
+        assert db.sql("SHOW TABLES").rows == []
+        # qualified name across db
+        db.sql("INSERT INTO mydb.t VALUES (1000, 5.0)")
+        assert db.sql("SELECT count(*) FROM mydb.t").rows == [[1]]
+
+    def test_alter_add_column(self, cpu):
+        cpu.sql("ALTER TABLE cpu ADD COLUMN mem DOUBLE")
+        desc = cpu.sql("DESC TABLE cpu")
+        assert "mem" in [r[0] for r in desc.rows]
+        cpu.sql(
+            "INSERT INTO cpu (hostname, region, ts, usage_user, usage_system, mem)"
+            " VALUES ('h9','x',999000,1.0,1.0,77.0)"
+        )
+        r = cpu.sql("SELECT mem FROM cpu WHERE hostname = 'h9'")
+        assert r.rows == [[77.0]]
+        # old rows read NULL for the new column
+        r = cpu.sql("SELECT mem FROM cpu WHERE hostname = 'h1' AND ts = 0")
+        assert r.rows == [[None]]
+
+
+class TestQueries:
+    def test_select_star_where(self, cpu):
+        r = cpu.sql("SELECT * FROM cpu WHERE hostname = 'h1' ORDER BY ts")
+        assert len(r.rows) == 3
+        assert r.column_names == ["hostname", "region", "ts", "usage_user", "usage_system"]
+        assert r.rows[0] == ["h1", "us-east", 0, 10.0, 1.0]
+
+    def test_group_by_tag(self, cpu):
+        r = cpu.sql(
+            "SELECT region, avg(usage_user) FROM cpu GROUP BY region ORDER BY region"
+        )
+        assert r.rows == [["eu-west", 45.0], ["us-east", 38.0]]
+
+    def test_group_by_two_tags(self, cpu):
+        r = cpu.sql(
+            "SELECT hostname, region, count(*) FROM cpu GROUP BY hostname, region"
+            " ORDER BY hostname"
+        )
+        assert r.rows == [["h1", "us-east", 3], ["h2", "us-east", 2], ["h3", "eu-west", 2]]
+
+    def test_time_bucket_group(self, cpu):
+        r = cpu.sql(
+            "SELECT date_bin(INTERVAL '1 minute', ts) m, max(usage_user)"
+            " FROM cpu GROUP BY m ORDER BY m"
+        )
+        assert r.rows == [[0, 30.0], [60000, 60.0], [120000, 70.0]]
+
+    def test_double_groupby(self, cpu):
+        r = cpu.sql(
+            "SELECT hostname, date_bin(INTERVAL '1 minute', ts) m, avg(usage_user)"
+            " FROM cpu GROUP BY hostname, m ORDER BY hostname, m"
+        )
+        assert r.rows[0] == ["h1", 0, 10.0]
+        assert len(r.rows) == 7
+
+    def test_where_time_range(self, cpu):
+        r = cpu.sql("SELECT count(*) FROM cpu WHERE ts >= 60000 AND ts < 120000")
+        assert r.rows == [[3]]
+        r = cpu.sql("SELECT count(*) FROM cpu WHERE ts BETWEEN 0 AND 60000")
+        assert r.rows == [[6]]
+
+    def test_where_tag_predicates(self, cpu):
+        assert cpu.sql("SELECT count(*) FROM cpu WHERE region != 'us-east'").rows == [[2]]
+        assert cpu.sql(
+            "SELECT count(*) FROM cpu WHERE hostname IN ('h1','h3')"
+        ).rows == [[5]]
+        assert cpu.sql(
+            "SELECT count(*) FROM cpu WHERE hostname NOT IN ('h1')"
+        ).rows == [[4]]
+        assert cpu.sql("SELECT count(*) FROM cpu WHERE region LIKE 'us%'").rows == [[5]]
+        assert cpu.sql("SELECT count(*) FROM cpu WHERE hostname = 'nope'").rows == [[0]]
+
+    def test_field_predicates(self, cpu):
+        assert cpu.sql(
+            "SELECT count(*) FROM cpu WHERE usage_user > 25 AND usage_system < 6"
+        ).rows == [[3]]
+        assert cpu.sql(
+            "SELECT count(*) FROM cpu WHERE usage_user BETWEEN 20 AND 50"
+        ).rows == [[4]]
+
+    def test_aggregates(self, cpu):
+        r = cpu.sql(
+            "SELECT count(*), sum(usage_user), min(usage_user), max(usage_user),"
+            " avg(usage_user) FROM cpu"
+        )
+        assert r.rows == [[7, 280.0, 10.0, 70.0, 40.0]]
+
+    def test_first_last_value(self, cpu):
+        r = cpu.sql(
+            "SELECT hostname, last_value(usage_user), first_value(usage_user)"
+            " FROM cpu GROUP BY hostname ORDER BY hostname"
+        )
+        assert r.rows == [["h1", 70.0, 10.0], ["h2", 50.0, 20.0], ["h3", 60.0, 30.0]]
+
+    def test_stddev(self, cpu):
+        r = cpu.sql("SELECT stddev(usage_user) FROM cpu WHERE hostname = 'h1'")
+        assert r.rows[0][0] == pytest.approx(30.0, rel=1e-5)
+
+    def test_having_order_limit(self, cpu):
+        r = cpu.sql(
+            "SELECT hostname, sum(usage_user) s FROM cpu GROUP BY hostname"
+            " HAVING s >= 70 ORDER BY s DESC LIMIT 2"
+        )
+        assert r.rows == [["h1", 120.0], ["h3", 90.0]]
+
+    def test_order_by_desc_nulls(self, cpu):
+        cpu.sql("INSERT INTO cpu (hostname, region, ts, usage_user) VALUES ('h4','x',0,NULL)")
+        r = cpu.sql(
+            "SELECT hostname, max(usage_user) m FROM cpu GROUP BY hostname ORDER BY m DESC"
+        )
+        # NULLS FIRST on DESC (pg default)
+        assert r.rows[0][0] == "h4" and r.rows[0][1] is None
+        assert r.rows[1] == ["h1", 70.0]
+
+    def test_limit_offset(self, cpu):
+        r = cpu.sql("SELECT DISTINCT hostname FROM cpu ORDER BY hostname LIMIT 2 OFFSET 1")
+        assert r.rows == [["h2"], ["h3"]]
+
+    def test_arithmetic_projection(self, cpu):
+        r = cpu.sql(
+            "SELECT usage_user + usage_system AS total FROM cpu"
+            " WHERE hostname = 'h1' AND ts = 0"
+        )
+        assert r.rows == [[11.0]]
+
+    def test_agg_arithmetic(self, cpu):
+        r = cpu.sql("SELECT max(usage_user) - min(usage_user) FROM cpu")
+        assert r.rows == [[60.0]]
+
+    def test_case_expression(self, cpu):
+        r = cpu.sql(
+            "SELECT hostname, CASE WHEN max(usage_user) > 55 THEN 'hot' ELSE 'cold' END"
+            " FROM cpu GROUP BY hostname ORDER BY hostname"
+        )
+        assert r.rows == [["h1", "hot"], ["h2", "cold"], ["h3", "hot"]]
+
+    def test_range_align(self, cpu):
+        r = cpu.sql(
+            "SELECT ts, hostname, max(usage_user) RANGE '1m' FROM cpu"
+            " ALIGN '1m' BY (hostname) ORDER BY hostname, ts"
+        )
+        assert r.rows[0] == [0, "h1", 10.0]
+        assert len(r.rows) == 7
+
+    def test_tableless(self, db):
+        assert db.sql("SELECT 1").rows == [[1]]
+        assert db.sql("SELECT 1 + 2 AS three").rows == [[3]]
+        assert db.sql("SELECT version()").rows[0][0].startswith("greptimedb-tpu")
+
+    def test_count_on_empty_table(self, db):
+        db.sql("CREATE TABLE e (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        assert db.sql("SELECT count(*) FROM e").rows == [[0]]
+        assert db.sql("SELECT * FROM e").rows == []
+        r = db.sql("SELECT max(v) FROM e")
+        assert r.rows == [[None]]
+
+    def test_group_by_ordinal_and_alias(self, cpu):
+        r1 = cpu.sql("SELECT region r, count(*) FROM cpu GROUP BY 1 ORDER BY r")
+        r2 = cpu.sql("SELECT region r, count(*) FROM cpu GROUP BY r ORDER BY r")
+        assert r1.rows == r2.rows
+
+    def test_explain(self, cpu):
+        r = cpu.sql("EXPLAIN SELECT region, count(*) FROM cpu GROUP BY region")
+        assert "TpuAggregate" in r.rows[0][1]
+
+
+class TestDML:
+    def test_insert_nulls_and_defaults(self, cpu):
+        cpu.sql("INSERT INTO cpu (hostname, region, ts) VALUES ('h8','x',5000)")
+        r = cpu.sql("SELECT usage_user FROM cpu WHERE hostname = 'h8'")
+        assert r.rows == [[None]]
+
+    def test_insert_ts_string(self, db):
+        db.sql("CREATE TABLE t (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        db.sql("INSERT INTO t VALUES ('2021-01-01 00:00:00', 1.5)")
+        r = db.sql("SELECT ts, v FROM t")
+        assert r.rows == [[1609459200000, 1.5]]
+
+    def test_delete(self, cpu):
+        cpu.sql("DELETE FROM cpu WHERE hostname = 'h1' AND region = 'us-east' AND ts = 0")
+        assert cpu.sql("SELECT count(*) FROM cpu").rows == [[6]]
+        r = cpu.sql("SELECT count(*) FROM cpu WHERE hostname = 'h1'")
+        assert r.rows == [[2]]
+
+    def test_truncate(self, cpu):
+        cpu.sql("TRUNCATE TABLE cpu")
+        assert cpu.sql("SELECT count(*) FROM cpu").rows == [[0]]
+
+    def test_upsert_same_key(self, db):
+        db.sql("CREATE TABLE t (a STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(a))")
+        db.sql("INSERT INTO t VALUES ('x', 1000, 1.0)")
+        db.sql("INSERT INTO t VALUES ('x', 1000, 2.0)")
+        assert db.sql("SELECT v FROM t").rows == [[2.0]]
+
+
+class TestPersistence:
+    def test_restart_roundtrip(self, tmp_data_dir):
+        db = GreptimeDB(tmp_data_dir)
+        db.sql("CREATE TABLE t (a STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(a))")
+        db.sql("INSERT INTO t VALUES ('x', 1000, 1.0), ('y', 2000, 2.0)")
+        db.close()
+        db2 = GreptimeDB(tmp_data_dir)
+        assert db2.sql("SHOW TABLES").rows == [["t"]]
+        r = db2.sql("SELECT a, v FROM t ORDER BY a")
+        assert r.rows == [["x", 1.0], ["y", 2.0]]
+        db2.close()
+
+
+class TestErrors:
+    def test_syntax_error(self, db):
+        with pytest.raises(SyntaxError_):
+            db.sql("SELEC 1")
+
+    def test_unknown_column(self, db):
+        db.sql("CREATE TABLE t (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        from greptimedb_tpu.errors import ColumnNotFound
+
+        with pytest.raises(ColumnNotFound):
+            db.sql("SELECT nope FROM t")
+
+    def test_table_not_found(self, db):
+        with pytest.raises(TableNotFound):
+            db.sql("SELECT * FROM missing")
+
+
+class TestSchemaEvolutionRegressions:
+    """Review findings: mixed-schema SSTs through compaction and DROP COLUMN."""
+
+    def test_compact_across_alter(self, db):
+        db.sql("CREATE TABLE t (a STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(a))")
+        db.sql("INSERT INTO t VALUES ('x', 1000, 1.0)")
+        r = db._region_of("t")
+        r.flush()
+        db.sql("ALTER TABLE t ADD COLUMN w DOUBLE")
+        db.sql("INSERT INTO t (a, ts, v, w) VALUES ('y', 2000, 2.0, 9.0)")
+        r = db._region_of("t")
+        r.flush()
+        r.compact()  # pre-alter + post-alter SSTs merged
+        assert len(r.sst_files) == 1
+        res = db.sql("SELECT a, v, w FROM t ORDER BY a")
+        assert res.rows == [["x", 1.0, None], ["y", 2.0, 9.0]]
+
+    def test_drop_column_with_old_ssts(self, db):
+        db.sql("CREATE TABLE t (a STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, w DOUBLE, PRIMARY KEY(a))")
+        db.sql("INSERT INTO t VALUES ('x', 1000, 1.0, 5.0)")
+        db._region_of("t").flush()
+        db.sql("ALTER TABLE t DROP COLUMN w")
+        db.sql("INSERT INTO t (a, ts, v) VALUES ('y', 2000, 2.0)")
+        res = db.sql("SELECT * FROM t ORDER BY a")
+        assert res.column_names == ["a", "ts", "v"]
+        assert res.rows == [["x", 1000, 1.0], ["y", 2000, 2.0]]
+        from greptimedb_tpu.errors import ColumnNotFound
+        import pytest as _pytest
+        with _pytest.raises(ColumnNotFound):
+            db.sql("SELECT w FROM t")
+
+    def test_default_value_backfill(self, db):
+        db.sql("CREATE TABLE t (a STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(a))")
+        db.sql("INSERT INTO t VALUES ('x', 1000, 1.0)")
+        db.sql("INSERT INTO t (a, ts) VALUES ('z', 3000)")
+        res = db.sql("SELECT a, v FROM t ORDER BY a")
+        assert res.rows == [["x", 1.0], ["z", None]]
