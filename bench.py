@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""TSBS-style benchmark: double-groupby-all (the north-star query).
+
+Reference baseline (BASELINE.md): GreptimeDB v0.12.0 on EC2 c5d.2xlarge
+runs TSBS `double-groupby-all` — mean of all 10 CPU metrics grouped by
+(hostname, hour) over a 12-hour window at scale=4000 — in 1330.05 ms.
+
+This bench builds the same-shape dataset (4000 hosts, 24 h @ 10 s, 10 f64
+metric columns ≈ 34.5 M rows), ingests it through the real write path
+(tag encode → memtable → Parquet SST), loads it into the device cache, and
+measures steady-state SQL latency of the north-star query (median of 10
+runs after 2 warmups — the reference's TSBS numbers are warm medians too).
+
+Prints ONE json line:
+  {"metric": "tsbs_double_groupby_all_ms", "value": <median ms>,
+   "unit": "ms", "vs_baseline": <value / 1330.05>}   (lower is better)
+
+Env knobs: GREPTIME_BENCH_SCALE (hosts, default 4000),
+GREPTIME_BENCH_HOURS (default 24), GREPTIME_BENCH_DATA (cache dir).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_MS = 1330.05
+SCALE = int(os.environ.get("GREPTIME_BENCH_SCALE", "4000"))
+HOURS = int(os.environ.get("GREPTIME_BENCH_HOURS", "24"))
+STEP_S = 10
+DATA_DIR = os.environ.get(
+    "GREPTIME_BENCH_DATA", os.path.join(os.path.dirname(__file__), ".bench_data")
+)
+METRICS = [
+    "usage_user", "usage_system", "usage_idle", "usage_nice", "usage_iowait",
+    "usage_irq", "usage_softirq", "usage_steal", "usage_guest",
+    "usage_guest_nice",
+]
+T0 = 1451606400000  # 2016-01-01, the TSBS epoch
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_db():
+    from greptimedb_tpu.standalone import GreptimeDB
+    from greptimedb_tpu.storage.region import RegionOptions
+
+    marker = os.path.join(DATA_DIR, f"ready_{SCALE}_{HOURS}")
+    db = GreptimeDB(
+        DATA_DIR,
+        region_options=RegionOptions(wal_enabled=False,
+                                     flush_threshold_bytes=1 << 40),
+    )
+    cols = ", ".join(f"{m} DOUBLE" for m in METRICS)
+    db.sql(
+        f"CREATE TABLE IF NOT EXISTS cpu (hostname STRING, "
+        f"ts TIMESTAMP(3) TIME INDEX, {cols}, PRIMARY KEY (hostname))"
+    )
+    if os.path.exists(marker):
+        return db
+
+    log(f"generating TSBS data: scale={SCALE}, {HOURS}h @ {STEP_S}s ...")
+    region = db._region_of("cpu")
+    steps_per_hour = 3600 // STEP_S
+    total_steps = HOURS * steps_per_hour
+    hostnames = np.array([f"host_{i}" for i in range(SCALE)], dtype=object)
+    rng = np.random.default_rng(7)
+    # random-walk per host, ingested in hour-sized chunks (row-major: for
+    # each timestep all hosts report, like the TSBS generator)
+    state = rng.uniform(0, 100, size=(SCALE, len(METRICS)))
+    t_ingest = time.time()
+    for hour in range(HOURS):
+        n = SCALE * steps_per_hour
+        ts = (
+            T0
+            + (hour * steps_per_hour + np.repeat(np.arange(steps_per_hour), SCALE))
+            * STEP_S * 1000
+        )
+        hosts = np.tile(hostnames, steps_per_hour)
+        data = {"hostname": hosts, "ts": ts}
+        walk = rng.normal(0, 1, size=(steps_per_hour, SCALE, len(METRICS)))
+        series = np.clip(state[None, :, :] + np.cumsum(walk, axis=0), 0, 100)
+        state = series[-1]
+        for j, m in enumerate(METRICS):
+            data[m] = series[:, :, j].reshape(-1)
+        region.write(data)
+        region.flush()
+        log(f"  hour {hour + 1}/{HOURS} ingested "
+            f"({(hour + 1) * n:,} rows, {time.time() - t_ingest:.0f}s)")
+    with open(marker, "w") as f:
+        f.write("ok")
+    return db
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # the runtime image preimports jax, so the env var alone is too late
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    db = build_db()
+    log(f"jax devices: {jax.devices()}")
+
+    # TSBS double-groupby-all: avg of all 10 metrics by (hostname, hour)
+    # over a 12h window (window shrinks with GREPTIME_BENCH_HOURS)
+    window_h = min(12, HOURS)
+    q_start = T0 + ((HOURS - window_h) // 2) * 3600 * 1000
+    q_end = q_start + window_h * 3600 * 1000
+    aggs = ", ".join(f"avg({m})" for m in METRICS)
+    sql = (
+        f"SELECT hostname, date_trunc('hour', ts) AS hour, {aggs} "
+        f"FROM cpu WHERE ts >= {q_start} AND ts < {q_end} "
+        f"GROUP BY hostname, hour"
+    )
+
+    log("warmup (compile + cache build) ...")
+    t0 = time.time()
+    r = db.sql(sql)
+    log(f"  first run: {(time.time() - t0) * 1000:.0f} ms, {r.num_rows} groups")
+    t0 = time.time()
+    db.sql(sql)
+    log(f"  second run: {(time.time() - t0) * 1000:.0f} ms")
+
+    times = []
+    for _ in range(10):
+        t0 = time.time()
+        r = db.sql(sql)
+        times.append((time.time() - t0) * 1000)
+    value = float(np.median(times))
+    expected_groups = SCALE * window_h
+    assert r.num_rows == expected_groups, (r.num_rows, expected_groups)
+    log(f"runs: {[f'{t:.0f}' for t in times]} ms; groups={r.num_rows}")
+    print(json.dumps({
+        "metric": "tsbs_double_groupby_all_ms",
+        "value": round(value, 2),
+        "unit": "ms",
+        "vs_baseline": round(value / BASELINE_MS, 4),
+    }))
+    db.close()
+
+
+if __name__ == "__main__":
+    main()

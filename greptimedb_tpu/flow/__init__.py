@@ -1,0 +1,5 @@
+"""Flow engine: continuous aggregation (reference src/flow, SURVEY.md §2.7).
+
+Batching mode first (time-window-aware re-query — trivially TPU-friendly,
+SURVEY.md §7.2 step 7); the streaming dataflow mode is a later round.
+"""

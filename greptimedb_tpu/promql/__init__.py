@@ -1,0 +1,15 @@
+"""PromQL engine: Prometheus query language over TPU tensors.
+
+The reference compiles PromQL to DataFusion plans with custom extension
+operators (SURVEY.md §2.3: SeriesNormalize, RangeManipulate, SeriesDivide,
+ExtrapolatedRate...). Here the whole range-vector pipeline lowers to one
+XLA computation over a dense ``[series, steps]`` value matrix (SURVEY.md
+§3.3: "exactly the loop the TPU build turns into an XLA computation"):
+window boundaries by composite-key searchsorted, rate/increase by
+counter-reset-adjusted cumulative sums, cross-series aggregation by
+segment reduction over the series axis.
+"""
+
+from greptimedb_tpu.promql.parser import parse_promql
+
+__all__ = ["parse_promql"]

@@ -1,0 +1,914 @@
+"""PromQL evaluation: range queries as dense [series, steps] tensor programs.
+
+Pipeline per selector (SURVEY.md §3.3's hot loop, TPU-shaped):
+1. host: match series against label matchers over the region's series
+   registry (dictionary codes, no string work on device);
+2. device: one jitted window kernel per (table shape-class, range, steps)
+   computes per-(series, step) window stats — boundaries by composite-key
+   searchsorted over the (tsid, ts)-sorted resident table, sums by
+   counter-reset-adjusted cumulative sums (exact Prometheus extrapolation,
+   reference src/promql/src/functions/extrapolate_rate.rs:56), min/max by
+   multi-bucket segment scatter;
+3. device: cross-series aggregation = segment reduction over the series
+   axis; binary-op vector matching joins series on host, aligns rows on
+   device.
+
+NaN encodes "absent" throughout (Prometheus staleness semantics).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.errors import PlanError, TableNotFound, Unsupported
+from greptimedb_tpu.promql.parser import (
+    Aggregation, BinaryExpr, FunctionCall, LabelMatcher, NumberLit, PromExpr,
+    StringLit, SubqueryExpr, UnaryExpr, VectorSelector, parse_promql,
+)
+from greptimedb_tpu.storage.memtable import TSID
+
+DEFAULT_LOOKBACK_S = 300.0
+
+_I64_MAX = np.int64(np.iinfo(np.int64).max)
+
+
+@dataclass
+class EvalResult:
+    """A (possibly scalar) instant-vector time series matrix."""
+
+    values: jnp.ndarray  # [S, T] f32; NaN = absent
+    labels: list[dict]  # len S
+    is_scalar: bool = False
+
+    @property
+    def num_series(self) -> int:
+        return len(self.labels)
+
+
+def _matches(matcher: LabelMatcher, value: str) -> bool:
+    if matcher.op == "=":
+        return value == matcher.value
+    if matcher.op == "!=":
+        return value != matcher.value
+    if matcher.op == "=~":
+        return re.fullmatch(matcher.value, value) is not None
+    if matcher.op == "!~":
+        return re.fullmatch(matcher.value, value) is None
+    raise PlanError(f"bad matcher {matcher.op}")
+
+
+# ---------------------------------------------------------------------------
+# Window kernels
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class WindowParams:
+    """Static shape-class key for window kernels. start_ms is deliberately
+    NOT here — it is a traced argument, so repeated queries at different
+    times reuse one compiled program."""
+
+    step_ms: int
+    num_steps: int
+    range_ms: int  # window width (lookback for instant selectors)
+    num_sel: int  # padded selected series count
+    total_series: int
+    kind: str  # which stats to compute
+
+
+_KERNEL_CACHE: dict[WindowParams, object] = {}
+
+
+def _window_kernel(p: WindowParams):
+    """Build the jitted kernel computing window stats for selected series.
+
+    Inputs: ts [N] i64, val [N] f32, tsid [N] i32, mask [N] bool,
+            sel_tsids [S] i32 (padded with -1), start_ms scalar i64.
+    Output dict of [S, T] arrays depending on p.kind.
+    """
+
+    T = p.num_steps
+    S = p.num_sel
+
+    @jax.jit
+    def kernel(ts, val, tsid, mask, sel_tsids, start_ms):
+        n = ts.shape[0]
+        base = start_ms - p.range_ms - 1
+        span = p.step_ms * (T + 2) + p.range_ms + 2
+        K = np.int64(1) << int(span - 1).bit_length() if span > 0 else np.int64(2)
+        # composite sort key; padding/invalid rows to +inf so order holds
+        rel = jnp.clip(ts - base, 0, K - 1)
+        valid = mask & ~jnp.isnan(val) & (ts > base) & (ts - base < K)
+        key = jnp.where(valid, tsid.astype(jnp.int64) * K + rel, _I64_MAX)
+        # data is sorted by (tsid, ts) but NaN/out-of-range rows poke holes;
+        # re-sort keys (cheap vs correctness; XLA sorts well)
+        order = jnp.argsort(key)
+        key_s = key[order]
+        val_s = val[order]
+        ts_s = ts[order]
+        tsid_s = tsid[order]
+        valid_s = valid[order]
+
+        # per-series counter-reset adjustment (for counter kinds)
+        prev_same = jnp.concatenate(
+            [jnp.array([False]), (tsid_s[1:] == tsid_s[:-1]) & valid_s[1:] & valid_s[:-1]]
+        )
+        prev_val = jnp.concatenate([val_s[:1] * 0, val_s[:-1]])
+        drop = jnp.where(prev_same & (prev_val > val_s), prev_val, 0.0)
+        gdrop = jnp.cumsum(drop.astype(jnp.float64))
+        # offset at series start: first valid index per selected series found
+        # via searchsorted of tsid*K
+        adj = val_s.astype(jnp.float64) + gdrop  # minus series-start gdrop via window diff
+
+        # cumulative sums (leading zero) over sorted order
+        def cs(x):
+            x64 = x.astype(jnp.float64)
+            return jnp.concatenate([jnp.zeros(1, jnp.float64), jnp.cumsum(x64)])
+
+        cs_v = cs(jnp.where(valid_s, val_s, 0.0))
+        cs_v2 = cs(jnp.where(valid_s, val_s.astype(jnp.float64) ** 2, 0.0))
+        tsec = (ts_s - start_ms).astype(jnp.float64) / 1000.0
+        cs_t = cs(jnp.where(valid_s, tsec, 0.0))
+        cs_tv = cs(jnp.where(valid_s, tsec * val_s.astype(jnp.float64), 0.0))
+        cs_t2 = cs(jnp.where(valid_s, tsec * tsec, 0.0))
+
+        steps = start_ms + p.step_ms * jnp.arange(T, dtype=jnp.int64)  # [T]
+        sel64 = sel_tsids.astype(jnp.int64)  # [S]
+        sel_ok = sel_tsids >= 0
+        skey = jnp.where(sel_ok, sel64, 0) * K  # [S]
+        # window (t - range, t]: left-exclusive
+        lo_k = skey[:, None] + jnp.clip(steps[None, :] - p.range_ms - base + 1, 1, K - 1)
+        hi_k = skey[:, None] + jnp.clip(steps[None, :] - base, 1, K - 1)
+        lo = jnp.searchsorted(key_s, lo_k.reshape(-1), side="left").reshape(S, T)
+        hi = jnp.searchsorted(key_s, hi_k.reshape(-1), side="right").reshape(S, T)
+        cnt = (hi - lo).astype(jnp.int32)
+        has = (cnt > 0) & sel_ok[:, None]
+        has2 = (cnt >= 2) & sel_ok[:, None]
+
+        first_i = jnp.clip(lo, 0, n - 1)
+        last_i = jnp.clip(hi - 1, 0, n - 1)
+        out = {}
+        fcnt = cnt.astype(jnp.float32)
+        nan = jnp.float32(jnp.nan)
+
+        if p.kind in ("counter", "gauge_window", "regression", "instant"):
+            out["count"] = jnp.where(has, fcnt, 0.0)
+        if p.kind == "instant":
+            lastv = val_s[last_i]
+            out["last"] = jnp.where(has, lastv, nan)
+            out["last_ts"] = jnp.where(has, ts_s[last_i], 0)
+        if p.kind == "counter":
+            ft = ts_s[first_i]
+            lt = ts_s[last_i]
+            fv = val_s[first_i]
+            d_adj = (adj[last_i] - adj[first_i]).astype(jnp.float32)
+            out["first_ts"] = jnp.where(has, ft, 0)
+            out["last_ts"] = jnp.where(has, lt, 0)
+            out["first_val"] = jnp.where(has, fv, nan)
+            out["last_val"] = jnp.where(has, val_s[last_i], nan)
+            out["delta_adj"] = jnp.where(has2, d_adj, nan)
+            out["delta_raw"] = jnp.where(
+                has2, val_s[last_i] - val_s[first_i], nan
+            )
+            # resets/changes counts via indicator cumsums
+            ind_reset = jnp.where(prev_same & (prev_val > val_s), 1.0, 0.0)
+            ind_change = jnp.where(prev_same & (prev_val != val_s), 1.0, 0.0)
+            cs_r = cs(ind_reset)
+            cs_c = cs(ind_change)
+            # exclude the boundary pair crossing into the window: indicator at
+            # index i compares i-1,i; window pairs are (lo+1..hi-1)
+            lo1 = jnp.clip(lo + 1, 0, n)
+            out["resets"] = jnp.where(has, (cs_r[hi] - cs_r[lo1]).astype(jnp.float32), nan)
+            out["changes"] = jnp.where(has, (cs_c[hi] - cs_c[lo1]).astype(jnp.float32), nan)
+        if p.kind in ("gauge_window",):
+            s = (cs_v[hi] - cs_v[lo]).astype(jnp.float32)
+            s2 = (cs_v2[hi] - cs_v2[lo]).astype(jnp.float32)
+            out["sum"] = jnp.where(has, s, nan)
+            out["avg"] = jnp.where(has, s / jnp.maximum(fcnt, 1), nan)
+            mean = s.astype(jnp.float64) / jnp.maximum(cnt, 1)
+            var = (cs_v2[hi] - cs_v2[lo]) / jnp.maximum(cnt, 1) - mean * mean
+            out["var"] = jnp.where(has, jnp.maximum(var, 0.0).astype(jnp.float32), nan)
+            out["last"] = jnp.where(has, val_s[last_i], nan)
+            out["first"] = jnp.where(has, val_s[first_i], nan)
+            out["first_ts"] = jnp.where(has, ts_s[first_i], 0)
+            out["last_ts"] = jnp.where(has, ts_s[last_i], 0)
+        if p.kind == "regression":
+            sw = (cs_v[hi] - cs_v[lo])
+            st = cs_t[hi] - cs_t[lo]
+            stv = cs_tv[hi] - cs_tv[lo]
+            st2 = cs_t2[hi] - cs_t2[lo]
+            cn = cnt.astype(jnp.float64)
+            denom = cn * st2 - st * st
+            slope = jnp.where(denom != 0, (cn * stv - st * sw) / denom, jnp.nan)
+            intercept = jnp.where(cn > 0, (sw - slope * st) / cn, jnp.nan)
+            out["slope"] = jnp.where(has2, slope.astype(jnp.float32), nan)
+            out["intercept"] = jnp.where(has2, intercept.astype(jnp.float32), nan)
+            out["last_ts"] = jnp.where(has, ts_s[last_i], 0)
+        if p.kind == "irate":
+            lastv = val_s[last_i]
+            prev_i = jnp.clip(hi - 2, 0, n - 1)
+            prevv = val_s[prev_i]
+            out["last_ts"] = jnp.where(has2, ts_s[last_i], 0)
+            out["prev_ts"] = jnp.where(has2, ts_s[prev_i], 0)
+            out["last_val"] = jnp.where(has2, lastv, nan)
+            out["prev_val"] = jnp.where(has2, prevv, nan)
+        if p.kind == "minmax":
+            # multi-bucket scatter: sample contributes to ceil(r/step)+1
+            # windows; fori_loop keeps compile size O(1) in range/step ratio
+            kmax = int(p.range_ms // p.step_ms + 1)
+            row_of = jnp.full((p.total_series + 1,), -1, dtype=jnp.int32)
+            row_of = row_of.at[jnp.where(sel_ok, sel_tsids, p.total_series)].set(
+                jnp.arange(S, dtype=jnp.int32)
+            )
+            rows = row_of[jnp.clip(tsid_s, 0, p.total_series)]
+            rows = jnp.where(valid_s & (tsid_s >= 0), rows, -1)
+            # first window index receiving this sample: smallest i with
+            # start + i*step >= ts  →  i = ceil((ts-start)/step)
+            i0 = -((start_ms - ts_s) // p.step_ms)  # ceil div
+
+            def body(k, carry):
+                mn, mx = carry
+                i_k = i0 + k
+                in_win = (
+                    (rows >= 0)
+                    & (i_k >= 0)
+                    & (i_k < T)
+                    & ((start_ms + i_k * p.step_ms) - ts_s < p.range_ms)
+                    & ((start_ms + i_k * p.step_ms) >= ts_s)
+                )
+                gid = jnp.where(in_win, rows.astype(jnp.int64) * T + i_k, S * T)
+                mn = mn.at[gid].min(jnp.where(in_win, val_s, jnp.inf))
+                mx = mx.at[gid].max(jnp.where(in_win, val_s, -jnp.inf))
+                return mn, mx
+
+            mn0 = jnp.full((S * T + 1,), jnp.inf, dtype=jnp.float32)
+            mx0 = jnp.full((S * T + 1,), -jnp.inf, dtype=jnp.float32)
+            mn, mx = jax.lax.fori_loop(0, kmax, body, (mn0, mx0))
+            mn = mn[:-1].reshape(S, T)
+            mx = mx[:-1].reshape(S, T)
+            out["min"] = jnp.where(jnp.isfinite(mn), mn, nan)
+            out["max"] = jnp.where(jnp.isfinite(mx), mx, nan)
+        return out
+
+    return kernel
+
+
+class SelectorData:
+    """Host-side prepared state for one table used by selectors."""
+
+    def __init__(self, db, table: str):
+        region = db._region_of(table)
+        self.region = region
+        self.table = db.cache.get(region)
+        self.schema = region.schema
+        self.ts_name = region.schema.time_index.name
+        self.tag_names = region.tag_names
+        # series registry: tsid -> tag code tuple
+        self.series_codes = sorted(region._series.items(), key=lambda kv: kv[1])
+        self.encoders = region.encoders
+
+    def field_column(self, matchers: list[LabelMatcher]) -> str:
+        fields = [c.name for c in self.schema.field_columns]
+        for m in matchers:
+            if m.name == "__field__":
+                if m.value not in fields:
+                    raise PlanError(f"field {m.value} not in {self.table!r}")
+                return m.value
+        for cand in ("greptime_value", "val", "value"):
+            if cand in fields:
+                return cand
+        if len(fields) == 1:
+            return fields[0]
+        raise PlanError(
+            f"table has {len(fields)} fields; use __field__ matcher: {fields}"
+        )
+
+    def select_series(self, matchers: list[LabelMatcher]) -> tuple[np.ndarray, list[dict]]:
+        """Returns (tsids, labels dicts) matching the label matchers."""
+        tag_matchers = [m for m in matchers if m.name != "__field__"]
+        values = {name: self.encoders[name].values() for name in self.tag_names}
+        sel: list[int] = []
+        labels: list[dict] = []
+        for key, tsid in self.series_codes:
+            lab = {
+                name: values[name][code]
+                for name, code in zip(self.tag_names, key)
+                if 0 <= code < len(values[name])
+            }
+            ok = True
+            for m in tag_matchers:
+                v = lab.get(m.name, "")
+                if not _matches(m, str(v)):
+                    ok = False
+                    break
+            if ok:
+                sel.append(tsid)
+                labels.append(lab)
+        return np.asarray(sel, dtype=np.int32), labels
+
+
+class PromEvaluator:
+    def __init__(self, db, start_s: float, end_s: float, step_s: float,
+                 lookback_s: float = DEFAULT_LOOKBACK_S):
+        self.db = db
+        if end_s < start_s:
+            raise PlanError(f"invalid time range: end {end_s} < start {start_s}")
+        if step_s <= 0:
+            raise PlanError(f"invalid step: {step_s}")
+        self.start_ms = int(round(start_s * 1000))
+        self.step_ms = max(int(round(step_s * 1000)), 1)
+        # integer-ms math: float division can drop the final (inclusive) step
+        end_ms = int(round(end_s * 1000))
+        self.num_steps = (end_ms - self.start_ms) // self.step_ms + 1
+        self.lookback_ms = int(lookback_s * 1000)
+        self._data: dict[str, SelectorData] = {}
+        self._kernels: dict[tuple, object] = {}
+
+    # ---- plumbing -------------------------------------------------------
+    def data_for(self, metric: str) -> SelectorData:
+        if metric not in self._data:
+            self._data[metric] = SelectorData(self.db, metric)
+        return self._data[metric]
+
+    def steps_ms(self) -> np.ndarray:
+        return self.start_ms + self.step_ms * np.arange(self.num_steps, dtype=np.int64)
+
+    _KIND_KEYS = {
+        "instant": ("count", "last", "last_ts"),
+        "counter": ("count", "first_ts", "last_ts", "first_val", "last_val",
+                    "delta_adj", "delta_raw", "resets", "changes"),
+        "gauge_window": ("count", "sum", "avg", "var", "last", "first",
+                         "first_ts", "last_ts"),
+        "regression": ("count", "slope", "intercept", "last_ts"),
+        "irate": ("last_ts", "prev_ts", "last_val", "prev_val"),
+        "minmax": ("min", "max"),
+    }
+
+    def _run_window(
+        self, sel: VectorSelector, kind: str, range_ms: int | None = None
+    ) -> tuple[dict, list[dict]]:
+        try:
+            d = self.data_for(sel.metric)
+        except TableNotFound:
+            # unknown metric = empty vector (Prometheus semantics)
+            empty = jnp.zeros((0, self.num_steps), jnp.float32)
+            return {k: empty for k in self._KIND_KEYS[kind]}, []
+        fieldcol = d.field_column(sel.matchers)
+        tsids, labels = d.select_series(sel.matchers)
+        S = max(1, 1 << (max(len(tsids), 1) - 1).bit_length())
+        sel_padded = np.full(S, -1, dtype=np.int32)
+        sel_padded[: len(tsids)] = tsids
+        rng = range_ms
+        if rng is None:
+            rng = int(sel.range_s * 1000) if sel.range_s else self.lookback_ms
+        offset_ms = int(sel.offset_s * 1000)
+        # @ modifier pins evaluation time: compute ONE step at at_ts (minus
+        # offset, per Prometheus), then broadcast across the output grid
+        pinned = sel.at_ts is not None
+        if pinned:
+            start = int(sel.at_ts * 1000) - offset_ms
+            num_steps = 1
+        else:
+            start = self.start_ms - offset_ms
+            num_steps = self.num_steps
+        p = WindowParams(
+            step_ms=self.step_ms,
+            num_steps=num_steps,
+            range_ms=int(rng),
+            num_sel=S,
+            total_series=max(d.region.num_series, 1),
+            kind=kind,
+        )
+        kern = _KERNEL_CACHE.get(p)
+        if kern is None:
+            kern = _window_kernel(p)
+            _KERNEL_CACHE[p] = kern
+        cols = d.table.columns
+        out = kern(
+            cols[d.ts_name], cols[fieldcol], cols[TSID].astype(jnp.int32),
+            d.table.row_mask, jnp.asarray(sel_padded), np.int64(start),
+        )
+        out = {k: v[: len(tsids)] for k, v in out.items()}
+        if pinned:
+            out = {
+                k: jnp.broadcast_to(v, (v.shape[0], self.num_steps))
+                for k, v in out.items()
+            }
+        self._last_window_grid = (start, int(rng), pinned)
+        return out, labels
+
+    # ---- eval -----------------------------------------------------------
+    def eval(self, e: PromExpr) -> EvalResult:
+        if isinstance(e, NumberLit):
+            v = jnp.full((1, self.num_steps), e.value, dtype=jnp.float32)
+            return EvalResult(v, [{}], is_scalar=True)
+        if isinstance(e, StringLit):
+            raise Unsupported("bare string expression")
+        if isinstance(e, VectorSelector):
+            if e.range_s is not None:
+                raise PlanError(f"range vector {e} needs a function")
+            out, labels = self._run_window(e, "instant")
+            now = jnp.asarray(self.steps_ms())[None, :]
+            # staleness: sample must be within lookback (already enforced by
+            # window) — value is last sample in (t-lookback, t]
+            vals = out["last"] if labels else jnp.zeros((0, self.num_steps), jnp.float32)
+            return EvalResult(vals, labels)
+        if isinstance(e, UnaryExpr):
+            r = self.eval(e.expr)
+            return EvalResult(-r.values if e.op == "-" else r.values, r.labels,
+                              r.is_scalar)
+        if isinstance(e, FunctionCall):
+            return self.eval_function(e)
+        if isinstance(e, Aggregation):
+            return self.eval_aggregation(e)
+        if isinstance(e, BinaryExpr):
+            return self.eval_binary(e)
+        if isinstance(e, SubqueryExpr):
+            raise Unsupported("subqueries not yet implemented")
+        raise Unsupported(f"promql node {type(e).__name__}")
+
+    # ---- functions --------------------------------------------------------
+    def eval_function(self, e: FunctionCall) -> EvalResult:
+        f = e.func
+        simple = {
+            "abs": jnp.abs, "ceil": jnp.ceil, "floor": jnp.floor,
+            "exp": jnp.exp, "ln": jnp.log, "log2": jnp.log2,
+            "log10": jnp.log10, "sqrt": jnp.sqrt, "sgn": jnp.sign,
+            "acos": jnp.arccos, "asin": jnp.arcsin, "atan": jnp.arctan,
+            "cos": jnp.cos, "sin": jnp.sin, "tan": jnp.tan,
+            "cosh": jnp.cosh, "sinh": jnp.sinh, "tanh": jnp.tanh,
+            "deg": jnp.degrees, "rad": jnp.radians,
+        }
+        if f in simple:
+            r = self.eval(e.args[0])
+            return EvalResult(simple[f](r.values), r.labels, r.is_scalar)
+        if f == "round":
+            r = self.eval(e.args[0])
+            to = 1.0
+            if len(e.args) > 1 and isinstance(e.args[1], NumberLit):
+                to = e.args[1].value
+            return EvalResult(jnp.round(r.values / to) * to, r.labels, r.is_scalar)
+        if f in ("clamp", "clamp_min", "clamp_max"):
+            r = self.eval(e.args[0])
+            v = r.values
+            if f == "clamp":
+                v = jnp.clip(v, e.args[1].value, e.args[2].value)
+            elif f == "clamp_min":
+                v = jnp.maximum(v, e.args[1].value)
+            else:
+                v = jnp.minimum(v, e.args[1].value)
+            return EvalResult(v, r.labels)
+        if f == "scalar":
+            r = self.eval(e.args[0])
+            if r.num_series == 1:
+                return EvalResult(r.values, [{}], is_scalar=True)
+            v = jnp.full((1, self.num_steps), jnp.nan, jnp.float32)
+            return EvalResult(v, [{}], is_scalar=True)
+        if f == "vector":
+            r = self.eval(e.args[0])
+            return EvalResult(r.values, [{}])
+        if f == "time":
+            t = (jnp.asarray(self.steps_ms()) / 1000.0).astype(jnp.float32)
+            return EvalResult(t[None, :], [{}], is_scalar=True)
+        if f == "timestamp":
+            sel = self._selector_arg(e, 0, want_range=False)
+            out, labels = self._run_window(sel, "instant")
+            # divide in f64: f32 quantizes epoch-ms to ~minutes
+            ts = (out["last_ts"].astype(jnp.float64) / 1000.0)
+            ts = jnp.where(jnp.isnan(out["last"]), jnp.nan, ts)
+            return EvalResult(ts, labels)
+        if f == "absent":
+            r = self.eval(e.args[0])
+            present = jnp.any(~jnp.isnan(r.values), axis=0) if r.num_series else (
+                jnp.zeros(self.num_steps, bool)
+            )
+            v = jnp.where(present, jnp.nan, 1.0).astype(jnp.float32)
+            lab = {}
+            if isinstance(e.args[0], VectorSelector):
+                lab = {
+                    m.name: m.value
+                    for m in e.args[0].matchers
+                    if m.op == "=" and m.name != "__field__"
+                }
+            return EvalResult(v[None, :], [lab])
+        if f in ("rate", "increase", "delta"):
+            sel = self._selector_arg(e, 0)
+            out, labels = self._run_window(sel, "counter")
+            start, _rng, pinned = self._last_window_grid
+            if pinned:
+                range_end = np.full(self.num_steps, start, dtype=np.float64)
+            else:
+                range_end = start + self.step_ms * np.arange(
+                    self.num_steps, dtype=np.float64
+                )
+            vals = _extrapolated(
+                out, sel.range_s, range_end, counter=f != "delta",
+                is_rate=f == "rate",
+            )
+            return EvalResult(vals, labels)
+        if f in ("irate", "idelta"):
+            sel = self._selector_arg(e, 0)
+            out, labels = self._run_window(sel, "irate")
+            dt = (out["last_ts"] - out["prev_ts"]).astype(jnp.float32) / 1000.0
+            dv = out["last_val"] - out["prev_val"]
+            if f == "irate":
+                dv = jnp.where(dv < 0, out["last_val"], dv)  # counter reset
+                vals = jnp.where(dt > 0, dv / dt, jnp.nan)
+            else:
+                vals = jnp.where(dt > 0, dv, jnp.nan)
+            return EvalResult(vals, labels)
+        if f in ("resets", "changes"):
+            sel = self._selector_arg(e, 0)
+            out, labels = self._run_window(sel, "counter")
+            return EvalResult(out[f], labels)
+        if f in ("avg_over_time", "sum_over_time", "count_over_time",
+                 "last_over_time", "first_over_time", "stddev_over_time",
+                 "stdvar_over_time", "present_over_time"):
+            sel = self._selector_arg(e, 0)
+            out, labels = self._run_window(sel, "gauge_window")
+            present = ~jnp.isnan(out["last"])
+            table = {
+                "avg_over_time": out["avg"],
+                "sum_over_time": out["sum"],
+                "count_over_time": jnp.where(present, out["count"], jnp.nan),
+                "last_over_time": out["last"],
+                "first_over_time": out["first"],
+                "stddev_over_time": jnp.sqrt(out["var"]),
+                "stdvar_over_time": out["var"],
+                "present_over_time": jnp.where(present, 1.0, jnp.nan),
+            }
+            return EvalResult(table[f], labels)
+        if f in ("min_over_time", "max_over_time"):
+            sel = self._selector_arg(e, 0)
+            out, labels = self._run_window(sel, "minmax")
+            return EvalResult(out["min" if f == "min_over_time" else "max"], labels)
+        if f == "deriv":
+            sel = self._selector_arg(e, 0)
+            out, labels = self._run_window(sel, "regression")
+            return EvalResult(out["slope"], labels)
+        if f == "predict_linear":
+            sel = self._selector_arg(e, 0)
+            horizon = self.eval(e.args[1]).values[0]  # scalar [T]
+            out, labels = self._run_window(sel, "regression")
+            # regression t is seconds relative to each step's start_ms grid;
+            # predict at t_step + horizon
+            t_at = (jnp.asarray(self.steps_ms()) - self.start_ms).astype(
+                jnp.float32
+            ) / 1000.0
+            vals = out["intercept"] + out["slope"] * (t_at[None, :] + horizon[None, :])
+            return EvalResult(vals, labels)
+        if f == "histogram_quantile":
+            return self._histogram_quantile(e)
+        if f == "label_replace":
+            r = self.eval(e.args[0])
+            dst, repl, src, regex = (a.value for a in e.args[1:5])
+            rx = re.compile(str(regex))
+            labels = []
+            for lab in r.labels:
+                m = rx.fullmatch(str(lab.get(src, "")))
+                lab = dict(lab)
+                if m is not None:
+                    lab[dst] = m.expand(
+                        str(repl).replace("$", "\\")
+                    ) if "$" in str(repl) else str(repl)
+                    if lab[dst] == "":
+                        lab.pop(dst, None)
+                labels.append(lab)
+            return EvalResult(r.values, labels)
+        if f == "label_join":
+            r = self.eval(e.args[0])
+            dst = e.args[1].value
+            sep = e.args[2].value
+            srcs = [a.value for a in e.args[3:]]
+            labels = []
+            for lab in r.labels:
+                lab = dict(lab)
+                lab[dst] = str(sep).join(str(lab.get(s, "")) for s in srcs)
+                labels.append(lab)
+            return EvalResult(r.values, labels)
+        if f == "sort" or f == "sort_desc":
+            return self.eval(e.args[0])  # ordering is a presentation concern
+        raise Unsupported(f"promql function {f}")
+
+    def _selector_arg(self, e: FunctionCall, i: int, want_range: bool = True) -> VectorSelector:
+        a = e.args[i]
+        if not isinstance(a, VectorSelector):
+            raise Unsupported(f"{e.func} needs a selector argument, got {a}")
+        if want_range and a.range_s is None:
+            raise PlanError(f"{e.func} needs a range vector (e.g. {a}[5m])")
+        return a
+
+    # ---- aggregation ------------------------------------------------------
+    def eval_aggregation(self, e: Aggregation) -> EvalResult:
+        r = self.eval(e.expr)
+        if r.num_series == 0:
+            return r
+        # group series by label subset on host
+        def group_key(lab: dict) -> tuple:
+            if e.without:
+                keys = sorted(k for k in lab if k not in e.grouping)
+            elif e.grouping:
+                keys = [k for k in sorted(e.grouping)]
+            else:
+                keys = []
+            return tuple((k, str(lab.get(k, ""))) for k in keys)
+
+        groups: dict[tuple, int] = {}
+        gids = np.zeros(r.num_series, dtype=np.int32)
+        out_labels: list[dict] = []
+        for i, lab in enumerate(r.labels):
+            k = group_key(lab)
+            if k not in groups:
+                groups[k] = len(groups)
+                out_labels.append(dict(k))
+            gids[i] = groups[k]
+        ng = len(groups)
+        v = r.values
+        present = ~jnp.isnan(v)
+        gid_dev = jnp.asarray(gids)
+        cnt = jax.ops.segment_sum(present.astype(jnp.float32), gid_dev, num_segments=ng)
+
+        if e.op in ("sum", "avg", "count", "group", "stddev", "stdvar"):
+            s = jax.ops.segment_sum(jnp.where(present, v, 0), gid_dev, num_segments=ng)
+            if e.op == "sum":
+                out = jnp.where(cnt > 0, s, jnp.nan)
+            elif e.op == "avg":
+                out = jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), jnp.nan)
+            elif e.op == "count":
+                out = jnp.where(cnt > 0, cnt, jnp.nan)
+            elif e.op == "group":
+                out = jnp.where(cnt > 0, 1.0, jnp.nan)
+            else:
+                s2 = jax.ops.segment_sum(
+                    jnp.where(present, v * v, 0), gid_dev, num_segments=ng
+                )
+                mean = s / jnp.maximum(cnt, 1)
+                var = jnp.maximum(s2 / jnp.maximum(cnt, 1) - mean * mean, 0)
+                out = jnp.where(cnt > 0, var if e.op == "stdvar" else jnp.sqrt(var),
+                                jnp.nan)
+            return EvalResult(out, out_labels)
+        if e.op in ("min", "max"):
+            fill = jnp.inf if e.op == "min" else -jnp.inf
+            fn = jax.ops.segment_min if e.op == "min" else jax.ops.segment_max
+            out = fn(jnp.where(present, v, fill), gid_dev, num_segments=ng)
+            return EvalResult(jnp.where(cnt > 0, out, jnp.nan), out_labels)
+        if e.op == "quantile":
+            q = e.param.value if isinstance(e.param, NumberLit) else 0.5
+            # per group nanquantile via host loop over groups (group counts
+            # are small); device computes each
+            outs = []
+            for g in range(ng):
+                rows = np.nonzero(gids == g)[0]
+                outs.append(jnp.nanquantile(v[jnp.asarray(rows)], q, axis=0))
+            return EvalResult(jnp.stack(outs).astype(jnp.float32), out_labels)
+        if e.op in ("topk", "bottomk"):
+            k = int(e.param.value) if isinstance(e.param, NumberLit) else 1
+            sign = 1.0 if e.op == "topk" else -1.0
+            work = jnp.where(present, sign * v, -jnp.inf)
+            if ng == 1 and not e.grouping and not e.without:
+                kth = -jnp.sort(-work, axis=0)[jnp.minimum(k - 1, v.shape[0] - 1)]
+                keep = work >= kth[None, :]
+            else:
+                # per-group top-k: rank within group via sort of (gid, -val)
+                keep = jnp.zeros(v.shape, bool)
+                for g in range(ng):
+                    rows = np.nonzero(gids == g)[0]
+                    sub = work[jnp.asarray(rows)]
+                    kk = min(k, len(rows))
+                    kth = -jnp.sort(-sub, axis=0)[kk - 1]
+                    keep = keep.at[jnp.asarray(rows)].set(sub >= kth[None, :])
+            out = jnp.where(keep & present, v, jnp.nan)
+            return EvalResult(out, r.labels)
+        raise Unsupported(f"aggregation {e.op}")
+
+    # ---- binary ops ---------------------------------------------------------
+    def eval_binary(self, e: BinaryExpr) -> EvalResult:
+        l = self.eval(e.lhs)
+        r = self.eval(e.rhs)
+        op = e.op
+
+        def apply(a, b):
+            if op == "+":
+                return a + b
+            if op == "-":
+                return a - b
+            if op == "*":
+                return a * b
+            if op == "/":
+                return a / b
+            if op == "%":
+                return jnp.mod(a, b)
+            if op == "^":
+                return jnp.power(a, b)
+            if op == "atan2":
+                return jnp.arctan2(a, b)
+            cmp = {
+                "==": a == b, "!=": a != b, "<": a < b,
+                "<=": a <= b, ">": a > b, ">=": a >= b,
+            }[op]
+            if e.bool_modifier:
+                return jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.nan,
+                                 cmp.astype(jnp.float32))
+            return jnp.where(cmp, a, jnp.nan)
+
+        if op in ("and", "or", "unless"):
+            return self._set_op(e, l, r)
+
+        if l.is_scalar and r.is_scalar:
+            return EvalResult(apply(l.values, r.values), [{}], is_scalar=True)
+        if l.is_scalar:
+            return EvalResult(apply(l.values[0][None, :], r.values), r.labels)
+        if r.is_scalar:
+            return EvalResult(apply(l.values, r.values[0][None, :]), l.labels)
+
+        li, ri, labels = self._match_series(e, l, r)
+        out = apply(l.values[jnp.asarray(li)], r.values[jnp.asarray(ri)])
+        return EvalResult(out, labels)
+
+    def _match_key(self, e: BinaryExpr, lab: dict) -> tuple:
+        if e.on is not None:
+            keys = sorted(e.on)
+        else:
+            drop = set(e.ignoring or [])
+            drop.add("__name__")
+            keys = sorted(k for k in lab if k not in drop)
+        return tuple((k, str(lab.get(k, ""))) for k in keys)
+
+    def _match_series(self, e: BinaryExpr, l: EvalResult, r: EvalResult):
+        rmap: dict[tuple, int] = {}
+        for j, lab in enumerate(r.labels):
+            k = self._match_key(e, lab)
+            if k in rmap:
+                raise PlanError(f"many-to-many vector match on {k}")
+            rmap[k] = j
+        li, ri, labels = [], [], []
+        for i, lab in enumerate(l.labels):
+            k = self._match_key(e, lab)
+            j = rmap.get(k)
+            if j is None:
+                continue
+            li.append(i)
+            ri.append(j)
+            if e.on is not None:
+                labels.append(dict(k))
+            else:
+                labels.append({kk: vv for kk, vv in lab.items()
+                               if kk not in (e.ignoring or [])})
+        if not li:
+            return [0], [0], []  # empty result
+        return li, ri, labels
+
+    def _set_op(self, e: BinaryExpr, l: EvalResult, r: EvalResult) -> EvalResult:
+        rkeys = {self._match_key(e, lab) for lab in r.labels}
+        if e.op == "and":
+            keep = [i for i, lab in enumerate(l.labels)
+                    if self._match_key(e, lab) in rkeys]
+            if not keep:
+                return EvalResult(jnp.zeros((0, self.num_steps), jnp.float32), [])
+            idx = jnp.asarray(keep)
+            rrows = {self._match_key(e, lab): j for j, lab in enumerate(r.labels)}
+            rsel = jnp.asarray([rrows[self._match_key(e, l.labels[i])] for i in keep])
+            vals = jnp.where(~jnp.isnan(r.values[rsel]), l.values[idx], jnp.nan)
+            return EvalResult(vals, [l.labels[i] for i in keep])
+        if e.op == "unless":
+            rrows = {self._match_key(e, lab): j for j, lab in enumerate(r.labels)}
+            vals_list = []
+            labels = []
+            for i, lab in enumerate(l.labels):
+                j = rrows.get(self._match_key(e, lab))
+                if j is None:
+                    vals_list.append(l.values[i])
+                else:
+                    vals_list.append(
+                        jnp.where(jnp.isnan(r.values[j]), l.values[i], jnp.nan)
+                    )
+                labels.append(lab)
+            if not labels:
+                return EvalResult(jnp.zeros((0, self.num_steps), jnp.float32), [])
+            return EvalResult(jnp.stack(vals_list), labels)
+        # or: left rows plus right rows whose key is absent on the left
+        lkeys = {self._match_key(e, lab) for lab in l.labels}
+        extra = [j for j, lab in enumerate(r.labels)
+                 if self._match_key(e, lab) not in lkeys]
+        vals = l.values
+        labels = list(l.labels)
+        if extra:
+            vals = jnp.concatenate([vals, r.values[jnp.asarray(extra)]], axis=0)
+            labels += [r.labels[j] for j in extra]
+        return EvalResult(vals, labels)
+
+    # ---- histogram_quantile -------------------------------------------------
+    def _histogram_quantile(self, e: FunctionCall) -> EvalResult:
+        q = e.args[0].value if isinstance(e.args[0], NumberLit) else 0.5
+        r = self.eval(e.args[1])
+        groups: dict[tuple, list[tuple[float, int]]] = {}
+        glabels: dict[tuple, dict] = {}
+        for i, lab in enumerate(r.labels):
+            le_raw = str(lab.get("le", ""))
+            try:
+                le = float(le_raw.replace("+Inf", "inf"))
+            except ValueError:
+                continue
+            key = tuple(sorted((k, str(v)) for k, v in lab.items() if k != "le"))
+            groups.setdefault(key, []).append((le, i))
+            glabels[key] = {k: v for k, v in lab.items() if k != "le"}
+        out_vals = []
+        out_labels = []
+        for key, buckets in groups.items():
+            buckets.sort()
+            les = np.array([b[0] for b in buckets], dtype=np.float64)
+            rows = jnp.asarray([b[1] for b in buckets])
+            counts = r.values[rows]  # [B, T] cumulative
+            if not math.isinf(les[-1]):
+                continue  # spec: need +Inf bucket
+            total = counts[-1]
+            rank = q * total
+            # first bucket with count >= rank
+            ge = counts >= rank[None, :]
+            idx = jnp.argmax(ge, axis=0)
+            idx = jnp.clip(idx, 0, len(buckets) - 1)
+            lo_le = jnp.asarray(
+                np.concatenate([[0.0], les[:-1]]), dtype=jnp.float32
+            )[idx]
+            hi_le = jnp.asarray(les, dtype=jnp.float32)[idx]
+            lo_cnt = jnp.concatenate(
+                [jnp.zeros((1, counts.shape[1]), counts.dtype), counts[:-1]], axis=0
+            )[idx, jnp.arange(counts.shape[1])]
+            hi_cnt = counts[idx, jnp.arange(counts.shape[1])]
+            frac = jnp.where(hi_cnt > lo_cnt, (rank - lo_cnt) / (hi_cnt - lo_cnt), 1.0)
+            val = lo_le + (hi_le - lo_le) * jnp.clip(frac, 0, 1)
+            # highest bucket: return lower bound of +Inf bucket
+            val = jnp.where(jnp.isinf(hi_le), lo_le, val)
+            val = jnp.where(total > 0, val, jnp.nan)
+            out_vals.append(val.astype(jnp.float32))
+            out_labels.append(glabels[key])
+        if not out_vals:
+            return EvalResult(jnp.zeros((0, self.num_steps), jnp.float32), [])
+        return EvalResult(jnp.stack(out_vals), out_labels)
+
+
+def _extrapolated(out: dict, range_s: float, range_end_ms: np.ndarray,
+                  counter: bool, is_rate: bool) -> jnp.ndarray:
+    """Prometheus extrapolatedRate (reference extrapolate_rate.rs:56)."""
+    rng_ms = range_s * 1000.0
+    ft = out["first_ts"].astype(jnp.float64)
+    lt = out["last_ts"].astype(jnp.float64)
+    cnt = out["count"]
+    delta = out["delta_adj"] if counter else out["delta_raw"]
+    range_end = jnp.asarray(range_end_ms)[None, :]  # [1, T]
+    range_start = range_end - rng_ms
+
+    sampled = (lt - ft) / 1000.0
+    avg_dur = sampled / jnp.maximum(cnt - 1, 1)
+    dur_to_start = (ft - range_start) / 1000.0
+    dur_to_end = (range_end - lt) / 1000.0
+    threshold = avg_dur * 1.1
+    dur_to_start = jnp.where(dur_to_start >= threshold, avg_dur / 2, dur_to_start)
+    dur_to_end = jnp.where(dur_to_end >= threshold, avg_dur / 2, dur_to_end)
+    if counter:
+        fv = out["first_val"].astype(jnp.float64)
+        d64 = delta.astype(jnp.float64)
+        dur_to_zero = jnp.where(d64 > 0, sampled * (fv / jnp.maximum(d64, 1e-30)),
+                                jnp.inf)
+        dur_to_start = jnp.minimum(dur_to_start, dur_to_zero)
+    factor = (sampled + dur_to_start + dur_to_end) / jnp.maximum(sampled, 1e-30)
+    result = delta.astype(jnp.float64) * factor
+    if is_rate:
+        result = result / range_s
+    return jnp.where(cnt >= 2, result.astype(jnp.float32), jnp.nan)
+
+
+# ---------------------------------------------------------------------------
+# TQL entry (called from standalone)
+# ---------------------------------------------------------------------------
+
+def execute_tql(db, stmt):
+    from greptimedb_tpu.query.engine import QueryResult
+
+    expr = parse_promql(stmt.query)
+    ev = PromEvaluator(
+        db, stmt.start, stmt.end, stmt.step,
+        stmt.lookback or DEFAULT_LOOKBACK_S,
+    )
+    if stmt.command in ("EXPLAIN",):
+        return QueryResult(["plan"], [[f"PromQL: {expr}"]])
+    res = ev.eval(expr)
+    vals = np.asarray(res.values)
+    steps = ev.steps_ms()
+    label_keys = sorted({k for lab in res.labels for k in lab})
+    names = label_keys + ["ts", "val"]
+    rows = []
+    for s, lab in enumerate(res.labels):
+        col = vals[s]
+        for t in range(len(steps)):
+            v = float(col[t])
+            if np.isnan(v):
+                continue
+            rows.append([str(lab.get(k, "")) for k in label_keys]
+                        + [int(steps[t]), v])
+    return QueryResult(names, rows)

@@ -36,7 +36,7 @@ class Token:
 
 _TWO_CHAR_OPS = ("<=", ">=", "!=", "<>", "||", "!~", "=~")
 _ONE_CHAR_OPS = "+-*/%<>=~"
-_PUNCT = "(),.;[]{}:"
+_PUNCT = "(),.;[]{}:@#"
 
 
 def tokenize(sql: str) -> list[Token]:

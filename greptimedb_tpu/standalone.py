@@ -64,7 +64,9 @@ class GreptimeDB(TableProvider):
         self.cache = RegionCacheManager(cache_capacity_bytes)
         self.engine = QueryEngine(self)
         self.current_db = DEFAULT_DB
-        self.flows: dict[str, object] = {}
+        from greptimedb_tpu.flow.engine import FlowEngine
+
+        self.flow_engine = FlowEngine(self)
 
     def close(self) -> None:
         self.regions.close()
@@ -262,6 +264,11 @@ class GreptimeDB(TableProvider):
             ctx = TableContext(schema, region.encoders)
             data[ts_name] = [ctx.ts_literal(v) for v in data[ts_name]]
         region.write(data)
+        if self.flow_engine.flows:
+            # batching flows: mark dirty windows and re-evaluate synchronously
+            # (the reference defers via eval_schedule; standalone runs inline)
+            self.flow_engine.on_write(stmt.table, data[ts_name])
+            self.flow_engine.run_all()
         return QueryResult([], [], affected_rows=len(stmt.rows))
 
     def _delete(self, stmt: Delete) -> QueryResult:
