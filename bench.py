@@ -98,12 +98,35 @@ def build_db():
     return db
 
 
+def probe_tpu(timeout_s: int = 180) -> bool:
+    """Check the TPU backend responds (the axon relay can wedge; a hung
+    bench is worse than a CPU bench). Probe in a subprocess with timeout."""
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((128,128));"
+        "(x @ x).block_until_ready();"
+        "print('ok')"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=timeout_s
+        )
+        return b"ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     import jax
 
     if os.environ.get("JAX_PLATFORMS"):
         # the runtime image preimports jax, so the env var alone is too late
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    elif not probe_tpu():
+        log("WARNING: TPU backend unresponsive; falling back to CPU for this run")
+        jax.config.update("jax_platforms", "cpu")
 
     db = build_db()
     log(f"jax devices: {jax.devices()}")

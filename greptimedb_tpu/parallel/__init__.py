@@ -1,0 +1,29 @@
+"""Distribution layer: partition rules, device mesh, collective exchange.
+
+The reference distributes via table partitioning across regions + plan
+push-down + Arrow Flight merge (SURVEY.md §2.6). On TPU the same three
+ideas become (SURVEY.md §5.8 "TPU-native equivalent"):
+
+- partition rules  → sharding the series axis across a jax Mesh;
+- plan push-down   → the commutativity split (reference
+  dist_plan/commutativity.rs): each shard computes partial aggregates
+  locally inside shard_map;
+- MergeScan/Flight → XLA collectives (psum/pmin/pmax) over ICI.
+"""
+
+from greptimedb_tpu.parallel.partition import PartitionRule, split_rows
+from greptimedb_tpu.parallel.dist import (
+    ShardedTable,
+    create_mesh,
+    shard_table,
+    DistAggExecutor,
+)
+
+__all__ = [
+    "PartitionRule",
+    "split_rows",
+    "ShardedTable",
+    "create_mesh",
+    "shard_table",
+    "DistAggExecutor",
+]

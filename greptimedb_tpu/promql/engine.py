@@ -413,9 +413,8 @@ class PromEvaluator:
             if e.range_s is not None:
                 raise PlanError(f"range vector {e} needs a function")
             out, labels = self._run_window(e, "instant")
-            now = jnp.asarray(self.steps_ms())[None, :]
-            # staleness: sample must be within lookback (already enforced by
-            # window) — value is last sample in (t-lookback, t]
+            # staleness enforced by the window kernel: value is the last
+            # sample within (t - lookback, t]
             vals = out["last"] if labels else jnp.zeros((0, self.num_steps), jnp.float32)
             return EvalResult(vals, labels)
         if isinstance(e, UnaryExpr):
@@ -568,14 +567,15 @@ class PromEvaluator:
             r = self.eval(e.args[0])
             dst, repl, src, regex = (a.value for a in e.args[1:5])
             rx = re.compile(str(regex))
+            # Prometheus $1 / ${1} group refs → python \1 / \g<1>
+            template = re.sub(r"\$\{(\w+)\}", r"\\g<\1>", str(repl))
+            template = re.sub(r"\$(\d+)", r"\\\1", template)
             labels = []
             for lab in r.labels:
                 m = rx.fullmatch(str(lab.get(src, "")))
                 lab = dict(lab)
                 if m is not None:
-                    lab[dst] = m.expand(
-                        str(repl).replace("$", "\\")
-                    ) if "$" in str(repl) else str(repl)
+                    lab[dst] = m.expand(template)
                     if lab[dst] == "":
                         lab.pop(dst, None)
                 labels.append(lab)
@@ -604,6 +604,20 @@ class PromEvaluator:
         return a
 
     # ---- aggregation ------------------------------------------------------
+    def _scalar_param(self, param: PromExpr | None, who: str) -> float:
+        """Aggregation parameter (k, q): literal or constant scalar expr."""
+        if param is None:
+            raise PlanError(f"{who} needs a parameter")
+        if isinstance(param, NumberLit):
+            return float(param.value)
+        r = self.eval(param)
+        if not r.is_scalar:
+            raise Unsupported(f"{who} parameter must be a scalar")
+        vals = np.asarray(r.values[0])
+        if len(vals) > 1 and not np.allclose(vals, vals[0], equal_nan=True):
+            raise Unsupported(f"{who} parameter varying per step")
+        return float(vals[0])
+
     def eval_aggregation(self, e: Aggregation) -> EvalResult:
         r = self.eval(e.expr)
         if r.num_series == 0:
@@ -658,7 +672,7 @@ class PromEvaluator:
             out = fn(jnp.where(present, v, fill), gid_dev, num_segments=ng)
             return EvalResult(jnp.where(cnt > 0, out, jnp.nan), out_labels)
         if e.op == "quantile":
-            q = e.param.value if isinstance(e.param, NumberLit) else 0.5
+            q = self._scalar_param(e.param, "quantile")
             # per group nanquantile via host loop over groups (group counts
             # are small); device computes each
             outs = []
@@ -667,7 +681,9 @@ class PromEvaluator:
                 outs.append(jnp.nanquantile(v[jnp.asarray(rows)], q, axis=0))
             return EvalResult(jnp.stack(outs).astype(jnp.float32), out_labels)
         if e.op in ("topk", "bottomk"):
-            k = int(e.param.value) if isinstance(e.param, NumberLit) else 1
+            k = int(self._scalar_param(e.param, e.op))
+            if k <= 0:
+                return EvalResult(jnp.zeros((0, self.num_steps), jnp.float32), [])
             sign = 1.0 if e.op == "topk" else -1.0
             work = jnp.where(present, sign * v, -jnp.inf)
             if ng == 1 and not e.grouping and not e.without:
@@ -692,6 +708,10 @@ class PromEvaluator:
         r = self.eval(e.rhs)
         op = e.op
 
+        # for filter comparisons the surviving sample value comes from the
+        # vector side (Prometheus keeps LHS for vector-vector)
+        keep_rhs_value = l.is_scalar and not r.is_scalar
+
         def apply(a, b):
             if op == "+":
                 return a + b
@@ -714,7 +734,7 @@ class PromEvaluator:
             if e.bool_modifier:
                 return jnp.where(jnp.isnan(a) | jnp.isnan(b), jnp.nan,
                                  cmp.astype(jnp.float32))
-            return jnp.where(cmp, a, jnp.nan)
+            return jnp.where(cmp, b if keep_rhs_value else a, jnp.nan)
 
         if op in ("and", "or", "unless"):
             return self._set_op(e, l, r)

@@ -400,7 +400,7 @@ class PromParser:
         name = self.ident()
         low = name.lower()
         if low in ("inf", "nan"):
-            return NumberLit(float(low.replace("inf", "inf")))
+            return NumberLit(float(low))
         self._ws()
         if low in AGG_OPS and self.peek_char() in "(bw":
             # aggregation: op [by/without (...)] (expr) | op(...) [by/without]

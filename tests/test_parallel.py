@@ -1,0 +1,134 @@
+"""Distribution tests on the 8-device virtual CPU mesh.
+
+Mirrors the reference's in-process mock-cluster strategy (SURVEY.md §4):
+multi-shard behavior without real hardware.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from greptimedb_tpu.errors import InvalidArguments
+from greptimedb_tpu.ops.segment import combine_keys, segment_reduce
+from greptimedb_tpu.parallel import (
+    DistAggExecutor, PartitionRule, create_mesh, shard_table, split_rows,
+)
+from greptimedb_tpu.storage.memtable import TSID
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return create_mesh(8)
+
+
+def make_data(rng, n=10_000, n_series=64, n_hours=6):
+    tsid = rng.integers(0, n_series, n).astype(np.int64)
+    ts = rng.integers(0, n_hours * 3600_000, n).astype(np.int64)
+    val = rng.random(n).astype(np.float32) * 100
+    order = np.lexsort((ts, tsid))
+    return {
+        TSID: tsid[order],
+        "ts": ts[order],
+        "val": val[order],
+        "host": (tsid[order] % 16).astype(np.int32),
+    }
+
+
+class TestPartitionRule:
+    def test_expr_rule(self):
+        rule = PartitionRule.from_sql(
+            ["host"], ["host < 'm'", "host >= 'm'"]
+        )
+        cols = {"host": np.array(["alpha", "zulu", "beta"], dtype=object)}
+        parts = split_rows(rule, cols, 3)
+        assert sorted(parts) == [0, 1]
+        np.testing.assert_array_equal(parts[0], [0, 2])
+        np.testing.assert_array_equal(parts[1], [1])
+
+    def test_uncovered_rows_raise(self):
+        rule = PartitionRule.from_sql(["v"], ["v < 10"])
+        with pytest.raises(InvalidArguments):
+            split_rows(rule, {"v": np.array([5, 20], dtype=object)}, 2)
+
+    def test_hash_rule_balance(self):
+        rule = PartitionRule.hash_rule(4)
+        cols = {"host": np.array([f"h{i}" for i in range(1000)], dtype=object)}
+        rule.columns = ["host"]
+        parts = split_rows(rule, cols, 1000)
+        sizes = [len(v) for v in parts.values()]
+        assert len(parts) == 4 and min(sizes) > 100
+
+
+class TestShardTable:
+    def test_sharding_layout(self, mesh, rng):
+        data = make_data(rng, n=5000, n_series=64)
+        t = shard_table(data, mesh)
+        assert t.num_shards == 8
+        # every row lands on the shard of its series
+        tsid = np.asarray(t.columns[TSID]).reshape(8, -1)
+        mask = np.asarray(t.row_mask).reshape(8, -1)
+        for s in range(8):
+            sel = tsid[s][mask[s]]
+            assert (sel % 8 == s).all()
+        assert mask.sum() == 5000
+
+    def test_explicit_series_map(self, mesh, rng):
+        data = make_data(rng, n=1000, n_series=16)
+        shard_of = np.arange(16, dtype=np.int64) // 2  # 2 series per shard
+        t = shard_table(data, mesh, shard_of_series=shard_of)
+        tsid = np.asarray(t.columns[TSID]).reshape(8, -1)
+        mask = np.asarray(t.row_mask).reshape(8, -1)
+        for s in range(8):
+            sel = np.unique(tsid[s][mask[s]])
+            assert set(sel) <= {2 * s, 2 * s + 1}
+
+
+class TestDistAgg:
+    def test_matches_single_device(self, mesh, rng):
+        data = make_data(rng, n=20_000, n_series=64, n_hours=4)
+        t = shard_table(data, mesh)
+        ex = DistAggExecutor(mesh)
+        key_specs = [
+            ("tag", "host", 16),
+            ("time", "ts", 3600_000, 0, 4),
+        ]
+        agg_specs = [
+            ("sum_v", "sum", "val"),
+            ("cnt", "count", "val"),
+            ("min_v", "min", "val"),
+            ("max_v", "max", "val"),
+            ("avg_v", "mean", "val"),
+        ]
+        got = ex.aggregate(t, key_specs, agg_specs)
+
+        # single-device reference
+        import jax.numpy as jnp
+
+        host = jnp.asarray(data["host"].astype(np.int64))
+        hour = jnp.asarray(data["ts"] // 3600_000)
+        gid, total = combine_keys([host, hour], [16, 4])
+        mask = jnp.ones(len(data["ts"]), bool)
+        vals = jnp.asarray(data["val"])
+        for name, op in [("sum_v", "sum"), ("cnt", "count"), ("min_v", "min"),
+                         ("max_v", "max"), ("avg_v", "mean")]:
+            want = np.asarray(segment_reduce(vals, gid.astype(jnp.int32),
+                                             total, op, mask))
+            np.testing.assert_allclose(
+                got[name], want, rtol=2e-5, equal_nan=True,
+                err_msg=name,
+            )
+
+    def test_empty_groups_nan(self, mesh, rng):
+        data = make_data(rng, n=100, n_series=8, n_hours=1)
+        t = shard_table(data, mesh)
+        ex = DistAggExecutor(mesh)
+        got = ex.aggregate(
+            t,
+            [("tag", "host", 16), ("time", "ts", 3600_000, 0, 4)],
+            [("mx", "max", "val")],
+        )
+        grid = np.asarray(got["mx"]).reshape(16, 4)
+        # hours 1..3 have no data -> NaN
+        assert np.isnan(grid[:, 1:]).all()
+        assert np.isfinite(grid[:8, 0]).all()

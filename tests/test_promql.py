@@ -354,3 +354,37 @@ class TestReviewRegressions:
         res = db.sql("TQL EVAL (0.0, 0.3, '0.1') count_over_time(requests[5m])")
         times = sorted({r[1] for r in res.rows})
         assert times == [0, 100, 200, 300]
+
+
+class TestReviewRound2:
+    def test_scalar_lhs_filter_keeps_vector_value(self, db):
+        make_counter(db, pods=("p1", "p2", "p3"), rates=(5.0, 10.0, 15.0))
+        res = db.sql("TQL EVAL (300, 300, '60') 0.7 < rate(requests[5m])")
+        got = {r[0]: r[-1] for r in res.rows}
+        assert got == {
+            "p2": pytest.approx(1.0, rel=1e-5),
+            "p3": pytest.approx(1.5, rel=1e-5),
+        }
+
+    def test_topk_zero_empty(self, db):
+        make_counter(db, pods=("p1", "p2"), rates=(5.0, 10.0))
+        res = db.sql("TQL EVAL (300, 300, '60') topk(0, rate(requests[5m]))")
+        assert res.rows == []
+
+    def test_topk_expr_param(self, db):
+        make_counter(db, pods=("p1", "p2"), rates=(5.0, 10.0))
+        res = db.sql("TQL EVAL (300, 300, '60') topk(1 + 0, rate(requests[5m]))")
+        assert [r[0] for r in res.rows] == ["p2"]
+
+    def test_label_replace_group_ref(self, db):
+        make_counter(db, pods=("p1",))
+        res = db.sql(
+            'TQL EVAL (300, 300, \'60\') label_replace(requests, "env", "${1}x", "pod", "(p.)")'
+        )
+        env_idx = res.column_names.index("env")
+        assert res.rows[0][env_idx] == "p1x"
+
+    def test_quantile_expr_param(self, db):
+        make_counter(db, pods=("p1", "p2", "p3"), rates=(5.0, 10.0, 15.0))
+        res = db.sql("TQL EVAL (300, 300, '60') quantile(2/4, rate(requests[5m]))")
+        assert res.rows[0][-1] == pytest.approx(1.0, rel=1e-5)
