@@ -45,18 +45,26 @@ class PartitionRule:
         return PartitionRule(columns, exprs, max(len(exprs), 1))
 
     @staticmethod
-    def hash_rule(num_partitions: int) -> "PartitionRule":
-        return PartitionRule([], [], num_partitions)
+    def hash_rule(num_partitions: int, columns: list[str] | None = None) -> "PartitionRule":
+        return PartitionRule(columns or [], [], num_partitions)
 
     def evaluate(self, row_values: dict[str, np.ndarray], n: int) -> np.ndarray:
         """Vectorized partition index per row; -1 when nothing matches."""
         if not self.exprs:
-            # hash of the first tag column (or zeros if none)
-            if not self.columns and not row_values:
-                return np.zeros(n, dtype=np.int64)
+            # stable hash of the rule's key columns (crc32: process- and
+            # restart-independent, unlike the salted builtin hash)
+            import zlib
+
             key = None
-            for name, arr in sorted(row_values.items()):
-                h = np.array([hash(v) for v in arr], dtype=np.int64)
+            names = self.columns or sorted(row_values)
+            for name in names:
+                if name not in row_values:
+                    continue
+                arr = row_values[name]
+                h = np.array(
+                    [zlib.crc32(str(v).encode("utf-8")) for v in arr],
+                    dtype=np.int64,
+                )
                 key = h if key is None else key * 1000003 + h
             if key is None:
                 return np.zeros(n, dtype=np.int64)
@@ -105,7 +113,11 @@ def split_rows(
     rule: PartitionRule, columns: dict[str, np.ndarray], n: int
 ) -> dict[int, np.ndarray]:
     """Row indices per partition (reference PartitionRuleManager::split_rows)."""
-    env = {c: np.asarray(columns[c], dtype=object) for c in rule.columns if c in columns}
+    env = {
+        c: np.asarray(columns[c], dtype=object)
+        for c in (rule.columns or sorted(columns))
+        if c in columns
+    }
     if rule.exprs:
         idx = rule.evaluate(env, n)
         bad = idx < 0

@@ -616,7 +616,10 @@ class PromEvaluator:
         vals = np.asarray(r.values[0])
         if len(vals) > 1 and not np.allclose(vals, vals[0], equal_nan=True):
             raise Unsupported(f"{who} parameter varying per step")
-        return float(vals[0])
+        v = float(vals[0])
+        if np.isnan(v):
+            raise PlanError(f"{who} parameter evaluates to NaN")
+        return v
 
     def eval_aggregation(self, e: Aggregation) -> EvalResult:
         r = self.eval(e.expr)

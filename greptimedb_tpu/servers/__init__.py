@@ -1,0 +1,11 @@
+"""Protocol servers: the wire surface (reference src/servers, SURVEY.md §2.2).
+
+Round-1 coverage: HTTP SQL/PromQL API, the Prometheus HTTP API emulation,
+Prometheus remote write (snappy+protobuf), InfluxDB line protocol, admin
+endpoints (/health, /metrics, /config). gRPC/Flight, MySQL and PostgreSQL
+wire protocols are later rounds.
+"""
+
+from greptimedb_tpu.servers.http import HttpServer
+
+__all__ = ["HttpServer"]

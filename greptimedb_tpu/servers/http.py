@@ -1,0 +1,507 @@
+"""HTTP server: SQL API, Prometheus API emulation, ingest protocols, admin.
+
+Route surface mirrors the reference's make_app (src/servers/src/http.rs:775):
+
+    /v1/sql                         SQL (greptime JSON envelope)
+    /v1/promql                      native PromQL range query
+    /v1/prometheus/api/v1/query          instant query
+    /v1/prometheus/api/v1/query_range    range query
+    /v1/prometheus/api/v1/labels         label names
+    /v1/prometheus/api/v1/label/{n}/values
+    /v1/prometheus/api/v1/series         series metadata
+    /v1/prometheus/write            remote write (snappy protobuf)
+    /v1/influxdb/api/v2/write       line protocol (also /v1/influxdb/write)
+    /health /metrics /config /status
+
+Runs the (synchronous) database in a thread-pool executor so the event
+loop stays responsive; a dedicated thread hosts the loop so tests and the
+standalone binary can start/stop it synchronously.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+
+import numpy as np
+from aiohttp import web
+
+from greptimedb_tpu.errors import GreptimeError, StatusCode
+from greptimedb_tpu.query.engine import QueryResult
+from greptimedb_tpu.utils import telemetry
+from greptimedb_tpu.utils.snappy import decompress as snappy_decompress
+
+M_REQUESTS = telemetry.REGISTRY.counter(
+    "greptime_http_requests_total", "HTTP requests", ("path", "code")
+)
+M_LATENCY = telemetry.REGISTRY.histogram(
+    "greptime_http_request_duration_seconds", "HTTP latency", ("path",)
+)
+M_INGEST_ROWS = telemetry.REGISTRY.counter(
+    "greptime_ingest_rows_total", "Rows ingested", ("protocol",)
+)
+
+
+def _result_to_json(res: QueryResult, t0: float) -> dict:
+    if res.column_names:
+        records = {
+            "schema": {
+                "column_schemas": [
+                    {"name": n, "data_type": "unknown"} for n in res.column_names
+                ]
+            },
+            "rows": res.rows,
+            "total_rows": len(res.rows),
+        }
+        output = [{"records": records}]
+    else:
+        output = [{"affectedrows": res.affected_rows}]
+    return {
+        "code": 0,
+        "output": output,
+        "execution_time_ms": int((time.perf_counter() - t0) * 1000),
+    }
+
+
+def _error_json(e: Exception) -> tuple[dict, int]:
+    if isinstance(e, GreptimeError):
+        code = e.status_code
+        http = {
+            StatusCode.TABLE_NOT_FOUND: 404,
+            StatusCode.DATABASE_NOT_FOUND: 404,
+            StatusCode.FLOW_NOT_FOUND: 404,
+            StatusCode.INVALID_SYNTAX: 400,
+            StatusCode.INVALID_ARGUMENTS: 400,
+            StatusCode.PLAN_QUERY: 400,
+            StatusCode.UNSUPPORTED: 400,
+            StatusCode.TABLE_ALREADY_EXISTS: 409,
+            StatusCode.DATABASE_ALREADY_EXISTS: 409,
+        }.get(code, 500)
+        return {"code": int(code), "error": e.msg, "execution_time_ms": 0}, http
+    return {"code": int(StatusCode.INTERNAL), "error": str(e)}, 500
+
+
+class HttpServer:
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 4000):
+        self.db = db
+        self.host = host
+        self.port = port
+        self._thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = threading.Event()
+        self._runner = None
+        # the database is single-writer (region sequence assignment and
+        # memtable mutation are unsynchronized, like mito2's per-region
+        # worker loop) — serialize all DB work on one executor thread
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._db_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="greptime-db"
+        )
+
+    # ------------------------------------------------------------------
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        r = app.router
+        r.add_route("*", "/v1/sql", self.h_sql)
+        r.add_route("*", "/v1/promql", self.h_promql)
+        r.add_route("*", "/v1/prometheus/api/v1/query", self.h_prom_query)
+        r.add_route("*", "/v1/prometheus/api/v1/query_range", self.h_prom_range)
+        r.add_route("*", "/v1/prometheus/api/v1/labels", self.h_prom_labels)
+        r.add_get("/v1/prometheus/api/v1/label/{name}/values", self.h_prom_label_values)
+        r.add_route("*", "/v1/prometheus/api/v1/series", self.h_prom_series)
+        r.add_post("/v1/prometheus/write", self.h_remote_write)
+        r.add_post("/v1/influxdb/api/v2/write", self.h_influx_write)
+        r.add_post("/v1/influxdb/write", self.h_influx_write)
+        r.add_get("/health", self.h_health)
+        r.add_get("/ready", self.h_health)
+        r.add_get("/metrics", self.h_metrics)
+        r.add_get("/config", self.h_config)
+        r.add_get("/status", self.h_status)
+        return app
+
+    async def _call(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._db_executor, fn, *args
+        )
+
+    async def _param(self, request: web.Request, name: str, default=None):
+        if name in request.query:
+            return request.query[name]
+        if request.method == "POST" and request.content_type in (
+            "application/x-www-form-urlencoded", "multipart/form-data",
+        ):
+            form = await request.post()
+            if name in form:
+                return form[name]
+        return default
+
+    # ---- handlers ------------------------------------------------------
+    async def h_sql(self, request: web.Request) -> web.Response:
+        t0 = time.perf_counter()
+        sql = await self._param(request, "sql")
+        with M_LATENCY.labels("/v1/sql").time():
+            if not sql:
+                M_REQUESTS.labels("/v1/sql", "400").inc()
+                return web.json_response(
+                    {"code": int(StatusCode.INVALID_ARGUMENTS),
+                     "error": "missing sql parameter"}, status=400)
+            try:
+                res = await self._call(self.db.sql, sql)
+                M_REQUESTS.labels("/v1/sql", "200").inc()
+                return web.json_response(_result_to_json(res, t0))
+            except Exception as e:  # noqa: BLE001
+                body, status = _error_json(e)
+                M_REQUESTS.labels("/v1/sql", str(status)).inc()
+                return web.json_response(body, status=status)
+
+    async def _eval_promql(self, query: str, start: float, end: float,
+                           step: float, lookback: float | None = None):
+        from greptimedb_tpu.promql.engine import DEFAULT_LOOKBACK_S, PromEvaluator
+        from greptimedb_tpu.promql.parser import parse_promql
+
+        expr = parse_promql(query)
+
+        def run():
+            ev = PromEvaluator(self.db, start, end, step,
+                               lookback or DEFAULT_LOOKBACK_S)
+            res = ev.eval(expr)
+            return res, ev.steps_ms()
+
+        return await self._call(run)
+
+    @staticmethod
+    def _fmt_val(v: float) -> str:
+        if np.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        return repr(float(v))
+
+    async def h_prom_range(self, request: web.Request) -> web.Response:
+        try:
+            query = await self._param(request, "query")
+            start = _parse_prom_time(await self._param(request, "start"))
+            end = _parse_prom_time(await self._param(request, "end"))
+            step = _parse_prom_duration(await self._param(request, "step", "60"))
+            with M_LATENCY.labels("/v1/prometheus/api/v1/query_range").time():
+                res, steps = await self._eval_promql(query, start, end, step)
+            vals = np.asarray(res.values, dtype=np.float64)
+            result = []
+            for s, lab in enumerate(res.labels):
+                pts = [
+                    [steps[t] / 1000.0, self._fmt_val(vals[s, t])]
+                    for t in range(len(steps))
+                    if not np.isnan(vals[s, t])
+                ]
+                if pts:
+                    result.append({"metric": {k: str(v) for k, v in lab.items()},
+                                   "values": pts})
+            M_REQUESTS.labels("/v1/prometheus/api/v1/query_range", "200").inc()
+            return web.json_response(
+                {"status": "success",
+                 "data": {"resultType": "matrix", "result": result}})
+        except Exception as e:  # noqa: BLE001
+            M_REQUESTS.labels("/v1/prometheus/api/v1/query_range", "400").inc()
+            return web.json_response(
+                {"status": "error", "errorType": "bad_data", "error": str(e)},
+                status=400)
+
+    async def h_prom_query(self, request: web.Request) -> web.Response:
+        try:
+            query = await self._param(request, "query")
+            t = _parse_prom_time(await self._param(request, "time", str(time.time())))
+            with M_LATENCY.labels("/v1/prometheus/api/v1/query").time():
+                res, steps = await self._eval_promql(query, t, t, 1)
+            vals = np.asarray(res.values, dtype=np.float64)
+            result = []
+            for s, lab in enumerate(res.labels):
+                v = vals[s, -1]
+                if not np.isnan(v):
+                    result.append({
+                        "metric": {k: str(x) for k, x in lab.items()},
+                        "value": [steps[-1] / 1000.0, self._fmt_val(v)],
+                    })
+            M_REQUESTS.labels("/v1/prometheus/api/v1/query", "200").inc()
+            return web.json_response(
+                {"status": "success",
+                 "data": {"resultType": "vector", "result": result}})
+        except Exception as e:  # noqa: BLE001
+            M_REQUESTS.labels("/v1/prometheus/api/v1/query", "400").inc()
+            return web.json_response(
+                {"status": "error", "errorType": "bad_data", "error": str(e)},
+                status=400)
+
+    async def h_prom_labels(self, request: web.Request) -> web.Response:
+        def run():
+            names = {"__name__"}
+            for t in self.db.catalog.list_tables(self.db.current_db):
+                for c in t.schema.tag_columns:
+                    names.add(c.name)
+            return sorted(names)
+
+        data = await self._call(run)
+        return web.json_response({"status": "success", "data": data})
+
+    async def h_prom_label_values(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+
+        def run():
+            if name == "__name__":
+                return sorted(
+                    t.name for t in self.db.catalog.list_tables(self.db.current_db)
+                )
+            values = set()
+            for t in self.db.catalog.list_tables(self.db.current_db):
+                if any(c.name == name for c in t.schema.tag_columns):
+                    region = self.db._region_of(t.name)
+                    enc = region.encoders.get(name)
+                    if enc:
+                        values.update(str(v) for v in enc.values())
+            return sorted(values)
+
+        data = await self._call(run)
+        return web.json_response({"status": "success", "data": data})
+
+    async def h_prom_series(self, request: web.Request) -> web.Response:
+        matches = request.query.getall("match[]", [])
+        if not matches and request.method == "POST":
+            form = await request.post()
+            matches = form.getall("match[]", [])
+
+        def run():
+            from greptimedb_tpu.promql.engine import SelectorData
+            from greptimedb_tpu.promql.parser import parse_promql, VectorSelector
+
+            out = []
+            for m in matches:
+                e = parse_promql(m)
+                if not isinstance(e, VectorSelector):
+                    continue
+                try:
+                    d = SelectorData(self.db, e.metric)
+                except GreptimeError:
+                    continue
+                _tsids, labels = d.select_series(e.matchers)
+                for lab in labels:
+                    item = {"__name__": e.metric}
+                    item.update({k: str(v) for k, v in lab.items()})
+                    out.append(item)
+            return out
+
+        data = await self._call(run)
+        return web.json_response({"status": "success", "data": data})
+
+    async def h_remote_write(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.servers.protocols import parse_remote_write
+
+        body = await request.read()
+        if request.headers.get("Content-Encoding", "snappy").lower() == "snappy":
+            try:
+                body = snappy_decompress(body)
+            except ValueError as e:
+                return web.json_response({"error": f"snappy: {e}"}, status=400)
+
+        def run():
+            tables = parse_remote_write(body)
+            total = 0
+            for table, cols in tables.items():
+                total += _ingest_columns(self.db, table, cols)
+            return total
+
+        try:
+            n = await self._call(run)
+            M_INGEST_ROWS.labels("prom_remote_write").inc(n)
+            return web.Response(status=204)
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_influx_write(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.servers.protocols import parse_line_protocol
+
+        body = (await request.read()).decode("utf-8")
+        precision = request.query.get("precision", "ns")
+
+        def run():
+            tables = parse_line_protocol(body, precision)
+            total = 0
+            for table, cols in tables.items():
+                total += _ingest_columns(self.db, table, cols)
+            return total
+
+        try:
+            n = await self._call(run)
+            M_INGEST_ROWS.labels("influxdb").inc(n)
+            return web.Response(status=204)
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_health(self, request: web.Request) -> web.Response:
+        return web.json_response({})
+
+    async def h_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=telemetry.REGISTRY.render(),
+                            content_type="text/plain")
+
+    async def h_config(self, request: web.Request) -> web.Response:
+        cfg = {
+            "data_home": self.db.data_home,
+            "http": {"addr": f"{self.host}:{self.port}"},
+            "version": "greptimedb-tpu-0.1.0",
+        }
+        return web.Response(text=json.dumps(cfg, indent=2),
+                            content_type="text/plain")
+
+    async def h_status(self, request: web.Request) -> web.Response:
+        import jax
+
+        return web.json_response({
+            "version": "greptimedb-tpu-0.1.0",
+            "devices": [str(d) for d in jax.devices()],
+            "tables": len(self.db.catalog.list_tables(self.db.current_db)),
+        })
+
+    async def h_promql(self, request: web.Request) -> web.Response:
+        """Greptime-native PromQL endpoint: query/start/end/step params,
+        greptime JSON envelope output (reference /v1/promql)."""
+        t0 = time.perf_counter()
+        try:
+            query = await self._param(request, "query")
+            start = _parse_prom_time(await self._param(request, "start", "0"))
+            end = _parse_prom_time(await self._param(request, "end", "0"))
+            step = _parse_prom_duration(await self._param(request, "step", "60"))
+            res, steps = await self._eval_promql(query, start, end, step)
+            vals = np.asarray(res.values, dtype=np.float64)
+            label_keys = sorted({k for lab in res.labels for k in lab})
+            rows = []
+            for s, lab in enumerate(res.labels):
+                for t in range(len(steps)):
+                    v = vals[s, t]
+                    if not np.isnan(v):
+                        rows.append(
+                            [str(lab.get(k, "")) for k in label_keys]
+                            + [int(steps[t]), float(v)]
+                        )
+            qr = QueryResult(label_keys + ["ts", "val"], rows)
+            return web.json_response(_result_to_json(qr, t0))
+        except Exception as e:  # noqa: BLE001
+            body, status = _error_json(e)
+            return web.json_response(body, status=status)
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        def run_loop():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            app = self.build_app()
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+            self._runner = runner
+            if self.port == 0:
+                self.port = runner.addresses[0][1]
+            self._started.set()
+            loop.run_forever()
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
+
+        self._thread = threading.Thread(target=run_loop, daemon=True,
+                                        name="greptime-http")
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("http server failed to start")
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def _parse_prom_time(raw) -> float:
+    if raw is None:
+        raise GreptimeError("missing time parameter",
+                            code=StatusCode.INVALID_ARGUMENTS)
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        pass
+    from greptimedb_tpu.query.parser import parse_timestamp_str
+
+    return parse_timestamp_str(str(raw).replace("T", " ").rstrip("Z")) / 1000.0
+
+
+def _parse_prom_duration(raw) -> float:
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        from greptimedb_tpu.query.parser import parse_interval_str
+
+        return parse_interval_str(str(raw)) / 1000.0
+
+
+def _ingest_columns(db, table: str, cols: dict) -> int:
+    """Auto-creating ingest (reference Inserter auto table creation,
+    src/operator/src/insert.rs:178-304): create the table from the first
+    batch's shape, add columns on demand, then write."""
+    from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+    from greptimedb_tpu.datatypes.types import ConcreteDataType, SemanticType
+    from greptimedb_tpu.query.ast import AlterTable, ColumnDef
+
+    tag_names = cols.pop("__tags__", [])
+    field_names = cols.pop("__fields__", [])
+    n = len(cols["ts"])
+
+    def field_type(values) -> ConcreteDataType:
+        for v in values:
+            if isinstance(v, bool):
+                return ConcreteDataType.BOOL
+            if isinstance(v, str):
+                return ConcreteDataType.STRING
+            if isinstance(v, float):
+                return ConcreteDataType.FLOAT64
+            if isinstance(v, int):
+                return ConcreteDataType.INT64
+        return ConcreteDataType.FLOAT64
+
+    dbname, name = db._split_name(table)
+    if not db.catalog.table_exists(dbname, name):
+        defs = [ColumnSchema(t, ConcreteDataType.STRING, SemanticType.TAG)
+                for t in tag_names]
+        defs.append(ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND,
+                                 SemanticType.TIMESTAMP, nullable=False))
+        defs += [ColumnSchema(f, field_type(cols[f]), SemanticType.FIELD)
+                 for f in field_names]
+        info = db.catalog.create_table(dbname, name, Schema(tuple(defs)),
+                                       if_not_exists=True)
+        if info is not None:
+            db.regions.create_region(info.region_ids[0], info.schema)
+    else:
+        info = db.catalog.get_table(dbname, name)
+        missing_tags = [t for t in tag_names if not info.schema.has_column(t)]
+        if missing_tags:
+            # silently dropping tags would lose series identity; adding tag
+            # columns online (reference supports it) lands in a later round
+            from greptimedb_tpu.errors import InvalidArguments
+
+            raise InvalidArguments(
+                f"table {name} lacks tag columns {missing_tags}; "
+                "online tag addition is not yet supported"
+            )
+        for f in field_names:
+            if not info.schema.has_column(f):
+                db.execute_statement(AlterTable(
+                    f"{dbname}.{name}", "add_column",
+                    column=ColumnDef(f, field_type(cols[f]).value),
+                ))
+                info = db.catalog.get_table(dbname, name)
+    region = db._region_of(f"{dbname}.{name}")
+    region.write(cols)
+    if db.flow_engine.flows:
+        db.flow_engine.on_write(name, cols["ts"])
+        db.flow_engine.run_all()
+    return n

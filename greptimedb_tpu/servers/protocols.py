@@ -1,0 +1,275 @@
+"""Wire-format codecs: InfluxDB line protocol, Prometheus remote write.
+
+- Line protocol (reference src/servers/src/influxdb.rs):
+  ``measurement[,tag=v...] field=value[,field2=v2...] [timestamp]``.
+- Remote write (reference src/servers/src/prom_store.rs + prom_row_builder):
+  snappy-compressed protobuf WriteRequest; parsed here with a minimal
+  hand-rolled proto wire reader (no generated classes in the image).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import defaultdict
+
+from greptimedb_tpu.errors import InvalidArguments
+
+
+# ---------------------------------------------------------------------------
+# InfluxDB line protocol
+# ---------------------------------------------------------------------------
+
+def _split_unescaped(s: str, sep: str, quotes: bool = False) -> list[str]:
+    """Split on unescaped sep; with quotes=True, separators inside
+    double-quoted strings are literal (field-section semantics)."""
+    out = []
+    buf = []
+    i = 0
+    in_quote = False
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            buf.append(s[i:i + 2])
+            i += 2
+            continue
+        if quotes and c == '"':
+            in_quote = not in_quote
+            buf.append(c)
+            i += 1
+            continue
+        if c == sep and not in_quote:
+            out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(c)
+        i += 1
+    out.append("".join(buf))
+    return out
+
+
+def _split_sections(line: str) -> list[str]:
+    """Split a line-protocol line into measurement+tags / fields / ts,
+    honoring escapes everywhere and quotes in the field section."""
+    # section 1: no quote special-casing
+    first = _split_unescaped(line, " ")
+    head = first[0]
+    rest = " ".join(first[1:])
+    if not rest:
+        return [head]
+    tail = _split_unescaped(rest, " ", quotes=True)
+    tail = [t for t in tail if t != ""]
+    if len(tail) == 1:
+        return [head, tail[0]]
+    return [head, tail[0], " ".join(tail[1:])]
+
+
+def _unescape(s: str) -> str:
+    return (
+        s.replace("\\,", ",").replace("\\ ", " ").replace("\\=", "=")
+        .replace('\\"', '"').replace("\\\\", "\\")
+    )
+
+
+def _parse_field_value(raw: str):
+    if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
+        return _unescape(raw[1:-1])
+    if raw.endswith("i"):
+        return int(raw[:-1])
+    if raw.endswith("u"):
+        return int(raw[:-1])
+    low = raw.lower()
+    if low in ("t", "true"):
+        return True
+    if low in ("f", "false"):
+        return False
+    return float(raw)
+
+
+def parse_line_protocol(
+    body: str, precision: str = "ns"
+) -> dict[str, dict[str, list]]:
+    """Parse line protocol into per-measurement columnar dicts.
+
+    Returns {measurement: {tag/field/ts column -> values}}; missing
+    tags/fields across lines are None-filled (schema union per table).
+    Timestamps normalize to epoch ms.
+    """
+    div = {"ns": 1_000_000, "us": 1_000, "ms": 1, "s": 0.001}.get(precision)
+    if div is None:
+        raise InvalidArguments(f"bad precision {precision}")
+    per_table: dict[str, list[tuple[dict, dict, int]]] = defaultdict(list)
+    now_ms = int(time.time() * 1000)
+    for lineno, line in enumerate(body.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        # measurement+tags SPACE fields SPACE [ts]
+        parts = _split_sections(line)
+        if len(parts) < 2 or not parts[1]:
+            raise InvalidArguments(f"line {lineno}: need fields: {line!r}")
+        head = _split_unescaped(parts[0], ",")
+        measurement = _unescape(head[0])
+        if not measurement:
+            raise InvalidArguments(f"line {lineno}: empty measurement")
+        tags = {}
+        for t in head[1:]:
+            kv = _split_unescaped(t, "=")
+            if len(kv) != 2:
+                raise InvalidArguments(f"line {lineno}: bad tag {t!r}")
+            tags[_unescape(kv[0])] = _unescape(kv[1])
+        fields = {}
+        for f in _split_unescaped(parts[1], ",", quotes=True):
+            kv = _split_unescaped(f, "=", quotes=True)
+            if len(kv) != 2:
+                raise InvalidArguments(f"line {lineno}: bad field {f!r}")
+            try:
+                fields[_unescape(kv[0])] = _parse_field_value(kv[1])
+            except ValueError:
+                raise InvalidArguments(
+                    f"line {lineno}: bad field value {kv[1]!r}"
+                ) from None
+        if not fields:
+            raise InvalidArguments(f"line {lineno}: no fields")
+        if len(parts) >= 3:
+            try:
+                ts_raw = int(parts[2])
+            except ValueError:
+                raise InvalidArguments(
+                    f"line {lineno}: bad timestamp {parts[2]!r}"
+                ) from None
+            # integer floor division: float math corrupts epoch-ns > 2^53
+            ts_ms = ts_raw // div if div >= 1 else ts_raw * 1000
+        else:
+            ts_ms = now_ms
+        per_table[measurement].append((tags, fields, ts_ms))
+
+    out: dict[str, dict[str, list]] = {}
+    for table, rows in per_table.items():
+        tag_names = sorted({k for tags, _f, _t in rows for k in tags})
+        field_names = sorted({k for _t, fields, _ in rows for k in fields})
+        cols: dict[str, list] = {k: [] for k in tag_names}
+        cols.update({k: [] for k in field_names})
+        cols["ts"] = []
+        for tags, fields, ts_ms in rows:
+            for k in tag_names:
+                cols[k].append(tags.get(k))
+            for k in field_names:
+                cols[k].append(fields.get(k))
+            cols["ts"].append(ts_ms)
+        out[table] = {"__tags__": tag_names, "__fields__": field_names, **cols}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus remote write: minimal protobuf wire parsing
+# ---------------------------------------------------------------------------
+
+def _pb_fields(data: bytes):
+    """Yield (field_number, wire_type, value_bytes_or_int) from a message."""
+    pos = 0
+    n = len(data)
+    while pos < n:
+        key = 0
+        shift = 0
+        while True:
+            b = data[pos]
+            pos += 1
+            key |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        field, wtype = key >> 3, key & 0x07
+        if wtype == 0:  # varint
+            v = 0
+            shift = 0
+            while True:
+                b = data[pos]
+                pos += 1
+                v |= (b & 0x7F) << shift
+                if not (b & 0x80):
+                    break
+                shift += 7
+            yield field, wtype, v
+        elif wtype == 1:  # 64-bit
+            yield field, wtype, data[pos:pos + 8]
+            pos += 8
+        elif wtype == 2:  # length-delimited
+            ln = 0
+            shift = 0
+            while True:
+                b = data[pos]
+                pos += 1
+                ln |= (b & 0x7F) << shift
+                if not (b & 0x80):
+                    break
+                shift += 7
+            yield field, wtype, data[pos:pos + ln]
+            pos += ln
+        elif wtype == 5:  # 32-bit
+            yield field, wtype, data[pos:pos + 4]
+            pos += 4
+        else:
+            raise InvalidArguments(f"unsupported protobuf wire type {wtype}")
+
+
+def _zigzag_or_signed(v: int) -> int:
+    """Interpret a varint as a signed int64 (two's complement)."""
+    if v >= 1 << 63:
+        v -= 1 << 64
+    return v
+
+
+def parse_remote_write(body: bytes) -> dict[str, dict[str, list]]:
+    """Parse a prometheus.WriteRequest into per-metric columnar dicts.
+
+    WriteRequest{ timeseries=1: TimeSeries{ labels=1: Label{name=1,value=2},
+    samples=2: Sample{value=1(double), timestamp=2(int64)} } }.
+    The __name__ label routes to a table; remaining labels are tags; the
+    sample value lands in column 'val' (greptime's metric data model).
+    """
+    import struct
+
+    per_table: dict[str, list[tuple[dict, float, int]]] = defaultdict(list)
+    for field, _wt, ts_bytes in _pb_fields(body):
+        if field != 1:
+            continue
+        labels: dict[str, str] = {}
+        samples: list[tuple[float, int]] = []
+        for f2, _wt2, v2 in _pb_fields(ts_bytes):
+            if f2 == 1:  # Label
+                name = value = ""
+                for f3, _wt3, v3 in _pb_fields(v2):
+                    if f3 == 1:
+                        name = v3.decode("utf-8")
+                    elif f3 == 2:
+                        value = v3.decode("utf-8")
+                labels[name] = value
+            elif f2 == 2:  # Sample
+                val = math.nan
+                ts = 0
+                for f3, wt3, v3 in _pb_fields(v2):
+                    if f3 == 1:
+                        val = struct.unpack("<d", v3)[0]
+                    elif f3 == 2:
+                        ts = _zigzag_or_signed(v3)
+                samples.append((val, ts))
+        metric = labels.pop("__name__", "")
+        if not metric:
+            continue
+        for val, ts in samples:
+            per_table[metric].append((labels, val, ts))
+
+    out: dict[str, dict[str, list]] = {}
+    for table, rows in per_table.items():
+        tag_names = sorted({k for tags, _v, _t in rows for k in tags})
+        cols: dict[str, list] = {k: [] for k in tag_names}
+        cols["ts"] = []
+        cols["val"] = []
+        for tags, val, ts in rows:
+            for k in tag_names:
+                cols[k].append(tags.get(k, ""))
+            cols["ts"].append(ts)
+            cols["val"].append(val)
+        out[table] = {"__tags__": tag_names, "__fields__": ["val"], **cols}
+    return out

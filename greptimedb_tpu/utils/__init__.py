@@ -1,0 +1,1 @@
+"""Shared runtime utilities: telemetry, config, codecs."""

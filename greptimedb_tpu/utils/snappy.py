@@ -1,0 +1,117 @@
+"""Pure-python Snappy raw-format decompressor (and a trivial compressor).
+
+Prometheus remote write bodies are snappy-compressed protobuf
+(reference src/servers/src/prom_store.rs: snap::raw::Decoder); the runtime
+image ships no snappy binding, so this implements the raw format
+(github.com/google/snappy/blob/main/format_description.txt): a uvarint
+uncompressed length followed by literal/copy tagged elements.
+
+The compressor emits valid-but-uncompressed output (all literals) — enough
+for tests and for responding to remote_read.
+"""
+
+from __future__ import annotations
+
+
+def _read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated snappy varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("snappy varint too long")
+
+
+def decompress(data: bytes) -> bytes:
+    if not data:
+        return b""
+    expected, pos = _read_uvarint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        elem_type = tag & 0x03
+        if elem_type == 0:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                if pos + extra > n:
+                    raise ValueError("truncated snappy literal length")
+                length = int.from_bytes(data[pos:pos + extra], "little") + 1
+                pos += extra
+            if pos + length > n:
+                raise ValueError("truncated snappy literal")
+            out += data[pos:pos + length]
+            pos += length
+            continue
+        if elem_type == 1:  # copy, 1-byte offset
+            length = ((tag >> 2) & 0x07) + 4
+            if pos >= n:
+                raise ValueError("truncated snappy copy1")
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif elem_type == 2:  # copy, 2-byte offset
+            length = (tag >> 2) + 1
+            if pos + 2 > n:
+                raise ValueError("truncated snappy copy2")
+            offset = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:  # copy, 4-byte offset
+            length = (tag >> 2) + 1
+            if pos + 4 > n:
+                raise ValueError("truncated snappy copy4")
+            offset = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise ValueError(f"bad snappy copy offset {offset}")
+        start = len(out) - offset
+        if offset >= length:
+            # non-overlapping (common case): one slice copy
+            out += out[start:start + length]
+        else:
+            # overlapping copy: repeat the window by doubling
+            remaining = length
+            while remaining > 0:
+                chunk = out[start:start + min(remaining, len(out) - start)]
+                out += chunk
+                remaining -= len(chunk)
+    if len(out) != expected:
+        raise ValueError(
+            f"snappy length mismatch: got {len(out)}, expected {expected}"
+        )
+    return bytes(out)
+
+
+def compress(data: bytes) -> bytes:
+    """All-literal encoding: valid snappy, no compression."""
+    out = bytearray()
+    # uvarint length
+    v = len(data)
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            break
+    pos = 0
+    while pos < len(data):
+        chunk = data[pos:pos + 65536]
+        length = len(chunk)
+        if length <= 60:
+            out.append((length - 1) << 2)
+        else:
+            out.append(61 << 2)  # literal with 2-byte length
+            out += (length - 1).to_bytes(2, "little")
+        out += chunk
+        pos += length
+    return bytes(out)

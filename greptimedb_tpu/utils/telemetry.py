@@ -1,0 +1,185 @@
+"""Metrics registry: counters/gauges/histograms + Prometheus text export.
+
+Equivalent of the reference's per-crate lazy_static metric registries
+exported at /metrics (SURVEY.md §5.5, src/servers/src/http.rs:944).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...]):
+        self.name = name
+        self.help = help_
+        self.label_names = labels
+        self._children: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values: str):
+        key = tuple(str(v) for v in values)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._new_child())
+        return child
+
+    def _new_child(self):
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    class _Child:
+        __slots__ = ("value",)
+
+        def __init__(self):
+            self.value = 0.0
+
+        def inc(self, by: float = 1.0):
+            self.value += by
+
+    def _new_child(self):
+        return Counter._Child()
+
+    def inc(self, by: float = 1.0):
+        self.labels().inc(by)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    class _Child:
+        __slots__ = ("value",)
+
+        def __init__(self):
+            self.value = 0.0
+
+        def set(self, v: float):
+            self.value = v
+
+        def inc(self, by: float = 1.0):
+            self.value += by
+
+        def dec(self, by: float = 1.0):
+            self.value -= by
+
+    def _new_child(self):
+        return Gauge._Child()
+
+    def set(self, v: float):
+        self.labels().set(v)
+
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, labels, buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_, labels)
+        self.buckets = tuple(sorted(buckets))
+
+    class _Child:
+        __slots__ = ("counts", "total", "sum", "buckets")
+
+        def __init__(self, buckets):
+            self.buckets = buckets
+            self.counts = [0] * len(buckets)
+            self.total = 0
+            self.sum = 0.0
+
+        def observe(self, v: float):
+            self.total += 1
+            self.sum += v
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+
+        def time(self):
+            return _Timer(self)
+
+    def _new_child(self):
+        return Histogram._Child(self.buckets)
+
+    def observe(self, v: float):
+        self.labels().observe(v)
+
+    def time(self):
+        return self.labels().time()
+
+
+class _Timer:
+    def __init__(self, child):
+        self.child = child
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.child.observe(time.perf_counter() - self.t0)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name, help_="", labels=()):
+        return self._get(Counter, name, help_, tuple(labels))
+
+    def gauge(self, name, help_="", labels=()):
+        return self._get(Gauge, name, help_, tuple(labels))
+
+    def histogram(self, name, help_="", labels=(), buckets=_DEFAULT_BUCKETS):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, tuple(labels), buckets)
+                self._metrics[name] = m
+            return m
+
+    def _get(self, cls, name, help_, labels):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, labels)
+                self._metrics[name] = m
+            return m
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            out.append(f"# HELP {name} {m.help}")
+            out.append(f"# TYPE {name} {m.kind}")
+            for key, child in sorted(m._children.items()):
+                lab = ",".join(
+                    f'{n}="{v}"' for n, v in zip(m.label_names, key)
+                )
+                lab = "{" + lab + "}" if lab else ""
+                if m.kind == "histogram":
+                    cum = 0
+                    for b, c in zip(m.buckets, child.counts):
+                        cum = c
+                        blab = (lab[:-1] + "," if lab else "{") + f'le="{b}"' + "}"
+                        out.append(f"{name}_bucket{blab} {c}")
+                    inf_lab = (lab[:-1] + "," if lab else "{") + 'le="+Inf"' + "}"
+                    out.append(f"{name}_bucket{inf_lab} {child.total}")
+                    out.append(f"{name}_sum{lab} {child.sum}")
+                    out.append(f"{name}_count{lab} {child.total}")
+                else:
+                    out.append(f"{name}{lab} {child.value}")
+        return "\n".join(out) + "\n"
+
+
+REGISTRY = Registry()

@@ -52,9 +52,8 @@ class TestPartitionRule:
             split_rows(rule, {"v": np.array([5, 20], dtype=object)}, 2)
 
     def test_hash_rule_balance(self):
-        rule = PartitionRule.hash_rule(4)
+        rule = PartitionRule.hash_rule(4, ["host"])
         cols = {"host": np.array([f"h{i}" for i in range(1000)], dtype=object)}
-        rule.columns = ["host"]
         parts = split_rows(rule, cols, 1000)
         sizes = [len(v) for v in parts.values()]
         assert len(parts) == 4 and min(sizes) > 100
@@ -132,3 +131,14 @@ class TestDistAgg:
         # hours 1..3 have no data -> NaN
         assert np.isnan(grid[:, 1:]).all()
         assert np.isfinite(grid[:8, 0]).all()
+
+
+    def test_hash_rule_stable_and_spread(self):
+        # no explicit columns: uses all provided columns, crc32-stable
+        rule = PartitionRule.hash_rule(4)
+        cols = {"host": np.array([f"h{i}" for i in range(100)], dtype=object)}
+        p1 = split_rows(rule, cols, 100)
+        p2 = split_rows(PartitionRule.hash_rule(4), cols, 100)
+        assert len(p1) > 1  # regression: used to collapse to one partition
+        for k in p1:
+            np.testing.assert_array_equal(p1[k], p2[k])  # deterministic

@@ -1,0 +1,304 @@
+"""HTTP protocol server tests: real sockets, real wire formats.
+
+Mirrors the reference's protocol integration tests
+(tests-integration/tests/http.rs): SQL envelope, Prometheus API formats,
+line protocol and remote write bodies.
+"""
+
+import json
+import struct
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.servers import HttpServer
+from greptimedb_tpu.servers.protocols import parse_line_protocol, parse_remote_write
+from greptimedb_tpu.standalone import GreptimeDB
+from greptimedb_tpu.utils import snappy
+
+
+@pytest.fixture(scope="module")
+def server():
+    db = GreptimeDB()
+    srv = HttpServer(db, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+    db.close()
+
+
+def http(server, path, method="GET", body=None, headers=None, form=None):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    if form is not None:
+        body = urllib.parse.urlencode(form).encode()
+        headers = dict(headers or {})
+        headers["Content-Type"] = "application/x-www-form-urlencoded"
+        method = "POST"
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            data = resp.read()
+            return resp.status, data
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestSnappy:
+    def test_roundtrip(self):
+        for payload in [b"", b"x", b"hello world" * 100, bytes(range(256)) * 50]:
+            assert snappy.decompress(snappy.compress(payload)) == payload
+
+    def test_copy_elements(self):
+        # hand-built: literal "abcd" + 1-byte-offset copy of 4 from offset 4
+        body = bytes([8]) + bytes([(4 - 1) << 2]) + b"abcd" + bytes(
+            [0b001 | ((4 - 4) << 2)][0:1]
+        )
+        # tag: type=1, len=4 -> ((4-4)<<2)|1 = 1; offset byte = 4
+        body = bytes([8, (4 - 1) << 2]) + b"abcd" + bytes([1, 4])
+        assert snappy.decompress(body) == b"abcdabcd"
+
+    def test_corrupt(self):
+        with pytest.raises(ValueError):
+            snappy.decompress(b"\x10\xff\xff")
+
+
+class TestLineProtocol:
+    def test_parse(self):
+        out = parse_line_protocol(
+            'cpu,host=h1,region=us value=0.5,count=3i 1700000000000000000\n'
+            'cpu,host=h2 value=1.5 1700000001000000000\n'
+            'mem,host=h1 used=12.5 1700000000000000000\n'
+        )
+        assert set(out) == {"cpu", "mem"}
+        cpu = out["cpu"]
+        assert cpu["__tags__"] == ["host", "region"]
+        assert cpu["host"] == ["h1", "h2"]
+        assert cpu["region"] == ["us", None]
+        assert cpu["value"] == [0.5, 1.5]
+        assert cpu["count"] == [3, None]
+        assert cpu["ts"] == [1700000000000, 1700000001000]
+
+    def test_escapes_and_types(self):
+        out = parse_line_protocol(
+            'my\\ table,tag=va\\,lue str="quoted \\"x\\"",b=t 1000',
+            precision="ms",
+        )
+        t = out["my table"]
+        assert t["tag"] == ["va,lue"]
+        assert t["str"] == ['quoted "x"']
+        assert t["b"] == [True]
+        assert t["ts"] == [1000]
+
+    def test_bad_lines(self):
+        from greptimedb_tpu.errors import InvalidArguments
+
+        for bad in ["cpu", "cpu,host=h1", "cpu value=", ",host=x value=1"]:
+            with pytest.raises(InvalidArguments):
+                parse_line_protocol(bad)
+
+
+def _pb_varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _pb_len(field: int, payload: bytes) -> bytes:
+    return _pb_varint((field << 3) | 2) + _pb_varint(len(payload)) + payload
+
+
+def make_write_request(series: list[tuple[dict, list[tuple[float, int]]]]) -> bytes:
+    body = b""
+    for labels, samples in series:
+        ts_msg = b""
+        for name, value in labels.items():
+            label = _pb_len(1, name.encode()) + _pb_len(2, value.encode())
+            ts_msg += _pb_len(1, label)
+        for val, ts in samples:
+            sample = (
+                _pb_varint((1 << 3) | 1) + struct.pack("<d", val)
+                + _pb_varint(2 << 3) + _pb_varint(ts & ((1 << 64) - 1))
+            )
+            ts_msg += _pb_len(2, sample)
+        body += _pb_len(1, ts_msg)
+    return body
+
+
+class TestRemoteWriteCodec:
+    def test_parse(self):
+        pb = make_write_request([
+            ({"__name__": "up", "job": "api"}, [(1.0, 1000), (0.0, 2000)]),
+            ({"__name__": "up", "job": "web"}, [(1.0, 1000)]),
+        ])
+        out = parse_remote_write(pb)
+        assert set(out) == {"up"}
+        up = out["up"]
+        assert up["job"] == ["api", "api", "web"]
+        assert up["val"] == [1.0, 0.0, 1.0]
+        assert up["ts"] == [1000, 2000, 1000]
+
+
+class TestHttpApi:
+    def test_sql_roundtrip(self, server):
+        code, _ = http(server, "/v1/sql", form={
+            "sql": "CREATE TABLE web (host STRING, ts TIMESTAMP(3) TIME INDEX,"
+                   " hits DOUBLE, PRIMARY KEY (host))"})
+        assert code == 200
+        code, _ = http(server, "/v1/sql", form={
+            "sql": "INSERT INTO web VALUES ('a', 1000, 5.0), ('b', 2000, 7.0)"})
+        assert code == 200
+        code, raw = http(
+            server,
+            "/v1/sql?" + urllib.parse.urlencode(
+                {"sql": "SELECT host, hits FROM web ORDER BY host"}),
+        )
+        assert code == 200
+        body = json.loads(raw)
+        assert body["code"] == 0
+        rec = body["output"][0]["records"]
+        assert [c["name"] for c in rec["schema"]["column_schemas"]] == ["host", "hits"]
+        assert rec["rows"] == [["a", 5.0], ["b", 7.0]]
+
+    def test_sql_errors(self, server):
+        code, raw = http(server, "/v1/sql", form={"sql": "SELEC 1"})
+        assert code == 400
+        assert json.loads(raw)["code"] != 0
+        code, raw = http(server, "/v1/sql", form={"sql": "SELECT * FROM nope"})
+        assert code == 404
+        code, raw = http(server, "/v1/sql")
+        assert code == 400
+
+    def test_influx_write_and_query(self, server):
+        lp = (
+            "weather,city=sf temp=13.5 1700000000000\n"
+            "weather,city=nyc temp=2.0 1700000000000\n"
+        )
+        code, _ = http(server, "/v1/influxdb/api/v2/write?precision=ms",
+                       method="POST", body=lp.encode())
+        assert code == 204
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT city, temp FROM weather ORDER BY city"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert rows == [["nyc", 2.0], ["sf", 13.5]]
+
+    def test_influx_schema_extension(self, server):
+        http(server, "/v1/influxdb/api/v2/write?precision=ms",
+             method="POST", body=b"weather,city=sf humidity=80.0 1700000001000")
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT humidity FROM weather WHERE city = 'sf' ORDER BY ts"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert rows == [[None], [80.0]]
+
+    def test_remote_write_and_prom_query(self, server):
+        ts0 = 1700000000000
+        pb = make_write_request([
+            ({"__name__": "http_total", "job": "api"},
+             [(float(5 * i), ts0 + i * 10_000) for i in range(60)]),
+        ])
+        code, _ = http(server, "/v1/prometheus/write", method="POST",
+                       body=snappy.compress(pb),
+                       headers={"Content-Encoding": "snappy"})
+        assert code == 204
+        q = urllib.parse.urlencode({
+            "query": "rate(http_total[5m])",
+            "start": str(ts0 / 1000 + 300), "end": str(ts0 / 1000 + 500),
+            "step": "100",
+        })
+        code, raw = http(server, f"/v1/prometheus/api/v1/query_range?{q}")
+        assert code == 200
+        body = json.loads(raw)
+        assert body["status"] == "success"
+        series = body["data"]["result"]
+        assert len(series) == 1
+        assert series[0]["metric"] == {"job": "api"}
+        for _t, v in series[0]["values"]:
+            assert float(v) == pytest.approx(0.5, rel=1e-5)
+
+    def test_prom_instant_query(self, server):
+        q = urllib.parse.urlencode({
+            "query": "http_total", "time": str(1700000000000 / 1000 + 590),
+        })
+        code, raw = http(server, f"/v1/prometheus/api/v1/query?{q}")
+        body = json.loads(raw)
+        assert body["data"]["resultType"] == "vector"
+        assert len(body["data"]["result"]) == 1
+
+    def test_prom_metadata(self, server):
+        code, raw = http(server, "/v1/prometheus/api/v1/labels")
+        data = json.loads(raw)["data"]
+        assert "__name__" in data and "job" in data
+        code, raw = http(server, "/v1/prometheus/api/v1/label/__name__/values")
+        assert "http_total" in json.loads(raw)["data"]
+        code, raw = http(server, "/v1/prometheus/api/v1/label/job/values")
+        assert "api" in json.loads(raw)["data"]
+        q = urllib.parse.urlencode({"match[]": "http_total"})
+        code, raw = http(server, f"/v1/prometheus/api/v1/series?{q}")
+        data = json.loads(raw)["data"]
+        assert {"__name__": "http_total", "job": "api"} in data
+
+    def test_promql_native_endpoint(self, server):
+        q = urllib.parse.urlencode({
+            "query": "http_total", "start": str(1700000000000 / 1000 + 100),
+            "end": str(1700000000000 / 1000 + 100), "step": "60",
+        })
+        code, raw = http(server, f"/v1/promql?{q}")
+        assert code == 200
+        body = json.loads(raw)
+        rec = body["output"][0]["records"]
+        assert rec["schema"]["column_schemas"][0]["name"] == "job"
+
+    def test_admin_endpoints(self, server):
+        code, _ = http(server, "/health")
+        assert code == 200
+        code, raw = http(server, "/metrics")
+        assert code == 200
+        assert b"greptime_http_requests_total" in raw
+        code, raw = http(server, "/config")
+        assert code == 200 and b"data_home" in raw
+        code, raw = http(server, "/status")
+        assert code == 200 and b"devices" in raw
+
+    def test_bad_remote_write_body(self, server):
+        code, _ = http(server, "/v1/prometheus/write", method="POST",
+                       body=b"\xff\xfe\xfd",
+                       headers={"Content-Encoding": "snappy"})
+        assert code == 400
+
+
+class TestReviewRegressions:
+    def test_new_tag_rejected_not_dropped(self, server):
+        http(server, "/v1/influxdb/api/v2/write?precision=ms",
+             method="POST", body=b"ttags,host=a v=1.0 1000")
+        code, raw = http(server, "/v1/influxdb/api/v2/write?precision=ms",
+                         method="POST", body=b"ttags,host=a,region=us v=2.0 2000")
+        assert code == 400
+        assert b"region" in raw
+
+    def test_bad_lp_timestamp_is_400(self, server):
+        code, _ = http(server, "/v1/influxdb/write", method="POST",
+                       body=b"cpu val=1 notanumber")
+        assert code == 400
+
+    def test_ns_timestamp_exact(self):
+        ns = 1700000000123999999  # truncates to ...123 ms exactly
+        out = parse_line_protocol(f"m v=1 {ns}")
+        assert out["m"]["ts"] == [1700000000123]
+
+    def test_snappy_overlapping_copy_fast(self):
+        # run-length style: 1-byte literal + long overlapping copy
+        data = b"a" * 10000
+        assert snappy.decompress(snappy.compress(data)) == data
+        import time
+        big = bytes(np.random.default_rng(0).integers(65, 91, 2_000_000, dtype=np.uint8))
+        t0 = time.time()
+        assert snappy.decompress(snappy.compress(big)) == big
+        assert time.time() - t0 < 2.0
