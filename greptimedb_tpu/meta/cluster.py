@@ -1,0 +1,305 @@
+"""Cluster control plane: datanodes, heartbeats, leases, failover, migration.
+
+In-process model of the reference's control loop (SURVEY.md §3.5): every
+datanode heartbeats the metasrv; the metasrv's handler chain updates lease
+keys, feeds the phi-accrual failure detectors and piggybacks mailbox
+instructions on responses (reference src/meta-srv/src/handler/*.rs,
+instruction.rs). Region failover runs the region-migration procedure —
+a persisted, resumable state machine (reference
+src/meta-srv/src/procedure/region_migration/*.rs).
+
+Time is an explicit parameter everywhere (now_ms) so tests drive the loop
+deterministically — the reference gets the same property from its mock
+clusters (tests-integration/src/cluster.rs).
+"""
+
+from __future__ import annotations
+
+import json
+
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.errors import GreptimeError, RegionNotFound
+from greptimedb_tpu.meta.failure_detector import PhiAccrualFailureDetector
+from greptimedb_tpu.meta.kv import KvBackend
+from greptimedb_tpu.meta.procedure import (
+    Procedure, ProcedureContext, ProcedureManager, Status,
+)
+from greptimedb_tpu.storage.region import RegionEngine
+
+REGION_LEASE_MS = 20_000.0
+
+
+class Datanode:
+    """One storage node: a RegionEngine plus the node-side control loop
+    (heartbeat emission, mailbox execution, lease self-fencing — reference
+    src/datanode/src/{heartbeat.rs,alive_keeper.rs})."""
+
+    def __init__(self, node_id: int, data_home: str):
+        self.node_id = node_id
+        self.engine = RegionEngine(data_home)
+        self.roles: dict[int, str] = {}  # region_id -> leader|follower|downgrading
+        self.lease_until_ms: dict[int, float] = {}
+        self.alive = True
+
+    # ---- data plane ----------------------------------------------------
+    def write(self, region_id: int, data: dict, now_ms: float) -> int:
+        if not self.alive:
+            raise GreptimeError(f"datanode {self.node_id} is down")
+        role = self.roles.get(region_id)
+        if role != "leader":
+            raise GreptimeError(
+                f"region {region_id} on node {self.node_id} is {role}, not leader"
+            )
+        if self.lease_until_ms.get(region_id, 0) < now_ms:
+            # self-fencing (reference alive_keeper.rs:50): an expired lease
+            # means the metasrv may have moved the region elsewhere
+            raise GreptimeError(
+                f"region {region_id} lease expired on node {self.node_id}"
+            )
+        return self.engine.regions[region_id].write(data)
+
+    # ---- control plane -------------------------------------------------
+    def heartbeat(self, now_ms: float) -> dict:
+        if not self.alive:
+            raise GreptimeError(f"datanode {self.node_id} is down")
+        regions = []
+        for rid, region in self.engine.regions.items():
+            regions.append({
+                "region_id": rid,
+                "role": self.roles.get(rid, "follower"),
+                "num_rows": region.memtable.num_rows
+                + sum(m.num_rows for m in region.sst_files),
+            })
+        return {"node_id": self.node_id, "regions": regions, "ts": now_ms}
+
+    def handle_instruction(self, instr: dict, now_ms: float) -> dict:
+        """Mailbox instruction execution (reference instruction.rs)."""
+        if not self.alive:
+            raise GreptimeError(
+                f"datanode {self.node_id} is down (instruction {instr['kind']})"
+            )
+        kind = instr["kind"]
+        rid = instr.get("region_id")
+        if kind == "open_region":
+            schema = Schema.from_dict(instr["schema"]) if "schema" in instr else None
+            try:
+                self.engine.open_region(rid)
+            except RegionNotFound:
+                if schema is None:
+                    raise
+                self.engine.create_region(rid, schema)
+            self.roles[rid] = instr.get("role", "follower")
+            if self.roles[rid] == "leader":
+                self.lease_until_ms[rid] = now_ms + REGION_LEASE_MS
+            return {"ok": True}
+        if kind == "close_region":
+            region = self.engine.regions.pop(rid, None)
+            if region is not None:
+                region.wal.close()
+            self.roles.pop(rid, None)
+            self.lease_until_ms.pop(rid, None)
+            return {"ok": True}
+        if kind == "downgrade_region":
+            region = self.engine.regions.get(rid)
+            if region is not None:
+                region.flush()
+            self.roles[rid] = "downgrading"
+            return {"ok": True, "last_seq": region.next_seq - 1 if region else 0}
+        if kind == "upgrade_region":
+            region = self.engine.regions.get(rid)
+            if region is None:
+                raise RegionNotFound(f"region {rid} not open on {self.node_id}")
+            # catch-up (reference handle_catchup.rs): reload the latest
+            # manifest from shared storage, drop any stale memtable state,
+            # re-sync the sequence counter past flushed_seq (a stale
+            # next_seq would mint sequences the dedup already considers
+            # superseded), then replay the remaining WAL
+            from greptimedb_tpu.storage.manifest import Manifest
+            from greptimedb_tpu.storage.memtable import Memtable
+
+            region.manifest = Manifest.open(
+                region.store, f"region_{rid}/manifest"
+            )
+            region.memtable = Memtable(region.schema)
+            region.next_seq = max(
+                region.next_seq, region.manifest.state.flushed_seq + 1
+            )
+            region.replay_wal()
+            region.generation += 1
+            self.roles[rid] = "leader"
+            self.lease_until_ms[rid] = now_ms + REGION_LEASE_MS
+            return {"ok": True}
+        if kind == "flush_region":
+            region = self.engine.regions.get(rid)
+            if region is not None:
+                region.flush()
+            return {"ok": True}
+        if kind == "renew_lease":
+            if self.roles.get(rid) == "leader":
+                self.lease_until_ms[rid] = now_ms + REGION_LEASE_MS
+            return {"ok": True}
+        raise GreptimeError(f"unknown instruction {kind}")
+
+    def tick_alive_keeper(self, now_ms: float) -> list[int]:
+        """Self-fence regions whose lease expired; returns closed ids."""
+        expired = [
+            rid for rid, until in self.lease_until_ms.items()
+            if until < now_ms and self.roles.get(rid) == "leader"
+        ]
+        for rid in expired:
+            self.roles[rid] = "follower"
+        return expired
+
+
+class RegionMigrationProcedure(Procedure):
+    """OpenCandidate → Downgrade → Upgrade → UpdateMetadata → CloseOld
+    (reference migration_start.rs ... migration_end.rs)."""
+
+    type_name = "region_migration"
+
+    def execute(self, ctx: ProcedureContext) -> Status:
+        s = self.state
+        datanodes: dict[int, Datanode] = ctx.services["datanodes"]
+        metasrv: Metasrv = ctx.services["metasrv"]
+        rid = s["region_id"]
+        src = s["from_node"]
+        dst = s["to_node"]
+        now = s.get("now_ms", 0.0)
+        phase = s.setdefault("phase", "open_candidate")
+
+        if phase == "open_candidate":
+            dn = datanodes[dst]
+            dn.handle_instruction(
+                {"kind": "open_region", "region_id": rid, "role": "follower",
+                 "schema": s.get("schema")}, now,
+            )
+            s["phase"] = "downgrade_leader"
+            return Status.executing()
+        if phase == "downgrade_leader":
+            src_dn = datanodes.get(src)
+            if src_dn is not None and src_dn.alive:
+                src_dn.handle_instruction(
+                    {"kind": "downgrade_region", "region_id": rid}, now
+                )
+            s["phase"] = "upgrade_candidate"
+            return Status.executing()
+        if phase == "upgrade_candidate":
+            datanodes[dst].handle_instruction(
+                {"kind": "upgrade_region", "region_id": rid}, now
+            )
+            s["phase"] = "update_metadata"
+            return Status.executing()
+        if phase == "update_metadata":
+            metasrv.set_region_route(rid, dst)
+            s["phase"] = "close_old"
+            return Status.executing()
+        if phase == "close_old":
+            src_dn = datanodes.get(src)
+            if src_dn is not None and src_dn.alive:
+                src_dn.handle_instruction(
+                    {"kind": "close_region", "region_id": rid}, now
+                )
+            return Status.done({"region_id": rid, "to_node": dst})
+        raise GreptimeError(f"unknown migration phase {phase}")
+
+    def lock_keys(self) -> list[str]:
+        return [f"region/{self.state['region_id']}"]
+
+
+class Metasrv:
+    """Cluster brain (reference src/meta-srv/src/metasrv.rs:556): heartbeat
+    handler chain, failure detection, region routes, migration driving."""
+
+    def __init__(self, kv: KvBackend):
+        self.kv = kv
+        self.datanodes: dict[int, Datanode] = {}
+        self.detectors: dict[int, PhiAccrualFailureDetector] = {}
+        self.procedures = ProcedureManager(
+            kv, services={"datanodes": self.datanodes, "metasrv": self}
+        )
+        self.procedures.register(RegionMigrationProcedure)
+        self.maintenance_mode = False
+
+    # ---- membership ----------------------------------------------------
+    def register_datanode(self, dn: Datanode) -> None:
+        self.datanodes[dn.node_id] = dn
+        self.detectors[dn.node_id] = PhiAccrualFailureDetector()
+
+    # ---- routes --------------------------------------------------------
+    def set_region_route(self, region_id: int, node_id: int) -> None:
+        self.kv.put_json(f"__meta/route/region/{region_id}", {"node": node_id})
+
+    def region_route(self, region_id: int) -> int | None:
+        rec = self.kv.get_json(f"__meta/route/region/{region_id}")
+        return None if rec is None else rec["node"]
+
+    def routes(self) -> dict[int, int]:
+        out = {}
+        for k, v in self.kv.range("__meta/route/region/"):
+            out[int(k.rsplit("/", 1)[-1])] = json.loads(v)["node"]
+        return out
+
+    # ---- heartbeat chain (reference handler.rs:322) --------------------
+    def handle_heartbeat(self, hb: dict, now_ms: float) -> list[dict]:
+        node_id = hb["node_id"]
+        det = self.detectors.get(node_id)
+        if det is None:
+            return []
+        det.heartbeat(now_ms)
+        instructions = []
+        # lease renewal for leader regions this node legitimately routes
+        for r in hb.get("regions", []):
+            if r["role"] == "leader" and self.region_route(r["region_id"]) == node_id:
+                instructions.append(
+                    {"kind": "renew_lease", "region_id": r["region_id"]}
+                )
+        return instructions
+
+    # ---- supervision (reference region/supervisor.rs:280) --------------
+    def select_target(self, exclude: set[int]) -> int | None:
+        """Least-loaded alive node (reference selector/load_based.rs)."""
+        best = None
+        best_load = None
+        for nid, dn in self.datanodes.items():
+            if nid in exclude or not dn.alive:
+                continue
+            load = len([r for r, role in dn.roles.items() if role == "leader"])
+            if best_load is None or load < best_load:
+                best, best_load = nid, load
+        return best
+
+    def tick(self, now_ms: float) -> list[dict]:
+        """Failure detection sweep; returns completed migrations."""
+        if self.maintenance_mode:
+            return []
+        migrated = []
+        for nid, det in self.detectors.items():
+            dn = self.datanodes[nid]
+            if det.phi(now_ms) < det.threshold:
+                continue
+            # node suspected dead: move its leader regions away
+            for rid, node in self.routes().items():
+                if node != nid:
+                    continue
+                target = self.select_target(exclude={nid})
+                if target is None:
+                    continue
+                migrated.append(
+                    self._submit_migration(rid, nid, target, now_ms)
+                )
+        return migrated
+
+    def _submit_migration(self, region_id: int, from_node: int, to_node: int,
+                          now_ms: float) -> dict:
+        region = self.datanodes[from_node].engine.regions.get(region_id)
+        schema = region.schema.to_dict() if region is not None else None
+        proc = RegionMigrationProcedure(state={
+            "region_id": region_id, "from_node": from_node, "to_node": to_node,
+            "schema": schema, "now_ms": now_ms,
+        })
+        return self.procedures.submit(proc)
+
+    def migrate_region(self, region_id: int, from_node: int, to_node: int,
+                       now_ms: float) -> dict:
+        """Manual migration (reference admin migrate_region function)."""
+        return self._submit_migration(region_id, from_node, to_node, now_ms)

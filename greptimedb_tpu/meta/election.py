@@ -1,0 +1,55 @@
+"""Leader election over the kv backend (reference src/common/meta/src/election/).
+
+Lease-based: candidates CAS the leader key with an expiry; the holder
+renews before expiry; anyone observing an expired lease may take over.
+The reference runs this over etcd leases / RDS rows — the CAS semantics
+are identical.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from greptimedb_tpu.meta.kv import KvBackend
+
+LEADER_KEY = "__election/leader"
+
+
+@dataclass
+class Election:
+    kv: KvBackend
+    node_id: str
+    lease_s: float = 10.0
+
+    def campaign(self, now_s: float) -> bool:
+        """Try to become (or stay) leader; returns True when leading."""
+        raw = self.kv.get(LEADER_KEY)
+        record = json.dumps(
+            {"leader": self.node_id, "expires_at": now_s + self.lease_s}
+        ).encode()
+        if raw is None:
+            return self.kv.compare_and_put(LEADER_KEY, None, record)
+        cur = json.loads(raw)
+        if cur["leader"] == self.node_id or cur["expires_at"] <= now_s:
+            return self.kv.compare_and_put(LEADER_KEY, raw, record)
+        return False
+
+    def leader(self, now_s: float) -> str | None:
+        raw = self.kv.get(LEADER_KEY)
+        if raw is None:
+            return None
+        cur = json.loads(raw)
+        if cur["expires_at"] <= now_s:
+            return None
+        return cur["leader"]
+
+    def is_leader(self, now_s: float) -> bool:
+        return self.leader(now_s) == self.node_id
+
+    def resign(self) -> None:
+        # CAS-delete: a plain get-then-delete could remove a NEWER leader's
+        # record written between our read and our delete
+        raw = self.kv.get(LEADER_KEY)
+        if raw is not None and json.loads(raw)["leader"] == self.node_id:
+            self.kv.compare_and_delete(LEADER_KEY, raw)

@@ -1,0 +1,71 @@
+"""Phi-accrual failure detector (reference src/meta-srv/src/failure_detector.rs:31-178).
+
+Akka-lineage detector: keeps a bounded history of heartbeat inter-arrival
+times and computes phi = -log10(P(no heartbeat by now | history)) under a
+normal approximation. phi crosses the threshold smoothly as heartbeats go
+missing, avoiding binary timeout flapping. Defaults mirror the reference
+(threshold 8, min_std 100ms, acceptable_pause 10s, first_estimate 1s).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PhiAccrualFailureDetector:
+    threshold: float = 8.0
+    min_std_deviation_ms: float = 100.0
+    acceptable_heartbeat_pause_ms: float = 10_000.0
+    first_heartbeat_estimate_ms: float = 1_000.0
+    max_sample_size: int = 1000
+    _intervals: deque = None
+    _last_heartbeat_ms: float | None = None
+
+    def __post_init__(self):
+        if self._intervals is None:
+            self._intervals = deque(maxlen=self.max_sample_size)
+
+    def heartbeat(self, now_ms: float) -> None:
+        if self._last_heartbeat_ms is not None:
+            interval = now_ms - self._last_heartbeat_ms
+            if interval >= 0:
+                self._intervals.append(interval)
+        else:
+            # seed with the bootstrap estimate (reference :92-104)
+            std = self.first_heartbeat_estimate_ms / 4
+            self._intervals.append(self.first_heartbeat_estimate_ms - std)
+            self._intervals.append(self.first_heartbeat_estimate_ms + std)
+        self._last_heartbeat_ms = now_ms
+
+    def phi(self, now_ms: float) -> float:
+        if self._last_heartbeat_ms is None or not self._intervals:
+            return 0.0
+        elapsed = now_ms - self._last_heartbeat_ms
+        mean = sum(self._intervals) / len(self._intervals)
+        var = sum((x - mean) ** 2 for x in self._intervals) / max(
+            len(self._intervals), 1
+        )
+        std = max(math.sqrt(var), self.min_std_deviation_ms)
+        mean_adj = mean + self.acceptable_heartbeat_pause_ms
+        y = (elapsed - mean_adj) / std
+        # P(X > elapsed) for N(mean_adj, std), logistic approximation of the
+        # normal CDF (same as Akka / reference :150-166)
+        exponent = -y * (1.5976 + 0.070566 * y * y)
+        if exponent > 700:  # elapsed far below mean: certainly alive
+            return 0.0
+        if exponent < -700:  # elapsed far past mean: certainly dead
+            return 300.0
+        e = math.exp(exponent)
+        if elapsed > mean_adj:
+            p = e / (1.0 + e)
+        else:
+            p = 1.0 - 1.0 / (1.0 + e)
+        if p <= 1e-300:
+            return 300.0
+        return -math.log10(p)
+
+    def is_available(self, now_ms: float) -> bool:
+        return self.phi(now_ms) < self.threshold

@@ -31,6 +31,9 @@ class KvBackend:
     ) -> bool:
         raise NotImplementedError
 
+    def compare_and_delete(self, key: str, expect: bytes) -> bool:
+        raise NotImplementedError
+
     # convenience json codecs
     def get_json(self, key: str):
         raw = self.get(key)
@@ -69,6 +72,13 @@ class MemoryKv(KvBackend):
             self._data[key] = bytes(value)
             return True
 
+    def compare_and_delete(self, key: str, expect: bytes) -> bool:
+        with self._lock:
+            if self._data.get(key) != expect:
+                return False
+            del self._data[key]
+            return True
+
 
 class FileKv(MemoryKv):
     """Write-through JSON file persistence (standalone embedded metadata)."""
@@ -100,6 +110,12 @@ class FileKv(MemoryKv):
 
     def compare_and_put(self, key: str, expect: bytes | None, value: bytes) -> bool:
         ok = super().compare_and_put(key, expect, value)
+        if ok:
+            self._persist()
+        return ok
+
+    def compare_and_delete(self, key: str, expect: bytes) -> bool:
+        ok = super().compare_and_delete(key, expect)
         if ok:
             self._persist()
         return ok

@@ -1,0 +1,265 @@
+"""Control plane tests: procedures, failure detection, election, migration.
+
+Deterministic time everywhere — mirrors the reference's mock-cluster tests
+(tests-integration/tests/region_migration.rs) without processes or sleeps.
+"""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.datatypes import ColumnSchema, ConcreteDataType as T, Schema, SemanticType as S
+from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.meta.cluster import Datanode, Metasrv, REGION_LEASE_MS
+from greptimedb_tpu.meta.election import Election
+from greptimedb_tpu.meta.failure_detector import PhiAccrualFailureDetector
+from greptimedb_tpu.meta.kv import MemoryKv
+from greptimedb_tpu.meta.procedure import (
+    Procedure, ProcedureManager, ProcedureState, Status,
+)
+
+
+def schema():
+    return Schema((
+        ColumnSchema("h", T.STRING, S.TAG),
+        ColumnSchema("ts", T.TIMESTAMP_MILLISECOND, S.TIMESTAMP),
+        ColumnSchema("v", T.FLOAT64, S.FIELD),
+    ))
+
+
+class CountingProcedure(Procedure):
+    type_name = "counting"
+
+    def execute(self, ctx):
+        n = self.state.setdefault("n", 0)
+        if n >= 3:
+            return Status.done(output=n)
+        self.state["n"] = n + 1
+        return Status.executing()
+
+
+class CrashyProcedure(Procedure):
+    type_name = "crashy"
+    crash = True
+
+    def execute(self, ctx):
+        n = self.state.setdefault("n", 0)
+        if n >= 2 and type(self).crash:
+            raise RuntimeError("boom")
+        if n >= 4:
+            return Status.done(output=n)
+        self.state["n"] = n + 1
+        return Status.executing()
+
+
+class TestProcedures:
+    def test_run_to_completion_journaled(self):
+        kv = MemoryKv()
+        mgr = ProcedureManager(kv)
+        mgr.register(CountingProcedure)
+        assert mgr.submit(CountingProcedure()) == 3
+        hist = mgr.history()
+        assert hist[-1]["status"] == ProcedureState.DONE.value
+
+    def test_failure_journals_and_recovery_resumes(self):
+        kv = MemoryKv()
+        mgr = ProcedureManager(kv)
+        mgr.register(CrashyProcedure)
+        with pytest.raises(RuntimeError):
+            mgr.submit(CrashyProcedure())
+        assert mgr.history()[-1]["status"] == ProcedureState.FAILED.value
+
+        # simulate: crash mid-run leaves a RUNNING journal; a new manager
+        # (restarted coordinator) resumes it
+        kv2 = MemoryKv()
+        mgr2 = ProcedureManager(kv2)
+        mgr2.register(CountingProcedure)
+        kv2.put_json("__procedure/deadbeef", {
+            "type": "counting", "state": {"n": 2}, "status": "running", "ts": 0,
+        })
+        assert mgr2.recover() == [3]
+
+    def test_locks_and_poison(self):
+        kv = MemoryKv()
+        mgr = ProcedureManager(kv)
+
+        class Poisoner(Procedure):
+            type_name = "poisoner"
+
+            def execute(self, ctx):
+                return Status.poison()
+
+            def lock_keys(self):
+                return ["region/7"]
+
+        mgr.register(Poisoner)
+        with pytest.raises(GreptimeError):
+            mgr.submit(Poisoner())
+        # poisoned resource rejects new procedures until cleared
+        with pytest.raises(GreptimeError, match="poisoned"):
+            mgr.submit(Poisoner())
+        mgr.clear_poison("region/7")
+
+        class Ok(Procedure):
+            type_name = "ok"
+
+            def execute(self, ctx):
+                return Status.done(output="fine")
+
+            def lock_keys(self):
+                return ["region/7"]
+
+        mgr.register(Ok)
+        assert mgr.submit(Ok()) == "fine"
+
+
+class TestFailureDetector:
+    def test_steady_heartbeats_low_phi(self):
+        det = PhiAccrualFailureDetector()
+        t = 0.0
+        for _ in range(50):
+            det.heartbeat(t)
+            t += 1000.0
+        assert det.phi(t + 500) < 1.0
+        assert det.is_available(t + 500)
+
+    def test_missing_heartbeats_raise_phi(self):
+        det = PhiAccrualFailureDetector(acceptable_heartbeat_pause_ms=2000)
+        t = 0.0
+        for _ in range(50):
+            det.heartbeat(t)
+            t += 1000.0
+        assert det.phi(t + 1000) < det.threshold
+        assert det.phi(t + 60_000) > det.threshold
+        assert not det.is_available(t + 60_000)
+
+    def test_phi_monotone(self):
+        det = PhiAccrualFailureDetector()
+        for i in range(20):
+            det.heartbeat(i * 1000.0)
+        phis = [det.phi(19_000 + dt) for dt in (0, 5_000, 15_000, 30_000, 60_000)]
+        assert phis == sorted(phis)
+
+
+class TestElection:
+    def test_campaign_renew_takeover(self):
+        kv = MemoryKv()
+        a = Election(kv, "metasrv-a", lease_s=10)
+        b = Election(kv, "metasrv-b", lease_s=10)
+        assert a.campaign(0.0)
+        assert not b.campaign(1.0)
+        assert a.leader(5.0) == "metasrv-a"
+        assert a.campaign(8.0)  # renew
+        assert b.leader(17.0) == "metasrv-a"
+        # lease expires at 18 -> b takes over
+        assert b.campaign(19.0)
+        assert b.is_leader(20.0)
+        b.resign()
+        assert a.leader(20.0) is None
+
+
+class TestCluster:
+    def make_cluster(self, tmp_path, n=3):
+        kv = MemoryKv()
+        ms = Metasrv(kv)
+        nodes = []
+        for i in range(n):
+            dn = Datanode(i, str(tmp_path))  # shared storage root
+            ms.register_datanode(dn)
+            nodes.append(dn)
+        return ms, nodes
+
+    def seed_region(self, ms, nodes, rid=1001, now=0.0):
+        nodes[0].handle_instruction(
+            {"kind": "open_region", "region_id": rid, "role": "leader",
+             "schema": schema().to_dict()}, now,
+        )
+        ms.set_region_route(rid, 0)
+        return rid
+
+    def test_write_requires_leadership_and_lease(self, tmp_path):
+        ms, nodes = self.make_cluster(tmp_path)
+        rid = self.seed_region(ms, nodes)
+        nodes[0].write(rid, {"h": ["a"], "ts": [1000], "v": [1.0]}, now_ms=10.0)
+        with pytest.raises(GreptimeError, match="not leader"):
+            nodes[1].write(rid, {"h": ["a"], "ts": [1000], "v": [1.0]}, 10.0)
+        # lease expiry fences writes
+        with pytest.raises(GreptimeError, match="lease expired"):
+            nodes[0].write(rid, {"h": ["a"], "ts": [2000], "v": [1.0]},
+                           REGION_LEASE_MS + 1)
+
+    def test_heartbeat_renews_lease(self, tmp_path):
+        ms, nodes = self.make_cluster(tmp_path)
+        rid = self.seed_region(ms, nodes)
+        t = REGION_LEASE_MS - 1000
+        instrs = ms.handle_heartbeat(nodes[0].heartbeat(t), t)
+        assert any(i["kind"] == "renew_lease" for i in instrs)
+        for i in instrs:
+            nodes[0].handle_instruction(i, t)
+        nodes[0].write(rid, {"h": ["a"], "ts": [3000], "v": [2.0]},
+                       REGION_LEASE_MS + 5000)
+
+    def test_manual_migration_preserves_data(self, tmp_path):
+        ms, nodes = self.make_cluster(tmp_path)
+        rid = self.seed_region(ms, nodes)
+        nodes[0].write(rid, {"h": ["a", "b"], "ts": [1000, 2000],
+                             "v": [1.0, 2.0]}, 10.0)
+        out = ms.migrate_region(rid, 0, 2, now_ms=20.0)
+        assert out == {"region_id": rid, "to_node": 2}
+        assert ms.region_route(rid) == 2
+        assert rid not in nodes[0].engine.regions
+        assert nodes[2].roles[rid] == "leader"
+        host = nodes[2].engine.regions[rid].scan_host()
+        assert sorted(host["v"].tolist()) == [1.0, 2.0]
+        # new leader accepts writes
+        nodes[2].write(rid, {"h": ["c"], "ts": [3000], "v": [3.0]}, 30.0)
+
+    def test_failover_on_dead_node(self, tmp_path):
+        ms, nodes = self.make_cluster(tmp_path)
+        rid = self.seed_region(ms, nodes)
+        nodes[0].write(rid, {"h": ["a"], "ts": [1000], "v": [7.0]}, 10.0)
+        nodes[0].engine.regions[rid].flush()
+        # healthy heartbeats from all nodes
+        t = 0.0
+        for _ in range(30):
+            for dn in nodes:
+                ms.handle_heartbeat(dn.heartbeat(t), t)
+            t += 1000.0
+        # node 0 dies; only others heartbeat
+        nodes[0].alive = False
+        for _ in range(60):
+            for dn in nodes[1:]:
+                ms.handle_heartbeat(dn.heartbeat(t), t)
+            t += 1000.0
+        migrated = ms.tick(t)
+        assert migrated and migrated[0]["region_id"] == rid
+        new_node = ms.region_route(rid)
+        assert new_node != 0
+        host = nodes[new_node].engine.regions[rid].scan_host()
+        assert host["v"].tolist() == [7.0]
+
+    def test_maintenance_mode_blocks_failover(self, tmp_path):
+        ms, nodes = self.make_cluster(tmp_path)
+        rid = self.seed_region(ms, nodes)
+        t = 0.0
+        for _ in range(30):
+            for dn in nodes:
+                ms.handle_heartbeat(dn.heartbeat(t), t)
+            t += 1000.0
+        nodes[0].alive = False
+        t += 120_000.0  # phi well past threshold
+        ms.maintenance_mode = True
+        assert ms.tick(t) == []
+        assert ms.region_route(rid) == 0  # untouched during maintenance
+        ms.maintenance_mode = False
+        migrated = ms.tick(t)
+        assert migrated and ms.region_route(rid) != 0
+
+    def test_migration_to_dead_node_fails_cleanly(self, tmp_path):
+        ms, nodes = self.make_cluster(tmp_path)
+        rid = self.seed_region(ms, nodes)
+        nodes[2].alive = False
+        with pytest.raises(GreptimeError, match="down"):
+            ms.migrate_region(rid, 0, 2, now_ms=10.0)
+        # route unchanged, source still leader
+        assert ms.region_route(rid) == 0
+        assert nodes[0].roles[rid] == "leader"
