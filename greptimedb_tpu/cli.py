@@ -1,0 +1,239 @@
+"""CLI entry: ``python -m greptimedb_tpu.cli <subcommand>``.
+
+Mirrors the reference binary's role subcommands (src/cmd/src/bin/greptime.rs:
+standalone/cli) for the roles that exist this round, plus data export/
+import (reference src/cli/src/data/) and an interactive SQL shell.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_standalone(args) -> int:
+    import jax
+
+    from greptimedb_tpu.servers import HttpServer
+    from greptimedb_tpu.standalone import GreptimeDB
+    from greptimedb_tpu.storage.region import RegionOptions
+    from greptimedb_tpu.utils.config import load_options
+
+    opts = load_options(args.config)
+    if args.data_home:
+        opts.storage.data_home = args.data_home
+    if args.http_addr:
+        opts.http.addr = args.http_addr
+    if opts.device.platform:
+        jax.config.update("jax_platforms", opts.device.platform)
+    db = GreptimeDB(
+        opts.storage.data_home,
+        region_options=RegionOptions(
+            flush_threshold_bytes=opts.storage.flush_threshold_mb << 20,
+            compaction_window_ms=opts.storage.compaction_window_hours * 3600_000,
+            compaction_trigger_files=opts.storage.compaction_trigger_files,
+            wal_enabled=opts.wal.provider != "noop",
+            wal_sync=opts.wal.sync,
+        ),
+        cache_capacity_bytes=opts.storage.cache_capacity_gb << 30,
+    )
+    host, port = opts.http.addr.rsplit(":", 1)
+    srv = HttpServer(db, host=host, port=int(port))
+    srv.start()
+    print(f"greptimedb-tpu standalone listening on http://{host}:{srv.port} "
+          f"(data_home={opts.storage.data_home}, devices={jax.devices()})")
+    try:
+        import signal
+        import threading
+
+        stop = threading.Event()
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        stop.wait()
+    finally:
+        srv.stop()
+        db.close()
+    return 0
+
+
+def cmd_sql(args) -> int:
+    from greptimedb_tpu.standalone import GreptimeDB
+
+    db = GreptimeDB(args.data_home)
+    try:
+        if args.execute:
+            res = db.sql(args.execute)
+            _print_result(res)
+            return 0
+        # interactive shell
+        print("greptimedb-tpu sql shell (end statements with ;, \\q to quit)")
+        buf: list[str] = []
+        while True:
+            try:
+                prompt = "greptime> " if not buf else "      ...> "
+                line = input(prompt)
+            except EOFError:
+                break
+            if line.strip() in ("\\q", "exit", "quit"):
+                break
+            buf.append(line)
+            if line.rstrip().endswith(";"):
+                stmt = "\n".join(buf)
+                buf = []
+                try:
+                    _print_result(db.sql(stmt))
+                except Exception as e:  # noqa: BLE001
+                    print(f"ERROR: {e}")
+    finally:
+        db.close()
+    return 0
+
+
+def _print_result(res) -> None:
+    if not res.column_names:
+        print(f"OK, {res.affected_rows} rows affected")
+        return
+    widths = [
+        max(len(str(n)), *(len(str(r[i])) for r in res.rows)) if res.rows else len(str(n))
+        for i, n in enumerate(res.column_names)
+    ]
+    line = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    print(line)
+    print("|" + "|".join(f" {n:<{w}} " for n, w in zip(res.column_names, widths)) + "|")
+    print(line)
+    for r in res.rows:
+        print("|" + "|".join(f" {str(v):<{w}} " for v, w in zip(r, widths)) + "|")
+    print(line)
+    print(f"{len(res.rows)} rows in set")
+
+
+def cmd_export(args) -> int:
+    """Data export (reference greptime cli data export): per-table parquet +
+    a metadata manifest."""
+    import os
+
+    import pyarrow.parquet as pq
+
+    from greptimedb_tpu.standalone import GreptimeDB
+
+    db = GreptimeDB(args.data_home)
+    os.makedirs(args.output_dir, exist_ok=True)
+    manifest = {"version": 1, "databases": {}}
+    try:
+        for dbname in db.catalog.list_databases():
+            manifest["databases"][dbname] = []
+            for t in db.catalog.list_tables(dbname):
+                region = db._region_of(f"{dbname}.{t.name}")
+                host = region.scan_host()
+                import numpy as np
+                import pyarrow as pa
+
+                cols = {}
+                for c in t.schema:
+                    arr = host[c.name]
+                    cols[c.name] = pa.array(
+                        arr.astype(object) if arr.dtype == object else arr,
+                        type=c.to_arrow().type,
+                    )
+                table = pa.table(cols)
+                path = os.path.join(args.output_dir, f"{dbname}.{t.name}.parquet")
+                pq.write_table(table, path)
+                manifest["databases"][dbname].append({
+                    "table": t.name, "schema": t.schema.to_dict(),
+                    "rows": table.num_rows, "file": os.path.basename(path),
+                })
+                print(f"exported {dbname}.{t.name}: {table.num_rows} rows")
+        with open(os.path.join(args.output_dir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+    finally:
+        db.close()
+    return 0
+
+
+def cmd_import(args) -> int:
+    import os
+
+    import pyarrow.parquet as pq
+
+    from greptimedb_tpu.datatypes.schema import Schema
+    from greptimedb_tpu.standalone import GreptimeDB
+
+    db = GreptimeDB(args.data_home)
+    try:
+        with open(os.path.join(args.input_dir, "manifest.json")) as f:
+            manifest = json.load(f)
+        for dbname, tables in manifest["databases"].items():
+            db.catalog.create_database(dbname, if_not_exists=True)
+            for entry in tables:
+                schema = Schema.from_dict(entry["schema"])
+                info = db.catalog.create_table(
+                    dbname, entry["table"], schema, if_not_exists=True
+                )
+                if info is not None:
+                    db.regions.create_region(info.region_ids[0], schema)
+                table = pq.read_table(os.path.join(args.input_dir, entry["file"]))
+                region = db._region_of(f"{dbname}.{entry['table']}")
+                data = {}
+                for c in schema:
+                    col = table.column(c.name)
+                    if c.dtype.is_string_like:
+                        data[c.name] = col.to_pylist()
+                    elif c.dtype.is_timestamp:
+                        data[c.name] = col.to_numpy(zero_copy_only=False).astype("int64")
+                    else:
+                        data[c.name] = col.to_numpy(zero_copy_only=False)
+                if table.num_rows:
+                    region.write(data)
+                print(f"imported {dbname}.{entry['table']}: {table.num_rows} rows")
+    finally:
+        db.close()
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import os
+    import subprocess
+
+    bench = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"
+    )
+    return subprocess.call([sys.executable, bench])
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="greptime-tpu",
+                                description="TPU-native observability database")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    ps = sub.add_parser("standalone", help="run the standalone server")
+    ps.add_argument("action", choices=["start"])
+    ps.add_argument("-c", "--config", help="TOML config file")
+    ps.add_argument("--data-home")
+    ps.add_argument("--http-addr")
+    ps.set_defaults(fn=cmd_standalone)
+
+    pq_ = sub.add_parser("sql", help="SQL shell / one-shot query")
+    pq_.add_argument("--data-home", required=True)
+    pq_.add_argument("-e", "--execute", help="run one statement and exit")
+    pq_.set_defaults(fn=cmd_sql)
+
+    pe = sub.add_parser("export", help="export all data to parquet")
+    pe.add_argument("--data-home", required=True)
+    pe.add_argument("--output-dir", required=True)
+    pe.set_defaults(fn=cmd_export)
+
+    pi = sub.add_parser("import", help="import a previous export")
+    pi.add_argument("--data-home", required=True)
+    pi.add_argument("--input-dir", required=True)
+    pi.set_defaults(fn=cmd_import)
+
+    pb = sub.add_parser("bench", help="run the TSBS benchmark")
+    pb.set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,238 @@
+"""information_schema: live system introspection tables.
+
+Reference: src/catalog/src/system_schema/information_schema/ exposes 20+
+virtual tables (SURVEY.md §2.3/§5.5). Round-1 set: schemata, tables,
+columns, partitions, region_statistics, flows, build_info, cluster_info,
+engines, key_column_usage.
+"""
+
+from __future__ import annotations
+
+import time
+
+from greptimedb_tpu.errors import TableNotFound
+from greptimedb_tpu.query.ast import Select
+from greptimedb_tpu.query.engine import QueryResult
+from greptimedb_tpu.query.virtual import execute_virtual_select
+
+INFORMATION_SCHEMA = "information_schema"
+
+
+def is_information_schema(table: str | None) -> bool:
+    return bool(table) and table.lower().startswith(INFORMATION_SCHEMA + ".")
+
+
+def execute(db, sel: Select) -> QueryResult:
+    name = sel.table.split(".", 1)[1].lower()
+    builder = _TABLES.get(name)
+    if builder is None:
+        raise TableNotFound(f"information_schema.{name}")
+    columns, types = builder(db)
+    return execute_virtual_select(sel, columns, types)
+
+
+def _columns_of(rows: list[dict], names: list[str]) -> dict[str, list]:
+    return {n: [r.get(n) for r in rows] for n in names}
+
+
+def _schemata(db):
+    rows = [
+        {"catalog_name": "greptime", "schema_name": d, "default_character_set_name": "utf8",
+         "default_collation_name": "utf8_bin"}
+        for d in db.catalog.list_databases()
+    ] + [{"catalog_name": "greptime", "schema_name": INFORMATION_SCHEMA,
+          "default_character_set_name": "utf8", "default_collation_name": "utf8_bin"}]
+    names = ["catalog_name", "schema_name", "default_character_set_name",
+             "default_collation_name"]
+    return _columns_of(rows, names), {n: "String" for n in names}
+
+
+def _tables(db):
+    rows = []
+    for d in db.catalog.list_databases():
+        for t in db.catalog.list_tables(d):
+            rows.append({
+                "table_catalog": "greptime", "table_schema": d,
+                "table_name": t.name, "table_type": "BASE TABLE",
+                "table_id": t.table_id, "engine": t.engine,
+                "region_count": len(t.region_ids),
+            })
+    for vt in sorted(_TABLES):
+        rows.append({
+            "table_catalog": "greptime", "table_schema": INFORMATION_SCHEMA,
+            "table_name": vt, "table_type": "LOCAL TEMPORARY",
+            "table_id": None, "engine": None, "region_count": 0,
+        })
+    names = ["table_catalog", "table_schema", "table_name", "table_type",
+             "table_id", "engine", "region_count"]
+    types = {n: "String" for n in names}
+    types.update({"table_id": "UInt32", "region_count": "Int64"})
+    return _columns_of(rows, names), types
+
+
+def _columns(db):
+    rows = []
+    for d in db.catalog.list_databases():
+        for t in db.catalog.list_tables(d):
+            for i, c in enumerate(t.schema):
+                rows.append({
+                    "table_catalog": "greptime", "table_schema": d,
+                    "table_name": t.name, "column_name": c.name,
+                    "ordinal_position": i + 1,
+                    "data_type": c.dtype.value.lower(),
+                    "semantic_type": c.semantic.value,
+                    "is_nullable": "Yes" if c.nullable else "No",
+                    "column_default": c.default,
+                })
+    names = ["table_catalog", "table_schema", "table_name", "column_name",
+             "ordinal_position", "data_type", "semantic_type", "is_nullable",
+             "column_default"]
+    types = {n: "String" for n in names}
+    types["ordinal_position"] = "Int64"
+    return _columns_of(rows, names), types
+
+
+def _region_statistics(db):
+    rows = []
+    for d in db.catalog.list_databases():
+        for t in db.catalog.list_tables(d):
+            for rid in t.region_ids:
+                region = db.regions.regions.get(rid)
+                if region is None:
+                    try:
+                        region = db.regions.open_region(rid)
+                    except Exception:  # noqa: BLE001
+                        continue
+                sst_rows = sum(m.num_rows for m in region.sst_files)
+                sst_size = sum(m.size_bytes for m in region.sst_files)
+                rows.append({
+                    "region_id": rid, "table_id": t.table_id,
+                    "region_number": rid % 1024, "region_rows":
+                        sst_rows + region.memtable.num_rows,
+                    "disk_size": sst_size, "memtable_size": region.memtable.bytes,
+                    "sst_size": sst_size, "sst_num": len(region.sst_files),
+                    "index_size": 0, "manifest_size": 0, "engine": t.engine,
+                    "region_role": "Leader",
+                })
+    names = ["region_id", "table_id", "region_number", "region_rows",
+             "disk_size", "memtable_size", "sst_size", "sst_num", "index_size",
+             "manifest_size", "engine", "region_role"]
+    types = {n: "UInt64" for n in names}
+    types.update({"engine": "String", "region_role": "String"})
+    return _columns_of(rows, names), types
+
+
+def _partitions(db):
+    rows = []
+    for d in db.catalog.list_databases():
+        for t in db.catalog.list_tables(d):
+            for i, rid in enumerate(t.region_ids):
+                expr = (
+                    t.partition_exprs[i]
+                    if i < len(t.partition_exprs) else None
+                )
+                rows.append({
+                    "table_catalog": "greptime", "table_schema": d,
+                    "table_name": t.name, "partition_name": f"p{i}",
+                    "partition_expression": expr, "greptime_partition_id": rid,
+                })
+    names = ["table_catalog", "table_schema", "table_name", "partition_name",
+             "partition_expression", "greptime_partition_id"]
+    types = {n: "String" for n in names}
+    types["greptime_partition_id"] = "UInt64"
+    return _columns_of(rows, names), types
+
+
+def _flows(db):
+    rows = []
+    for t in db.flow_engine.list_flows():
+        rows.append({
+            "flow_name": t.name, "flow_id": None, "state_size": None,
+            "table_catalog": "greptime", "flow_definition": None,
+            "comment": t.comment, "expire_after":
+                t.expire_after_ms // 1000 if t.expire_after_ms else None,
+            "source_table_names": t.source_table, "sink_table_name": t.sink_table,
+            "last_execution_time": t.last_run_ms or None,
+        })
+    names = ["flow_name", "flow_id", "state_size", "table_catalog",
+             "flow_definition", "comment", "expire_after",
+             "source_table_names", "sink_table_name", "last_execution_time"]
+    return _columns_of(rows, names), {n: "String" for n in names}
+
+
+def _build_info(db):
+    rows = [{
+        "git_branch": "main", "git_commit": "tpu-native", "git_commit_short":
+            "tpu", "git_clean": "true", "pkg_version": "0.1.0",
+    }]
+    names = ["git_branch", "git_commit", "git_commit_short", "git_clean",
+             "pkg_version"]
+    return _columns_of(rows, names), {n: "String" for n in names}
+
+
+def _cluster_info(db):
+    import jax
+
+    rows = [{
+        "peer_id": 0, "peer_type": "STANDALONE", "peer_addr": "",
+        "version": "0.1.0", "git_commit": "tpu-native",
+        "start_time": None, "uptime": None, "active_time": None,
+        "node_status": f"devices={len(jax.devices())}",
+    }]
+    names = ["peer_id", "peer_type", "peer_addr", "version", "git_commit",
+             "start_time", "uptime", "active_time", "node_status"]
+    types = {n: "String" for n in names}
+    types["peer_id"] = "Int64"
+    return _columns_of(rows, names), types
+
+
+def _engines(db):
+    rows = [
+        {"engine": "mito", "support": "DEFAULT",
+         "comment": "TPU-native LSM storage engine", "transactions": "NO",
+         "xa": "NO", "savepoints": "NO"},
+        {"engine": "metric", "support": "YES",
+         "comment": "Metric multiplexing engine (planned)", "transactions": "NO",
+         "xa": "NO", "savepoints": "NO"},
+    ]
+    names = ["engine", "support", "comment", "transactions", "xa", "savepoints"]
+    return _columns_of(rows, names), {n: "String" for n in names}
+
+
+def _key_column_usage(db):
+    rows = []
+    for d in db.catalog.list_databases():
+        for t in db.catalog.list_tables(d):
+            pos = 1
+            for c in t.schema:
+                if c.is_tag or c.is_time_index:
+                    rows.append({
+                        "constraint_catalog": "def", "constraint_schema": d,
+                        "constraint_name": (
+                            "TIME INDEX" if c.is_time_index else "PRIMARY"
+                        ),
+                        "table_catalog": "greptime", "table_schema": d,
+                        "table_name": t.name, "column_name": c.name,
+                        "ordinal_position": pos,
+                    })
+                    pos += 1
+    names = ["constraint_catalog", "constraint_schema", "constraint_name",
+             "table_catalog", "table_schema", "table_name", "column_name",
+             "ordinal_position"]
+    types = {n: "String" for n in names}
+    types["ordinal_position"] = "UInt32"
+    return _columns_of(rows, names), types
+
+
+_TABLES = {
+    "schemata": _schemata,
+    "tables": _tables,
+    "columns": _columns,
+    "region_statistics": _region_statistics,
+    "partitions": _partitions,
+    "flows": _flows,
+    "build_info": _build_info,
+    "cluster_info": _cluster_info,
+    "engines": _engines,
+    "key_column_usage": _key_column_usage,
+}

@@ -1,0 +1,121 @@
+"""ctypes bindings for the native IO library (optional accelerator).
+
+``lib()`` returns the loaded library or None; callers keep pure-python
+fallbacks. Build with ``make -C greptimedb_tpu/native`` (g++ only, no
+external deps — see greptime_native.cpp).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_LIB = None
+_TRIED = False
+
+_DIR = os.path.dirname(__file__)
+_SO = os.path.join(_DIR, "libgreptime_native.so")
+
+
+class GtWalSpan(ctypes.Structure):
+    _fields_ = [
+        ("seq", ctypes.c_uint64),
+        ("payload_off", ctypes.c_uint64),
+        ("payload_len", ctypes.c_uint64),
+    ]
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile the library in place; returns success."""
+    try:
+        r = subprocess.run(
+            ["make", "-C", _DIR],
+            capture_output=quiet, timeout=120,
+        )
+        return r.returncode == 0 and os.path.exists(_SO)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def lib():
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.exists(_SO):
+        # never compile on a hot path (region open, request handling) —
+        # the library is built by `make -C greptimedb_tpu/native` or an
+        # explicit native.build() call
+        return None
+    try:
+        l = ctypes.CDLL(_SO)
+        l.gt_crc32.restype = ctypes.c_uint32
+        l.gt_crc32.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        l.gt_snappy_length.restype = ctypes.c_int64
+        l.gt_snappy_length.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        l.gt_snappy_decompress.restype = ctypes.c_int
+        l.gt_snappy_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        l.gt_wal_scan.restype = ctypes.c_int64
+        l.gt_wal_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
+            ctypes.POINTER(GtWalSpan), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        _LIB = l
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+# ---- typed wrappers (None-safe: callers check availability) ---------------
+
+def crc32(data: bytes) -> int | None:
+    l = lib()
+    if l is None:
+        return None
+    return l.gt_crc32(data, len(data))
+
+
+def snappy_decompress(data: bytes) -> bytes | None:
+    l = lib()
+    if l is None or not data:
+        return None
+    n = l.gt_snappy_length(data, len(data))
+    if n < 0 or n > 1 << 31:
+        raise ValueError("bad snappy header")
+    out = ctypes.create_string_buffer(max(int(n), 1))
+    out_len = ctypes.c_size_t(0)
+    rc = l.gt_snappy_decompress(data, len(data), out, n, ctypes.byref(out_len))
+    if rc != 0:
+        raise ValueError(f"snappy decompress failed ({rc})")
+    if out_len.value != n:
+        raise ValueError(
+            f"snappy length mismatch: got {out_len.value}, expected {n}"
+        )
+    return out.raw[: out_len.value]
+
+
+def wal_scan(buf: bytes, min_seq: int) -> tuple[list[tuple[int, int, int]], int] | None:
+    """Returns ([(seq, payload_off, payload_len)], good_end) or None."""
+    l = lib()
+    if l is None:
+        return None
+    cap = max(len(buf) // 16, 16)
+    while True:
+        spans = (GtWalSpan * cap)()
+        good_end = ctypes.c_size_t(0)
+        n = l.gt_wal_scan(buf, len(buf), min_seq, spans, cap,
+                          ctypes.byref(good_end))
+        if n < 0:
+            cap *= 2
+            continue
+        return (
+            [(spans[i].seq, spans[i].payload_off, spans[i].payload_len)
+             for i in range(n)],
+            good_end.value,
+        )

@@ -1,0 +1,189 @@
+// Native IO hot paths for greptimedb_tpu.
+//
+// The reference implements its entire runtime in Rust; here the compute
+// path is JAX/XLA and the IO-bound runtime pieces that profile hot in
+// Python move to C++ (SURVEY.md §7.1: storage/WAL stay CPU-side, native):
+//   - CRC32 (zlib polynomial) for WAL record integrity
+//   - Snappy raw-format decompression (Prometheus remote write bodies)
+//   - WAL segment scanning: frame validation + torn-tail detection
+//
+// Build: make -C greptimedb_tpu/native      (produces libgreptime_native.so)
+// Bound via ctypes (greptimedb_tpu/native/__init__.py); every entry point
+// has a pure-python fallback so the library is an accelerator, not a
+// dependency.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32 (IEEE 802.3, zlib-compatible)
+// ---------------------------------------------------------------------------
+
+static uint32_t crc_table[8][256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[0][i] = c;
+  }
+  // slicing-by-8 tables
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = crc_table[0][i];
+    for (int s = 1; s < 8; s++) {
+      c = crc_table[0][c & 0xFF] ^ (c >> 8);
+      crc_table[s][i] = c;
+    }
+  }
+  crc_init_done = true;
+}
+
+uint32_t gt_crc32(const uint8_t* data, size_t len) {
+  crc_init();
+  uint32_t crc = 0xFFFFFFFFu;
+  // slicing-by-8 main loop
+  while (len >= 8) {
+    uint32_t lo;
+    uint32_t hi;
+    memcpy(&lo, data, 4);
+    memcpy(&hi, data + 4, 4);
+    lo ^= crc;
+    crc = crc_table[7][lo & 0xFF] ^ crc_table[6][(lo >> 8) & 0xFF] ^
+          crc_table[5][(lo >> 16) & 0xFF] ^ crc_table[4][lo >> 24] ^
+          crc_table[3][hi & 0xFF] ^ crc_table[2][(hi >> 8) & 0xFF] ^
+          crc_table[1][(hi >> 16) & 0xFF] ^ crc_table[0][hi >> 24];
+    data += 8;
+    len -= 8;
+  }
+  while (len--) crc = crc_table[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// Snappy raw format decompression
+// ---------------------------------------------------------------------------
+
+// Returns decompressed length from the header uvarint, or -1 on error.
+int64_t gt_snappy_length(const uint8_t* in, size_t in_len) {
+  uint64_t result = 0;
+  int shift = 0;
+  size_t pos = 0;
+  while (pos < in_len && shift <= 63) {
+    uint8_t b = in[pos++];
+    result |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) return static_cast<int64_t>(result);
+    shift += 7;
+  }
+  return -1;
+}
+
+// 0 = ok; negative = error. out must hold gt_snappy_length() bytes.
+int gt_snappy_decompress(const uint8_t* in, size_t in_len, uint8_t* out,
+                         size_t out_cap, size_t* out_len) {
+  size_t pos = 0;
+  // skip the length varint
+  while (pos < in_len && (in[pos] & 0x80)) pos++;
+  if (pos >= in_len) return -1;
+  pos++;
+  size_t o = 0;
+  while (pos < in_len) {
+    uint8_t tag = in[pos++];
+    uint32_t elem = tag & 0x03;
+    if (elem == 0) {  // literal
+      size_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        size_t extra = len - 60;
+        if (pos + extra > in_len) return -2;
+        len = 0;
+        for (size_t i = 0; i < extra; i++) len |= static_cast<size_t>(in[pos + i]) << (8 * i);
+        len += 1;
+        pos += extra;
+      }
+      if (pos + len > in_len || o + len > out_cap) return -3;
+      memcpy(out + o, in + pos, len);
+      pos += len;
+      o += len;
+      continue;
+    }
+    size_t len;
+    size_t offset;
+    if (elem == 1) {
+      len = ((tag >> 2) & 0x07) + 4;
+      if (pos >= in_len) return -4;
+      offset = (static_cast<size_t>(tag >> 5) << 8) | in[pos++];
+    } else if (elem == 2) {
+      len = (tag >> 2) + 1;
+      if (pos + 2 > in_len) return -5;
+      offset = in[pos] | (static_cast<size_t>(in[pos + 1]) << 8);
+      pos += 2;
+    } else {
+      len = (tag >> 2) + 1;
+      if (pos + 4 > in_len) return -6;
+      offset = 0;
+      for (int i = 0; i < 4; i++) offset |= static_cast<size_t>(in[pos + i]) << (8 * i);
+      pos += 4;
+    }
+    if (offset == 0 || offset > o || o + len > out_cap) return -7;
+    if (offset >= len) {
+      memcpy(out + o, out + o - offset, len);
+      o += len;
+    } else {
+      // overlapping: byte-wise (run-length semantics)
+      for (size_t i = 0; i < len; i++) {
+        out[o] = out[o - offset];
+        o++;
+      }
+    }
+  }
+  *out_len = o;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// WAL segment scan: [u32 len][u32 crc][u64 seq][payload] frames
+// ---------------------------------------------------------------------------
+
+struct GtWalSpan {
+  uint64_t seq;
+  uint64_t payload_off;
+  uint64_t payload_len;
+};
+
+// Scans frames, validating CRCs. Returns the number of valid frames with
+// seq >= min_seq written to spans (up to max_spans), and sets *good_end to
+// the byte offset after the last valid frame (torn-tail truncation point).
+// A negative return means spans overflowed (call again with more room).
+int64_t gt_wal_scan(const uint8_t* buf, size_t len, uint64_t min_seq,
+                    GtWalSpan* spans, size_t max_spans, size_t* good_end) {
+  size_t off = 0;
+  size_t n = 0;
+  *good_end = 0;
+  while (off + 16 <= len) {
+    uint32_t rec_len;
+    uint32_t crc;
+    uint64_t seq;
+    memcpy(&rec_len, buf + off, 4);
+    memcpy(&crc, buf + off + 4, 4);
+    memcpy(&seq, buf + off + 8, 8);
+    size_t end = off + 16 + rec_len;
+    if (end > len) break;
+    if (gt_crc32(buf + off + 16, rec_len) != crc) break;
+    if (seq >= min_seq) {
+      if (n >= max_spans) return -static_cast<int64_t>(n);
+      spans[n].seq = seq;
+      spans[n].payload_off = off + 16;
+      spans[n].payload_len = rec_len;
+      n++;
+    }
+    off = end;
+    *good_end = end;
+  }
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"

@@ -25,6 +25,8 @@ class QueryResult:
     column_names: list[str]
     rows: list[list]
     affected_rows: int = 0
+    # greptime type names per column (e.g. "Float64", "TimestampMillisecond")
+    column_types: list[str] | None = None
 
     @property
     def num_rows(self) -> int:
@@ -210,7 +212,63 @@ class QueryEngine:
                 v = out_cols[name][i]
                 row.append(_pyval(v))
             rows.append(row)
-        return QueryResult(names, rows)
+        return QueryResult(names, rows, column_types=[
+            _infer_type(item.expr, plan) for item in items
+        ])
+
+
+def _infer_type(expr, plan: SelectPlan) -> str:
+    """Greptime type name for an output expression (best effort)."""
+    from greptimedb_tpu.query.ast import (
+        BinaryOp, Case, Cast, Column, FuncCall, Literal,
+    )
+
+    ctx = plan.ctx
+    for k in plan.group_keys:
+        if str(k.expr) == str(expr):
+            if k.kind == "tag":
+                return "String"
+            if k.kind == "time":
+                return ctx.schema.time_index.dtype.value if ctx.schema.time_index else "Int64"
+    if isinstance(expr, Column):
+        try:
+            return ctx.schema.column(ctx.resolve(expr.name)).dtype.value
+        except Exception:  # noqa: BLE001
+            return "String"
+    if isinstance(expr, FuncCall):
+        if expr.name == "count":
+            return "Int64"
+        if expr.name in ("sum", "min", "max", "first_value", "last_value"):
+            if expr.args and isinstance(expr.args[0], Column):
+                return _infer_type(expr.args[0], plan)
+            return "Float64"
+        if expr.name in ("date_bin", "date_trunc"):
+            return ctx.schema.time_index.dtype.value if ctx.schema.time_index else "Int64"
+        return "Float64"
+    if isinstance(expr, Literal):
+        v = expr.value
+        if isinstance(v, bool):
+            return "Boolean"
+        if isinstance(v, int):
+            return "Int64"
+        if isinstance(v, float):
+            return "Float64"
+        return "String"
+    if isinstance(expr, Cast):
+        from greptimedb_tpu.datatypes.types import ConcreteDataType
+
+        try:
+            return ConcreteDataType.parse(expr.type_name).value
+        except ValueError:
+            return "String"
+    if isinstance(expr, Case):
+        return "String"
+    if isinstance(expr, BinaryOp):
+        if expr.op.upper() in ("AND", "OR", "=", "!=", "<", "<=", ">", ">=",
+                               "LIKE", "ILIKE"):
+            return "Boolean"
+        return "Float64"
+    return "Float64"
 
 
 class _Reversed:

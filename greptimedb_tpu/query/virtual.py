@@ -1,0 +1,95 @@
+"""Virtual (system) table evaluation: host-side SELECT over generated rows.
+
+Backs information_schema (reference src/catalog/src/system_schema/ — 20+
+virtual tables): these tables are tiny and control-plane-owned, so they
+evaluate entirely on host numpy via the shared host expression evaluator;
+the device is never involved.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from greptimedb_tpu.errors import ColumnNotFound, PlanError, Unsupported
+from greptimedb_tpu.query.ast import Column, FuncCall, Select, Star
+from greptimedb_tpu.query.engine import QueryResult, _Reversed, _null_key, _pyval
+from greptimedb_tpu.query.exprs import eval_host, is_aggregate
+
+
+def execute_virtual_select(sel: Select, columns: dict[str, list],
+                           types: dict[str, str] | None = None) -> QueryResult:
+    """Evaluate a Select against host columns (no aggregates beyond
+    count(*); virtual tables are small enumerations)."""
+    names = list(columns.keys())
+    n = len(next(iter(columns.values()))) if columns else 0
+    env = {k: np.asarray(v, dtype=object) for k, v in columns.items()}
+
+    keep = np.ones(n, dtype=bool)
+    if sel.where is not None:
+        keep &= np.asarray(eval_host(sel.where, env, n), dtype=bool)
+    idx = np.nonzero(keep)[0]
+
+    if sel.group_by:
+        raise Unsupported("GROUP BY over system tables")
+    # count fast path (used by clients probing system tables)
+    if (
+        len(sel.items) == 1
+        and isinstance(sel.items[0].expr, FuncCall)
+        and sel.items[0].expr.name == "count"
+    ):
+        agg = sel.items[0].expr
+        if agg.args and not isinstance(agg.args[0], Star):
+            # count(col): SQL excludes NULLs
+            vals = np.asarray(
+                eval_host(agg.args[0], env, n), dtype=object
+            )[idx]
+            cnt = int(sum(1 for v in vals if v is not None))
+        else:
+            cnt = int(len(idx))
+        return QueryResult([sel.items[0].output_name], [[cnt]],
+                           column_types=["Int64"])
+    for item in sel.items:
+        if not isinstance(item.expr, Star) and is_aggregate(item.expr):
+            raise Unsupported("aggregates over system tables (except count)")
+
+    items = []
+    for item in sel.items:
+        if isinstance(item.expr, Star):
+            items.extend((name, Column(name)) for name in names)
+        else:
+            items.append((item.output_name, item.expr))
+
+    out_cols = {}
+    for out_name, expr in items:
+        v = eval_host(expr, env, n)
+        arr = np.asarray(v, dtype=object)
+        if arr.ndim == 0:
+            arr = np.full(n, arr.item(), dtype=object)
+        out_cols[out_name] = arr
+
+    if sel.order_by:
+        sort_cols = [
+            (np.asarray(eval_host(o.expr, env, n), dtype=object), o.asc,
+             o.nulls_first)
+            for o in sel.order_by
+        ]
+
+        def key_fn(i):
+            parts = []
+            for v, asc, nf in sort_cols:
+                nr, val = _null_key(v[i], asc, nf)
+                parts.append((nr, _Reversed(val) if not asc else val))
+            return tuple(parts)
+
+        idx = np.array(sorted(idx.tolist(), key=key_fn), dtype=np.int64)
+    if sel.offset:
+        idx = idx[sel.offset:]
+    if sel.limit is not None:
+        idx = idx[: sel.limit]
+
+    col_names = [name for name, _ in items]
+    rows = [[_pyval(out_cols[nm][i]) for nm in col_names] for i in idx.tolist()]
+    col_types = None
+    if types:
+        col_types = [types.get(nm, "String") for nm in col_names]
+    return QueryResult(col_names, rows, column_types=col_types)

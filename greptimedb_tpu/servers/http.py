@@ -46,10 +46,12 @@ M_INGEST_ROWS = telemetry.REGISTRY.counter(
 
 def _result_to_json(res: QueryResult, t0: float) -> dict:
     if res.column_names:
+        types = res.column_types or ["String"] * len(res.column_names)
         records = {
             "schema": {
                 "column_schemas": [
-                    {"name": n, "data_type": "unknown"} for n in res.column_names
+                    {"name": n, "data_type": t}
+                    for n, t in zip(res.column_names, types)
                 ]
             },
             "rows": res.rows,

@@ -44,6 +44,16 @@ class GreptimeDB(TableProvider):
         region_options: RegionOptions | None = None,
         cache_capacity_bytes: int = 8 << 30,
     ):
+        # sanity-check the accelerator backend: if the configured platform
+        # can't initialize (e.g. the TPU relay is down), fall back to CPU
+        # rather than failing every query
+        import jax as _jax
+
+        try:
+            _jax.devices()
+        except RuntimeError:
+            _jax.config.update("jax_platforms", "cpu")
+
         self.memory_mode = data_home is None
         if data_home is None:
             import tempfile
@@ -114,6 +124,20 @@ class GreptimeDB(TableProvider):
 
     def execute_statement(self, stmt: Statement) -> QueryResult:
         if isinstance(stmt, Select):
+            from greptimedb_tpu.meta import information_schema as info
+
+            if info.is_information_schema(stmt.table):
+                return info.execute(self, stmt)
+            if (
+                stmt.table
+                and "." not in stmt.table
+                and self.current_db == info.INFORMATION_SCHEMA
+            ):
+                import copy
+
+                sel = copy.copy(stmt)
+                sel.table = f"{info.INFORMATION_SCHEMA}.{stmt.table}"
+                return info.execute(self, sel)
             return self.engine.execute_select(stmt)
         if isinstance(stmt, Tql):
             return self._execute_tql(stmt)
@@ -139,20 +163,32 @@ class GreptimeDB(TableProvider):
         if isinstance(stmt, AlterTable):
             return self._alter_table(stmt)
         if isinstance(stmt, ShowDatabases):
-            rows = [[d] for d in self.catalog.list_databases()
-                    if _like(d, stmt.like)]
+            from greptimedb_tpu.meta import information_schema as info
+
+            names = self.catalog.list_databases() + [info.INFORMATION_SCHEMA]
+            rows = [[d] for d in sorted(names) if _like(d, stmt.like)]
             return QueryResult(["Databases"], rows)
         if isinstance(stmt, ShowTables):
+            from greptimedb_tpu.meta import information_schema as info
+
             db = stmt.database or self.current_db
-            rows = [[t.name] for t in self.catalog.list_tables(db)
-                    if _like(t.name, stmt.like)]
+            if db == info.INFORMATION_SCHEMA:
+                rows = [[n] for n in sorted(info._TABLES)
+                        if _like(n, stmt.like)]
+            else:
+                rows = [[t.name] for t in self.catalog.list_tables(db)
+                        if _like(t.name, stmt.like)]
             return QueryResult(["Tables"], rows)
         if isinstance(stmt, ShowCreateTable):
             return self._show_create(stmt)
         if isinstance(stmt, DescribeTable):
             return self._describe(stmt)
         if isinstance(stmt, Use):
-            if not self.catalog.database_exists(stmt.database):
+            from greptimedb_tpu.meta import information_schema as info
+
+            if stmt.database != info.INFORMATION_SCHEMA and not (
+                self.catalog.database_exists(stmt.database)
+            ):
                 from greptimedb_tpu.errors import DatabaseNotFound
 
                 raise DatabaseNotFound(stmt.database)

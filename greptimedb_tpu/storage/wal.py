@@ -78,24 +78,34 @@ class FileLogStore(LogStore):
     def replay(self, from_sequence: int = 0):
         """Yield (sequence, payload) for entries with sequence >= from_sequence.
         Stops (and truncates) at the first torn/corrupt record."""
+        try:
+            from greptimedb_tpu import native
+        except ImportError:
+            native = None
         for seg in self._segments():
             path = self._seg_path(seg)
             with open(path, "rb") as f:
                 data = f.read()
-            off = 0
             good_end = 0
-            while off + _HDR.size <= len(data):
-                ln, crc, seq = _HDR.unpack_from(data, off)
-                end = off + _HDR.size + ln
-                if end > len(data):
-                    break
-                payload = data[off + _HDR.size : end]
-                if zlib.crc32(payload) != crc:
-                    break
-                good_end = end
-                off = end
-                if seq >= from_sequence:
-                    yield seq, payload
+            scanned = native.wal_scan(data, from_sequence) if native else None
+            if scanned is not None:
+                spans, good_end = scanned
+                for seq, off, ln in spans:
+                    yield seq, data[off:off + ln]
+            else:
+                off = 0
+                while off + _HDR.size <= len(data):
+                    ln, crc, seq = _HDR.unpack_from(data, off)
+                    end = off + _HDR.size + ln
+                    if end > len(data):
+                        break
+                    payload = data[off + _HDR.size : end]
+                    if zlib.crc32(payload) != crc:
+                        break
+                    good_end = end
+                    off = end
+                    if seq >= from_sequence:
+                        yield seq, payload
             if good_end < len(data):
                 # torn tail: truncate so future appends start clean
                 with open(path, "r+b") as f:

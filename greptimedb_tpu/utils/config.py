@@ -1,0 +1,99 @@
+"""Layered configuration: defaults → TOML file → env vars → CLI flags.
+
+Reference: the Configurable trait over the config crate (SURVEY.md §5.6,
+src/common/config/): env vars use the GREPTIMEDB_<ROLE>__SECTION__KEY
+convention with ``__`` as the section separator; later layers win.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields, is_dataclass
+
+
+@dataclass
+class HttpOptions:
+    addr: str = "127.0.0.1:4000"
+    timeout_s: float = 30.0
+    body_limit_mb: int = 64
+
+
+@dataclass
+class WalOptions:
+    provider: str = "file"  # file | noop
+    sync: bool = False
+
+
+@dataclass
+class StorageOptions:
+    data_home: str = "./greptimedb_data"
+    flush_threshold_mb: int = 256
+    compaction_window_hours: int = 24
+    compaction_trigger_files: int = 8
+    cache_capacity_gb: int = 8
+
+
+@dataclass
+class DeviceOptions:
+    platform: str = ""  # "" = jax default; "cpu" forces host
+    mesh_shards: int = 0  # 0 = all available devices
+
+
+@dataclass
+class StandaloneOptions:
+    node_id: int = 0
+    default_timezone: str = "UTC"
+    http: HttpOptions = field(default_factory=HttpOptions)
+    wal: WalOptions = field(default_factory=WalOptions)
+    storage: StorageOptions = field(default_factory=StorageOptions)
+    device: DeviceOptions = field(default_factory=DeviceOptions)
+
+
+def _apply_dict(obj, data: dict) -> None:
+    for f in fields(obj):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        cur = getattr(obj, f.name)
+        if is_dataclass(cur) and isinstance(v, dict):
+            _apply_dict(cur, v)
+        else:
+            setattr(obj, f.name, type(cur)(v) if cur is not None else v)
+
+
+def _apply_env(obj, prefix: str) -> None:
+    for f in fields(obj):
+        cur = getattr(obj, f.name)
+        key = f"{prefix}__{f.name.upper()}"
+        if is_dataclass(cur):
+            _apply_env(cur, key)
+        elif key in os.environ:
+            raw = os.environ[key]
+            if isinstance(cur, bool):
+                setattr(obj, f.name, raw.lower() in ("1", "true", "yes", "on"))
+            else:
+                setattr(obj, f.name, type(cur)(raw))
+
+
+def load_options(
+    config_file: str | None = None,
+    env_prefix: str = "GREPTIMEDB_STANDALONE",
+    overrides: dict | None = None,
+) -> StandaloneOptions:
+    opts = StandaloneOptions()
+    if config_file:
+        with open(config_file, "rb") as f:
+            _apply_dict(opts, tomllib.load(f))
+    _apply_env(opts, env_prefix)
+    if overrides:
+        _apply_dict(opts, overrides)
+    return opts
+
+
+def to_dict(obj) -> dict:
+    out = {}
+    for f in fields(obj):
+        v = getattr(obj, f.name)
+        out[f.name] = to_dict(v) if is_dataclass(v) else v
+    return out

@@ -32,6 +32,14 @@ def _read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
 def decompress(data: bytes) -> bytes:
     if not data:
         return b""
+    try:  # native fast path when the C++ library is built
+        from greptimedb_tpu import native
+
+        out = native.snappy_decompress(data)
+        if out is not None:
+            return out
+    except ImportError:
+        pass
     expected, pos = _read_uvarint(data, 0)
     out = bytearray()
     n = len(data)

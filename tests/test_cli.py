@@ -1,0 +1,66 @@
+"""CLI, config, export/import tests."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from greptimedb_tpu.utils.config import StandaloneOptions, load_options, to_dict
+
+
+class TestConfig:
+    def test_defaults(self):
+        o = load_options()
+        assert o.http.addr == "127.0.0.1:4000"
+        assert o.storage.flush_threshold_mb == 256
+
+    def test_toml_env_override_layers(self, tmp_path, monkeypatch):
+        cfg = tmp_path / "c.toml"
+        cfg.write_text("""
+node_id = 7
+[http]
+addr = "0.0.0.0:9999"
+[storage]
+flush_threshold_mb = 64
+""")
+        monkeypatch.setenv("GREPTIMEDB_STANDALONE__STORAGE__FLUSH_THRESHOLD_MB", "32")
+        monkeypatch.setenv("GREPTIMEDB_STANDALONE__WAL__SYNC", "true")
+        o = load_options(str(cfg))
+        assert o.node_id == 7
+        assert o.http.addr == "0.0.0.0:9999"
+        assert o.storage.flush_threshold_mb == 32  # env beats file
+        assert o.wal.sync is True
+        d = to_dict(o)
+        assert d["http"]["addr"] == "0.0.0.0:9999"
+
+
+class TestCliSql:
+    def test_one_shot_sql(self, tmp_path):
+        from greptimedb_tpu.cli import main
+
+        home = str(tmp_path / "home")
+        assert main(["sql", "--data-home", home, "-e",
+                     "CREATE TABLE t (a STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(a))"]) == 0
+        assert main(["sql", "--data-home", home, "-e",
+                     "INSERT INTO t VALUES ('x', 1000, 1.5)"]) == 0
+        assert main(["sql", "--data-home", home, "-e", "SELECT * FROM t"]) == 0
+
+    def test_export_import_roundtrip(self, tmp_path, capsys):
+        from greptimedb_tpu.cli import main
+
+        home = str(tmp_path / "h1")
+        out = str(tmp_path / "dump")
+        main(["sql", "--data-home", home, "-e",
+              "CREATE TABLE t (a STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(a))"])
+        main(["sql", "--data-home", home, "-e",
+              "INSERT INTO t VALUES ('x', 1000, 1.5), ('y', 2000, 2.5)"])
+        assert main(["export", "--data-home", home, "--output-dir", out]) == 0
+        assert os.path.exists(os.path.join(out, "manifest.json"))
+
+        home2 = str(tmp_path / "h2")
+        assert main(["import", "--data-home", home2, "--input-dir", out]) == 0
+        main(["sql", "--data-home", home2, "-e", "SELECT a, v FROM t ORDER BY a"])
+        text = capsys.readouterr().out
+        assert "x" in text and "2.5" in text

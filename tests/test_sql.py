@@ -345,3 +345,57 @@ class TestSchemaEvolutionRegressions:
         db.sql("INSERT INTO t (a, ts) VALUES ('z', 3000)")
         res = db.sql("SELECT a, v FROM t ORDER BY a")
         assert res.rows == [["x", 1.0], ["z", None]]
+
+
+class TestInformationSchema:
+    def test_tables_and_columns(self, cpu):
+        r = cpu.sql("SELECT table_name, engine FROM information_schema.tables"
+                    " WHERE table_schema = 'public'")
+        assert ["cpu", "mito"] in r.rows
+        r = cpu.sql(
+            "SELECT column_name, semantic_type FROM information_schema.columns"
+            " WHERE table_name = 'cpu' ORDER BY ordinal_position")
+        assert r.rows[0] == ["hostname", "TAG"]
+        assert ["ts", "TIMESTAMP"] in r.rows
+
+    def test_region_statistics(self, cpu):
+        r = cpu.sql("SELECT region_rows FROM information_schema.region_statistics")
+        assert r.rows and r.rows[0][0] == 7
+
+    def test_use_information_schema(self, cpu):
+        cpu.sql("USE information_schema")
+        r = cpu.sql("SELECT count(*) FROM tables")
+        assert r.rows[0][0] > 0
+        cpu.sql("USE public")
+
+    def test_misc_tables(self, cpu):
+        assert cpu.sql("SELECT * FROM information_schema.build_info").num_rows == 1
+        assert cpu.sql("SELECT * FROM information_schema.cluster_info").num_rows == 1
+        assert cpu.sql("SELECT * FROM information_schema.schemata").num_rows >= 2
+        r = cpu.sql("SELECT constraint_name FROM information_schema.key_column_usage"
+                    " WHERE table_name = 'cpu'")
+        flat = [x[0] for x in r.rows]
+        assert "PRIMARY" in flat and "TIME INDEX" in flat
+
+    def test_column_types_threaded(self, cpu):
+        r = cpu.sql("SELECT hostname, count(*) c FROM cpu GROUP BY hostname")
+        assert r.column_types == ["String", "Int64"]
+        r2 = cpu.sql("SELECT hostname, max(usage_user) FROM cpu GROUP BY hostname")
+        assert r2.column_types == ["String", "Float64"]
+        r3 = cpu.sql("SELECT date_bin(INTERVAL '1 minute', ts) m, avg(usage_user)"
+                     " FROM cpu GROUP BY m")
+        assert r3.column_types == ["TimestampMillisecond", "Float64"]
+
+
+    def test_qualified_query_from_information_schema_db(self, cpu):
+        cpu.sql("USE information_schema")
+        r = cpu.sql("SELECT count(*) FROM public.cpu")
+        assert r.rows == [[7]]
+        assert cpu.sql("SHOW TABLES").rows[0][0] == "build_info"
+        assert ["information_schema"] in cpu.sql("SHOW DATABASES").rows
+        cpu.sql("USE public")
+
+    def test_count_col_excludes_nulls_virtual(self, cpu):
+        r = cpu.sql("SELECT count(table_id) FROM information_schema.tables")
+        r2 = cpu.sql("SELECT count(*) FROM information_schema.tables")
+        assert r.rows[0][0] < r2.rows[0][0]  # virtual tables have NULL ids
