@@ -32,6 +32,7 @@ class TableInfo:
     engine: str = "mito"
     options: dict = field(default_factory=dict)
     partition_exprs: list[str] = field(default_factory=list)
+    partition_columns: list[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -43,6 +44,7 @@ class TableInfo:
             "engine": self.engine,
             "options": self.options,
             "partition_exprs": self.partition_exprs,
+            "partition_columns": self.partition_columns,
         }
 
     @staticmethod
@@ -56,6 +58,7 @@ class TableInfo:
             engine=d.get("engine", "mito"),
             options=d.get("options", {}),
             partition_exprs=d.get("partition_exprs", []),
+            partition_columns=d.get("partition_columns", []),
         )
 
 
@@ -124,6 +127,7 @@ class CatalogManager:
         engine: str = "mito",
         options: dict | None = None,
         partition_exprs: list[str] | None = None,
+        partition_columns: list[str] | None = None,
         num_regions: int = 1,
         if_not_exists: bool = False,
     ) -> TableInfo | None:
@@ -145,6 +149,7 @@ class CatalogManager:
             engine=engine,
             options=options or {},
             partition_exprs=partition_exprs or [],
+            partition_columns=partition_columns or [],
         )
         self.kv.put_json(key, info.to_dict())
         return info

@@ -262,7 +262,12 @@ class SelectorData:
     """Host-side prepared state for one table used by selectors."""
 
     def __init__(self, db, table: str):
-        region = db._region_of(table)
+        # partitioned tables come back as a CombinedRegionView duck-typing
+        # the Region surface (encoders/_series/scan_host/num_series)
+        region = (
+            db._table_view(table) if hasattr(db, "_table_view")
+            else db._region_of(table)
+        )
         self.region = region
         self.table = db.cache.get(region)
         self.schema = region.schema

@@ -204,6 +204,7 @@ class CreateTable(Statement):
     if_not_exists: bool = False
     options: dict = field(default_factory=dict)
     partitions: list[str] = field(default_factory=list)
+    partition_columns: list[str] = field(default_factory=list)
     engine: str = "mito"
 
 

@@ -620,6 +620,7 @@ class Parser:
             engine = "mito"
             options: dict = {}
             partitions: list[str] = []
+            partition_columns: list[str] = []
             while True:
                 if self.eat_kw("ENGINE"):
                     self.eat(Tok.OP, "=")
@@ -662,10 +663,11 @@ class Parser:
                             seg_start = self.peek().pos + 1
                         self.next()
                     partitions = [e for e in exprs if e]
+                    partition_columns = on_cols
                 else:
                     break
             return CreateTable(name, cols, time_index, pks, ine, options,
-                               partitions, engine)
+                               partitions, partition_columns, engine)
         raise Unsupported(f"unsupported CREATE at {self.peek().pos}")
 
     def _if_not_exists(self) -> bool:

@@ -256,8 +256,9 @@ class HttpServer:
             values = set()
             for t in self.db.catalog.list_tables(self.db.current_db):
                 if any(c.name == name for c in t.schema.tag_columns):
-                    region = self.db._region_of(t.name)
-                    enc = region.encoders.get(name)
+                    # _table_view merges all partitions' dictionaries
+                    view = self.db._table_view(t.name)
+                    enc = view.encoders.get(name)
                     if enc:
                         values.update(str(v) for v in enc.values())
             return sorted(values)
@@ -501,8 +502,21 @@ def _ingest_columns(db, table: str, cols: dict) -> int:
                     column=ColumnDef(f, field_type(cols[f]).value),
                 ))
                 info = db.catalog.get_table(dbname, name)
-    region = db._region_of(f"{dbname}.{name}")
-    region.write(cols)
+    regions = db._regions_of(f"{dbname}.{name}")
+    if len(regions) == 1:
+        regions[0].write(cols)
+    else:
+        # partition routing (same as SQL INSERT; skipping it would dump all
+        # rows into region 0 and break cross-region dedup/DELETE)
+        import numpy as np
+
+        from greptimedb_tpu.parallel.partition import split_rows
+
+        cols_np = {c: np.asarray(v, dtype=object) for c, v in cols.items()}
+        parts = split_rows(db._partition_rule(f"{dbname}.{name}"), cols_np, n)
+        for pidx, row_idx in parts.items():
+            sub = {c: [cols[c][i] for i in row_idx] for c in cols}
+            regions[pidx].write(sub)
     if db.flow_engine.flows:
         db.flow_engine.on_write(name, cols["ts"])
         db.flow_engine.run_all()

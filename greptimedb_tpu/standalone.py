@@ -34,6 +34,86 @@ from greptimedb_tpu.storage.cache import RegionCacheManager
 from greptimedb_tpu.storage.region import RegionEngine, RegionOptions
 
 
+class CombinedRegionView:
+    """Frontend-side merge view over a partitioned table's regions.
+
+    The single-node analog of MergeScanExec (reference merge_scan.rs:210):
+    partial scans from every region concatenate on host, tag codes are
+    re-encoded into one table-wide dictionary space, and a global series id
+    is assigned — after which the query engine sees one DeviceTable exactly
+    as for an unpartitioned table. Duck-types the Region surface the cache
+    and planners consume (schema/encoders/_series/num_series/generation/
+    scan_host).
+    """
+
+    def __init__(self, table_key: str, regions: list):
+        self.table_key = table_key
+        self.regions = regions
+        self.schema = regions[0].schema
+        # strictly negative: disjoint from real region ids in the cache
+        self.region_id = -(abs(hash(table_key)) % (1 << 40)) - 1
+        self.encoders: dict[str, object] = {}
+        self._series: dict[tuple, int] = {}
+        self._built_for: tuple | None = None
+        self._refresh()
+
+    @property
+    def generation(self) -> int:
+        return sum(r.generation for r in self.regions) + len(self.regions)
+
+    @property
+    def tag_names(self) -> list[str]:
+        return [c.name for c in self.schema.tag_columns]
+
+    @property
+    def num_series(self) -> int:
+        self._refresh()
+        return len(self._series)
+
+    def _refresh(self) -> None:
+        """(Re)build combined dictionaries deterministically: region order,
+        then each region's insertion order — stable for append-only dicts."""
+        gen = tuple(r.generation for r in self.regions)
+        if self._built_for == gen:
+            return
+        from greptimedb_tpu.datatypes.batch import DictionaryEncoder
+
+        self.encoders = {name: DictionaryEncoder() for name in self.tag_names}
+        self._series = {}
+        for r in self.regions:
+            code_maps = {}
+            for name in self.tag_names:
+                enc = self.encoders[name]
+                code_maps[name] = [
+                    enc.get_or_insert(v) for v in r.encoders[name].values()
+                ]
+            for key, _tsid in sorted(r._series.items(), key=lambda kv: kv[1]):
+                gkey = tuple(
+                    code_maps[name][code]
+                    for name, code in zip(r.tag_names, key)
+                )
+                if gkey not in self._series:
+                    self._series[gkey] = len(self._series)
+        self._built_for = gen
+
+    def scan_host(self, ts_range=(None, None), columns=None):
+        import numpy as np
+
+        from greptimedb_tpu.storage.memtable import SEQ, TSID
+        from greptimedb_tpu.storage.region import Region
+
+        self._refresh()
+        parts = [r.scan_host(ts_range, columns) for r in self.regions]
+        names = list(parts[0].keys())
+        merged = {k: np.concatenate([p[k] for p in parts]) for k in names}
+        n = len(merged[SEQ])
+        # recompute a table-global tsid from raw tag values
+        merged[TSID] = Region._encode_tags(self, merged, n)
+        ts_name = self.schema.time_index.name
+        order = np.lexsort((merged[ts_name], merged[TSID]))
+        return {k: v[order] for k, v in merged.items()}
+
+
 class GreptimeDB(TableProvider):
     """The standalone instance: SQL in, results out."""
 
@@ -74,6 +154,7 @@ class GreptimeDB(TableProvider):
         self.cache = RegionCacheManager(cache_capacity_bytes)
         self.engine = QueryEngine(self)
         self.current_db = DEFAULT_DB
+        self._views: dict[str, CombinedRegionView] = {}
         from greptimedb_tpu.flow.engine import FlowEngine
 
         self.flow_engine = FlowEngine(self)
@@ -88,27 +169,65 @@ class GreptimeDB(TableProvider):
             return db, name
         return self.current_db, table
 
-    def _region_of(self, table: str):
-        db, name = self._split_name(table)
-        info = self.catalog.get_table(db, name)
-        region_id = info.region_ids[0]
+    def _open_or_create(self, region_id: int, schema):
         try:
             return self.regions.open_region(region_id)
         except Exception:
-            return self.regions.create_region(region_id, info.schema)
+            return self.regions.create_region(region_id, schema)
+
+    def _regions_of(self, table: str) -> list:
+        db, name = self._split_name(table)
+        info = self.catalog.get_table(db, name)
+        return [self._open_or_create(rid, info.schema) for rid in info.region_ids]
+
+    def _region_of(self, table: str):
+        return self._regions_of(table)[0]
+
+    def _table_view(self, table: str):
+        """Region for single-region tables; merge view for partitioned."""
+        regions = self._regions_of(table)
+        if len(regions) == 1:
+            return regions[0]
+        db, name = self._split_name(table)
+        key = f"{db}.{name}"
+        view = self._views.get(key)
+        if view is None or [r.region_id for r in view.regions] != [
+            r.region_id for r in regions
+        ]:
+            view = CombinedRegionView(key, regions)
+            self._views[key] = view
+        view._refresh()  # planning needs current combined dictionaries
+        return view
+
+    def _partition_rule(self, table: str):
+        from greptimedb_tpu.parallel.partition import PartitionRule
+
+        db, name = self._split_name(table)
+        info = self.catalog.get_table(db, name)
+        if info.partition_exprs:
+            return PartitionRule.from_sql(info.partition_columns,
+                                          info.partition_exprs)
+        return PartitionRule.hash_rule(
+            len(info.region_ids),
+            [c.name for c in info.schema.tag_columns],
+        )
 
     def table_context(self, table: str) -> TableContext:
-        region = self._region_of(table)
-        return TableContext(region.schema, region.encoders)
+        view = self._table_view(table)
+        return TableContext(view.schema, view.encoders)
 
     def device_table(self, table: str, plan: SelectPlan):
-        region = self._region_of(table)
-        dt = self.cache.get(region)
-        lo = region.memtable.ts_min
-        hi = region.memtable.ts_max
-        for m in region.sst_files:
-            lo = m.ts_min if lo is None else min(lo, m.ts_min)
-            hi = m.ts_max if hi is None else max(hi, m.ts_max)
+        view = self._table_view(table)
+        dt = self.cache.get(view)
+        regions = view.regions if isinstance(view, CombinedRegionView) else [view]
+        lo = hi = None
+        for region in regions:
+            if region.memtable.ts_min is not None:
+                lo = region.memtable.ts_min if lo is None else min(lo, region.memtable.ts_min)
+                hi = region.memtable.ts_max if hi is None else max(hi, region.memtable.ts_max)
+            for m in region.sst_files:
+                lo = m.ts_min if lo is None else min(lo, m.ts_min)
+                hi = m.ts_max if hi is None else max(hi, m.ts_max)
         return dt, (lo if lo is not None else 0, hi if hi is not None else 0)
 
     # ---- SQL entry -----------------------------------------------------
@@ -195,8 +314,8 @@ class GreptimeDB(TableProvider):
             self.current_db = stmt.database
             return QueryResult([], [])
         if isinstance(stmt, TruncateTable):
-            region = self._region_of(stmt.table)
-            region.truncate()
+            for region in self._regions_of(stmt.table):
+                region.truncate()
             return QueryResult([], [], affected_rows=0)
         if isinstance(stmt, (CreateFlow, DropFlow, ShowFlows)):
             return self._flow_statement(stmt)
@@ -234,10 +353,13 @@ class GreptimeDB(TableProvider):
             engine=stmt.engine,
             options=stmt.options,
             partition_exprs=stmt.partitions,
+            partition_columns=stmt.partition_columns,
+            num_regions=max(len(stmt.partitions), 1),
             if_not_exists=stmt.if_not_exists,
         )
         if info is not None:
-            self.regions.create_region(info.region_ids[0], schema)
+            for rid in info.region_ids:
+                self.regions.create_region(rid, schema)
         return QueryResult([], [], affected_rows=0)
 
     def _drop_table(self, stmt: DropTable) -> QueryResult:
@@ -269,19 +391,25 @@ class GreptimeDB(TableProvider):
         info.schema = new_schema
         self.catalog.update_table(info)
         # region schema change: flush current data then swap schema
-        region = self.regions.regions.get(info.region_ids[0])
-        if region is not None:
-            region.flush()
-            region.schema = new_schema
-            region.manifest.commit({"kind": "schema", "schema": new_schema.to_dict()})
-            region.memtable.schema = new_schema
-            self.cache.invalidate_region(region.region_id)
+        for rid in info.region_ids:
+            region = self.regions.regions.get(rid)
+            if region is not None:
+                region.flush()
+                region.schema = new_schema
+                region.manifest.commit(
+                    {"kind": "schema", "schema": new_schema.to_dict()}
+                )
+                region.memtable.schema = new_schema
+                self.cache.invalidate_region(region.region_id)
+        view = self._views.pop(f"{db}.{name}", None)
+        if view is not None:
+            self.cache.invalidate_region(view.region_id)
         return QueryResult([], [], affected_rows=0)
 
     # ---- DML -----------------------------------------------------------
     def _insert(self, stmt: Insert) -> QueryResult:
-        region = self._region_of(stmt.table)
-        schema = region.schema
+        regions = self._regions_of(stmt.table)
+        schema = regions[0].schema
         columns = stmt.columns or [c.name for c in schema]
         if any(not schema.has_column(c) for c in columns):
             bad = [c for c in columns if not schema.has_column(c)]
@@ -297,9 +425,26 @@ class GreptimeDB(TableProvider):
         # timestamp strings → epoch ints
         ts_name = schema.time_index.name
         if ts_name in data:
-            ctx = TableContext(schema, region.encoders)
+            ctx = TableContext(schema, regions[0].encoders)
             data[ts_name] = [ctx.ts_literal(v) for v in data[ts_name]]
-        region.write(data)
+        if len(regions) == 1:
+            regions[0].write(data)
+        else:
+            # route rows to partitions (reference split_rows, manager.rs:232)
+            import numpy as np
+
+            from greptimedb_tpu.parallel.partition import split_rows
+
+            rule = self._partition_rule(stmt.table)
+            cols_np = {c: np.asarray(v, dtype=object) for c, v in data.items()}
+            parts = split_rows(rule, cols_np, len(stmt.rows))
+            for pidx, row_idx in parts.items():
+                if pidx >= len(regions):
+                    raise InvalidArguments(
+                        f"partition index {pidx} out of range"
+                    )
+                sub = {c: [data[c][i] for i in row_idx] for c in columns}
+                regions[pidx].write(sub)
         if self.flow_engine.flows:
             # batching flows: mark dirty windows and re-evaluate synchronously
             # (the reference defers via eval_schedule; standalone runs inline)
@@ -309,7 +454,8 @@ class GreptimeDB(TableProvider):
 
     def _delete(self, stmt: Delete) -> QueryResult:
         """DELETE by exact key conjunction (tags + ts), the mito semantic."""
-        region = self._region_of(stmt.table)
+        regions = self._regions_of(stmt.table)
+        region = regions[0]
         ctx = TableContext(region.schema, region.encoders)
         from greptimedb_tpu.query.ast import BinaryOp, Column, Literal
 
@@ -338,7 +484,18 @@ class GreptimeDB(TableProvider):
         if ts_name not in eq:
             raise Unsupported("DELETE needs ts = <value>")
         data = {k: [ctx.ts_literal(v) if k == ts_name else v] for k, v in eq.items()}
-        region.delete(data)
+        if len(regions) == 1:
+            region.delete(data)
+        else:
+            import numpy as np
+
+            from greptimedb_tpu.parallel.partition import split_rows
+
+            rule = self._partition_rule(stmt.table)
+            cols_np = {c: np.asarray(v, dtype=object) for c, v in data.items()}
+            parts = split_rows(rule, cols_np, 1)
+            for pidx in parts:
+                regions[pidx].delete(data)
         return QueryResult([], [], affected_rows=1)
 
     # ---- introspection -------------------------------------------------

@@ -302,3 +302,20 @@ class TestReviewRegressions:
         t0 = time.time()
         assert snappy.decompress(snappy.compress(big)) == big
         assert time.time() - t0 < 2.0
+
+    def test_partitioned_ingest_and_label_values(self, server):
+        http(server, "/v1/sql", form={
+            "sql": "CREATE TABLE ppt (host STRING, ts TIMESTAMP(3) TIME INDEX,"
+                   " val DOUBLE, PRIMARY KEY (host))"
+                   " PARTITION ON COLUMNS (host) (host < 'm', host >= 'm')"})
+        lp = "ppt,host=alpha val=1 1000\nppt,host=zulu val=2 1000\n"
+        code, _ = http(server, "/v1/influxdb/write?precision=ms",
+                       method="POST", body=lp.encode())
+        assert code == 204
+        db = server.db
+        info = db.catalog.get_table("public", "ppt")
+        r1_hosts = set(db.regions.regions[info.region_ids[1]].scan_host()["host"])
+        assert "zulu" in r1_hosts  # routed, not dumped into region 0
+        code, raw = http(server, "/v1/prometheus/api/v1/label/host/values")
+        vals = json.loads(raw)["data"]
+        assert "alpha" in vals and "zulu" in vals

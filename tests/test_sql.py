@@ -399,3 +399,70 @@ class TestInformationSchema:
         r = cpu.sql("SELECT count(table_id) FROM information_schema.tables")
         r2 = cpu.sql("SELECT count(*) FROM information_schema.tables")
         assert r.rows[0][0] < r2.rows[0][0]  # virtual tables have NULL ids
+
+
+class TestPartitionedTables:
+    @pytest.fixture
+    def ptab(self, db):
+        db.sql(
+            "CREATE TABLE pt (host STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE,"
+            " PRIMARY KEY (host))"
+            " PARTITION ON COLUMNS (host) (host < 'm', host >= 'm')"
+        )
+        db.sql(
+            "INSERT INTO pt VALUES ('alpha', 1000, 1.0), ('zulu', 1000, 2.0),"
+            " ('beta', 2000, 3.0), ('november', 2000, 4.0)"
+        )
+        return db
+
+    def test_regions_created_and_routed(self, ptab):
+        info = ptab.catalog.get_table("public", "pt")
+        assert len(info.region_ids) == 2
+        r0 = ptab.regions.regions[info.region_ids[0]]
+        r1 = ptab.regions.regions[info.region_ids[1]]
+        h0 = set(r0.scan_host()["host"])
+        h1 = set(r1.scan_host()["host"])
+        assert h0 == {"alpha", "beta"} and h1 == {"zulu", "november"}
+
+    def test_merged_query(self, ptab):
+        r = ptab.sql("SELECT host, v FROM pt ORDER BY host")
+        assert r.rows == [["alpha", 1.0], ["beta", 3.0],
+                          ["november", 4.0], ["zulu", 2.0]]
+        r = ptab.sql("SELECT count(*), sum(v) FROM pt")
+        assert r.rows == [[4, 10.0]]
+        r = ptab.sql("SELECT host, max(v) FROM pt WHERE ts = 2000 GROUP BY host ORDER BY host")
+        assert r.rows == [["beta", 3.0], ["november", 4.0]]
+
+    def test_cross_partition_filter(self, ptab):
+        r = ptab.sql("SELECT count(*) FROM pt WHERE host IN ('alpha', 'zulu')")
+        assert r.rows == [[2]]
+
+    def test_partition_upsert(self, ptab):
+        ptab.sql("INSERT INTO pt VALUES ('zulu', 1000, 20.0)")
+        r = ptab.sql("SELECT v FROM pt WHERE host = 'zulu' AND ts = 1000")
+        assert r.rows == [[20.0]]
+
+    def test_information_schema_partitions(self, ptab):
+        r = ptab.sql(
+            "SELECT partition_name, partition_expression FROM"
+            " information_schema.partitions WHERE table_name = 'pt'"
+            " ORDER BY partition_name"
+        )
+        assert r.rows == [["p0", "host < 'm'"], ["p1", "host >= 'm'"]]
+
+    def test_partitioned_tql(self, ptab):
+        res = ptab.sql("TQL EVAL (1, 2, '1') pt")
+        hosts = {r[0] for r in res.rows}
+        assert hosts == {"alpha", "beta", "november", "zulu"}
+
+    def test_truncate_partitioned(self, ptab):
+        ptab.sql("TRUNCATE TABLE pt")
+        assert ptab.sql("SELECT count(*) FROM pt").rows == [[0]]
+
+    def test_alter_partitioned_invalidates_view_cache(self, ptab):
+        for rid in ptab.catalog.get_table("public", "pt").region_ids:
+            ptab.regions.regions[rid].flush()
+        ptab.sql("SELECT host, v FROM pt")  # populate the view cache
+        ptab.sql("ALTER TABLE pt ADD COLUMN extra DOUBLE")
+        r = ptab.sql("SELECT host, v, extra FROM pt ORDER BY host LIMIT 1")
+        assert r.rows == [["alpha", 1.0, None]]
