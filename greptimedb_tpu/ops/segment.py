@@ -195,6 +195,83 @@ def segment_first_last(
     return out_ts, out_val
 
 
+def sorted_segment_reduce(
+    values: jnp.ndarray,
+    seg_ids: jnp.ndarray,
+    num_segments: int,
+    op: str,
+    mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Scatter-free segment reduction for NONDECREASING seg_ids.
+
+    TPU lowers jax.ops.segment_* to scatter, which serializes badly; when
+    the group ids are sorted (data laid out by (series, time) with group
+    keys monotone in that order — the TSBS/PromQL hot path), the same
+    reductions become cumulative sums diffed at group boundaries
+    (sum/count/mean) or a segmented associative scan (min/max) — all
+    TPU-friendly primitives. Caller guarantees sortedness of the VALID
+    rows' ids; invalid rows may hold any id (they are neutralized).
+
+    Semantics identical to segment_reduce.
+    """
+    is_float = jnp.issubdtype(values.dtype, jnp.floating)
+    m = valid_mask(values, mask if mask is not None else jnp.ones(values.shape, bool))
+    m = m & (seg_ids >= 0) & (seg_ids < num_segments)
+    # out-of-range ids only occur in trailing padding rows (poisoned -1
+    # codes); route them past the last segment so the array stays sorted.
+    # WHERE-masked rows keep their (valid, sorted) ids and are neutralized
+    # by the mask in every accumulation below.
+    ids = jnp.where(
+        (seg_ids < 0) | (seg_ids >= num_segments), num_segments, seg_ids
+    ).astype(jnp.int32)
+
+    grid = jnp.arange(num_segments, dtype=jnp.int32)
+    # boundaries over the (sorted) id array
+    starts = jnp.searchsorted(ids, grid, side="left")
+    ends = jnp.searchsorted(ids, grid, side="right")
+
+    def cs(x):
+        return jnp.concatenate(
+            [jnp.zeros(1, x.dtype), jnp.cumsum(x)]
+        )
+
+    cnt = (cs(m.astype(jnp.int64))[ends] - cs(m.astype(jnp.int64))[starts])
+    if op == "count":
+        return cnt
+    if op in ("sum", "mean"):
+        v = values if is_float else values.astype(
+            jnp.int64 if op == "sum" else jnp.int64
+        )
+        s = cs(jnp.where(m, v, 0))[ends] - cs(jnp.where(m, v, 0))[starts]
+        if op == "sum":
+            return s
+        sf = s.astype(jnp.float32) if not is_float else s
+        return jnp.where(cnt > 0, sf / jnp.maximum(cnt, 1).astype(sf.dtype),
+                         jnp.nan)
+    if op in ("min", "max"):
+        if is_float:
+            fill = jnp.inf if op == "min" else -jnp.inf
+            v = jnp.where(m, values, fill)
+        else:
+            fill = _I64_MAX if op == "min" else _I64_MIN
+            v = jnp.where(m, values.astype(jnp.int64), fill)
+        combine = jnp.minimum if op == "min" else jnp.maximum
+
+        def seg_op(a, b):
+            # carry = (value, id); reset the running extreme at id changes
+            av, ai = a
+            bv, bi = b
+            keep = ai == bi
+            return jnp.where(keep, combine(av, bv), bv), bi
+
+        scanned, _ids = jax.lax.associative_scan(seg_op, (v, ids))
+        out = scanned[jnp.clip(ends - 1, 0, v.shape[0] - 1)]
+        if is_float:
+            return jnp.where(cnt > 0, out, jnp.nan)
+        return jnp.where(cnt > 0, out, 0)
+    raise ValueError(f"unknown sorted segment op: {op}")
+
+
 def compact_groups(
     combined_ids: jnp.ndarray, mask: jnp.ndarray, num_groups: int
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:

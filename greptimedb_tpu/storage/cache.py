@@ -42,6 +42,9 @@ class DeviceTable:
     row_mask: jnp.ndarray
     num_series: int
     dicts: dict[str, list] = field(default_factory=dict)
+    # tag columns whose codes are nondecreasing in row order — unlocks the
+    # scatter-free sorted segment reduction in the query executor
+    sorted_tags: tuple = ()
 
     @property
     def padded_rows(self) -> int:
@@ -60,14 +63,16 @@ class DeviceTable:
             tuple(names),
             self.num_series,
             tuple((k, tuple(v)) for k, v in sorted(self.dicts.items())),
+            tuple(self.sorted_tags),
         )
         return children, aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        names, num_series, dict_items = aux
+        names, num_series, dict_items, sorted_tags = aux
         cols = dict(zip(names, children[:-1]))
-        return cls(cols, children[-1], num_series, {k: list(v) for k, v in dict_items})
+        return cls(cols, children[-1], num_series,
+                   {k: list(v) for k, v in dict_items}, sorted_tags)
 
 
 def build_device_table(
@@ -116,7 +121,21 @@ def build_device_table(
             dev_cols[name] = jnp.asarray(out)
     mask = np.zeros(padded, dtype=bool)
     mask[:n] = True
-    return DeviceTable(dev_cols, jnp.asarray(mask), region.num_series, dicts)
+    # monotone tag detection: rows are (tsid, ts)-sorted; a tag qualifies
+    # for sorted segment reductions when its codes are nondecreasing AND
+    # bijective with series runs (each code run is exactly one tsid run, so
+    # ts — and hence any time bucket — is ascending within every code run)
+    sorted_tags = []
+    if n > 0:
+        tsid_runs = 1 + int((np.diff(np.asarray(dev_cols[TSID])[:n]) != 0).sum())
+        for c in schema.tag_columns:
+            if c.name in dev_cols:
+                codes = np.asarray(dev_cols[c.name])[:n]
+                d = np.diff(codes)
+                if bool((d >= 0).all()) and 1 + int((d != 0).sum()) == tsid_runs:
+                    sorted_tags.append(c.name)
+    return DeviceTable(dev_cols, jnp.asarray(mask), region.num_series, dicts,
+                       tuple(sorted_tags))
 
 
 class RegionCacheManager:

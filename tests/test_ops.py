@@ -237,3 +237,36 @@ class TestReviewRound2Regressions:
         m = jnp.zeros(2, bool)
         assert int(masked_reduce(v, m, "sum")) == 0
         assert int(masked_reduce(v, m, "min")) == 0
+
+
+class TestSortedSegmentReduce:
+    def test_equivalence_with_scatter(self, rng):
+        from greptimedb_tpu.ops.segment import sorted_segment_reduce
+
+        n, g = 5000, 37
+        ids = np.sort(rng.integers(0, g, n)).astype(np.int32)
+        vals = rng.normal(size=n).astype(np.float32)
+        vals[rng.random(n) < 0.05] = np.nan
+        mask = rng.random(n) > 0.1
+        # trailing padding with poisoned ids
+        ids = np.concatenate([ids, np.full(24, -1, np.int32)])
+        vals = np.concatenate([vals, np.zeros(24, np.float32)])
+        mask = np.concatenate([mask, np.zeros(24, bool)])
+        for op in ("sum", "count", "min", "max", "mean"):
+            want = np.asarray(segment_reduce(jnp.asarray(vals), jnp.asarray(ids),
+                                             g, op, jnp.asarray(mask)))
+            got = np.asarray(sorted_segment_reduce(
+                jnp.asarray(vals), jnp.asarray(ids), g, op, jnp.asarray(mask)))
+            np.testing.assert_allclose(got, want, rtol=1e-5, equal_nan=True,
+                                       err_msg=op)
+
+    def test_int_values(self):
+        from greptimedb_tpu.ops.segment import sorted_segment_reduce
+
+        ids = jnp.array([0, 0, 2, 2, 2], dtype=jnp.int32)
+        v = jnp.array([2**53, 1, 5, 3, 9], dtype=jnp.int64)
+        assert int(sorted_segment_reduce(v, ids, 3, "sum")[0]) == 2**53 + 1
+        got_min = np.asarray(sorted_segment_reduce(v, ids, 3, "min"))
+        assert got_min.tolist() == [1, 0, 3]
+        got_max = np.asarray(sorted_segment_reduce(v, ids, 3, "max"))
+        assert got_max.tolist() == [2**53, 0, 9]
