@@ -239,9 +239,7 @@ def sorted_segment_reduce(
     if op == "count":
         return cnt
     if op in ("sum", "mean"):
-        v = values if is_float else values.astype(
-            jnp.int64 if op == "sum" else jnp.int64
-        )
+        v = values if is_float else values.astype(jnp.int64)
         s = cs(jnp.where(m, v, 0))[ends] - cs(jnp.where(m, v, 0))[starts]
         if op == "sum":
             return s

@@ -186,13 +186,19 @@ class Executor:
         arg = agg.args[0]
         if (
             isinstance(arg, Column)
-            and ctx.is_tag(arg.name)
             and name not in ("count", "first_value", "last_value")
         ):
-            # tag columns are dictionary codes on device; numeric aggregation
-            # over them would sum codes, and lexicographic min/max needs a
-            # sorted dictionary — neither is implemented yet
-            raise Unsupported(f"{name}() over string tag column {arg.name}")
+            try:
+                col_schema = ctx.schema.column(ctx.resolve(arg.name))
+            except Exception:  # noqa: BLE001
+                col_schema = None
+            if col_schema is not None and (
+                col_schema.is_tag or col_schema.dtype.is_string_like
+            ):
+                # string columns (tags AND fields) are dictionary codes on
+                # device; numeric aggregation would aggregate codes, and
+                # lexicographic min/max needs a sorted dictionary
+                raise Unsupported(f"{name}() over string column {arg.name}")
         arg_fn = compile_device(arg, ctx)
         if name == "count":
             return lambda env, gid, ng, mask: seg_fn(
@@ -241,6 +247,7 @@ class Executor:
         @jax.jit
         def kernel(table: DeviceTable):
             env = dict(table.columns)
+            pad_mask = table.row_mask  # padding rows, pre-WHERE
             mask = table.row_mask
             if lo is not None and ts_name is not None:
                 mask = mask & (env[ts_name] >= lo)
@@ -272,9 +279,14 @@ class Executor:
                         step, start, nb = spec[1]
                         idx = bucket_index(env[ts_name], step, start)
                         if use_sorted:
-                            # out-of-range rows are already mask-excluded;
-                            # clamping (vs poisoning) preserves sortedness
-                            idx = jnp.clip(idx, 0, nb - 1)
+                            # WHERE-excluded rows clamp (keeps ids sorted and
+                            # they are mask-neutral); PADDING rows must still
+                            # poison — they trail, and clamping them to bucket
+                            # 0 would break sortedness and corrupt the min/max
+                            # scan's end-of-group reads on tag-less tables
+                            idx = jnp.where(
+                                pad_mask, jnp.clip(idx, 0, nb - 1), nb
+                            )
                         codes.append(idx)
                     ordered_cards.append(cards[i])
                 combined, _tot = combine_keys(codes, ordered_cards)
@@ -417,10 +429,13 @@ class Executor:
             col = ctx.schema.column(c) if ctx.schema.has_column(c) else None
             if col is not None and col.is_tag:
                 vals = ctx.encoders[c].values()
-                lookup = np.array(vals + [None], dtype=object)
-                codes = arr.astype(np.int64)
-                codes = np.where((codes < 0) | (codes >= len(vals)), len(vals), codes)
-                env[c] = lookup[codes]
+            elif c in table.dicts:  # dictionary-encoded string FIELD
+                vals = table.dicts[c]
             else:
                 env[c] = arr
+                continue
+            lookup = np.array(list(vals) + [None], dtype=object)
+            codes = arr.astype(np.int64)
+            codes = np.where((codes < 0) | (codes >= len(vals)), len(vals), codes)
+            env[c] = lookup[codes]
         return env, n

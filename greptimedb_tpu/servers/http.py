@@ -117,6 +117,8 @@ class HttpServer:
         r.add_post("/v1/prometheus/write", self.h_remote_write)
         r.add_post("/v1/influxdb/api/v2/write", self.h_influx_write)
         r.add_post("/v1/influxdb/write", self.h_influx_write)
+        r.add_post("/v1/otlp/v1/metrics", self.h_otlp_metrics)
+        r.add_post("/v1/loki/api/v1/push", self.h_loki_push)
         r.add_get("/health", self.h_health)
         r.add_get("/ready", self.h_health)
         r.add_get("/metrics", self.h_metrics)
@@ -336,6 +338,86 @@ class HttpServer:
         try:
             n = await self._call(run)
             M_INGEST_ROWS.labels("influxdb").inc(n)
+            return web.Response(status=204)
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_otlp_metrics(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.servers.otlp import parse_otlp_metrics
+
+        # aiohttp transparently inflates Content-Encoding: gzip on read()
+        try:
+            body = await request.read()
+        except Exception as e:  # noqa: BLE001 (bad content encoding etc.)
+            return web.json_response({"error": f"body: {e}"}, status=400)
+
+        def run():
+            tables = parse_otlp_metrics(body)
+            total = 0
+            for table, cols in tables.items():
+                total += _ingest_columns(self.db, table, cols)
+            return total
+
+        try:
+            n = await self._call(run)
+            M_INGEST_ROWS.labels("otlp_metrics").inc(n)
+            return web.json_response({"partialSuccess": {}})
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_loki_push(self, request: web.Request) -> web.Response:
+        """Loki JSON push (reference src/servers/src/http/loki.rs): streams
+        land in ``loki_logs`` with stream labels as tags and the line in
+        ``line`` (string field)."""
+        try:
+            body = await request.read()
+        except Exception as e:  # noqa: BLE001 (bad content encoding etc.)
+            return web.json_response({"error": f"body: {e}"}, status=400)
+        ctype = request.content_type or ""
+        if "json" not in ctype:
+            return web.json_response(
+                {"error": "only JSON Loki push is supported"}, status=400)
+        try:
+            payload = json.loads(body)
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": f"bad json: {e}"}, status=400)
+
+        def run():
+            rows: list[tuple[dict, str, int]] = []
+            for stream in payload.get("streams", []):
+                labels = {str(k): str(v) for k, v in
+                          (stream.get("stream") or {}).items()}
+                for entry in stream.get("values", []):
+                    from greptimedb_tpu.errors import InvalidArguments
+
+                    try:
+                        ts_ns = int(entry[0])
+                        line = str(entry[1])
+                    except (ValueError, TypeError, IndexError) as e:
+                        raise InvalidArguments(
+                            f"bad loki entry {entry!r}: {e}"
+                        ) from None
+                    rows.append((labels, line, ts_ns // 1_000_000))
+            if not rows:
+                return 0
+            tag_names = sorted({k for lab, _l, _t in rows for k in lab})
+            cols: dict[str, list] = {k: [] for k in tag_names}
+            cols["ts"] = []
+            cols["line"] = []
+            for lab, line, ts in rows:
+                for k in tag_names:
+                    cols[k].append(lab.get(k, ""))
+                cols["ts"].append(ts)
+                cols["line"].append(line)
+            cols["__tags__"] = tag_names
+            cols["__fields__"] = ["line"]
+            return _ingest_columns(self.db, "loki_logs", cols)
+
+        try:
+            n = await self._call(run)
+            M_INGEST_ROWS.labels("loki").inc(n)
             return web.Response(status=204)
         except Exception as e:  # noqa: BLE001
             body_json, status = _error_json(e)

@@ -1,0 +1,187 @@
+"""OTLP/HTTP metrics ingest: hand-rolled protobuf wire parsing.
+
+Reference: src/servers/src/otlp/metrics.rs — OTel metrics map to tables:
+gauge/sum data points land in a table named after the metric (attributes →
+tags, value → ``val``); histograms explode prometheus-style into
+``<name>_bucket`` (cumulative counts with an ``le`` tag), ``<name>_sum`` and
+``<name>_count`` tables, which makes ``histogram_quantile`` work unchanged.
+
+Wire schema walked here (opentelemetry-proto, metrics/v1):
+ExportMetricsServiceRequest.resource_metrics[1] → ResourceMetrics{
+resource[1]{attributes[1]}, scope_metrics[2]{metrics[2]}} → Metric{name[1],
+gauge[5]/sum[7]/histogram[9]} → NumberDataPoint{attributes[7],
+time_unix_nano[3], as_double[4], as_int[6]} / HistogramDataPoint{
+attributes[9], time_unix_nano[3], count[4], sum[5], bucket_counts[6],
+explicit_bounds[7]}.
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import defaultdict
+
+from greptimedb_tpu.servers.protocols import _pb_fields
+
+
+def _kv_attr(data: bytes) -> tuple[str, str]:
+    key = ""
+    value = ""
+    for f, _wt, v in _pb_fields(data):
+        if f == 1:
+            key = v.decode("utf-8")
+        elif f == 2:  # AnyValue
+            for f2, wt2, v2 in _pb_fields(v):
+                if f2 == 1:
+                    value = v2.decode("utf-8")
+                elif f2 == 2:
+                    value = "true" if v2 else "false"
+                elif f2 == 3:
+                    value = str(_signed(v2))
+                elif f2 == 4:
+                    value = repr(struct.unpack("<d", v2)[0])
+    return key, value
+
+
+def _signed(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _fixed64_f(v: bytes) -> float:
+    return struct.unpack("<d", v)[0]
+
+
+def _fixed64_u(v: bytes) -> int:
+    return struct.unpack("<Q", v)[0]
+
+
+def _packed_doubles(v: bytes) -> list[float]:
+    return [struct.unpack("<d", v[i:i + 8])[0] for i in range(0, len(v), 8)]
+
+
+def _packed_fixed64(v: bytes) -> list[int]:
+    return [struct.unpack("<Q", v[i:i + 8])[0] for i in range(0, len(v), 8)]
+
+
+def _number_point(data: bytes) -> tuple[dict, float, int]:
+    attrs: dict[str, str] = {}
+    val = float("nan")
+    ts_ms = 0
+    for f, wt, v in _pb_fields(data):
+        if f == 7:
+            k, a = _kv_attr(v)
+            attrs[k] = a
+        elif f == 3:
+            ts_ms = _fixed64_u(v) // 1_000_000
+        elif f == 4:
+            val = _fixed64_f(v)
+        elif f == 6:
+            # as_int: sfixed64
+            val = float(struct.unpack("<q", v)[0])
+    return attrs, val, ts_ms
+
+
+def _histogram_point(data: bytes):
+    attrs: dict[str, str] = {}
+    ts_ms = 0
+    count = 0
+    total = float("nan")
+    bucket_counts: list[int] = []
+    bounds: list[float] = []
+    for f, wt, v in _pb_fields(data):
+        if f == 9:
+            k, a = _kv_attr(v)
+            attrs[k] = a
+        elif f == 3:
+            ts_ms = _fixed64_u(v) // 1_000_000
+        elif f == 4:
+            count = _fixed64_u(v)
+        elif f == 5:
+            total = _fixed64_f(v)
+        elif f == 6:
+            bucket_counts = (
+                _packed_fixed64(v) if wt == 2 else bucket_counts + [v]
+            )
+        elif f == 7:
+            bounds = _packed_doubles(v) if wt == 2 else bounds
+    return attrs, ts_ms, count, total, bucket_counts, bounds
+
+
+def _norm(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    return "".join(out)
+
+
+def parse_otlp_metrics(body: bytes) -> dict[str, dict[str, list]]:
+    """ExportMetricsServiceRequest → per-table columnar dicts (same shape
+    the line-protocol/remote-write parsers emit)."""
+    rows: dict[str, list[tuple[dict, float, int]]] = defaultdict(list)
+    for f, _wt, rm in _pb_fields(body):
+        if f != 1:
+            continue
+        resource_attrs: dict[str, str] = {}
+        scope_metrics = []
+        for f2, _wt2, v2 in _pb_fields(rm):
+            if f2 == 1:  # Resource
+                for f3, _wt3, v3 in _pb_fields(v2):
+                    if f3 == 1:
+                        k, a = _kv_attr(v3)
+                        resource_attrs[k] = a
+            elif f2 == 2:
+                scope_metrics.append(v2)
+        for sm in scope_metrics:
+            for f3, _wt3, metric in _pb_fields(sm):
+                if f3 != 2:
+                    continue
+                name = ""
+                gauges = []
+                hists = []
+                for f4, _wt4, v4 in _pb_fields(metric):
+                    if f4 == 1:
+                        name = v4.decode("utf-8")
+                    elif f4 in (5, 7):  # gauge / sum: points in field 1
+                        for f5, _wt5, p in _pb_fields(v4):
+                            if f5 == 1:
+                                gauges.append(p)
+                    elif f4 == 9:  # histogram
+                        for f5, _wt5, p in _pb_fields(v4):
+                            if f5 == 1:
+                                hists.append(p)
+                if not name:
+                    continue
+                table = _norm(name)
+                for p in gauges:
+                    attrs, val, ts_ms = _number_point(p)
+                    merged = {**resource_attrs, **attrs}
+                    rows[table].append((merged, val, ts_ms))
+                for p in hists:
+                    attrs, ts_ms, count, total, bcounts, bounds = (
+                        _histogram_point(p)
+                    )
+                    merged = {**resource_attrs, **attrs}
+                    cum = 0
+                    for i, c in enumerate(bcounts):
+                        cum += c
+                        le = (
+                            repr(bounds[i]) if i < len(bounds) else "+Inf"
+                        )
+                        rows[f"{table}_bucket"].append(
+                            ({**merged, "le": le}, float(cum), ts_ms)
+                        )
+                    rows[f"{table}_sum"].append((merged, total, ts_ms))
+                    rows[f"{table}_count"].append((merged, float(count), ts_ms))
+
+    out: dict[str, dict[str, list]] = {}
+    for table, data in rows.items():
+        tag_names = sorted({k for tags, _v, _t in data for k in tags})
+        cols: dict[str, list] = {k: [] for k in tag_names}
+        cols["ts"] = []
+        cols["val"] = []
+        for tags, val, ts in data:
+            for k in tag_names:
+                cols[k].append(tags.get(k, ""))
+            cols["ts"].append(ts)
+            cols["val"].append(val)
+        out[table] = {"__tags__": tag_names, "__fields__": ["val"], **cols}
+    return out

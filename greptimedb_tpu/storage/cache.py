@@ -109,6 +109,26 @@ def build_device_table(
                 dev_cols[name] = jnp.asarray(out)
                 dicts[name] = enc.values()
                 continue
+            if c.dtype.is_string_like:
+                # string FIELD (log lines, json): ad-hoc dictionary per
+                # build — codes live on device, values in dicts for decode
+                from greptimedb_tpu.datatypes.batch import DictionaryEncoder
+
+                enc = DictionaryEncoder()
+                # NULL string fields become "" (np.unique cannot order None)
+                arr = np.array(
+                    ["" if v is None else v for v in arr], dtype=object
+                )
+                uniq, inv = np.unique(arr, return_inverse=True)
+                codes = np.fromiter(
+                    (enc.get_or_insert(v) for v in uniq), dtype=np.int32,
+                    count=len(uniq),
+                )
+                out = np.full(padded, -1, dtype=np.int32)
+                out[:n] = codes[inv]
+                dev_cols[name] = jnp.asarray(out)
+                dicts[name] = enc.values()
+                continue
             dev_dtype = c.dtype.to_device_dtype()
             pad_val = np.nan if np.issubdtype(dev_dtype, np.floating) else 0
             out = np.full(padded, pad_val, dtype=dev_dtype)

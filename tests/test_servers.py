@@ -319,3 +319,100 @@ class TestReviewRegressions:
         code, raw = http(server, "/v1/prometheus/api/v1/label/host/values")
         vals = json.loads(raw)["data"]
         assert "alpha" in vals and "zulu" in vals
+
+
+def _otlp_metrics_request():
+    """Build a minimal ExportMetricsServiceRequest: one gauge + one histogram."""
+    def kv(key, sval):
+        anyv = _pb_len(1, sval.encode())
+        return _pb_len(1, key.encode()) + _pb_len(2, anyv)
+
+    def fixed64(field, val_bytes):
+        return _pb_varint((field << 3) | 1) + val_bytes
+
+    ts_ns = 1700000000 * 10**9
+    # gauge point: attrs {pod=p1}, t, as_double 42.5
+    pt = (_pb_len(7, kv("pod", "p1"))
+          + fixed64(3, struct.pack("<Q", ts_ns))
+          + fixed64(4, struct.pack("<d", 42.5)))
+    gauge = _pb_len(1, pt)
+    metric1 = _pb_len(1, b"cpu_usage") + _pb_len(5, gauge)
+    # histogram point: count=6, sum=7.5, buckets [1,2,3] bounds [0.1, 1]
+    hp = (_pb_len(9, kv("pod", "p1"))
+          + fixed64(3, struct.pack("<Q", ts_ns))
+          + fixed64(4, struct.pack("<Q", 6))
+          + fixed64(5, struct.pack("<d", 7.5))
+          + _pb_len(6, struct.pack("<QQQ", 1, 2, 3))
+          + _pb_len(7, struct.pack("<dd", 0.1, 1.0)))
+    hist = _pb_len(1, hp)
+    metric2 = _pb_len(1, b"req_latency") + _pb_len(9, hist)
+    scope_metrics = _pb_len(2, metric1) + _pb_len(2, metric2)
+    resource = _pb_len(1, kv("svc", "api"))
+    rm = _pb_len(1, resource) + _pb_len(2, scope_metrics)
+    return _pb_len(1, rm)
+
+
+class TestOtlpAndLoki:
+    def test_otlp_metrics(self, server):
+        body = _otlp_metrics_request()
+        code, raw = http(server, "/v1/otlp/v1/metrics", method="POST", body=body,
+                         headers={"Content-Type": "application/x-protobuf"})
+        assert code == 200, raw
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT pod, svc, val FROM cpu_usage"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert rows == [["p1", "api", 42.5]]
+        # histogram exploded prom-style with cumulative buckets
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT le, val FROM req_latency_bucket ORDER BY val"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert rows == [["0.1", 1.0], ["1.0", 3.0], ["+Inf", 6.0]]
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT val FROM req_latency_count"}))
+        assert json.loads(raw)["output"][0]["records"]["rows"] == [[6.0]]
+        # and histogram_quantile works over the bucket table
+        code, raw = http(server, "/v1/prometheus/api/v1/query?" +
+                         urllib.parse.urlencode({
+                             "query": "histogram_quantile(0.5, req_latency_bucket)",
+                             "time": str(1700000000 + 10)}))
+        body = json.loads(raw)
+        assert body["status"] == "success"
+        assert len(body["data"]["result"]) == 1
+
+    def test_loki_push_and_query(self, server):
+        payload = {
+            "streams": [{
+                "stream": {"app": "web", "level": "error"},
+                "values": [
+                    ["1700000000000000000", "boom happened"],
+                    ["1700000001000000000", "again"],
+                ],
+            }]
+        }
+        code, _ = http(server, "/v1/loki/api/v1/push", method="POST",
+                       body=json.dumps(payload).encode(),
+                       headers={"Content-Type": "application/json"})
+        assert code == 204
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT app, level, line FROM loki_logs ORDER BY ts"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert rows == [["web", "error", "boom happened"],
+                        ["web", "error", "again"]]
+
+    def test_loki_bad_payload(self, server):
+        code, _ = http(server, "/v1/loki/api/v1/push", method="POST",
+                       body=b"not json",
+                       headers={"Content-Type": "application/json"})
+        assert code == 400
+
+    def test_loki_bad_entry_and_gzip(self, server):
+        payload = {"streams": [{"stream": {"a": "b"},
+                                "values": [["not-a-number", "line"]]}]}
+        code, _ = http(server, "/v1/loki/api/v1/push", method="POST",
+                       body=json.dumps(payload).encode(),
+                       headers={"Content-Type": "application/json"})
+        assert code == 400
+        code, _ = http(server, "/v1/otlp/v1/metrics", method="POST",
+                       body=b"\x1f\x8b truncated",
+                       headers={"Content-Encoding": "gzip"})
+        assert code == 400

@@ -521,3 +521,37 @@ class TestSortedFastPath:
                      " GROUP BY host ORDER BY host")
         total = sum(row[1] for row in res.rows)
         assert total == 100.0
+
+
+class TestStringFieldRegressions:
+    def test_null_string_field_query(self, db):
+        db.sql("CREATE TABLE lg (ts TIMESTAMP(3) TIME INDEX, line STRING)")
+        db.sql("INSERT INTO lg VALUES (1000, 'hello'), (2000, NULL)")
+        r = db.sql("SELECT line FROM lg ORDER BY ts")
+        assert r.rows == [["hello"], [""]]
+
+    def test_string_field_aggregate_rejected(self, db):
+        db.sql("CREATE TABLE lg2 (ts TIMESTAMP(3) TIME INDEX, line STRING)")
+        db.sql("INSERT INTO lg2 VALUES (1000, 'zebra'), (2000, 'apple')")
+        with pytest.raises(Unsupported):
+            db.sql("SELECT max(line) FROM lg2")
+        assert db.sql("SELECT count(line) FROM lg2").rows == [[2]]
+
+    def test_sorted_minmax_tagless_timeonly(self, db):
+        # review regression: padding rows must not corrupt min/max on the
+        # sorted path for tag-less time-only group-bys
+        db.sql("CREATE TABLE nt (ts TIMESTAMP(3) TIME INDEX, v DOUBLE)")
+        import numpy as np
+        r = db._region_of("nt")
+        n = 100  # pads to 128 -> 28 padding rows
+        r.write({"ts": np.arange(n) * 60_000, "v": np.arange(n, dtype=float)})
+        import greptimedb_tpu.query.physical as phys
+        orig = phys.jax.default_backend
+        phys.jax.default_backend = lambda: "tpu"
+        try:
+            res = db.sql("SELECT date_bin(INTERVAL '30 minute', ts) b, max(v), min(v)"
+                         " FROM nt GROUP BY b ORDER BY b")
+        finally:
+            phys.jax.default_backend = orig
+        assert res.rows[-1][1] == 99.0  # last bucket max intact
+        assert res.rows[0][2] == 0.0
