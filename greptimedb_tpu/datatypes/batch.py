@@ -34,10 +34,18 @@ _MIN_BUCKET = 128
 
 
 def pad_rows(n: int, min_bucket: int = _MIN_BUCKET) -> int:
-    """Shape-class bucket for n rows: next power of two, at least min_bucket."""
+    """Shape-class bucket for n rows.
+
+    Powers of two up to 4M rows (few classes, cheap recompiles); above that,
+    multiples of 2M — pure pow2 would waste up to 2x memory bandwidth on
+    padding (e.g. 34.5M rows -> 64M), which dominates large scans.
+    """
     if n <= min_bucket:
         return min_bucket
-    return 1 << (n - 1).bit_length()
+    if n <= (1 << 22):
+        return 1 << (n - 1).bit_length()
+    step = 1 << 21
+    return -(-n // step) * step
 
 
 class RecordBatch:

@@ -119,6 +119,10 @@ class HttpServer:
         r.add_post("/v1/influxdb/write", self.h_influx_write)
         r.add_post("/v1/otlp/v1/metrics", self.h_otlp_metrics)
         r.add_post("/v1/loki/api/v1/push", self.h_loki_push)
+        r.add_post("/v1/pipelines/{name}", self.h_pipeline_upsert)
+        r.add_delete("/v1/pipelines/{name}", self.h_pipeline_delete)
+        r.add_get("/v1/pipelines", self.h_pipeline_list)
+        r.add_post("/v1/ingest", self.h_ingest)
         r.add_get("/health", self.h_health)
         r.add_get("/ready", self.h_health)
         r.add_get("/metrics", self.h_metrics)
@@ -387,8 +391,12 @@ class HttpServer:
         def run():
             rows: list[tuple[dict, str, int]] = []
             for stream in payload.get("streams", []):
-                labels = {str(k): str(v) for k, v in
-                          (stream.get("stream") or {}).items()}
+                labels = {
+                    # labels named like reserved columns are renamed
+                    (str(k) + "_label" if str(k) in ("ts", "line") else str(k)):
+                        str(v)
+                    for k, v in (stream.get("stream") or {}).items()
+                }
                 for entry in stream.get("values", []):
                     from greptimedb_tpu.errors import InvalidArguments
 
@@ -419,6 +427,85 @@ class HttpServer:
             n = await self._call(run)
             M_INGEST_ROWS.labels("loki").inc(n)
             return web.Response(status=204)
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    def _pipelines(self):
+        from greptimedb_tpu.servers.pipeline import PipelineManager
+
+        return PipelineManager(self.db)
+
+    async def h_pipeline_upsert(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        body = (await request.read()).decode("utf-8")
+        try:
+            pipe = await self._call(self._pipelines().upsert, name, body)
+            return web.json_response(
+                {"name": name, "version": pipe.version})
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_pipeline_delete(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        ok = await self._call(self._pipelines().delete, name)
+        if not ok:
+            return web.json_response({"error": f"pipeline {name} not found"},
+                                     status=404)
+        return web.json_response({"name": name})
+
+    async def h_pipeline_list(self, request: web.Request) -> web.Response:
+        out = await self._call(self._pipelines().list)
+        return web.json_response(
+            {"pipelines": [{"name": n, "version": v} for n, v in out]})
+
+    async def h_ingest(self, request: web.Request) -> web.Response:
+        """Log ingestion through a pipeline (reference /v1/ingest +
+        http/event.rs): body is NDJSON or a JSON array of objects; the
+        pipeline shapes rows into table columns."""
+        table = request.query.get("table")
+        pname = request.query.get("pipeline_name")
+        if not table or not pname:
+            return web.json_response(
+                {"error": "table and pipeline_name query params required"},
+                status=400)
+        raw = (await request.read()).decode("utf-8")
+
+        def run():
+            from greptimedb_tpu.errors import InvalidArguments
+
+            rows: list[dict] = []
+            stripped = raw.strip()
+            if stripped.startswith("["):
+                try:
+                    parsed = json.loads(stripped)
+                except json.JSONDecodeError as e:
+                    raise InvalidArguments(f"bad json body: {e}") from None
+                rows = [r for r in parsed if isinstance(r, dict)]
+            else:
+                for line in stripped.splitlines():
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        parsed = json.loads(line)
+                    except json.JSONDecodeError:
+                        parsed = None
+                    rows.append(
+                        parsed if isinstance(parsed, dict)
+                        else {"message": line}
+                    )
+            pipe = self._pipelines().get(pname)
+            cols = pipe.run(rows)
+            if not cols["ts"]:
+                return 0
+            return _ingest_columns(self.db, table, cols)
+
+        try:
+            n = await self._call(run)
+            M_INGEST_ROWS.labels("pipeline").inc(n)
+            return web.json_response({"rows": n})
         except Exception as e:  # noqa: BLE001
             body_json, status = _error_json(e)
             return web.json_response(body_json, status=status)

@@ -98,11 +98,15 @@ def _histogram_point(data: bytes):
         elif f == 5:
             total = _fixed64_f(v)
         elif f == 6:
-            bucket_counts = (
-                _packed_fixed64(v) if wt == 2 else bucket_counts + [v]
-            )
+            if wt == 2:
+                bucket_counts = _packed_fixed64(v)
+            else:  # legal unpacked repeated fixed64
+                bucket_counts.append(_fixed64_u(v))
         elif f == 7:
-            bounds = _packed_doubles(v) if wt == 2 else bounds
+            if wt == 2:
+                bounds = _packed_doubles(v)
+            else:
+                bounds.append(_fixed64_f(v))
     return attrs, ts_ms, count, total, bucket_counts, bounds
 
 
@@ -174,14 +178,23 @@ def parse_otlp_metrics(body: bytes) -> dict[str, dict[str, list]]:
 
     out: dict[str, dict[str, list]] = {}
     for table, data in rows.items():
-        tag_names = sorted({k for tags, _v, _t in data for k in tags})
+        tag_names = sorted(
+            {_safe_tag(k) for tags, _v, _t in data for k in tags}
+        )
         cols: dict[str, list] = {k: [] for k in tag_names}
         cols["ts"] = []
         cols["val"] = []
         for tags, val, ts in data:
+            renamed = {_safe_tag(k): v for k, v in tags.items()}
             for k in tag_names:
-                cols[k].append(tags.get(k, ""))
+                cols[k].append(renamed.get(k, ""))
             cols["ts"].append(ts)
             cols["val"].append(val)
         out[table] = {"__tags__": tag_names, "__fields__": ["val"], **cols}
     return out
+
+
+def _safe_tag(k: str) -> str:
+    """Attribute keys colliding with reserved output columns are renamed
+    (an attribute literally named 'ts' or 'val' would corrupt the batch)."""
+    return k + "_attr" if k in ("ts", "val") else k

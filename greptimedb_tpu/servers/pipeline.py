@@ -1,0 +1,574 @@
+"""ETL pipelines: YAML-defined log transformation at ingest time.
+
+Reference: src/pipeline (SURVEY.md §2.7) — pipelines are versioned YAML
+documents of processors (dissect, date, regex, json, ...) followed by a
+transform section that types and routes fields into table columns; they are
+stored in a system table and applied to /v1/ingest payloads.
+
+Round-1 processor set: dissect, regex, date, epoch, json_path, letter
+(case), gsub, split, csv, urlencoding, filter; transform with type coercion
+and tag/field/time-index roles. Pipelines persist in the metadata kv
+(versioned) like flows.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import re
+import urllib.parse
+from dataclasses import dataclass, field
+
+from greptimedb_tpu.errors import InvalidArguments, Unsupported
+
+
+# ---------------------------------------------------------------------------
+# Minimal YAML subset parser (the image ships no yaml module): supports
+# mappings, lists of mappings, scalars, inline lists — enough for pipeline
+# documents like the reference's examples.
+# ---------------------------------------------------------------------------
+
+def parse_simple_yaml(text: str):
+    lines = []
+    for raw in text.splitlines():
+        if raw.strip().startswith("#") or not raw.strip():
+            continue
+        lines.append(raw.rstrip())
+    pos = 0
+
+    def parse_block(indent: int):
+        nonlocal pos
+        # decide list vs mapping from the first line
+        items = None
+        mapping = None
+        while pos < len(lines):
+            line = lines[pos]
+            cur_indent = len(line) - len(line.lstrip())
+            if cur_indent < indent:
+                break
+            stripped = line.strip()
+            if stripped.startswith("- "):
+                if mapping is not None:
+                    break
+                if items is None:
+                    items = []
+                if cur_indent != indent:
+                    break
+                pos += 1
+                # item may be a scalar or an inline "key: value" start of map
+                content = stripped[2:]
+                if re.search(r":(\s|$)", content) and not content.startswith(
+                    ("'", '"')
+                ):
+                    # re-inject as a mapping line at deeper indent
+                    lines.insert(pos, " " * (indent + 2) + content)
+                    sub = parse_block(indent + 2)
+                    items.append(sub)
+                else:
+                    items.append(_scalar(content))
+            else:
+                if items is not None:
+                    break
+                if mapping is None:
+                    mapping = {}
+                if cur_indent != indent:
+                    break
+                # YAML rule: a colon starts a mapping only when followed by
+                # whitespace or end of line ('%H:%M' is a plain scalar)
+                m = re.search(r":(\s|$)", stripped)
+                if m is None:
+                    raise InvalidArguments(f"bad yaml line: {line!r}")
+                key = stripped[: m.start()]
+                rest = stripped[m.end():].strip()
+                pos += 1
+                if rest == "":
+                    # nested block or empty
+                    if pos < len(lines):
+                        nxt = lines[pos]
+                        nxt_indent = len(nxt) - len(nxt.lstrip())
+                        if nxt_indent > cur_indent:
+                            mapping[key.strip()] = parse_block(nxt_indent)
+                            continue
+                    mapping[key.strip()] = None
+                else:
+                    mapping[key.strip()] = _scalar(rest)
+        return items if items is not None else (mapping or {})
+
+    return parse_block(0)
+
+
+def _scalar(s: str):
+    s = s.strip()
+    if s.startswith("[") and s.endswith("]"):
+        inner = s[1:-1].strip()
+        return [_scalar(x) for x in inner.split(",")] if inner else []
+    if s.startswith(("'", '"')) and s.endswith(s[0]) and len(s) >= 2:
+        return s[1:-1]
+    low = s.lower()
+    if low in ("true", "yes"):
+        return True
+    if low in ("false", "no"):
+        return False
+    if low in ("null", "~"):
+        return None
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Processors
+# ---------------------------------------------------------------------------
+
+def _fields_of(cfg) -> list[str]:
+    f = cfg.get("fields") or ([cfg["field"]] if "field" in cfg else [])
+    return [str(x) for x in f]
+
+
+class Processor:
+    def apply(self, row: dict) -> dict | None:
+        raise NotImplementedError
+
+
+@dataclass
+class DissectProcessor(Processor):
+    fields: list[str]
+    patterns: list[str]
+    ignore_missing: bool = True
+
+    def apply(self, row):
+        for f in self.fields:
+            val = row.get(f)
+            if val is None:
+                if self.ignore_missing:
+                    continue
+                raise InvalidArguments(f"dissect: missing field {f}")
+            for pattern in self.patterns:
+                out = _dissect(str(val), pattern)
+                if out is not None:
+                    row.update(out)
+                    break
+        return row
+
+
+def _dissect(value: str, pattern: str) -> dict | None:
+    """'%{a} %{b}' style dissect: literal separators between %{name} keys."""
+    parts = re.split(r"(%\{[^}]*\})", pattern)
+    keys: list[str | None] = []
+    regex = []
+    for p in parts:
+        if p.startswith("%{") and p.endswith("}"):
+            name = p[2:-1]
+            if name.startswith("?"):  # named skip
+                regex.append("(?:.*?)")
+                keys.append(None)
+            else:
+                keys.append(name)
+                regex.append("(.*?)")
+        elif p:
+            regex.append(re.escape(p))
+    m = re.fullmatch("".join(regex), value)
+    if m is None:
+        return None
+    out = {}
+    gi = 1
+    for k in keys:
+        if k is None:
+            continue
+        out[k] = m.group(gi)
+        gi += 1
+    return out
+
+
+@dataclass
+class RegexProcessor(Processor):
+    fields: list[str]
+    patterns: list[str]
+    ignore_missing: bool = True
+
+    def apply(self, row):
+        for f in self.fields:
+            val = row.get(f)
+            if val is None:
+                continue
+            for pat in self.patterns:
+                m = re.search(pat, str(val))
+                if m:
+                    # reference semantics: outputs named <field>_<group>
+                    for name, g in (m.groupdict() or {}).items():
+                        row[f"{f}_{name}"] = g
+                    break
+        return row
+
+
+_DATE_FORMATS = [
+    "%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z",
+    "%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d",
+    "%d/%b/%Y:%H:%M:%S %z",  # common log format
+]
+
+
+@dataclass
+class DateProcessor(Processor):
+    fields: list[str]
+    formats: list[str] = field(default_factory=list)
+    timezone: str = "UTC"
+    ignore_missing: bool = True
+
+    def apply(self, row):
+        for f in self.fields:
+            val = row.get(f)
+            if val is None:
+                continue
+            for fmt in (self.formats or _DATE_FORMATS):
+                try:
+                    dt = datetime.datetime.strptime(str(val), fmt)
+                    if dt.tzinfo is None:
+                        import zoneinfo
+
+                        try:
+                            tz = zoneinfo.ZoneInfo(self.timezone)
+                        except (KeyError, zoneinfo.ZoneInfoNotFoundError):
+                            tz = datetime.timezone.utc
+                        dt = dt.replace(tzinfo=tz)
+                    row[f] = int(dt.timestamp() * 1000)
+                    break
+                except ValueError:
+                    continue
+        return row
+
+
+@dataclass
+class EpochProcessor(Processor):
+    fields: list[str]
+    resolution: str = "ms"
+
+    def apply(self, row):
+        mult = {"s": 1000, "sec": 1000, "second": 1000, "ms": 1,
+                "milli": 1, "millisecond": 1, "us": 0.001, "ns": 0.000001}
+        m = mult.get(self.resolution, 1)
+        for f in self.fields:
+            val = row.get(f)
+            if val is None:
+                continue
+            row[f] = int(float(val) * m)
+        return row
+
+
+@dataclass
+class JsonPathProcessor(Processor):
+    fields: list[str]
+    json_path: str = ""
+
+    def apply(self, row):
+        for f in self.fields:
+            val = row.get(f)
+            if val is None:
+                continue
+            try:
+                doc = json.loads(val) if isinstance(val, str) else val
+            except json.JSONDecodeError:
+                continue
+            cur = doc
+            for part in self.json_path.lstrip("$.").split("."):
+                if not part:
+                    continue
+                if isinstance(cur, dict):
+                    cur = cur.get(part)
+                else:
+                    cur = None
+                    break
+            row[f] = cur
+        return row
+
+
+@dataclass
+class LetterProcessor(Processor):
+    fields: list[str]
+    method: str = "lower"
+
+    def apply(self, row):
+        for f in self.fields:
+            v = row.get(f)
+            if isinstance(v, str):
+                fn = {"lower": str.lower, "upper": str.upper,
+                      "capital": str.capitalize}.get(self.method, str.lower)
+                row[f] = fn(v)
+        return row
+
+
+@dataclass
+class GsubProcessor(Processor):
+    fields: list[str]
+    pattern: str = ""
+    replacement: str = ""
+
+    def apply(self, row):
+        for f in self.fields:
+            v = row.get(f)
+            if isinstance(v, str):
+                row[f] = re.sub(self.pattern, self.replacement, v)
+        return row
+
+
+@dataclass
+class SplitProcessor(Processor):
+    fields: list[str]
+    separator: str = ","
+
+    def apply(self, row):
+        for f in self.fields:
+            v = row.get(f)
+            if isinstance(v, str):
+                row[f] = v.split(self.separator)
+        return row
+
+
+@dataclass
+class CsvProcessor(Processor):
+    fields: list[str]
+    target_fields: list[str] = field(default_factory=list)
+    separator: str = ","
+
+    def apply(self, row):
+        import csv as _csv
+        import io
+
+        for f in self.fields:
+            v = row.get(f)
+            if isinstance(v, str) and v:
+                vals = next(
+                    _csv.reader(io.StringIO(v), delimiter=self.separator),
+                    [],
+                )
+                for name, val in zip(self.target_fields, vals):
+                    row[name] = val
+        return row
+
+
+@dataclass
+class UrlEncodingProcessor(Processor):
+    fields: list[str]
+    method: str = "decode"
+
+    def apply(self, row):
+        for f in self.fields:
+            v = row.get(f)
+            if isinstance(v, str):
+                row[f] = (urllib.parse.unquote(v) if self.method == "decode"
+                          else urllib.parse.quote(v))
+        return row
+
+
+@dataclass
+class FilterProcessor(Processor):
+    fields: list[str]
+    mode: str = "include"  # include = keep rows matching, exclude = drop
+    match: list[str] = field(default_factory=list)
+
+    def apply(self, row):
+        for f in self.fields:
+            v = str(row.get(f, ""))
+            hit = any(re.search(m, v) for m in self.match)
+            if (self.mode == "include") != hit:
+                return None
+        return row
+
+
+_PROCESSORS = {
+    "dissect": lambda c: DissectProcessor(
+        _fields_of(c), [str(p) for p in (c.get("patterns") or [])],
+        c.get("ignore_missing", True)),
+    "regex": lambda c: RegexProcessor(
+        _fields_of(c), [str(p) for p in (c.get("patterns") or [c.get("pattern", "")])],
+        c.get("ignore_missing", True)),
+    "date": lambda c: DateProcessor(
+        _fields_of(c), [str(f) for f in (c.get("formats") or [])],
+        c.get("timezone", "UTC"), c.get("ignore_missing", True)),
+    "epoch": lambda c: EpochProcessor(_fields_of(c), str(c.get("resolution", "ms"))),
+    "json_path": lambda c: JsonPathProcessor(_fields_of(c), str(c.get("json_path", ""))),
+    "letter": lambda c: LetterProcessor(_fields_of(c), str(c.get("method", "lower"))),
+    "gsub": lambda c: GsubProcessor(
+        _fields_of(c), str(c.get("pattern", "")), str(c.get("replacement", ""))),
+    "split": lambda c: SplitProcessor(_fields_of(c), str(c.get("separator", ","))),
+    "csv": lambda c: CsvProcessor(
+        _fields_of(c), [str(x) for x in (c.get("target_fields") or [])],
+        str(c.get("separator", ","))),
+    "urlencoding": lambda c: UrlEncodingProcessor(
+        _fields_of(c), str(c.get("method", "decode"))),
+    "filter": lambda c: FilterProcessor(
+        _fields_of(c), str(c.get("mode", "include")),
+        [str(m) for m in (c.get("match") or [])]),
+}
+
+
+@dataclass
+class TransformRule:
+    fields: list[str]
+    type_name: str
+    index: str | None = None  # tag | timestamp | fulltext | skip
+    on_failure: str = "ignore"
+
+
+@dataclass
+class Pipeline:
+    name: str
+    processors: list[Processor]
+    transforms: list[TransformRule]
+    version: int = 1
+
+    @staticmethod
+    def from_yaml(name: str, text: str, version: int = 1) -> "Pipeline":
+        doc = parse_simple_yaml(text)
+        if not isinstance(doc, dict):
+            raise InvalidArguments("pipeline yaml must be a mapping")
+        procs: list[Processor] = []
+        for item in doc.get("processors") or []:
+            if not isinstance(item, dict) or len(item) != 1:
+                raise InvalidArguments(f"bad processor entry: {item}")
+            kind, cfg = next(iter(item.items()))
+            maker = _PROCESSORS.get(str(kind))
+            if maker is None:
+                raise Unsupported(f"pipeline processor {kind}")
+            procs.append(maker(cfg or {}))
+        transforms = []
+        for item in doc.get("transform") or doc.get("transforms") or []:
+            transforms.append(TransformRule(
+                fields=_fields_of(item),
+                type_name=str(item.get("type", "string")),
+                index=item.get("index"),
+                on_failure=str(item.get("on_failure", "ignore")),
+            ))
+        if not transforms:
+            raise InvalidArguments("pipeline needs a transform section")
+        if not any(t.index == "timestamp" for t in transforms):
+            raise InvalidArguments("pipeline transform needs a timestamp index")
+        for t in transforms:
+            for f in t.fields:
+                if f == "ts" and t.index != "timestamp":
+                    raise InvalidArguments(
+                        "'ts' is reserved for the timestamp column; rename "
+                        "the field or mark it index: timestamp"
+                    )
+        return Pipeline(name, procs, transforms, version)
+
+    # ------------------------------------------------------------------
+    def run(self, rows: list[dict]) -> dict[str, list]:
+        """Apply processors + transform; returns ingest-shaped columns."""
+        out_rows: list[dict] = []
+        for row in rows:
+            r: dict | None = dict(row)
+            for p in self.processors:
+                r = p.apply(r)
+                if r is None:
+                    break
+            if r is not None:
+                out_rows.append(r)
+
+        tags, fields_, ts_field = [], [], None
+        for t in self.transforms:
+            for f in t.fields:
+                if t.index == "tag":
+                    tags.append(f)
+                elif t.index == "timestamp":
+                    ts_field = f
+                elif t.index == "skip":
+                    continue
+                else:
+                    fields_.append(f)
+        if ts_field is None:
+            raise InvalidArguments("pipeline transform needs a timestamp index")
+
+        def coerce(t: TransformRule, v):
+            ty = t.type_name.lower()
+            if v is None:
+                return None
+            try:
+                if ty.startswith(("int", "uint", "epoch", "time")):
+                    return int(v)
+                if ty.startswith("float") or ty == "double":
+                    return float(v)
+                if ty == "boolean":
+                    return str(v).lower() in ("1", "true", "yes")
+                return str(v)
+            except (ValueError, TypeError):
+                if t.on_failure == "ignore":
+                    return None
+                raise InvalidArguments(f"cannot coerce {v!r} to {ty}")
+
+        by_field = {}
+        for t in self.transforms:
+            for f in t.fields:
+                by_field[f] = t
+        cols: dict[str, list] = {f: [] for f in tags + fields_}
+        cols["ts"] = []
+        for r in out_rows:
+            ts_val = coerce(by_field[ts_field], r.get(ts_field))
+            if ts_val is None:
+                # a row without a usable timestamp would silently land at
+                # epoch 0 — drop it instead
+                continue
+            for f in tags + fields_:
+                cols[f].append(coerce(by_field[f], r.get(f)))
+            cols["ts"].append(ts_val)
+        return {"__tags__": tags, "__fields__": fields_, **cols}
+
+
+class PipelineManager:
+    """Versioned pipeline storage in the metadata kv (reference keeps them
+    in greptime_private.pipelines, manager/table.rs:64)."""
+
+    _PREFIX = "__pipeline/"
+
+    def __init__(self, db):
+        self.db = db
+
+    def upsert(self, name: str, yaml_text: str) -> Pipeline:
+        pipe = Pipeline.from_yaml(name, yaml_text)  # validate first
+        cur = self.db.kv.get_json(self._PREFIX + name)
+        version = (cur["version"] + 1) if cur else 1
+        self.db.kv.put_json(self._PREFIX + name,
+                            {"yaml": yaml_text, "version": version})
+        pipe.version = version
+        return pipe
+
+    def get(self, name: str, version: int | None = None) -> Pipeline:
+        cur = self.db.kv.get_json(self._PREFIX + name)
+        if cur is None:
+            raise InvalidArguments(f"pipeline not found: {name}")
+        if version is not None and version != cur["version"]:
+            raise InvalidArguments(
+                f"pipeline {name} version {version} not available "
+                f"(latest is {cur['version']})"
+            )
+        # parsed-pipeline cache on the db (hot ingest path: avoid re-parsing
+        # yaml + recompiling regexes per request)
+        cache = getattr(self.db, "_pipeline_cache", None)
+        if cache is None:
+            cache = self.db._pipeline_cache = {}
+        key = (name, cur["version"])
+        pipe = cache.get(key)
+        if pipe is None:
+            pipe = Pipeline.from_yaml(name, cur["yaml"], cur["version"])
+            cache[key] = pipe
+        return pipe
+
+    def delete(self, name: str) -> bool:
+        cache = getattr(self.db, "_pipeline_cache", None)
+        if cache is not None:
+            for key in [k for k in cache if k[0] == name]:
+                del cache[key]
+        return self.db.kv.delete(self._PREFIX + name)
+
+    def list(self) -> list[tuple[str, int]]:
+        out = []
+        for k, v in self.db.kv.range(self._PREFIX):
+            rec = json.loads(v)
+            out.append((k[len(self._PREFIX):], rec["version"]))
+        return out

@@ -1,0 +1,294 @@
+"""ETL pipeline tests: yaml parsing, processors, transform, HTTP ingest."""
+
+import json
+import urllib.parse
+
+import pytest
+
+from greptimedb_tpu.errors import InvalidArguments, Unsupported
+from greptimedb_tpu.servers.pipeline import Pipeline, parse_simple_yaml
+
+ACCESS_LOG_PIPELINE = """
+processors:
+  - dissect:
+      fields:
+        - message
+      patterns:
+        - '%{ip} - %{user} [%{ts}] "%{method} %{path} %{proto}" %{status} %{size}'
+  - date:
+      fields:
+        - ts
+      formats:
+        - '%d/%b/%Y:%H:%M:%S %z'
+  - letter:
+      fields:
+        - method
+      method: lower
+transform:
+  - fields:
+      - ip
+      - method
+    type: string
+    index: tag
+  - fields:
+      - path
+      - user
+    type: string
+  - fields:
+      - status
+      - size
+    type: int64
+  - fields:
+      - ts
+    type: epoch
+    index: timestamp
+"""
+
+LOG_LINE = '1.2.3.4 - frank [10/Oct/2000:13:55:36 -0700] "GET /apache_pb.gif HTTP/1.0" 200 2326'
+
+
+class TestYaml:
+    def test_parse_pipeline_doc(self):
+        doc = parse_simple_yaml(ACCESS_LOG_PIPELINE)
+        assert isinstance(doc["processors"], list)
+        assert "dissect" in doc["processors"][0]
+        assert doc["processors"][0]["dissect"]["fields"] == ["message"]
+        assert doc["transform"][0]["index"] == "tag"
+
+    def test_scalars(self):
+        doc = parse_simple_yaml("a: 1\nb: true\nc: [x, y]\nd: 'q: z'")
+        assert doc == {"a": 1, "b": True, "c": ["x", "y"], "d": "q: z"}
+
+
+class TestPipeline:
+    def test_access_log_end_to_end(self):
+        pipe = Pipeline.from_yaml("p", ACCESS_LOG_PIPELINE)
+        cols = pipe.run([{"message": LOG_LINE}])
+        assert cols["ip"] == ["1.2.3.4"]
+        assert cols["method"] == ["get"]
+        assert cols["path"] == ["/apache_pb.gif"]
+        assert cols["status"] == [200]
+        assert cols["size"] == [2326]
+        # 10/Oct/2000:13:55:36 -0700 = 971211336 s
+        assert cols["ts"] == [971211336000]
+        assert cols["__tags__"] == ["ip", "method"]
+
+    def test_filter_processor(self):
+        yaml = """
+processors:
+  - filter:
+      fields:
+        - level
+      mode: include
+      match:
+        - 'ERROR'
+transform:
+  - fields:
+      - level
+    type: string
+    index: tag
+  - fields:
+      - ts
+    type: epoch
+    index: timestamp
+"""
+        pipe = Pipeline.from_yaml("f", yaml)
+        cols = pipe.run([
+            {"level": "ERROR", "ts": 1}, {"level": "INFO", "ts": 2},
+        ])
+        assert cols["level"] == ["ERROR"]
+
+    def test_unknown_processor(self):
+        with pytest.raises(Unsupported):
+            Pipeline.from_yaml("x", "processors:\n  - vrl:\n      x: 1\ntransform:\n  - fields:\n      - ts\n    type: epoch\n    index: timestamp")
+
+    def test_missing_timestamp_transform(self):
+        with pytest.raises(InvalidArguments):
+            Pipeline.from_yaml("x", "transform:\n  - fields:\n      - a\n    type: string")
+
+    def test_json_path_and_gsub(self):
+        yaml = """
+processors:
+  - json_path:
+      fields:
+        - payload
+      json_path: '$.user.name'
+  - gsub:
+      fields:
+        - payload
+      pattern: ' '
+      replacement: '_'
+transform:
+  - fields:
+      - payload
+    type: string
+  - fields:
+      - ts
+    type: epoch
+    index: timestamp
+"""
+        pipe = Pipeline.from_yaml("j", yaml)
+        cols = pipe.run([{"payload": '{"user": {"name": "jo an"}}', "ts": 5}])
+        assert cols["payload"] == ["jo_an"]
+
+
+class TestPipelineHttp:
+    def test_upsert_ingest_query(self, tmp_path):
+        from greptimedb_tpu.servers import HttpServer
+        from greptimedb_tpu.standalone import GreptimeDB
+        from tests.test_servers import http
+
+        db = GreptimeDB()
+        srv = HttpServer(db, port=0)
+        srv.start()
+        try:
+            code, raw = http(srv, "/v1/pipelines/access", method="POST",
+                             body=ACCESS_LOG_PIPELINE.encode())
+            assert code == 200 and json.loads(raw)["version"] == 1
+            # versioning bumps
+            code, raw = http(srv, "/v1/pipelines/access", method="POST",
+                             body=ACCESS_LOG_PIPELINE.encode())
+            assert json.loads(raw)["version"] == 2
+            code, raw = http(srv, "/v1/pipelines")
+            assert json.loads(raw)["pipelines"][0]["name"] == "access"
+
+            body = json.dumps([{"message": LOG_LINE}]).encode()
+            code, raw = http(
+                srv, "/v1/ingest?table=access_logs&pipeline_name=access",
+                method="POST", body=body)
+            assert code == 200 and json.loads(raw)["rows"] == 1
+            code, raw = http(srv, "/v1/sql?" + urllib.parse.urlencode(
+                {"sql": "SELECT ip, status, path FROM access_logs"}))
+            rows = json.loads(raw)["output"][0]["records"]["rows"]
+            assert rows == [["1.2.3.4", 200, "/apache_pb.gif"]]
+            # bad pipeline yaml -> 400
+            code, _ = http(srv, "/v1/pipelines/bad", method="POST",
+                           body=b"transform:\n  - fields:\n      - a\n    type: string")
+            assert code == 400
+            # unknown pipeline on ingest -> 400
+            code, _ = http(srv, "/v1/ingest?table=t&pipeline_name=nope",
+                           method="POST", body=b"[]")
+            assert code == 400
+        finally:
+            srv.stop()
+            db.close()
+
+
+class TestReviewRegressions:
+    def test_dissect_requires_full_match(self):
+        out = __import__("greptimedb_tpu.servers.pipeline", fromlist=["_dissect"])
+        assert out._dissect("x y", "%{a} %{b}!") is None
+        assert out._dissect("x y!", "%{a} %{b}!") == {"a": "x", "b": "y"}
+
+    def test_rows_without_timestamp_dropped(self):
+        yaml = """
+transform:
+  - fields:
+      - v
+    type: string
+  - fields:
+      - ts
+    type: epoch
+    index: timestamp
+"""
+        pipe = Pipeline.from_yaml("t", yaml)
+        cols = pipe.run([{"v": "a", "ts": 5}, {"v": "b"}, {"v": "c", "ts": "bad"}])
+        assert cols["v"] == ["a"] and cols["ts"] == [5]
+
+    def test_regex_group_prefix(self):
+        yaml = """
+processors:
+  - regex:
+      fields:
+        - msg
+      patterns:
+        - 'code=(?P<code>\\d+)'
+transform:
+  - fields:
+      - msg_code
+    type: int64
+  - fields:
+      - ts
+    type: epoch
+    index: timestamp
+"""
+        pipe = Pipeline.from_yaml("r", yaml)
+        cols = pipe.run([{"msg": "err code=503", "ts": 1}])
+        assert cols["msg_code"] == [503]
+
+    def test_yaml_colon_in_scalar(self):
+        doc = parse_simple_yaml(
+            "patterns:\n  - %d/%b/%Y:%H:%M:%S %z\nkey: a:b:c")
+        assert doc["patterns"] == ["%d/%b/%Y:%H:%M:%S %z"]
+        assert doc["key"] == "a:b:c"
+
+    def test_reserved_ts_field_rejected(self):
+        with pytest.raises(InvalidArguments):
+            Pipeline.from_yaml("x", """
+transform:
+  - fields:
+      - ts
+    type: string
+    index: tag
+  - fields:
+      - t
+    type: epoch
+    index: timestamp
+""")
+
+    def test_delete_invalidates_cache(self):
+        from greptimedb_tpu.servers.pipeline import PipelineManager
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB()
+        try:
+            mgr = PipelineManager(db)
+            y1 = "transform:\n  - fields:\n      - ts\n    type: epoch\n    index: timestamp\n  - fields:\n      - a\n    type: string"
+            y2 = y1 + "\n  - fields:\n      - b\n    type: string"
+            mgr.upsert("p", y1)
+            assert len(mgr.get("p").transforms) == 2
+            mgr.delete("p")
+            mgr.upsert("p", y2)
+            assert len(mgr.get("p").transforms) == 3  # not the stale cache
+        finally:
+            db.close()
+
+    def test_timezone_applied(self):
+        yaml = """
+processors:
+  - date:
+      fields:
+        - t
+      formats:
+        - '%Y-%m-%d %H:%M:%S'
+      timezone: America/New_York
+transform:
+  - fields:
+      - t
+    type: epoch
+    index: timestamp
+"""
+        pipe = Pipeline.from_yaml("tz", yaml)
+        cols = pipe.run([{"t": "2026-01-15 10:00:00"}])
+        # 10:00 EST = 15:00 UTC
+        assert cols["ts"] == [1768489200000]
+
+    def test_empty_csv_value(self):
+        yaml = """
+processors:
+  - csv:
+      fields:
+        - data
+      target_fields: [a, b]
+transform:
+  - fields:
+      - a
+    type: string
+  - fields:
+      - ts
+    type: epoch
+    index: timestamp
+"""
+        pipe = Pipeline.from_yaml("c", yaml)
+        cols = pipe.run([{"data": "", "ts": 1}, {"data": "x,y", "ts": 2}])
+        assert cols["a"] == [None, "x"]

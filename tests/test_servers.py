@@ -416,3 +416,25 @@ class TestOtlpAndLoki:
                        body=b"\x1f\x8b truncated",
                        headers={"Content-Encoding": "gzip"})
         assert code == 400
+
+    def test_reserved_label_names(self):
+        # loki labels named ts/line must not corrupt the batch (fresh db:
+        # loki_logs schema is created from the first batch's labels)
+        db = GreptimeDB()
+        srv = HttpServer(db, port=0)
+        srv.start()
+        try:
+            payload = {"streams": [{"stream": {"ts": "oops", "line": "also"},
+                                    "values": [["1700000000000000000", "msg"]]}]}
+            code, _ = http(srv, "/v1/loki/api/v1/push", method="POST",
+                           body=json.dumps(payload).encode(),
+                           headers={"Content-Type": "application/json"})
+            assert code == 204
+            code, raw = http(srv, "/v1/sql?" + urllib.parse.urlencode(
+                {"sql": "SELECT ts_label, line_label, line FROM loki_logs"
+                        " WHERE ts_label = 'oops'"}))
+            rows = json.loads(raw)["output"][0]["records"]["rows"]
+            assert rows == [["oops", "also", "msg"]]
+        finally:
+            srv.stop()
+            db.close()
