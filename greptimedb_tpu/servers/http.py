@@ -119,6 +119,13 @@ class HttpServer:
         r.add_post("/v1/influxdb/write", self.h_influx_write)
         r.add_post("/v1/otlp/v1/metrics", self.h_otlp_metrics)
         r.add_post("/v1/loki/api/v1/push", self.h_loki_push)
+        r.add_post("/v1/opentsdb/api/put", self.h_opentsdb_put)
+        r.add_post("/v1/elasticsearch/_bulk", self.h_es_bulk)
+        r.add_post("/v1/elasticsearch/{index}/_bulk", self.h_es_bulk)
+        r.add_get("/v1/elasticsearch/", self.h_es_info)
+        r.add_get("/v1/elasticsearch/_license", self.h_es_license)
+        r.add_post("/v1/splunk/services/collector", self.h_splunk_hec)
+        r.add_post("/v1/splunk/services/collector/event", self.h_splunk_hec)
         r.add_post("/v1/pipelines/{name}", self.h_pipeline_upsert)
         r.add_delete("/v1/pipelines/{name}", self.h_pipeline_delete)
         r.add_get("/v1/pipelines", self.h_pipeline_list)
@@ -431,6 +438,199 @@ class HttpServer:
             body_json, status = _error_json(e)
             return web.json_response(body_json, status=status)
 
+    async def h_opentsdb_put(self, request: web.Request) -> web.Response:
+        """OpenTSDB /api/put (reference src/servers/src/opentsdb.rs): JSON
+        datapoints {metric, timestamp, value, tags} — single or array."""
+        try:
+            payload = json.loads(await request.read())
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": f"bad json: {e}"}, status=400)
+        points = payload if isinstance(payload, list) else [payload]
+
+        def run():
+            from collections import defaultdict
+
+            from greptimedb_tpu.errors import InvalidArguments
+
+            per_table: dict[str, list] = defaultdict(list)
+            for p in points:
+                if not isinstance(p, dict) or "metric" not in p:
+                    raise InvalidArguments(f"bad datapoint: {p!r}")
+                try:
+                    ts = int(p.get("timestamp", 0))
+                    value = float(p.get("value", 0))
+                except (TypeError, ValueError) as e:
+                    raise InvalidArguments(f"bad datapoint {p!r}: {e}") from None
+                ts_ms = ts * 1000 if ts < 10**12 else ts  # s or ms heuristic
+                tags = {
+                    (str(k) + "_tag" if str(k) in ("ts", "val") else str(k)):
+                        str(v)
+                    for k, v in (p.get("tags") or {}).items()
+                }
+                # metric names commonly contain dots (sys.cpu.user), which
+                # SQL would read as db.table — sanitize to an identifier
+                per_table[_safe_table(str(p["metric"]))].append(
+                    (tags, value, ts_ms)
+                )
+            total = 0
+            for table, rows in per_table.items():
+                tag_names = sorted({k for t, _v, _ts in rows for k in t})
+                cols: dict[str, list] = {k: [] for k in tag_names}
+                cols["ts"] = []
+                cols["val"] = []
+                for tags, val, ts in rows:
+                    for k in tag_names:
+                        cols[k].append(tags.get(k, ""))
+                    cols["ts"].append(ts)
+                    cols["val"].append(val)
+                cols["__tags__"] = tag_names
+                cols["__fields__"] = ["val"]
+                total += _ingest_columns(self.db, table, cols)
+            return total
+
+        try:
+            n = await self._call(run)
+            M_INGEST_ROWS.labels("opentsdb").inc(n)
+            return web.Response(status=204)
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_es_info(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "name": "greptimedb-tpu", "cluster_name": "greptimedb",
+            "version": {"number": "8.15.0"}, "tagline": "You Know, for Search",
+        })
+
+    async def h_es_license(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"license": {"status": "active", "type": "basic"}})
+
+    async def h_es_bulk(self, request: web.Request) -> web.Response:
+        """Elasticsearch _bulk emulation for Logstash/Filebeat (reference
+        src/servers/src/elasticsearch.rs): NDJSON action/document pairs;
+        documents land in a table named after the index."""
+        raw = (await request.read()).decode("utf-8")
+        default_index = request.match_info.get("index") or request.query.get(
+            "index", "es_logs")
+        t0 = time.perf_counter()
+
+        def run():
+            from collections import defaultdict
+
+            per_table: dict[str, list[dict]] = defaultdict(list)
+            index = default_index
+            expect_doc = False
+            for line in raw.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    # a bad document line must consume its action slot, or
+                    # the next action line would be misread as a document
+                    expect_doc = False
+                    continue
+                if not expect_doc:
+                    action = next(iter(doc), "")
+                    if action in ("index", "create"):
+                        index = (doc[action] or {}).get("_index", default_index)
+                        expect_doc = True
+                    continue
+                expect_doc = False
+                per_table[_safe_table(index)].append(doc)
+            total = 0
+            now_ms = int(time.time() * 1000)
+            for table, docs in per_table.items():
+                rows = []
+                for d in docs:
+                    ts = now_ms
+                    for key in ("@timestamp", "timestamp"):
+                        if key in d:
+                            try:
+                                from greptimedb_tpu.query.parser import (
+                                    parse_timestamp_str,
+                                )
+
+                                ts = parse_timestamp_str(
+                                    str(d[key]).replace("T", " ").rstrip("Z"))
+                            except Exception:  # noqa: BLE001
+                                pass
+                            break
+                    rows.append((ts, json.dumps(d)))
+                cols = {
+                    "__tags__": [], "__fields__": ["doc"],
+                    "ts": [r[0] for r in rows],
+                    "doc": [r[1] for r in rows],
+                }
+                total += _ingest_columns(self.db, table, cols)
+            return total
+
+        try:
+            n = await self._call(run)
+            M_INGEST_ROWS.labels("elasticsearch").inc(n)
+            took = int((time.perf_counter() - t0) * 1000)
+            return web.json_response({"took": took, "errors": False,
+                                      "items": []})
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_splunk_hec(self, request: web.Request) -> web.Response:
+        """Splunk HTTP Event Collector (reference src/servers/src/http/
+        splunk.rs): concatenated JSON events {time, event, fields,
+        sourcetype}."""
+        raw = (await request.read()).decode("utf-8")
+
+        def run():
+            from greptimedb_tpu.errors import InvalidArguments
+
+            dec = json.JSONDecoder()
+            events = []
+            pos = 0
+            s = raw.strip()
+            while pos < len(s):
+                while pos < len(s) and s[pos].isspace():
+                    pos += 1
+                if pos >= len(s):
+                    break
+                try:
+                    obj, end = dec.raw_decode(s, pos)
+                except json.JSONDecodeError as e:
+                    raise InvalidArguments(f"bad HEC payload: {e}") from None
+                events.append(obj)
+                pos = end
+            rows = []
+            for e in events:
+                if not isinstance(e, dict):
+                    continue
+                t = e.get("time")
+                ts_ms = (
+                    int(float(t) * 1000) if t is not None
+                    else int(time.time() * 1000)
+                )
+                ev = e.get("event")
+                line = ev if isinstance(ev, str) else json.dumps(ev)
+                rows.append((str(e.get("sourcetype", "")), line, ts_ms))
+            if not rows:
+                return 0
+            cols = {
+                "__tags__": ["sourcetype"], "__fields__": ["event"],
+                "sourcetype": [r[0] for r in rows],
+                "ts": [r[2] for r in rows],
+                "event": [r[1] for r in rows],
+            }
+            return _ingest_columns(self.db, "splunk_events", cols)
+
+        try:
+            n = await self._call(run)
+            M_INGEST_ROWS.labels("splunk").inc(n)
+            return web.json_response({"text": "Success", "code": 0})
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
     def _pipelines(self):
         from greptimedb_tpu.servers.pipeline import PipelineManager
 
@@ -614,6 +814,11 @@ def _parse_prom_duration(raw) -> float:
         from greptimedb_tpu.query.parser import parse_interval_str
 
         return parse_interval_str(str(raw)) / 1000.0
+
+
+def _safe_table(name: str) -> str:
+    out = "".join(ch if (ch.isalnum() or ch == "_") else "_" for ch in name)
+    return out or "es_logs"
 
 
 def _ingest_columns(db, table: str, cols: dict) -> int:

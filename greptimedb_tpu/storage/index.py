@@ -1,0 +1,120 @@
+"""Skipping indexes: per-SST bloom filters over tag columns.
+
+Reference: src/index/src/bloom_filter/ + the puffin blob container
+(SURVEY.md §2.5) — indexes are built at flush/compaction time and prune
+SSTs (and eventually row groups) before any Parquet IO. Here each SST gets
+one sidecar blob (``<file_id>.idx``) holding a bloom filter per tag
+column; ``Region.scan_host`` consults them for equality/IN predicates.
+
+Read-path consumers: cold scans that bypass the HBM-resident cache
+(exports, range-restricted scans over beyond-HBM tables). The resident
+query path deliberately loads whole regions once and filters on device, so
+it does not pass tag_filters; wiring planner-extracted filters into
+range-restricted scans lands with the beyond-HBM work.
+
+Bloom layout: double hashing with two crc32-derived hashes (Kirsch-
+Mitzenmacher), bit array in numpy uint64 words, target ~1% false positives
+(10 bits/key, 7 hashes).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+
+import numpy as np
+
+BITS_PER_KEY = 10
+NUM_HASHES = 7
+_MAGIC = b"GTIX1\n"
+
+
+class BloomFilter:
+    def __init__(self, num_bits: int, bits: np.ndarray | None = None):
+        self.num_bits = max(int(num_bits), 64)
+        words = (self.num_bits + 63) // 64
+        self.bits = (
+            bits if bits is not None else np.zeros(words, dtype=np.uint64)
+        )
+
+    @staticmethod
+    def for_keys(n: int) -> "BloomFilter":
+        return BloomFilter(max(n, 1) * BITS_PER_KEY)
+
+    def _hashes(self, value: str) -> tuple[int, int]:
+        data = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        h1 = zlib.crc32(data)
+        h2 = zlib.crc32(data, 0x9E3779B9) | 1  # odd => full period
+        return h1, h2
+
+    def add(self, value) -> None:
+        h1, h2 = self._hashes(str(value))
+        for i in range(NUM_HASHES):
+            bit = (h1 + i * h2) % self.num_bits
+            self.bits[bit >> 6] |= np.uint64(1 << (bit & 63))
+
+    def might_contain(self, value) -> bool:
+        h1, h2 = self._hashes(str(value))
+        for i in range(NUM_HASHES):
+            bit = (h1 + i * h2) % self.num_bits
+            if not (int(self.bits[bit >> 6]) >> (bit & 63)) & 1:
+                return False
+        return True
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("<I", self.num_bits) + self.bits.tobytes()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "BloomFilter":
+        (num_bits,) = struct.unpack_from("<I", raw, 0)
+        bits = np.frombuffer(raw[4:], dtype=np.uint64).copy()
+        return BloomFilter(num_bits, bits)
+
+
+def build_sst_index(columns: dict[str, np.ndarray], tag_names: list[str]) -> bytes:
+    """Serialize per-tag-column blooms for one SST (the puffin blob)."""
+    blobs: dict[str, bytes] = {}
+    for name in tag_names:
+        if name not in columns:
+            continue
+        uniq = np.unique(columns[name].astype(object))
+        bf = BloomFilter.for_keys(len(uniq))
+        for v in uniq:
+            bf.add(v)
+        blobs[name] = bf.to_bytes()
+    header = json.dumps(
+        {name: len(b) for name, b in blobs.items()}
+    ).encode("utf-8")
+    out = _MAGIC + struct.pack("<I", len(header)) + header
+    for name in sorted(blobs):
+        out += blobs[name]
+    return out
+
+
+def load_sst_index(raw: bytes) -> dict[str, BloomFilter]:
+    if not raw.startswith(_MAGIC):
+        raise ValueError("bad index blob magic")
+    (hlen,) = struct.unpack_from("<I", raw, len(_MAGIC))
+    off = len(_MAGIC) + 4
+    header = json.loads(raw[off:off + hlen])
+    off += hlen
+    out = {}
+    for name in sorted(header):
+        ln = header[name]
+        out[name] = BloomFilter.from_bytes(raw[off:off + ln])
+        off += ln
+    return out
+
+
+def sst_may_match(
+    index: dict[str, BloomFilter], tag_filters: dict[str, set]
+) -> bool:
+    """False only when some filtered column's bloom excludes EVERY value."""
+    for col, values in tag_filters.items():
+        bf = index.get(col)
+        if bf is None or not values:
+            continue
+        if not any(bf.might_contain(v) for v in values):
+            return False
+    return True

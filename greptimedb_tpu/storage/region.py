@@ -79,6 +79,7 @@ class Region:
             tuple(codes): i for i, codes in enumerate(manifest.state.series)
         }
         self.generation = 0  # bumped on any data mutation; cache key
+        self._index_cache: dict[str, dict] = {}  # file_id -> column blooms
 
     # ------------------------------------------------------------------
     @property
@@ -197,6 +198,7 @@ class Region:
         flushed_seq = self.memtable.max_seq
         # storage keeps ts as int64 epoch in schema unit
         meta = write_sst(self.store, f"{self._dir}/sst", self.schema, frozen)
+        self._write_sst_index(meta, frozen)
         self.manifest.commit(
             {
                 "kind": "dicts",
@@ -285,6 +287,7 @@ class Region:
             self.store, f"{self._dir}/sst", self.schema, merged,
             level=max(m.level for m in files) + 1,
         )
+        self._write_sst_index(new_meta, merged)
         self.manifest.commit(
             {
                 "kind": "edit",
@@ -294,6 +297,8 @@ class Region:
         )
         for m in files:
             self.store.delete(m.path)
+            self.store.delete(self._index_path(m))
+            self._index_cache.pop(m.file_id, None)
         self.generation += 1
         return new_meta
 
@@ -309,30 +314,67 @@ class Region:
     def truncate(self) -> None:
         for m in self.sst_files:
             self.store.delete(m.path)
+            self.store.delete(self._index_path(m))
+        self._index_cache.clear()
         self.manifest.commit({"kind": "truncate", "truncated_seq": self.next_seq - 1})
         self.memtable = Memtable(self.schema)
         self.generation += 1
+
+    # ---- skipping index -------------------------------------------------
+    def _index_path(self, meta) -> str:
+        return f"{self._dir}/sst/{meta.file_id}.idx"
+
+    def _write_sst_index(self, meta, columns: dict[str, np.ndarray]) -> None:
+        from greptimedb_tpu.storage.index import build_sst_index
+
+        tag_names = self.tag_names
+        if not tag_names:
+            return
+        self.store.write(
+            self._index_path(meta), build_sst_index(columns, tag_names)
+        )
+
+    def _sst_index(self, meta) -> dict | None:
+        from greptimedb_tpu.storage.index import load_sst_index
+
+        cached = self._index_cache.get(meta.file_id)
+        if cached is not None:
+            return cached
+        if not self.store.exists(self._index_path(meta)):
+            return None  # pre-index SSTs: no pruning
+        idx = load_sst_index(self.store.read(self._index_path(meta)))
+        self._index_cache[meta.file_id] = idx
+        return idx
 
     # ---- read path -----------------------------------------------------
     def scan_host(
         self,
         ts_range: tuple[int | None, int | None] = (None, None),
         columns: list[str] | None = None,
+        tag_filters: dict[str, set] | None = None,
     ) -> dict[str, np.ndarray]:
         """Merged, deduped host columns for the requested time range.
 
-        Sources: SSTs overlapping the range (file + row-group pruned) and
-        the live memtable. Dedup keep-max-seq across sources; tombstones
-        applied then dropped.
+        Sources: SSTs overlapping the range (file-level time pruning, bloom
+        skipping-index pruning on ``tag_filters`` equality/IN sets, then
+        Parquet row-group pruning) and the live memtable. Dedup
+        keep-max-seq across sources; tombstones applied then dropped.
         """
+        from greptimedb_tpu.storage.index import sst_may_match
+
         want = None
         if columns is not None:
             internal = [TSID, SEQ, OP, self.ts_name]
             want = list(dict.fromkeys(columns + internal))
         parts: list[dict[str, np.ndarray]] = []
         for m in self.sst_files:
-            if m.overlaps(*ts_range):
-                parts.append(read_sst(self.store, m, self.schema, ts_range, want))
+            if not m.overlaps(*ts_range):
+                continue
+            if tag_filters:
+                idx = self._sst_index(m)
+                if idx is not None and not sst_may_match(idx, tag_filters):
+                    continue
+            parts.append(read_sst(self.store, m, self.schema, ts_range, want))
         internal = (TSID, SEQ, OP)
         schema_cols = {c.name for c in self.schema}
         eff_want = want if want is not None else list(schema_cols) + list(internal)

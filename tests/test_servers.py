@@ -438,3 +438,86 @@ class TestOtlpAndLoki:
         finally:
             srv.stop()
             db.close()
+
+
+class TestMoreProtocols:
+    def test_opentsdb_put(self, server):
+        pts = [{"metric": "sys_cpu", "timestamp": 1700000000,
+                "value": 42.5, "tags": {"host": "web01"}},
+               {"metric": "sys_cpu", "timestamp": 1700000010,
+                "value": 43.0, "tags": {"host": "web01"}}]
+        code, _ = http(server, "/v1/opentsdb/api/put", method="POST",
+                       body=json.dumps(pts).encode())
+        assert code == 204
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT host, val FROM sys_cpu ORDER BY ts"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert rows == [["web01", 42.5], ["web01", 43.0]]
+        code, _ = http(server, "/v1/opentsdb/api/put", method="POST",
+                       body=b"[{\"nope\": 1}]")
+        assert code == 400
+
+    def test_es_bulk(self, server):
+        nd = (
+            '{"index": {"_index": "app-logs"}}\n'
+            '{"@timestamp": "2026-01-01T00:00:00Z", "message": "hello"}\n'
+            '{"create": {"_index": "app-logs"}}\n'
+            '{"@timestamp": "2026-01-01T00:00:01Z", "message": "world"}\n'
+        )
+        code, raw = http(server, "/v1/elasticsearch/_bulk", method="POST",
+                         body=nd.encode())
+        assert code == 200 and json.loads(raw)["errors"] is False
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT doc FROM app_logs ORDER BY ts"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert len(rows) == 2 and "hello" in rows[0][0]
+        code, raw = http(server, "/v1/elasticsearch/")
+        assert json.loads(raw)["version"]["number"].startswith("8.")
+
+    def test_splunk_hec(self, server):
+        events = (
+            '{"time": 1700000000.5, "sourcetype": "access",'
+            ' "event": "GET /"}'
+            '{"time": 1700000001, "sourcetype": "access",'
+            ' "event": {"msg": "structured"}}'
+        )
+        code, raw = http(server, "/v1/splunk/services/collector",
+                         method="POST", body=events.encode())
+        assert code == 200 and json.loads(raw)["code"] == 0
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT sourcetype, event FROM splunk_events ORDER BY ts"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert rows[0] == ["access", "GET /"]
+        assert "structured" in rows[1][1]
+
+    def test_opentsdb_reserved_tag_and_bad_ts(self, server):
+        pts = [{"metric": "rm1", "timestamp": 1700000000, "value": 1.0,
+                "tags": {"ts": "x", "val": "y"}}]
+        code, _ = http(server, "/v1/opentsdb/api/put", method="POST",
+                       body=json.dumps(pts).encode())
+        assert code == 204
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT ts_tag, val_tag, val FROM rm1"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert rows == [["x", "y", 1.0]]
+        code, _ = http(server, "/v1/opentsdb/api/put", method="POST",
+                       body=b'{"metric":"m","timestamp":"abc","value":1}')
+        assert code == 400
+
+    def test_es_bulk_desync_recovery(self, server):
+        nd = ('{"index": {"_index": "dsync"}}\n'
+              'not json at all {{{\n'
+              '{"index": {"_index": "dsync"}}\n'
+              '{"@timestamp": "2026-01-01T00:00:00Z", "message": "real"}\n')
+        code, _ = http(server, "/v1/elasticsearch/_bulk", method="POST",
+                       body=nd.encode())
+        assert code == 200
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT doc FROM dsync"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert len(rows) == 1 and "real" in rows[0][0]
+
+    def test_splunk_bad_payload(self, server):
+        code, _ = http(server, "/v1/splunk/services/collector",
+                       method="POST", body=b'{"time":1} {{{garbage')
+        assert code == 400
