@@ -119,6 +119,13 @@ class HttpServer:
         r.add_post("/v1/influxdb/write", self.h_influx_write)
         r.add_post("/v1/otlp/v1/metrics", self.h_otlp_metrics)
         r.add_post("/v1/loki/api/v1/push", self.h_loki_push)
+        r.add_post("/v1/otlp/v1/traces", self.h_otlp_traces)
+        r.add_get("/v1/jaeger/api/services", self.h_jaeger_services)
+        r.add_get("/v1/jaeger/api/operations", self.h_jaeger_operations)
+        r.add_get("/v1/jaeger/api/services/{service}/operations",
+                  self.h_jaeger_service_operations)
+        r.add_get("/v1/jaeger/api/traces/{trace_id}", self.h_jaeger_trace)
+        r.add_get("/v1/jaeger/api/traces", self.h_jaeger_find)
         r.add_post("/v1/opentsdb/api/put", self.h_opentsdb_put)
         r.add_post("/v1/elasticsearch/_bulk", self.h_es_bulk)
         r.add_post("/v1/elasticsearch/{index}/_bulk", self.h_es_bulk)
@@ -434,6 +441,107 @@ class HttpServer:
             n = await self._call(run)
             M_INGEST_ROWS.labels("loki").inc(n)
             return web.Response(status=204)
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_otlp_traces(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.servers.trace import TRACE_TABLE, parse_otlp_traces
+
+        try:
+            body = await request.read()
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": f"body: {e}"}, status=400)
+
+        def run():
+            cols = parse_otlp_traces(body)
+            if not cols:
+                return 0
+            return _ingest_columns(self.db, TRACE_TABLE, cols)
+
+        try:
+            n = await self._call(run)
+            M_INGEST_ROWS.labels("otlp_traces").inc(n)
+            return web.json_response({"partialSuccess": {}})
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_jaeger_services(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.servers.trace import jaeger_services
+
+        try:
+            data = await self._call(jaeger_services, self.db)
+            return web.json_response({"data": data, "total": len(data),
+                                      "limit": 0, "offset": 0, "errors": None})
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_jaeger_operations(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.servers.trace import jaeger_operations
+
+        service = request.query.get("service", "")
+        try:
+            data = await self._call(jaeger_operations, self.db, service)
+            return web.json_response({"data": data, "total": len(data),
+                                      "errors": None})
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_jaeger_service_operations(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.servers.trace import jaeger_operations
+
+        service = request.match_info["service"]
+        try:
+            data = await self._call(jaeger_operations, self.db, service)
+            names = [d["name"] for d in data]
+            return web.json_response({"data": names, "total": len(names),
+                                      "errors": None})
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_jaeger_trace(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.servers.trace import jaeger_trace
+
+        trace_id = request.match_info["trace_id"]
+        try:
+            data = await self._call(jaeger_trace, self.db, trace_id)
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+        if not data:
+            return web.json_response(
+                {"data": [], "errors": [{"code": 404, "msg": "trace not found"}]},
+                status=404)
+        return web.json_response({"data": data, "errors": None})
+
+    async def h_jaeger_find(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.servers.trace import jaeger_find_traces
+
+        q = request.query
+
+        def run():
+            return jaeger_find_traces(
+                self.db,
+                service=q.get("service"),
+                operation=q.get("operation"),
+                start_us=int(q["start"]) if "start" in q else None,
+                end_us=int(q["end"]) if "end" in q else None,
+                min_duration_us=(
+                    _parse_go_duration_us(q["minDuration"])
+                    if "minDuration" in q else None
+                ),
+                limit=int(q.get("limit", "20")),
+            )
+
+        try:
+            data = await self._call(run)
+            return web.json_response({"data": data, "errors": None})
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
         except Exception as e:  # noqa: BLE001
             body_json, status = _error_json(e)
             return web.json_response(body_json, status=status)
@@ -814,6 +922,16 @@ def _parse_prom_duration(raw) -> float:
         from greptimedb_tpu.query.parser import parse_interval_str
 
         return parse_interval_str(str(raw)) / 1000.0
+
+
+def _parse_go_duration_us(raw: str) -> int:
+    """Go-style duration (Jaeger minDuration): '100ms', '2s', '50us', '1m'."""
+    s = raw.strip().lower()
+    for suffix, mult in (("us", 1), ("µs", 1), ("ms", 1000),
+                         ("m", 60_000_000), ("s", 1_000_000), ("h", 3_600_000_000)):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * mult)
+    return int(float(s))  # bare number: microseconds
 
 
 def _safe_table(name: str) -> str:

@@ -23,23 +23,39 @@ from collections import defaultdict
 from greptimedb_tpu.servers.protocols import _pb_fields
 
 
-def _kv_attr(data: bytes) -> tuple[str, str]:
-    key = ""
-    value = ""
+def parse_any_value(data: bytes):
+    """opentelemetry.proto.common.v1.AnyValue → typed python value."""
     for f, _wt, v in _pb_fields(data):
         if f == 1:
-            key = v.decode("utf-8")
-        elif f == 2:  # AnyValue
-            for f2, wt2, v2 in _pb_fields(v):
-                if f2 == 1:
-                    value = v2.decode("utf-8")
-                elif f2 == 2:
-                    value = "true" if v2 else "false"
-                elif f2 == 3:
-                    value = str(_signed(v2))
-                elif f2 == 4:
-                    value = repr(struct.unpack("<d", v2)[0])
+            return v.decode("utf-8", "replace")
+        if f == 2:
+            return bool(v)
+        if f == 3:
+            return _signed(v)
+        if f == 4:
+            return struct.unpack("<d", v)[0]
+    return None
+
+
+def parse_key_value(data: bytes) -> tuple[str, object]:
+    """opentelemetry.proto.common.v1.KeyValue → (key, typed value)."""
+    key = ""
+    value = None
+    for f, _wt, v in _pb_fields(data):
+        if f == 1:
+            key = v.decode("utf-8", "replace")
+        elif f == 2:
+            value = parse_any_value(v)
     return key, value
+
+
+def _kv_attr(data: bytes) -> tuple[str, str]:
+    key, value = parse_key_value(data)
+    if isinstance(value, bool):
+        return key, "true" if value else "false"
+    if isinstance(value, float):
+        return key, repr(value)
+    return key, "" if value is None else str(value)
 
 
 def _signed(v: int) -> int:

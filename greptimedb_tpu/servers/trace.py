@@ -1,0 +1,249 @@
+"""Traces: OTLP trace ingest + Jaeger query API support.
+
+Reference: src/servers/src/otlp/trace/ stores spans as wide events in an
+``opentelemetry_traces`` table; src/servers/src/http/jaeger.rs serves the
+Jaeger HTTP API (services/operations/traces) from that table.
+
+Table shape here: service_name TAG; ts = span start (ms); fields:
+trace_id/span_id/parent_span_id (hex strings), span_name, span_kind,
+duration_nano, status_code, attributes (JSON string).
+
+Span search (by service/operation/time/duration) runs host-side over the
+region scan — the Jaeger API is an admin/debug surface, not the hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from collections import defaultdict
+
+from greptimedb_tpu.servers.protocols import _pb_fields
+
+TRACE_TABLE = "opentelemetry_traces"
+
+_KIND = {0: "SPAN_KIND_UNSPECIFIED", 1: "SPAN_KIND_INTERNAL",
+         2: "SPAN_KIND_SERVER", 3: "SPAN_KIND_CLIENT",
+         4: "SPAN_KIND_PRODUCER", 5: "SPAN_KIND_CONSUMER"}
+_STATUS = {0: "STATUS_CODE_UNSET", 1: "STATUS_CODE_OK", 2: "STATUS_CODE_ERROR"}
+
+
+def _attrs(kvs: list[bytes]) -> dict:
+    from greptimedb_tpu.servers.otlp import parse_key_value
+
+    out = {}
+    for kv in kvs:
+        key, val = parse_key_value(kv)
+        if key:
+            out[key] = val
+    return out
+
+
+def parse_otlp_traces(body: bytes) -> dict[str, list]:
+    """ExportTraceServiceRequest → columnar rows for the traces table."""
+    rows = []
+    for f, _wt, rs in _pb_fields(body):
+        if f != 1:  # resource_spans
+            continue
+        service = ""
+        resource_attrs: dict = {}
+        scope_spans = []
+        for f2, _wt2, v2 in _pb_fields(rs):
+            if f2 == 1:  # Resource
+                kvs = [v3 for f3, _w, v3 in _pb_fields(v2) if f3 == 1]
+                resource_attrs = _attrs(kvs)
+                service = str(resource_attrs.get("service.name", ""))
+            elif f2 == 2:
+                scope_spans.append(v2)
+        for ss in scope_spans:
+            for f3, _wt3, span in _pb_fields(ss):
+                if f3 != 2:
+                    continue
+                trace_id = span_id = parent = ""
+                name = ""
+                kind = 0
+                start_ns = end_ns = 0
+                attr_kvs: list[bytes] = []
+                status_code = 0
+                for f4, _wt4, v4 in _pb_fields(span):
+                    if f4 == 1:
+                        trace_id = v4.hex()
+                    elif f4 == 2:
+                        span_id = v4.hex()
+                    elif f4 == 4:
+                        parent = v4.hex()
+                    elif f4 == 5:
+                        name = v4.decode("utf-8", "replace")
+                    elif f4 == 6:
+                        kind = v4 if isinstance(v4, int) else 0
+                    elif f4 == 7:
+                        start_ns = struct.unpack("<Q", v4)[0]
+                    elif f4 == 8:
+                        end_ns = struct.unpack("<Q", v4)[0]
+                    elif f4 == 9:
+                        attr_kvs.append(v4)
+                    elif f4 == 15:
+                        for f5, _w5, v5 in _pb_fields(v4):
+                            if f5 == 2:
+                                status_code = v5 if isinstance(v5, int) else 0
+                attrs = _attrs(attr_kvs)
+                attrs.update({f"resource.{k}": v
+                              for k, v in resource_attrs.items()
+                              if k != "service.name"})
+                rows.append({
+                    "service_name": service or "unknown",
+                    "ts": start_ns // 1_000_000,
+                    "trace_id": trace_id,
+                    "span_id": span_id,
+                    "parent_span_id": parent,
+                    "span_name": name,
+                    "span_kind": _KIND.get(kind, str(kind)),
+                    "duration_nano": max(end_ns - start_ns, 0),
+                    "status_code": _STATUS.get(status_code, str(status_code)),
+                    "attributes": json.dumps(attrs),
+                })
+    if not rows:
+        return {}
+    cols: dict[str, list] = {
+        "__tags__": ["service_name"],
+        "__fields__": ["trace_id", "span_id", "parent_span_id", "span_name",
+                       "span_kind", "duration_nano", "status_code",
+                       "attributes"],
+    }
+    for key in ["service_name", "ts", "trace_id", "span_id", "parent_span_id",
+                "span_name", "span_kind", "duration_nano", "status_code",
+                "attributes"]:
+        cols[key] = [r[key] for r in rows]
+    return cols
+
+
+# ---------------------------------------------------------------------------
+# Jaeger API formatting
+# ---------------------------------------------------------------------------
+
+def _scan_spans(db, columns: list[str] | None = None) -> list[dict]:
+    try:
+        region = db._table_view(TRACE_TABLE)
+    except Exception:  # noqa: BLE001 (no traces ingested yet)
+        return []
+    host = region.scan_host(columns=columns)
+    n = len(host["ts"])
+    return [
+        {k: host[k][i] for k in host if not k.startswith("__")}
+        for i in range(n)
+    ]
+
+
+def jaeger_services(db) -> list[str]:
+    return sorted({
+        str(s["service_name"])
+        for s in _scan_spans(db, columns=["service_name"])
+    })
+
+
+def jaeger_operations(db, service: str) -> list[dict]:
+    ops = sorted({
+        (str(s["span_name"]), str(s["span_kind"]))
+        for s in _scan_spans(db, columns=["service_name", "span_name",
+                                          "span_kind"])
+        if str(s["service_name"]) == service
+    })
+    return [{"name": n, "spanKind": k.replace("SPAN_KIND_", "").lower()}
+            for n, k in ops]
+
+
+def _span_to_jaeger(s: dict, process_id: str) -> dict:
+    attrs = {}
+    try:
+        attrs = json.loads(s.get("attributes") or "{}")
+    except json.JSONDecodeError:
+        pass
+    tags = [
+        {"key": k, "type": "string", "value": str(v)}
+        for k, v in attrs.items()
+    ]
+    tags.append({"key": "span.kind", "type": "string",
+                 "value": str(s["span_kind"]).replace("SPAN_KIND_", "").lower()})
+    refs = []
+    if s.get("parent_span_id"):
+        refs.append({"refType": "CHILD_OF", "traceID": str(s["trace_id"]),
+                     "spanID": str(s["parent_span_id"])})
+    return {
+        "traceID": str(s["trace_id"]),
+        "spanID": str(s["span_id"]),
+        "operationName": str(s["span_name"]),
+        "references": refs,
+        "startTime": int(s["ts"]) * 1000,  # jaeger wants microseconds
+        "duration": int(s["duration_nano"]) // 1000,
+        "tags": tags,
+        "logs": [],
+        "processID": process_id,
+    }
+
+
+def _traces_payload(spans_by_trace: dict[str, list[dict]]) -> list[dict]:
+    out = []
+    for trace_id, spans in spans_by_trace.items():
+        # one process entry per service so multi-service traces attribute
+        # each span to ITS service
+        services = sorted({str(s["service_name"]) for s in spans})
+        pid_of = {svc: f"p{i + 1}" for i, svc in enumerate(services)}
+        processes = {
+            pid: {"serviceName": svc, "tags": []}
+            for svc, pid in pid_of.items()
+        }
+        out.append({
+            "traceID": trace_id,
+            "spans": [
+                _span_to_jaeger(s, pid_of[str(s["service_name"])])
+                for s in spans
+            ],
+            "processes": processes,
+        })
+    return out
+
+
+def jaeger_trace(db, trace_id: str) -> list[dict]:
+    spans = [s for s in _scan_spans(db) if str(s["trace_id"]) == trace_id]
+    if not spans:
+        return []
+    return _traces_payload({trace_id: spans})
+
+
+def jaeger_find_traces(
+    db,
+    service: str | None = None,
+    operation: str | None = None,
+    start_us: int | None = None,
+    end_us: int | None = None,
+    min_duration_us: int | None = None,
+    limit: int = 20,
+) -> list[dict]:
+    by_trace: dict[str, list[dict]] = defaultdict(list)
+    for s in _scan_spans(db):
+        by_trace[str(s["trace_id"])].append(s)
+    matches: list[tuple[int, str]] = []
+    for tid, spans in by_trace.items():
+        ok = True
+        if service is not None and not any(
+            str(s["service_name"]) == service for s in spans
+        ):
+            ok = False
+        if ok and operation is not None and not any(
+            str(s["span_name"]) == operation for s in spans
+        ):
+            ok = False
+        t0 = min(int(s["ts"]) for s in spans)
+        if ok and start_us is not None and t0 * 1000 < start_us:
+            ok = False
+        if ok and end_us is not None and t0 * 1000 > end_us:
+            ok = False
+        if ok and min_duration_us is not None and not any(
+            int(s["duration_nano"]) // 1000 >= min_duration_us for s in spans
+        ):
+            ok = False
+        if ok:
+            matches.append((t0, tid))
+    matches.sort(reverse=True)
+    selected = {tid: by_trace[tid] for _t, tid in matches[:limit]}
+    return _traces_payload(selected)

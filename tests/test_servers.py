@@ -521,3 +521,105 @@ class TestMoreProtocols:
         code, _ = http(server, "/v1/splunk/services/collector",
                        method="POST", body=b'{"time":1} {{{garbage')
         assert code == 400
+
+
+def _otlp_traces_request():
+    """ExportTraceServiceRequest: 2 spans in one trace + 1 in another."""
+    def kv(key, sval):
+        return _pb_len(1, key.encode()) + _pb_len(2, _pb_len(1, sval.encode()))
+
+    def fixed64(field, value):
+        return _pb_varint((field << 3) | 1) + struct.pack("<Q", value)
+
+    t0 = 1700000000 * 10**9
+
+    def span(tid, sid, parent, name, start, dur, kind=2):
+        s = (_pb_len(1, bytes.fromhex(tid)) + _pb_len(2, bytes.fromhex(sid))
+             + (_pb_len(4, bytes.fromhex(parent)) if parent else b"")
+             + _pb_len(5, name.encode())
+             + _pb_varint(6 << 3) + _pb_varint(kind)
+             + fixed64(7, start) + fixed64(8, start + dur)
+             + _pb_len(9, kv("http.method", "GET")))
+        return _pb_len(2, s)
+
+    tid1 = "0102030405060708090a0b0c0d0e0f10"
+    tid2 = "1112131415161718191a1b1c1d1e1f20"
+    spans = (span(tid1, "0102030405060708", "", "GET /api", t0, 50_000_000)
+             + span(tid1, "1112131415161718", "0102030405060708", "db.query",
+                    t0 + 10**7, 20_000_000, kind=3)
+             + span(tid2, "2122232425262728", "", "GET /other", t0 + 10**9,
+                    5_000_000))
+    # ScopeSpans message = concatenated field-2 Span entries; ResourceSpans
+    # wraps it once as ITS field 2
+    resource = _pb_len(1, kv("service.name", "api-server"))
+    rs = _pb_len(1, resource) + _pb_len(2, spans)
+    return _pb_len(1, rs), tid1, tid2
+
+
+class TestTraces:
+    def test_otlp_traces_and_jaeger_api(self, server):
+        body, tid1, tid2 = _otlp_traces_request()
+        code, raw = http(server, "/v1/otlp/v1/traces", method="POST", body=body)
+        assert code == 200, raw
+        # services
+        code, raw = http(server, "/v1/jaeger/api/services")
+        assert "api-server" in json.loads(raw)["data"]
+        # operations
+        code, raw = http(server, "/v1/jaeger/api/operations?service=api-server")
+        names = {o["name"] for o in json.loads(raw)["data"]}
+        assert {"GET /api", "db.query", "GET /other"} <= names
+        # get one trace
+        code, raw = http(server, f"/v1/jaeger/api/traces/{tid1}")
+        assert code == 200
+        data = json.loads(raw)["data"]
+        assert len(data) == 1 and len(data[0]["spans"]) == 2
+        span = next(s for s in data[0]["spans"] if s["operationName"] == "GET /api")
+        assert span["duration"] == 50_000
+        child = next(s for s in data[0]["spans"] if s["operationName"] == "db.query")
+        assert child["references"][0]["spanID"] == "0102030405060708"
+        # search with filters
+        q = urllib.parse.urlencode({"service": "api-server",
+                                    "operation": "GET /other"})
+        code, raw = http(server, f"/v1/jaeger/api/traces?{q}")
+        data = json.loads(raw)["data"]
+        assert [t["traceID"] for t in data] == [tid2]
+        # min duration filter excludes the short trace
+        q = urllib.parse.urlencode({"service": "api-server",
+                                    "minDuration": "40000us"})
+        code, raw = http(server, f"/v1/jaeger/api/traces?{q}")
+        assert [t["traceID"] for t in json.loads(raw)["data"]] == [tid1]
+        # unknown trace -> 404
+        code, _ = http(server, "/v1/jaeger/api/traces/" + "00" * 16)
+        assert code == 404
+        # spans also queryable via plain SQL
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT count(*) FROM opentelemetry_traces"}))
+        assert json.loads(raw)["output"][0]["records"]["rows"] == [[3]]
+
+    def test_go_duration_units(self):
+        from greptimedb_tpu.servers.http import _parse_go_duration_us
+
+        assert _parse_go_duration_us("50us") == 50
+        assert _parse_go_duration_us("100ms") == 100_000
+        assert _parse_go_duration_us("2s") == 2_000_000
+        assert _parse_go_duration_us("1m") == 60_000_000
+        assert _parse_go_duration_us("250") == 250
+
+    def test_multi_service_trace_processes(self):
+        from greptimedb_tpu.servers.trace import _traces_payload
+
+        spans = [
+            {"service_name": "web", "trace_id": "t1", "span_id": "a",
+             "parent_span_id": "", "span_name": "GET /", "span_kind":
+             "SPAN_KIND_SERVER", "ts": 1, "duration_nano": 1000,
+             "status_code": "STATUS_CODE_OK", "attributes": "{}"},
+            {"service_name": "auth", "trace_id": "t1", "span_id": "b",
+             "parent_span_id": "a", "span_name": "check", "span_kind":
+             "SPAN_KIND_CLIENT", "ts": 2, "duration_nano": 500,
+             "status_code": "STATUS_CODE_OK", "attributes": "{}"},
+        ]
+        out = _traces_payload({"t1": spans})
+        procs = out[0]["processes"]
+        by_op = {s["operationName"]: s["processID"] for s in out[0]["spans"]}
+        assert procs[by_op["GET /"]]["serviceName"] == "web"
+        assert procs[by_op["check"]]["serviceName"] == "auth"
