@@ -68,14 +68,31 @@ class QueryEngine:
         self.executor = Executor()
 
     # ------------------------------------------------------------------
-    def execute_select(self, sel: Select) -> QueryResult:
+    def execute_select(self, sel: Select, metrics: dict | None = None) -> QueryResult:
+        import time as _time
+
         if sel.table is None:
             return self._execute_tableless(sel)
+
+        def mark(name, t0):
+            if metrics is not None:
+                metrics[name] = round((_time.perf_counter() - t0) * 1000, 3)
+            return _time.perf_counter()
+
+        t = _time.perf_counter()
         ctx = self.provider.table_context(sel.table)
         plan = plan_select(sel, ctx)
+        t = mark("plan_ms", t)
         table, ts_bounds = self.provider.device_table(sel.table, plan)
+        t = mark("scan_cache_ms", t)
         env, n = self.executor.execute(plan, table, ts_bounds)
-        return self._shape(plan, env, n)
+        t = mark("device_exec_ms", t)
+        result = self._shape(plan, env, n)
+        mark("shape_ms", t)
+        if metrics is not None:
+            metrics["output_rows"] = len(result.rows)
+            metrics["scanned_rows_padded"] = table.padded_rows
+        return result
 
     def explain(self, sel: Select) -> str:
         if sel.table is None:

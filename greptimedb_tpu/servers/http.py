@@ -119,6 +119,7 @@ class HttpServer:
         r.add_post("/v1/influxdb/write", self.h_influx_write)
         r.add_post("/v1/otlp/v1/metrics", self.h_otlp_metrics)
         r.add_post("/v1/loki/api/v1/push", self.h_loki_push)
+        r.add_post("/v1/logs", self.h_log_query)
         r.add_post("/v1/otlp/v1/traces", self.h_otlp_traces)
         r.add_get("/v1/jaeger/api/services", self.h_jaeger_services)
         r.add_get("/v1/jaeger/api/operations", self.h_jaeger_operations)
@@ -441,6 +442,25 @@ class HttpServer:
             n = await self._call(run)
             M_INGEST_ROWS.labels("loki").inc(n)
             return web.Response(status=204)
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_log_query(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.servers.logquery import execute_log_query
+
+        t0 = time.perf_counter()
+        try:
+            query = json.loads(await request.read())
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": f"bad json: {e}"}, status=400)
+        try:
+            res = await self._call(execute_log_query, self.db, query)
+            return web.json_response(_result_to_json(res, t0))
+        except (AttributeError, TypeError, KeyError) as e:
+            # malformed-but-parseable request shapes are client errors
+            return web.json_response({"error": f"bad log query: {e}"},
+                                     status=400)
         except Exception as e:  # noqa: BLE001
             body_json, status = _error_json(e)
             return web.json_response(body_json, status=status)

@@ -1,0 +1,123 @@
+"""Log query API: structured log search DSL over log tables.
+
+Reference: src/log-query (660 LoC) + src/servers/src/http/logs.rs — a JSON
+DSL (table, time_filter, column filters, limit) compiled to a plan. Here
+the DSL evaluates host-side over the region scan: log search is
+string-matching territory, which stays off the device by design.
+
+Request shape (subset of the reference's LogQuery):
+{
+  "table": {"schema": "public", "table": "loki_logs"},
+  "time_filter": {"start": "2026-01-01T00:00:00Z", "end": "..."},
+  "filters": [{"column": "line", "filters": [
+      {"contains": "error"} | {"prefix": "GET"} | {"regex": "..."} |
+      {"exists": true} | {"eq": "value"}
+  ]}],
+  "columns": ["ts", "line", "app"],   # optional projection
+  "limit": {"fetch": 100, "skip": 0}
+}
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from greptimedb_tpu.errors import InvalidArguments
+from greptimedb_tpu.query.engine import QueryResult
+from greptimedb_tpu.query.parser import parse_timestamp_str
+
+
+def _parse_time(v) -> int | None:
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return int(v)
+    return parse_timestamp_str(str(v))
+
+
+def _match(cond: dict, values: np.ndarray) -> np.ndarray:
+    strs = np.asarray([("" if v is None else str(v)) for v in values],
+                      dtype=object)
+    n = len(strs)
+    if "contains" in cond:
+        needle = str(cond["contains"])
+        return np.array([needle in s for s in strs], dtype=bool)
+    if "prefix" in cond:
+        p = str(cond["prefix"])
+        return np.array([s.startswith(p) for s in strs], dtype=bool)
+    if "regex" in cond:
+        try:
+            rx = re.compile(str(cond["regex"]))
+        except re.error as e:
+            raise InvalidArguments(f"bad regex {cond['regex']!r}: {e}") from None
+        return np.array([rx.search(s) is not None for s in strs], dtype=bool)
+    if "eq" in cond:
+        return np.asarray(strs == str(cond["eq"]), dtype=bool).reshape(n)
+    if "exists" in cond:
+        has = np.array([s != "" for s in strs], dtype=bool)
+        return has if cond["exists"] else ~has
+    raise InvalidArguments(f"unknown log filter {cond!r}")
+
+
+def execute_log_query(db, query: dict) -> QueryResult:
+    if not isinstance(query, dict):
+        raise InvalidArguments("log query body must be a JSON object")
+    tbl = query.get("table") or {}
+    name = tbl.get("table")
+    if not name:
+        raise InvalidArguments("log query needs table.table")
+    schema_name = tbl.get("schema", "public")
+    full = f"{schema_name}.{name}" if schema_name != db.current_db else name
+
+    view = db._table_view(full)
+    ts_name = view.schema.time_index.name
+    tf = query.get("time_filter") or {}
+    lo = _parse_time(tf.get("start"))
+    hi = _parse_time(tf.get("end"))
+    # scan only what the filters + projection touch
+    needed: set[str] = set()
+    for f in query.get("filters") or []:
+        if f.get("column"):
+            needed.add(str(f["column"]))
+    if query.get("columns"):
+        needed.update(str(c) for c in query["columns"])
+    # without an explicit projection the response returns every column, so
+    # only restrict the scan when the caller named its columns
+    want = sorted(needed | {ts_name}) if query.get("columns") else None
+    host = view.scan_host((lo, hi), columns=want)
+    n = len(host[ts_name])
+    keep = np.ones(n, dtype=bool)
+    for f in query.get("filters") or []:
+        col = f.get("column")
+        if col not in host:
+            raise InvalidArguments(f"unknown filter column {col!r}")
+        for cond in f.get("filters") or []:
+            keep &= _match(cond, host[col])
+    idx = np.nonzero(keep)[0]
+    # newest first, like the reference's default ordering for log search
+    order = np.argsort(host[ts_name][idx].astype(np.int64))[::-1]
+    idx = idx[order]
+    lim = query.get("limit") or {}
+    skip = int(lim.get("skip", 0))
+    fetch = lim.get("fetch")
+    idx = idx[skip: skip + int(fetch)] if fetch is not None else idx[skip:]
+
+    columns = query.get("columns")
+    if columns:
+        bad = [c for c in columns if c not in host]
+        if bad:
+            raise InvalidArguments(f"unknown columns {bad}")
+        names = list(columns)
+    else:
+        names = [c.name for c in view.schema]
+    rows = []
+    for i in idx.tolist():
+        row = []
+        for c in names:
+            v = host[c][i]
+            row.append(int(v) if isinstance(v, np.integer) else
+                       float(v) if isinstance(v, np.floating) else v)
+        rows.append(row)
+    return QueryResult(names, rows)

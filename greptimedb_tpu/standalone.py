@@ -551,10 +551,22 @@ class GreptimeDB(TableProvider):
             text = f"TQL {stmt.inner.command} (promql planning)"
         else:
             text = f"{type(stmt.inner).__name__}"
-        return QueryResult(
-            ["plan_type", "plan"],
-            [["logical_plan (tpu)", text]],
-        )
+        rows = [["logical_plan (tpu)", text]]
+        if stmt.analyze and isinstance(stmt.inner, Select):
+            # EXPLAIN ANALYZE (reference DistAnalyzeExec): run the query and
+            # report per-stage wall times + row counts
+            metrics: dict = {}
+            self.engine.execute_select(stmt.inner, metrics=metrics)
+            # run once more for warm (compiled) numbers — the first run may
+            # include XLA compilation
+            warm: dict = {}
+            self.engine.execute_select(stmt.inner, metrics=warm)
+            lines = [
+                f"{k}: {metrics[k]} (warm: {warm.get(k, '-')})"
+                for k in metrics
+            ]
+            rows.append(["analyze (cold vs warm ms)", "\n".join(lines)])
+        return QueryResult(["plan_type", "plan"], rows)
 
     # ---- TQL / flows (wired in later milestones) -----------------------
     def _execute_tql(self, stmt: Tql) -> QueryResult:

@@ -623,3 +623,79 @@ class TestTraces:
         by_op = {s["operationName"]: s["processID"] for s in out[0]["spans"]}
         assert procs[by_op["GET /"]]["serviceName"] == "web"
         assert procs[by_op["check"]]["serviceName"] == "auth"
+
+
+class TestLogQueryApi:
+    def test_log_query_dsl(self):
+        db = GreptimeDB()
+        srv = HttpServer(db, port=0)
+        srv.start()
+        try:
+            payload = {"streams": [{
+                "stream": {"app": "web"},
+                "values": [
+                    ["1700000000000000000", "GET /index ok"],
+                    ["1700000001000000000", "error: boom"],
+                    ["1700000002000000000", "GET /health ok"],
+                ]}]}
+            http(srv, "/v1/loki/api/v1/push", method="POST",
+                 body=json.dumps(payload).encode(),
+                 headers={"Content-Type": "application/json"})
+            q = {
+                "table": {"schema": "public", "table": "loki_logs"},
+                "filters": [{"column": "line",
+                             "filters": [{"contains": "error"}]}],
+                "columns": ["ts", "line"],
+                "limit": {"fetch": 10},
+            }
+            code, raw = http(srv, "/v1/logs", method="POST",
+                             body=json.dumps(q).encode())
+            assert code == 200, raw
+            rec = json.loads(raw)["output"][0]["records"]
+            assert rec["rows"] == [[1700000001000, "error: boom"]]
+            # prefix + newest-first ordering + limit
+            q2 = {"table": {"table": "loki_logs"},
+                  "filters": [{"column": "line",
+                               "filters": [{"prefix": "GET"}]}],
+                  "columns": ["line"], "limit": {"fetch": 1}}
+            code, raw = http(srv, "/v1/logs", method="POST",
+                             body=json.dumps(q2).encode())
+            rows = json.loads(raw)["output"][0]["records"]["rows"]
+            assert rows == [["GET /health ok"]]
+            # bad column -> 400
+            q3 = {"table": {"table": "loki_logs"},
+                  "filters": [{"column": "nope", "filters": [{"eq": "x"}]}]}
+            code, _ = http(srv, "/v1/logs", method="POST",
+                           body=json.dumps(q3).encode())
+            assert code == 400
+        finally:
+            srv.stop()
+            db.close()
+
+    def test_log_query_empty_and_malformed(self):
+        db = GreptimeDB()
+        srv = HttpServer(db, port=0)
+        srv.start()
+        try:
+            db.sql("CREATE TABLE el (app STRING, ts TIMESTAMP(3) TIME INDEX,"
+                   " line STRING, PRIMARY KEY (app))")
+            # empty table + contains filter: zero rows, not a 500
+            q = {"table": {"table": "el"},
+                 "filters": [{"column": "line",
+                              "filters": [{"contains": "x"}]}]}
+            code, raw = http(srv, "/v1/logs", method="POST",
+                             body=json.dumps(q).encode())
+            assert code == 200, raw
+            assert json.loads(raw)["output"][0]["records"]["rows"] == []
+            # bad regex -> 400
+            q["filters"][0]["filters"] = [{"regex": "("}]
+            code, _ = http(srv, "/v1/logs", method="POST",
+                           body=json.dumps(q).encode())
+            assert code == 400
+            # non-object body -> 400
+            code, _ = http(srv, "/v1/logs", method="POST", body=b"[1, 2]")
+            assert code == 400
+        finally:
+            srv.stop()
+            db.close()
+

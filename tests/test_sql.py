@@ -555,3 +555,13 @@ class TestStringFieldRegressions:
             phys.jax.default_backend = orig
         assert res.rows[-1][1] == 99.0  # last bucket max intact
         assert res.rows[0][2] == 0.0
+
+
+class TestExplainAnalyze:
+    def test_stage_metrics(self, cpu):
+        r = cpu.sql("EXPLAIN ANALYZE SELECT hostname, avg(usage_user)"
+                    " FROM cpu GROUP BY hostname")
+        assert len(r.rows) == 2
+        text = r.rows[1][1]
+        for key in ("plan_ms", "device_exec_ms", "shape_ms", "output_rows"):
+            assert key in text
