@@ -327,10 +327,25 @@ class HttpServer:
                 return web.json_response({"error": f"snappy: {e}"}, status=400)
 
         def run():
+            from greptimedb_tpu.errors import InvalidArguments
+
             tables = parse_remote_write(body)
             total = 0
             for table, cols in tables.items():
-                total += _ingest_columns(self.db, table, cols)
+                # Prometheus metrics multiplex onto the metric engine's
+                # physical region (reference default for remote write);
+                # names already taken by plain tables fall back to them so
+                # one conflicting metric can't wedge the whole batch
+                name = _safe_table(table)
+                try:
+                    total += self.db.metric_engine.write(name, cols)
+                except InvalidArguments:
+                    total += _ingest_columns(self.db, name, cols)
+            if self.db.flow_engine.flows:
+                for table, cols in tables.items():
+                    self.db.flow_engine.on_write(_safe_table(table),
+                                                 cols["ts"])
+                self.db.flow_engine.run_all()
             return total
 
         try:

@@ -70,6 +70,13 @@ class CombinedRegionView:
         self._refresh()
         return len(self._series)
 
+    def ts_bounds(self) -> tuple[int, int] | None:
+        bounds = [b for b in (r.ts_bounds() for r in self.regions)
+                  if b is not None]
+        if not bounds:
+            return None
+        return (min(b[0] for b in bounds), max(b[1] for b in bounds))
+
     def _refresh(self) -> None:
         """(Re)build combined dictionaries deterministically: region order,
         then each region's insertion order — stable for append-only dicts."""
@@ -159,6 +166,9 @@ class GreptimeDB(TableProvider):
         from greptimedb_tpu.flow.engine import FlowEngine
 
         self.flow_engine = FlowEngine(self)
+        from greptimedb_tpu.storage.metric_engine import MetricEngine
+
+        self.metric_engine = MetricEngine(self)
 
     def close(self) -> None:
         self.regions.close()
@@ -185,7 +195,10 @@ class GreptimeDB(TableProvider):
         return self._regions_of(table)[0]
 
     def _table_view(self, table: str):
-        """Region for single-region tables; merge view for partitioned."""
+        """Region, partitioned merge view, or metric-engine logical view."""
+        db, name = self._split_name(table)
+        if self.metric_engine.is_logical(db, name):
+            return self.metric_engine.view(db, name)
         regions = self._regions_of(table)
         if len(regions) == 1:
             return regions[0]
@@ -220,16 +233,7 @@ class GreptimeDB(TableProvider):
     def device_table(self, table: str, plan: SelectPlan):
         view = self._table_view(table)
         dt = self.cache.get(view)
-        regions = view.regions if isinstance(view, CombinedRegionView) else [view]
-        lo = hi = None
-        for region in regions:
-            if region.memtable.ts_min is not None:
-                lo = region.memtable.ts_min if lo is None else min(lo, region.memtable.ts_min)
-                hi = region.memtable.ts_max if hi is None else max(hi, region.memtable.ts_max)
-            for m in region.sst_files:
-                lo = m.ts_min if lo is None else min(lo, m.ts_min)
-                hi = m.ts_max if hi is None else max(hi, m.ts_max)
-        return dt, (lo if lo is not None else 0, hi if hi is not None else 0)
+        return dt, view.ts_bounds() or (0, 0)
 
     # ---- SQL entry -----------------------------------------------------
     def sql(self, query: str) -> QueryResult:
@@ -315,6 +319,12 @@ class GreptimeDB(TableProvider):
             self.current_db = stmt.database
             return QueryResult([], [])
         if isinstance(stmt, TruncateTable):
+            db, name = self._split_name(stmt.table)
+            if self.metric_engine.is_logical(db, name):
+                raise Unsupported(
+                    "TRUNCATE on a metric-engine logical table (the region "
+                    "is shared across metrics)"
+                )
             for region in self._regions_of(stmt.table):
                 region.truncate()
             return QueryResult([], [], affected_rows=0)
@@ -364,8 +374,31 @@ class GreptimeDB(TableProvider):
         return QueryResult([], [], affected_rows=0)
 
     def _drop_table(self, stmt: DropTable) -> QueryResult:
+        from greptimedb_tpu.storage.metric_engine import PHYSICAL_TABLE
+
         for full in stmt.names:
             db, name = self._split_name(full)
+            try:
+                existing = self.catalog.get_table(db, name)
+            except TableNotFound:
+                existing = None
+            if existing is not None and existing.engine == "metric":
+                # logical metric table: drop METADATA only — the region is
+                # shared with every other metric (its rows are reclaimed by
+                # compaction GC later, like the reference's metric engine)
+                self.catalog.drop_table(db, name, stmt.if_exists)
+                self.cache.invalidate_region(
+                    -(1 << 50) - existing.table_id
+                )
+                continue
+            if existing is not None and existing.engine == "metric_physical":
+                logical = [t for t in self.catalog.list_tables(db)
+                           if t.engine == "metric"]
+                if logical:
+                    raise InvalidArguments(
+                        f"cannot drop {PHYSICAL_TABLE}: {len(logical)} logical "
+                        "metric tables still reference it"
+                    )
             info = self.catalog.drop_table(db, name, stmt.if_exists)
             if info is not None:
                 for rid in info.region_ids:

@@ -76,6 +76,11 @@ class ManifestState:
                 cur = self.dicts.setdefault(col, [])
                 cur.extend(vals[len(cur):])
             self.series.extend(action.get("series", [])[len(self.series):])
+        elif kind == "reset_dicts":
+            # wholesale replacement: series keys change ARITY when a tag
+            # column is added online, which append-only growth cannot express
+            self.dicts = dict(action.get("dicts", {}))
+            self.series = list(action.get("series", []))
         elif kind == "truncate":
             self.files.clear()
             self.truncated_seq = action["truncated_seq"]

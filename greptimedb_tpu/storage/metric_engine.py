@@ -1,0 +1,240 @@
+"""Metric engine: many logical metric tables on one physical region.
+
+Reference: src/metric-engine (SURVEY.md §2.4, RFC 2023-07-10-metric-engine)
+— Prometheus workloads create one table per metric name; at 10k+ metrics,
+one region/WAL/manifest per table drowns the system in per-table overhead.
+The metric engine multiplexes all of them onto a single physical region by
+injecting a ``__metric__`` tag (the reference injects __table_id/__tsid via
+its row modifiers) and evolving one shared label-column superset online.
+
+TPU significance: a SINGLE resident DeviceTable holds every metric's
+samples, so cross-metric PromQL evaluation shares one (tsid, ts)-sorted
+tensor and one kernel cache — the 10M-series design from SURVEY §5.7.
+
+Logical tables are catalog entries with engine="metric" pointing at the
+physical table; reads go through LogicalMetricView, which duck-types the
+Region surface the planners consume while hiding ``__metric__`` and
+restricting the series registry to the metric's own series.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from greptimedb_tpu.datatypes.batch import DictionaryEncoder
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+from greptimedb_tpu.datatypes.types import ConcreteDataType, SemanticType
+from greptimedb_tpu.errors import InvalidArguments
+
+METRIC_COLUMN = "__metric__"
+PHYSICAL_TABLE = "greptime_physical_table"
+
+
+def physical_schema() -> Schema:
+    return Schema((
+        ColumnSchema(METRIC_COLUMN, ConcreteDataType.STRING, SemanticType.TAG,
+                     nullable=False),
+        ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND,
+                     SemanticType.TIMESTAMP, nullable=False),
+        ColumnSchema("val", ConcreteDataType.FLOAT64, SemanticType.FIELD),
+    ))
+
+
+class MetricEngine:
+    """Facade owned by the standalone instance."""
+
+    def __init__(self, db):
+        self.db = db
+
+    # ---- physical table ------------------------------------------------
+    def physical_region(self):
+        dbname = self.db.current_db
+        if not self.db.catalog.table_exists(dbname, PHYSICAL_TABLE):
+            info = self.db.catalog.create_table(
+                dbname, PHYSICAL_TABLE, physical_schema(),
+                engine="metric_physical", if_not_exists=True,
+            )
+            if info is not None:
+                self.db.regions.create_region(info.region_ids[0],
+                                              physical_schema())
+        info = self.db.catalog.get_table(dbname, PHYSICAL_TABLE)
+        return self.db._open_or_create(info.region_ids[0], info.schema)
+
+    # ---- logical tables ------------------------------------------------
+    def ensure_logical(self, metric: str, tag_names: list[str]) -> None:
+        """Register/extend a logical table and grow the physical label set."""
+        region = self.physical_region()
+        for t in tag_names:
+            if t == METRIC_COLUMN:
+                raise InvalidArguments(f"{METRIC_COLUMN} is reserved")
+            if not region.schema.has_column(t):
+                region.add_tag_column(t)
+        dbname = self.db.current_db
+        cols = [ColumnSchema(t, ConcreteDataType.STRING, SemanticType.TAG)
+                for t in tag_names]
+        cols.append(ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND,
+                                 SemanticType.TIMESTAMP, nullable=False))
+        cols.append(ColumnSchema("val", ConcreteDataType.FLOAT64,
+                                 SemanticType.FIELD))
+        schema = Schema(tuple(cols))
+        if not self.db.catalog.table_exists(dbname, metric):
+            info = self.db.catalog.create_table(
+                dbname, metric, schema, engine="metric", if_not_exists=True,
+            )
+            # logical tables share the physical region
+            if info is not None:
+                phys = self.db.catalog.get_table(dbname, PHYSICAL_TABLE)
+                info.region_ids = list(phys.region_ids)
+                self.db.catalog.update_table(info)
+        else:
+            info = self.db.catalog.get_table(dbname, metric)
+            if info.engine != "metric":
+                raise InvalidArguments(
+                    f"table {metric} exists with engine {info.engine}"
+                )
+            known = {c.name for c in info.schema}
+            grown = False
+            for t in tag_names:
+                if t not in known:
+                    info.schema = Schema(
+                        (info.schema.columns[0:0]
+                         + tuple(c for c in info.schema if c.is_tag)
+                         + (ColumnSchema(t, ConcreteDataType.STRING,
+                                         SemanticType.TAG),)
+                         + tuple(c for c in info.schema if not c.is_tag)),
+                        version=info.schema.version + 1,
+                    )
+                    grown = True
+            if grown:
+                self.db.catalog.update_table(info)
+
+    def write(self, metric: str, cols: dict) -> int:
+        """Route one metric's batch into the physical region."""
+        tag_names = list(cols.get("__tags__") or [])
+        self.ensure_logical(metric, tag_names)
+        region = self.physical_region()
+        n = len(cols["ts"])
+        data = {METRIC_COLUMN: [metric] * n, "ts": cols["ts"],
+                "val": cols["val"]}
+        for t in tag_names:
+            data[t] = cols[t]
+        region.write(data)
+        return n
+
+    def is_logical(self, dbname: str, table: str) -> bool:
+        try:
+            return self.db.catalog.get_table(dbname, table).engine == "metric"
+        except Exception:  # noqa: BLE001
+            return False
+
+    def view(self, dbname: str, metric: str) -> "LogicalMetricView":
+        info = self.db.catalog.get_table(dbname, metric)
+        cache = getattr(self.db, "_metric_views", None)
+        if cache is None:
+            cache = self.db._metric_views = {}
+        key = (dbname, metric)
+        v = cache.get(key)
+        if v is None or v.schema.version != info.schema.version:
+            v = LogicalMetricView(self, metric, info.schema, info.table_id)
+            cache[key] = v
+        return v
+
+
+class LogicalMetricView:
+    """One metric's slice of the physical region, duck-typing Region for
+    the planners (schema/encoders/_series/num_series/generation/scan_host/
+    region_id/tag_names)."""
+
+    def __init__(self, engine: MetricEngine, metric: str, schema: Schema,
+                 table_id: int):
+        self.engine = engine
+        self.metric = metric
+        self.schema = schema
+        self.physical = engine.physical_region()
+        # table_id-derived: collision-free, disjoint from real region ids
+        # (positive) and CombinedRegionView ids (-(hash%2^40)-1)
+        self.region_id = -(1 << 50) - table_id
+        self._built_for: int | None = None
+        self.encoders: dict[str, DictionaryEncoder] = {}
+        self._series: dict[tuple, int] = {}
+        self._refresh()
+
+    @property
+    def generation(self) -> int:
+        return self.physical.generation
+
+    @property
+    def tag_names(self) -> list[str]:
+        return [c.name for c in self.schema.tag_columns]
+
+    @property
+    def num_series(self) -> int:
+        self._refresh()
+        return len(self._series)
+
+    def ts_bounds(self):
+        # physical-wide bounds: per-metric bounds would need per-series time
+        # stats; queries with WHERE time ranges override these anyway
+        return self.physical.ts_bounds()
+
+    def _refresh(self) -> None:
+        """Project the physical series registry onto this metric's tags.
+
+        Logical tsids must be stable under physical growth: they are
+        assigned in physical-tsid order and only appended.
+        """
+        gen = self.physical.generation
+        if self._built_for == gen:
+            return
+        phys = self.physical
+        metric_enc = phys.encoders[METRIC_COLUMN]
+        my_code = metric_enc.get(self.metric)
+        phys_tags = phys.tag_names
+        col_pos = {name: i for i, name in enumerate(phys_tags)}
+        metric_pos = col_pos[METRIC_COLUMN]
+        self.encoders = {
+            name: phys.encoders[name] for name in self.tag_names
+        }
+        mine = self._series
+        self._tsid_map: dict[int, int] = getattr(self, "_tsid_map", {})
+        for key, ptsid in sorted(phys._series.items(), key=lambda kv: kv[1]):
+            if my_code < 0 or key[metric_pos] != my_code:
+                continue
+            if ptsid in self._tsid_map:
+                continue
+            lkey = tuple(key[col_pos[t]] for t in self.tag_names)
+            if lkey not in mine:
+                mine[lkey] = len(mine)
+            self._tsid_map[ptsid] = mine[lkey]
+        self._built_for = gen
+
+    def scan_host(self, ts_range=(None, None), columns=None, tag_filters=None):
+        self._refresh()
+        filters = dict(tag_filters or {})
+        filters[METRIC_COLUMN] = {self.metric}
+        want = None
+        if columns is not None:
+            want = list(dict.fromkeys(list(columns) + [METRIC_COLUMN]))
+        host = self.physical.scan_host(ts_range, want, filters)
+        sel = host[METRIC_COLUMN] == self.metric  # vectorized object-eq
+        from greptimedb_tpu.storage.memtable import TSID
+
+        out = {}
+        for k, v in host.items():
+            if k == METRIC_COLUMN:
+                continue
+            if columns is not None and k not in want and not k.startswith("__"):
+                continue
+            out[k] = v[sel]
+        # physical tsid -> logical tsid via a dense lookup table
+        tmap = self._tsid_map
+        max_p = max(tmap) if tmap else -1
+        lookup = np.full(max_p + 2, -1, dtype=np.int64)
+        for p, l in tmap.items():
+            lookup[p] = l
+        ptsid = np.clip(out[TSID].astype(np.int64), 0, max_p + 1)
+        out[TSID] = lookup[ptsid]
+        keep = out[TSID] >= 0
+        if not keep.all():
+            out = {k: v[keep] for k, v in out.items()}
+        return out

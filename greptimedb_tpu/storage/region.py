@@ -114,38 +114,21 @@ class Region:
                 (enc.get_or_insert(v) for v in uniq), dtype=np.int64, count=len(uniq)
             )
             code_arrays.append(codes[inv])
-        # pack codes into one int64 key; bail to tuple keys if it could overflow
-        packable = len(code_arrays) <= 3 and all(
-            len(self.encoders[n]) < 2**20 for n in tag_cols
-        )
-        if packable:
-            packed = code_arrays[0].copy()
-            for codes in code_arrays[1:]:
-                packed = packed * (2**20) + codes
-            uniq_keys, inv2 = np.unique(packed, return_inverse=True)
-            # first occurrence row per unique key (vectorized)
-            first_row = np.full(len(uniq_keys), len(packed), dtype=np.int64)
-            np.minimum.at(first_row, inv2, np.arange(len(packed)))
-            tsids = np.empty(len(uniq_keys), dtype=np.int64)
-            for j in range(len(uniq_keys)):
-                row = int(first_row[j])
-                key = tuple(int(c[row]) for c in code_arrays)
-                tsid = self._series.get(key)
-                if tsid is None:
-                    tsid = len(self._series)
-                    self._series[key] = tsid
-                tsids[j] = tsid
-            return tsids[inv2]
-        # fallback: python tuple keys, row at a time (rare: >3 tags or huge dicts)
-        out = np.empty(n, dtype=np.int64)
-        for i in range(n):
-            key = tuple(int(c[i]) for c in code_arrays)
+        # vectorized any-arity series resolution: unique rows of the stacked
+        # code matrix, then a small python loop over UNIQUE keys only (the
+        # metric-engine physical region routinely has many tag columns, so
+        # no per-row python fallback is acceptable on the ingest hot path)
+        code_mat = np.stack(code_arrays, axis=1)  # [n, k] int64
+        uniq_rows, inv2 = np.unique(code_mat, axis=0, return_inverse=True)
+        tsids = np.empty(len(uniq_rows), dtype=np.int64)
+        for j in range(len(uniq_rows)):
+            key = tuple(int(c) for c in uniq_rows[j])
             tsid = self._series.get(key)
             if tsid is None:
                 tsid = len(self._series)
                 self._series[key] = tsid
-            out[i] = tsid
-        return out
+            tsids[j] = tsid
+        return tsids[inv2.reshape(-1)]
 
     def write(self, data: dict[str, list | np.ndarray], op: int = OP_PUT) -> int:
         """Synchronous write of one row group; returns the sequence."""
@@ -189,6 +172,43 @@ class Region:
     def delete(self, data: dict[str, list | np.ndarray]) -> int:
         """Delete by full key (tags + ts): writes tombstones."""
         return self.write(data, op=OP_DELETE)
+
+    def add_tag_column(self, name: str) -> None:
+        """Online tag addition (reference alter-on-demand for metric-engine
+        labels, src/operator/src/insert.rs + metric engine row_modifier).
+
+        Existing series extend their key with the empty-string code; tsids
+        are preserved, so resident caches/devices stay consistent. Flushes
+        first so every SST is backfillable by schema evolution.
+        """
+        from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+        from greptimedb_tpu.datatypes.types import ConcreteDataType, SemanticType
+
+        if self.schema.has_column(name):
+            return
+        self.flush()
+        new_schema = Schema(
+            self.schema.columns
+            + (ColumnSchema(name, ConcreteDataType.STRING, SemanticType.TAG),),
+            version=self.schema.version + 1,
+        )
+        enc = DictionaryEncoder()
+        empty_code = enc.get_or_insert("")
+        self.encoders[name] = enc
+        # extend every registered series key in place (ids unchanged)
+        self._series = {
+            key + (empty_code,): tsid for key, tsid in self._series.items()
+        }
+        self.schema = new_schema
+        self.memtable.schema = new_schema
+        self.manifest.commit({"kind": "schema", "schema": new_schema.to_dict()})
+        self.manifest.commit({
+            "kind": "reset_dicts",
+            "dicts": {k: e.values() for k, e in self.encoders.items()},
+            "series": [list(k) for k in sorted(self._series,
+                                               key=self._series.get)],
+        })
+        self.generation += 1
 
     # ---- flush / replay ------------------------------------------------
     def flush(self) -> SstMeta | None:
@@ -319,6 +339,18 @@ class Region:
         self.manifest.commit({"kind": "truncate", "truncated_seq": self.next_seq - 1})
         self.memtable = Memtable(self.schema)
         self.generation += 1
+
+    def ts_bounds(self) -> tuple[int, int] | None:
+        """Data time bounds across memtable + SSTs; None when empty (an
+        empty region must not drag a combined view's bounds to epoch 0)."""
+        lo = self.memtable.ts_min
+        hi = self.memtable.ts_max
+        for m in self.sst_files:
+            lo = m.ts_min if lo is None else min(lo, m.ts_min)
+            hi = m.ts_max if hi is None else max(hi, m.ts_max)
+        if lo is None:
+            return None
+        return (lo, hi)
 
     # ---- skipping index -------------------------------------------------
     def _index_path(self, meta) -> str:

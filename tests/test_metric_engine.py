@@ -1,0 +1,162 @@
+"""Metric engine tests: multiplexed logical tables on one physical region."""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.standalone import GreptimeDB
+from greptimedb_tpu.storage.metric_engine import PHYSICAL_TABLE
+
+
+@pytest.fixture
+def db():
+    d = GreptimeDB()
+    yield d
+    d.close()
+
+
+def ingest(db, metric, rows):
+    tag_names = sorted({k for tags, _v, _t in rows for k in tags})
+    cols = {k: [] for k in tag_names}
+    cols["ts"] = []
+    cols["val"] = []
+    for tags, val, ts in rows:
+        for k in tag_names:
+            cols[k].append(tags.get(k, ""))
+        cols["ts"].append(ts)
+        cols["val"].append(val)
+    cols["__tags__"] = tag_names
+    cols["__fields__"] = ["val"]
+    return db.metric_engine.write(metric, cols)
+
+
+class TestMetricEngine:
+    def test_multiplexing_one_physical_region(self, db):
+        ingest(db, "http_requests", [({"pod": "p1"}, 1.0, 1000),
+                                     ({"pod": "p2"}, 2.0, 1000)])
+        ingest(db, "cpu_seconds", [({"core": "0"}, 5.0, 1000)])
+        # one physical region holds everything
+        phys = db.metric_engine.physical_region()
+        assert len(phys.scan_host()["ts"]) == 3
+        # logical tables appear in the catalog with engine=metric
+        infos = {t.name: t for t in db.catalog.list_tables("public")}
+        assert infos["http_requests"].engine == "metric"
+        assert infos["cpu_seconds"].engine == "metric"
+        assert infos[PHYSICAL_TABLE].engine == "metric_physical"
+        # same region ids
+        assert infos["http_requests"].region_ids == infos[PHYSICAL_TABLE].region_ids
+
+    def test_logical_sql_isolation(self, db):
+        ingest(db, "m_a", [({"pod": "p1"}, 1.0, 1000),
+                           ({"pod": "p2"}, 2.0, 2000)])
+        ingest(db, "m_b", [({"pod": "p1"}, 9.0, 1000)])
+        r = db.sql("SELECT pod, val FROM m_a ORDER BY pod")
+        assert r.rows == [["p1", 1.0], ["p2", 2.0]]
+        r = db.sql("SELECT count(*) FROM m_b")
+        assert r.rows == [[1]]
+        r = db.sql("SELECT pod, sum(val) FROM m_a GROUP BY pod ORDER BY pod")
+        assert r.rows == [["p1", 1.0], ["p2", 2.0]]
+
+    def test_label_set_growth(self, db):
+        ingest(db, "m", [({"pod": "p1"}, 1.0, 1000)])
+        ingest(db, "m", [({"pod": "p1", "zone": "eu"}, 2.0, 2000)])
+        r = db.sql("SELECT pod, zone, val FROM m ORDER BY ts")
+        # first sample predates the zone label -> empty string
+        assert r.rows == [["p1", "", 1.0], ["p1", "eu", 2.0]]
+        # distinct series: (p1,"") vs (p1,eu)
+        assert db._table_view("m").num_series == 2
+
+    def test_promql_over_logical_tables(self, db):
+        rows = [({"pod": "p1"}, float(5 * i), i * 10_000) for i in range(60)]
+        ingest(db, "req_total", rows)
+        res = db.sql("TQL EVAL (300, 300, '60') rate(req_total[5m])")
+        assert res.rows[0][-1] == pytest.approx(0.5, rel=1e-5)
+        res = db.sql("TQL EVAL (300, 300, '60') sum by (pod) (rate(req_total[5m]))")
+        assert res.rows[0][0] == "p1"
+
+    def test_tsid_stability_across_growth(self, db):
+        ingest(db, "m", [({"pod": "p1"}, 1.0, 1000)])
+        v1 = db._table_view("m")
+        tsids_before = dict(v1._series)
+        ingest(db, "other_metric", [({"x": "y"}, 1.0, 1000)])
+        ingest(db, "m", [({"pod": "p9"}, 3.0, 3000)])
+        v2 = db._table_view("m")
+        for key, tsid in tsids_before.items():
+            # old keys extended by new physical tags keep their logical ids
+            assert any(
+                k[: len(key)] == key and v == tsid
+                for k, v in v2._series.items()
+            )
+
+    def test_restart_preserves_logical_tables(self, tmp_data_dir):
+        db = GreptimeDB(tmp_data_dir)
+        ingest(db, "m_persist", [({"pod": "p1"}, 7.0, 1000)])
+        db.close()
+        db2 = GreptimeDB(tmp_data_dir)
+        r = db2.sql("SELECT pod, val FROM m_persist")
+        assert r.rows == [["p1", 7.0]]
+        db2.close()
+
+    def test_remote_write_routes_to_metric_engine(self):
+        from greptimedb_tpu.servers import HttpServer
+        from tests.test_servers import http, make_write_request
+        from greptimedb_tpu.utils import snappy
+        import json, urllib.parse
+
+        db = GreptimeDB()
+        srv = HttpServer(db, port=0)
+        srv.start()
+        try:
+            pb = make_write_request([
+                ({"__name__": "mm1", "job": "api"}, [(1.0, 1000)]),
+                ({"__name__": "mm2", "job": "api"}, [(2.0, 1000)]),
+            ])
+            code, _ = http(srv, "/v1/prometheus/write", method="POST",
+                           body=snappy.compress(pb),
+                           headers={"Content-Encoding": "snappy"})
+            assert code == 204
+            infos = {t.name: t.engine for t in db.catalog.list_tables("public")}
+            assert infos["mm1"] == "metric" and infos["mm2"] == "metric"
+            code, raw = http(srv, "/v1/sql?" + urllib.parse.urlencode(
+                {"sql": "SELECT job, val FROM mm1"}))
+            assert json.loads(raw)["output"][0]["records"]["rows"] == [["api", 1.0]]
+        finally:
+            srv.stop()
+            db.close()
+
+    def test_drop_logical_keeps_other_metrics(self, db):
+        ingest(db, "keep_me", [({"pod": "p1"}, 1.0, 1000)])
+        ingest(db, "drop_me", [({"pod": "p1"}, 2.0, 1000)])
+        db.sql("DROP TABLE drop_me")
+        # the other metric's data survives
+        assert db.sql("SELECT val FROM keep_me").rows == [[1.0]]
+        from greptimedb_tpu.errors import TableNotFound
+        with pytest.raises(TableNotFound):
+            db.sql("SELECT * FROM drop_me")
+        # physical cannot be dropped while logical tables exist
+        from greptimedb_tpu.errors import InvalidArguments
+        with pytest.raises(InvalidArguments):
+            db.sql(f"DROP TABLE {PHYSICAL_TABLE}")
+
+    def test_truncate_logical_rejected(self, db):
+        ingest(db, "m_t", [({"pod": "p1"}, 1.0, 1000)])
+        from greptimedb_tpu.errors import Unsupported
+        with pytest.raises(Unsupported):
+            db.sql("TRUNCATE TABLE m_t")
+
+    def test_empty_partition_does_not_zero_bounds(self, db):
+        db.sql("CREATE TABLE eb (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE,"
+               " PRIMARY KEY(h)) PARTITION ON COLUMNS (h) (h < 'm', h >= 'm')")
+        # all rows land in partition 0; partition 1 stays empty
+        db.sql("INSERT INTO eb VALUES ('a', 1700000000000, 1.0)")
+        view = db._table_view("eb")
+        lo, hi = view.ts_bounds()
+        assert lo == 1700000000000  # not dragged to 0 by the empty region
+
+    def test_many_tag_columns_vectorized(self, db):
+        # >3 tags used to hit a per-row python loop; ensure correctness
+        rows = [({"a": f"a{i%3}", "b": f"b{i%2}", "c": "c", "d": f"d{i%5}",
+                  "e": "e"}, float(i), i * 1000) for i in range(100)]
+        ingest(db, "wide_tags", rows)
+        r = db.sql("SELECT count(*) FROM wide_tags")
+        assert r.rows == [[100]]
+        assert db._table_view("wide_tags").num_series == 3 * 2 * 5
