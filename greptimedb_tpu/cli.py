@@ -39,11 +39,31 @@ def cmd_standalone(args) -> int:
         cache_capacity_bytes=opts.storage.cache_capacity_gb << 30,
     )
     host, port = opts.http.addr.rsplit(":", 1)
-    srv = HttpServer(db, host=host, port=int(port))
-    srv.start()
-    print(f"greptimedb-tpu standalone listening on http://{host}:{srv.port} "
-          f"(data_home={opts.storage.data_home}, devices={jax.devices()})")
+    servers = []
     try:
+        srv = HttpServer(db, host=host, port=int(port))
+        srv.start()
+        servers.append(srv)
+        extra = []
+        if opts.mysql.enable:
+            from greptimedb_tpu.servers.mysql import MysqlServer
+
+            mh, mp = opts.mysql.addr.rsplit(":", 1)
+            mysql_srv = MysqlServer(db, host=mh, port=int(mp))
+            mysql_srv.start()
+            servers.append(mysql_srv)
+            extra.append(f"mysql://{mh}:{mysql_srv.port}")
+        if opts.postgres.enable:
+            from greptimedb_tpu.servers.postgres import PostgresServer
+
+            ph, pp = opts.postgres.addr.rsplit(":", 1)
+            pg_srv = PostgresServer(db, host=ph, port=int(pp))
+            pg_srv.start()
+            servers.append(pg_srv)
+            extra.append(f"postgres://{ph}:{pg_srv.port}")
+        print(f"greptimedb-tpu standalone listening on http://{host}:{srv.port}"
+              + (" " + " ".join(extra) if extra else "")
+              + f" (data_home={opts.storage.data_home}, devices={jax.devices()})")
         import signal
         import threading
 
@@ -52,7 +72,9 @@ def cmd_standalone(args) -> int:
         signal.signal(signal.SIGTERM, lambda *a: stop.set())
         stop.wait()
     finally:
-        srv.stop()
+        # protocol servers drain before the database closes under them
+        for s in reversed(servers):
+            s.stop()
         db.close()
     return 0
 

@@ -1,0 +1,262 @@
+"""MySQL wire protocol server (reference: opensrv-mysql fork, port 4002).
+
+Text protocol only (COM_QUERY), protocol 4.1 with mysql_native_password
+auth (accept-all by default, like the reference without a user provider).
+Covers what MySQL clients/drivers need for SELECT/DDL/DML round trips:
+handshake, OK/ERR/EOF packets, column definitions with type mapping,
+text-encoded result rows, COM_PING/COM_QUIT/COM_INIT_DB.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+
+from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.servers.tcp import ThreadedTcpServer
+
+# capability flags
+CLIENT_LONG_PASSWORD = 0x1
+CLIENT_PROTOCOL_41 = 0x200
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x80000
+CLIENT_CONNECT_WITH_DB = 0x8
+CLIENT_DEPRECATE_EOF = 0x1000000
+
+SERVER_CAPS = (
+    CLIENT_LONG_PASSWORD | CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION
+    | CLIENT_PLUGIN_AUTH | CLIENT_CONNECT_WITH_DB
+)
+
+# column types (subset)
+MYSQL_TYPE_LONGLONG = 0x08
+MYSQL_TYPE_DOUBLE = 0x05
+MYSQL_TYPE_VAR_STRING = 0xFD
+MYSQL_TYPE_TIMESTAMP = 0x07
+MYSQL_TYPE_TINY = 0x01
+
+_TYPE_MAP = {
+    "Int8": MYSQL_TYPE_TINY, "Int16": MYSQL_TYPE_LONGLONG,
+    "Int32": MYSQL_TYPE_LONGLONG, "Int64": MYSQL_TYPE_LONGLONG,
+    "UInt8": MYSQL_TYPE_TINY, "UInt16": MYSQL_TYPE_LONGLONG,
+    "UInt32": MYSQL_TYPE_LONGLONG, "UInt64": MYSQL_TYPE_LONGLONG,
+    "Float32": MYSQL_TYPE_DOUBLE, "Float64": MYSQL_TYPE_DOUBLE,
+    "Boolean": MYSQL_TYPE_TINY,
+    # timestamps travel as raw epoch ints in our text rows — declaring them
+    # MYSQL_TYPE_TIMESTAMP would make clients parse them as datetimes
+    "TimestampSecond": MYSQL_TYPE_LONGLONG,
+    "TimestampMillisecond": MYSQL_TYPE_LONGLONG,
+    "TimestampMicrosecond": MYSQL_TYPE_LONGLONG,
+    "TimestampNanosecond": MYSQL_TYPE_LONGLONG,
+}
+
+
+def _lenenc_int(n: int) -> bytes:
+    if n < 251:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def _lenenc_str(s: bytes) -> bytes:
+    return _lenenc_int(len(s)) + s
+
+
+class _Conn:
+    def __init__(self, server: "MysqlServer", reader, writer):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.seq = 0
+        self.caps = 0
+        self.session_db = "public"  # per-connection database
+
+    # ---- packet IO -----------------------------------------------------
+    async def read_packet(self) -> bytes | None:
+        hdr = await self.reader.readexactly(4)
+        ln = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+        self.seq = (hdr[3] + 1) & 0xFF
+        return await self.reader.readexactly(ln) if ln else b""
+
+    def send(self, payload: bytes) -> None:
+        ln = len(payload)
+        self.writer.write(
+            bytes([ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF, self.seq])
+            + payload
+        )
+        self.seq = (self.seq + 1) & 0xFF
+
+    def send_ok(self, affected: int = 0) -> None:
+        self.send(b"\x00" + _lenenc_int(affected) + _lenenc_int(0)
+                  + struct.pack("<HH", 0x0002, 0))  # autocommit, no warnings
+
+    def send_err(self, msg: str, errno: int = 1064, sqlstate: bytes = b"42000") -> None:
+        self.send(b"\xff" + struct.pack("<H", errno) + b"#" + sqlstate
+                  + msg.encode("utf-8")[:400])
+
+    def send_eof(self) -> None:
+        self.send(b"\xfe" + struct.pack("<HH", 0, 0x0002))
+
+    # ---- handshake ------------------------------------------------------
+    async def handshake(self) -> bool:
+        salt = b"12345678901234567890"
+        payload = (
+            b"\x0a" + b"8.4.2-greptimedb-tpu\x00"
+            + struct.pack("<I", threading.get_ident() & 0xFFFFFFFF)
+            + salt[:8] + b"\x00"
+            + struct.pack("<H", SERVER_CAPS & 0xFFFF)
+            + bytes([0x21])  # utf8_general_ci
+            + struct.pack("<H", 0x0002)  # status
+            + struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF)
+            + bytes([21])  # auth data len
+            + b"\x00" * 10
+            + salt[8:] + b"\x00"
+            + b"mysql_native_password\x00"
+        )
+        self.seq = 0
+        self.send(payload)
+        await self.writer.drain()
+        try:
+            resp = await self.read_packet()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return False
+        if resp is None or len(resp) < 32:
+            return False
+        self.caps = struct.unpack("<I", resp[:4])[0]
+        # username at offset 32 (after max_packet, charset, 23 reserved)
+        rest = resp[32:]
+        nul = rest.find(b"\x00")
+        username = rest[:nul].decode("utf-8", "replace") if nul >= 0 else ""
+        # auth verification is a no-op without a user provider (reference
+        # behaviour when auth is not configured)
+        db = None
+        if self.caps & CLIENT_CONNECT_WITH_DB:
+            after = rest[nul + 1:]
+            if after:
+                alen = after[0]
+                after = after[1 + alen:]
+                dbn = after.find(b"\x00")
+                if dbn > 0:
+                    db = after[:dbn].decode("utf-8", "replace")
+        if db:
+            self.session_db = db
+        self.send_ok()
+        await self.writer.drain()
+        return True
+
+    # ---- result sets ----------------------------------------------------
+    def _coldef(self, name: str, type_name: str) -> bytes:
+        mtype = _TYPE_MAP.get(type_name, MYSQL_TYPE_VAR_STRING)
+        charset = 0x3F if mtype != MYSQL_TYPE_VAR_STRING else 0x21
+        return (
+            _lenenc_str(b"def") + _lenenc_str(b"") + _lenenc_str(b"")
+            + _lenenc_str(b"") + _lenenc_str(name.encode("utf-8"))
+            + _lenenc_str(b"") + b"\x0c"
+            + struct.pack("<H", charset) + struct.pack("<I", 1024)
+            + bytes([mtype]) + struct.pack("<H", 0) + bytes([0])
+            + b"\x00\x00"
+        )
+
+    def send_resultset(self, result) -> None:
+        names = result.column_names
+        types = result.column_types or ["String"] * len(names)
+        self.send(_lenenc_int(len(names)))
+        for n, t in zip(names, types):
+            self.send(self._coldef(n, t))
+        self.send_eof()
+        for row in result.rows:
+            out = b""
+            for v in row:
+                if v is None:
+                    out += b"\xfb"
+                elif isinstance(v, bool):
+                    out += _lenenc_str(b"1" if v else b"0")
+                elif isinstance(v, float):
+                    out += _lenenc_str(repr(v).encode())
+                else:
+                    out += _lenenc_str(str(v).encode("utf-8"))
+            self.send(out)
+        self.send_eof()
+
+    # ---- command loop ----------------------------------------------------
+    async def run(self) -> None:
+        if not await self.handshake():
+            self.writer.close()
+            return
+        while True:
+            try:
+                pkt = await self.read_packet()
+            except (asyncio.IncompleteReadError, ConnectionError):
+                break
+            if not pkt:
+                break
+            cmd = pkt[0]
+            if cmd == 0x01:  # COM_QUIT
+                break
+            if cmd == 0x0E:  # COM_PING
+                self.send_ok()
+            elif cmd == 0x02:  # COM_INIT_DB
+                dbname = pkt[1:].decode("utf-8", "replace")
+                try:
+                    await self._query(f"USE {dbname}")
+                except Exception:  # noqa: BLE001 (error already sent)
+                    pass
+            elif cmd == 0x03:  # COM_QUERY
+                sql = pkt[1:].decode("utf-8", "replace")
+                try:
+                    await self._query(sql)
+                except Exception:  # noqa: BLE001 (error already sent)
+                    pass
+            else:
+                self.send_err(f"unsupported command 0x{cmd:02x}", errno=1047,
+                              sqlstate=b"08S01")
+            await self.writer.drain()
+        self.writer.close()
+
+    async def _query(self, sql: str) -> None:
+        loop = asyncio.get_running_loop()
+        stripped = sql.strip().rstrip(";").strip()
+        # common client housekeeping queries
+        low = stripped.lower()
+        if low.startswith(("set ", "commit", "rollback", "start transaction",
+                           "begin")):
+            self.send_ok()
+            return
+        if low in ("select @@version_comment limit 1",):
+            from greptimedb_tpu.query.engine import QueryResult
+
+            self.send_resultset(QueryResult(
+                ["@@version_comment"], [["greptimedb-tpu"]],
+                column_types=["String"]))
+            return
+        try:
+            result, self.session_db = await loop.run_in_executor(
+                self.server._db_executor, self.server.db.sql_in_db,
+                stripped, self.session_db,
+            )
+        except GreptimeError as e:
+            self.send_err(e.msg, errno=1105, sqlstate=b"HY000")
+            raise
+        except Exception as e:  # noqa: BLE001
+            self.send_err(str(e), errno=1105, sqlstate=b"HY000")
+            raise
+        if result.column_names:
+            self.send_resultset(result)
+        else:
+            self.send_ok(result.affected_rows)
+
+
+class MysqlServer(ThreadedTcpServer):
+    """TCP server on the MySQL port (reference default 4002)."""
+
+    name = "greptime-mysql"
+
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 4002):
+        super().__init__(db, host, port)
+
+    async def _handle(self, reader, writer) -> None:
+        await _Conn(self, reader, writer).run()

@@ -1,0 +1,184 @@
+"""PostgreSQL wire protocol server (reference: pgwire 0.40, port 4003).
+
+Protocol v3 simple-query flavor: startup/auth (trust), ParameterStatus,
+RowDescription/DataRow/CommandComplete, ErrorResponse with SQLSTATE,
+ReadyForQuery cycle, Terminate. Enough for psql and simple drivers'
+text-mode queries; the extended (prepared) protocol is a later round.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+
+from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.servers.tcp import ThreadedTcpServer
+
+_OID = {
+    "Boolean": 16, "Int8": 21, "Int16": 21, "Int32": 23, "Int64": 20,
+    "UInt8": 21, "UInt16": 23, "UInt32": 20, "UInt64": 20,
+    "Float32": 700, "Float64": 701,
+    "TimestampSecond": 20, "TimestampMillisecond": 20,
+    "TimestampMicrosecond": 20, "TimestampNanosecond": 20,
+    "String": 25,
+}
+
+
+class _PgConn:
+    def __init__(self, server: "PostgresServer", reader, writer):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.session_db = "public"  # per-connection database
+
+    def _msg(self, tag: bytes, payload: bytes) -> None:
+        self.writer.write(tag + struct.pack(">I", len(payload) + 4) + payload)
+
+    def _ready(self) -> None:
+        self._msg(b"Z", b"I")
+
+    def _error(self, msg: str, code: str = "XX000") -> None:
+        fields = (b"SERROR\x00" + b"C" + code.encode() + b"\x00"
+                  + b"M" + msg.encode("utf-8") + b"\x00" + b"\x00")
+        self._msg(b"E", fields)
+
+    async def startup(self) -> bool:
+        while True:
+            hdr = await self.reader.readexactly(4)
+            ln = struct.unpack(">I", hdr)[0]
+            body = await self.reader.readexactly(ln - 4)
+            code = struct.unpack(">I", body[:4])[0]
+            if code == 80877103:  # SSLRequest → decline
+                self.writer.write(b"N")
+                await self.writer.drain()
+                continue
+            if code == 196608:  # protocol 3.0
+                params = {}
+                parts = body[4:].split(b"\x00")
+                for i in range(0, len(parts) - 1, 2):
+                    if parts[i]:
+                        params[parts[i].decode()] = parts[i + 1].decode()
+                db = params.get("database")
+                if db:
+                    self.session_db = db
+                self._msg(b"R", struct.pack(">I", 0))  # AuthenticationOk
+                for k, v in (("server_version", "16.3 (greptimedb-tpu)"),
+                             ("server_encoding", "UTF8"),
+                             ("client_encoding", "UTF8"),
+                             ("DateStyle", "ISO"),
+                             ("integer_datetimes", "on")):
+                    self._msg(b"S", k.encode() + b"\x00" + v.encode() + b"\x00")
+                self._msg(b"K", struct.pack(">II", 1, 0))
+                self._ready()
+                await self.writer.drain()
+                return True
+            self._error(f"unsupported protocol {code}", "0A000")
+            await self.writer.drain()
+            return False
+
+    def _row_description(self, names, types) -> None:
+        out = struct.pack(">H", len(names))
+        for n, t in zip(names, types):
+            oid = _OID.get(t, 25)
+            out += (n.encode("utf-8") + b"\x00"
+                    + struct.pack(">IhIhih", 0, 0, oid, -1, -1, 0))
+        self._msg(b"T", out)
+
+    def _data_row(self, row) -> None:
+        out = struct.pack(">H", len(row))
+        for v in row:
+            if v is None:
+                out += struct.pack(">i", -1)
+            else:
+                if isinstance(v, bool):
+                    s = b"t" if v else b"f"
+                elif isinstance(v, float):
+                    s = repr(v).encode()
+                else:
+                    s = str(v).encode("utf-8")
+                out += struct.pack(">i", len(s)) + s
+        self._msg(b"D", out)
+
+    async def run(self) -> None:
+        try:
+            if not await self.startup():
+                self.writer.close()
+                return
+            loop = asyncio.get_running_loop()
+            while True:
+                tag = await self.reader.readexactly(1)
+                ln = struct.unpack(">I", await self.reader.readexactly(4))[0]
+                body = await self.reader.readexactly(ln - 4)
+                if tag == b"X":  # Terminate
+                    break
+                if tag != b"Q":
+                    self._error(f"unsupported message {tag!r}", "0A000")
+                    self._ready()
+                    await self.writer.drain()
+                    continue
+                sql = body.rstrip(b"\x00").decode("utf-8", "replace").strip()
+                low = sql.lower().rstrip(";")
+                if not low or low.startswith(("set ", "begin", "commit",
+                                              "rollback", "discard")):
+                    self._msg(b"C", b"SET\x00")
+                    self._ready()
+                    await self.writer.drain()
+                    continue
+                try:
+                    result, self.session_db = await loop.run_in_executor(
+                        self.server._db_executor, self.server.db.sql_in_db,
+                        sql, self.session_db,
+                    )
+                    if result.column_names:
+                        types = (result.column_types
+                                 or ["String"] * len(result.column_names))
+                        self._row_description(result.column_names, types)
+                        for row in result.rows:
+                            self._data_row(row)
+                        self._msg(b"C", f"SELECT {len(result.rows)}\x00".encode())
+                    else:
+                        self._msg(b"C", _complete_tag(low, result) + b"\x00")
+                except GreptimeError as e:
+                    self._error(e.msg, "42000")
+                except Exception as e:  # noqa: BLE001
+                    self._error(str(e))
+                self._ready()
+                await self.writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            self.writer.close()
+
+
+def _complete_tag(low: str, result) -> bytes:
+    """CommandComplete tag by statement kind (drivers parse these)."""
+    if low.startswith("insert"):
+        return f"INSERT 0 {result.affected_rows}".encode()
+    if low.startswith("delete"):
+        return f"DELETE {result.affected_rows}".encode()
+    if low.startswith("create table"):
+        return b"CREATE TABLE"
+    if low.startswith("create"):
+        return b"CREATE"
+    if low.startswith("drop"):
+        return b"DROP"
+    if low.startswith("alter"):
+        return b"ALTER TABLE"
+    if low.startswith("truncate"):
+        return b"TRUNCATE TABLE"
+    if low.startswith("use"):
+        return b"USE"
+    return b"OK"
+
+
+class PostgresServer(ThreadedTcpServer):
+    """TCP server on the PostgreSQL port (reference default 4003)."""
+
+    name = "greptime-pg"
+
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 4003):
+        super().__init__(db, host, port)
+
+    async def _handle(self, reader, writer) -> None:
+        await _PgConn(self, reader, writer).run()

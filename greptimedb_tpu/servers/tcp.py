@@ -1,0 +1,70 @@
+"""Shared lifecycle for threaded asyncio TCP servers (MySQL/Postgres wire).
+
+One place for the loop/thread/executor boilerplate — including propagating
+bind errors out of the daemon thread (a busy port must fail start()
+immediately with the real errno, not a generic timeout).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class ThreadedTcpServer:
+    name = "greptime-tcp"
+
+    def __init__(self, db, host: str, port: int):
+        self.db = db
+        self.host = host
+        self.port = port
+        self._thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = threading.Event()
+        self._start_error: BaseException | None = None
+        self._db_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"{self.name}-db"
+        )
+
+    async def _handle(self, reader, writer) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def start(self) -> None:
+        def run_loop():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            try:
+                server = loop.run_until_complete(
+                    asyncio.start_server(self._handle, self.host, self.port)
+                )
+            except BaseException as e:  # noqa: BLE001
+                self._start_error = e
+                self._started.set()
+                loop.close()
+                return
+            if self.port == 0:
+                self.port = server.sockets[0].getsockname()[1]
+            self._started.set()
+            loop.run_forever()
+            server.close()
+            loop.run_until_complete(server.wait_closed())
+            loop.close()
+
+        self._thread = threading.Thread(target=run_loop, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError(f"{self.name} failed to start (timeout)")
+        if self._start_error is not None:
+            raise RuntimeError(
+                f"{self.name} failed to start: {self._start_error}"
+            ) from self._start_error
+
+    def stop(self) -> None:
+        if self._loop is not None and self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._db_executor.shutdown(wait=True, cancel_futures=True)

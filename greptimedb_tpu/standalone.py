@@ -163,6 +163,13 @@ class GreptimeDB(TableProvider):
         self.engine = QueryEngine(self)
         self.current_db = DEFAULT_DB
         self._views: dict[str, CombinedRegionView] = {}
+        # the storage engine is single-writer (region sequence assignment and
+        # memtable mutation are unsynchronized, like mito2's per-region
+        # worker loop); with three protocol servers calling in, correctness
+        # comes from this lock, not from any particular executor topology
+        import threading as _threading
+
+        self._lock = _threading.RLock()
         from greptimedb_tpu.flow.engine import FlowEngine
 
         self.flow_engine = FlowEngine(self)
@@ -238,13 +245,28 @@ class GreptimeDB(TableProvider):
     # ---- SQL entry -----------------------------------------------------
     def sql(self, query: str) -> QueryResult:
         """Execute one or more statements; returns the LAST result."""
-        stmts = parse_sql(query)
-        if not stmts:
-            return QueryResult([], [])
-        result = QueryResult([], [])
-        for stmt in stmts:
-            result = self.execute_statement(stmt)
-        return result
+        with self._lock:
+            stmts = parse_sql(query)
+            if not stmts:
+                return QueryResult([], [])
+            result = QueryResult([], [])
+            for stmt in stmts:
+                result = self.execute_statement(stmt)
+            return result
+
+    def sql_in_db(self, query: str, dbname: str) -> tuple[QueryResult, str]:
+        """Session-scoped execution for wire-protocol connections: run with
+        ``dbname`` as the current database without leaking the switch to
+        other connections. Returns (result, session db after the call —
+        USE statements move it)."""
+        with self._lock:
+            prev = self.current_db
+            self.current_db = dbname
+            try:
+                result = self.sql(query)
+                return result, self.current_db
+            finally:
+                self.current_db = prev
 
     def execute_statement(self, stmt: Statement) -> QueryResult:
         if isinstance(stmt, Select):

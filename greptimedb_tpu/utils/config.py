@@ -41,10 +41,24 @@ class DeviceOptions:
 
 
 @dataclass
+class MysqlOptions:
+    enable: bool = True
+    addr: str = "127.0.0.1:4002"
+
+
+@dataclass
+class PostgresOptions:
+    enable: bool = True
+    addr: str = "127.0.0.1:4003"
+
+
+@dataclass
 class StandaloneOptions:
     node_id: int = 0
     default_timezone: str = "UTC"
     http: HttpOptions = field(default_factory=HttpOptions)
+    mysql: MysqlOptions = field(default_factory=MysqlOptions)
+    postgres: PostgresOptions = field(default_factory=PostgresOptions)
     wal: WalOptions = field(default_factory=WalOptions)
     storage: StorageOptions = field(default_factory=StorageOptions)
     device: DeviceOptions = field(default_factory=DeviceOptions)

@@ -1,0 +1,201 @@
+"""MySQL wire protocol tests with a minimal hand-rolled 4.1 client."""
+
+import socket
+import struct
+
+import pytest
+
+from greptimedb_tpu.servers.mysql import MysqlServer
+from greptimedb_tpu.standalone import GreptimeDB
+
+
+class MiniMysqlClient:
+    """Just enough of the client side to validate the server's wire format."""
+
+    def __init__(self, port: int):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+        self.seq = 0
+
+    def _read_packet(self) -> bytes:
+        hdr = self._recv(4)
+        ln = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+        self.seq = (hdr[3] + 1) & 0xFF
+        return self._recv(ln)
+
+    def _recv(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    def _send(self, payload: bytes) -> None:
+        ln = len(payload)
+        self.sock.sendall(
+            bytes([ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF, self.seq])
+            + payload
+        )
+        self.seq = (self.seq + 1) & 0xFF
+
+    def connect(self, user: str = "root", database: str | None = None) -> None:
+        greeting = self._read_packet()
+        assert greeting[0] == 0x0A  # protocol 10
+        assert b"greptimedb-tpu" in greeting
+        caps = 0x200 | 0x8000 | 0x1  # protocol41 | secure | long password
+        if database:
+            caps |= 0x8
+        resp = (struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23
+                + user.encode() + b"\x00" + b"\x00")  # empty auth
+        if database:
+            resp += database.encode() + b"\x00"
+        self._send(resp)
+        ok = self._read_packet()
+        assert ok[0] == 0x00, ok
+
+    @staticmethod
+    def _lenenc(buf: bytes, pos: int) -> tuple[int | None, int]:
+        b0 = buf[pos]
+        if b0 == 0xFB:
+            return None, pos + 1
+        if b0 < 251:
+            return b0, pos + 1
+        if b0 == 0xFC:
+            return struct.unpack_from("<H", buf, pos + 1)[0], pos + 3
+        if b0 == 0xFD:
+            return int.from_bytes(buf[pos + 1:pos + 4], "little"), pos + 4
+        return struct.unpack_from("<Q", buf, pos + 1)[0], pos + 9
+
+    def query(self, sql: str):
+        self.seq = 0
+        self._send(b"\x03" + sql.encode())
+        first = self._read_packet()
+        if first[0] == 0x00:  # OK
+            affected, _pos = self._lenenc(first, 1)
+            return ("ok", affected)
+        if first[0] == 0xFF:  # ERR
+            errno = struct.unpack_from("<H", first, 1)[0]
+            return ("err", errno, first[9:].decode())
+        ncols, _ = self._lenenc(first, 0)
+        names = []
+        for _ in range(ncols):
+            col = self._read_packet()
+            # skip def/schema/table/org_table, read name
+            pos = 0
+            for _i in range(4):
+                ln, pos = self._lenenc(col, pos)
+                pos += ln or 0
+            ln, pos = self._lenenc(col, pos)
+            names.append(col[pos:pos + ln].decode())
+        eof = self._read_packet()
+        assert eof[0] == 0xFE
+        rows = []
+        while True:
+            pkt = self._read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            row = []
+            pos = 0
+            while pos < len(pkt):
+                ln, pos = self._lenenc(pkt, pos)
+                if ln is None:
+                    row.append(None)
+                else:
+                    row.append(pkt[pos:pos + ln].decode())
+                    pos += ln
+            rows.append(row)
+        return ("rows", names, rows)
+
+    def ping(self) -> bool:
+        self.seq = 0
+        self._send(b"\x0e")
+        return self._read_packet()[0] == 0x00
+
+    def quit(self) -> None:
+        self.seq = 0
+        self._send(b"\x01")
+        self.sock.close()
+
+
+@pytest.fixture(scope="module")
+def mysql():
+    db = GreptimeDB()
+    srv = MysqlServer(db, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+    db.close()
+
+
+class TestMysqlProtocol:
+    def test_handshake_ping_query_roundtrip(self, mysql):
+        c = MiniMysqlClient(mysql.port)
+        c.connect()
+        assert c.ping()
+        kind, affected = c.query(
+            "CREATE TABLE mt (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+            " v DOUBLE, PRIMARY KEY (h))")
+        assert kind == "ok"
+        kind, affected = c.query("INSERT INTO mt VALUES ('a', 1000, 1.5),"
+                                 " ('b', 2000, NULL)")
+        assert (kind, affected) == ("ok", 2)
+        kind, names, rows = c.query("SELECT h, ts, v FROM mt ORDER BY h")
+        assert names == ["h", "ts", "v"]
+        assert rows == [["a", "1000", "1.5"], ["b", "2000", None]]
+        c.quit()
+
+    def test_error_packet(self, mysql):
+        c = MiniMysqlClient(mysql.port)
+        c.connect()
+        kind, errno, msg = c.query("SELECT * FROM missing_table")
+        assert kind == "err" and "missing_table" in msg
+        # connection still usable after an error
+        kind, names, rows = c.query("SELECT 1")
+        assert rows == [["1"]]
+        c.quit()
+
+    def test_client_housekeeping(self, mysql):
+        c = MiniMysqlClient(mysql.port)
+        c.connect()
+        assert c.query("SET NAMES utf8")[0] == "ok"
+        kind, names, rows = c.query("select @@version_comment limit 1")
+        assert rows == [["greptimedb-tpu"]]
+        c.quit()
+
+    def test_connect_with_db_and_init_db(self, mysql):
+        mysql.db.sql("CREATE DATABASE IF NOT EXISTS mdb")
+        c = MiniMysqlClient(mysql.port)
+        c.connect(database="mdb")
+        c.query("CREATE TABLE t1 (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        assert mysql.db.catalog.table_exists("mdb", "t1")
+        # COM_INIT_DB back to public
+        c.seq = 0
+        c._send(b"\x02public")
+        assert c._read_packet()[0] == 0x00
+        c.quit()
+
+    def test_sessions_isolated_between_connections(self, mysql):
+        mysql.db.sql("CREATE DATABASE IF NOT EXISTS iso1")
+        c1 = MiniMysqlClient(mysql.port); c1.connect(database="iso1")
+        c2 = MiniMysqlClient(mysql.port); c2.connect()  # public
+        c1.query("CREATE TABLE st (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        # c2 (public session) must NOT see iso1.st unqualified
+        kind, *rest = c2.query("SELECT * FROM st")
+        assert kind == "err"
+        # and the global/HTTP session db is untouched
+        assert mysql.db.current_db == "public"
+        c1.quit(); c2.quit()
+
+    def test_timestamp_declared_as_longlong(self, mysql):
+        from greptimedb_tpu.servers.mysql import _TYPE_MAP, MYSQL_TYPE_LONGLONG
+        assert _TYPE_MAP["TimestampMillisecond"] == MYSQL_TYPE_LONGLONG
+
+    def test_busy_port_fails_fast(self, mysql):
+        from greptimedb_tpu.servers.mysql import MysqlServer
+        import time
+        t0 = time.time()
+        dup = MysqlServer(mysql.db, port=mysql.port)
+        with pytest.raises(RuntimeError, match="failed to start"):
+            dup.start()
+        assert time.time() - t0 < 5  # real errno propagated, no 10s timeout

@@ -1,0 +1,126 @@
+"""PostgreSQL wire tests with a minimal hand-rolled v3 client."""
+
+import socket
+import struct
+
+import pytest
+
+from greptimedb_tpu.servers.postgres import PostgresServer
+from greptimedb_tpu.standalone import GreptimeDB
+
+
+class MiniPgClient:
+    def __init__(self, port: int, database: str | None = None):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+        params = {"user": "root"}
+        if database:
+            params["database"] = database
+        body = struct.pack(">I", 196608)
+        for k, v in params.items():
+            body += k.encode() + b"\x00" + v.encode() + b"\x00"
+        body += b"\x00"
+        self.sock.sendall(struct.pack(">I", len(body) + 4) + body)
+        self._drain_until_ready()
+
+    def _read_msg(self):
+        tag = self._recv(1)
+        ln = struct.unpack(">I", self._recv(4))[0]
+        return tag, self._recv(ln - 4)
+
+    def _recv(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    def _drain_until_ready(self):
+        msgs = []
+        while True:
+            tag, body = self._read_msg()
+            msgs.append((tag, body))
+            if tag == b"Z":
+                return msgs
+
+    def query(self, sql: str):
+        body = sql.encode() + b"\x00"
+        self.sock.sendall(b"Q" + struct.pack(">I", len(body) + 4) + body)
+        names, rows, complete, err = [], [], None, None
+        for tag, body in self._drain_until_ready():
+            if tag == b"T":
+                nf = struct.unpack(">H", body[:2])[0]
+                pos = 2
+                for _ in range(nf):
+                    nul = body.index(b"\x00", pos)
+                    names.append(body[pos:nul].decode())
+                    pos = nul + 1 + 18
+            elif tag == b"D":
+                nf = struct.unpack(">H", body[:2])[0]
+                pos = 2
+                row = []
+                for _ in range(nf):
+                    ln = struct.unpack(">i", body[pos:pos + 4])[0]
+                    pos += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(body[pos:pos + ln].decode())
+                        pos += ln
+                rows.append(row)
+            elif tag == b"C":
+                complete = body.rstrip(b"\x00").decode()
+            elif tag == b"E":
+                err = body
+        return names, rows, complete, err
+
+    def close(self):
+        self.sock.sendall(b"X" + struct.pack(">I", 4))
+        self.sock.close()
+
+
+@pytest.fixture(scope="module")
+def pg():
+    db = GreptimeDB()
+    srv = PostgresServer(db, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+    db.close()
+
+
+class TestPostgresProtocol:
+    def test_startup_and_query(self, pg):
+        c = MiniPgClient(pg.port)
+        names, rows, complete, err = c.query(
+            "CREATE TABLE pt (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+            " v DOUBLE, PRIMARY KEY (h))")
+        assert err is None
+        names, rows, complete, err = c.query(
+            "INSERT INTO pt VALUES ('a', 1000, 2.5), ('b', 2000, NULL)")
+        assert complete == "INSERT 0 2"
+        names, rows, complete, err = c.query("SELECT h, v FROM pt ORDER BY h")
+        assert names == ["h", "v"]
+        assert rows == [["a", "2.5"], ["b", None]]
+        assert complete == "SELECT 2"
+        c.close()
+
+    def test_error_then_recover(self, pg):
+        c = MiniPgClient(pg.port)
+        _n, _r, _c, err = c.query("SELECT * FROM nonexistent")
+        assert err is not None and b"nonexistent" in err
+        names, rows, complete, err = c.query("SELECT 1 + 1")
+        assert rows == [["2"]] and err is None
+        c.close()
+
+    def test_set_and_ssl_decline(self, pg):
+        # SSLRequest then normal startup
+        s = socket.create_connection(("127.0.0.1", pg.port), timeout=5)
+        s.sendall(struct.pack(">II", 8, 80877103))
+        assert s.recv(1) == b"N"
+        s.close()
+        c = MiniPgClient(pg.port)
+        _n, _r, complete, err = c.query("SET client_encoding = 'UTF8'")
+        assert err is None and complete == "SET"
+        c.close()
