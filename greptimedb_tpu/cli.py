@@ -38,6 +38,12 @@ def cmd_standalone(args) -> int:
         ),
         cache_capacity_bytes=opts.storage.cache_capacity_gb << 30,
     )
+    if opts.auth.users:
+        from greptimedb_tpu.utils.auth import StaticUserProvider
+
+        db.user_provider = StaticUserProvider.from_lines(
+            [str(u) for u in opts.auth.users]
+        )
     host, port = opts.http.addr.rsplit(":", 1)
     servers = []
     try:

@@ -105,7 +105,27 @@ class HttpServer:
 
     # ------------------------------------------------------------------
     def build_app(self) -> web.Application:
-        app = web.Application(client_max_size=64 * 1024 * 1024)
+        @web.middleware
+        async def auth_middleware(request: web.Request, handler):
+            provider = getattr(self.db, "user_provider", None)
+            if (
+                provider is not None
+                and provider.enabled
+                and request.path not in ("/health", "/ready", "/metrics")
+            ):
+                if not provider.check_http_basic(
+                    request.headers.get("Authorization")
+                ):
+                    return web.json_response(
+                        {"code": int(StatusCode.USER_PASSWORD_MISMATCH),
+                         "error": "authentication failed"},
+                        status=401,
+                        headers={"WWW-Authenticate": 'Basic realm="greptime"'},
+                    )
+            return await handler(request)
+
+        app = web.Application(client_max_size=64 * 1024 * 1024,
+                              middlewares=[auth_middleware])
         r = app.router
         r.add_route("*", "/v1/sql", self.h_sql)
         r.add_route("*", "/v1/promql", self.h_promql)

@@ -103,7 +103,9 @@ class _Conn:
 
     # ---- handshake ------------------------------------------------------
     async def handshake(self) -> bool:
-        salt = b"12345678901234567890"
+        import os as _os
+
+        salt = self.salt = _os.urandom(20).replace(b"\x00", b"\x01")
         payload = (
             b"\x0a" + b"8.4.2-greptimedb-tpu\x00"
             + struct.pack("<I", threading.get_ident() & 0xFFFFFFFF)
@@ -131,17 +133,41 @@ class _Conn:
         rest = resp[32:]
         nul = rest.find(b"\x00")
         username = rest[:nul].decode("utf-8", "replace") if nul >= 0 else ""
-        # auth verification is a no-op without a user provider (reference
-        # behaviour when auth is not configured)
+        after = rest[nul + 1:]
+        auth_response = b""
+        if after:
+            alen = after[0]
+            auth_response = after[1:1 + alen]
+            after = after[1 + alen:]
         db = None
-        if self.caps & CLIENT_CONNECT_WITH_DB:
-            after = rest[nul + 1:]
-            if after:
-                alen = after[0]
-                after = after[1 + alen:]
-                dbn = after.find(b"\x00")
-                if dbn > 0:
-                    db = after[:dbn].decode("utf-8", "replace")
+        if self.caps & CLIENT_CONNECT_WITH_DB and after:
+            dbn = after.find(b"\x00")
+            if dbn > 0:
+                db = after[:dbn].decode("utf-8", "replace")
+            after = after[dbn + 1:] if dbn >= 0 else b""
+        client_plugin = ""
+        if self.caps & CLIENT_PLUGIN_AUTH and after:
+            pn = after.find(b"\x00")
+            client_plugin = after[:pn if pn >= 0 else len(after)].decode(
+                "utf-8", "replace")
+        provider = getattr(self.server.db, "user_provider", None)
+        if provider is not None and provider.enabled:
+            if client_plugin and client_plugin != "mysql_native_password":
+                # MySQL 8 clients default to caching_sha2_password; ask them
+                # to switch plugins and resend the native scramble
+                self.send(b"\xfe" + b"mysql_native_password\x00"
+                          + self.salt + b"\x00")
+                await self.writer.drain()
+                try:
+                    auth_response = await self.read_packet() or b""
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return False
+            if not provider.check_mysql_native(username, auth_response,
+                                               self.salt):
+                self.send_err("Access denied for user "
+                              f"'{username}'", errno=1045, sqlstate=b"28000")
+                await self.writer.drain()
+                return False
         if db:
             self.session_db = db
         self.send_ok()

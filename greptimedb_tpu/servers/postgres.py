@@ -62,6 +62,23 @@ class _PgConn:
                 db = params.get("database")
                 if db:
                     self.session_db = db
+                provider = getattr(self.server.db, "user_provider", None)
+                if provider is not None and provider.enabled:
+                    # AuthenticationCleartextPassword
+                    self._msg(b"R", struct.pack(">I", 3))
+                    await self.writer.drain()
+                    tag = await self.reader.readexactly(1)
+                    ln = struct.unpack(
+                        ">I", await self.reader.readexactly(4))[0]
+                    pw_body = await self.reader.readexactly(ln - 4)
+                    password = pw_body.rstrip(b"\x00").decode(
+                        "utf-8", "replace")
+                    user = params.get("user", "")
+                    if tag != b"p" or not provider.check_plain(user, password):
+                        self._error("password authentication failed for "
+                                    f'user "{user}"', "28P01")
+                        await self.writer.drain()
+                        return False
                 self._msg(b"R", struct.pack(">I", 0))  # AuthenticationOk
                 for k, v in (("server_version", "16.3 (greptimedb-tpu)"),
                              ("server_encoding", "UTF8"),

@@ -176,6 +176,9 @@ class GreptimeDB(TableProvider):
         from greptimedb_tpu.storage.metric_engine import MetricEngine
 
         self.metric_engine = MetricEngine(self)
+        from greptimedb_tpu.utils.auth import StaticUserProvider
+
+        self.user_provider = StaticUserProvider()
 
     def close(self) -> None:
         self.regions.close()

@@ -1,0 +1,82 @@
+"""Authentication: static user provider + per-protocol credential checks.
+
+Reference: src/auth (UserProvider trait, static file provider, SURVEY.md
+§2.9). When no users are configured every protocol accepts all connections
+(the reference behaves the same without a user provider).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+
+
+class StaticUserProvider:
+    """users: {name: password} (config `[auth] users = ["u:p", ...]` or a
+    `name=password` lines file, matching the reference's static provider)."""
+
+    def __init__(self, users: dict[str, str] | None = None):
+        self.users = dict(users or {})
+
+    @staticmethod
+    def from_lines(lines: list[str]) -> "StaticUserProvider":
+        users = {}
+        for line in lines:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            # split on whichever separator comes FIRST: passwords commonly
+            # contain '=' (base64) or ':' — only the first is structural
+            candidates = [(line.index(s), s) for s in ("=", ":") if s in line]
+            if not candidates:
+                continue
+            _, sep = min(candidates)
+            name, _, pw = line.partition(sep)
+            users[name.strip()] = pw.strip()
+        return StaticUserProvider(users)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.users)
+
+    # ---- checks --------------------------------------------------------
+    def check_plain(self, username: str, password: str) -> bool:
+        if not self.enabled:
+            return True
+        expected = self.users.get(username)
+        if expected is None:
+            return False
+        return hmac.compare_digest(expected.encode(), password.encode())
+
+    def check_http_basic(self, header: str | None) -> bool:
+        if not self.enabled:
+            return True
+        if not header or not header.startswith("Basic "):
+            return False
+        try:
+            raw = base64.b64decode(header[6:]).decode("utf-8")
+        except Exception:  # noqa: BLE001
+            return False
+        user, _, pw = raw.partition(":")
+        return self.check_plain(user, pw)
+
+    def check_mysql_native(self, username: str, auth_response: bytes,
+                           salt: bytes) -> bool:
+        """mysql_native_password: SHA1(pw) XOR SHA1(salt + SHA1(SHA1(pw)))."""
+        if not self.enabled:
+            return True
+        pw = self.users.get(username)
+        if pw is None:
+            return False
+        if not auth_response:
+            return pw == ""
+        sha_pw = hashlib.sha1(pw.encode()).digest()
+        expected = bytes(
+            a ^ b
+            for a, b in zip(
+                sha_pw,
+                hashlib.sha1(salt + hashlib.sha1(sha_pw).digest()).digest(),
+            )
+        )
+        return hmac.compare_digest(auth_response, expected)

@@ -53,9 +53,16 @@ class PostgresOptions:
 
 
 @dataclass
+class AuthOptions:
+    # "user:password" entries; empty = open access
+    users: list = field(default_factory=list)
+
+
+@dataclass
 class StandaloneOptions:
     node_id: int = 0
     default_timezone: str = "UTC"
+    auth: AuthOptions = field(default_factory=AuthOptions)
     http: HttpOptions = field(default_factory=HttpOptions)
     mysql: MysqlOptions = field(default_factory=MysqlOptions)
     postgres: PostgresOptions = field(default_factory=PostgresOptions)
@@ -86,6 +93,10 @@ def _apply_env(obj, prefix: str) -> None:
             raw = os.environ[key]
             if isinstance(cur, bool):
                 setattr(obj, f.name, raw.lower() in ("1", "true", "yes", "on"))
+            elif isinstance(cur, list):
+                # comma-separated entries; list(str) would explode into chars
+                setattr(obj, f.name,
+                        [p.strip() for p in raw.split(",") if p.strip()])
             else:
                 setattr(obj, f.name, type(cur)(raw))
 

@@ -1,0 +1,190 @@
+"""Auth tests: static provider + per-protocol enforcement."""
+
+import base64
+import hashlib
+import json
+import struct
+
+import pytest
+
+from greptimedb_tpu.servers import HttpServer
+from greptimedb_tpu.servers.mysql import MysqlServer
+from greptimedb_tpu.servers.postgres import PostgresServer
+from greptimedb_tpu.standalone import GreptimeDB
+from greptimedb_tpu.utils.auth import StaticUserProvider
+from tests.test_mysql import MiniMysqlClient
+from tests.test_servers import http
+
+
+@pytest.fixture
+def secure_db():
+    db = GreptimeDB()
+    db.user_provider = StaticUserProvider({"admin": "s3cret"})
+    yield db
+    db.close()
+
+
+class TestProvider:
+    def test_plain_and_lines(self):
+        p = StaticUserProvider.from_lines(["# comment", "alice=pw1", "bob:pw2"])
+        assert p.check_plain("alice", "pw1")
+        assert not p.check_plain("alice", "wrong")
+        assert p.check_plain("bob", "pw2")
+        assert not p.check_plain("nobody", "")
+
+    def test_open_when_empty(self):
+        p = StaticUserProvider()
+        assert p.check_plain("anyone", "anything")
+        assert p.check_http_basic(None)
+
+    def test_mysql_native_scramble(self):
+        p = StaticUserProvider({"u": "pw"})
+        salt = b"ABCDEFGHIJKLMNOPQRST"
+        sha_pw = hashlib.sha1(b"pw").digest()
+        scramble = bytes(
+            a ^ b for a, b in zip(
+                sha_pw, hashlib.sha1(salt + hashlib.sha1(sha_pw).digest()).digest())
+        )
+        assert p.check_mysql_native("u", scramble, salt)
+        assert not p.check_mysql_native("u", b"\x00" * 20, salt)
+
+
+class TestHttpAuth:
+    def test_basic_auth_enforced(self, secure_db):
+        srv = HttpServer(secure_db, port=0)
+        srv.start()
+        try:
+            code, _ = http(srv, "/v1/sql", form={"sql": "SELECT 1"})
+            assert code == 401
+            cred = base64.b64encode(b"admin:s3cret").decode()
+            code, raw = http(srv, "/v1/sql", form={"sql": "SELECT 1"},
+                             headers={"Authorization": f"Basic {cred}"})
+            assert code == 200
+            assert json.loads(raw)["output"][0]["records"]["rows"] == [[1]]
+            # health/metrics stay open
+            assert http(srv, "/health")[0] == 200
+            assert http(srv, "/metrics")[0] == 200
+            bad = base64.b64encode(b"admin:wrong").decode()
+            code, _ = http(srv, "/v1/sql", form={"sql": "SELECT 1"},
+                           headers={"Authorization": f"Basic {bad}"})
+            assert code == 401
+        finally:
+            srv.stop()
+
+
+class TestMysqlAuth:
+    def test_wrong_password_rejected(self, secure_db):
+        srv = MysqlServer(secure_db, port=0)
+        srv.start()
+        try:
+            c = MiniMysqlClient(srv.port)
+            greeting = c._read_packet()
+            # empty auth response for a required user -> ERR 1045
+            resp = (struct.pack("<IIB", 0x200 | 0x8000, 1 << 24, 0x21)
+                    + b"\x00" * 23 + b"admin\x00" + b"\x00")
+            c._send(resp)
+            err = c._read_packet()
+            assert err[0] == 0xFF
+            assert struct.unpack_from("<H", err, 1)[0] == 1045
+        finally:
+            srv.stop()
+
+    def test_correct_scramble_accepted(self, secure_db):
+        srv = MysqlServer(secure_db, port=0)
+        srv.start()
+        try:
+            c = MiniMysqlClient(srv.port)
+            greeting = c._read_packet()
+            # salt: 8 bytes at offset 5.., then 12 more after filler (v10)
+            # server version string ends at first NUL after protocol byte
+            nul = greeting.index(b"\x00", 1)
+            p1 = greeting[nul + 5:nul + 13]
+            # capabilities block: after salt1 + filler(1): 2 caps, 1 charset,
+            # 2 status, 2 caps hi, 1 len, 10 reserved, then salt part 2 (12)
+            p2_off = nul + 13 + 1 + 2 + 1 + 2 + 2 + 1 + 10
+            p2 = greeting[p2_off:p2_off + 12]
+            salt = p1 + p2
+            sha_pw = hashlib.sha1(b"s3cret").digest()
+            scramble = bytes(a ^ b for a, b in zip(
+                sha_pw,
+                hashlib.sha1(salt + hashlib.sha1(sha_pw).digest()).digest()))
+            resp = (struct.pack("<IIB", 0x200 | 0x8000, 1 << 24, 0x21)
+                    + b"\x00" * 23 + b"admin\x00"
+                    + bytes([len(scramble)]) + scramble)
+            c._send(resp)
+            ok = c._read_packet()
+            assert ok[0] == 0x00, ok
+            assert c.ping()
+            c.quit()
+        finally:
+            srv.stop()
+
+
+class TestPostgresAuth:
+    def test_cleartext_password_flow(self, secure_db):
+        import socket
+
+        srv = PostgresServer(secure_db, port=0)
+        srv.start()
+        try:
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+            body = struct.pack(">I", 196608) + b"user\x00admin\x00\x00"
+            s.sendall(struct.pack(">I", len(body) + 4) + body)
+            tag = s.recv(1)
+            assert tag == b"R"
+            ln = struct.unpack(">I", s.recv(4))[0]
+            code = struct.unpack(">I", s.recv(ln - 4))[0]
+            assert code == 3  # cleartext password request
+            pw = b"s3cret\x00"
+            s.sendall(b"p" + struct.pack(">I", len(pw) + 4) + pw)
+            tag = s.recv(1)
+            assert tag == b"R"  # AuthenticationOk follows
+            s.close()
+        finally:
+            srv.stop()
+
+
+class TestReviewRegressions:
+    def test_env_list_users(self, monkeypatch):
+        from greptimedb_tpu.utils.config import load_options
+
+        monkeypatch.setenv("GREPTIMEDB_STANDALONE__AUTH__USERS",
+                           "admin:pw1, bob:pw2")
+        o = load_options()
+        assert o.auth.users == ["admin:pw1", "bob:pw2"]
+        p = StaticUserProvider.from_lines(o.auth.users)
+        assert p.check_plain("admin", "pw1") and p.check_plain("bob", "pw2")
+
+    def test_password_with_equals(self):
+        p = StaticUserProvider.from_lines(["alice:p=w=="])
+        assert p.check_plain("alice", "p=w==")
+        p2 = StaticUserProvider.from_lines(["carol=x:y"])
+        assert p2.check_plain("carol", "x:y")
+
+    def test_auth_switch_request(self, secure_db):
+        import hashlib, struct
+        from tests.test_mysql import MiniMysqlClient
+
+        srv = MysqlServer(secure_db, port=0)
+        srv.start()
+        try:
+            c = MiniMysqlClient(srv.port)
+            greeting = c._read_packet()
+            caps = 0x200 | 0x8000 | 0x80000  # incl PLUGIN_AUTH
+            resp = (struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23
+                    + b"admin\x00" + bytes([32]) + b"\x11" * 32
+                    + b"caching_sha2_password\x00")
+            c._send(resp)
+            switch = c._read_packet()
+            assert switch[0] == 0xFE and b"mysql_native_password" in switch
+            salt = switch[len(b"\xfe" + b"mysql_native_password\x00"):-1]
+            sha_pw = hashlib.sha1(b"s3cret").digest()
+            scramble = bytes(a ^ b for a, b in zip(
+                sha_pw,
+                hashlib.sha1(salt + hashlib.sha1(sha_pw).digest()).digest()))
+            c._send(scramble)
+            ok = c._read_packet()
+            assert ok[0] == 0x00, ok
+            c.quit()
+        finally:
+            srv.stop()
