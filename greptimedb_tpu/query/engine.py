@@ -87,6 +87,8 @@ class QueryEngine:
         t = mark("scan_cache_ms", t)
         env, n = self.executor.execute(plan, table, ts_bounds)
         t = mark("device_exec_ms", t)
+        if plan.sliding is not None:
+            env, n = _apply_sliding(plan, env, n)
         result = self._shape(plan, env, n)
         mark("shape_ms", t)
         if metrics is not None:
@@ -232,6 +234,72 @@ class QueryEngine:
         return QueryResult(names, rows, column_types=[
             _infer_type(item.expr, plan) for item in items
         ])
+
+
+def _apply_sliding(plan: SelectPlan, env: dict, n: int) -> tuple[dict, int]:
+    """Combine s-wide tumbling partials into sliding [t, t+w) windows
+    (reference range_select semantics: RANGE w evaluated at each ALIGN step).
+    Partial volumes are small (groups x buckets), so this runs on host."""
+    import collections
+
+    w, s = plan.sliding
+    k = w // s
+    time_key = next(g for g in plan.group_keys if g.kind == "time")
+    tag_keys = [g for g in plan.group_keys if g is not time_key]
+    partial_names = sorted({p for parts in plan.sliding_rewrites.values()
+                            for p in parts})
+
+    groups: dict = collections.defaultdict(dict)  # tag values -> {bucket: i}
+    for i in range(n):
+        tags = tuple(env[str(g.expr)][i] for g in tag_keys)
+        groups[tags][int(env[str(time_key.expr)][i])] = i
+
+    out_rows: list[tuple] = []  # (tags, t, {partial: combined})
+    for tags, buckets in groups.items():
+        window_starts = sorted({
+            b - j * s for b in buckets for j in range(k)
+        })
+        for t0 in window_starts:
+            window = [buckets[t0 + j * s] for j in range(k)
+                      if (t0 + j * s) in buckets]
+            combined = {}
+            for p in partial_names:
+                vals = [env[p][i] for i in window]
+                vals = [v for v in vals if not (
+                    isinstance(v, float) and np.isnan(v))]
+                if not vals:
+                    combined[p] = np.nan
+                elif p.startswith(("sum(", "count(")):
+                    combined[p] = sum(vals)
+                elif p.startswith("min("):
+                    combined[p] = min(vals)
+                elif p.startswith("max("):
+                    combined[p] = max(vals)
+            out_rows.append((tags, t0, combined))
+
+    m = len(out_rows)
+    new_env: dict[str, np.ndarray] = {}
+    for gi, g in enumerate(tag_keys):
+        col = np.array([r[0][gi] for r in out_rows], dtype=object)
+        new_env[g.name] = col
+        new_env[str(g.expr)] = col
+    tcol = np.array([r[1] for r in out_rows], dtype=np.int64)
+    new_env[time_key.name] = tcol
+    new_env[str(time_key.expr)] = tcol
+    for p in partial_names:
+        new_env[p] = np.array([r[2].get(p, np.nan) for r in out_rows])
+    # reconstruct the original aggregates (avg = sum/count)
+    for orig, parts in plan.sliding_rewrites.items():
+        if orig in new_env:
+            continue
+        if orig.startswith(("avg(", "mean(")):
+            s_arr = new_env[parts[0]].astype(float)
+            c_arr = new_env[parts[1]].astype(float)
+            new_env[orig] = np.where(c_arr > 0, s_arr / np.maximum(c_arr, 1),
+                                     np.nan)
+        else:
+            new_env[orig] = new_env[parts[0]]
+    return new_env, m
 
 
 def _infer_type(expr, plan: SelectPlan) -> str:

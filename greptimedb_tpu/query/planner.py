@@ -47,6 +47,12 @@ class SelectPlan:
     limit: int | None = None
     offset: int | None = None
     distinct: bool = False
+    # sliding range-select: (window_ms, step_ms) in ts units when
+    # `agg() RANGE w ... ALIGN s` with w != s; device computes s-wide
+    # tumbling partials, the engine combines them into sliding windows
+    sliding: tuple[int, int] | None = None
+    # original agg -> partial aggs it decomposes into (avg -> sum+count)
+    sliding_rewrites: dict = field(default_factory=dict)
 
     def fingerprint(self) -> str:
         gk = ";".join(f"{k.kind}:{k.expr}" for k in self.group_keys)
@@ -239,6 +245,66 @@ def plan_select(sel: Select, ctx: TableContext) -> SelectPlan:
 
     having = _substitute_aliases(sel.having, aliases) if sel.having else None
 
+    # sliding range-select: RANGE wider than ALIGN
+    sliding = None
+    sliding_rewrites: dict = {}
+    if sel.align is not None:
+        ranges = {i.range_.ms for i in sel.items if i.range_ is not None}
+        if ranges:
+            w_ms = max(ranges)
+            s_ms = sel.align.ms
+            if len(ranges) > 1:
+                raise Unsupported("mixed RANGE widths in one query")
+            if w_ms != s_ms:
+                if w_ms % s_ms != 0:
+                    raise Unsupported(
+                        f"RANGE ({w_ms}ms) must be a multiple of ALIGN ({s_ms}ms)"
+                    )
+                # every aggregate must carry a RANGE (the reference errors
+                # likewise): a range-less agg would otherwise be silently
+                # widened to the sliding window
+                ranged_aggs: set[str] = set()
+                for item in items:
+                    if isinstance(item.expr, Star):
+                        continue
+                    item_aggs: list[FuncCall] = []
+                    collect_aggs(item.expr, item_aggs)
+                    if item_aggs and item.range_ is None:
+                        raise Unsupported(
+                            f"aggregate {item_aggs[0]} needs a RANGE clause "
+                            "in a range query"
+                        )
+                    ranged_aggs.update(str(a) for a in item_aggs)
+                for agg in aggs:
+                    if str(agg) not in ranged_aggs:
+                        raise Unsupported(
+                            f"aggregate {agg} (HAVING/ORDER BY) must match a "
+                            "RANGE select item"
+                        )
+                factor = ctx.ts_unit_ms_factor()
+                sliding = (int(w_ms * factor), int(s_ms * factor))
+                # decompose non-combinable aggregates into partials
+                new_aggs: list[FuncCall] = []
+                for agg in aggs:
+                    if agg.distinct:
+                        raise Unsupported(
+                            "DISTINCT aggregates with sliding RANGE windows"
+                        )
+                    if agg.name in ("avg", "mean"):
+                        parts = [FuncCall("sum", agg.args),
+                                 FuncCall("count", agg.args)]
+                    elif agg.name in ("sum", "min", "max", "count"):
+                        parts = [agg]
+                    else:
+                        raise Unsupported(
+                            f"{agg.name}() with sliding RANGE windows"
+                        )
+                    sliding_rewrites[str(agg)] = [str(p) for p in parts]
+                    for p in parts:
+                        if str(p) not in {str(x) for x in new_aggs}:
+                            new_aggs.append(p)
+                aggs = new_aggs
+
     return SelectPlan(
         select=sel,
         ctx=ctx,
@@ -254,6 +320,8 @@ def plan_select(sel: Select, ctx: TableContext) -> SelectPlan:
         limit=sel.limit,
         offset=sel.offset,
         distinct=sel.distinct,
+        sliding=sliding,
+        sliding_rewrites=sliding_rewrites,
     )
 
 

@@ -565,3 +565,45 @@ class TestExplainAnalyze:
         text = r.rows[1][1]
         for key in ("plan_ms", "device_exec_ms", "shape_ms", "output_rows"):
             assert key in text
+
+
+class TestSlidingRange:
+    def test_range_wider_than_align(self, db):
+        db.sql("CREATE TABLE sr (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY(h))")
+        db.sql("INSERT INTO sr VALUES "
+               "('a', 0, 1.0), ('a', 60000, 2.0), ('a', 120000, 4.0),"
+               " ('a', 180000, 8.0)")
+        # 2-minute window sliding at 1-minute steps, window = [t, t+2m)
+        r = db.sql("SELECT ts, h, sum(v) RANGE '2m' FROM sr ALIGN '1m'"
+                   " BY (h) ORDER BY ts")
+        got = {row[0]: row[2] for row in r.rows}
+        assert got[0] == 3.0        # 0..2m: 1+2
+        assert got[60000] == 6.0    # 1..3m: 2+4
+        assert got[120000] == 12.0  # 2..4m: 4+8
+        assert got[180000] == 8.0
+        assert got[-60000] == 1.0   # window [-1m, 1m) catches the first point
+
+    def test_sliding_avg_and_minmax(self, db):
+        db.sql("CREATE TABLE sr2 (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY(h))")
+        db.sql("INSERT INTO sr2 VALUES ('a', 0, 2.0), ('a', 60000, 6.0),"
+               " ('b', 0, 10.0)")
+        r = db.sql("SELECT ts, h, avg(v) RANGE '2m', max(v) RANGE '2m'"
+                   " FROM sr2 ALIGN '1m' BY (h) ORDER BY h, ts")
+        by = {(row[1], row[0]): (row[2], row[3]) for row in r.rows}
+        assert by[("a", 0)] == (4.0, 6.0)
+        assert by[("b", 0)] == (10.0, 10.0)
+
+    def test_invalid_range_multiple(self, db):
+        db.sql("CREATE TABLE sr3 (ts TIMESTAMP(3) TIME INDEX, v DOUBLE)")
+        with pytest.raises(Unsupported):
+            db.sql("SELECT ts, sum(v) RANGE '90s' FROM sr3 ALIGN '1m'")
+
+    def test_rangeless_agg_rejected_in_range_query(self, db):
+        db.sql("CREATE TABLE sr4 (ts TIMESTAMP(3) TIME INDEX, v DOUBLE)")
+        with pytest.raises(Unsupported, match="RANGE clause"):
+            db.sql("SELECT ts, sum(v) RANGE '2m', count(v) FROM sr4 ALIGN '1m'")
+
+    def test_distinct_agg_rejected_in_sliding(self, db):
+        db.sql("CREATE TABLE sr5 (ts TIMESTAMP(3) TIME INDEX, v DOUBLE)")
+        with pytest.raises(Unsupported):
+            db.sql("SELECT ts, count(DISTINCT v) RANGE '2m' FROM sr5 ALIGN '1m'")
