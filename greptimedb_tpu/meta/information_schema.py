@@ -236,3 +236,84 @@ _TABLES = {
     "engines": _engines,
     "key_column_usage": _key_column_usage,
 }
+
+
+# ---------------------------------------------------------------------------
+# pg_catalog (reference src/catalog/src/system_schema/pg_catalog.rs):
+# the handful of tables psql/BI tools probe on connect
+# ---------------------------------------------------------------------------
+
+PG_CATALOG = "pg_catalog"
+
+
+def is_pg_catalog(table: str | None) -> bool:
+    return bool(table) and table.lower().startswith(PG_CATALOG + ".")
+
+
+def _namespace_oids(db) -> dict[str, int]:
+    """Deterministic schema→oid map shared by pg_namespace and pg_class so
+    the standard `relnamespace = n.oid` join works."""
+    oids = {PG_CATALOG: 11, "public": 2200}
+    nxt = 16384
+    for d in sorted(db.catalog.list_databases()):
+        if d not in oids:
+            oids[d] = nxt
+            nxt += 1
+    return oids
+
+
+def _pg_namespace(db):
+    oids = _namespace_oids(db)
+    rows = [{"oid": oid, "nspname": name} for name, oid in sorted(
+        oids.items(), key=lambda kv: kv[1])]
+    names = ["oid", "nspname"]
+    return _columns_of(rows, names), {"oid": "UInt32", "nspname": "String"}
+
+
+def _pg_class(db):
+    oids = _namespace_oids(db)
+    rows = []
+    for d in db.catalog.list_databases():
+        for t in db.catalog.list_tables(d):
+            rows.append({"oid": t.table_id, "relname": t.name,
+                         "relnamespace": oids.get(d, 2200),
+                         "relkind": "r", "relowner": 10})
+    names = ["oid", "relname", "relnamespace", "relkind", "relowner"]
+    types = {n: "UInt32" for n in names}
+    types.update({"relname": "String", "relkind": "String"})
+    return _columns_of(rows, names), types
+
+
+def _pg_tables(db):
+    rows = []
+    for d in db.catalog.list_databases():
+        for t in db.catalog.list_tables(d):
+            rows.append({"schemaname": d, "tablename": t.name,
+                         "tableowner": "greptime"})
+    names = ["schemaname", "tablename", "tableowner"]
+    return _columns_of(rows, names), {n: "String" for n in names}
+
+
+def _pg_database(db):
+    oids = _namespace_oids(db)
+    rows = [{"oid": oids.get(d, 1), "datname": d}
+            for d in sorted(db.catalog.list_databases())]
+    names = ["oid", "datname"]
+    return _columns_of(rows, names), {"oid": "UInt32", "datname": "String"}
+
+
+_PG_TABLES = {
+    "pg_namespace": _pg_namespace,
+    "pg_class": _pg_class,
+    "pg_tables": _pg_tables,
+    "pg_database": _pg_database,
+}
+
+
+def execute_pg_catalog(db, sel: Select) -> QueryResult:
+    name = sel.table.split(".", 1)[1].lower()
+    builder = _PG_TABLES.get(name)
+    if builder is None:
+        raise TableNotFound(f"pg_catalog.{name}")
+    columns, types = builder(db)
+    return execute_virtual_select(sel, columns, types)

@@ -315,3 +315,14 @@ class DropFlow(Statement):
 @dataclass
 class ShowFlows(Statement):
     pass
+
+
+@dataclass
+class Copy(Statement):
+    """COPY <table> TO|FROM '<path>' [WITH (format='parquet'|'csv'|'json')]
+    (reference src/operator/src/statement/copy_table_{to,from}.rs)."""
+
+    table: str
+    path: str
+    direction: str  # "to" | "from"
+    options: dict = field(default_factory=dict)

@@ -190,6 +190,8 @@ class Parser:
             self.next()
             self.eat_kw("TABLE")
             return TruncateTable(self.qualified_name())
+        if kw == "COPY":
+            return self.copy()
         raise SyntaxError_(f"unrecognized statement keyword: {t.text!r} at {t.pos}")
 
     # ---- SELECT ---------------------------------------------------------
@@ -625,15 +627,8 @@ class Parser:
                 if self.eat_kw("ENGINE"):
                     self.eat(Tok.OP, "=")
                     engine = self.ident()
-                elif self.eat_kw("WITH"):
-                    self.expect(Tok.PUNCT, "(")
-                    while not self.at(Tok.PUNCT, ")"):
-                        k = self.ident() if not self.at(Tok.STRING) else self.next().text
-                        self.eat(Tok.OP, "=")
-                        v = self.next().text
-                        options[k] = v
-                        self.eat(Tok.PUNCT, ",")
-                    self.expect(Tok.PUNCT, ")")
+                elif self.at_kw("WITH"):
+                    options.update(self._with_options())
                 elif self.at_kw("PARTITION"):
                     # PARTITION ON COLUMNS (...) ( expr, ... )
                     self.next()
@@ -669,6 +664,34 @@ class Parser:
             return CreateTable(name, cols, time_index, pks, ine, options,
                                partitions, partition_columns, engine)
         raise Unsupported(f"unsupported CREATE at {self.peek().pos}")
+
+    def copy(self):
+        from greptimedb_tpu.query.ast import Copy
+
+        self.expect_kw("COPY")
+        table = self.qualified_name()
+        if self.eat_kw("TO"):
+            direction = "to"
+        elif self.eat_kw("FROM"):
+            direction = "from"
+        else:
+            raise SyntaxError_(f"expected TO or FROM at {self.peek().pos}")
+        path = self.expect(Tok.STRING).text
+        options = self._with_options(lowercase_keys=True)
+        return Copy(table, path, direction, options)
+
+    def _with_options(self, lowercase_keys: bool = False) -> dict:
+        """Shared `WITH (k = v, ...)` parsing (CREATE TABLE, COPY)."""
+        options: dict = {}
+        if self.eat_kw("WITH"):
+            self.expect(Tok.PUNCT, "(")
+            while not self.at(Tok.PUNCT, ")"):
+                k = self.ident() if not self.at(Tok.STRING) else self.next().text
+                self.eat(Tok.OP, "=")
+                options[k.lower() if lowercase_keys else k] = self.next().text
+                self.eat(Tok.PUNCT, ",")
+            self.expect(Tok.PUNCT, ")")
+        return options
 
     def _if_not_exists(self) -> bool:
         if self.at_kw("IF"):

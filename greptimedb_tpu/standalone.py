@@ -277,6 +277,8 @@ class GreptimeDB(TableProvider):
 
             if info.is_information_schema(stmt.table):
                 return info.execute(self, stmt)
+            if info.is_pg_catalog(stmt.table):
+                return info.execute_pg_catalog(self, stmt)
             if (
                 stmt.table
                 and "." not in stmt.table
@@ -355,6 +357,10 @@ class GreptimeDB(TableProvider):
             return QueryResult([], [], affected_rows=0)
         if isinstance(stmt, (CreateFlow, DropFlow, ShowFlows)):
             return self._flow_statement(stmt)
+        from greptimedb_tpu.query.ast import Copy
+
+        if isinstance(stmt, Copy):
+            return self._copy(stmt)
         raise Unsupported(f"statement {type(stmt).__name__}")
 
     # ---- DDL -----------------------------------------------------------
@@ -556,6 +562,116 @@ class GreptimeDB(TableProvider):
             for pidx in parts:
                 regions[pidx].delete(data)
         return QueryResult([], [], affected_rows=1)
+
+    # ---- COPY TO/FROM ---------------------------------------------------
+    def _copy(self, stmt) -> QueryResult:
+        """COPY table TO/FROM file (reference copy_table_{to,from}; formats
+        from src/common/datasource: parquet, csv, json)."""
+        import numpy as np
+        import pyarrow as pa
+
+        fmt = stmt.options.get("format", "parquet").lower()
+        view = self._table_view(stmt.table)
+        schema = view.schema
+        if stmt.direction == "to":
+            host = view.scan_host()
+            cols = {}
+            for c in schema:
+                arr = host[c.name]
+                cols[c.name] = pa.array(
+                    arr.astype(object) if arr.dtype == object else arr,
+                    type=c.to_arrow().type,
+                )
+            table = pa.table(cols)
+            if fmt == "parquet":
+                import pyarrow.parquet as pq
+
+                pq.write_table(table, stmt.path)
+            elif fmt == "csv":
+                import pyarrow.csv as pacsv
+
+                pacsv.write_csv(table, stmt.path)
+            elif fmt == "json":
+                import json as _json
+
+                with open(stmt.path, "w") as f:
+                    for row in table.to_pylist():
+                        f.write(_json.dumps(row, default=str) + "\n")
+            else:
+                raise Unsupported(f"COPY format {fmt}")
+            return QueryResult([], [], affected_rows=table.num_rows)
+        # COPY FROM
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            table = pq.read_table(stmt.path)
+        elif fmt == "csv":
+            import pyarrow.csv as pacsv
+
+            table = pacsv.read_csv(stmt.path)
+        elif fmt == "json":
+            import json as _json
+
+            rows = [
+                _json.loads(line)
+                for line in open(stmt.path)
+                if line.strip()
+            ]
+            table = pa.Table.from_pylist(rows)
+        else:
+            raise Unsupported(f"COPY format {fmt}")
+        # reuse RecordBatch.from_arrow: it already handles null-int widening
+        # (fill before to_numpy) and unit casts (batch.py) — re-implementing
+        # the conversion here caused both classes of bug
+        from greptimedb_tpu.datatypes.batch import RecordBatch
+        from greptimedb_tpu.datatypes.schema import Schema as _Schema
+
+        present = [c for c in schema if c.name in table.column_names]
+        sub_schema = _Schema(tuple(present))
+        casted = []
+        for c in present:
+            arr = table.column(c.name)
+            want_type = c.to_arrow().type
+            if arr.type != want_type:
+                arr = arr.cast(want_type)  # incl. timestamp UNIT casts
+            casted.append(arr)
+        rb = RecordBatch.from_arrow(
+            pa.Table.from_arrays(casted, schema=sub_schema.to_arrow()),
+            sub_schema,
+        )
+        data: dict = {}
+        for c in present:
+            col = rb.columns[c.name]
+            null = rb.nulls.get(c.name)
+            if c.dtype.is_timestamp:
+                col = col.astype("int64")
+            elif null is not None and c.dtype.is_float:
+                col = col.copy()
+                col[null] = np.nan
+            data[c.name] = col
+        if table.num_rows:
+            regions = self._regions_of(stmt.table)
+            if len(regions) == 1:
+                regions[0].write(data)
+            else:
+                from greptimedb_tpu.parallel.partition import split_rows
+
+                cols_np = {k: np.asarray(v, dtype=object)
+                           for k, v in data.items()}
+                parts = split_rows(self._partition_rule(stmt.table), cols_np,
+                                   table.num_rows)
+                for pidx, row_idx in parts.items():
+                    if pidx >= len(regions):
+                        raise InvalidArguments(
+                            f"partition index {pidx} out of range"
+                        )
+                    sub = {k: [data[k][i] for i in row_idx] for k in data}
+                    regions[pidx].write(sub)
+            if self.flow_engine.flows:
+                ts_name = schema.time_index.name
+                self.flow_engine.on_write(stmt.table, data[ts_name])
+                self.flow_engine.run_all()
+        return QueryResult([], [], affected_rows=table.num_rows)
 
     # ---- introspection -------------------------------------------------
     def _describe(self, stmt: DescribeTable) -> QueryResult:

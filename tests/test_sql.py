@@ -607,3 +607,82 @@ class TestSlidingRange:
         db.sql("CREATE TABLE sr5 (ts TIMESTAMP(3) TIME INDEX, v DOUBLE)")
         with pytest.raises(Unsupported):
             db.sql("SELECT ts, count(DISTINCT v) RANGE '2m' FROM sr5 ALIGN '1m'")
+
+
+class TestCopy:
+    def test_copy_parquet_roundtrip(self, cpu, tmp_path):
+        path = str(tmp_path / "cpu.parquet")
+        r = cpu.sql(f"COPY cpu TO '{path}' WITH (format = 'parquet')")
+        assert r.affected_rows == 7
+        cpu.sql("CREATE TABLE cpu2 (hostname STRING, region STRING,"
+                " ts TIMESTAMP(3) TIME INDEX, usage_user DOUBLE,"
+                " usage_system DOUBLE, PRIMARY KEY (hostname, region))")
+        r = cpu.sql(f"COPY cpu2 FROM '{path}'")
+        assert r.affected_rows == 7
+        assert cpu.sql("SELECT count(*) FROM cpu2").rows == [[7]]
+        a = cpu.sql("SELECT hostname, usage_user FROM cpu ORDER BY hostname, ts").rows
+        b = cpu.sql("SELECT hostname, usage_user FROM cpu2 ORDER BY hostname, ts").rows
+        assert a == b
+
+    def test_copy_csv_and_json(self, cpu, tmp_path):
+        for fmt in ("csv", "json"):
+            path = str(tmp_path / f"cpu.{fmt}")
+            r = cpu.sql(f"COPY cpu TO '{path}' WITH (format = '{fmt}')")
+            assert r.affected_rows == 7
+            tname = f"cpu_{fmt}"
+            cpu.sql(f"CREATE TABLE {tname} (hostname STRING, region STRING,"
+                    " ts TIMESTAMP(3) TIME INDEX, usage_user DOUBLE,"
+                    " usage_system DOUBLE, PRIMARY KEY (hostname, region))")
+            r = cpu.sql(f"COPY {tname} FROM '{path}' WITH (format = '{fmt}')")
+            assert r.affected_rows == 7
+            assert cpu.sql(f"SELECT count(*) FROM {tname}").rows == [[7]]
+
+    def test_copy_bad_format(self, cpu, tmp_path):
+        with pytest.raises(Unsupported):
+            cpu.sql(f"COPY cpu TO '{tmp_path}/x' WITH (format = 'xml')")
+
+
+class TestPgCatalog:
+    def test_pg_tables_and_class(self, cpu):
+        r = cpu.sql("SELECT schemaname, tablename FROM pg_catalog.pg_tables"
+                    " WHERE schemaname = 'public'")
+        assert ["public", "cpu"] in r.rows
+        r = cpu.sql("SELECT relname FROM pg_catalog.pg_class WHERE relkind = 'r'")
+        assert ["cpu"] in r.rows
+        r = cpu.sql("SELECT nspname FROM pg_catalog.pg_namespace")
+        flat = [x[0] for x in r.rows]
+        assert "pg_catalog" in flat and "public" in flat
+        r = cpu.sql("SELECT datname FROM pg_catalog.pg_database")
+        assert ["public"] in r.rows
+
+    def test_copy_from_with_null_int_and_ns_timestamps(self, db, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        db.sql("CREATE TABLE ct (ts TIMESTAMP(3) TIME INDEX, n BIGINT, v DOUBLE)")
+        t = pa.table({
+            "ts": pa.array([1600000000001000000, 1600000000002000000],
+                           pa.timestamp("ns")),  # ns file vs ms schema
+            "n": pa.array([5, None], pa.int64()),
+            "v": pa.array([1.0, None]),
+        })
+        pq.write_table(t, str(tmp_path / "in.parquet"))
+        r = db.sql(f"COPY ct FROM '{tmp_path}/in.parquet'")
+        assert r.affected_rows == 2
+        rows = db.sql("SELECT ts, n, v FROM ct ORDER BY ts").rows
+        assert rows[0][0] == 1600000000001  # unit-cast to ms, not raw ns
+        assert rows[1][2] is None  # float null survives
+
+    def test_copy_from_triggers_flows(self, db, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        db.sql("CREATE TABLE fsrc (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY(h))")
+        db.sql("CREATE FLOW cf SINK TO fsink AS SELECT"
+               " date_bin(INTERVAL '1 minute', ts) AS minute, h, sum(v) AS s"
+               " FROM fsrc GROUP BY minute, h")
+        t = pa.table({"h": ["x", "x"], "ts": pa.array([1000, 2000], pa.timestamp("ms")),
+                      "v": [1.0, 2.0]})
+        pq.write_table(t, str(tmp_path / "f.parquet"))
+        db.sql(f"COPY fsrc FROM '{tmp_path}/f.parquet'")
+        assert db.sql("SELECT s FROM fsink").rows == [[3.0]]
