@@ -406,7 +406,8 @@ class Region:
                 idx = self._sst_index(m)
                 if idx is not None and not sst_may_match(idx, tag_filters):
                     continue
-            parts.append(read_sst(self.store, m, self.schema, ts_range, want))
+            parts.append(read_sst(self.store, m, self.schema, ts_range, want,
+                                  tag_filters))
         internal = (TSID, SEQ, OP)
         schema_cols = {c.name for c in self.schema}
         eff_want = want if want is not None else list(schema_cols) + list(internal)

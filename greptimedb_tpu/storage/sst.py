@@ -133,8 +133,12 @@ def read_sst(
     schema: Schema,
     ts_range: tuple[int | None, int | None] = (None, None),
     columns: list[str] | None = None,
+    tag_filters: dict[str, set] | None = None,
 ) -> dict[str, np.ndarray]:
-    """Read an SST back into numpy columns, pruning row groups by time.
+    """Read an SST back into numpy columns, pruning row groups by time and
+    (when ``tag_filters`` equality/IN sets are given) by tag values via
+    Parquet dictionary/statistics filtering — the row-group-level
+    counterpart of the file-level bloom skipping index.
 
     Tag dictionary columns come back as raw values (object arrays);
     re-encoding to region codes happens in the cache layer against the
@@ -143,15 +147,17 @@ def read_sst(
     ts_idx = schema.time_index
     ts_col = ts_idx.name
     ts_type = pa.timestamp(ts_idx.dtype.time_unit.value)
-    filters = None
+    conj = []
     lo, hi = ts_range
-    if lo is not None or hi is not None:
-        conj = []
-        if lo is not None:
-            conj.append((ts_col, ">=", pa.scalar(int(lo), type=ts_type)))
-        if hi is not None:
-            conj.append((ts_col, "<", pa.scalar(int(hi), type=ts_type)))
-        filters = conj
+    if lo is not None:
+        conj.append((ts_col, ">=", pa.scalar(int(lo), type=ts_type)))
+    if hi is not None:
+        conj.append((ts_col, "<", pa.scalar(int(hi), type=ts_type)))
+    tag_names = {c.name for c in schema.tag_columns}
+    for col, values in (tag_filters or {}).items():
+        if col in tag_names and values:
+            conj.append((col, "in", [str(v) for v in values]))
+    filters = conj or None
 
     local = store.local_path(meta.path)
     src = local if local else io.BytesIO(store.read(meta.path))

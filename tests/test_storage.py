@@ -335,9 +335,10 @@ class TestSkippingIndex:
         reads = []
         orig = regmod.read_sst
 
-        def counting(store, meta, schema, ts_range=(None, None), columns=None):
+        def counting(store, meta, schema, ts_range=(None, None), columns=None,
+                     tag_filters=None):
             reads.append(meta.file_id)
-            return orig(store, meta, schema, ts_range, columns)
+            return orig(store, meta, schema, ts_range, columns, tag_filters)
 
         regmod.read_sst = counting
         try:
@@ -361,3 +362,22 @@ class TestSkippingIndex:
         assert r.store.exists(r._index_path(meta))
         idx = r._sst_index(meta)
         assert idx["hostname"].might_contain("h0")
+
+    def test_tag_filter_row_level_pruning(self, tmp_data_dir):
+        eng = RegionEngine(tmp_data_dir)
+        r = eng.create_region(1, cpu_schema())
+        # one SST containing BOTH hostnames: bloom can't skip the file, but
+        # the parquet filter drops non-matching rows at read time
+        r.write({"hostname": ["alpha", "zulu"] * 50, "region": ["us"] * 100,
+                 "ts": list(range(0, 100_000, 1000)),
+                 "usage_user": [1.0] * 100, "usage_system": [0.0] * 100})
+        r.flush()
+        host = r.scan_host(tag_filters={"hostname": {"zulu"}})
+        assert set(host["hostname"]) == {"zulu"}
+        assert len(host["ts"]) == 50
+        # memtable rows stay unfiltered (hint contract: superset allowed)
+        r.write({"hostname": ["alpha"], "region": ["us"], "ts": [999_000],
+                 "usage_user": [9.0], "usage_system": [0.0]})
+        host2 = r.scan_host(tag_filters={"hostname": {"zulu"}})
+        assert len(host2["ts"]) >= 50
+        eng.close()
