@@ -76,6 +76,160 @@ def collect_aggs(e: Expr, out: list[FuncCall]) -> None:
             collect_aggs(e.else_, out)
 
 
+# ---------------------------------------------------------------------------
+# Host scalar function families (reference src/common/function: json, ip,
+# string helpers). These evaluate over result columns (projections, HAVING),
+# keeping string work off the device by design.
+# ---------------------------------------------------------------------------
+
+def _json_path_get(doc: str, path: str, default=None):
+    """Walk a $.a.b[0] path; returns ``default`` when the path is ABSENT
+    (a present JSON null returns None, which callers may treat distinctly)."""
+    import json as _json
+
+    try:
+        cur = _json.loads(doc) if isinstance(doc, str) else doc
+    except (TypeError, _json.JSONDecodeError):
+        return default
+    for part in str(path).lstrip("$").strip(".").split("."):
+        if not part:
+            continue
+        name, _, idx = part.partition("[")
+        if name:
+            if not isinstance(cur, dict) or name not in cur:
+                return default
+            cur = cur[name]
+        while idx:
+            i, _, idx = idx.partition("]")
+            idx = idx.lstrip("[")
+            if not isinstance(cur, list):
+                return default
+            try:
+                cur = cur[int(i)]
+            except (ValueError, IndexError):
+                return default
+    return cur
+
+
+def _per_row(args, n, fn):
+    a0 = args[0]
+    rows = a0 if isinstance(a0, np.ndarray) else np.full(n, a0, dtype=object)
+
+    def arg_at(j, i):
+        a = args[1 + j]
+        return a[i] if isinstance(a, np.ndarray) else a
+
+    return np.array(
+        [fn(rows[i], *[arg_at(j, i) for j in range(len(args) - 1)])
+         for i in range(len(rows))],
+        dtype=object,
+    )
+
+
+_JSON_MISSING = object()  # distinguishes "path absent" from JSON null
+
+
+def _json_get(cast):
+    def fn(args, n):
+        def one(doc, path="$"):
+            v = _json_path_get(doc, path, default=_JSON_MISSING)
+            if v is _JSON_MISSING or v is None:
+                return None
+            try:
+                return cast(v)
+            except (TypeError, ValueError):
+                return None
+        return _per_row(args, n, one)
+    return fn
+
+
+def _json_as_text(v):
+    """JSON-serialize nested values (not Python repr)."""
+    import json as _json
+
+    if isinstance(v, (dict, list)):
+        return _json.dumps(v)
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def _ipv4_num_to_string(args, n):
+    def one(v):
+        try:
+            x = int(v)
+        except (TypeError, ValueError):
+            return None
+        return ".".join(str((x >> s) & 0xFF) for s in (24, 16, 8, 0))
+    return _per_row(args, n, one)
+
+
+def _ipv4_string_to_num(args, n):
+    def one(v):
+        try:
+            parts = [int(p) for p in str(v).split(".")]
+            if len(parts) != 4 or any(p < 0 or p > 255 for p in parts):
+                return None
+            return (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+        except (TypeError, ValueError):
+            return None
+    return _per_row(args, n, one)
+
+
+def _strict_bool(v):
+    if isinstance(v, bool):
+        return v
+    raise TypeError("not a json boolean")
+
+
+_HOST_FUNCS = {
+    "json_get_string": _json_get(_json_as_text),
+    "json_get_int": _json_get(int),
+    "json_get_float": _json_get(float),
+    "json_get_bool": _json_get(_strict_bool),
+    "json_path_exists": lambda args, n: _per_row(
+        args, n,
+        lambda doc, path="$": _json_path_get(doc, path, _JSON_MISSING)
+        is not _JSON_MISSING,
+    ),
+    "json_is_object": lambda args, n: _per_row(
+        args, n, lambda doc: isinstance(_json_path_get(doc, "$"), dict)
+    ),
+    "ipv4_num_to_string": _ipv4_num_to_string,
+    "ipv4_string_to_num": _ipv4_string_to_num,
+    "length": lambda args, n: _per_row(
+        args, n, lambda v: len(str(v)) if v is not None else None
+    ),
+    "lower": lambda args, n: _per_row(
+        args, n, lambda v: str(v).lower() if v is not None else None
+    ),
+    "upper": lambda args, n: _per_row(
+        args, n, lambda v: str(v).upper() if v is not None else None
+    ),
+    "trim": lambda args, n: _per_row(
+        args, n, lambda v: str(v).strip() if v is not None else None
+    ),
+    "concat": lambda args, n: _per_row(
+        args, n, lambda *vs: "".join("" if v is None else str(v) for v in vs)
+    ),
+    "substr": lambda args, n: _per_row(args, n, _substr),
+}
+
+
+def _substr(v, start, ln=None):
+    """PostgreSQL substr semantics: 1-based; start <= 0 shifts the window
+    (substr('alphabet', 0, 3) = 'al'), never Python negative indexing."""
+    if v is None:
+        return None
+    s = str(v)
+    start = int(start)
+    begin = start - 1
+    if ln is None:
+        return s[max(begin, 0):]
+    end = begin + int(ln)
+    return s[max(begin, 0):max(end, 0)]
+
+
 class TableContext:
     """Static planning context for one table: schema + tag dictionaries."""
 
@@ -475,6 +629,8 @@ def eval_host(e: Expr, env: dict[str, np.ndarray], n: int):
         }
         if e.name in table:
             return table[e.name](np.asarray(args[0], dtype=float))
+        if e.name in _HOST_FUNCS:
+            return _HOST_FUNCS[e.name](args, n)
         raise Unsupported(f"host function {e.name}")
     if isinstance(e, UnaryOp):
         v = eval_host(e.operand, env, n)

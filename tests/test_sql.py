@@ -686,3 +686,46 @@ class TestPgCatalog:
         pq.write_table(t, str(tmp_path / "f.parquet"))
         db.sql(f"COPY fsrc FROM '{tmp_path}/f.parquet'")
         assert db.sql("SELECT s FROM fsink").rows == [[3.0]]
+
+
+class TestScalarFunctions:
+    def test_json_functions(self, db):
+        db.sql("CREATE TABLE js (ts TIMESTAMP(3) TIME INDEX, doc STRING)")
+        db.sql("""INSERT INTO js VALUES (1000, '{"user": {"name": "ada", "age": 36}, "tags": ["x", "y"]}'),
+                  (2000, 'not json')""")
+        r = db.sql("SELECT json_get_string(doc, '$.user.name'),"
+                   " json_get_int(doc, '$.user.age'),"
+                   " json_get_string(doc, '$.tags[1]'),"
+                   " json_path_exists(doc, '$.user') FROM js ORDER BY ts")
+        assert r.rows[0] == ["ada", 36, "y", True]
+        assert r.rows[1] == [None, None, None, False]
+
+    def test_ip_and_string_functions(self, db):
+        db.sql("CREATE TABLE ipt (ts TIMESTAMP(3) TIME INDEX, ip BIGINT, name STRING)")
+        db.sql("INSERT INTO ipt VALUES (1000, 3232235777, '  WebServer  ')")
+        r = db.sql("SELECT ipv4_num_to_string(ip), lower(trim(name)),"
+                   " length(trim(name)), substr(trim(name), 1, 3) FROM ipt")
+        assert r.rows == [["192.168.1.1", "webserver", 9, "Web"]]
+        r = db.sql("SELECT ipv4_string_to_num('10.0.0.1')")
+        assert r.rows == [[167772161]]
+
+    def test_json_semantics_regressions(self, db):
+        db.sql("CREATE TABLE js2 (ts TIMESTAMP(3) TIME INDEX, doc STRING)")
+        db.sql('INSERT INTO js2 VALUES (1000, '
+               '\'{"a": null, "o": {"b": 1}, "f": false, "n": 1}\')')
+        r = db.sql("SELECT json_path_exists(doc, '$.a'),"
+                   " json_path_exists(doc, '$.zz'),"
+                   " json_get_string(doc, '$.o'),"
+                   " json_get_bool(doc, '$.f'),"
+                   " json_get_bool(doc, '$.n') FROM js2")
+        row = r.rows[0]
+        assert row[0] is True        # null value: path EXISTS
+        assert row[1] is False
+        assert row[2] == '{"b": 1}'  # JSON text, not python repr
+        assert row[3] is False
+        assert row[4] is None        # non-bool -> NULL
+
+    def test_substr_pg_semantics(self, db):
+        r = db.sql("SELECT substr('alphabet', 0, 3), substr('alphabet', 0),"
+                   " substr('alphabet', 3, 2)")
+        assert r.rows == [["al", "alphabet", "ph"]]
