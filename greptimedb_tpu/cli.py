@@ -38,6 +38,8 @@ def cmd_standalone(args) -> int:
         ),
         cache_capacity_bytes=opts.storage.cache_capacity_gb << 30,
     )
+    if opts.default_timezone and opts.default_timezone != "UTC":
+        db.set_timezone(opts.default_timezone)
     if opts.auth.users:
         from greptimedb_tpu.utils.auth import StaticUserProvider
 

@@ -318,6 +318,15 @@ class ShowFlows(Statement):
 
 
 @dataclass
+class SetVar(Statement):
+    """SET [SESSION|GLOBAL] name = value (time_zone handled; others no-op
+    for client compatibility, like the reference)."""
+
+    name: str
+    value: str
+
+
+@dataclass
 class Copy(Statement):
     """COPY <table> TO|FROM '<path>' [WITH (format='parquet'|'csv'|'json')]
     (reference src/operator/src/statement/copy_table_{to,from}.rs)."""

@@ -231,11 +231,14 @@ def _substr(v, start, ln=None):
 
 
 class TableContext:
-    """Static planning context for one table: schema + tag dictionaries."""
+    """Static planning context for one table: schema + tag dictionaries +
+    session timezone (naive timestamp literals localize to it)."""
 
-    def __init__(self, schema: Schema, encoders: dict[str, DictionaryEncoder]):
+    def __init__(self, schema: Schema, encoders: dict[str, DictionaryEncoder],
+                 timezone: str = "UTC"):
         self.schema = schema
         self.encoders = encoders
+        self.timezone = timezone
         self._lower = {c.name.lower(): c.name for c in schema}
 
     def resolve(self, name: str) -> str:
@@ -257,7 +260,7 @@ class TableContext:
     def ts_literal(self, v: object) -> int:
         """Literal compared against the time index → epoch int in ts unit."""
         if isinstance(v, str):
-            ms = parse_timestamp_str(v)
+            ms = parse_timestamp_str(v, self.timezone)
             return int(ms * self.ts_unit_ms_factor())
         if isinstance(v, (int, float)):
             return int(v)

@@ -62,8 +62,30 @@ def parse_interval_str(raw: str) -> int:
     return int(total)
 
 
-def parse_timestamp_str(raw: str) -> int:
-    """ISO-ish timestamp string → epoch ms (UTC when no tz given)."""
+def resolve_timezone(tz: str):
+    """'UTC' | 'Asia/Shanghai' | '+08:00' | '-05:30' → tzinfo."""
+    tz = (tz or "UTC").strip()
+    if tz.upper() == "UTC" or tz.upper() == "SYSTEM":
+        return datetime.timezone.utc
+    if tz and tz[0] in "+-":
+        sign = -1 if tz[0] == "-" else 1
+        hh, _, mm = tz[1:].partition(":")
+        try:
+            return datetime.timezone(
+                sign * datetime.timedelta(hours=int(hh), minutes=int(mm or 0))
+            )
+        except ValueError:
+            raise SyntaxError_(f"bad timezone offset {tz!r}") from None
+    import zoneinfo
+
+    try:
+        return zoneinfo.ZoneInfo(tz)
+    except (KeyError, zoneinfo.ZoneInfoNotFoundError):
+        raise SyntaxError_(f"unknown timezone {tz!r}") from None
+
+
+def parse_timestamp_str(raw: str, tz: str = "UTC") -> int:
+    """ISO-ish timestamp string → epoch ms (naive inputs localized to tz)."""
     s = raw.strip().replace("T", " ")
     fmts = [
         "%Y-%m-%d %H:%M:%S.%f%z", "%Y-%m-%d %H:%M:%S%z",
@@ -76,7 +98,7 @@ def parse_timestamp_str(raw: str) -> int:
         try:
             dt = datetime.datetime.strptime(s, f)
             if dt.tzinfo is None:
-                dt = dt.replace(tzinfo=datetime.timezone.utc)
+                dt = dt.replace(tzinfo=resolve_timezone(tz))
             return int(dt.timestamp() * 1000)
         except ValueError:
             continue
@@ -192,6 +214,8 @@ class Parser:
             return TruncateTable(self.qualified_name())
         if kw == "COPY":
             return self.copy()
+        if kw == "SET":
+            return self.set_var()
         raise SyntaxError_(f"unrecognized statement keyword: {t.text!r} at {t.pos}")
 
     # ---- SELECT ---------------------------------------------------------
@@ -679,6 +703,43 @@ class Parser:
         path = self.expect(Tok.STRING).text
         options = self._with_options(lowercase_keys=True)
         return Copy(table, path, direction, options)
+
+    def set_var(self):
+        from greptimedb_tpu.query.ast import SetVar
+
+        self.expect_kw("SET")
+        self.eat_kw("SESSION", "GLOBAL", "LOCAL")
+        while self.eat(Tok.PUNCT, "@"):  # @@session.var / @var forms
+            pass
+        self.eat_kw("SESSION")
+        self.eat(Tok.PUNCT, ".")
+        # NAMES charset [COLLATE ...] is special-cased
+        if self.eat_kw("NAMES"):
+            charset = self.next().text
+            self._consume_rest_of_statement()
+            return SetVar("names", charset)
+        # postgres form: SET TIME ZONE 'x'
+        if self.at_kw("TIME") and self.peek(1).upper == "ZONE":
+            self.next(); self.next()
+            value = self.next().text
+            self._consume_rest_of_statement()
+            return SetVar("time_zone", value)
+        name_parts = [self.ident()]
+        while self.eat(Tok.PUNCT, "."):
+            name_parts.append(self.ident())
+        name = name_parts[-1]  # session.time_zone → time_zone
+        self.eat(Tok.OP, "=")
+        self.eat_kw("TO")
+        t = self.next()
+        value = t.text
+        # remaining tokens (COLLATE ..., multiple assignments) are a
+        # compat no-op, like the statement itself for unknown variables
+        self._consume_rest_of_statement()
+        return SetVar(name.lower(), value)
+
+    def _consume_rest_of_statement(self) -> None:
+        while not self.at(Tok.EOF) and not self.at(Tok.PUNCT, ";"):
+            self.next()
 
     def _with_options(self, lowercase_keys: bool = False) -> dict:
         """Shared `WITH (k = v, ...)` parsing (CREATE TABLE, COPY)."""

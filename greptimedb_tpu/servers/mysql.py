@@ -74,6 +74,7 @@ class _Conn:
         self.seq = 0
         self.caps = 0
         self.session_db = "public"  # per-connection database
+        self.session_tz = "UTC"
 
     # ---- packet IO -----------------------------------------------------
     async def read_packet(self) -> bytes | None:
@@ -248,7 +249,7 @@ class _Conn:
         stripped = sql.strip().rstrip(";").strip()
         # common client housekeeping queries
         low = stripped.lower()
-        if low.startswith(("set ", "commit", "rollback", "start transaction",
+        if low.startswith(("commit", "rollback", "start transaction",
                            "begin")):
             self.send_ok()
             return
@@ -260,11 +261,17 @@ class _Conn:
                 column_types=["String"]))
             return
         try:
-            result, self.session_db = await loop.run_in_executor(
-                self.server._db_executor, self.server.db.sql_in_db,
-                stripped, self.session_db,
+            result, self.session_db, self.session_tz = (
+                await loop.run_in_executor(
+                    self.server._db_executor, self.server.db.sql_in_db,
+                    stripped, self.session_db, self.session_tz,
+                )
             )
         except GreptimeError as e:
+            if low.startswith("set "):
+                # exotic client SETs are compat no-ops, not errors
+                self.send_ok()
+                return
             self.send_err(e.msg, errno=1105, sqlstate=b"HY000")
             raise
         except Exception as e:  # noqa: BLE001

@@ -31,6 +31,7 @@ class _PgConn:
         self.reader = reader
         self.writer = writer
         self.session_db = "public"  # per-connection database
+        self.session_tz = "UTC"
 
     def _msg(self, tag: bytes, payload: bytes) -> None:
         self.writer.write(tag + struct.pack(">I", len(payload) + 4) + payload)
@@ -136,16 +137,19 @@ class _PgConn:
                     continue
                 sql = body.rstrip(b"\x00").decode("utf-8", "replace").strip()
                 low = sql.lower().rstrip(";")
-                if not low or low.startswith(("set ", "begin", "commit",
+                if not low or low.startswith(("begin", "commit",
                                               "rollback", "discard")):
                     self._msg(b"C", b"SET\x00")
                     self._ready()
                     await self.writer.drain()
                     continue
                 try:
-                    result, self.session_db = await loop.run_in_executor(
-                        self.server._db_executor, self.server.db.sql_in_db,
-                        sql, self.session_db,
+                    result, self.session_db, self.session_tz = (
+                        await loop.run_in_executor(
+                            self.server._db_executor,
+                            self.server.db.sql_in_db,
+                            sql, self.session_db, self.session_tz,
+                        )
                     )
                     if result.column_names:
                         types = (result.column_types
@@ -157,6 +161,11 @@ class _PgConn:
                     else:
                         self._msg(b"C", _complete_tag(low, result) + b"\x00")
                 except GreptimeError as e:
+                    if low.startswith("set"):
+                        self._msg(b"C", b"SET\x00")
+                        self._ready()
+                        await self.writer.drain()
+                        continue
                     self._error(e.msg, "42000")
                 except Exception as e:  # noqa: BLE001
                     self._error(str(e))
@@ -186,6 +195,8 @@ def _complete_tag(low: str, result) -> bytes:
         return b"TRUNCATE TABLE"
     if low.startswith("use"):
         return b"USE"
+    if low.startswith("set"):
+        return b"SET"
     return b"OK"
 
 

@@ -179,6 +179,7 @@ class GreptimeDB(TableProvider):
         from greptimedb_tpu.utils.auth import StaticUserProvider
 
         self.user_provider = StaticUserProvider()
+        self.timezone = "UTC"  # SET time_zone / config default_timezone
 
     def close(self) -> None:
         self.regions.close()
@@ -238,7 +239,7 @@ class GreptimeDB(TableProvider):
 
     def table_context(self, table: str) -> TableContext:
         view = self._table_view(table)
-        return TableContext(view.schema, view.encoders)
+        return TableContext(view.schema, view.encoders, self.timezone)
 
     def device_table(self, table: str, plan: SelectPlan):
         view = self._table_view(table)
@@ -257,19 +258,36 @@ class GreptimeDB(TableProvider):
                 result = self.execute_statement(stmt)
             return result
 
-    def sql_in_db(self, query: str, dbname: str) -> tuple[QueryResult, str]:
+    def set_timezone(self, tz: str) -> None:
+        """Validate + apply the instance default timezone."""
+        from greptimedb_tpu.errors import SyntaxError_
+        from greptimedb_tpu.query.parser import resolve_timezone
+
+        try:
+            resolve_timezone(tz)
+        except SyntaxError_ as e:
+            raise InvalidArguments(str(e)) from None
+        self.timezone = tz
+
+    def sql_in_db(
+        self, query: str, dbname: str, timezone: str | None = None
+    ) -> tuple[QueryResult, str, str]:
         """Session-scoped execution for wire-protocol connections: run with
-        ``dbname`` as the current database without leaking the switch to
-        other connections. Returns (result, session db after the call —
-        USE statements move it)."""
+        the connection's database and timezone without leaking either to
+        other connections. Returns (result, session db, session tz) —
+        USE / SET time_zone move them."""
         with self._lock:
-            prev = self.current_db
+            prev_db = self.current_db
+            prev_tz = self.timezone
             self.current_db = dbname
+            if timezone is not None:
+                self.timezone = timezone
             try:
                 result = self.sql(query)
-                return result, self.current_db
+                return result, self.current_db, self.timezone
             finally:
-                self.current_db = prev
+                self.current_db = prev_db
+                self.timezone = prev_tz
 
     def execute_statement(self, stmt: Statement) -> QueryResult:
         if isinstance(stmt, Select):
@@ -357,10 +375,16 @@ class GreptimeDB(TableProvider):
             return QueryResult([], [], affected_rows=0)
         if isinstance(stmt, (CreateFlow, DropFlow, ShowFlows)):
             return self._flow_statement(stmt)
-        from greptimedb_tpu.query.ast import Copy
+        from greptimedb_tpu.query.ast import Copy, SetVar
 
         if isinstance(stmt, Copy):
             return self._copy(stmt)
+        if isinstance(stmt, SetVar):
+            if stmt.name in ("time_zone", "timezone"):
+                self.set_timezone(stmt.value)
+            # other variables (names, sql_mode, ...) are accepted as no-ops
+            # for client compatibility, like the reference
+            return QueryResult([], [])
         raise Unsupported(f"statement {type(stmt).__name__}")
 
     # ---- DDL -----------------------------------------------------------
@@ -490,7 +514,7 @@ class GreptimeDB(TableProvider):
         # timestamp strings → epoch ints
         ts_name = schema.time_index.name
         if ts_name in data:
-            ctx = TableContext(schema, regions[0].encoders)
+            ctx = TableContext(schema, regions[0].encoders, self.timezone)
             data[ts_name] = [ctx.ts_literal(v) for v in data[ts_name]]
         if len(regions) == 1:
             regions[0].write(data)
@@ -521,7 +545,7 @@ class GreptimeDB(TableProvider):
         """DELETE by exact key conjunction (tags + ts), the mito semantic."""
         regions = self._regions_of(stmt.table)
         region = regions[0]
-        ctx = TableContext(region.schema, region.encoders)
+        ctx = TableContext(region.schema, region.encoders, self.timezone)
         from greptimedb_tpu.query.ast import BinaryOp, Column, Literal
 
         eq: dict[str, object] = {}

@@ -199,3 +199,19 @@ class TestMysqlProtocol:
         with pytest.raises(RuntimeError, match="failed to start"):
             dup.start()
         assert time.time() - t0 < 5  # real errno propagated, no 10s timeout
+
+    def test_session_timezone_isolated(self, mysql):
+        c1 = MiniMysqlClient(mysql.port); c1.connect()
+        c2 = MiniMysqlClient(mysql.port); c2.connect()
+        c1.query("CREATE TABLE tzt (ts TIMESTAMP(3) TIME INDEX, v DOUBLE)")
+        assert c1.query("SET time_zone = '+08:00'")[0] == "ok"
+        c1.query("INSERT INTO tzt VALUES ('2026-01-01 08:00:00', 1.0)")
+        # c1 sees its tz; c2 (UTC session) interprets the same literal differently
+        k1 = c1.query("SELECT count(*) FROM tzt WHERE ts >= '2026-01-01 08:00:00'")
+        k2 = c2.query("SELECT count(*) FROM tzt WHERE ts >= '2026-01-01 08:00:00'")
+        assert k1[2] == [["1"]]   # +08:00 session: literal == stored instant
+        assert k2[2] == [["0"]]   # UTC session: literal is 8h later
+        assert mysql.db.timezone == "UTC"  # global untouched
+        # exotic SET stays a no-op, not an error
+        assert c1.query("SET @@session.autocommit = 1")[0] == "ok"
+        c1.quit(); c2.quit()

@@ -729,3 +729,32 @@ class TestScalarFunctions:
         r = db.sql("SELECT substr('alphabet', 0, 3), substr('alphabet', 0),"
                    " substr('alphabet', 3, 2)")
         assert r.rows == [["al", "alphabet", "ph"]]
+
+
+class TestTimezones:
+    def test_set_time_zone_applies_to_literals(self, db):
+        db.sql("CREATE TABLE tz (ts TIMESTAMP(3) TIME INDEX, v DOUBLE)")
+        db.sql("SET time_zone = '+08:00'")
+        try:
+            db.sql("INSERT INTO tz VALUES ('2026-01-01 08:00:00', 1.0)")
+            # 08:00 at +08:00 == midnight UTC
+            r = db.sql("SELECT ts FROM tz")
+            assert r.rows == [[1767225600000]]
+            # WHERE literals parse in session tz too
+            assert db.sql("SELECT count(*) FROM tz WHERE"
+                          " ts >= '2026-01-01 07:59:00'").rows == [[1]]
+            db.sql("SET time_zone = 'UTC'")
+            assert db.sql("SELECT count(*) FROM tz WHERE"
+                          " ts >= '2026-01-01 00:00:00'").rows == [[1]]
+            assert db.sql("SELECT count(*) FROM tz WHERE"
+                          " ts >= '2026-01-01 00:00:01'").rows == [[0]]
+        finally:
+            db.sql("SET time_zone = 'UTC'")
+
+    def test_named_zone_and_bad_zone(self, db):
+        db.sql("SET time_zone = 'Asia/Shanghai'")
+        db.sql("SET time_zone = 'UTC'")
+        with pytest.raises(InvalidArguments):
+            db.sql("SET time_zone = 'Not/AZone'")
+        # unrelated SETs are tolerated no-ops
+        assert db.sql("SET sql_mode = 'ANSI'").rows == []
