@@ -40,6 +40,8 @@ def cmd_standalone(args) -> int:
     )
     if opts.default_timezone and opts.default_timezone != "UTC":
         db.set_timezone(opts.default_timezone)
+    if opts.slow_query.threshold_ms > 0:
+        db.slow_query_threshold_ms = opts.slow_query.threshold_ms
     if opts.auth.users:
         from greptimedb_tpu.utils.auth import StaticUserProvider
 

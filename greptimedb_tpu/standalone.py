@@ -180,6 +180,11 @@ class GreptimeDB(TableProvider):
 
         self.user_provider = StaticUserProvider()
         self.timezone = "UTC"  # SET time_zone / config default_timezone
+        # slow-query recorder (reference common-event-recorder + the
+        # greptime_private.slow_queries system table): queries slower than
+        # the threshold are appended to a private table; 0 disables
+        self.slow_query_threshold_ms: float = 0.0
+        self._recording_slow_query = False
 
     def close(self) -> None:
         self.regions.close()
@@ -249,14 +254,57 @@ class GreptimeDB(TableProvider):
     # ---- SQL entry -----------------------------------------------------
     def sql(self, query: str) -> QueryResult:
         """Execute one or more statements; returns the LAST result."""
+        import time as _time
+
         with self._lock:
+            t0 = _time.perf_counter()
             stmts = parse_sql(query)
             if not stmts:
                 return QueryResult([], [])
             result = QueryResult([], [])
             for stmt in stmts:
                 result = self.execute_statement(stmt)
+            elapsed_ms = (_time.perf_counter() - t0) * 1000
+            if (
+                self.slow_query_threshold_ms > 0
+                and elapsed_ms >= self.slow_query_threshold_ms
+                and not self._recording_slow_query
+                and any(isinstance(s, (Select, Tql)) for s in stmts)
+            ):
+                self._record_slow_query(query, elapsed_ms)
             return result
+
+    def _record_slow_query(self, query: str, elapsed_ms: float) -> None:
+        """Append to greptime_private.slow_queries (reference recorder.rs)."""
+        import time as _time
+
+        self._recording_slow_query = True  # the recorder must never recurse
+        try:
+            db = "greptime_private"
+            self.catalog.create_database(db, if_not_exists=True)
+            if not self.catalog.table_exists(db, "slow_queries"):
+                schema = Schema((
+                    ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND,
+                                 SemanticType.TIMESTAMP, nullable=False),
+                    ColumnSchema("cost_ms", ConcreteDataType.FLOAT64),
+                    ColumnSchema("threshold_ms", ConcreteDataType.FLOAT64),
+                    ColumnSchema("query", ConcreteDataType.STRING),
+                ))
+                info = self.catalog.create_table(db, "slow_queries", schema,
+                                                 if_not_exists=True)
+                if info is not None:
+                    self.regions.create_region(info.region_ids[0], schema)
+            region = self._region_of(f"{db}.slow_queries")
+            region.write({
+                "ts": [int(_time.time() * 1000)],
+                "cost_ms": [round(elapsed_ms, 3)],
+                "threshold_ms": [self.slow_query_threshold_ms],
+                "query": [query[:4096]],
+            })
+        except Exception:  # noqa: BLE001 (recording must never fail queries)
+            pass
+        finally:
+            self._recording_slow_query = False
 
     def set_timezone(self, tz: str) -> None:
         """Validate + apply the instance default timezone."""

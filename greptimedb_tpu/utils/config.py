@@ -59,10 +59,16 @@ class AuthOptions:
 
 
 @dataclass
+class SlowQueryOptions:
+    threshold_ms: float = 0.0  # 0 disables recording
+
+
+@dataclass
 class StandaloneOptions:
     node_id: int = 0
     default_timezone: str = "UTC"
     auth: AuthOptions = field(default_factory=AuthOptions)
+    slow_query: SlowQueryOptions = field(default_factory=SlowQueryOptions)
     http: HttpOptions = field(default_factory=HttpOptions)
     mysql: MysqlOptions = field(default_factory=MysqlOptions)
     postgres: PostgresOptions = field(default_factory=PostgresOptions)

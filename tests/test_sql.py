@@ -758,3 +758,26 @@ class TestTimezones:
             db.sql("SET time_zone = 'Not/AZone'")
         # unrelated SETs are tolerated no-ops
         assert db.sql("SET sql_mode = 'ANSI'").rows == []
+
+
+class TestSlowQueryRecorder:
+    def test_slow_queries_recorded(self, cpu):
+        cpu.slow_query_threshold_ms = 0.0001  # everything is "slow"
+        try:
+            cpu.sql("SELECT count(*) FROM cpu")
+        finally:
+            cpu.slow_query_threshold_ms = 0.0
+        r = cpu.sql("SELECT query, cost_ms FROM greptime_private.slow_queries")
+        assert r.num_rows >= 1
+        assert "count(*)" in r.rows[0][0]
+        assert r.rows[0][1] > 0
+        # recording itself (and DDL) is not re-recorded
+        n_before = r.num_rows
+        cpu.sql("CREATE TABLE notslow (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        r2 = cpu.sql("SELECT count(*) FROM greptime_private.slow_queries")
+        assert r2.rows[0][0] == n_before
+
+    def test_disabled_by_default(self, db):
+        db.sql("CREATE TABLE q (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        db.sql("SELECT count(*) FROM q")
+        assert not db.catalog.database_exists("greptime_private")
