@@ -185,6 +185,26 @@ class GreptimeDB(TableProvider):
         # the threshold are appended to a private table; 0 disables
         self.slow_query_threshold_ms: float = 0.0
         self._recording_slow_query = False
+        # persistent procedure manager (repartition etc.): one instance so
+        # table locks are process-wide; RUNNING journals from a crashed
+        # process resume here at startup
+        from greptimedb_tpu.meta.procedure import ProcedureManager
+        from greptimedb_tpu.meta.repartition import RepartitionProcedure
+
+        self.procedures = ProcedureManager(self.kv, services={"db": self})
+        self.procedures.register(RepartitionProcedure)
+        try:
+            resumed = self.procedures.recover()
+            if resumed:
+                import sys as _sys
+
+                print(f"resumed {len(resumed)} interrupted procedure(s)",
+                      file=_sys.stderr)
+        except Exception as e:  # noqa: BLE001 (startup must not die on a
+            # poisoned procedure; it stays journaled for inspection)
+            import sys as _sys
+
+            print(f"procedure recovery failed: {e}", file=_sys.stderr)
 
     def close(self) -> None:
         self.regions.close()
