@@ -40,8 +40,34 @@ class Datanode:
         self.roles: dict[int, str] = {}  # region_id -> leader|follower|downgrading
         self.lease_until_ms: dict[int, float] = {}
         self.alive = True
+        self._sync_fingerprints: dict[int, tuple] = {}
 
     # ---- data plane ----------------------------------------------------
+    def read(self, region_id: int, ts_range=(None, None), columns=None):
+        """Serve a scan from leader OR follower (read replica). Followers
+        return data as of their last sync (reference read-preference +
+        follower regions, store-api region_engine.rs RegionRole)."""
+        if not self.alive:
+            raise GreptimeError(f"datanode {self.node_id} is down")
+        region = self.engine.regions.get(region_id)
+        if region is None:
+            raise RegionNotFound(f"region {region_id} not on node {self.node_id}")
+        return region.scan_host(ts_range, columns)
+
+    def sync_region(self, region_id: int) -> None:
+        """Follower catch-up from shared storage (reference
+        SyncRegionFromRequest); no-op when storage hasn't changed since the
+        last sync (a full manifest+WAL re-read per heartbeat would be pure
+        waste on idle clusters)."""
+        region = self.engine.regions.get(region_id)
+        if region is None:
+            raise RegionNotFound(f"region {region_id} not on node {self.node_id}")
+        fp = region.storage_fingerprint()
+        if self._sync_fingerprints.get(region_id) == fp:
+            return
+        region.catch_up()
+        self._sync_fingerprints[region_id] = region.storage_fingerprint()
+
     def write(self, region_id: int, data: dict, now_ms: float) -> int:
         if not self.alive:
             raise GreptimeError(f"datanode {self.node_id} is down")
@@ -109,23 +135,8 @@ class Datanode:
             region = self.engine.regions.get(rid)
             if region is None:
                 raise RegionNotFound(f"region {rid} not open on {self.node_id}")
-            # catch-up (reference handle_catchup.rs): reload the latest
-            # manifest from shared storage, drop any stale memtable state,
-            # re-sync the sequence counter past flushed_seq (a stale
-            # next_seq would mint sequences the dedup already considers
-            # superseded), then replay the remaining WAL
-            from greptimedb_tpu.storage.manifest import Manifest
-            from greptimedb_tpu.storage.memtable import Memtable
-
-            region.manifest = Manifest.open(
-                region.store, f"region_{rid}/manifest"
-            )
-            region.memtable = Memtable(region.schema)
-            region.next_seq = max(
-                region.next_seq, region.manifest.state.flushed_seq + 1
-            )
-            region.replay_wal()
-            region.generation += 1
+            # catch-up before taking leadership (reference handle_catchup.rs)
+            region.catch_up()
             self.roles[rid] = "leader"
             self.lease_until_ms[rid] = now_ms + REGION_LEASE_MS
             return {"ok": True}
@@ -137,6 +148,9 @@ class Datanode:
         if kind == "renew_lease":
             if self.roles.get(rid) == "leader":
                 self.lease_until_ms[rid] = now_ms + REGION_LEASE_MS
+            return {"ok": True}
+        if kind == "sync_region":
+            self.sync_region(rid)
             return {"ok": True}
         raise GreptimeError(f"unknown instruction {kind}")
 
@@ -247,13 +261,33 @@ class Metasrv:
             return []
         det.heartbeat(now_ms)
         instructions = []
-        # lease renewal for leader regions this node legitimately routes
         for r in hb.get("regions", []):
             if r["role"] == "leader" and self.region_route(r["region_id"]) == node_id:
+                # lease renewal for leader regions this node legitimately routes
                 instructions.append(
                     {"kind": "renew_lease", "region_id": r["region_id"]}
                 )
+            elif r["role"] == "follower":
+                # read replicas catch up from shared storage each beat
+                instructions.append(
+                    {"kind": "sync_region", "region_id": r["region_id"]}
+                )
         return instructions
+
+    def add_follower(self, region_id: int, node_id: int, now_ms: float) -> None:
+        """Open a read replica of a region on another node."""
+        if node_id not in self.datanodes:
+            raise GreptimeError(f"unknown datanode {node_id}")
+        leader_node = self.region_route(region_id)
+        leader = self.datanodes.get(leader_node)
+        region = leader.engine.regions.get(region_id) if leader else None
+        instr = {"kind": "open_region", "region_id": region_id,
+                 "role": "follower"}
+        if region is not None:
+            instr["schema"] = region.schema.to_dict()
+        # without a schema the follower can still open a region that exists
+        # on shared storage; a truly unknown region raises RegionNotFound
+        self.datanodes[node_id].handle_instruction(instr, now_ms)
 
     # ---- supervision (reference region/supervisor.rs:280) --------------
     def select_target(self, exclude: set[int]) -> int | None:

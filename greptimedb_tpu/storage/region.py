@@ -340,6 +340,48 @@ class Region:
         self.memtable = Memtable(self.schema)
         self.generation += 1
 
+    def catch_up(self) -> None:
+        """Re-sync this region from shared storage (follower sync, leader
+        upgrade after migration — reference handle_catchup.rs): reload the
+        manifest, REHYDRATE tag dictionaries and the series registry from it
+        (stale encoders would mint colliding tsids against newer SSTs),
+        drop memtable state, sync the sequence counter, replay the WAL."""
+        from greptimedb_tpu.storage.manifest import Manifest
+
+        self.manifest = Manifest.open(self.store, f"{self._dir}/manifest")
+        state = self.manifest.state
+        self.encoders = {
+            c.name: DictionaryEncoder(state.dicts.get(c.name, []))
+            for c in self.schema.tag_columns
+        }
+        self._series = {
+            tuple(codes): i for i, codes in enumerate(state.series)
+        }
+        if state.schema is not None:
+            self.schema = state.schema
+        self.memtable = Memtable(self.schema)
+        self.next_seq = max(self.next_seq, state.flushed_seq + 1)
+        self.replay_wal()
+        self.generation += 1
+        self._index_cache.clear()
+
+    def storage_fingerprint(self) -> tuple:
+        """Cheap change detector for no-op sync skipping: manifest file set
+        + WAL segment names/sizes."""
+        import os as _os
+
+        manifest_files = tuple(self.store.list(f"{self._dir}/manifest"))
+        wal_state: tuple = ()
+        if hasattr(self.wal, "dir"):
+            try:
+                wal_state = tuple(
+                    (fn, _os.path.getsize(_os.path.join(self.wal.dir, fn)))
+                    for fn in sorted(_os.listdir(self.wal.dir))
+                )
+            except OSError:
+                wal_state = ()
+        return (manifest_files, wal_state)
+
     def ts_bounds(self) -> tuple[int, int] | None:
         """Data time bounds across memtable + SSTs; None when empty (an
         empty region must not drag a combined view's bounds to epoch 0)."""

@@ -373,3 +373,97 @@ class TestRepartition:
             assert db2.sql("SELECT count(*) FROM rr").rows == [[2]]
         finally:
             db2.close()
+
+
+class TestFollowerReads:
+    def test_replica_reads_and_sync(self, tmp_path):
+        from greptimedb_tpu.meta.cluster import Datanode, Metasrv
+        from greptimedb_tpu.meta.kv import MemoryKv
+
+        kv = MemoryKv(); ms = Metasrv(kv)
+        nodes = [Datanode(i, str(tmp_path)) for i in range(2)]
+        for dn in nodes:
+            ms.register_datanode(dn)
+        rid = 2001
+        nodes[0].handle_instruction(
+            {"kind": "open_region", "region_id": rid, "role": "leader",
+             "schema": schema().to_dict()}, 0.0)
+        ms.set_region_route(rid, 0)
+        nodes[0].write(rid, {"h": ["a"], "ts": [1000], "v": [1.0]}, 10.0)
+        nodes[0].engine.regions[rid].flush()
+
+        ms.add_follower(rid, 1, now_ms=20.0)
+        # follower serves reads; leader-only writes still enforced
+        host = nodes[1].read(rid)
+        assert host["v"].tolist() == [1.0]
+        with pytest.raises(GreptimeError, match="not leader"):
+            nodes[1].write(rid, {"h": ["b"], "ts": [2000], "v": [2.0]}, 20.0)
+
+        # new leader data becomes visible after the heartbeat-driven sync
+        nodes[0].write(rid, {"h": ["b"], "ts": [2000], "v": [2.0]}, 30.0)
+        nodes[0].engine.regions[rid].flush()
+        instrs = ms.handle_heartbeat(nodes[1].heartbeat(40.0), 40.0)
+        assert any(i["kind"] == "sync_region" for i in instrs)
+        for i in instrs:
+            nodes[1].handle_instruction(i, 40.0)
+        assert sorted(nodes[1].read(rid)["v"].tolist()) == [1.0, 2.0]
+
+    def test_sync_rehydrates_dictionaries(self, tmp_path):
+        """Regression: stale follower encoders must not mint colliding tsids."""
+        from greptimedb_tpu.meta.cluster import Datanode, Metasrv
+        from greptimedb_tpu.meta.kv import MemoryKv
+
+        kv = MemoryKv(); ms = Metasrv(kv)
+        nodes = [Datanode(i, str(tmp_path)) for i in range(2)]
+        for dn in nodes:
+            ms.register_datanode(dn)
+        rid = 2002
+        nodes[0].handle_instruction(
+            {"kind": "open_region", "region_id": rid, "role": "leader",
+             "schema": schema().to_dict()}, 0.0)
+        ms.set_region_route(rid, 0)
+        nodes[0].write(rid, {"h": ["a"], "ts": [1000], "v": [1.0]}, 10.0)
+        nodes[0].engine.regions[rid].flush()
+        ms.add_follower(rid, 1, now_ms=20.0)
+        # leader flushes NEW series 'b' (so follower WAL replay can't see it)
+        nodes[0].write(rid, {"h": ["b"], "ts": [1000], "v": [2.0]}, 30.0)
+        nodes[0].engine.regions[rid].flush()
+        # then writes WAL-only series 'c' at the SAME ts
+        nodes[0].write(rid, {"h": ["c"], "ts": [1000], "v": [3.0]}, 40.0)
+        nodes[1].sync_region(rid)
+        host = nodes[1].read(rid)
+        got = {h: v for h, v in zip(host["h"], host["v"])}
+        assert got == {"a": 1.0, "b": 2.0, "c": 3.0}  # no tsid collisions
+
+    def test_noop_sync_skipped(self, tmp_path):
+        from greptimedb_tpu.meta.cluster import Datanode, Metasrv
+        from greptimedb_tpu.meta.kv import MemoryKv
+
+        kv = MemoryKv(); ms = Metasrv(kv)
+        nodes = [Datanode(i, str(tmp_path)) for i in range(2)]
+        for dn in nodes:
+            ms.register_datanode(dn)
+        rid = 2003
+        nodes[0].handle_instruction(
+            {"kind": "open_region", "region_id": rid, "role": "leader",
+             "schema": schema().to_dict()}, 0.0)
+        ms.set_region_route(rid, 0)
+        nodes[0].write(rid, {"h": ["a"], "ts": [1000], "v": [1.0]}, 10.0)
+        nodes[0].engine.regions[rid].flush()
+        ms.add_follower(rid, 1, now_ms=20.0)
+        nodes[1].sync_region(rid)
+        gen = nodes[1].engine.regions[rid].generation
+        nodes[1].sync_region(rid)  # unchanged storage → no generation bump
+        assert nodes[1].engine.regions[rid].generation == gen
+
+    def test_add_follower_errors(self, tmp_path):
+        from greptimedb_tpu.meta.cluster import Datanode, Metasrv
+        from greptimedb_tpu.meta.kv import MemoryKv
+
+        kv = MemoryKv(); ms = Metasrv(kv)
+        dn = Datanode(0, str(tmp_path)); ms.register_datanode(dn)
+        with pytest.raises(GreptimeError, match="unknown datanode"):
+            ms.add_follower(5, 99, 0.0)
+        from greptimedb_tpu.errors import RegionNotFound
+        with pytest.raises(RegionNotFound):
+            ms.add_follower(424242, 0, 0.0)  # no route, not on disk
