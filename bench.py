@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import time
 
@@ -31,6 +32,10 @@ import numpy as np
 BASELINE_MS = 1330.05
 SCALE = int(os.environ.get("GREPTIME_BENCH_SCALE", "4000"))
 HOURS = int(os.environ.get("GREPTIME_BENCH_HOURS", "24"))
+# Wall-clock budget: the driver kills the bench with `timeout`; emit the
+# JSON line from however many runs completed before the budget expires.
+BUDGET_S = float(os.environ.get("GREPTIME_BENCH_BUDGET_S", "420"))
+START = time.time()
 STEP_S = 10
 DATA_DIR = os.environ.get(
     "GREPTIME_BENCH_DATA", os.path.join(os.path.dirname(__file__), ".bench_data")
@@ -98,7 +103,49 @@ def build_db():
     return db
 
 
-def probe_tpu(timeout_s: int = 180) -> bool:
+_times: list[float] = []
+_warmup_times: list[float] = []  # SIGTERM fallback when no timed run finished
+_emitted = False
+
+
+def emit(times: list[float]) -> None:
+    """Print the one JSON line of record from whatever runs completed."""
+    global _emitted
+    if _emitted or not times:
+        return
+    _emitted = True
+    value = float(np.median(times))
+    print(json.dumps({
+        "metric": "tsbs_double_groupby_all_ms",
+        "value": round(value, 2),
+        "unit": "ms",
+        "vs_baseline": round(value / BASELINE_MS, 4),
+    }), flush=True)
+
+
+def _on_term(signum, frame):
+    # async-signal context: the main thread may hold the stdout/stderr
+    # BufferedWriter lock, so print() here could raise a reentrancy error —
+    # write the JSON line with raw os.write instead
+    global _emitted
+    times = _times or _warmup_times[-1:]
+    if times and not _emitted:
+        _emitted = True
+        value = float(np.median(times))
+        line = json.dumps({
+            "metric": "tsbs_double_groupby_all_ms",
+            "value": round(value, 2),
+            "unit": "ms",
+            "vs_baseline": round(value / BASELINE_MS, 4),
+        })
+        os.write(2, f"signal {signum}; emitting from {len(times)} runs\n".encode())
+        os.write(1, (line + "\n").encode())
+    os._exit(0 if _emitted else 1)
+
+
+def probe_tpu(
+    timeout_s: int = int(os.environ.get("GREPTIME_BENCH_PROBE_S", "45")),
+) -> bool:
     """Check the TPU backend responds (the axon relay can wedge; a hung
     bench is worse than a CPU bench). Probe in a subprocess with timeout."""
     import subprocess
@@ -121,6 +168,9 @@ def probe_tpu(timeout_s: int = 180) -> bool:
 def main() -> None:
     import jax
 
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
     if os.environ.get("JAX_PLATFORMS"):
         # the runtime image preimports jax, so the env var alone is too late
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
@@ -128,8 +178,19 @@ def main() -> None:
         log("WARNING: TPU backend unresponsive; falling back to CPU for this run")
         jax.config.update("jax_platforms", "cpu")
 
+    # Persistent compilation cache: kills the multi-minute first-run compile
+    # on repeat driver invocations (jit programs are keyed by shape class,
+    # so the warm cache from data generation runs carries over).
+    cache_dir = os.path.join(DATA_DIR, "jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # cache is an optimisation, never a blocker
+        log(f"compile cache unavailable: {e}")
+
     db = build_db()
-    log(f"jax devices: {jax.devices()}")
+    log(f"jax devices: {jax.devices()} ({time.time() - START:.0f}s elapsed)")
 
     # TSBS double-groupby-all: avg of all 10 metrics by (hostname, hour)
     # over a 12h window (window shrinks with GREPTIME_BENCH_HOURS)
@@ -146,26 +207,34 @@ def main() -> None:
     log("warmup (compile + cache build) ...")
     t0 = time.time()
     r = db.sql(sql)
-    log(f"  first run: {(time.time() - t0) * 1000:.0f} ms, {r.num_rows} groups")
-    t0 = time.time()
-    db.sql(sql)
-    log(f"  second run: {(time.time() - t0) * 1000:.0f} ms")
-
-    times = []
-    for _ in range(10):
-        t0 = time.time()
-        r = db.sql(sql)
-        times.append((time.time() - t0) * 1000)
-    value = float(np.median(times))
+    first_ms = (time.time() - t0) * 1000
+    _warmup_times.append(first_ms)
+    log(f"  first run: {first_ms:.0f} ms, {r.num_rows} groups")
     expected_groups = SCALE * window_h
     assert r.num_rows == expected_groups, (r.num_rows, expected_groups)
-    log(f"runs: {[f'{t:.0f}' for t in times]} ms; groups={r.num_rows}")
-    print(json.dumps({
-        "metric": "tsbs_double_groupby_all_ms",
-        "value": round(value, 2),
-        "unit": "ms",
-        "vs_baseline": round(value / BASELINE_MS, 4),
-    }))
+
+    deadline = START + BUDGET_S
+    second_ms = None
+    if time.time() < deadline:
+        t0 = time.time()
+        db.sql(sql)
+        second_ms = (time.time() - t0) * 1000
+        _warmup_times.append(second_ms)
+        log(f"  second run: {second_ms:.0f} ms")
+
+    while len(_times) < 10 and time.time() + (
+        second_ms or first_ms
+    ) / 1000 < deadline:
+        t0 = time.time()
+        r = db.sql(sql)
+        _times.append((time.time() - t0) * 1000)
+
+    if not _times:
+        # budget exhausted during warmup: the warm(er) run is the number
+        _times.append(second_ms if second_ms is not None else first_ms)
+    log(f"runs: {[f'{t:.0f}' for t in _times]} ms; groups={r.num_rows} "
+        f"({time.time() - START:.0f}s elapsed)")
+    emit(_times)
     db.close()
 
 

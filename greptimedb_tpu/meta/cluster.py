@@ -18,7 +18,7 @@ from __future__ import annotations
 import json
 
 from greptimedb_tpu.datatypes.schema import Schema
-from greptimedb_tpu.errors import GreptimeError, RegionNotFound
+from greptimedb_tpu.errors import GreptimeError, InvalidArguments, RegionNotFound
 from greptimedb_tpu.meta.failure_detector import PhiAccrualFailureDetector
 from greptimedb_tpu.meta.kv import KvBackend
 from greptimedb_tpu.meta.procedure import (
@@ -108,13 +108,22 @@ class Datanode:
         rid = instr.get("region_id")
         if kind == "open_region":
             schema = Schema.from_dict(instr["schema"]) if "schema" in instr else None
+            role = instr.get("role", "follower")
+            was_open = rid in self.engine.regions
             try:
-                self.engine.open_region(rid)
+                # followers open read-only: the WAL dir is shared with the
+                # live leader, whose in-flight append must not be repaired
+                self.engine.open_region(rid, take_ownership=(role == "leader"))
             except RegionNotFound:
                 if schema is None:
                     raise
                 self.engine.create_region(rid, schema)
-            self.roles[rid] = instr.get("role", "follower")
+            if role == "leader" and was_open and self.roles.get(rid) != "leader":
+                # promoting an already-open follower region: its read-only
+                # replay left torn tails unrepaired and state possibly stale;
+                # a full ownership catch-up is mandatory before leadership
+                self.engine.regions[rid].catch_up(take_ownership=True)
+            self.roles[rid] = role
             if self.roles[rid] == "leader":
                 self.lease_until_ms[rid] = now_ms + REGION_LEASE_MS
             return {"ok": True}
@@ -136,7 +145,7 @@ class Datanode:
             if region is None:
                 raise RegionNotFound(f"region {rid} not open on {self.node_id}")
             # catch-up before taking leadership (reference handle_catchup.rs)
-            region.catch_up()
+            region.catch_up(take_ownership=True)
             self.roles[rid] = "leader"
             self.lease_until_ms[rid] = now_ms + REGION_LEASE_MS
             return {"ok": True}
@@ -279,6 +288,16 @@ class Metasrv:
         if node_id not in self.datanodes:
             raise GreptimeError(f"unknown datanode {node_id}")
         leader_node = self.region_route(region_id)
+        if node_id == leader_node:
+            # re-opening the region as follower on its own leader node would
+            # silently demote the active leader and fail all writes
+            raise InvalidArguments(
+                f"node {node_id} is the leader for region {region_id}; "
+                f"cannot also host it as follower"
+            )
+        dn = self.datanodes[node_id]
+        if dn.roles.get(region_id) == "follower":
+            return  # already a follower there
         leader = self.datanodes.get(leader_node)
         region = leader.engine.regions.get(region_id) if leader else None
         instr = {"kind": "open_region", "region_id": region_id,

@@ -158,7 +158,10 @@ class Region:
         # durability first (reference handle_write.rs: WAL before memtable)
         wal_cols = {}
         for k, v in chunk.items():
-            wal_cols[k] = pa.array(v.astype(str) if v.dtype == object else v)
+            # object-dtype (string) columns: pa.array over the python list
+            # preserves None as arrow nulls (astype(str) would corrupt NULL
+            # into the literal 'None' across crash recovery)
+            wal_cols[k] = pa.array(v.tolist() if v.dtype == object else v)
         self.wal.append(seq, encode_write(wal_cols))
         # memtable stores ts as int64 under the schema's ts column name
         mt_chunk = dict(chunk)
@@ -235,16 +238,21 @@ class Region:
         self._maybe_compact()
         return meta
 
-    def replay_wal(self) -> int:
+    def replay_wal(self, repair: bool = True) -> int:
         """Replay entries past flushed_seq into the memtable (region open).
 
         Tag codes/tsids are RECOMPUTED (not trusted from the log): encoders
         are hydrated from the manifest's flush-time state, and replaying
         writes in original order regrows them deterministically — so the
         series registry stays consistent for post-replay writes.
+
+        ``repair=False`` = read-only replay (followers sharing the leader's
+        WAL dir must never truncate its active segment).
         """
         count = 0
-        for seq, payload in self.wal.replay(self.manifest.state.flushed_seq + 1):
+        for seq, payload in self.wal.replay(
+            self.manifest.state.flushed_seq + 1, repair=repair
+        ):
             cols = decode_write(payload)
             chunk: dict[str, np.ndarray] = {}
             for c in self.schema:
@@ -340,16 +348,25 @@ class Region:
         self.memtable = Memtable(self.schema)
         self.generation += 1
 
-    def catch_up(self) -> None:
+    def catch_up(self, take_ownership: bool = False) -> None:
         """Re-sync this region from shared storage (follower sync, leader
         upgrade after migration — reference handle_catchup.rs): reload the
         manifest, REHYDRATE tag dictionaries and the series registry from it
         (stale encoders would mint colliding tsids against newer SSTs),
-        drop memtable state, sync the sequence counter, replay the WAL."""
+        drop memtable state, sync the sequence counter, replay the WAL.
+
+        ``take_ownership=True`` (leader upgrade) additionally repairs torn
+        WAL tails; followers replay read-only — the leader may be mid-append
+        on the shared segment."""
         from greptimedb_tpu.storage.manifest import Manifest
 
         self.manifest = Manifest.open(self.store, f"{self._dir}/manifest")
         state = self.manifest.state
+        # adopt the manifest schema FIRST: the leader may have added tag
+        # columns online (add_tag_column), and encoders built from the stale
+        # schema would miss them, breaking the next replay/write
+        if state.schema is not None:
+            self.schema = state.schema
         self.encoders = {
             c.name: DictionaryEncoder(state.dicts.get(c.name, []))
             for c in self.schema.tag_columns
@@ -357,11 +374,9 @@ class Region:
         self._series = {
             tuple(codes): i for i, codes in enumerate(state.series)
         }
-        if state.schema is not None:
-            self.schema = state.schema
         self.memtable = Memtable(self.schema)
         self.next_seq = max(self.next_seq, state.flushed_seq + 1)
-        self.replay_wal()
+        self.replay_wal(repair=take_ownership)
         self.generation += 1
         self._index_cache.clear()
 
@@ -527,7 +542,10 @@ class RegionEngine:
         self.regions[region_id] = region
         return region
 
-    def open_region(self, region_id: int) -> Region:
+    def open_region(self, region_id: int, take_ownership: bool = True) -> Region:
+        """Open an existing region.  ``take_ownership=False`` = follower open:
+        replay the (possibly leader-shared) WAL read-only, never repairing
+        torn tails the live leader may still be appending."""
         if region_id in self.regions:
             return self.regions[region_id]
         manifest = Manifest.open(self.store, f"region_{region_id}/manifest")
@@ -536,7 +554,7 @@ class RegionEngine:
         opts = RegionOptions(**manifest.state.options) if manifest.state.options else self.default_options
         region = Region(region_id, self.store, manifest.state.schema, manifest,
                         self._wal_dir(region_id), opts)
-        region.replay_wal()
+        region.replay_wal(repair=take_ownership)
         self.regions[region_id] = region
         return region
 

@@ -33,7 +33,7 @@ class LogStore:
     def append(self, sequence: int, payload: bytes) -> None:
         raise NotImplementedError
 
-    def replay(self, from_sequence: int):
+    def replay(self, from_sequence: int, repair: bool = True):
         raise NotImplementedError
 
     def truncate(self, up_to_sequence: int) -> None:
@@ -75,9 +75,13 @@ class FileLogStore(LogStore):
         self._current_id += 1
         self._fh = open(self._seg_path(self._current_id), "ab")
 
-    def replay(self, from_sequence: int = 0):
+    def replay(self, from_sequence: int = 0, repair: bool = True):
         """Yield (sequence, payload) for entries with sequence >= from_sequence.
-        Stops (and truncates) at the first torn/corrupt record."""
+        Stops at the first torn/corrupt record; with ``repair`` (write
+        ownership — leader open/recovery) the torn tail is truncated so
+        future appends start clean.  Followers replaying a WAL directory
+        shared with a live leader MUST pass repair=False: a partially
+        flushed leader append would otherwise be destroyed mid-write."""
         try:
             from greptimedb_tpu import native
         except ImportError:
@@ -107,12 +111,13 @@ class FileLogStore(LogStore):
                     if seq >= from_sequence:
                         yield seq, payload
             if good_end < len(data):
-                # torn tail: truncate so future appends start clean
-                with open(path, "r+b") as f:
-                    f.truncate(good_end)
-                if seg == self._current_id:
-                    self._fh.close()
-                    self._fh = open(path, "ab")
+                if repair:
+                    # torn tail: truncate so future appends start clean
+                    with open(path, "r+b") as f:
+                        f.truncate(good_end)
+                    if seg == self._current_id:
+                        self._fh.close()
+                        self._fh = open(path, "ab")
                 break
 
     def truncate(self, up_to_sequence: int) -> None:
@@ -142,7 +147,7 @@ class NoopLogStore(LogStore):
     def append(self, sequence: int, payload: bytes) -> None:
         pass
 
-    def replay(self, from_sequence: int = 0):
+    def replay(self, from_sequence: int = 0, repair: bool = True):
         return iter(())
 
     def truncate(self, up_to_sequence: int) -> None:

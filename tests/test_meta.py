@@ -467,3 +467,57 @@ class TestFollowerReads:
         from greptimedb_tpu.errors import RegionNotFound
         with pytest.raises(RegionNotFound):
             ms.add_follower(424242, 0, 0.0)  # no route, not on disk
+
+
+class TestAdvisorRegressions:
+    def test_add_follower_on_leader_node_rejected(self, tmp_path):
+        """add_follower(leader's own node) must not demote the leader."""
+        from greptimedb_tpu.errors import InvalidArguments
+        from greptimedb_tpu.meta.cluster import Datanode, Metasrv
+        from greptimedb_tpu.meta.kv import MemoryKv
+
+        kv = MemoryKv(); ms = Metasrv(kv)
+        nodes = [Datanode(i, str(tmp_path)) for i in range(2)]
+        for dn in nodes:
+            ms.register_datanode(dn)
+        rid = 2100
+        nodes[0].handle_instruction(
+            {"kind": "open_region", "region_id": rid, "role": "leader",
+             "schema": schema().to_dict()}, 0.0)
+        ms.set_region_route(rid, 0)
+        with pytest.raises(InvalidArguments, match="leader"):
+            ms.add_follower(rid, 0, now_ms=10.0)
+        # leader unharmed: writes still work
+        nodes[0].write(rid, {"h": ["a"], "ts": [1000], "v": [1.0]}, 20.0)
+        # adding the same follower twice is a no-op, not a demotion
+        ms.add_follower(rid, 1, now_ms=30.0)
+        ms.add_follower(rid, 1, now_ms=31.0)
+        assert nodes[1].roles[rid] == "follower"
+
+    def test_open_region_leader_promotion_catches_up(self, tmp_path):
+        """open_region(role=leader) on an already-open follower region must
+        run an ownership catch-up (torn-tail repair + fresh replay), not
+        silently grant leadership over stale state."""
+        from greptimedb_tpu.meta.cluster import Datanode, Metasrv
+        from greptimedb_tpu.meta.kv import MemoryKv
+
+        kv = MemoryKv(); ms = Metasrv(kv)
+        nodes = [Datanode(i, str(tmp_path)) for i in range(2)]
+        for dn in nodes:
+            ms.register_datanode(dn)
+        rid = 2200
+        nodes[0].handle_instruction(
+            {"kind": "open_region", "region_id": rid, "role": "leader",
+             "schema": schema().to_dict()}, 0.0)
+        ms.set_region_route(rid, 0)
+        nodes[0].write(rid, {"h": ["a"], "ts": [1000], "v": [1.0]}, 1.0)
+        ms.add_follower(rid, 1, now_ms=2.0)
+        # leader writes more (WAL-only) after the follower opened
+        nodes[0].write(rid, {"h": ["b"], "ts": [2000], "v": [2.0]}, 3.0)
+        # promote the follower via open_region(role=leader)
+        nodes[1].handle_instruction(
+            {"kind": "open_region", "region_id": rid, "role": "leader"}, 4.0)
+        host = nodes[1].read(rid)
+        assert sorted(host["v"].tolist()) == [1.0, 2.0]  # caught up
+        seq = nodes[1].write(rid, {"h": ["c"], "ts": [3000], "v": [3.0]}, 5.0)
+        assert seq >= 3  # sequence advanced past the leader's writes

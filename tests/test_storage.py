@@ -381,3 +381,97 @@ class TestSkippingIndex:
         host2 = r.scan_host(tag_filters={"hostname": {"zulu"}})
         assert len(host2["ts"]) >= 50
         eng.close()
+
+
+class TestAdvisorRegressions:
+    def test_wal_preserves_string_nulls(self, tmp_data_dir):
+        """NULL in a nullable string field must survive crash recovery
+        (WAL encode used astype(str), corrupting None -> 'None')."""
+        sch = Schema((
+            ColumnSchema("h", T.STRING, S.TAG),
+            ColumnSchema("ts", T.TIMESTAMP_MILLISECOND, S.TIMESTAMP),
+            ColumnSchema("msg", T.STRING, S.FIELD),
+        ))
+        eng = RegionEngine(tmp_data_dir)
+        r = eng.create_region(1, sch)
+        r.write({"h": ["a", "a"], "ts": [1000, 2000],
+                 "msg": ["hello", None]})
+        # crash: no flush; reopen replays the WAL
+        eng2 = RegionEngine(tmp_data_dir)
+        r2 = eng2.open_region(1)
+        host = r2.scan_host()
+        got = {int(t): m for t, m in zip(host["ts"], host["msg"])}
+        assert got[1000] == "hello"
+        assert got[2000] is None
+        eng2.close()
+        eng.close()
+
+    def test_readonly_replay_keeps_torn_tail(self, tmp_path):
+        """Follower (read-only) replay must not truncate a torn tail the
+        live leader may still be appending."""
+        import os
+
+        wal = FileLogStore(str(tmp_path / "wal"))
+        wal.append(1, encode_write({"v": np.array([1])}))
+        wal.close()
+        path = tmp_path / "wal" / os.listdir(tmp_path / "wal")[0]
+        with open(path, "ab") as f:
+            f.write(b"\x01\x02\x03")  # leader mid-append
+        size_before = os.path.getsize(path)
+        reader = FileLogStore(str(tmp_path / "wal"))
+        assert [s for s, _ in reader.replay(0, repair=False)] == [1]
+        assert os.path.getsize(path) == size_before  # untouched
+        # write-ownership replay repairs it
+        assert [s for s, _ in reader.replay(0, repair=True)] == [1]
+        assert os.path.getsize(path) < size_before
+        reader.close()
+
+    def test_catchup_after_online_tag_add(self, tmp_data_dir):
+        """Follower catch_up must adopt the manifest schema BEFORE building
+        encoders: a leader-side add_tag_column + WAL-only write previously
+        left the follower's encoders missing the new column."""
+        eng = RegionEngine(tmp_data_dir)
+        leader = eng.create_region(1, cpu_schema())
+        write_rows(leader, 4)
+        leader.flush()
+
+        eng2 = RegionEngine(tmp_data_dir)
+        follower = eng2.open_region(1)
+        assert len(follower.scan_host()["ts"]) == 4
+
+        leader.add_tag_column("dc")
+        leader.write({"hostname": ["h9"], "region": ["eu"], "dc": ["fra"],
+                      "ts": [99000], "usage_user": [9.0],
+                      "usage_system": [9.0]})  # WAL-only (no flush)
+        follower.catch_up()
+        host = follower.scan_host()
+        assert len(host["ts"]) == 5
+        by_ts = {int(t): d for t, d in zip(host["ts"], host["dc"])}
+        assert by_ts[99000] == "fra"
+        # follower can keep replaying subsequent leader writes
+        leader.write({"hostname": ["h9"], "region": ["eu"], "dc": ["ber"],
+                      "ts": [99500], "usage_user": [9.5],
+                      "usage_system": [9.5]})
+        follower.catch_up()
+        assert len(follower.scan_host()["ts"]) == 6
+        eng2.close()
+        eng.close()
+
+    def test_follower_open_keeps_torn_tail(self, tmp_data_dir):
+        """Initial follower open (not just catch_up) must replay read-only."""
+        import os
+
+        eng = RegionEngine(tmp_data_dir)
+        leader = eng.create_region(1, cpu_schema())
+        write_rows(leader, 3)
+        wal_dir = leader.wal.dir
+        seg = os.path.join(wal_dir, sorted(os.listdir(wal_dir))[0])
+        with open(seg, "ab") as f:
+            f.write(b"\x07\x07")  # leader mid-append
+        size_before = os.path.getsize(seg)
+        eng2 = RegionEngine(tmp_data_dir)
+        follower = eng2.open_region(1, take_ownership=False)
+        assert len(follower.scan_host()["ts"]) == 3
+        assert os.path.getsize(seg) == size_before  # untouched
+        eng2.close()
+        eng.close()
