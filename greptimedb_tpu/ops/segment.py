@@ -195,6 +195,38 @@ def segment_first_last(
     return out_ts, out_val
 
 
+def segmented_sum_scan(
+    values: jnp.ndarray,
+    ids: jnp.ndarray,
+    starts: jnp.ndarray,
+    ends: jnp.ndarray,
+) -> jnp.ndarray:
+    """Scatter-free per-segment float sums for NONDECREASING ids.
+
+    Uses a segmented scan that resets at id boundaries instead of a global
+    cumsum-diff: a global f32 prefix over millions of rows grows to
+    magnitudes where eps(prefix) swamps small group sums, while the
+    segmented scan bounds rounding error by each GROUP's own magnitude
+    (same associative (value, id) trick as the min/max path below).
+
+    ``values`` is [N] or [N, C] (already masked to 0 on invalid rows);
+    ``starts``/``ends`` are the searchsorted segment boundaries. Empty
+    segments return 0.
+    """
+    wide = values.ndim == 2
+
+    def seg_add(a, b):
+        av, ai = a
+        bv, bi = b
+        eq = ai == bi
+        return jnp.where(eq[:, None] if wide else eq, av + bv, bv), bi
+
+    scanned, _ids = jax.lax.associative_scan(seg_add, (values, ids))
+    s = scanned[jnp.clip(ends - 1, 0, values.shape[0] - 1)]
+    nonempty = ends > starts
+    return jnp.where(nonempty[:, None] if wide else nonempty, s, 0)
+
+
 def sorted_segment_reduce(
     values: jnp.ndarray,
     seg_ids: jnp.ndarray,
@@ -239,8 +271,12 @@ def sorted_segment_reduce(
     if op == "count":
         return cnt
     if op in ("sum", "mean"):
-        v = values if is_float else values.astype(jnp.int64)
-        s = cs(jnp.where(m, v, 0))[ends] - cs(jnp.where(m, v, 0))[starts]
+        if is_float:
+            s = segmented_sum_scan(jnp.where(m, values, 0), ids, starts, ends)
+        else:
+            # int64 cumsum-diff is exact — keep the cheaper single pass
+            v = values.astype(jnp.int64)
+            s = cs(jnp.where(m, v, 0))[ends] - cs(jnp.where(m, v, 0))[starts]
         if op == "sum":
             return s
         sf = s.astype(jnp.float32) if not is_float else s

@@ -475,7 +475,7 @@ class TestSortedFastPath:
             "SELECT host, date_bin(INTERVAL '5 minute', ts) b, avg(v), max(v),"
             " count(*) FROM st GROUP BY host, b ORDER BY host, b LIMIT 3")
 
-    def test_single_tag_groupby_uses_sorted_path(self, db):
+    def test_single_tag_groupby_uses_sorted_path(self, db, monkeypatch):
         db.sql("CREATE TABLE st (host STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (host))")
         r = db._region_of("st")
         import numpy as np
@@ -488,12 +488,11 @@ class TestSortedFastPath:
         assert "host" in table.sorted_tags  # precondition for the fast path
         # force the sorted kernel (CPU-gated by default) to cover it e2e
         import greptimedb_tpu.query.physical as phys
-        orig = phys.jax.default_backend
-        phys.jax.default_backend = lambda: "tpu"
-        try:
-            res = self._run_query(db)
-        finally:
-            phys.jax.default_backend = orig
+        before = dict(phys.DISPATCH_STATS)
+        monkeypatch.setenv("GREPTIME_SORTED_SEGMENTS", "force")
+        res = self._run_query(db)
+        monkeypatch.setenv("GREPTIME_SORTED_SEGMENTS", "off")
+        assert phys.DISPATCH_STATS["sorted"] > before["sorted"]  # really ran
         res2 = db.sql(  # and the scatter path for comparison
 
             "SELECT host, date_bin(INTERVAL '5 minute', ts) b, avg(v), max(v),"
@@ -537,7 +536,7 @@ class TestStringFieldRegressions:
             db.sql("SELECT max(line) FROM lg2")
         assert db.sql("SELECT count(line) FROM lg2").rows == [[2]]
 
-    def test_sorted_minmax_tagless_timeonly(self, db):
+    def test_sorted_minmax_tagless_timeonly(self, db, monkeypatch):
         # review regression: padding rows must not corrupt min/max on the
         # sorted path for tag-less time-only group-bys
         db.sql("CREATE TABLE nt (ts TIMESTAMP(3) TIME INDEX, v DOUBLE)")
@@ -545,14 +544,9 @@ class TestStringFieldRegressions:
         r = db._region_of("nt")
         n = 100  # pads to 128 -> 28 padding rows
         r.write({"ts": np.arange(n) * 60_000, "v": np.arange(n, dtype=float)})
-        import greptimedb_tpu.query.physical as phys
-        orig = phys.jax.default_backend
-        phys.jax.default_backend = lambda: "tpu"
-        try:
-            res = db.sql("SELECT date_bin(INTERVAL '30 minute', ts) b, max(v), min(v)"
-                         " FROM nt GROUP BY b ORDER BY b")
-        finally:
-            phys.jax.default_backend = orig
+        monkeypatch.setenv("GREPTIME_SORTED_SEGMENTS", "force")
+        res = db.sql("SELECT date_bin(INTERVAL '30 minute', ts) b, max(v), min(v)"
+                     " FROM nt GROUP BY b ORDER BY b")
         assert res.rows[-1][1] == 99.0  # last bucket max intact
         assert res.rows[0][2] == 0.0
 
