@@ -89,6 +89,20 @@ def cmd_standalone(args) -> int:
     return 0
 
 
+def cmd_datanode(args) -> int:
+    """Datanode role process: regions behind Arrow Flight (reference
+    src/cmd/src/datanode.rs + src/datanode/src/region_server.rs)."""
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from greptimedb_tpu.rpc.datanode import serve
+
+    serve(args.node_id, args.data_home, host=args.host, port=args.port,
+          managed=args.managed)
+    return 0
+
+
 def cmd_sql(args) -> int:
     from greptimedb_tpu.standalone import GreptimeDB
 
@@ -244,6 +258,21 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--data-home")
     ps.add_argument("--http-addr")
     ps.set_defaults(fn=cmd_standalone)
+
+    pd = sub.add_parser("datanode", help="run a datanode (Flight server)")
+    pd.add_argument("action", choices=["start"])
+    pd.add_argument("--node-id", type=int, required=True)
+    pd.add_argument("--data-home", required=True)
+    pd.add_argument("--host", default="127.0.0.1")
+    pd.add_argument("--port", type=int, default=0,
+                    help="0 = pick a free port (printed as JSON on stdout)")
+    pd.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu)")
+    pd.add_argument("--managed", action="store_true",
+                    help="a metasrv owns region leases (enables lease "
+                         "self-fencing; without it leader leases self-renew "
+                         "on write)")
+    pd.set_defaults(fn=cmd_datanode)
 
     pq_ = sub.add_parser("sql", help="SQL shell / one-shot query")
     pq_.add_argument("--data-home", required=True)

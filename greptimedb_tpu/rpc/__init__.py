@@ -1,0 +1,33 @@
+"""Cross-process data plane: Arrow Flight services over gRPC.
+
+The reference runs its distributed data plane on tonic gRPC + Arrow
+Flight (src/servers/src/grpc/builder.rs:140-166; region RPC + Flight
+do_get in src/client/src/region.rs:53-133).  This package is the
+TPU-framework equivalent for the frontend↔datanode boundary (SURVEY.md
+§5.8: collectives ride ICI inside a pod; Flight/gRPC stays for the
+frontend↔pod and inter-pod hops):
+
+- ``datanode``  — DatanodeFlightServer: hosts regions in a separate OS
+  process; do_put = region writes, do_get = shipped sub-query execution
+  streaming Arrow batches back, do_action = control-plane instructions
+  (open/close/upgrade region, heartbeat) — the mailbox made explicit.
+- ``client``    — DatanodeClient (thin Flight wrapper) and
+  RemoteDatanode, a proxy with the in-process Datanode surface so the
+  Metasrv's migration/failover procedures drive remote processes
+  unchanged.
+- ``frontend``  — DistFrontend: catalog + routes + the MergeScan analog
+  (partial-aggregate pushdown, merge on the frontend).
+- ``partial``   — the commutativity split shared by both sides
+  (reference dist_plan/commutativity.rs).
+"""
+
+from greptimedb_tpu.rpc.client import DatanodeClient, RemoteDatanode
+from greptimedb_tpu.rpc.datanode import DatanodeFlightServer
+from greptimedb_tpu.rpc.frontend import DistFrontend
+
+__all__ = [
+    "DatanodeClient",
+    "DatanodeFlightServer",
+    "DistFrontend",
+    "RemoteDatanode",
+]

@@ -1,0 +1,223 @@
+"""Datanode role: an OS process hosting regions behind Arrow Flight.
+
+Reference equivalents: the datanode RegionServer gRPC service
+(src/servers/src/grpc/region_server.rs, src/datanode/src/region_server.rs:230)
+and Flight do_get for shipped sub-plans (region_server.rs:958).  One
+Flight service carries all three planes:
+
+- ``do_put``   — region writes (Arrow record batches; the reference bulk
+  ingest path, grpc/flight do_put).
+- ``do_get``   — query execution: the ticket carries a SQL sub-plan (the
+  plan codec — the reference ships substrait, we ship SQL re-split by
+  rpc/partial.py on both sides) or a raw scan request; results stream
+  back as Arrow batches.
+- ``do_action``— control plane: mailbox instructions (open/close/
+  upgrade/downgrade/flush region), heartbeat, status — the reference's
+  heartbeat mailbox made an explicit RPC.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as fl
+
+from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.meta.cluster import Datanode
+from greptimedb_tpu.query.engine import QueryEngine, TableProvider
+from greptimedb_tpu.query.exprs import TableContext
+from greptimedb_tpu.query.parser import parse_sql
+from greptimedb_tpu.rpc.partial import split_partial
+from greptimedb_tpu.storage.cache import RegionCacheManager
+from greptimedb_tpu.storage.memtable import OP, SEQ, TSID
+
+
+def _now_ms() -> float:
+    return time.time() * 1000.0
+
+
+class _ScopedProvider(TableProvider):
+    """TableProvider over one request's (table, region set) view."""
+
+    def __init__(self, name: str, view, cache: RegionCacheManager,
+                 timezone: str):
+        self.name = name
+        self.view = view
+        self.cache = cache
+        self.timezone = timezone
+
+    def table_context(self, table: str) -> TableContext:
+        return TableContext(self.view.schema, self.view.encoders,
+                            self.timezone)
+
+    def device_table(self, table: str, plan):
+        return self.cache.get(self.view), self.view.ts_bounds() or (0, 0)
+
+
+def _result_to_table(res) -> pa.Table:
+    cols = {}
+    for i, name in enumerate(res.column_names):
+        cols[name] = [r[i] for r in res.rows]
+    if not cols:
+        return pa.table({"__empty__": pa.array([], pa.int8())})
+    meta = {}
+    if res.column_types:
+        meta[b"greptime_types"] = json.dumps(res.column_types).encode()
+    t = pa.table(cols)
+    return t.replace_schema_metadata(meta)
+
+
+def _host_scan_to_table(host: dict[str, np.ndarray]) -> pa.Table:
+    cols = {}
+    for k, v in host.items():
+        if k in (TSID, SEQ, OP):
+            continue  # region-local internals; the puller re-derives them
+        cols[k] = pa.array(v.tolist() if v.dtype == object else v)
+    return pa.table(cols)
+
+
+class DatanodeFlightServer(fl.FlightServerBase):
+    def __init__(self, node_id: int, data_home: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 managed: bool = False):
+        location = f"grpc://{host}:{port}"
+        super().__init__(location)
+        self.node_id = node_id
+        self.datanode = Datanode(node_id, data_home)
+        self.cache = RegionCacheManager()
+        self._views: dict[tuple, object] = {}
+        self._view_nonce = 0
+        self.host = host
+        # managed=True: a metasrv owns region leases (renewed through
+        # heartbeat instructions; expired leases self-fence writes).
+        # managed=False: frontend-only deployment — leader leases
+        # self-renew on write (no supervisor exists to fence against).
+        self.managed = managed
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # ---- helpers -------------------------------------------------------
+    def _view(self, table: str, region_ids: list[int]):
+        from greptimedb_tpu.standalone import CombinedRegionView
+
+        regions = []
+        for rid in region_ids:
+            r = self.datanode.engine.regions.get(rid)
+            if r is None:
+                raise fl.FlightServerError(
+                    f"region {rid} not open on node {self.node_id}"
+                )
+            regions.append(r)
+        if len(regions) == 1:
+            return regions[0]
+        key = (table, tuple(region_ids))
+        cached = self._views.get(key)
+        # identity check: a close+reopen replaces the Region object; a view
+        # over the dead object would serve its stale memtable forever
+        if cached is not None and all(
+            a is b for a, b in zip(cached.regions, regions)
+        ):
+            view = cached
+        else:
+            # nonce in the key: a rebuilt view (region reopened) must not
+            # share the old view's device-cache identity — the reopened
+            # region's reset generation could collide with a cached entry
+            self._view_nonce += 1
+            view = CombinedRegionView(
+                f"{table}@{self.node_id}#{self._view_nonce}", regions
+            )
+            self._views[key] = view
+        view._refresh()
+        return view
+
+    # ---- write plane ---------------------------------------------------
+    def do_put(self, context, descriptor, reader, writer):
+        from greptimedb_tpu.meta.cluster import REGION_LEASE_MS
+
+        cmd = json.loads(descriptor.command.decode())
+        rid = cmd["region_id"]
+        if not self.managed and self.datanode.roles.get(rid) == "leader":
+            self.datanode.lease_until_ms[rid] = _now_ms() + REGION_LEASE_MS
+        table = reader.read_all()
+        data: dict[str, np.ndarray] = {}
+        for name in table.column_names:
+            col = table.column(name)
+            if pa.types.is_string(col.type) or pa.types.is_large_string(col.type):
+                data[name] = np.asarray(col.to_pylist(), dtype=object)
+            else:
+                data[name] = col.to_numpy(zero_copy_only=False)
+        self.datanode.write(rid, data, _now_ms())
+
+    # ---- query plane ---------------------------------------------------
+    def do_get(self, context, ticket):
+        req = json.loads(ticket.ticket.decode())
+        mode = req.get("mode", "sql")
+        view = self._view(req["table"], req["region_ids"])
+        if mode == "scan":
+            ts_range = tuple(req.get("ts_range", (None, None)))
+            host = view.scan_host(ts_range)
+            table = _host_scan_to_table(host)
+        else:
+            sel = parse_sql(req["sql"])[0]
+            if mode == "partial":
+                plan = split_partial(sel)
+                if plan is None:
+                    raise fl.FlightServerError(
+                        f"query is not partial-decomposable: {req['sql']}"
+                    )
+                sel = plan.partial_select
+            provider = _ScopedProvider(
+                req["table"], view, self.cache, req.get("timezone", "UTC")
+            )
+            sel.table = req["table"]
+            res = QueryEngine(provider).execute_select(sel)
+            table = _result_to_table(res)
+        return fl.RecordBatchStream(table)
+
+    # ---- control plane -------------------------------------------------
+    def do_action(self, context, action):
+        kind = action.type
+        body = json.loads(action.body.to_pybytes().decode()) if (
+            action.body is not None and len(action.body)
+        ) else {}
+        if kind == "instruction":
+            out = self.datanode.handle_instruction(body, _now_ms())
+        elif kind == "heartbeat":
+            out = self.datanode.heartbeat(_now_ms())
+        elif kind == "status":
+            out = {
+                "node_id": self.node_id,
+                "roles": {str(k): v for k, v in self.datanode.roles.items()},
+                "regions": {
+                    str(rid): r.schema.to_dict()
+                    for rid, r in self.datanode.engine.regions.items()
+                },
+            }
+        elif kind == "health":
+            out = {"ok": True, "node_id": self.node_id}
+        elif kind == "shutdown":
+            # shutdown() blocks until in-flight RPCs finish — including
+            # THIS one; defer it so the action can complete first
+            import threading
+
+            threading.Thread(target=self.shutdown, daemon=True).start()
+            yield fl.Result(json.dumps({"ok": True}).encode())
+            return
+        else:
+            raise GreptimeError(f"unknown action {kind}")
+        yield fl.Result(json.dumps(out).encode())
+
+
+def serve(node_id: int, data_home: str, host: str = "127.0.0.1",
+          port: int = 0, managed: bool = False) -> None:
+    """Blocking entry point for the datanode role process."""
+    server = DatanodeFlightServer(node_id, data_home, host, port,
+                                  managed=managed)
+    print(json.dumps({"node_id": node_id, "address": server.address}),
+          flush=True)
+    server.serve()

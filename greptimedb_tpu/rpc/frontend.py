@@ -1,0 +1,266 @@
+"""Distributed frontend: stateless SQL router over remote datanodes.
+
+The process-split analog of the reference frontend Instance
+(src/frontend/src/instance.rs:917) with the MergeScan execution model
+(src/query/src/dist_plan/merge_scan.rs:210,335): DDL creates regions on
+datanodes and records routes; INSERT splits rows by partition rule and
+ships per-region Flight do_put batches; SELECT pushes the commutative
+partial query (rpc/partial.py) to every datanode hosting the table and
+merges partial states on the frontend — or, for non-decomposable
+queries, pulls filtered rows into a local staging instance and finishes
+with the full local engine (the reference's "rest of the plan executes
+on the frontend" path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from greptimedb_tpu.errors import GreptimeError, Unsupported
+from greptimedb_tpu.meta.catalog import CatalogManager
+from greptimedb_tpu.meta.kv import KvBackend, MemoryKv
+from greptimedb_tpu.query.ast import CreateTable, Insert, Select
+from greptimedb_tpu.query.engine import QueryResult
+from greptimedb_tpu.query.exprs import TableContext
+from greptimedb_tpu.query.parser import parse_sql
+from greptimedb_tpu.rpc.client import RemoteDatanode
+from greptimedb_tpu.rpc.partial import merge_partials, split_partial
+
+
+class DistFrontend:
+    def __init__(self, kv: KvBackend | None = None, db: str = "public"):
+        self.kv = kv or MemoryKv()
+        self.catalog = CatalogManager(self.kv)
+        if not self.catalog.database_exists(db):
+            self.catalog.create_database(db, if_not_exists=True)
+        self.db = db
+        self.datanodes: dict[int, RemoteDatanode] = {}
+        self._rr = 0  # round-robin cursor for region placement
+        self.timezone = "UTC"
+
+    # ---- membership ----------------------------------------------------
+    def add_datanode(self, node_id: int, address: str) -> RemoteDatanode:
+        dn = RemoteDatanode(node_id, address)
+        self.datanodes[node_id] = dn
+        return dn
+
+    def close(self) -> None:
+        for dn in self.datanodes.values():
+            dn.client.close()
+
+    # ---- routes --------------------------------------------------------
+    def set_region_route(self, region_id: int, node_id: int) -> None:
+        self.kv.put_json(f"__meta/route/region/{region_id}",
+                         {"node": node_id})
+
+    def region_route(self, region_id: int) -> int | None:
+        rec = self.kv.get_json(f"__meta/route/region/{region_id}")
+        return None if rec is None else rec["node"]
+
+    # ---- SQL entry -----------------------------------------------------
+    def sql(self, query: str) -> QueryResult:
+        stmts = parse_sql(query)
+        res = QueryResult([], [])
+        for stmt in stmts:
+            if isinstance(stmt, CreateTable):
+                res = self._create_table(stmt)
+            elif isinstance(stmt, Insert):
+                res = self._insert(stmt)
+            elif isinstance(stmt, Select):
+                if len(stmts) > 1:
+                    raise Unsupported(
+                        "multi-statement scripts with SELECT on the "
+                        "distributed frontend"
+                    )
+                res = self._select(stmt, query)
+            else:
+                raise Unsupported(
+                    f"distributed frontend: {type(stmt).__name__}"
+                )
+        return res
+
+    # ---- DDL -----------------------------------------------------------
+    def _create_table(self, stmt: CreateTable) -> QueryResult:
+        from greptimedb_tpu.standalone import schema_from_create
+
+        schema = schema_from_create(stmt)
+        info = self.catalog.create_table(
+            self.db, stmt.name, schema,
+            engine=stmt.engine,
+            options=stmt.options,
+            partition_exprs=stmt.partitions,
+            partition_columns=stmt.partition_columns,
+            num_regions=max(len(stmt.partitions), 1),
+            if_not_exists=stmt.if_not_exists,
+        )
+        if info is None:  # IF NOT EXISTS on an existing table
+            return QueryResult([], [])
+        node_ids = sorted(self.datanodes)
+        if not node_ids:
+            raise GreptimeError("no datanodes registered")
+        for rid in info.region_ids:
+            node = node_ids[self._rr % len(node_ids)]
+            self._rr += 1
+            self.datanodes[node].handle_instruction(
+                {"kind": "open_region", "region_id": rid, "role": "leader",
+                 "schema": schema.to_dict()}, 0.0,
+            )
+            self.set_region_route(rid, node)
+        return QueryResult([], [])
+
+    # ---- DML -----------------------------------------------------------
+    def _partition_rule(self, info):
+        from greptimedb_tpu.parallel.partition import PartitionRule
+
+        if info.partition_exprs:
+            return PartitionRule.from_sql(info.partition_columns,
+                                          info.partition_exprs)
+        return PartitionRule.hash_rule(
+            len(info.region_ids), [c.name for c in info.schema.tag_columns]
+        )
+
+    def _insert(self, stmt: Insert) -> QueryResult:
+        from greptimedb_tpu.parallel.partition import split_rows
+        from greptimedb_tpu.standalone import insert_rows_to_columns
+
+        info = self.catalog.get_table(self.db, stmt.table)
+        schema = info.schema
+        columns, data = insert_rows_to_columns(stmt, schema, self.timezone)
+        n = len(stmt.rows)
+        if len(info.region_ids) == 1:
+            routed = {0: np.arange(n)}
+        else:
+            rule = self._partition_rule(info)
+            cols_np = {c: np.asarray(v, dtype=object)
+                       for c, v in data.items()}
+            routed = split_rows(rule, cols_np, n)
+        for pidx, row_idx in routed.items():
+            rid = info.region_ids[pidx]
+            chunk = {c: [data[c][i] for i in row_idx] for c in columns}
+            node = self.region_route(rid)
+            if node is None or node not in self.datanodes:
+                raise GreptimeError(f"no route for region {rid}")
+            self.datanodes[node].client.write(rid, chunk)
+        return QueryResult([], [], affected_rows=n)
+
+    # ---- reads ---------------------------------------------------------
+    def _node_regions(self, info) -> dict[int, list[int]]:
+        """region ids of this table grouped by hosting datanode."""
+        out: dict[int, list[int]] = {}
+        for rid in info.region_ids:
+            node = self.region_route(rid)
+            if node is None:
+                raise GreptimeError(f"no route for region {rid}")
+            out.setdefault(node, []).append(rid)
+        return out
+
+    def _select(self, sel: Select, raw_sql: str) -> QueryResult:
+        if sel.table is None:
+            raise Unsupported("tableless SELECT on the distributed frontend")
+        info = self.catalog.get_table(self.db, sel.table)
+        by_node = self._node_regions(info)
+        plan = split_partial(sel)
+        if plan is not None:
+            # MergeScan fast path: each datanode re-derives the identical
+            # partial split from the shipped SQL (shared rpc/partial.py)
+            parts = []
+            for node, rids in by_node.items():
+                table = self.datanodes[node].client.query(
+                    raw_sql, sel.table, rids, mode="partial",
+                    timezone=self.timezone,
+                )
+                parts.append({
+                    name: table.column(name).to_pylist()
+                    for name in table.column_names
+                    if name != "__empty__"
+                })
+            names, rows = merge_partials(plan, parts)
+            return self._shape(sel, QueryResult(names, rows))
+        return self._select_raw(sel, info, by_node, raw_sql)
+
+    def _select_raw(self, sel: Select, info, by_node,
+                    raw_sql: str) -> QueryResult:
+        """Pull filtered rows into a local staging instance, finish
+        locally.  The time-index range from the WHERE clause is pushed
+        into the remote scan (reference scan-hint pruning); the full WHERE
+        re-applies locally over the staged rows."""
+        from greptimedb_tpu.query.planner import extract_time_range
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        ctx = TableContext(info.schema, {}, self.timezone)
+        ts_range = extract_time_range(sel.where, ctx)
+        stage = GreptimeDB(None)
+        try:
+            st_info = stage.catalog.create_table(
+                stage.current_db, sel.table, info.schema, num_regions=1
+            )
+            region = stage.regions.create_region(
+                st_info.region_ids[0], info.schema
+            )
+            for node, rids in by_node.items():
+                table = self.datanodes[node].client.scan(
+                    sel.table, rids, ts_range=ts_range
+                )
+                if table.num_rows == 0:
+                    continue
+                data = {}
+                for name in table.column_names:
+                    col = table.column(name)
+                    if str(col.type) in ("string", "large_string"):
+                        data[name] = np.asarray(col.to_pylist(), dtype=object)
+                    else:
+                        data[name] = col.to_numpy(zero_copy_only=False)
+                region.write(data)
+            return stage.sql(raw_sql)
+        finally:
+            stage.close()
+
+    def _shape(self, sel: Select, res: QueryResult) -> QueryResult:
+        """ORDER BY / LIMIT over merged partial results (frontend side of
+        MergeScan: the non-commutative suffix)."""
+        if sel.order_by:
+            idx = {n: i for i, n in enumerate(res.column_names)}
+
+            def sort_key(row):
+                key = []
+                for ob in sel.order_by:
+                    name = str(ob.expr)
+                    if name not in idx:
+                        raise Unsupported(
+                            f"distributed ORDER BY {name}: not an output "
+                            "column"
+                        )
+                    key.append(_SortVal(row[idx[name]], ob.asc))
+                return key
+
+            res.rows.sort(key=sort_key)
+        if sel.limit is not None:
+            res.rows[:] = res.rows[: sel.limit]
+        return res
+
+
+class _SortVal:
+    """Total-orderable wrapper: None/NaN last, direction-aware."""
+
+    __slots__ = ("v", "asc")
+
+    def __init__(self, v, asc: bool):
+        self.v = v
+        self.asc = asc
+
+    def _rank(self):
+        missing = self.v is None or (
+            isinstance(self.v, float) and self.v != self.v
+        )
+        return (1 if missing else 0, 0 if missing else self.v)
+
+    def __lt__(self, other):
+        a, b = self._rank(), other._rank()
+        if a[0] != b[0]:
+            return a[0] < b[0]
+        if a[1] == b[1]:
+            return False
+        return (a[1] < b[1]) if self.asc else (a[1] > b[1])
+
+    def __eq__(self, other):
+        return self._rank() == other._rank()

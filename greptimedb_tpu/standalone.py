@@ -34,6 +34,61 @@ from greptimedb_tpu.storage.cache import RegionCacheManager
 from greptimedb_tpu.storage.region import RegionEngine, RegionOptions
 
 
+def schema_from_create(stmt: "CreateTable") -> Schema:
+    """CREATE TABLE statement → Schema (time index + tags + fields);
+    shared by the standalone executor and the distributed frontend."""
+    time_index = stmt.time_index
+    cols: list[ColumnSchema] = []
+    for cd in stmt.columns:
+        dtype = ConcreteDataType.parse(cd.type_name)
+        if cd.name == time_index:
+            semantic = SemanticType.TIMESTAMP
+            if not dtype.is_timestamp:
+                raise InvalidArguments(
+                    f"time index {cd.name} must be a timestamp, got {cd.type_name}"
+                )
+        elif cd.name in stmt.primary_keys:
+            semantic = SemanticType.TAG
+        else:
+            semantic = SemanticType.FIELD
+        cols.append(
+            ColumnSchema(
+                cd.name, dtype, semantic,
+                nullable=cd.nullable and semantic is not SemanticType.TIMESTAMP,
+                default=cd.default,
+            )
+        )
+    schema = Schema(tuple(cols))
+    if schema.time_index is None:
+        raise InvalidArguments("missing TIME INDEX")
+    return schema
+
+
+def insert_rows_to_columns(
+    stmt: "Insert", schema: Schema, timezone: str = "UTC"
+) -> tuple[list[str], dict[str, list]]:
+    """INSERT statement → validated column lists (timestamp strings
+    localized to epoch ints); shared by the standalone executor and the
+    distributed frontend."""
+    columns = stmt.columns or [c.name for c in schema]
+    if any(not schema.has_column(c) for c in columns):
+        bad = [c for c in columns if not schema.has_column(c)]
+        raise InvalidArguments(f"unknown insert columns {bad}")
+    data: dict[str, list] = {c: [] for c in columns}
+    for row in stmt.rows:
+        if len(row) != len(columns):
+            raise InvalidArguments(
+                f"row has {len(row)} values, expected {len(columns)}"
+            )
+        for c, v in zip(columns, row):
+            data[c].append(v)
+    ts_name = schema.time_index.name
+    if ts_name in data:
+        ctx = TableContext(schema, {}, timezone)
+        data[ts_name] = [ctx.ts_literal(v) for v in data[ts_name]]
+    return columns, data
+
+
 class CombinedRegionView:
     """Frontend-side merge view over a partitioned table's regions.
 
@@ -241,10 +296,16 @@ class GreptimeDB(TableProvider):
         db, name = self._split_name(table)
         key = f"{db}.{name}"
         view = self._views.get(key)
-        if view is None or [r.region_id for r in view.regions] != [
-            r.region_id for r in regions
-        ]:
-            view = CombinedRegionView(key, regions)
+        if view is None or not (
+            len(view.regions) == len(regions)
+            and all(a is b for a, b in zip(view.regions, regions))
+        ):
+            # nonce: a rebuilt view (repartition swapped the region set)
+            # must not share the old view's device-cache identity — fresh
+            # regions restart at low generations that could collide with
+            # cached entries
+            self._view_nonce = getattr(self, "_view_nonce", 0) + 1
+            view = CombinedRegionView(f"{key}#{self._view_nonce}", regions)
             self._views[key] = view
         view._refresh()  # planning needs current combined dictionaries
         return view
@@ -458,30 +519,7 @@ class GreptimeDB(TableProvider):
     # ---- DDL -----------------------------------------------------------
     def _create_table(self, stmt: CreateTable) -> QueryResult:
         db, name = self._split_name(stmt.name)
-        time_index = stmt.time_index
-        cols: list[ColumnSchema] = []
-        for cd in stmt.columns:
-            dtype = ConcreteDataType.parse(cd.type_name)
-            if cd.name == time_index:
-                semantic = SemanticType.TIMESTAMP
-                if not dtype.is_timestamp:
-                    raise InvalidArguments(
-                        f"time index {cd.name} must be a timestamp, got {cd.type_name}"
-                    )
-            elif cd.name in stmt.primary_keys:
-                semantic = SemanticType.TAG
-            else:
-                semantic = SemanticType.FIELD
-            cols.append(
-                ColumnSchema(
-                    cd.name, dtype, semantic,
-                    nullable=cd.nullable and semantic is not SemanticType.TIMESTAMP,
-                    default=cd.default,
-                )
-            )
-        schema = Schema(tuple(cols))
-        if schema.time_index is None:
-            raise InvalidArguments("missing TIME INDEX")
+        schema = schema_from_create(stmt)
         info = self.catalog.create_table(
             db, name, schema,
             engine=stmt.engine,
@@ -567,23 +605,8 @@ class GreptimeDB(TableProvider):
     def _insert(self, stmt: Insert) -> QueryResult:
         regions = self._regions_of(stmt.table)
         schema = regions[0].schema
-        columns = stmt.columns or [c.name for c in schema]
-        if any(not schema.has_column(c) for c in columns):
-            bad = [c for c in columns if not schema.has_column(c)]
-            raise InvalidArguments(f"unknown insert columns {bad}")
-        data: dict[str, list] = {c: [] for c in columns}
-        for row in stmt.rows:
-            if len(row) != len(columns):
-                raise InvalidArguments(
-                    f"row has {len(row)} values, expected {len(columns)}"
-                )
-            for c, v in zip(columns, row):
-                data[c].append(v)
-        # timestamp strings → epoch ints
+        columns, data = insert_rows_to_columns(stmt, schema, self.timezone)
         ts_name = schema.time_index.name
-        if ts_name in data:
-            ctx = TableContext(schema, regions[0].encoders, self.timezone)
-            data[ts_name] = [ctx.ts_literal(v) for v in data[ts_name]]
         if len(regions) == 1:
             regions[0].write(data)
         else:

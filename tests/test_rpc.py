@@ -1,0 +1,333 @@
+"""Cross-process data plane tests: Flight datanode service, distributed
+frontend (MergeScan analog), migration across real sockets.
+
+Mirrors the reference's cluster integration tier
+(tests-integration/src/cluster.rs + tests/grpc.rs): servers here run
+in-process on real TCP sockets; one test spawns true OS subprocesses.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.query.parser import parse_sql
+from greptimedb_tpu.rpc import (
+    DatanodeClient,
+    DatanodeFlightServer,
+    DistFrontend,
+    RemoteDatanode,
+)
+from greptimedb_tpu.rpc.partial import merge_partials, split_partial
+
+
+@pytest.fixture
+def two_nodes(tmp_path):
+    servers = [
+        DatanodeFlightServer(i, str(tmp_path / f"dn{i}")) for i in range(2)
+    ]
+    yield servers
+    for s in servers:
+        s.shutdown()
+
+
+@pytest.fixture
+def frontend(two_nodes):
+    fe = DistFrontend()
+    for s in two_nodes:
+        fe.add_datanode(s.node_id, s.address)
+    yield fe
+    fe.close()
+
+
+class TestPartialSplit:
+    def test_decomposable(self):
+        sel = parse_sql(
+            "SELECT host, avg(v), count(*), min(v), max(v), sum(v) FROM t "
+            "GROUP BY host ORDER BY host LIMIT 5"
+        )[0]
+        plan = split_partial(sel)
+        assert plan is not None
+        assert plan.key_cols == ("__k0",)
+        # avg ships as sum+count partials
+        names = [it.output_name for it in plan.items]
+        assert names[0] == "host" and "avg(v)" in names[1]
+        assert plan.partial_select.limit is None
+        assert plan.partial_select.order_by == []
+
+    def test_not_decomposable(self):
+        for q in (
+            "SELECT DISTINCT host FROM t",
+            "SELECT host, count(DISTINCT v) FROM t GROUP BY host",
+            "SELECT host, first_value(v) FROM t GROUP BY host",
+            "SELECT v FROM t ORDER BY ts LIMIT 3",
+            "SELECT host, avg(v) FROM t GROUP BY host HAVING avg(v) > 1",
+        ):
+            assert split_partial(parse_sql(q)[0]) is None, q
+
+    def test_merge_partials(self):
+        sel = parse_sql(
+            "SELECT host, avg(v), count(*) FROM t GROUP BY host"
+        )[0]
+        plan = split_partial(sel)
+        parts = [
+            {"__k0": ["a", "b"], "__a1_0": [10.0, 4.0], "__a1_1": [2, 1],
+             "__a2_0": [2, 1]},
+            {"__k0": ["a"], "__a1_0": [2.0], "__a1_1": [2], "__a2_0": [2]},
+        ]
+        names, rows = merge_partials(plan, parts)
+        got = {r[0]: r[1:] for r in rows}
+        assert got["a"] == [3.0, 4]  # (10+2)/(2+2), 2+2
+        assert got["b"] == [4.0, 1]
+
+
+class TestFlightDataPlane:
+    def test_write_query_roundtrip(self, two_nodes):
+        s = two_nodes[0]
+        client = DatanodeClient(s.address)
+        from tests.test_meta import schema
+
+        client.instruction({"kind": "open_region", "region_id": 11,
+                            "role": "leader", "schema": schema().to_dict()})
+        client.write(11, {"h": ["a", "b", "a"], "ts": [1000, 2000, 3000],
+                          "v": [1.0, 2.0, 3.0]})
+        out = client.query(
+            "SELECT h, sum(v) FROM t GROUP BY h ORDER BY h", "t", [11]
+        )
+        got = dict(zip(out.column("h").to_pylist(),
+                       out.column("sum(v)").to_pylist()))
+        assert got == {"a": 4.0, "b": 2.0}
+        # scan plane
+        raw = client.scan("t", [11])
+        assert raw.num_rows == 3
+        assert sorted(raw.column("v").to_pylist()) == [1.0, 2.0, 3.0]
+        # heartbeat + status
+        hb = client.heartbeat()
+        assert hb["regions"][0]["region_id"] == 11
+        assert client.status()["roles"] == {"11": "leader"}
+        client.close()
+
+    def test_partial_mode_on_datanode(self, two_nodes):
+        s = two_nodes[0]
+        client = DatanodeClient(s.address)
+        from tests.test_meta import schema
+
+        client.instruction({"kind": "open_region", "region_id": 12,
+                            "role": "leader", "schema": schema().to_dict()})
+        client.write(12, {"h": ["a", "a"], "ts": [1000, 2000],
+                          "v": [1.0, 5.0]})
+        out = client.query(
+            "SELECT h, avg(v) FROM t GROUP BY h", "t", [12], mode="partial"
+        )
+        # partial result: sum + count, not the final avg
+        assert set(out.column_names) == {"__k0", "__a1_0", "__a1_1"}
+        assert out.column("__a1_0").to_pylist() == [6.0]
+        assert out.column("__a1_1").to_pylist() == [2]
+        client.close()
+
+
+class TestDistFrontend:
+    def test_distributed_query(self, frontend):
+        frontend.sql(
+            "CREATE TABLE cpu (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY (host)) "
+            "PARTITION ON COLUMNS (host) (host < 'm', host >= 'm')"
+        )
+        # rows land on both datanodes (partition rule routes by host)
+        frontend.sql(
+            "INSERT INTO cpu VALUES ('a', 1000, 1.0), ('a', 2000, 3.0), "
+            "('z', 1000, 10.0), ('z', 2000, 20.0), ('b', 1000, 5.0)"
+        )
+        res = frontend.sql(
+            "SELECT host, avg(v), count(*), max(v) FROM cpu "
+            "GROUP BY host ORDER BY host"
+        )
+        assert res.column_names[0] == "host"
+        assert res.rows == [
+            ["a", 2.0, 2, 3.0],
+            ["b", 5.0, 1, 5.0],
+            ["z", 15.0, 2, 20.0],
+        ]
+
+    def test_distributed_raw_fallback(self, frontend):
+        frontend.sql(
+            "CREATE TABLE ev (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY (host)) "
+            "PARTITION ON COLUMNS (host) (host < 'm', host >= 'm')"
+        )
+        frontend.sql(
+            "INSERT INTO ev VALUES ('a', 1000, 1.0), ('z', 2000, 2.0), "
+            "('b', 3000, 3.0)"
+        )
+        # ORDER BY ts LIMIT: not partial-decomposable -> raw path
+        res = frontend.sql("SELECT host, v FROM ev ORDER BY ts DESC LIMIT 2")
+        assert res.rows == [["b", 3.0], ["z", 2.0]]
+        # WHERE + projection also goes raw (no aggregate to split)
+        res2 = frontend.sql(
+            "SELECT host FROM ev WHERE v > 1.5 ORDER BY host"
+        )
+        assert res2.rows == [["b"], ["z"]]
+
+    def test_query_spans_both_nodes(self, frontend, two_nodes):
+        frontend.sql(
+            "CREATE TABLE sp (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY (host)) "
+            "PARTITION ON COLUMNS (host) (host < 'm', host >= 'm')"
+        )
+        frontend.sql(
+            "INSERT INTO sp VALUES ('a', 1000, 1.0), ('z', 1000, 2.0)"
+        )
+        hosted = [len(s.datanode.engine.regions) for s in two_nodes]
+        assert hosted == [1, 1]  # one region per node (round-robin)
+        res = frontend.sql("SELECT sum(v), count(*) FROM sp")
+        assert res.rows == [[3.0, 2]]
+
+
+class TestCrossProcessMigration:
+    def test_migration_between_flight_nodes(self, tmp_path):
+        """Region migration driven by the UNMODIFIED Metasrv procedure over
+        RemoteDatanode proxies — instructions travel a real socket."""
+        from greptimedb_tpu.meta.cluster import Metasrv
+        from greptimedb_tpu.meta.kv import MemoryKv
+        from tests.test_meta import schema
+
+        # both nodes share a data home (shared storage, like the
+        # reference's object-store + remote-WAL failover story)
+        shared = str(tmp_path / "shared")
+        servers = [
+            DatanodeFlightServer(i, shared, managed=True) for i in range(2)
+        ]
+        try:
+            ms = Metasrv(MemoryKv())
+            proxies = [
+                RemoteDatanode(s.node_id, s.address) for s in servers
+            ]
+            for p in proxies:
+                ms.register_datanode(p)
+            rid = 31
+            proxies[0].handle_instruction(
+                {"kind": "open_region", "region_id": rid, "role": "leader",
+                 "schema": schema().to_dict()}, 0.0)
+            ms.set_region_route(rid, 0)
+            proxies[0].write(rid, {"h": ["a"], "ts": [1000], "v": [1.0]},
+                             10.0)
+
+            out = ms.migrate_region(rid, 0, 1, now_ms=20.0)
+            assert out == {"region_id": rid, "to_node": 1}
+            assert ms.region_route(rid) == 1
+            # data survived the move; new leader serves it
+            host = proxies[1].read(rid)
+            assert host["v"].tolist() == [1.0]
+            # old node no longer hosts the region
+            assert rid not in servers[0].datanode.engine.regions
+            # new leader accepts writes (lease granted by upgrade)
+            proxies[1].write(rid, {"h": ["b"], "ts": [2000], "v": [2.0]},
+                             30.0)
+            assert sorted(proxies[1].read(rid)["v"].tolist()) == [1.0, 2.0]
+        finally:
+            for s in servers:
+                s.shutdown()
+
+
+class TestSubprocessDatanode:
+    def test_true_process_split(self, tmp_path):
+        """Spawn a datanode as a real OS process via the CLI; query it over
+        the socket from this process."""
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "greptimedb_tpu.cli", "datanode", "start",
+             "--node-id", "7", "--data-home", str(tmp_path / "dn7"),
+             "--platform", "cpu"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd="/root/repo",
+        )
+        try:
+            line = proc.stdout.readline()
+            info = json.loads(line)
+            assert info["node_id"] == 7
+            client = DatanodeClient(info["address"])
+            from tests.test_meta import schema
+
+            client.instruction({"kind": "open_region", "region_id": 71,
+                                "role": "leader",
+                                "schema": schema().to_dict()})
+            client.write(71, {"h": ["x"], "ts": [1000], "v": [42.0]})
+            out = client.query("SELECT max(v) FROM t", "t", [71])
+            assert out.column("max(v)").to_pylist() == [42.0]
+            client.action("shutdown")
+            client.close()
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+                proc.wait(timeout=10)
+
+
+class TestReviewRegressions:
+    def test_groupby_key_not_projected_goes_raw(self):
+        """GROUP BY keys missing from the SELECT list must not be partial-
+        split (merge would collapse groups into one row)."""
+        assert split_partial(
+            parse_sql("SELECT count(*) FROM t GROUP BY host")[0]) is None
+        assert split_partial(
+            parse_sql("SELECT host, count(*) FROM t GROUP BY host, dc")[0]
+        ) is None
+
+    def test_groupby_key_not_projected_correct_e2e(self, frontend):
+        frontend.sql(
+            "CREATE TABLE gk (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY (host)) "
+            "PARTITION ON COLUMNS (host) (host < 'm', host >= 'm')")
+        frontend.sql(
+            "INSERT INTO gk VALUES ('a',1000,1.0),('a',2000,1.0),"
+            "('z',1000,1.0)")
+        res = frontend.sql("SELECT count(*) FROM gk GROUP BY host")
+        assert sorted(r[0] for r in res.rows) == [1, 2]  # per-host, 2 rows
+
+    def test_reopened_region_view_not_stale(self, two_nodes):
+        """close+reopen of a region must invalidate cached combined views."""
+        s = two_nodes[0]
+        client = DatanodeClient(s.address)
+        from tests.test_meta import schema
+
+        for rid in (41, 42):
+            client.instruction({"kind": "open_region", "region_id": rid,
+                                "role": "leader",
+                                "schema": schema().to_dict()})
+        client.write(41, {"h": ["a"], "ts": [1000], "v": [1.0]})
+        client.write(42, {"h": ["b"], "ts": [1000], "v": [2.0]})
+        q = "SELECT sum(v) FROM t"
+        out = client.query(q, "t", [41, 42])
+        assert out.column("sum(v)").to_pylist() == [3.0]
+        # flush so a reopen can see the data, then close + reopen region 42
+        client.instruction({"kind": "flush_region", "region_id": 42})
+        client.instruction({"kind": "close_region", "region_id": 42})
+        client.instruction({"kind": "open_region", "region_id": 42,
+                            "role": "leader"})
+        client.write(42, {"h": ["b"], "ts": [2000], "v": [10.0]})
+        out2 = client.query(q, "t", [41, 42])
+        assert out2.column("sum(v)").to_pylist() == [13.0]  # not stale
+        client.close()
+
+    def test_insert_validation(self, frontend):
+        from greptimedb_tpu.errors import InvalidArguments
+
+        frontend.sql(
+            "CREATE TABLE iv (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY (host))")
+        with pytest.raises(InvalidArguments, match="unknown insert columns"):
+            frontend.sql("INSERT INTO iv (host, ts, nope) VALUES ('a',1,2)")
+
+    def test_raw_scan_pushes_time_range(self, frontend, two_nodes):
+        frontend.sql(
+            "CREATE TABLE tr (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY (host)) "
+            "PARTITION ON COLUMNS (host) (host < 'm', host >= 'm')")
+        frontend.sql(
+            "INSERT INTO tr VALUES ('a',1000,1.0),('a',50000,2.0),"
+            "('z',60000,3.0)")
+        res = frontend.sql(
+            "SELECT host, v FROM tr WHERE ts >= 40000 ORDER BY ts LIMIT 10")
+        assert res.rows == [["a", 2.0], ["z", 3.0]]
