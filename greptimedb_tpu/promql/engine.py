@@ -51,15 +51,19 @@ class EvalResult:
         return len(self.labels)
 
 
-def _matches(matcher: LabelMatcher, value: str) -> bool:
+def matcher_pred(matcher: LabelMatcher):
+    """Matcher → (term predicate, negate) — the single definition of
+    PromQL matcher semantics, evaluated per DISTINCT term by the inverted
+    index (=~ is fully anchored, as in Prometheus)."""
     if matcher.op == "=":
-        return value == matcher.value
+        return (lambda t, mv=matcher.value: t == mv), False
     if matcher.op == "!=":
-        return value != matcher.value
-    if matcher.op == "=~":
-        return re.fullmatch(matcher.value, value) is not None
-    if matcher.op == "!~":
-        return re.fullmatch(matcher.value, value) is None
+        return (lambda t, mv=matcher.value: t == mv), True
+    if matcher.op in ("=~", "!~"):
+        rx = re.compile(matcher.value)
+        return (lambda t, rx=rx: rx.fullmatch(t) is not None), (
+            matcher.op == "!~"
+        )
     raise PlanError(f"bad matcher {matcher.op}")
 
 
@@ -273,8 +277,6 @@ class SelectorData:
         self.schema = region.schema
         self.ts_name = region.schema.time_index.name
         self.tag_names = region.tag_names
-        # series registry: tsid -> tag code tuple
-        self.series_codes = sorted(region._series.items(), key=lambda kv: kv[1])
         self.encoders = region.encoders
 
     def field_column(self, matchers: list[LabelMatcher]) -> str:
@@ -294,27 +296,33 @@ class SelectorData:
         )
 
     def select_series(self, matchers: list[LabelMatcher]) -> tuple[np.ndarray, list[dict]]:
-        """Returns (tsids, labels dicts) matching the label matchers."""
+        """Returns (tsids, labels dicts) matching the label matchers.
+
+        Inverted-index evaluation (storage/inverted.py): each matcher runs
+        once per DISTINCT term of its label and selects via posting lists —
+        O(vocabulary) string work, not O(series).  The reference gets the
+        same effect from its FST+bitmap inverted index
+        (src/index/src/inverted_index/)."""
+        from greptimedb_tpu.storage.inverted import get_series_index
+
         tag_matchers = [m for m in matchers if m.name != "__field__"]
+        idx = get_series_index(self.region)
+        sel_tsids = idx.all_tsids
+        for m in tag_matchers:
+            if sel_tsids.size == 0:
+                break
+            pred, neg = matcher_pred(m)
+            matched = idx.select(m.name, pred, negate=neg)
+            sel_tsids = np.intersect1d(sel_tsids, matched, assume_unique=True)
         values = {name: self.encoders[name].values() for name in self.tag_names}
-        sel: list[int] = []
-        labels: list[dict] = []
-        for key, tsid in self.series_codes:
-            lab = {
-                name: values[name][code]
-                for name, code in zip(self.tag_names, key)
-                if 0 <= code < len(values[name])
-            }
-            ok = True
-            for m in tag_matchers:
-                v = lab.get(m.name, "")
-                if not _matches(m, str(v)):
-                    ok = False
-                    break
-            if ok:
-                sel.append(tsid)
-                labels.append(lab)
-        return np.asarray(sel, dtype=np.int32), labels
+        labels = []
+        for tsid in sel_tsids:
+            labels.append({
+                name: values[name][int(idx.codes[name][tsid])]
+                for name in self.tag_names
+                if 0 <= idx.codes[name][tsid] < len(values[name])
+            })
+        return sel_tsids.astype(np.int32), labels
 
 
 class PromEvaluator:

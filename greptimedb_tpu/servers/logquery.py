@@ -37,6 +37,27 @@ def _parse_time(v) -> int | None:
     return parse_timestamp_str(str(v))
 
 
+def _term_pred(cond: dict):
+    """cond → single-term predicate for index pruning; None when the cond
+    cannot prune (its semantics aren't term-local, e.g. exists:true)."""
+    if "contains" in cond:
+        needle = str(cond["contains"])
+        return lambda t: needle in t
+    if "prefix" in cond:
+        p = str(cond["prefix"])
+        return lambda t: t.startswith(p)
+    if "regex" in cond:
+        try:
+            rx = re.compile(str(cond["regex"]))
+        except re.error:
+            return None  # row-level _match raises the proper error
+        return lambda t: rx.search(t) is not None
+    if "eq" in cond:
+        v = str(cond["eq"])
+        return lambda t: t == v
+    return None
+
+
 def _match(cond: dict, values: np.ndarray) -> np.ndarray:
     strs = np.asarray([("" if v is None else str(v)) for v in values],
                       dtype=object)
@@ -86,7 +107,24 @@ def execute_log_query(db, query: dict) -> QueryResult:
     # without an explicit projection the response returns every column, so
     # only restrict the scan when the caller named its columns
     want = sorted(needed | {ts_name}) if query.get("columns") else None
-    host = view.scan_host((lo, hi), columns=want)
+    # tag-column filters become file-level pruning predicates evaluated
+    # against each SST's exact term dictionary (inverted-index sidecar);
+    # the row-level filter below still applies in full
+    tag_cols = {c.name for c in view.schema.tag_columns}
+    per_col: dict[str, list] = {}
+    for f in query.get("filters") or []:
+        col = f.get("column")
+        if col in tag_cols:
+            per_col.setdefault(col, []).extend(
+                p for p in (_term_pred(c) for c in f.get("filters") or [])
+                if p is not None
+            )
+    tag_preds = {
+        c: (lambda t, ps=tuple(ps): all(p(t) for p in ps))
+        for c, ps in per_col.items() if ps
+    }
+    host = view.scan_host((lo, hi), columns=want,
+                          tag_preds=tag_preds or None)
     n = len(host[ts_name])
     keep = np.ones(n, dtype=bool)
     for f in query.get("filters") or []:

@@ -72,9 +72,42 @@ class BloomFilter:
         return BloomFilter(num_bits, bits)
 
 
+# Term dictionaries above this cardinality are dropped from the sidecar
+# (the bloom still covers equality); bounds sidecar size on high-churn tags.
+VOCAB_LIMIT = 4096
+_MAGIC2 = b"GTIX2\n"
+
+
+class ColumnIndex:
+    """Per-column SST index: bloom (always) + exact term dictionary (when
+    the column's distinct count fits VOCAB_LIMIT).  The term dictionary is
+    the file-level analog of the reference's FST term dict
+    (src/index/src/inverted_index/): it makes equality pruning exact and
+    lets ARBITRARY predicates (regex matchers) prune whole files."""
+
+    def __init__(self, bloom: BloomFilter, vocab: list[str] | None = None):
+        self.bloom = bloom
+        self.vocab = vocab
+        self._vset = set(vocab) if vocab is not None else None
+
+    def may_contain(self, value) -> bool:
+        if self._vset is not None:
+            return str(value) in self._vset
+        return self.bloom.might_contain(value)
+
+    def any_term_matches(self, pred) -> bool:
+        """False only when the exact vocabulary proves no term satisfies
+        pred; True when unknown (no vocabulary stored)."""
+        if self.vocab is None:
+            return True
+        return any(pred(t) for t in self.vocab)
+
+
 def build_sst_index(columns: dict[str, np.ndarray], tag_names: list[str]) -> bytes:
-    """Serialize per-tag-column blooms for one SST (the puffin blob)."""
+    """Serialize per-tag-column blooms + term dicts for one SST (the
+    puffin blob, reference src/puffin/)."""
     blobs: dict[str, bytes] = {}
+    vocabs: dict[str, list[str]] = {}
     for name in tag_names:
         if name not in columns:
             continue
@@ -83,18 +116,36 @@ def build_sst_index(columns: dict[str, np.ndarray], tag_names: list[str]) -> byt
         for v in uniq:
             bf.add(v)
         blobs[name] = bf.to_bytes()
-    header = json.dumps(
-        {name: len(b) for name, b in blobs.items()}
-    ).encode("utf-8")
-    out = _MAGIC + struct.pack("<I", len(header)) + header
+        if len(uniq) <= VOCAB_LIMIT:
+            vocabs[name] = [str(v) for v in uniq]
+    header = json.dumps({
+        "blooms": {name: len(b) for name, b in blobs.items()},
+        "vocabs": vocabs,
+    }).encode("utf-8")
+    out = _MAGIC2 + struct.pack("<I", len(header)) + header
     for name in sorted(blobs):
         out += blobs[name]
     return out
 
 
-def load_sst_index(raw: bytes) -> dict[str, BloomFilter]:
+def load_sst_index(raw: bytes) -> dict[str, ColumnIndex]:
+    if raw.startswith(_MAGIC2):
+        (hlen,) = struct.unpack_from("<I", raw, len(_MAGIC2))
+        off = len(_MAGIC2) + 4
+        header = json.loads(raw[off:off + hlen])
+        off += hlen
+        out = {}
+        for name in sorted(header["blooms"]):
+            ln = header["blooms"][name]
+            out[name] = ColumnIndex(
+                BloomFilter.from_bytes(raw[off:off + ln]),
+                header["vocabs"].get(name),
+            )
+            off += ln
+        return out
     if not raw.startswith(_MAGIC):
         raise ValueError("bad index blob magic")
+    # v1 (bloom-only) sidecars written by earlier builds
     (hlen,) = struct.unpack_from("<I", raw, len(_MAGIC))
     off = len(_MAGIC) + 4
     header = json.loads(raw[off:off + hlen])
@@ -102,19 +153,32 @@ def load_sst_index(raw: bytes) -> dict[str, BloomFilter]:
     out = {}
     for name in sorted(header):
         ln = header[name]
-        out[name] = BloomFilter.from_bytes(raw[off:off + ln])
+        out[name] = ColumnIndex(BloomFilter.from_bytes(raw[off:off + ln]))
         off += ln
     return out
 
 
 def sst_may_match(
-    index: dict[str, BloomFilter], tag_filters: dict[str, set]
+    index: dict[str, ColumnIndex], tag_filters: dict[str, set]
 ) -> bool:
-    """False only when some filtered column's bloom excludes EVERY value."""
+    """False only when some filtered column's index excludes EVERY value
+    (exact when the term dictionary is present, probabilistic via bloom
+    otherwise)."""
     for col, values in tag_filters.items():
-        bf = index.get(col)
-        if bf is None or not values:
+        ci = index.get(col)
+        if ci is None or not values:
             continue
-        if not any(bf.might_contain(v) for v in values):
+        if not any(ci.may_contain(v) for v in values):
             return False
     return True
+
+
+def sst_pred_may_match(
+    index: dict[str, ColumnIndex], column: str, pred
+) -> bool:
+    """File-level pruning for arbitrary term predicates (regex matchers):
+    False only when the stored vocabulary proves no term matches."""
+    ci = index.get(column)
+    if ci is None:
+        return True
+    return ci.any_term_matches(pred)

@@ -441,6 +441,7 @@ class Region:
         ts_range: tuple[int | None, int | None] = (None, None),
         columns: list[str] | None = None,
         tag_filters: dict[str, set] | None = None,
+        tag_preds: dict[str, object] | None = None,
     ) -> dict[str, np.ndarray]:
         """Merged, deduped host columns for the requested time range.
 
@@ -448,8 +449,15 @@ class Region:
         skipping-index pruning on ``tag_filters`` equality/IN sets, then
         Parquet row-group pruning) and the live memtable. Dedup
         keep-max-seq across sources; tombstones applied then dropped.
+
+        ``tag_preds`` maps tag columns to term predicates (e.g. compiled
+        regex matchers) used for FILE-LEVEL pruning only, via the sidecar's
+        exact term dictionary (inverted-index analog) — the caller still
+        applies the predicate row-wise to the returned columns.
         """
-        from greptimedb_tpu.storage.index import sst_may_match
+        from greptimedb_tpu.storage.index import (
+            sst_may_match, sst_pred_may_match,
+        )
 
         want = None
         if columns is not None:
@@ -459,10 +467,16 @@ class Region:
         for m in self.sst_files:
             if not m.overlaps(*ts_range):
                 continue
-            if tag_filters:
+            if tag_filters or tag_preds:
                 idx = self._sst_index(m)
-                if idx is not None and not sst_may_match(idx, tag_filters):
-                    continue
+                if idx is not None:
+                    if tag_filters and not sst_may_match(idx, tag_filters):
+                        continue
+                    if tag_preds and not all(
+                        sst_pred_may_match(idx, col, pred)
+                        for col, pred in tag_preds.items()
+                    ):
+                        continue
             parts.append(read_sst(self.store, m, self.schema, ts_range, want,
                                   tag_filters))
         internal = (TSID, SEQ, OP)

@@ -311,11 +311,18 @@ class TestSkippingIndex:
         }
         blob = build_sst_index(cols, ["host", "region"])
         idx = load_sst_index(blob)
-        assert idx["host"].might_contain("a")
+        assert idx["host"].may_contain("a")
         assert sst_may_match(idx, {"host": {"a"}})
         assert sst_may_match(idx, {"host": {"zzz", "a"}})
         assert not sst_may_match(idx, {"host": {"zzz"}})
-        assert sst_may_match(idx, {"unknown_col": {"x"}})  # no bloom -> pass
+        assert sst_may_match(idx, {"unknown_col": {"x"}})  # no index -> pass
+        # v2 term dictionary: exact matching + predicate (regex) pruning
+        from greptimedb_tpu.storage.index import sst_pred_may_match
+
+        assert idx["host"].vocab == ["a", "b"]
+        assert sst_pred_may_match(idx, "host", lambda t: t.startswith("a"))
+        assert not sst_pred_may_match(idx, "host", lambda t: t.startswith("z"))
+        assert sst_pred_may_match(idx, "nope", lambda t: False)  # unknown col
 
     def test_region_scan_prunes_by_bloom(self, tmp_data_dir):
         eng = RegionEngine(tmp_data_dir)
@@ -361,7 +368,7 @@ class TestSkippingIndex:
         meta = r.sst_files[0]
         assert r.store.exists(r._index_path(meta))
         idx = r._sst_index(meta)
-        assert idx["hostname"].might_contain("h0")
+        assert idx["hostname"].may_contain("h0")
 
     def test_tag_filter_row_level_pruning(self, tmp_data_dir):
         eng = RegionEngine(tmp_data_dir)
@@ -475,3 +482,134 @@ class TestAdvisorRegressions:
         assert os.path.getsize(seg) == size_before  # untouched
         eng2.close()
         eng.close()
+
+
+class TestSeriesInvertedIndex:
+    def _region(self, tmp_data_dir, n_hosts=50):
+        eng = RegionEngine(tmp_data_dir)
+        r = eng.create_region(1, cpu_schema())
+        n = n_hosts * 2
+        r.write({
+            "hostname": [f"web-{i:03d}" if i % 2 else f"db-{i:03d}"
+                         for i in range(n_hosts)] * 2,
+            "region": (["us-east"] * n_hosts + ["eu-west"] * n_hosts),
+            "ts": list(range(0, n * 1000, 1000)),
+            "usage_user": [1.0] * n,
+            "usage_system": [0.0] * n,
+        })
+        return eng, r
+
+    def test_equality_and_regex_select(self, tmp_data_dir):
+        from greptimedb_tpu.storage.inverted import get_series_index
+        import re
+
+        eng, r = self._region(tmp_data_dir)
+        idx = get_series_index(r)
+        web = idx.select("hostname", lambda t: t.startswith("web-"))
+        db = idx.select("hostname", lambda t: t.startswith("db-"))
+        assert web.size + db.size == idx.num_series
+        rx = re.compile(r"web-0[0-3]\d")
+        some = idx.select("hostname", lambda t: rx.fullmatch(t) is not None)
+        expect = {v for v in r.encoders["hostname"].values()
+                  if rx.fullmatch(v)}
+        assert some.size == sum(
+            1 for key, _t in r._series.items()
+            if r.encoders["hostname"].values()[key[0]] in expect
+        )
+        # negation = complement
+        not_web = idx.select("hostname", lambda t: t.startswith("web-"),
+                             negate=True)
+        assert sorted(np.concatenate([web, not_web]).tolist()) == sorted(
+            idx.all_tsids.tolist()
+        )
+        eng.close()
+
+    def test_absent_label_semantics(self, tmp_data_dir):
+        from greptimedb_tpu.storage.inverted import get_series_index
+
+        eng, r = self._region(tmp_data_dir)
+        idx = get_series_index(r)
+        # matcher on a label no series has: eq "" matches all, eq "x" none
+        assert idx.select("nope", lambda t: t == "").size == idx.num_series
+        assert idx.select("nope", lambda t: t == "x").size == 0
+        eng.close()
+
+    def test_generation_cache(self, tmp_data_dir):
+        from greptimedb_tpu.storage.inverted import get_series_index
+
+        eng, r = self._region(tmp_data_dir)
+        i1 = get_series_index(r)
+        assert get_series_index(r) is i1  # cached
+        r.write({"hostname": ["brand-new"], "region": ["ap"],
+                 "ts": [999999], "usage_user": [1.0], "usage_system": [0.0]})
+        i2 = get_series_index(r)
+        assert i2 is not i1  # generation bumped -> rebuilt
+        assert i2.select("hostname", lambda t: t == "brand-new").size == 1
+        eng.close()
+
+
+class TestInvertedPruning:
+    def test_logquery_tag_pred_prunes_ssts(self, tmp_data_dir):
+        """Tag-column log filters prune SST files via the term dictionary:
+        a scan with a non-matching prefix filter reads no SST."""
+        from greptimedb_tpu.servers.logquery import execute_log_query
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(tmp_data_dir)
+        db.sql("CREATE TABLE logs (app STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "line STRING, PRIMARY KEY (app))")
+        r = db._region_of("logs")
+        # two SSTs with disjoint app sets
+        r.write({"app": ["frontend"] * 3, "ts": [1000, 2000, 3000],
+                 "line": ["a", "b", "c"]})
+        r.flush()
+        r.write({"app": ["backend"] * 3, "ts": [4000, 5000, 6000],
+                 "line": ["d", "e", "f"]})
+        r.flush()
+
+        reads = []
+        orig = r._sst_index
+
+        import greptimedb_tpu.storage.sst as sstmod
+        real_read = sstmod.read_sst
+
+        def counting_read(store, meta, *a, **k):
+            reads.append(meta.file_id)
+            return real_read(store, meta, *a, **k)
+
+        sstmod.read_sst = counting_read
+        import greptimedb_tpu.storage.region as regmod
+        regmod.read_sst = counting_read
+        try:
+            out = execute_log_query(db, {
+                "table": {"table": "logs"},
+                "filters": [{"column": "app",
+                             "filters": [{"prefix": "front"}]}],
+            })
+            assert len(out.rows) == 3
+            assert len(reads) == 1  # backend SST pruned by term dict
+        finally:
+            regmod.read_sst = real_read
+            sstmod.read_sst = real_read
+        db.close()
+
+    def test_promql_nonstring_tag_matchers(self, tmp_data_dir):
+        """Regression: regex/eq matchers on a non-string tag column must
+        coerce terms to str (old loop did str(v); index must too)."""
+        from greptimedb_tpu.promql.engine import PromEvaluator
+        from greptimedb_tpu.promql.parser import parse_promql
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(tmp_data_dir)
+        db.sql("CREATE TABLE m (shard BIGINT, ts TIMESTAMP(3) TIME INDEX, "
+               "greptime_value DOUBLE, PRIMARY KEY (shard))")
+        db._region_of("m").write({
+            "shard": [1, 2, 12], "ts": [1000] * 3,
+            "greptime_value": [1.0, 2.0, 3.0],
+        })
+        ev = PromEvaluator(db, 1.0, 1.0, 1.0)
+        res = ev.eval(parse_promql('m{shard=~"1.*"}'))
+        assert res.num_series == 2  # shards 1 and 12
+        res2 = ev.eval(parse_promql('m{shard="2"}'))
+        assert res2.num_series == 1
+        db.close()
