@@ -75,6 +75,60 @@ class DeviceTable:
                    {k: list(v) for k, v in dict_items}, sorted_tags)
 
 
+def _canonical_column(
+    schema: Schema, encoders: dict, name: str, arr: np.ndarray,
+    dicts: dict[str, list],
+) -> np.ndarray:
+    """One column of host scan output → device encoding (unpadded).
+
+    The single definition of canonicalization, shared by the full build
+    and the incremental extend path so the two can never diverge: tags →
+    region dictionary codes (int32); string FIELDs → ad-hoc dictionary
+    codes seeded from ``dicts`` (NULL becomes ""); numerics → device
+    dtype; internal columns pass through.  ``dicts`` is updated in place.
+    """
+    if name == TSID:
+        return arr.astype(np.int32)
+    if schema.has_column(name):
+        c = schema.column(name)
+        if c.is_tag:
+            enc = encoders[name]
+            uniq, inv = np.unique(arr.astype(object), return_inverse=True)
+            codes = np.fromiter(
+                (enc.get(v) for v in uniq), dtype=np.int32, count=len(uniq)
+            )
+            dicts[name] = enc.values()
+            return codes[inv]
+        if c.dtype.is_string_like:
+            # string FIELD (log lines, json): ad-hoc dictionary — codes
+            # live on device, values in dicts for decode
+            from greptimedb_tpu.datatypes.batch import DictionaryEncoder
+
+            enc = DictionaryEncoder(dicts.get(name, []))
+            # NULL string fields become "" (np.unique cannot order None)
+            arr = np.array(["" if v is None else v for v in arr],
+                           dtype=object)
+            uniq, inv = np.unique(arr, return_inverse=True)
+            codes = np.fromiter(
+                (enc.get_or_insert(v) for v in uniq), dtype=np.int32,
+                count=len(uniq),
+            )
+            dicts[name] = enc.values()
+            return codes[inv]
+        return arr.astype(c.dtype.to_device_dtype())
+    return arr  # internal numeric column (e.g. __op__)
+
+
+def _pad_value(schema: Schema, name: str, dtype: np.dtype):
+    """Padding-row fill for a canonicalized column: poison code -1 for
+    tag/string-dict columns, NaN for floats, 0 otherwise."""
+    if name != TSID and schema.has_column(name):
+        c = schema.column(name)
+        if c.is_tag or c.dtype.is_string_like:
+            return -1
+    return np.nan if np.issubdtype(dtype, np.floating) else 0
+
+
 def build_device_table(
     region: Region,
     ts_range: tuple[int | None, int | None] = (None, None),
@@ -91,54 +145,11 @@ def build_device_table(
     for name, arr in host.items():
         if name == SEQ:
             continue  # sequences are a storage concern; queries never see them
-        if name == TSID:
-            out = np.zeros(padded, dtype=np.int32)
-            out[:n] = arr.astype(np.int32)
-            dev_cols[TSID] = jnp.asarray(out)
-            continue
-        if schema.has_column(name):
-            c = schema.column(name)
-            if c.is_tag:
-                enc = region.encoders[name]
-                uniq, inv = np.unique(arr.astype(object), return_inverse=True)
-                codes = np.fromiter(
-                    (enc.get(v) for v in uniq), dtype=np.int32, count=len(uniq)
-                )
-                out = np.full(padded, -1, dtype=np.int32)
-                out[:n] = codes[inv]
-                dev_cols[name] = jnp.asarray(out)
-                dicts[name] = enc.values()
-                continue
-            if c.dtype.is_string_like:
-                # string FIELD (log lines, json): ad-hoc dictionary per
-                # build — codes live on device, values in dicts for decode
-                from greptimedb_tpu.datatypes.batch import DictionaryEncoder
-
-                enc = DictionaryEncoder()
-                # NULL string fields become "" (np.unique cannot order None)
-                arr = np.array(
-                    ["" if v is None else v for v in arr], dtype=object
-                )
-                uniq, inv = np.unique(arr, return_inverse=True)
-                codes = np.fromiter(
-                    (enc.get_or_insert(v) for v in uniq), dtype=np.int32,
-                    count=len(uniq),
-                )
-                out = np.full(padded, -1, dtype=np.int32)
-                out[:n] = codes[inv]
-                dev_cols[name] = jnp.asarray(out)
-                dicts[name] = enc.values()
-                continue
-            dev_dtype = c.dtype.to_device_dtype()
-            pad_val = np.nan if np.issubdtype(dev_dtype, np.floating) else 0
-            out = np.full(padded, pad_val, dtype=dev_dtype)
-            out[:n] = arr.astype(dev_dtype)
-            dev_cols[name] = jnp.asarray(out)
-        else:
-            # internal numeric column (e.g. __op__)
-            out = np.zeros(padded, dtype=arr.dtype)
-            out[:n] = arr
-            dev_cols[name] = jnp.asarray(out)
+        vals = _canonical_column(schema, region.encoders, name, arr, dicts)
+        out = np.full(padded, _pad_value(schema, name, vals.dtype),
+                      dtype=vals.dtype)
+        out[:n] = vals
+        dev_cols[name] = jnp.asarray(out)
     mask = np.zeros(padded, dtype=bool)
     mask[:n] = True
     # monotone tag detection: rows are (tsid, ts)-sorted; a tag qualifies
@@ -158,17 +169,121 @@ def build_device_table(
                        tuple(sorted_tags))
 
 
+def _canonical_delta(
+    region, chunks: list[dict], dicts: dict[str, list]
+) -> tuple[dict[str, np.ndarray], int]:
+    """Canonicalize append-log chunks (same rules as build_device_table —
+    shared _canonical_column — unpadded).  ``dicts`` holds the resident
+    table's dictionaries and is extended in place so codes stay
+    consistent across deltas."""
+    schema = region.schema
+    host = {
+        k: np.concatenate([np.asarray(c[k]) for c in chunks])
+        for k in chunks[0]
+    }
+    dn = len(host[TSID])
+    out: dict[str, np.ndarray] = {}
+    for name, arr in host.items():
+        if name == SEQ:
+            continue
+        out[name] = _canonical_column(schema, region.encoders, name, arr,
+                                      dicts)
+    return out, dn
+
+
+def extend_device_table(
+    table: DeviceTable, region, chunks: list[dict], live_rows: int
+) -> tuple[DeviceTable, int]:
+    """Append new rows to a resident DeviceTable WITHOUT re-uploading the
+    base: only the delta crosses host→device; growth beyond the padding
+    bucket concatenates on device; the (tsid, ts) sort order every
+    consumer relies on is restored by a device-side lexsort + gather
+    (HBM-local, no PCIe traffic).
+
+    Correctness precondition (enforced by Region's append log): delta rows
+    are PUT-only with timestamps strictly after all resident rows, so no
+    dedup/tombstone interaction with the base is possible.
+    """
+    dicts = dict(table.dicts)
+    delta, dn = _canonical_delta(region, chunks, dicts)
+    n_old = live_rows
+    n_new = n_old + dn
+    old_padded = table.padded_rows
+    new_padded = pad_rows(n_new)
+    ts_name = region.schema.time_index.name
+
+    cols: dict[str, jnp.ndarray] = {}
+    for name, col in table.columns.items():
+        dv = delta.get(name)
+        if dv is None:  # column absent from delta (shouldn't happen)
+            dv = np.zeros(dn, dtype=np.asarray(col[:1]).dtype)
+        if new_padded > old_padded:
+            pad_np = np.full(
+                new_padded - old_padded,
+                _pad_value(region.schema, name, dv.dtype),
+                dtype=dv.dtype,
+            )
+            col = jnp.concatenate([col, jnp.asarray(pad_np)])
+        cols[name] = col.at[n_old:n_new].set(jnp.asarray(dv))
+    mask = table.row_mask
+    if new_padded > old_padded:
+        mask = jnp.concatenate(
+            [mask, jnp.zeros(new_padded - old_padded, dtype=bool)]
+        )
+    mask = mask.at[n_old:n_new].set(True)
+
+    # restore global (tsid, ts) order; padding rows pin to the end via the
+    # inverted mask as the primary key
+    order = jnp.lexsort(
+        (cols[ts_name], cols[TSID], (~mask).astype(jnp.int32))
+    )
+    cols = {k: v[order] for k, v in cols.items()}
+    mask = mask[order]
+
+    # sorted-tag monotonicity survives the re-sort only if no new series
+    # appeared (tag-per-tsid mapping unchanged); otherwise drop until the
+    # next full rebuild re-derives it
+    sorted_tags = (
+        table.sorted_tags if region.num_series == table.num_series else ()
+    )
+    return (
+        DeviceTable(cols, mask, region.num_series, dicts, sorted_tags),
+        n_new,
+    )
+
+
+@dataclass
+class _Entry:
+    table: DeviceTable
+    delta_pos: int | None = None  # consumed append-log position
+    live_rows: int = 0
+
+
 class RegionCacheManager:
-    """LRU of DeviceTables keyed by (region_id, generation, range, cols)."""
+    """LRU of DeviceTables.
+
+    Regions with the incremental protocol (base_version + append log) key
+    by base_version; pure time-forward appends EXTEND the resident tensors
+    device-side instead of rebuilding (reference analog: the write-through
+    cache keeps mito's page cache warm across flushes,
+    src/mito2/src/cache/write_cache.rs).  Duck-typed views and restricted
+    scans keep generation-keyed full rebuilds.
+    """
 
     def __init__(self, capacity_bytes: int = 8 << 30):
+        # delta volume beyond max(min_extend_rows, fraction * resident
+        # rows) → full rebuild (restores sorted-tag eligibility and
+        # compacts fragmentation); small deltas always extend
+        self.rebuild_fraction = 0.25
+        self.min_extend_rows = 4096
         self.capacity = capacity_bytes
-        self._lru: "collections.OrderedDict[tuple, DeviceTable]" = (
+        self._lru: "collections.OrderedDict[tuple, _Entry]" = (
             collections.OrderedDict()
         )
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        self.extends = 0
 
     def get(
         self,
@@ -176,33 +291,76 @@ class RegionCacheManager:
         ts_range: tuple[int | None, int | None] = (None, None),
         columns: list[str] | None = None,
     ) -> DeviceTable:
+        base_ver = getattr(region, "base_version", None)
+        append_log = getattr(region, "_append_log", None)
+        incremental = (
+            base_ver is not None
+            and append_log is not None
+            and ts_range == (None, None)
+            and columns is None
+        )
+        version = base_ver if incremental else region.generation
         key = (
             region.region_id,
-            region.generation,
+            version,
             ts_range,
             tuple(columns) if columns else None,
         )
-        hit = self._lru.get(key)
-        if hit is not None:
-            self.hits += 1
-            self._lru.move_to_end(key)
-            return hit
+        entry = self._lru.get(key)
+        if entry is not None:
+            if not incremental or entry.delta_pos == len(append_log):
+                self.hits += 1
+                self._lru.move_to_end(key)
+                return entry.table
+            # resident base is current; new append-log chunks extend it
+            chunks = append_log[entry.delta_pos:]
+            delta_rows = sum(len(c[TSID]) for c in chunks)
+            if delta_rows <= max(
+                self.min_extend_rows,
+                entry.live_rows * self.rebuild_fraction,
+            ):
+                self.extends += 1
+                self._bytes -= entry.table.nbytes()
+                entry.table, entry.live_rows = extend_device_table(
+                    entry.table, region, chunks, entry.live_rows
+                )
+                entry.delta_pos = len(append_log)
+                self._bytes += entry.table.nbytes()
+                self._lru.move_to_end(key)
+                self._shrink()
+                return entry.table
+            self._evict(key)  # too much drift: rebuild below
+
         self.misses += 1
         table = build_device_table(region, ts_range, columns)
-        # drop stale generations of the same region+range
-        stale = [k for k in self._lru if k[0] == key[0] and k[1] != key[1]]
+        entry = _Entry(
+            table,
+            delta_pos=len(append_log) if incremental else None,
+            live_rows=int(np.asarray(table.row_mask).sum()),
+        )
+        # drop stale versions of the same region+range; versions live in
+        # two namespaces (base_version for incremental full-table entries,
+        # generation for restricted scans), so only compare within the
+        # same (range, columns) class
+        stale = [
+            k for k in self._lru
+            if k[0] == key[0] and k[2:] == key[2:] and k[1] != key[1]
+        ]
         for k in stale:
             self._evict(k)
-        self._lru[key] = table
+        self._lru[key] = entry
         self._bytes += table.nbytes()
-        while self._bytes > self.capacity and len(self._lru) > 1:
-            self._evict(next(iter(self._lru)))
+        self._shrink()
         return table
 
+    def _shrink(self) -> None:
+        while self._bytes > self.capacity and len(self._lru) > 1:
+            self._evict(next(iter(self._lru)))
+
     def _evict(self, key) -> None:
-        t = self._lru.pop(key, None)
-        if t is not None:
-            self._bytes -= t.nbytes()
+        e = self._lru.pop(key, None)
+        if e is not None:
+            self._bytes -= e.table.nbytes()
 
     def invalidate_region(self, region_id: int) -> None:
         for k in [k for k in self._lru if k[0] == region_id]:

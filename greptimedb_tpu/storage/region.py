@@ -36,6 +36,11 @@ from greptimedb_tpu.storage.wal import (
 import pyarrow as pa
 
 
+# append-log cap: beyond this many unconsumed delta chunks the cache does
+# a full rebuild anyway, so stop buffering and force a structure change
+MAX_APPEND_CHUNKS = 256
+
+
 @dataclass
 class RegionOptions:
     flush_threshold_bytes: int = 256 * 1024 * 1024
@@ -70,6 +75,13 @@ class Region:
             self.wal = NoopLogStore()
         self.memtable = Memtable(schema)
         self.next_seq = manifest.state.flushed_seq + 1
+        # incremental device-cache protocol: base_version changes only on
+        # STRUCTURE changes (flush/compaction/truncate/catch-up/upsert...);
+        # pure time-forward appends go to _append_log so the cache layer
+        # can extend resident tensors instead of rebuilding (cache.py)
+        self.base_version = 0
+        self._append_log: list[dict] = []
+        self._max_ts_seen: int | None = None  # lazy; -2**63 = empty
         # tag encoders hydrated from the manifest
         self.encoders: dict[str, DictionaryEncoder] = {
             c.name: DictionaryEncoder(manifest.state.dicts.get(c.name, []))
@@ -166,11 +178,45 @@ class Region:
         # memtable stores ts as int64 under the schema's ts column name
         mt_chunk = dict(chunk)
         mt_chunk[self.ts_name] = chunk[self.ts_name].astype(np.int64)
+
+        # incremental-cache classification: a batch whose timestamps all lie
+        # strictly AFTER everything seen is a pure append (no upsert/delete
+        # can touch resident rows) — log it for device-side extension
+        if self._max_ts_seen is None:
+            b = self.ts_bounds()
+            self._max_ts_seen = b[1] if b is not None else -(1 << 63)
+        ts_i64 = mt_chunk[self.ts_name]
+        appendable = (
+            op == OP_PUT and n > 0 and int(ts_i64.min()) > self._max_ts_seen
+            and len(self._append_log) < MAX_APPEND_CHUNKS
+        )
+        if appendable and n > 1:
+            # within-batch duplicate (series, ts) keys dedup keep-last in
+            # the memtable but would append verbatim on the device — not
+            # extendable
+            pairs = np.stack([chunk[TSID], ts_i64], axis=1)
+            if len(np.unique(pairs, axis=0)) != n:
+                appendable = False
+        if n > 0:
+            self._max_ts_seen = max(self._max_ts_seen, int(ts_i64.max()))
+
         self.memtable.append(mt_chunk)
         self.generation += 1
+        if appendable:
+            self._append_log.append(mt_chunk)
+        elif n > 0:
+            self._mark_structure_change()
+        # n == 0: nothing changed; keep resident tables valid
         if self.memtable.bytes >= self.options.flush_threshold_bytes:
             self.flush()
         return seq
+
+    def _mark_structure_change(self) -> None:
+        """Resident device tables for this region can no longer be extended
+        in place — bump the base version so the cache rebuilds."""
+        self.base_version += 1
+        self._append_log.clear()
+        self._max_ts_seen = None
 
     def delete(self, data: dict[str, list | np.ndarray]) -> int:
         """Delete by full key (tags + ts): writes tombstones."""
@@ -212,6 +258,7 @@ class Region:
                                                key=self._series.get)],
         })
         self.generation += 1
+        self._mark_structure_change()
 
     # ---- flush / replay ------------------------------------------------
     def flush(self) -> SstMeta | None:
@@ -235,6 +282,7 @@ class Region:
         self.memtable = Memtable(self.schema)
         self.wal.truncate(flushed_seq + 1)
         self.generation += 1
+        self._mark_structure_change()
         self._maybe_compact()
         return meta
 
@@ -272,6 +320,7 @@ class Region:
             count += 1
         if count:
             self.generation += 1
+            self._mark_structure_change()
         return count
 
     # ---- compaction (TWCS-lite) ---------------------------------------
@@ -328,6 +377,7 @@ class Region:
             self.store.delete(self._index_path(m))
             self._index_cache.pop(m.file_id, None)
         self.generation += 1
+        self._mark_structure_change()
         return new_meta
 
     def compact(self) -> None:
@@ -347,6 +397,7 @@ class Region:
         self.manifest.commit({"kind": "truncate", "truncated_seq": self.next_seq - 1})
         self.memtable = Memtable(self.schema)
         self.generation += 1
+        self._mark_structure_change()
 
     def catch_up(self, take_ownership: bool = False) -> None:
         """Re-sync this region from shared storage (follower sync, leader
@@ -378,6 +429,7 @@ class Region:
         self.next_seq = max(self.next_seq, state.flushed_seq + 1)
         self.replay_wal(repair=take_ownership)
         self.generation += 1
+        self._mark_structure_change()
         self._index_cache.clear()
 
     def storage_fingerprint(self) -> tuple:

@@ -280,9 +280,16 @@ class TestDeviceCache:
         t1 = mgr.get(r)
         t2 = mgr.get(r)
         assert t1 is t2 and mgr.hits == 1
+        # a time-forward append EXTENDS the resident table (no rebuild)
         write_rows(r, 1, t0=999_000)
         t3 = mgr.get(r)
-        assert t3 is not t1 and mgr.misses == 2
+        assert t3 is not t1 and mgr.extends == 1 and mgr.misses == 1
+        assert int(np.asarray(t3.row_mask).sum()) == 11
+        # an upsert of an existing key is a structure change -> rebuild
+        write_rows(r, 1, t0=0)
+        t4 = mgr.get(r)
+        assert mgr.misses == 2
+        assert int(np.asarray(t4.row_mask).sum()) == 11  # deduped
         eng.close()
 
 
@@ -613,3 +620,167 @@ class TestInvertedPruning:
         res2 = ev.eval(parse_promql('m{shard="2"}'))
         assert res2.num_series == 1
         db.close()
+
+
+class TestIncrementalDeviceCache:
+    def test_extend_correctness_and_order(self, tmp_data_dir):
+        """Appends extend the resident table device-side; (tsid, ts) order
+        and query results stay correct."""
+        eng = RegionEngine(tmp_data_dir)
+        r = eng.create_region(1, cpu_schema())
+        write_rows(r, 10)
+        mgr = RegionCacheManager()
+        t1 = mgr.get(r)
+        base_padded = t1.padded_rows
+        for i in range(5):
+            write_rows(r, 3, t0=1_000_000 * (i + 1))
+        t2 = mgr.get(r)
+        assert mgr.extends == 1 and mgr.misses == 1
+        # order restored: (tsid, ts) nondecreasing over live rows
+        mask = np.asarray(t2.row_mask)
+        tsid = np.asarray(t2.columns[TSID])[mask]
+        ts = np.asarray(t2.columns["ts"])[mask]
+        key = tsid.astype(np.int64) * (1 << 44) + ts
+        assert (np.diff(key) >= 0).all()
+        assert mask.sum() == 25
+        # matches a fresh full build row-for-row
+        fresh = build_device_table(r)
+        fm = np.asarray(fresh.row_mask)
+        for col in ("ts", "usage_user", TSID):
+            np.testing.assert_array_equal(
+                np.asarray(t2.columns[col])[mask],
+                np.asarray(fresh.columns[col])[fm],
+            )
+        assert base_padded <= t2.padded_rows
+        eng.close()
+
+    def test_extend_grows_bucket(self, tmp_data_dir):
+        eng = RegionEngine(tmp_data_dir)
+        r = eng.create_region(1, cpu_schema())
+        write_rows(r, 120)
+        mgr = RegionCacheManager()
+        t1 = mgr.get(r)
+        assert t1.padded_rows == 128
+        write_rows(r, 20, t0=10_000_000)  # within REBUILD_FRACTION of 120
+        t2 = mgr.get(r)
+        assert mgr.extends == 1
+        assert t2.padded_rows == 256  # grew to the next bucket
+        assert int(np.asarray(t2.row_mask).sum()) == 140
+        eng.close()
+
+    def test_large_delta_triggers_rebuild(self, tmp_data_dir):
+        eng = RegionEngine(tmp_data_dir)
+        r = eng.create_region(1, cpu_schema())
+        write_rows(r, 10)
+        mgr = RegionCacheManager()
+        mgr.min_extend_rows = 0  # expose the fraction path at tiny scale
+        mgr.get(r)
+        write_rows(r, 50, t0=10_000_000)  # 5x the resident rows
+        mgr.get(r)
+        assert mgr.extends == 0 and mgr.misses == 2
+        eng.close()
+
+    def test_delete_and_flush_force_rebuild(self, tmp_data_dir):
+        eng = RegionEngine(tmp_data_dir)
+        r = eng.create_region(1, cpu_schema())
+        write_rows(r, 6)
+        mgr = RegionCacheManager()
+        mgr.get(r)
+        r.delete({"hostname": ["h1"], "region": ["us-west"], "ts": [1000]})
+        t = mgr.get(r)
+        assert mgr.misses == 2  # tombstone -> rebuild
+        assert int(np.asarray(t.row_mask).sum()) == 5
+        write_rows(r, 2, t0=5_000_000)
+        r.flush()
+        mgr.get(r)
+        assert mgr.misses == 3  # flush is a structure change
+        eng.close()
+
+    def test_sql_query_over_extended_table(self, tmp_data_dir):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(tmp_data_dir)
+        db.sql("CREATE TABLE inc (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (host))")
+        db.sql("INSERT INTO inc VALUES ('a', 1000, 1.0), ('b', 2000, 2.0)")
+        assert db.sql("SELECT sum(v) FROM inc").rows == [[3.0]]
+        db.sql("INSERT INTO inc VALUES ('a', 3000, 10.0), ('c', 4000, 4.0)")
+        assert db.sql("SELECT sum(v), count(*) FROM inc").rows == [[17.0, 4]]
+        assert db.cache.extends >= 1
+        r = db.sql("SELECT host, sum(v) FROM inc GROUP BY host ORDER BY host")
+        assert r.rows == [["a", 11.0], ["b", 2.0], ["c", 4.0]]
+        db.close()
+
+    def test_promql_over_extended_table(self, tmp_data_dir):
+        """The PromQL searchsorted windowing depends on (tsid, ts) order —
+        must stay correct after device-side extension."""
+        from greptimedb_tpu.promql.engine import PromEvaluator
+        from greptimedb_tpu.promql.parser import parse_promql
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(tmp_data_dir)
+        db.sql("CREATE TABLE pm (pod STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "greptime_value DOUBLE, PRIMARY KEY (pod))")
+        r = db._region_of("pm")
+        r.write({"pod": ["x", "y"] * 4,
+                 "ts": [i * 15000 for i in range(4) for _ in (0, 1)],
+                 "greptime_value": [float(i) for i in range(8)]})
+        ev = PromEvaluator(db, 45.0, 45.0, 1.0)
+        res = ev.eval(parse_promql("pm"))
+        assert res.num_series == 2
+        db.cache.get(r)  # ensure resident
+        r.write({"pod": ["x", "y"], "ts": [60000, 60000],
+                 "greptime_value": [100.0, 200.0]})
+        ev2 = PromEvaluator(db, 60.0, 60.0, 1.0)
+        res2 = ev2.eval(parse_promql("pm"))
+        got = {tuple(sorted(l.items()))[0][1]: float(v)
+               for l, v in zip(res2.labels, np.asarray(res2.values)[:, 0])}
+        assert got == {"x": 100.0, "y": 200.0}
+        assert db.cache.extends >= 1
+        db.close()
+
+    def test_within_batch_duplicates_not_appendable(self, tmp_data_dir):
+        """A batch with duplicate (series, ts) rows dedups keep-last in
+        storage — the cache must rebuild, not append both rows."""
+        eng = RegionEngine(tmp_data_dir)
+        r = eng.create_region(1, cpu_schema())
+        write_rows(r, 4)
+        mgr = RegionCacheManager()
+        mgr.get(r)
+        r.write({"hostname": ["h0", "h0"], "region": ["us-east"] * 2,
+                 "ts": [900_000, 900_000],
+                 "usage_user": [5.0, 7.0], "usage_system": [0.0, 0.0]})
+        t = mgr.get(r)
+        assert mgr.extends == 0 and mgr.misses == 2
+        mask = np.asarray(t.row_mask)
+        assert int(mask.sum()) == 5  # deduped keep-last
+        uu = np.asarray(t.columns["usage_user"])[mask]
+        assert 7.0 in uu and 5.0 not in uu
+
+    def test_mixed_full_and_restricted_scans_coexist(self, tmp_data_dir):
+        """Range-restricted entries must not evict the incremental
+        full-table entry (two version namespaces)."""
+        eng = RegionEngine(tmp_data_dir)
+        r = eng.create_region(1, cpu_schema())
+        write_rows(r, 10)
+        mgr = RegionCacheManager()
+        mgr.get(r)
+        mgr.get(r, ts_range=(0, 5000))
+        t = mgr.get(r)  # must still be a hit
+        assert mgr.hits == 1 and mgr.misses == 2
+        write_rows(r, 2, t0=999_000)
+        mgr.get(r)
+        assert mgr.extends == 1  # extend survived the restricted get
+        eng.close()
+
+    def test_empty_write_keeps_cache(self, tmp_data_dir):
+        eng = RegionEngine(tmp_data_dir)
+        r = eng.create_region(1, cpu_schema())
+        write_rows(r, 4)
+        mgr = RegionCacheManager()
+        mgr.get(r)
+        r.write({"hostname": [], "region": [], "ts": [],
+                 "usage_user": [], "usage_system": []})
+        mgr.get(r)
+        assert mgr.hits == 1 and mgr.misses == 1  # no invalidation
+        eng.close()
