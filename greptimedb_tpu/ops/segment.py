@@ -195,6 +195,40 @@ def segment_first_last(
     return out_ts, out_val
 
 
+def segment_distinct_count(
+    values: jnp.ndarray,
+    seg_ids: jnp.ndarray,
+    num_segments: int,
+    mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Per-segment count of DISTINCT valid values (COUNT(DISTINCT x)).
+
+    Sort-based, TPU-friendly (no hash tables): lexsort rows by
+    (segment, value), mark first occurrences at (segment, value) run
+    boundaries, segment-sum the marks.  Works for any comparable dtype —
+    dictionary codes for tags/strings, raw ints/floats for numerics;
+    invalid rows (mask False, NaN, poisoned ids) are excluded.
+    Reference semantics: DataFusion COUNT(DISTINCT) via
+    src/query/src/datafusion.rs.
+    """
+    m = valid_mask(values, mask if mask is not None else jnp.ones(values.shape, bool))
+    m = m & (seg_ids >= 0) & (seg_ids < num_segments)
+    ids = jnp.where(m, seg_ids, num_segments).astype(jnp.int32)
+    order = jnp.lexsort((values, ids))
+    g = ids[order]
+    v = values[order]
+    first = jnp.concatenate([
+        jnp.ones(1, dtype=bool),
+        (g[1:] != g[:-1]) | (v[1:] != v[:-1]),
+    ])
+    return jax.ops.segment_sum(
+        (first & (g < num_segments)).astype(jnp.int64),
+        g,
+        num_segments=num_segments + 1,
+        indices_are_sorted=True,
+    )[:num_segments]
+
+
 def segmented_sum_scan(
     values: jnp.ndarray,
     ids: jnp.ndarray,

@@ -168,10 +168,22 @@ class OrderByItem:
 
 
 @dataclass
+class JoinClause:
+    """INNER / LEFT [OUTER] equi-join (reference: DataFusion joins via
+    src/query/src/datafusion.rs; promql_tsid_narrow_join optimizer)."""
+
+    table: str
+    alias: str | None
+    on: "Expr"
+    kind: str = "inner"  # "inner" | "left"
+
+
+@dataclass
 class Select(Statement):
     items: list[SelectItem]
     table: str | None = None  # None for SELECT 1 / SELECT now()
     table_alias: str | None = None
+    joins: list[JoinClause] = field(default_factory=list)
     where: Expr | None = None
     group_by: list[Expr] = field(default_factory=list)
     having: Expr | None = None
@@ -184,6 +196,94 @@ class Select(Statement):
     align_by: list[Expr] = field(default_factory=list)
     range_: IntervalLit | None = None
     fill: str | None = None
+
+
+def _map_child(v, fn):
+    if isinstance(v, Expr):
+        return map_expr(v, fn)
+    if isinstance(v, tuple):
+        nv = tuple(_map_child(x, fn) for x in v)
+        return nv if any(a is not b for a, b in zip(nv, v)) else v
+    if isinstance(v, list):
+        nv = [_map_child(x, fn) for x in v]
+        return nv if any(a is not b for a, b in zip(nv, v)) else v
+    return v
+
+
+def map_expr(e, fn):
+    """Bottom-up structural transform over an Expr tree.
+
+    Descends every dataclass field, including nested tuples/lists (e.g.
+    ``Case.whens`` is a tuple of (cond, result) tuples), then applies
+    ``fn`` to the (child-transformed) node.  Nodes are rebuilt only when a
+    child changed.  The ONE tree walker — subquery resolution, join column
+    rewriting and any future pass share it, so shape handling can never
+    diverge.
+    """
+    import dataclasses as _dc
+
+    if not (_dc.is_dataclass(e) and isinstance(e, Expr)):
+        return e
+    changes = {}
+    for f in _dc.fields(e):
+        v = getattr(e, f.name)
+        nv = _map_child(v, fn)
+        if nv is not v:
+            changes[f.name] = nv
+    e2 = _dc.replace(e, **changes) if changes else e
+    return fn(e2)
+
+
+def expr_contains(e, types) -> bool:
+    """True when any node in the tree is an instance of ``types``."""
+    found = False
+
+    def probe(x):
+        nonlocal found
+        if isinstance(x, types):
+            found = True
+        return x
+
+    map_expr(e, probe)
+    return found
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    """(SELECT single_value ...) used as an expression; resolved to a
+    Literal before planning (the reference evaluates these via DataFusion
+    subquery decorrelation — ours requires them to be uncorrelated)."""
+
+    select: object  # Select (untyped: ast must not import itself)
+
+    def __str__(self):
+        return "(<subquery>)"
+
+
+@dataclass(frozen=True)
+class InSubquery(Expr):
+    """expr [NOT] IN (SELECT one_column ...); resolved to InList before
+    planning."""
+
+    expr: Expr
+    select: object
+    negated: bool = False
+
+    def __str__(self):
+        n = " NOT" if self.negated else ""
+        return f"{self.expr}{n} IN (<subquery>)"
+
+
+@dataclass
+class Union(Statement):
+    """UNION [ALL] chain; trailing ORDER BY/LIMIT apply to the union
+    (reference: DataFusion set operations via src/query/src/datafusion.rs)."""
+
+    selects: list[Select]
+    all: bool = False
+    order_by: list[OrderByItem] = field(default_factory=list)
+    limit: int | None = None
+    offset: int | None = None
 
 
 @dataclass

@@ -14,7 +14,10 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from greptimedb_tpu.errors import PlanError, TableNotFound, Unsupported
-from greptimedb_tpu.query.ast import Select, SelectItem, Star
+from greptimedb_tpu.query.ast import (
+    Expr, InList, InSubquery, Literal, ScalarSubquery, Select, SelectItem,
+    Star,
+)
 from greptimedb_tpu.query.exprs import TableContext, eval_host
 from greptimedb_tpu.query.physical import Executor
 from greptimedb_tpu.query.planner import SelectPlan, plan_select
@@ -62,17 +65,107 @@ def _null_key(v, asc: bool, nulls_first: bool | None):
     return null_rank, v if not is_null else 0
 
 
+class SingleTableProvider(TableProvider):
+    """Provider over one Region (or region-duck view): any table name maps
+    to it.  Used for ephemeral staged tables (joins) and scoped execution
+    (datanode shipped sub-queries)."""
+
+    def __init__(self, view, timezone: str = "UTC"):
+        self.view = view
+        self.timezone = timezone
+        self._built: tuple | None = None
+
+    def table_context(self, table: str) -> TableContext:
+        return TableContext(self.view.schema, self.view.encoders,
+                            self.timezone)
+
+    def device_table(self, table: str, plan):
+        from greptimedb_tpu.storage.cache import build_device_table
+
+        gen = self.view.generation
+        if self._built is None or self._built[0] != gen:
+            self._built = (gen, build_device_table(self.view))
+        return self._built[1], self.view.ts_bounds() or (0, 0)
+
+
 class QueryEngine:
     def __init__(self, provider: TableProvider):
         self.provider = provider
         self.executor = Executor()
+        # full-statement dispatch for nested queries (set by GreptimeDB to
+        # its execute_statement so information_schema subqueries work);
+        # defaults to this engine
+        self.dispatch = None
+
+    # ---- subquery resolution ------------------------------------------
+    def _run_nested(self, sub: Select) -> QueryResult:
+        run = self.dispatch if self.dispatch is not None else self.execute_select
+        return run(sub)
+
+    def _rewrite_subqueries(self, e):
+        """Uncorrelated subqueries → literals (scalar) / IN lists, bottom-up
+        via the shared map_expr walker (the reference relies on DataFusion's
+        subquery support, src/query/src/datafusion.rs:141; correlated
+        subqueries are not supported here)."""
+        from greptimedb_tpu.query.ast import map_expr
+
+        def resolve(node):
+            if isinstance(node, ScalarSubquery):
+                res = self._run_nested(node.select)
+                if len(res.column_names) != 1 or len(res.rows) > 1:
+                    raise PlanError(
+                        "scalar subquery must return one column and ≤1 row"
+                    )
+                return Literal(res.rows[0][0] if res.rows else None)
+            if isinstance(node, InSubquery):
+                res = self._run_nested(node.select)
+                if len(res.column_names) != 1:
+                    raise PlanError(
+                        "IN subquery must return exactly one column"
+                    )
+                if not res.rows:
+                    # IN () = FALSE, NOT IN () = TRUE
+                    return Literal(bool(node.negated))
+                items = tuple(Literal(r[0]) for r in res.rows)
+                return InList(node.expr, items, node.negated)
+            return node
+
+        return map_expr(e, resolve)
+
+    def _resolve_subqueries(self, sel: Select) -> Select:
+        import dataclasses
+
+        from greptimedb_tpu.query.ast import expr_contains
+
+        touched = [sel.where, sel.having] + [it.expr for it in sel.items]
+        if not any(
+            e is not None and expr_contains(e, (ScalarSubquery, InSubquery))
+            for e in touched
+        ):
+            return sel
+        return dataclasses.replace(
+            sel,
+            where=(self._rewrite_subqueries(sel.where)
+                   if sel.where is not None else None),
+            having=(self._rewrite_subqueries(sel.having)
+                    if sel.having is not None else None),
+            items=[
+                dataclasses.replace(it, expr=self._rewrite_subqueries(it.expr))
+                for it in sel.items
+            ],
+        )
 
     # ------------------------------------------------------------------
     def execute_select(self, sel: Select, metrics: dict | None = None) -> QueryResult:
         import time as _time
 
+        sel = self._resolve_subqueries(sel)
         if sel.table is None:
             return self._execute_tableless(sel)
+        if sel.joins:
+            from greptimedb_tpu.query.join import execute_join
+
+            return execute_join(self, sel)
 
         def mark(name, t0):
             if metrics is not None:
@@ -134,6 +227,51 @@ class QueryEngine:
         return "\n".join(f"{'  ' * i}{l}" for i, l in enumerate(lines))
 
     # ------------------------------------------------------------------
+    def execute_union(self, union, run_select) -> QueryResult:
+        """UNION [ALL]: run each member via ``run_select`` (the caller's
+        full dispatch, so information_schema members work), concatenate,
+        dedup unless ALL, then apply the union-level ORDER BY/LIMIT."""
+        results = [run_select(s) for s in union.selects]
+        ncols = len(results[0].column_names)
+        for r in results[1:]:
+            if len(r.column_names) != ncols:
+                raise PlanError(
+                    f"UNION members have {ncols} vs "
+                    f"{len(r.column_names)} columns"
+                )
+        rows = [row for r in results for row in r.rows]
+        if not union.all:
+            seen: set = set()
+            deduped = []
+            for row in rows:
+                key = tuple(row)
+                if key not in seen:
+                    seen.add(key)
+                    deduped.append(row)
+            rows = deduped
+        res = QueryResult(results[0].column_names, rows,
+                          column_types=results[0].column_types)
+        if union.order_by:
+            idx = {n: i for i, n in enumerate(res.column_names)}
+
+            def sort_key(row):
+                key = []
+                for ob in union.order_by:
+                    name = str(ob.expr)
+                    if name not in idx:
+                        raise PlanError(
+                            f"ORDER BY {name}: not a UNION output column"
+                        )
+                    key.append(SortVal(row[idx[name]], ob.asc))
+                return key
+
+            res.rows.sort(key=sort_key)
+        if union.offset:
+            res.rows[:] = res.rows[union.offset:]
+        if union.limit is not None:
+            res.rows[:] = res.rows[: union.limit]
+        return res
+
     def _execute_tableless(self, sel: Select) -> QueryResult:
         env: dict[str, np.ndarray] = {}
         names: list[str] = []
@@ -354,6 +492,34 @@ def _infer_type(expr, plan: SelectPlan) -> str:
             return "Boolean"
         return "Float64"
     return "Float64"
+
+
+class SortVal:
+    """Total-orderable sort-key wrapper for host-side row ordering:
+    None/NaN sort last, per-key direction."""
+
+    __slots__ = ("v", "asc")
+
+    def __init__(self, v, asc: bool):
+        self.v = v
+        self.asc = asc
+
+    def _rank(self):
+        missing = self.v is None or (
+            isinstance(self.v, float) and self.v != self.v
+        )
+        return (1 if missing else 0, 0 if missing else self.v)
+
+    def __lt__(self, other):
+        a, b = self._rank(), other._rank()
+        if a[0] != b[0]:
+            return a[0] < b[0]
+        if a[1] == b[1]:
+            return False
+        return (a[1] < b[1]) if self.asc else (a[1] > b[1])
+
+    def __eq__(self, other):
+        return self._rank() == other._rank()
 
 
 class _Reversed:

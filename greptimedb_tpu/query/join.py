@@ -1,0 +1,272 @@
+"""Equi-join execution: vectorized sort-merge on dictionary codes.
+
+The reference gets general joins from DataFusion
+(src/query/src/datafusion.rs:141) and narrows PromQL label-matching
+joins with a dedicated optimizer rule (optimizer/promql_tsid_narrow_join.rs).
+The TPU build splits a join query into three phases:
+
+1. match — factorize the equi-key columns of both sides into one shared
+   dictionary (np.unique), then a fully vectorized sort-merge produces
+   (left_row, right_row) index pairs; LEFT joins emit unmatched left rows
+   with a -1 right index.  Host-side numpy: key matching is control-heavy
+   and row counts here are the POST-scan sizes.
+2. stage — gather the joined columns into an ephemeral in-memory region
+   whose schema exposes every column of both sides (bare names when
+   unambiguous, "alias.column" otherwise, left time index preserved).
+3. finish — rewrite the original SELECT's qualified references to the
+   staged names and run it through the normal engine, so GROUP BY /
+   aggregates execute on device exactly like any single-table query.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+from greptimedb_tpu.datatypes.types import ConcreteDataType, SemanticType
+from greptimedb_tpu.errors import PlanError, Unsupported
+from greptimedb_tpu.query.ast import BinaryOp, Column, Expr, Select
+from greptimedb_tpu.storage.memtable import OP, SEQ, TSID
+
+
+def _equi_pairs(on: Expr) -> list[tuple[Column, Column]]:
+    """Flatten the ON condition into equality pairs of qualified columns."""
+    pairs: list[tuple[Column, Column]] = []
+
+    def visit(e: Expr) -> None:
+        if isinstance(e, BinaryOp) and e.op == "AND":
+            visit(e.left)
+            visit(e.right)
+            return
+        if (
+            isinstance(e, BinaryOp) and e.op == "="
+            and isinstance(e.left, Column) and isinstance(e.right, Column)
+        ):
+            pairs.append((e.left, e.right))
+            return
+        raise Unsupported(f"JOIN ON supports AND-ed column equalities, got {e}")
+
+    visit(on)
+    if not pairs:
+        raise PlanError("JOIN needs at least one equality condition")
+    return pairs
+
+
+def _factorize(left_vals: np.ndarray, right_vals: np.ndarray):
+    """Shared codes for both sides (strings compare as strings, numerics
+    as numerics; None → a dedicated sentinel that never matches)."""
+    l_ = np.asarray(
+        ["\0__null__" if v is None else v for v in left_vals], dtype=object
+    )
+    r_ = np.asarray(
+        ["\0__null__#r" if v is None else v for v in right_vals], dtype=object
+    )
+    both = np.concatenate([l_, r_])
+    _uniq, codes = np.unique(both, return_inverse=True)
+    return codes[: len(l_)], codes[len(l_):]
+
+
+def merge_join(
+    lkeys: list[np.ndarray], rkeys: list[np.ndarray], left: bool
+) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized sort-merge: returns (left_idx, right_idx) row pairs;
+    LEFT-join misses get right_idx == -1."""
+    nl = len(lkeys[0])
+    lc = np.zeros(nl, dtype=np.int64)
+    rc = np.zeros(len(rkeys[0]), dtype=np.int64)
+    for lv, rv in zip(lkeys, rkeys):
+        lcode, rcode = _factorize(lv, rv)
+        card = int(max(lcode.max(initial=0), rcode.max(initial=0))) + 1
+        lc = lc * card + lcode
+        rc = rc * card + rcode
+    rs = np.argsort(rc, kind="stable")
+    rsorted = rc[rs]
+    starts = np.searchsorted(rsorted, lc, side="left")
+    ends = np.searchsorted(rsorted, lc, side="right")
+    counts = ends - starts
+    total = int(counts.sum())
+    left_idx = np.repeat(np.arange(nl), counts)
+    # position within each left row's match run
+    run_starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    intra = np.arange(total) - np.repeat(run_starts, counts)
+    right_idx = rs[np.repeat(starts, counts) + intra]
+    if left:
+        miss = np.nonzero(counts == 0)[0]
+        left_idx = np.concatenate([left_idx, miss])
+        right_idx = np.concatenate(
+            [right_idx, np.full(len(miss), -1, dtype=np.int64)]
+        )
+    return left_idx, right_idx
+
+
+def _names_for(side_cols: list[str], other_cols: set[str],
+               qualifier: str) -> dict[str, str]:
+    """bare name when unambiguous, 'qualifier.name' when both sides have it."""
+    return {
+        c: (c if c not in other_cols else f"{qualifier}.{c}")
+        for c in side_cols
+    }
+
+
+def execute_join(engine, sel: Select):
+    """Entry point from QueryEngine.execute_select for Selects with joins."""
+    if len(sel.joins) != 1:
+        raise Unsupported("only single two-table joins are supported")
+    join = sel.joins[0]
+    provider = engine.provider
+    host_scan = getattr(provider, "host_columns", None)
+    if host_scan is None:
+        raise Unsupported("provider cannot scan host columns for joins")
+
+    lt, la = sel.table, sel.table_alias or sel.table
+    rt, ra = join.table, join.alias or join.table
+    if la == ra:
+        raise PlanError(f"duplicate table alias {la!r} in join")
+    # push the WHERE's time bounds into the LEFT scan: conjuncts on the
+    # left time index re-apply after the join, so pre-restricting is sound
+    # for both INNER and LEFT joins (excluded rows would be dropped anyway)
+    from greptimedb_tpu.query.planner import extract_time_range
+
+    try:
+        l_ts_range = extract_time_range(sel.where,
+                                        provider.table_context(lt))
+    except Exception:  # noqa: BLE001 — qualified refs etc.: scan all
+        l_ts_range = (None, None)
+    lcols_all = host_scan(lt, ts_range=l_ts_range)
+    rcols_all = host_scan(rt)
+    lcols = {k: v for k, v in lcols_all.items() if k not in (TSID, SEQ, OP)}
+    rcols = {k: v for k, v in rcols_all.items() if k not in (TSID, SEQ, OP)}
+
+    def side_of(col: Column) -> str:
+        if col.table == la:
+            return "l"
+        if col.table == ra:
+            return "r"
+        if col.table is not None:
+            raise PlanError(f"unknown table qualifier {col.table!r}")
+        in_l, in_r = col.name in lcols, col.name in rcols
+        if in_l and in_r:
+            raise PlanError(f"ambiguous join column {col.name!r}")
+        if in_l:
+            return "l"
+        if in_r:
+            return "r"
+        raise PlanError(f"unknown join column {col.name!r}")
+
+    lkeys, rkeys = [], []
+    for c1, c2 in _equi_pairs(join.on):
+        s1, s2 = side_of(c1), side_of(c2)
+        if {s1, s2} != {"l", "r"}:
+            raise PlanError(f"JOIN condition {c1} = {c2} must cross tables")
+        lcol, rcol = (c1, c2) if s1 == "l" else (c2, c1)
+        lkeys.append(lcols[lcol.name])
+        rkeys.append(rcols[rcol.name])
+
+    li, ri = merge_join(lkeys, rkeys, left=join.kind == "left")
+
+    # ---- stage the joined columns into an ephemeral in-memory region ----
+    lschema = provider.table_context(lt).schema
+    rschema = provider.table_context(rt).schema
+    lnames = _names_for(list(lcols), set(rcols), la)
+    rnames = _names_for(list(rcols), set(lcols), ra)
+
+    data: dict[str, np.ndarray] = {}
+    cols_schema: list[ColumnSchema] = []
+    ts_left = lschema.time_index.name
+    for name, arr in lcols.items():
+        out_name = lnames[name]
+        data[out_name] = arr[li]
+        c = lschema.column(name)
+        semantic = c.semantic if name != ts_left else SemanticType.TIMESTAMP
+        cols_schema.append(dataclasses.replace(c, name=out_name,
+                                               semantic=semantic))
+    miss = ri < 0
+    safe_ri = np.where(miss, 0, ri)
+    for name, arr in rcols.items():
+        out_name = rnames[name]
+        c = rschema.column(name)
+        vals = arr[safe_ri]
+        if miss.any():
+            if c.is_tag or c.dtype.is_string_like:
+                # "" is the engine's NULL-string representation (device
+                # dictionaries cannot hold None)
+                vals = vals.astype(object)
+                vals[miss] = ""
+            elif c.dtype.is_float:
+                vals = vals.astype(np.float64)
+                vals[miss] = np.nan
+            else:  # ints/timestamps: no NULL repr — 0 like empty default
+                vals = vals.copy()
+                vals[miss] = 0
+        semantic = (
+            SemanticType.FIELD
+            if c.semantic is SemanticType.TIMESTAMP
+            else c.semantic
+        )
+        dtype = (
+            ConcreteDataType.INT64
+            if c.dtype.is_timestamp
+            else c.dtype
+        )
+        cols_schema.append(dataclasses.replace(
+            c, name=out_name, semantic=semantic, dtype=dtype, nullable=True,
+        ))
+        data[out_name] = vals
+
+    # rewrite qualified references in the SELECT to the staged names
+    # (shared map_expr walker descends every shape, incl. Case.whens)
+    from greptimedb_tpu.query.ast import map_expr
+
+    item_aliases = {it.alias for it in sel.items if it.alias}
+
+    def _map_col(node):
+        if not isinstance(node, Column):
+            return node
+        if node.table is None and node.name in item_aliases:
+            return node  # references a projection alias (ORDER BY wcpu)
+        side = side_of(node)
+        return Column((lnames if side == "l" else rnames)[node.name])
+
+    def rewrite(e):
+        return map_expr(e, _map_col)
+
+    staged_name = "__joined__"
+    staged = dataclasses.replace(
+        sel,
+        table=staged_name,
+        table_alias=None,
+        joins=[],
+        items=[
+            dataclasses.replace(it, expr=rewrite(it.expr),
+                                alias=it.alias or str(it.expr))
+            for it in sel.items
+        ],
+        where=rewrite(sel.where) if sel.where is not None else None,
+        group_by=[rewrite(g) for g in sel.group_by],
+        having=rewrite(sel.having) if sel.having is not None else None,
+        order_by=[
+            dataclasses.replace(ob, expr=rewrite(ob.expr))
+            for ob in sel.order_by
+        ],
+    )
+
+    # ephemeral staging region: in-memory store, no WAL, no catalog — the
+    # joined rows only need dictionary encoding + a DeviceTable build
+    from greptimedb_tpu.query.engine import QueryEngine, SingleTableProvider
+    from greptimedb_tpu.storage.manifest import Manifest
+    from greptimedb_tpu.storage.object_store import MemoryObjectStore
+    from greptimedb_tpu.storage.region import Region, RegionOptions
+
+    schema = Schema(tuple(cols_schema))
+    store = MemoryObjectStore()
+    manifest = Manifest.open(store, "region_1/manifest")
+    manifest.commit({"kind": "schema", "schema": schema.to_dict()})
+    region = Region(1, store, schema, manifest, None,
+                    RegionOptions(wal_enabled=False))
+    if len(li):
+        region.write(data)
+    inner = QueryEngine(SingleTableProvider(region))
+    inner.dispatch = engine.dispatch  # nested subqueries still resolve
+    return inner.execute_select(staged)

@@ -13,9 +13,11 @@ from greptimedb_tpu.errors import SyntaxError_, Unsupported
 from greptimedb_tpu.query.ast import (
     AlterTable, Between, BinaryOp, Case, Cast, Column, ColumnDef, CreateDatabase,
     CreateFlow, CreateTable, Delete, DescribeTable, DropDatabase, DropFlow,
-    DropTable, Explain, Expr, FuncCall, InList, Insert, IntervalLit, IsNull,
+    DropTable, Explain, Expr, FuncCall, InList, InSubquery, Insert,
+    IntervalLit, IsNull, JoinClause, ScalarSubquery,
     Literal, OrderByItem, Select, SelectItem, ShowCreateTable, ShowDatabases,
-    ShowFlows, ShowTables, Star, Statement, Tql, TruncateTable, UnaryOp, Use,
+    ShowFlows, ShowTables, Star, Statement, Tql, TruncateTable, UnaryOp, Union,
+    Use,
 )
 from greptimedb_tpu.query.lexer import Tok, Token, tokenize
 
@@ -182,7 +184,7 @@ class Parser:
             raise SyntaxError_(f"expected statement at {t.pos}, got {t.text!r}")
         kw = t.upper
         if kw == "SELECT":
-            return self.select()
+            return self.select_or_union()
         if kw == "TQL":
             return self.tql()
         if kw == "CREATE":
@@ -219,6 +221,32 @@ class Parser:
         raise SyntaxError_(f"unrecognized statement keyword: {t.text!r} at {t.pos}")
 
     # ---- SELECT ---------------------------------------------------------
+    def select_or_union(self) -> Statement:
+        """SELECT ... [UNION [ALL] SELECT ...]*; a trailing ORDER BY/LIMIT
+        (parsed into the last member) applies to the whole union."""
+        first = self.select()
+        if not self.at_kw("UNION"):
+            return first
+        members = [first]
+        all_flags: list[bool] = []
+        while self.eat_kw("UNION"):
+            all_flags.append(bool(self.eat_kw("ALL")))
+            members.append(self.select())
+        if len(set(all_flags)) > 1:
+            raise SyntaxError_("mixed UNION and UNION ALL is not supported")
+        for m in members[:-1]:
+            if m.order_by or m.limit is not None or m.offset is not None:
+                raise SyntaxError_(
+                    "ORDER BY/LIMIT inside a UNION member needs parentheses"
+                )
+        last = members[-1]
+        union = Union(
+            selects=members, all=all_flags[0],
+            order_by=last.order_by, limit=last.limit, offset=last.offset,
+        )
+        last.order_by, last.limit, last.offset = [], None, None
+        return union
+
     def select(self) -> Select:
         self.expect_kw("SELECT")
         distinct = self.eat_kw("DISTINCT")
@@ -226,6 +254,7 @@ class Parser:
         while self.eat(Tok.PUNCT, ","):
             items.append(self.select_item())
         table = alias = None
+        joins: list[JoinClause] = []
         if self.eat_kw("FROM"):
             table = self.qualified_name()
             if self.peek().kind is Tok.IDENT and not self.at_kw(
@@ -235,6 +264,23 @@ class Parser:
                 alias = self.ident()
             elif self.eat_kw("AS"):
                 alias = self.ident()
+            while self.at_kw("JOIN", "INNER", "LEFT"):
+                kind = "inner"
+                if self.eat_kw("LEFT"):
+                    self.eat_kw("OUTER")
+                    kind = "left"
+                else:
+                    self.eat_kw("INNER")
+                self.expect_kw("JOIN")
+                jt = self.qualified_name()
+                ja = None
+                if self.eat_kw("AS"):
+                    ja = self.ident()
+                elif self.peek().kind is Tok.IDENT and not self.at_kw("ON"):
+                    ja = self.ident()
+                self.expect_kw("ON")
+                on = self.expr()
+                joins.append(JoinClause(jt, ja, on, kind))
         where = self.expr() if self.eat_kw("WHERE") else None
         group_by: list[Expr] = []
         if self.eat_kw("GROUP"):
@@ -270,7 +316,8 @@ class Parser:
         if self.eat_kw("OFFSET"):
             offset = int(self.expect(Tok.NUMBER).text)
         return Select(
-            items=items, table=table, table_alias=alias, where=where,
+            items=items, table=table, table_alias=alias, joins=joins,
+            where=where,
             group_by=group_by, having=having, order_by=order_by, limit=limit,
             offset=offset, distinct=distinct, align=align, align_by=align_by,
             fill=fill, range_=range_,
@@ -379,6 +426,10 @@ class Parser:
         if self.at_kw("IN"):
             self.next()
             self.expect(Tok.PUNCT, "(")
+            if self.at_kw("SELECT"):
+                sub = self.select()
+                self.expect(Tok.PUNCT, ")")
+                return InSubquery(left, sub, negated)
             items = [self.expr()]
             while self.eat(Tok.PUNCT, ","):
                 items.append(self.expr())
@@ -426,6 +477,10 @@ class Parser:
             self.next()
             return Literal(t.text)
         if self.eat(Tok.PUNCT, "("):
+            if self.at_kw("SELECT"):
+                sub = self.select()
+                self.expect(Tok.PUNCT, ")")
+                return ScalarSubquery(sub)
             e = self.expr()
             self.expect(Tok.PUNCT, ")")
             return e

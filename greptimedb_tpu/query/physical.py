@@ -23,8 +23,8 @@ import numpy as np
 from greptimedb_tpu.errors import ExecutionError, PlanError, Unsupported
 from greptimedb_tpu.ops.masks import compact_rows, valid_mask
 from greptimedb_tpu.ops.segment import (
-    combine_keys, compact_groups, segment_first_last, segment_reduce,
-    segmented_sum_scan, sorted_segment_reduce,
+    combine_keys, compact_groups, segment_distinct_count, segment_first_last,
+    segment_reduce, segmented_sum_scan, sorted_segment_reduce,
 )
 from greptimedb_tpu.ops.time import bucket_index
 from greptimedb_tpu.query.ast import Column, Expr, FuncCall, Star
@@ -40,6 +40,16 @@ DENSE_LIMIT = 1 << 22
 DISPATCH_STATS = {"sorted": 0, "scatter": 0}
 
 _I64_MAX = np.int64(np.iinfo(np.int64).max)
+
+
+def decode_codes(values: list, raw: np.ndarray, null=None) -> np.ndarray:
+    """Dictionary codes → values (object array); out-of-range/poisoned
+    codes become ``null``.  The one decode path for tag and string-field
+    group keys."""
+    lookup = np.array(list(values) + [null], dtype=object)
+    codes = raw.astype(np.int64)
+    codes = np.where((codes < 0) | (codes >= len(values)), len(values), codes)
+    return lookup[codes]
 
 
 def _pow2(n: int) -> int:
@@ -158,6 +168,7 @@ class Executor:
             col = None
             if (
                 op is not None
+                and not agg.distinct
                 and len(agg.args) == 1
                 and isinstance(agg.args[0], Column)
             ):
@@ -202,13 +213,22 @@ class Executor:
         for i, k in enumerate(plan.group_keys):
             raw = out[f"__key{i}__"][gmask]
             if k.kind == "tag":
-                vals = ctx.encoders[k.column].values()
-                lookup = np.array(vals + [None], dtype=object)
-                codes = raw.astype(np.int64)
-                codes = np.where((codes < 0) | (codes >= len(vals)), len(vals), codes)
-                col = lookup[codes]
+                col = decode_codes(ctx.encoders[k.column].values(), raw)
             else:
                 col = raw
+                # string-FIELD group keys come back as the DeviceTable's
+                # ad-hoc dictionary codes — decode, never leak codes
+                if isinstance(k.expr, Column):
+                    try:
+                        cs = ctx.schema.column(ctx.resolve(k.expr.name))
+                    except Exception:  # noqa: BLE001
+                        cs = None
+                    if (
+                        cs is not None and not cs.is_tag
+                        and cs.dtype.is_string_like
+                        and cs.name in table.dicts
+                    ):
+                        col = decode_codes(table.dicts[cs.name], raw)
             env[k.name] = col
             env[str(k.expr)] = col
         for name, _ in agg_specs:
@@ -221,7 +241,23 @@ class Executor:
                      seg_fn=segment_reduce):
         name = agg.name
         if agg.distinct or name == "count_distinct":
-            raise Unsupported("DISTINCT aggregates not yet implemented")
+            if name not in ("count", "count_distinct"):
+                raise Unsupported(f"DISTINCT is only supported for count()"
+                                  f", got {name}")
+            if not agg.args or isinstance(agg.args[0], Star):
+                raise PlanError("count(DISTINCT) needs a column argument")
+            if len(agg.args) > 1:
+                raise Unsupported(
+                    "count(DISTINCT a, b): multi-column distinct"
+                )
+            arg = agg.args[0]
+            # string/tag columns are dictionary codes on device — distinct
+            # over codes IS distinct over values (dictionaries are
+            # bijective), so no special-casing needed
+            arg_fn = compile_device(arg, ctx)
+            return lambda env, gid, ng, mask: segment_distinct_count(
+                arg_fn(env), gid, ng, mask
+            )
         if name == "count" and (not agg.args or isinstance(agg.args[0], Star)):
             def fn(env, gid, ng, mask):
                 ones = jnp.ones(mask.shape, dtype=jnp.int32)

@@ -20,7 +20,7 @@ from greptimedb_tpu.errors import GreptimeError, Unsupported
 from greptimedb_tpu.meta.catalog import CatalogManager
 from greptimedb_tpu.meta.kv import KvBackend, MemoryKv
 from greptimedb_tpu.query.ast import CreateTable, Insert, Select
-from greptimedb_tpu.query.engine import QueryResult
+from greptimedb_tpu.query.engine import QueryResult, SortVal
 from greptimedb_tpu.query.exprs import TableContext
 from greptimedb_tpu.query.parser import parse_sql
 from greptimedb_tpu.rpc.client import RemoteDatanode
@@ -230,37 +230,10 @@ class DistFrontend:
                             f"distributed ORDER BY {name}: not an output "
                             "column"
                         )
-                    key.append(_SortVal(row[idx[name]], ob.asc))
+                    key.append(SortVal(row[idx[name]], ob.asc))
                 return key
 
             res.rows.sort(key=sort_key)
         if sel.limit is not None:
             res.rows[:] = res.rows[: sel.limit]
         return res
-
-
-class _SortVal:
-    """Total-orderable wrapper: None/NaN last, direction-aware."""
-
-    __slots__ = ("v", "asc")
-
-    def __init__(self, v, asc: bool):
-        self.v = v
-        self.asc = asc
-
-    def _rank(self):
-        missing = self.v is None or (
-            isinstance(self.v, float) and self.v != self.v
-        )
-        return (1 if missing else 0, 0 if missing else self.v)
-
-    def __lt__(self, other):
-        a, b = self._rank(), other._rank()
-        if a[0] != b[0]:
-            return a[0] < b[0]
-        if a[1] == b[1]:
-            return False
-        return (a[1] < b[1]) if self.asc else (a[1] > b[1])
-
-    def __eq__(self, other):
-        return self._rank() == other._rank()

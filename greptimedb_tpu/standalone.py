@@ -217,6 +217,9 @@ class GreptimeDB(TableProvider):
         )
         self.cache = RegionCacheManager(cache_capacity_bytes)
         self.engine = QueryEngine(self)
+        # nested (sub)queries route through the full statement dispatch so
+        # information_schema / pg_catalog subqueries resolve
+        self.engine.dispatch = self.execute_statement
         self.current_db = DEFAULT_DB
         self._views: dict[str, CombinedRegionView] = {}
         # the storage engine is single-writer (region sequence assignment and
@@ -333,6 +336,10 @@ class GreptimeDB(TableProvider):
         dt = self.cache.get(view)
         return dt, view.ts_bounds() or (0, 0)
 
+    def host_columns(self, table: str, ts_range=(None, None)) -> dict:
+        """Raw host scan for operators that run host-side (join matching)."""
+        return self._table_view(table).scan_host(ts_range)
+
     # ---- SQL entry -----------------------------------------------------
     def sql(self, query: str) -> QueryResult:
         """Execute one or more statements; returns the LAST result."""
@@ -420,6 +427,10 @@ class GreptimeDB(TableProvider):
                 self.timezone = prev_tz
 
     def execute_statement(self, stmt: Statement) -> QueryResult:
+        from greptimedb_tpu.query.ast import Union as UnionStmt
+
+        if isinstance(stmt, UnionStmt):
+            return self.engine.execute_union(stmt, self.execute_statement)
         if isinstance(stmt, Select):
             from greptimedb_tpu.meta import information_schema as info
 

@@ -8,7 +8,8 @@ import numpy as np
 import pytest
 
 from greptimedb_tpu.errors import (
-    GreptimeError, InvalidArguments, SyntaxError_, TableNotFound, Unsupported,
+    GreptimeError, InvalidArguments, PlanError, SyntaxError_, TableNotFound,
+    Unsupported,
 )
 from greptimedb_tpu.standalone import GreptimeDB
 
@@ -775,3 +776,177 @@ class TestSlowQueryRecorder:
         db.sql("CREATE TABLE q (ts TIMESTAMP TIME INDEX, v DOUBLE)")
         db.sql("SELECT count(*) FROM q")
         assert not db.catalog.database_exists("greptime_private")
+
+
+class TestDistinctAggregates:
+    def test_count_distinct(self, db):
+        db.sql("CREATE TABLE cd (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (host))")
+        db.sql("INSERT INTO cd VALUES ('a',1000,1.0),('a',2000,1.0),"
+               "('a',3000,2.0),('b',4000,1.0),('b',5000,NULL)")
+        assert db.sql("SELECT host, count(DISTINCT v) FROM cd GROUP BY host"
+                      " ORDER BY host").rows == [["a", 2], ["b", 1]]
+        assert db.sql("SELECT count(DISTINCT host) FROM cd").rows == [[2]]
+        assert db.sql("SELECT count(DISTINCT v) FROM cd").rows == [[2]]
+        # mixed with plain aggs (must not join the batched wide pass)
+        assert db.sql(
+            "SELECT host, count(v), count(DISTINCT v), sum(v) FROM cd "
+            "GROUP BY host ORDER BY host"
+        ).rows == [["a", 3, 2, 4.0], ["b", 1, 1, 1.0]]
+
+    def test_distinct_only_for_count(self, db):
+        db.sql("CREATE TABLE cd2 (ts TIMESTAMP(3) TIME INDEX, v DOUBLE)")
+        db.sql("INSERT INTO cd2 VALUES (1000, 1.0)")
+        with pytest.raises(Unsupported):
+            db.sql("SELECT sum(DISTINCT v) FROM cd2")
+
+
+class TestUnion:
+    def test_union_dedup_and_all(self, db):
+        db.sql("CREATE TABLE u1 (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (h))")
+        db.sql("CREATE TABLE u2 (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO u1 VALUES ('x',1000,1.0),('y',2000,2.0)")
+        db.sql("INSERT INTO u2 VALUES ('x',1000,1.0),('z',3000,3.0)")
+        assert db.sql("SELECT h, v FROM u1 UNION SELECT h, v FROM u2 "
+                      "ORDER BY h").rows == [["x", 1.0], ["y", 2.0],
+                                             ["z", 3.0]]
+        assert db.sql("SELECT h, v FROM u1 UNION ALL SELECT h, v FROM u2 "
+                      "ORDER BY v DESC LIMIT 2").rows == [["z", 3.0],
+                                                          ["y", 2.0]]
+        assert db.sql("SELECT count(*) FROM u1 UNION ALL "
+                      "SELECT count(*) FROM u2").rows == [[2], [2]]
+
+    def test_union_column_mismatch(self, db):
+        db.sql("CREATE TABLE u3 (ts TIMESTAMP(3) TIME INDEX, v DOUBLE)")
+        db.sql("INSERT INTO u3 VALUES (1000, 1.0)")
+        with pytest.raises(PlanError):
+            db.sql("SELECT v FROM u3 UNION SELECT v, ts FROM u3")
+
+
+class TestSubqueries:
+    def test_scalar_and_in_subqueries(self, db):
+        db.sql("CREATE TABLE sq (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO sq VALUES ('a',1000,1.0),('b',2000,5.0),"
+               "('c',3000,3.0)")
+        assert db.sql("SELECT h, v FROM sq WHERE v > (SELECT avg(v) FROM sq)"
+                      " ORDER BY h").rows == [["b", 5.0]]
+        assert db.sql("SELECT h FROM sq WHERE h IN (SELECT h FROM sq "
+                      "WHERE v >= 3.0) ORDER BY h").rows == [["b"], ["c"]]
+        assert db.sql("SELECT h FROM sq WHERE h NOT IN (SELECT h FROM sq "
+                      "WHERE v >= 3.0) ORDER BY h").rows == [["a"]]
+        assert db.sql("SELECT (SELECT max(v) FROM sq) AS mx").rows == [[5.0]]
+        # empty IN subquery: nothing matches; NOT IN matches all
+        assert db.sql("SELECT count(*) FROM sq WHERE h IN "
+                      "(SELECT h FROM sq WHERE v > 99)").rows == [[0]]
+        assert db.sql("SELECT count(*) FROM sq WHERE h NOT IN "
+                      "(SELECT h FROM sq WHERE v > 99)").rows == [[3]]
+
+    def test_scalar_subquery_multi_row_errors(self, db):
+        db.sql("CREATE TABLE sq2 (ts TIMESTAMP(3) TIME INDEX, v DOUBLE)")
+        db.sql("INSERT INTO sq2 VALUES (1000,1.0),(2000,2.0)")
+        with pytest.raises(PlanError):
+            db.sql("SELECT v FROM sq2 WHERE v = (SELECT v FROM sq2)")
+
+
+class TestJoins:
+    @pytest.fixture
+    def jdb(self, db):
+        db.sql("CREATE TABLE metrics (host STRING, ts TIMESTAMP(3) "
+               "TIME INDEX, cpu DOUBLE, PRIMARY KEY (host))")
+        db.sql("CREATE TABLE meta (host STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " dc STRING, weight DOUBLE, PRIMARY KEY (host))")
+        db.sql("INSERT INTO metrics VALUES ('a',1000,10.0),('a',2000,20.0),"
+               "('b',1000,30.0),('c',1000,40.0)")
+        db.sql("INSERT INTO meta VALUES ('a',0,'us',1.0),('b',0,'eu',2.0)")
+        return db
+
+    def test_inner_join_groupby_device_agg(self, jdb):
+        r = jdb.sql("SELECT m.host, meta.dc, sum(m.cpu) FROM metrics m "
+                    "JOIN meta ON m.host = meta.host "
+                    "GROUP BY m.host, meta.dc ORDER BY m.host")
+        assert r.rows == [["a", "us", 30.0], ["b", "eu", 30.0]]
+
+    def test_left_join_misses(self, jdb):
+        r = jdb.sql("SELECT m.host, meta.dc, count(*) FROM metrics m "
+                    "LEFT JOIN meta ON m.host = meta.host "
+                    "GROUP BY m.host, meta.dc ORDER BY m.host")
+        assert r.rows == [["a", "us", 2], ["b", "eu", 1], ["c", "", 1]]
+
+    def test_join_projection_and_where(self, jdb):
+        r = jdb.sql("SELECT m.host, m.cpu, meta.weight FROM metrics m "
+                    "JOIN meta ON m.host = meta.host "
+                    "ORDER BY m.host, m.cpu")
+        assert r.rows == [["a", 10.0, 1.0], ["a", 20.0, 1.0],
+                          ["b", 30.0, 2.0]]
+        assert jdb.sql("SELECT count(*) FROM metrics m JOIN meta "
+                       "ON m.host = meta.host WHERE m.host = 'a'"
+                       ).rows == [[2]]
+
+    def test_join_agg_by_right_field(self, jdb):
+        r = jdb.sql("SELECT meta.dc, avg(m.cpu) FROM metrics m JOIN meta "
+                    "ON m.host = meta.host GROUP BY meta.dc ORDER BY meta.dc")
+        assert r.rows == [["eu", 30.0], ["us", 15.0]]
+
+    def test_join_expression_on_both_sides(self, jdb):
+        r = jdb.sql("SELECT m.host, m.cpu * meta.weight AS wcpu "
+                    "FROM metrics m JOIN meta ON m.host = meta.host "
+                    "ORDER BY m.host, wcpu")
+        assert r.rows == [["a", 10.0], ["a", 20.0], ["b", 60.0]]
+
+    def test_join_errors(self, jdb):
+        with pytest.raises(PlanError):
+            jdb.sql("SELECT 1 FROM metrics m JOIN meta m "
+                    "ON m.host = m.host")  # duplicate alias
+        with pytest.raises(Unsupported):
+            jdb.sql("SELECT 1 FROM metrics m JOIN meta "
+                    "ON m.cpu > meta.weight")  # non-equi
+
+
+class TestStringFieldGroupBy:
+    def test_string_field_key_decoded(self, db):
+        """Regression: GROUP BY over a string FIELD must decode the ad-hoc
+        dictionary codes, not leak them."""
+        db.sql("CREATE TABLE lg3 (ts TIMESTAMP(3) TIME INDEX, "
+               "level STRING, n DOUBLE)")
+        db.sql("INSERT INTO lg3 VALUES (1000,'info',1.0),(2000,'warn',2.0),"
+               "(3000,'info',3.0)")
+        r = db.sql("SELECT level, count(*), sum(n) FROM lg3 "
+                   "GROUP BY level ORDER BY level")
+        assert r.rows == [["info", 2, 4.0], ["warn", 1, 2.0]]
+
+
+class TestJoinReviewRegressions:
+    @pytest.fixture
+    def jdb(self, db):
+        db.sql("CREATE TABLE metrics (host STRING, ts TIMESTAMP(3) "
+               "TIME INDEX, cpu DOUBLE, PRIMARY KEY (host))")
+        db.sql("CREATE TABLE meta (host STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " dc STRING, weight DOUBLE, PRIMARY KEY (host))")
+        db.sql("INSERT INTO metrics VALUES ('a',1000,10.0),('a',2000,20.0),"
+               "('b',1000,30.0),('c',1000,40.0)")
+        db.sql("INSERT INTO meta VALUES ('a',0,'us',1.0),('b',0,'eu',2.0)")
+        return db
+
+    def test_join_case_expression(self, jdb):
+        """Regression: CASE WHEN arms (tuple-of-tuples) must be rewritten."""
+        r = jdb.sql(
+            "SELECT m.host, CASE WHEN m.host = 'a' THEN 1 ELSE 0 END "
+            "AS kind FROM metrics m "
+            "JOIN meta ON m.host = meta.host GROUP BY m.host, kind "
+            "ORDER BY m.host"
+        )
+        assert [row[1] for row in r.rows] == [1, 0]
+
+    def test_subquery_inside_case(self, jdb):
+        r = jdb.sql(
+            "SELECT host, CASE WHEN cpu > (SELECT avg(cpu) FROM metrics) "
+            "THEN 'hot' ELSE 'cool' END AS t FROM metrics ORDER BY host, cpu"
+        )
+        assert [row[1] for row in r.rows] == ["cool", "cool", "hot", "hot"]
+
+    def test_multi_column_count_distinct_rejected(self, jdb):
+        with pytest.raises(Unsupported):
+            jdb.sql("SELECT count(DISTINCT host, cpu) FROM metrics")
