@@ -99,7 +99,7 @@ def cmd_datanode(args) -> int:
     from greptimedb_tpu.rpc.datanode import serve
 
     serve(args.node_id, args.data_home, host=args.host, port=args.port,
-          managed=args.managed)
+          managed=args.managed, remote_wal_dir=args.remote_wal_dir)
     return 0
 
 
@@ -268,6 +268,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="0 = pick a free port (printed as JSON on stdout)")
     pd.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
+    pd.add_argument("--remote-wal-dir", default=None,
+                    help="shared-log broker directory (Kafka-style remote "
+                         "WAL; node holds no required local WAL state)")
     pd.add_argument("--managed", action="store_true",
                     help="a metasrv owns region leases (enables lease "
                          "self-fencing; without it leader leases self-renew "

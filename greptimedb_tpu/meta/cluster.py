@@ -34,9 +34,17 @@ class Datanode:
     (heartbeat emission, mailbox execution, lease self-fencing — reference
     src/datanode/src/{heartbeat.rs,alive_keeper.rs})."""
 
-    def __init__(self, node_id: int, data_home: str):
+    def __init__(self, node_id: int, data_home: str, wal_broker=None):
         self.node_id = node_id
-        self.engine = RegionEngine(data_home)
+        # wal_broker: SharedLogBroker → remote WAL mode (the reference's
+        # Kafka WAL): the node keeps NO required local state; its regions
+        # replay from the shared log on any node after failover
+        factory = None
+        if wal_broker is not None:
+            from greptimedb_tpu.storage.remote_wal import RemoteLogStore
+
+            factory = lambda rid: RemoteLogStore(wal_broker, rid)  # noqa: E731
+        self.engine = RegionEngine(data_home, log_store_factory=factory)
         self.roles: dict[int, str] = {}  # region_id -> leader|follower|downgrading
         self.lease_until_ms: dict[int, float] = {}
         self.alive = True
@@ -107,7 +115,10 @@ class Datanode:
         kind = instr["kind"]
         rid = instr.get("region_id")
         if kind == "open_region":
-            schema = Schema.from_dict(instr["schema"]) if "schema" in instr else None
+            schema = (
+                Schema.from_dict(instr["schema"])
+                if instr.get("schema") else None  # key may exist with None
+            )
             role = instr.get("role", "follower")
             was_open = rid in self.engine.regions
             try:
@@ -344,6 +355,9 @@ class Metasrv:
 
     def _submit_migration(self, region_id: int, from_node: int, to_node: int,
                           now_ms: float) -> dict:
+        # schema peek is best-effort: a dead from-node's proxy reports no
+        # regions (rpc client swallows transport errors) and the candidate
+        # then opens from shared storage via the manifest
         region = self.datanodes[from_node].engine.regions.get(region_id)
         schema = region.schema.to_dict() if region is not None else None
         proc = RegionMigrationProcedure(state={

@@ -99,7 +99,10 @@ class _RemoteRegions:
         self._client = client
 
     def _fetch(self) -> dict[int, _RemoteRegionStub]:
-        status = self._client.status()
+        try:
+            status = self._client.status()
+        except fl.FlightError:
+            return {}  # node unreachable (dead): no regions visible
         return {
             int(rid): _RemoteRegionStub(Schema.from_dict(sd))
             for rid, sd in status.get("regions", {}).items()
@@ -138,7 +141,10 @@ class RemoteDatanode:
 
     @property
     def roles(self) -> dict[int, str]:
-        status = self.client.status()
+        try:
+            status = self.client.status()
+        except fl.FlightError:
+            return {}
         return {int(k): v for k, v in status.get("roles", {}).items()}
 
     def handle_instruction(self, instr: dict, now_ms: float) -> dict:

@@ -82,11 +82,16 @@ def _host_scan_to_table(host: dict[str, np.ndarray]) -> pa.Table:
 class DatanodeFlightServer(fl.FlightServerBase):
     def __init__(self, node_id: int, data_home: str,
                  host: str = "127.0.0.1", port: int = 0,
-                 managed: bool = False):
+                 managed: bool = False, remote_wal_dir: str | None = None):
         location = f"grpc://{host}:{port}"
         super().__init__(location)
         self.node_id = node_id
-        self.datanode = Datanode(node_id, data_home)
+        broker = None
+        if remote_wal_dir is not None:
+            from greptimedb_tpu.storage.remote_wal import SharedLogBroker
+
+            broker = SharedLogBroker(remote_wal_dir)
+        self.datanode = Datanode(node_id, data_home, wal_broker=broker)
         self.cache = RegionCacheManager()
         self._views: dict[tuple, object] = {}
         self._view_nonce = 0
@@ -214,10 +219,12 @@ class DatanodeFlightServer(fl.FlightServerBase):
 
 
 def serve(node_id: int, data_home: str, host: str = "127.0.0.1",
-          port: int = 0, managed: bool = False) -> None:
+          port: int = 0, managed: bool = False,
+          remote_wal_dir: str | None = None) -> None:
     """Blocking entry point for the datanode role process."""
     server = DatanodeFlightServer(node_id, data_home, host, port,
-                                  managed=managed)
+                                  managed=managed,
+                                  remote_wal_dir=remote_wal_dir)
     print(json.dumps({"node_id": node_id, "address": server.address}),
           flush=True)
     server.serve()

@@ -62,6 +62,7 @@ class Region:
         manifest: Manifest,
         wal_dir: str | None,
         options: RegionOptions,
+        log_store: "LogStore | None" = None,
     ):
         self.region_id = region_id
         self.store = store
@@ -69,7 +70,10 @@ class Region:
         self.options = options
         self.manifest = manifest
         self._dir = f"region_{region_id}"
-        if options.wal_enabled and wal_dir is not None:
+        if log_store is not None:
+            # injected WAL (remote/shared log — storage/remote_wal.py)
+            self.wal = log_store
+        elif options.wal_enabled and wal_dir is not None:
             self.wal = FileLogStore(wal_dir, sync=options.wal_sync)
         else:
             self.wal = NoopLogStore()
@@ -583,11 +587,22 @@ class RegionEngine:
     """Owns all regions under one data home (the datanode's storage engine,
     reference RegionServer + MitoEngine)."""
 
-    def __init__(self, data_home: str, default_options: RegionOptions | None = None):
+    def __init__(self, data_home: str,
+                 default_options: RegionOptions | None = None,
+                 log_store_factory=None):
         self.data_home = data_home
         self.store = FsObjectStore(data_home)
         self.default_options = default_options or RegionOptions()
         self.regions: dict[int, Region] = {}
+        # region_id -> LogStore; None = node-local file WAL.  A remote
+        # factory (e.g. RemoteLogStore over a SharedLogBroker) makes the
+        # node (nearly) stateless: failover replays from shared infra
+        self.log_store_factory = log_store_factory
+
+    def _log_store(self, region_id: int):
+        if self.log_store_factory is None:
+            return None
+        return self.log_store_factory(region_id)
 
     def _wal_dir(self, region_id: int) -> str:
         return os.path.join(self.data_home, f"region_{region_id}", "wal")
@@ -604,7 +619,8 @@ class RegionEngine:
         manifest.commit({"kind": "schema", "schema": schema.to_dict()})
         manifest.commit({"kind": "options", "options": opts.to_dict()})
         region = Region(region_id, self.store, schema, manifest,
-                        self._wal_dir(region_id), opts)
+                        self._wal_dir(region_id), opts,
+                        log_store=self._log_store(region_id))
         self.regions[region_id] = region
         return region
 
@@ -619,7 +635,8 @@ class RegionEngine:
             raise RegionNotFound(f"region {region_id} not found in {self.data_home}")
         opts = RegionOptions(**manifest.state.options) if manifest.state.options else self.default_options
         region = Region(region_id, self.store, manifest.state.schema, manifest,
-                        self._wal_dir(region_id), opts)
+                        self._wal_dir(region_id), opts,
+                        log_store=self._log_store(region_id))
         region.replay_wal(repair=take_ownership)
         self.regions[region_id] = region
         return region

@@ -1,0 +1,195 @@
+"""Remote (shared) WAL: a Kafka-style replicated log decoupled from any
+datanode's local state.
+
+Reference: the Kafka remote WAL (src/log-store/src/kafka/ — per-topic
+producers/consumers, high-watermark index; topic allocation in
+src/common/wal; pruning procedure src/meta-srv/src/procedure/wal_prune/;
+RFC docs/rfcs/2023-03-08-region-fault-tolerance.md).  The point of the
+design is FAST FAILOVER: datanodes become (nearly) stateless because the
+write-ahead log lives on shared infrastructure — when a node dies, its
+regions open elsewhere and replay from the shared log; nothing on the
+dead machine is needed.
+
+``SharedLogBroker`` stands in for the Kafka cluster: a directory on
+shared storage holding one segmented CRC-checked log per topic (reusing
+the FileLogStore format), with per-region low watermarks driving
+whole-segment pruning.  Entries are envelopes of
+(region_id, region_sequence, payload) so multiple regions can multiplex
+one topic (the reference's WalEntryDistributor demux,
+src/mito2/src/wal/).  ``RemoteLogStore`` adapts one (broker, topic,
+region) to the LogStore interface Region already consumes — switching a
+region between local and remote WAL is a construction-time choice.
+
+Single-writer discipline: a topic's append side is the region leader
+(regions default to one topic each); readers always replay with
+repair=False.  A real multi-broker deployment would replace this class
+with a networked client — the interface is the contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+
+from greptimedb_tpu.storage.wal import FileLogStore, LogStore
+
+_ENV = struct.Struct("<QQ")  # region_id, region sequence
+
+
+class SharedLogBroker:
+    """File-backed shared log service (the 'Kafka cluster')."""
+
+    def __init__(self, root_dir: str, topics_per_node: int | None = None):
+        self.root = root_dir
+        os.makedirs(root_dir, exist_ok=True)
+        # None → one topic per region (safe for multi-process writers);
+        # an int enables shared-topic multiplexing (single process)
+        self.topics_per_node = topics_per_node
+        self._logs: dict[str, FileLogStore] = {}
+        self._offsets: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # ---- topology ------------------------------------------------------
+    def topic_for(self, region_id: int) -> str:
+        if self.topics_per_node is None:
+            return f"region_{region_id}"
+        return f"shared_{region_id % self.topics_per_node}"
+
+    def _log(self, topic: str) -> FileLogStore:
+        log = self._logs.get(topic)
+        if log is None:
+            log = FileLogStore(os.path.join(self.root, topic))
+            self._logs[topic] = log
+            last = self._floor(topic)
+            # append-side owner: REPAIR torn tails here (a SIGKILLed
+            # leader can leave a half-written record; appending after it
+            # would hide every later entry from replay forever)
+            for off, _payload in log.replay(last, repair=True):
+                last = off
+            self._offsets[topic] = last
+        return log
+
+    def acquire(self, topic: str) -> None:
+        """(Re)take append ownership of a topic: drop any cached handle and
+        offset so state re-reads from shared storage.  Called whenever a
+        region (re)opens — leadership may have bounced through another
+        broker instance that appended and pruned in the meantime."""
+        with self._lock:
+            log = self._logs.pop(topic, None)
+            if log is not None:
+                log.close()
+            self._offsets.pop(topic, None)
+
+    # ---- data plane ----------------------------------------------------
+    def append(self, topic: str, region_id: int, sequence: int,
+               payload: bytes) -> int:
+        with self._lock:
+            log = self._log(topic)
+            offset = self._offsets[topic] + 1
+            self._offsets[topic] = offset
+            log.append(offset, _ENV.pack(region_id, sequence) + payload)
+            return offset
+
+    def read(self, topic: str, from_offset: int | None = None):
+        """Yield (offset, region_id, sequence, payload); read-only (never
+        repairs — only the append owner may truncate tails)."""
+        log = self._log(topic)
+        if from_offset is None:
+            from_offset = self._floor(topic)
+        for offset, data in log.replay(from_offset, repair=False):
+            rid, seq = _ENV.unpack_from(data, 0)
+            yield offset, rid, seq, data[_ENV.size:]
+
+    # ---- pruning (reference wal_prune procedure) -----------------------
+    def _wm_path(self, topic: str) -> str:
+        return os.path.join(self.root, f"{topic}.watermarks.json")
+
+    def _load_wm(self, topic: str) -> dict:
+        path = self._wm_path(topic)
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (json.JSONDecodeError, OSError):
+                return {}  # corrupt marker: conservatively prune nothing
+        return {}
+
+    def _floor(self, topic: str) -> int:
+        """Offset below which everything has been pruned (scan start)."""
+        return int(self._load_wm(topic).get("_floor", 0))
+
+    def set_low_watermark(self, topic: str, region_id: int,
+                          sequence: int) -> None:
+        """Region has flushed everything below ``sequence``; entries older
+        than every region's watermark become prunable."""
+        with self._lock:
+            wm = self._load_wm(topic)
+            wm[str(region_id)] = max(int(wm.get(str(region_id), 0)), sequence)
+            self._prune(topic, wm)
+            # atomic replace: a crash mid-write must never corrupt the
+            # marker (a broken marker would wedge flush/prune forever)
+            path = self._wm_path(topic)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(wm, f)
+            os.replace(tmp, path)
+
+    def _prune(self, topic: str, wm: dict) -> None:
+        """Drop whole segments whose every entry is below its region's
+        watermark (the reference prunes Kafka up to the min high
+        watermark across regions on the topic).  Scans start at the
+        stored floor, not offset 0, so flush cost tracks the UNPRUNED
+        suffix only."""
+        log = self._log(topic)
+        keep_from: int | None = None
+        for offset, rid, seq, _payload in self.read(topic):
+            if seq >= int(wm.get(str(rid), 0)):
+                keep_from = offset
+                break
+        if keep_from is not None:
+            log.truncate(keep_from)
+            wm["_floor"] = keep_from
+        else:
+            # everything flushed: drop all closed segments
+            end = self._offsets.get(topic, 0) + 1
+            log.truncate(end)
+            wm["_floor"] = end
+
+    def close(self) -> None:
+        for log in self._logs.values():
+            log.close()
+        self._logs.clear()
+
+
+class RemoteLogStore(LogStore):
+    """One region's view of the shared log (LogStore interface)."""
+
+    def __init__(self, broker: SharedLogBroker, region_id: int):
+        self.broker = broker
+        self.region_id = region_id
+        self.topic = broker.topic_for(region_id)
+        # re-take ownership: leadership may have bounced through another
+        # broker instance (other process) that appended/pruned meanwhile
+        broker.acquire(self.topic)
+        # change-detection hook for Region.storage_fingerprint (follower
+        # no-op sync skipping): the topic's segment files
+        self.dir = os.path.join(broker.root, self.topic)
+
+    def append(self, sequence: int, payload: bytes) -> None:
+        self.broker.append(self.topic, self.region_id, sequence, payload)
+
+    def replay(self, from_sequence: int = 0, repair: bool = True):
+        # repair is meaningless here: the shared log is never truncated by
+        # readers (the broker owns its own tail integrity)
+        for _off, rid, seq, payload in self.broker.read(self.topic):
+            if rid == self.region_id and seq >= from_sequence:
+                yield seq, payload
+
+    def truncate(self, up_to_sequence: int) -> None:
+        self.broker.set_low_watermark(self.topic, self.region_id,
+                                      up_to_sequence)
+
+    def close(self) -> None:
+        pass  # broker lifecycle is owned by the node/deployment

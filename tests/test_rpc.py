@@ -331,3 +331,71 @@ class TestReviewRegressions:
         res = frontend.sql(
             "SELECT host, v FROM tr WHERE ts >= 40000 ORDER BY ts LIMIT 10")
         assert res.rows == [["a", 2.0], ["z", 3.0]]
+
+
+class TestRemoteWalFailover:
+    def test_failover_off_dead_process(self, tmp_path):
+        """SIGKILL a remote-WAL datanode process; the Metasrv migrates its
+        region to a live process and WAL-only rows replay from the shared
+        broker (reference: Kafka WAL fault tolerance, RFC 2023-03-08)."""
+        import os
+
+        from greptimedb_tpu.datatypes import (
+            ColumnSchema, ConcreteDataType as T, Schema, SemanticType as S,
+        )
+        from greptimedb_tpu.meta.cluster import Metasrv
+        from greptimedb_tpu.meta.kv import MemoryKv
+
+        storage = str(tmp_path / "store")
+        wal = str(tmp_path / "broker")
+        procs, addrs = [], []
+        for i in range(2):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "greptimedb_tpu.cli", "datanode",
+                 "start", "--node-id", str(i), "--data-home", storage,
+                 "--remote-wal-dir", wal, "--managed", "--platform", "cpu"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, cwd="/root/repo")
+            procs.append(p)
+            addrs.append(json.loads(p.stdout.readline())["address"])
+        try:
+            sch = Schema((
+                ColumnSchema("h", T.STRING, S.TAG),
+                ColumnSchema("ts", T.TIMESTAMP_MILLISECOND, S.TIMESTAMP),
+                ColumnSchema("v", T.FLOAT64, S.FIELD),
+            ))
+            ms = Metasrv(MemoryKv())
+            proxies = [RemoteDatanode(i, a) for i, a in enumerate(addrs)]
+            for pr in proxies:
+                ms.register_datanode(pr)
+            rid = 4242
+            proxies[0].handle_instruction(
+                {"kind": "open_region", "region_id": rid, "role": "leader",
+                 "schema": sch.to_dict()}, 0.0)
+            ms.set_region_route(rid, 0)
+            proxies[0].write(rid, {"h": ["a"], "ts": [1000], "v": [1.0]},
+                             1.0)
+            proxies[0].client.instruction(
+                {"kind": "flush_region", "region_id": rid})
+            proxies[0].write(rid, {"h": ["b"], "ts": [2000], "v": [2.0]},
+                             2.0)  # WAL-only
+            # no WAL bytes under the storage home: the broker owns them
+            assert not [f for _r, _d, fs in os.walk(storage) for f in fs
+                        if f.endswith(".wal")]
+            procs[0].kill()
+            procs[0].wait()
+            out = ms.migrate_region(rid, 0, 1, now_ms=10.0)
+            assert out == {"region_id": rid, "to_node": 1}
+            host = proxies[1].read(rid)
+            assert sorted(zip(host["h"], host["v"])) == [
+                ("a", 1.0), ("b", 2.0)]
+            proxies[1].write(rid, {"h": ["c"], "ts": [3000], "v": [3.0]},
+                             20.0)
+            assert len(proxies[1].read(rid)["ts"]) == 3
+            DatanodeClient(addrs[1]).action("shutdown")
+            procs[1].wait(timeout=20)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+                    p.wait(timeout=10)
