@@ -119,9 +119,15 @@ def split_partial(sel: Select) -> PartialPlan | None:
         # collapse its groups into one row — ship raw instead
         return None
 
+    from greptimedb_tpu.query.ast import Column
+
     partial = replace(
         sel,
         items=partial_items,
+        # every group key corresponds to a projected key item (enforced
+        # above); reference them by their partial aliases so original
+        # alias-based GROUP BY entries (GROUP BY minute) still resolve
+        group_by=[Column(k) for k in key_cols],
         order_by=[],
         limit=None,
         offset=None,
@@ -132,6 +138,25 @@ def split_partial(sel: Select) -> PartialPlan | None:
         merge_cols=dict(merge_cols),
         items=tuple(merge_items),
     )
+
+
+def merge_into(slot: dict, values: dict, merge_cols: dict[str, str]) -> None:
+    """Fold one partial row into an accumulator slot — the ONE definition
+    of partial-merge semantics (None-tolerant sum/min/max), shared by the
+    distributed frontend merge and the streaming flow engine."""
+    for c, op in merge_cols.items():
+        v = values[c]
+        cur = slot[c]
+        if v is None:
+            continue
+        if cur is None:
+            slot[c] = v
+        elif op == "sum":
+            slot[c] = cur + v
+        elif op == "min":
+            slot[c] = min(cur, v)
+        elif op == "max":
+            slot[c] = max(cur, v)
 
 
 def merge_partials(
@@ -154,19 +179,8 @@ def merge_partials(
             if slot is None:
                 acc[key] = {c: part[c][r] for c in plan.merge_cols}
                 continue
-            for c, op in plan.merge_cols.items():
-                v = part[c][r]
-                cur = slot[c]
-                if v is None:
-                    continue
-                if cur is None:
-                    slot[c] = v
-                elif op == "sum":
-                    slot[c] = cur + v
-                elif op == "min":
-                    slot[c] = min(cur, v)
-                elif op == "max":
-                    slot[c] = max(cur, v)
+            merge_into(slot, {c: part[c][r] for c in plan.merge_cols},
+                       plan.merge_cols)
 
     names = [m.output_name for m in plan.items]
     rows: list[list] = []

@@ -363,8 +363,11 @@ class HttpServer:
                     total += _ingest_columns(self.db, name, cols)
             if self.db.flow_engine.flows:
                 for table, cols in tables.items():
+                    # metric-engine writes multiplex regions; conservative
+                    # appendable=False is handled upstream via dirtying,
+                    # so pass the chunk and let pure appends stream
                     self.db.flow_engine.on_write(_safe_table(table),
-                                                 cols["ts"])
+                                                 cols["ts"], data=cols)
                 self.db.flow_engine.run_all()
             return total
 
@@ -1065,6 +1068,10 @@ def _ingest_columns(db, table: str, cols: dict) -> int:
             sub = {c: [cols[c][i] for i in row_idx] for c in cols}
             regions[pidx].write(sub)
     if db.flow_engine.flows:
-        db.flow_engine.on_write(name, cols["ts"])
+        appendable = all(
+            getattr(r, "last_write_appendable", True) for r in regions
+        )
+        db.flow_engine.on_write(name, cols["ts"], data=cols,
+                                appendable=appendable)
         db.flow_engine.run_all()
     return n

@@ -640,7 +640,11 @@ class GreptimeDB(TableProvider):
         if self.flow_engine.flows:
             # batching flows: mark dirty windows and re-evaluate synchronously
             # (the reference defers via eval_schedule; standalone runs inline)
-            self.flow_engine.on_write(stmt.table, data[ts_name])
+            appendable = all(
+                getattr(r, "last_write_appendable", True) for r in regions
+            )
+            self.flow_engine.on_write(stmt.table, data[ts_name], data=data,
+                                      appendable=appendable)
             self.flow_engine.run_all()
         return QueryResult([], [], affected_rows=len(stmt.rows))
 
@@ -796,7 +800,12 @@ class GreptimeDB(TableProvider):
                     regions[pidx].write(sub)
             if self.flow_engine.flows:
                 ts_name = schema.time_index.name
-                self.flow_engine.on_write(stmt.table, data[ts_name])
+                appendable = all(
+                    getattr(r, "last_write_appendable", True)
+                    for r in regions
+                )
+                self.flow_engine.on_write(stmt.table, data[ts_name],
+                                          data=data, appendable=appendable)
                 self.flow_engine.run_all()
         return QueryResult([], [], affected_rows=table.num_rows)
 

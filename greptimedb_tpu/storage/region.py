@@ -206,6 +206,10 @@ class Region:
 
         self.memtable.append(mt_chunk)
         self.generation += 1
+        # consumers like the streaming flow engine need to know whether
+        # this batch could have OVERWRITTEN existing rows (upsert) — an
+        # incremental aggregate may only fold in pure appends
+        self.last_write_appendable = appendable or n == 0
         if appendable:
             self._append_log.append(mt_chunk)
         elif n > 0:

@@ -1,0 +1,197 @@
+"""Dual-engine flow tests: streaming incremental aggregation vs batching
+dirty-window re-query (reference FlowDualEngine,
+src/flow/src/adapter/flownode_impl.rs:66).
+"""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.standalone import GreptimeDB
+
+
+@pytest.fixture
+def db():
+    d = GreptimeDB()
+    yield d
+    d.close()
+
+
+def _mk_source(db):
+    db.sql("CREATE TABLE src (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+           "v DOUBLE, PRIMARY KEY (h))")
+
+
+class TestDualEngineSelection:
+    def test_decomposable_query_streams(self, db):
+        _mk_source(db)
+        db.sql("CREATE FLOW f1 SINK TO s1 AS SELECT "
+               "date_bin(INTERVAL '1 minute', ts) AS w, h, sum(v), count(*),"
+               " max(v), avg(v) FROM src GROUP BY w, h")
+        assert db.flow_engine.flows["f1"].mode == "streaming"
+
+    def test_non_decomposable_query_batches(self, db):
+        _mk_source(db)
+        db.sql("CREATE FLOW f2 SINK TO s2 AS SELECT "
+               "date_bin(INTERVAL '1 minute', ts) AS w, h, "
+               "first_value(v) AS fv FROM src GROUP BY w, h")
+        assert db.flow_engine.flows["f2"].mode == "batching"
+
+
+class TestStreamingFlow:
+    def test_streamed_equals_requeried(self, db):
+        """The dual-engine parity contract: the streamed sink content must
+        equal re-running the flow query over the full source."""
+        _mk_source(db)
+        db.sql("CREATE FLOW f SINK TO agg AS SELECT "
+               "date_bin(INTERVAL '1 minute', ts) AS w, h, sum(v) AS s, "
+               "count(*) AS c, avg(v) AS a, max(v) AS mx "
+               "FROM src GROUP BY w, h")
+        rng = np.random.default_rng(5)
+        # several incremental batches, interleaved hosts/windows
+        for b in range(6):
+            vals = ", ".join(
+                f"('h{j % 3}', {b * 30000 + j * 700}, "
+                f"{float(rng.integers(1, 100))})"
+                for j in range(8)
+            )
+            db.sql(f"INSERT INTO src VALUES {vals}")
+        streamed = db.sql(
+            "SELECT w, h, s, c, a, mx FROM agg ORDER BY w, h").rows
+        requeried = db.sql(
+            "SELECT date_bin(INTERVAL '1 minute', ts) AS w, h, sum(v), "
+            "count(*), avg(v), max(v) FROM src GROUP BY w, h ORDER BY w, h"
+        ).rows
+        assert len(streamed) == len(requeried)
+        for srow, qrow in zip(streamed, requeried):
+            assert srow[:2] == qrow[:2]
+            for a, b_ in zip(srow[2:], qrow[2:]):
+                assert a == pytest.approx(b_, rel=1e-6)
+
+    def test_second_batch_streams_without_rescan(self, db):
+        _mk_source(db)
+        db.sql("CREATE FLOW f SINK TO agg AS SELECT "
+               "date_bin(INTERVAL '1 minute', ts) AS w, h, sum(v) AS s "
+               "FROM src GROUP BY w, h")
+        db.sql("INSERT INTO src VALUES ('x', 1000, 1.0)")  # seeds via backfill
+        task = db.flow_engine.flows["f"]
+        assert not task.needs_backfill
+
+        # spy: streaming must NOT re-scan the source table
+        scans = []
+        orig = db.host_columns
+
+        def spy(table, ts_range=(None, None)):
+            scans.append(table)
+            return orig(table, ts_range)
+
+        db.host_columns = spy
+        calls_before = len(scans)
+        db.sql("INSERT INTO src VALUES ('x', 2000, 2.0), ('y', 1500, 5.0)")
+        assert len(scans) == calls_before  # no source host-scan happened
+        assert task.stream_state[(0, "x")]["__a2_0"] == 3.0
+        r = db.sql("SELECT h, s FROM agg ORDER BY h")
+        assert r.rows == [["x", 3.0], ["y", 5.0]]
+
+    def test_restart_reseeds_state(self, tmp_path):
+        d = str(tmp_path / "data")
+        db = GreptimeDB(d)
+        _mk_source(db)
+        db.sql("CREATE FLOW f SINK TO agg AS SELECT "
+               "date_bin(INTERVAL '1 minute', ts) AS w, h, sum(v) AS s "
+               "FROM src GROUP BY w, h")
+        db.sql("INSERT INTO src VALUES ('x', 1000, 1.0)")
+        db.close()
+
+        db2 = GreptimeDB(d)
+        task = db2.flow_engine.flows["f"]
+        assert task.mode == "streaming"
+        # first post-restart ingest triggers the reseed, then streams
+        db2.sql("INSERT INTO src VALUES ('x', 2000, 4.0)")
+        assert task.stream_state[(0, "x")]["__a2_0"] == 5.0
+        assert db2.sql("SELECT s FROM agg").rows == [[5.0]]
+        db2.close()
+
+    def test_expire_prunes_state(self, db):
+        _mk_source(db)
+        db.sql("CREATE FLOW f SINK TO agg EXPIRE AFTER '1 hour' AS SELECT "
+               "date_bin(INTERVAL '1 minute', ts) AS w, h, sum(v) AS s "
+               "FROM src GROUP BY w, h")
+        task = db.flow_engine.flows["f"]
+        db.sql("INSERT INTO src VALUES ('x', 1000, 1.0)")  # window 0: ancient
+        import time as _t
+
+        now = int(_t.time() * 1000)
+        db.sql(f"INSERT INTO src VALUES ('x', {now}, 2.0)")
+        # window-0 state expired (1970 is far older than 1h); current kept
+        assert (0, "x") not in task.stream_state
+        assert any(k[1] == "x" and k[0] > 0 for k in task.stream_state)
+
+
+class TestBatchingStillWorks:
+    def test_batching_flow_end_to_end(self, db):
+        _mk_source(db)
+        db.sql("CREATE FLOW f SINK TO agg AS SELECT "
+               "date_bin(INTERVAL '1 minute', ts) AS w, h, "
+               "first_value(v) AS fv FROM src GROUP BY w, h")
+        db.sql("INSERT INTO src VALUES ('x', 1000, 9.0), ('x', 2000, 1.0)")
+        r = db.sql("SELECT w, h, fv FROM agg")
+        assert r.rows == [[0, "x", 9.0]]
+
+
+class TestStreamingReviewRegressions:
+    def test_upsert_forces_reseed_not_double_count(self, db):
+        """Re-writing an existing (tag, ts) row is keep-last in storage;
+        streaming state must reseed, never add both values."""
+        _mk_source(db)
+        db.sql("CREATE FLOW f SINK TO agg AS SELECT "
+               "date_bin(INTERVAL '1 minute', ts) AS w, h, sum(v) AS s "
+               "FROM src GROUP BY w, h")
+        db.sql("INSERT INTO src VALUES ('x', 1000, 1.0)")
+        db.sql("INSERT INTO src VALUES ('x', 1000, 5.0)")  # upsert!
+        assert db.sql("SELECT s FROM agg").rows == [[5.0]]  # not 6.0
+
+    def test_late_arrival_to_expired_window_skipped(self, db):
+        _mk_source(db)
+        db.sql("CREATE FLOW f SINK TO agg EXPIRE AFTER '1 hour' AS SELECT "
+               "date_bin(INTERVAL '1 minute', ts) AS w, h, sum(v) AS s "
+               "FROM src GROUP BY w, h")
+        import time as _t
+
+        task = db.flow_engine.flows["f"]
+        now = int(_t.time() * 1000)
+        db.sql(f"INSERT INTO src VALUES ('x', {now}, 2.0)")
+        # simulate: historical window had sum 100 in the sink, state pruned
+        sink = db._region_of("agg")
+        sink.write({"w": [0], "h": ["x"], "s": [100.0]})
+        db.cache.invalidate_region(sink.region_id)
+        # a late lone row for window 0 must NOT overwrite the 100
+        db.sql("INSERT INTO src VALUES ('x', 1000, 5.0)")
+        rows = dict(
+            (r[0], r[1])
+            for r in db.sql("SELECT w, s FROM agg ORDER BY w").rows
+        )
+        assert rows[0] == 100.0  # preserved
+
+    def test_int_tag_with_expiry_not_mistaken_for_window(self, db):
+        db.sql("CREATE TABLE http_src (code BIGINT, ts TIMESTAMP(3) "
+               "TIME INDEX, v DOUBLE, PRIMARY KEY (code))")
+        db.sql("CREATE FLOW f SINK TO agg2 EXPIRE AFTER '1 hour' AS SELECT "
+               "code, date_bin(INTERVAL '1 minute', ts) AS w, sum(v) AS s "
+               "FROM http_src GROUP BY code, w")
+        task = db.flow_engine.flows["f"]
+        assert task.mode == "streaming" and task.window_key_pos == 1
+        import time as _t
+
+        now = int(_t.time() * 1000)
+        db.sql(f"INSERT INTO http_src VALUES (200, {now}, 1.0)")
+        db.sql(f"INSERT INTO http_src VALUES (200, {now + 1}, 2.0)")
+        # live state must survive (code=200 is NOT a window timestamp)
+        assert any(task.stream_state.values())
+        assert db.sql("SELECT s FROM agg2").rows == [[3.0]]
+
+    def test_limit_flow_stays_batching(self, db):
+        _mk_source(db)
+        db.sql("CREATE FLOW f SINK TO agg3 AS SELECT "
+               "date_bin(INTERVAL '1 minute', ts) AS w, h, sum(v) AS s "
+               "FROM src GROUP BY w, h ORDER BY s DESC LIMIT 1")
+        assert db.flow_engine.flows["f"].mode == "batching"
