@@ -162,8 +162,32 @@ class Region:
                     cols[c.name] = np.asarray(v, dtype=object)
                 elif c.dtype.is_timestamp:
                     cols[c.name] = np.asarray(v).astype(np.int64)
+                elif isinstance(v, np.ndarray) and v.dtype != object:
+                    # typed arrays (arrow ingest, staging scans) can't hold
+                    # None — keep the single-pass hot path
+                    cols[c.name] = v.astype(c.dtype.to_numpy())
                 else:
-                    cols[c.name] = np.asarray(v, dtype=c.dtype.to_numpy())
+                    arr = np.asarray(v, dtype=object)
+                    if any(x is None for x in arr):
+                        if not c.nullable:
+                            raise InvalidArguments(
+                                f"column {c.name} is NOT NULL"
+                            )
+                        # NULL encoding (NOT the declared default — explicit
+                        # NULL is not DEFAULT): NaN for floats, 0 for ints,
+                        # matching default_fill_array's null branch and the
+                        # arrow path's fill_null(0)
+                        fill = np.nan if c.dtype.is_float else 0
+                        arr = np.array(
+                            [fill if x is None else x for x in arr],
+                            dtype=object,
+                        )
+                    try:
+                        cols[c.name] = arr.astype(c.dtype.to_numpy())
+                    except (TypeError, ValueError) as e:
+                        raise InvalidArguments(
+                            f"column {c.name}: {e}"
+                        ) from None
         seq = self.next_seq
         self.next_seq += 1
         chunk = dict(cols)

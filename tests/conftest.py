@@ -38,3 +38,8 @@ def tmp_data_dir(tmp_path):
     d = tmp_path / "data"
     d.mkdir()
     return str(d)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "golden: golden-file SQL/TQL corpus")
+    config.addinivalue_line("markers", "fuzz: randomized DDL/insert/query fuzzing")

@@ -1,0 +1,8 @@
+CREATE TABLE agg (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO agg VALUES ('x',1000,10.0),('x',2000,20.0),('y',1000,30.0),('y',2000,40.0),('y',3000,NULL);
+SELECT count(*), count(v), sum(v), min(v), max(v), avg(v) FROM agg;
+SELECT h, count(*), sum(v) FROM agg GROUP BY h ORDER BY h;
+SELECT h, stddev(v) FROM agg GROUP BY h ORDER BY h;
+SELECT h, first_value(v), last_value(v) FROM agg GROUP BY h ORDER BY h;
+SELECT count(DISTINCT h) FROM agg;
+SELECT h, count(DISTINCT v) FROM agg GROUP BY h ORDER BY h

@@ -1,0 +1,5 @@
+CREATE TABLE tb (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO tb VALUES ('a',0,1.0),('a',30000,2.0),('a',60000,3.0),('a',90000,4.0),('b',60000,10.0);
+SELECT date_bin(INTERVAL '1 minute', ts) AS w, sum(v) FROM tb GROUP BY w ORDER BY w;
+SELECT h, date_bin(INTERVAL '1 minute', ts) AS w, avg(v) FROM tb GROUP BY h, w ORDER BY h, w;
+SELECT date_trunc('minute', ts) AS m, count(*) FROM tb GROUP BY m ORDER BY m

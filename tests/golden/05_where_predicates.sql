@@ -1,0 +1,10 @@
+CREATE TABLE wp (h STRING, r STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h, r));
+INSERT INTO wp VALUES ('h1','us',0,10.0),('h2','us',0,20.0),('h3','eu',0,30.0),('h1','us',60000,40.0);
+SELECT count(*) FROM wp WHERE h IN ('h1','h3');
+SELECT count(*) FROM wp WHERE h NOT IN ('h1');
+SELECT count(*) FROM wp WHERE r != 'us';
+SELECT count(*) FROM wp WHERE r LIKE 'u%';
+SELECT count(*) FROM wp WHERE v BETWEEN 15 AND 35;
+SELECT count(*) FROM wp WHERE ts >= 0 AND ts < 60000;
+SELECT count(*) FROM wp WHERE v > 10 OR r = 'eu';
+SELECT h FROM wp WHERE v = 40.0

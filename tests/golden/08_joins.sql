@@ -1,0 +1,7 @@
+CREATE TABLE jm (host STRING, ts TIMESTAMP(3) TIME INDEX, cpu DOUBLE, PRIMARY KEY (host));
+CREATE TABLE jd (host STRING, ts TIMESTAMP(3) TIME INDEX, dc STRING, PRIMARY KEY (host));
+INSERT INTO jm VALUES ('a',1000,10.0),('a',2000,20.0),('b',1000,30.0),('c',1000,40.0);
+INSERT INTO jd VALUES ('a',0,'us'),('b',0,'eu');
+SELECT m.host, jd.dc, sum(m.cpu) FROM jm m JOIN jd ON m.host = jd.host GROUP BY m.host, jd.dc ORDER BY m.host;
+SELECT m.host, jd.dc FROM jm m LEFT JOIN jd ON m.host = jd.host GROUP BY m.host, jd.dc ORDER BY m.host;
+SELECT count(*) FROM jm m JOIN jd ON m.host = jd.host WHERE jd.host = 'a'

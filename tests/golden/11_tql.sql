@@ -1,0 +1,6 @@
+CREATE TABLE http_requests_total (pod STRING, ts TIMESTAMP(3) TIME INDEX, greptime_value DOUBLE, PRIMARY KEY (pod));
+INSERT INTO http_requests_total VALUES ('p1',0,0.0),('p1',15000,30.0),('p1',30000,60.0),('p1',45000,90.0),('p2',0,0.0),('p2',15000,15.0),('p2',30000,30.0),('p2',45000,45.0);
+TQL EVAL (45, 45, '15') http_requests_total;
+TQL EVAL (45, 45, '15') sum(http_requests_total);
+TQL EVAL (45, 45, '15') rate(http_requests_total[45s]);
+TQL EVAL (45, 45, '15') sum by (pod)(rate(http_requests_total[45s]))

@@ -1,0 +1,7 @@
+CREATE TABLE fx (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO fx VALUES ('a',1000,-2.5),('a',2000,4.0),('a',3000,9.0);
+SELECT abs(v), round(v), floor(v), ceil(v) FROM fx ORDER BY ts;
+SELECT sqrt(v) FROM fx WHERE v > 0 ORDER BY ts;
+SELECT v * 2 + 1 FROM fx ORDER BY ts;
+SELECT CASE WHEN v < 0 THEN 0 ELSE 1 END FROM fx ORDER BY ts;
+SELECT clamp(v, 0.0, 5.0) FROM fx ORDER BY ts

@@ -1,0 +1,4 @@
+CREATE TABLE info_t (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+SELECT table_name FROM information_schema.tables WHERE table_schema = 'public' ORDER BY table_name;
+SELECT column_name, semantic_type FROM information_schema.columns WHERE table_name = 'info_t' ORDER BY column_name;
+SELECT count(*) FROM information_schema.region_peers

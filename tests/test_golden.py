@@ -1,0 +1,150 @@
+"""Golden-file SQL/TQL corpus (the sqlness tier).
+
+Mirrors the reference's sqlness golden tests (tests/cases/standalone —
+454 .sql files with .result goldens, runner in tests/runner/): each
+``tests/golden/*.sql`` file holds ;-separated statements executed against
+a fresh standalone instance; expected output lives in the matching
+``.result`` file.  Numeric cells compare with float tolerance (TPU f32
+vs reference f64 — SURVEY §4 'numeric goldens must tolerate TPU float
+differences').
+
+Regenerate after INTENDED behavior changes with:
+    GREPTIME_GOLDEN_UPDATE=1 python -m pytest tests/test_golden.py -q
+then review the .result diff like any code change.
+"""
+
+import math
+import os
+import re
+
+import pytest
+
+from greptimedb_tpu.standalone import GreptimeDB
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+UPDATE = bool(os.environ.get("GREPTIME_GOLDEN_UPDATE"))
+
+pytestmark = pytest.mark.golden
+
+
+def _cases():
+    return sorted(
+        f[:-4] for f in os.listdir(GOLDEN_DIR) if f.endswith(".sql")
+    )
+
+
+def _strip_comments(text: str) -> str:
+    """Remove -- comments (outside string literals), line by line."""
+    out_lines = []
+    for line in text.splitlines():
+        in_str = False
+        cut = len(line)
+        for i, ch in enumerate(line):
+            if ch == "'":
+                in_str = not in_str
+            elif not in_str and line.startswith("--", i):
+                cut = i
+                break
+        out_lines.append(line[:cut])
+    return "\n".join(out_lines)
+
+
+def _split_statements(text: str) -> list[str]:
+    text = _strip_comments(text)
+    out, buf, in_str = [], [], False
+    for ch in text:
+        if ch == "'":
+            in_str = not in_str
+        if ch == ";" and not in_str:
+            stmt = "".join(buf).strip()
+            if stmt:
+                out.append(stmt)
+            buf = []
+        else:
+            buf.append(ch)
+    tail = "".join(buf).strip()
+    if tail:
+        out.append(tail)
+    return out
+
+
+def _fmt_cell(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _run_case(name: str) -> str:
+    db = GreptimeDB()
+    lines = []
+    try:
+        path = os.path.join(GOLDEN_DIR, name + ".sql")
+        with open(path) as f:
+            text = f.read()
+        for stmt in _split_statements(text):
+            lines.append(f">> {stmt}")
+            try:
+                res = db.sql(stmt)
+                if res.column_names:
+                    lines.append("| " + " | ".join(res.column_names) + " |")
+                    for row in res.rows:
+                        lines.append(
+                            "| " + " | ".join(_fmt_cell(v) for v in row)
+                            + " |"
+                        )
+                else:
+                    lines.append(f"OK affected={res.affected_rows}")
+            except Exception as e:  # noqa: BLE001 — errors ARE the golden
+                lines.append(f"ERROR[{type(e).__name__}]")
+            lines.append("")
+    finally:
+        db.close()
+    return "\n".join(lines).rstrip() + "\n"
+
+
+_NUM = re.compile(r"^-?\d+(\.\d+)?([eE][+-]?\d+)?$")
+
+
+def _rows_match(got: str, want: str) -> bool:
+    """Line-by-line compare; numeric cells at 1e-5 relative tolerance."""
+    glines = got.splitlines()
+    wlines = want.splitlines()
+    if len(glines) != len(wlines):
+        return False
+    for g, w in zip(glines, wlines):
+        if g == w:
+            continue
+        gc = [c.strip() for c in g.strip("|").split("|")]
+        wc = [c.strip() for c in w.strip("|").split("|")]
+        if len(gc) != len(wc):
+            return False
+        for a, b in zip(gc, wc):
+            if a == b:
+                continue
+            if _NUM.match(a) and _NUM.match(b):
+                fa, fb = float(a), float(b)
+                if abs(fa - fb) <= 1e-5 * max(1.0, abs(fb)):
+                    continue
+            return False
+    return True
+
+
+@pytest.mark.parametrize("name", _cases())
+def test_golden(name):
+    got = _run_case(name)
+    rpath = os.path.join(GOLDEN_DIR, name + ".result")
+    if UPDATE or not os.path.exists(rpath):
+        with open(rpath, "w") as f:
+            f.write(got)
+        if UPDATE:
+            pytest.skip("golden updated")
+        pytest.fail(f"golden {name}.result was missing; generated — review it")
+    with open(rpath) as f:
+        want = f.read()
+    assert _rows_match(got, want), (
+        f"golden mismatch for {name}\n--- got ---\n{got}\n--- want ---\n{want}"
+    )
