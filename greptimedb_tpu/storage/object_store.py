@@ -41,7 +41,9 @@ class FsObjectStore(ObjectStore):
 
     def _abs(self, path: str) -> str:
         p = os.path.normpath(os.path.join(self.root, path.lstrip("/")))
-        if not p.startswith(self.root):
+        # commonpath, not startswith: '../rootB' must not pass for root
+        # '/x/root' just because the string prefix matches
+        if os.path.commonpath([p, self.root]) != self.root:
             raise ValueError(f"path escapes store root: {path}")
         return p
 

@@ -617,9 +617,12 @@ class RegionEngine:
 
     def __init__(self, data_home: str,
                  default_options: RegionOptions | None = None,
-                 log_store_factory=None):
+                 log_store_factory=None,
+                 store: "ObjectStore | None" = None):
         self.data_home = data_home
-        self.store = FsObjectStore(data_home)
+        # default: local disk; pass an S3ObjectStore (storage/s3.py) for
+        # cloud storage — WAL stays local/remote-broker either way
+        self.store = store if store is not None else FsObjectStore(data_home)
         self.default_options = default_options or RegionOptions()
         self.regions: dict[int, Region] = {}
         # region_id -> LogStore; None = node-local file WAL.  A remote

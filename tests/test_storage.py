@@ -943,3 +943,152 @@ class TestRemoteWal:
         w.append(2, b"y")
         assert list(w.replay(1)) == [(1, b"x"), (2, b"y")]
         b.close()
+
+
+class TestS3ObjectStore:
+    @pytest.fixture
+    def s3(self, tmp_path):
+        from greptimedb_tpu.storage.s3 import MockS3Server, S3ObjectStore
+
+        server = MockS3Server()
+        store = S3ObjectStore(
+            server.endpoint, "testbucket",
+            access_key="AKIATEST", secret_key="secret",
+            cache_dir=str(tmp_path / "s3cache"),
+        )
+        yield server, store
+        server.stop()
+
+    def test_crud_and_list(self, s3):
+        _server, store = s3
+        store.write("a/b.bin", b"\x00\x01hello")
+        assert store.exists("a/b.bin")
+        assert store.read("a/b.bin") == b"\x00\x01hello"
+        store.write("a/c.bin", b"x")
+        assert store.list("a") == ["a/b.bin", "a/c.bin"]
+        store.delete("a/b.bin")
+        assert not store.exists("a/b.bin")
+        assert store.list("a") == ["a/c.bin"]
+
+    def test_sigv4_required(self, s3):
+        import urllib.request
+
+        server, store = s3
+        # unsigned requests are rejected by the mock (auth is real-ish)
+        req = urllib.request.Request(
+            server.endpoint + "/testbucket/a", method="GET")
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 403
+
+    def test_write_through_cache_serves_local_path(self, s3, tmp_path):
+        import os
+
+        _server, store = s3
+        store.write("sst/1.parquet", b"PARQUET-BYTES")
+        lp = store.local_path("sst/1.parquet")
+        assert lp and os.path.exists(lp)
+        with open(lp, "rb") as f:
+            assert f.read() == b"PARQUET-BYTES"
+        # cold cache: fetch-on-demand populates it
+        cold = type(store)(
+            store.endpoint, store.bucket,
+            access_key=store.access_key, secret_key=store.secret_key,
+            cache_dir=str(tmp_path / "cold_cache"),
+        )
+        lp2 = cold.local_path("sst/1.parquet")
+        assert lp2 and open(lp2, "rb").read() == b"PARQUET-BYTES"
+
+    def test_region_lifecycle_on_s3(self, s3, tmp_path):
+        """Full LSM lifecycle (write -> flush -> SST -> scan -> compact ->
+        reopen) against the S3 protocol."""
+        _server, store = s3
+        eng = RegionEngine(str(tmp_path / "home"), store=store)
+        r = eng.create_region(1, cpu_schema())
+        write_rows(r, 10)
+        r.flush()
+        write_rows(r, 10, t0=100_000)
+        r.flush()
+        assert len(r.sst_files) == 2
+        host = r.scan_host()
+        assert len(host["ts"]) == 20
+        r.compact()
+        assert len(r.sst_files) == 1
+        # reopen from S3 via a fresh engine (separate cache dir = cold)
+        eng2 = RegionEngine(str(tmp_path / "home2"), store=store)
+        r2 = eng2.open_region(1, take_ownership=False)
+        assert len(r2.scan_host()["ts"]) == 20
+        eng2.close()
+        eng.close()
+
+    def test_standalone_sql_on_s3(self, s3, tmp_path):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        _server, store = s3
+        db = GreptimeDB(str(tmp_path / "db_home"))
+        # swap the storage backend before any table exists
+        db.regions.store = store
+        db.sql("CREATE TABLE s3t (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO s3t VALUES ('a',1000,1.0),('b',2000,2.0)")
+        db._region_of("s3t").flush()
+        assert db.sql("SELECT sum(v) FROM s3t").rows == [[3.0]]
+        db.close()
+
+    def test_relative_cache_dir_and_escape_guard(self, s3, tmp_path):
+        import os
+
+        server, _ = s3
+        from greptimedb_tpu.storage.s3 import S3ObjectStore
+
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            rel = S3ObjectStore(server.endpoint, "testbucket",
+                                access_key="k", secret_key="s",
+                                cache_dir="relcache")
+            rel.write("x/y", b"data")  # must not raise
+            assert rel.read("x/y") == b"data"
+        finally:
+            os.chdir(cwd)
+        abs_store = S3ObjectStore(server.endpoint, "testbucket",
+                                  access_key="k", secret_key="s",
+                                  cache_dir=str(tmp_path / "cacheA"))
+        with pytest.raises(ValueError):
+            abs_store._cache_path("../cacheA2/evil")
+
+    def test_list_pagination(self, s3, monkeypatch):
+        """ListObjectsV2 truncation must be followed via continuation."""
+        _server, store = s3
+        for i in range(7):
+            store.write(f"pg/{i:02d}.bin", b"x")
+        # simulate a 3-keys-per-page server by intercepting _request
+        real = store._request
+        import urllib.parse as up
+
+        def paged(method, key="", query="", payload=b""):
+            if "list-type" not in query:
+                return real(method, key, query, payload)
+            q = dict(up.parse_qsl(query))
+            start = int(q.get("continuation-token", 0))
+            status, body = real(method, key,
+                                up.urlencode({"list-type": "2",
+                                              "prefix": q["prefix"]}))
+            import re as _re
+
+            keys = _re.findall(r"<Key>(.*?)</Key>", body.decode())
+            page = keys[start:start + 3]
+            trunc = start + 3 < len(keys)
+            xml = "<ListBucketResult>" + "".join(
+                f"<Contents><Key>{k}</Key></Contents>" for k in page
+            ) + f"<IsTruncated>{str(trunc).lower()}</IsTruncated>"
+            if trunc:
+                xml += f"<NextContinuationToken>{start+3}</NextContinuationToken>"
+            xml += "</ListBucketResult>"
+            return 200, xml.encode()
+
+        store._request = paged
+        assert len(store.list("pg")) == 7
+        store._request = real
