@@ -34,6 +34,7 @@ _ARROW_TYPES = {
     ConcreteDataType.STRING: pa.utf8(),
     ConcreteDataType.BINARY: pa.binary(),
     ConcreteDataType.JSON: pa.utf8(),
+    ConcreteDataType.VECTOR: pa.utf8(),
     ConcreteDataType.DATE: pa.date32(),
     ConcreteDataType.TIMESTAMP_SECOND: pa.timestamp("s"),
     ConcreteDataType.TIMESTAMP_MILLISECOND: pa.timestamp("ms"),

@@ -92,7 +92,10 @@ class ConcreteDataType(enum.Enum):
 
     @property
     def is_string_like(self) -> bool:
-        return self in (ConcreteDataType.STRING, ConcreteDataType.BINARY, ConcreteDataType.JSON)
+        # VECTOR stores its textual form ('[1.0,2.0]') host-side; the device
+        # path decodes the dictionary to an [V, dim] f32 tensor for search
+        return self in (ConcreteDataType.STRING, ConcreteDataType.BINARY,
+                        ConcreteDataType.JSON, ConcreteDataType.VECTOR)
 
     # ---- host/device dtype mapping --------------------------------------
     def to_numpy(self) -> np.dtype:
@@ -143,6 +146,9 @@ class ConcreteDataType(enum.Enum):
         key = name.strip().upper().replace(" ", "")
         if key in _SQL_ALIASES:
             return _SQL_ALIASES[key]
+        base = key.split("(")[0]
+        if base == "VECTOR":  # VECTOR(dim) — dim is advisory host-side
+            return ConcreteDataType.VECTOR
         raise ValueError(f"Unknown data type: {name!r}")
 
     def default_value(self):

@@ -20,6 +20,7 @@ import numpy as np
 
 from greptimedb_tpu.datatypes.batch import DictionaryEncoder
 from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.datatypes.types import ConcreteDataType
 from greptimedb_tpu.errors import ColumnNotFound, PlanError, Unsupported
 from greptimedb_tpu.ops.time import date_trunc_bucket, time_bucket
 from greptimedb_tpu.query.ast import (
@@ -528,10 +529,130 @@ def compile_device(e: Expr, ctx: TableContext):
     raise Unsupported(f"cannot compile {type(e).__name__} for device")
 
 
+VEC_FUNCS = ("vec_cos_distance", "vec_l2sq_distance", "vec_dot_product")
+
+
+def _parse_vec(text: str) -> "np.ndarray | None":
+    import numpy as _np
+
+    t = text.strip()
+    if not (t.startswith("[") and t.endswith("]")):
+        return None
+    try:
+        return _np.asarray(
+            [float(x) for x in t[1:-1].split(",") if x.strip()],
+            dtype=_np.float32,
+        )
+    except ValueError:
+        return None
+
+
+def _vocab_distances(name: str, terms: list, q: "np.ndarray") -> "np.ndarray":
+    """Distances from q to every DISTINCT vector term — computed with jnp
+    so the matmul runs on the accelerator; invalid terms → NaN."""
+    mat = np.zeros((max(len(terms), 1), q.shape[0]), dtype=np.float32)
+    valid = np.zeros(max(len(terms), 1), dtype=bool)
+    for i, term in enumerate(terms):
+        v = _parse_vec(str(term)) if term is not None else None
+        if v is not None and v.shape == q.shape:
+            mat[i] = v
+            valid[i] = True
+    M = jnp.asarray(mat)
+    qd = jnp.asarray(q)
+    if name == "vec_dot_product":
+        d = M @ qd
+    elif name == "vec_l2sq_distance":
+        d = jnp.sum((M - qd[None, :]) ** 2, axis=1)
+    else:  # cosine distance
+        denom = jnp.linalg.norm(M, axis=1) * jnp.linalg.norm(qd)
+        d = 1.0 - (M @ qd) / jnp.maximum(denom, 1e-30)
+    return np.where(valid, np.asarray(d, dtype=np.float64), np.nan)
+
+
+def _compile_vec_distance(e: FuncCall, ctx: TableContext):
+    """TPU-native vector search: NO index structure.  The reference uses a
+    usearch HNSW graph (src/index/src/vector/, RFC 2025-12-05-vector-index)
+    because CPUs need sublinear candidate sets; on the MXU, exact
+    brute-force distance over every DISTINCT vector is one small matmul
+    (1M x 128 dims ~ 0.3 GFLOP/query), so the 'index' is simply the
+    dictionary the resident table already keeps: distances compute once
+    per distinct vector on device and gather to rows by code."""
+    import numpy as _np
+
+    args = list(e.args)
+    if len(args) != 2:
+        raise PlanError(f"{e.name}(column, '[...]') takes two arguments")
+    col = next((a for a in args if isinstance(a, Column)), None)
+    lit = next((a for a in args if isinstance(a, Literal)), None)
+    if col is None or lit is None or not isinstance(lit.value, str):
+        raise Unsupported(f"{e.name} needs a vector column and a literal")
+    real = ctx.resolve(col.name)
+    if ctx.schema.column(real).dtype is not ConcreteDataType.VECTOR:
+        raise PlanError(f"{e.name}: {col.name} is not a VECTOR column")
+    vocab = getattr(ctx, "table_dicts", {}).get(real)
+    if vocab is None:
+        raise Unsupported(f"{e.name}: vector column not resident")
+    q = _parse_vec(lit.value)
+    if q is None:
+        raise PlanError(f"{e.name}: bad vector literal {lit.value!r}")
+    d = jnp.asarray(_vocab_distances(e.name, vocab, q), dtype=jnp.float32)
+
+    def fn(env, col_name=real, dist=d):
+        codes = env[col_name]
+        safe = jnp.clip(codes, 0, dist.shape[0] - 1)
+        return jnp.where(codes >= 0, dist[safe], jnp.nan)
+
+    return fn
+
+
+FT_FUNCS = ("matches", "matches_term")
+
+
+def _ft_pred(name: str, query: str):
+    from greptimedb_tpu.storage.index import ft_predicate
+
+    return ft_predicate(name, query)
+
+
+def _compile_ft_match(e: FuncCall, ctx: TableContext):
+    """Full-text match over a string column: the predicate evaluates once
+    per DISTINCT term (dictionary vocabulary), then gathers to rows by
+    code on device — same shape as the inverted-index matcher path."""
+    args = list(e.args)
+    if len(args) != 2:
+        raise PlanError(f"{e.name}(column, 'query') takes two arguments")
+    col = next((a for a in args if isinstance(a, Column)), None)
+    lit = next((a for a in args if isinstance(a, Literal)), None)
+    if col is None or lit is None or not isinstance(lit.value, str):
+        raise Unsupported(f"{e.name} needs a string column and a literal")
+    real = ctx.resolve(col.name)
+    vocab = getattr(ctx, "table_dicts", {}).get(real)
+    if vocab is None:
+        enc = ctx.encoders.get(real)  # tag column: region dictionary
+        if enc is None:
+            raise Unsupported(f"{e.name}: column {col.name} has no dictionary")
+        vocab = enc.values()
+    pred = _ft_pred(e.name, lit.value)
+    hits = jnp.asarray(
+        np.asarray([bool(pred(str(t))) for t in vocab], dtype=bool)
+    )
+
+    def fn(env, col_name=real, h=hits):
+        codes = env[col_name]
+        safe = jnp.clip(codes, 0, h.shape[0] - 1)
+        return jnp.where(codes >= 0, h[safe], False)
+
+    return fn
+
+
 def compile_device_func(e: FuncCall, ctx: TableContext):
     name = e.name
     if name in AGG_FUNCS:
         raise PlanError(f"aggregate {name} in scalar context")
+    if name in VEC_FUNCS:
+        return _compile_vec_distance(e, ctx)
+    if name in FT_FUNCS:
+        return _compile_ft_match(e, ctx)
     if name == "date_bin":
         if len(e.args) < 2:
             raise PlanError("date_bin(interval, ts)")
@@ -634,6 +755,38 @@ def eval_host(e: Expr, env: dict[str, np.ndarray], n: int):
             return table[e.name](np.asarray(args[0], dtype=float))
         if e.name in _HOST_FUNCS:
             return _HOST_FUNCS[e.name](args, n)
+        if e.name in FT_FUNCS:
+            col = next((a for a in e.args if isinstance(a, Column)), None)
+            lit = next((a for a in e.args if isinstance(a, Literal)), None)
+            if col is None or lit is None or not isinstance(lit.value, str):
+                raise Unsupported(f"{e.name} needs a column and a literal")
+            pred = _ft_pred(e.name, lit.value)
+            vals = np.asarray(eval_host(col, env, n), dtype=object)
+            uniq, inv = np.unique(
+                np.array(["" if v is None else str(v) for v in vals],
+                         dtype=object),
+                return_inverse=True,
+            )
+            hits = np.asarray([pred(str(u)) for u in uniq], dtype=bool)
+            return hits[inv]
+        if e.name in VEC_FUNCS:
+            # raw-scan projection: distances over DISTINCT vectors compute
+            # via jnp (device matmul); per-row values gather host-side
+            col = next((a for a in e.args if isinstance(a, Column)), None)
+            lit = next((a for a in e.args if isinstance(a, Literal)), None)
+            if col is None or lit is None or not isinstance(lit.value, str):
+                raise Unsupported(f"{e.name} needs a column and a literal")
+            q = _parse_vec(lit.value)
+            if q is None:
+                raise PlanError(f"{e.name}: bad vector literal")
+            vals = np.asarray(eval_host(col, env, n), dtype=object)
+            uniq, inv = np.unique(
+                np.array(["" if v is None else str(v) for v in vals],
+                         dtype=object),
+                return_inverse=True,
+            )
+            dists = _vocab_distances(e.name, list(uniq), q)
+            return dists[inv]
         raise Unsupported(f"host function {e.name}")
     if isinstance(e, UnaryOp):
         v = eval_host(e.operand, env, n)

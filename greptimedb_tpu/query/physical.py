@@ -42,6 +42,17 @@ DISPATCH_STATS = {"sorted": 0, "scatter": 0}
 _I64_MAX = np.int64(np.iinfo(np.int64).max)
 
 
+def _vec_fingerprint(plan, table) -> int:
+    """Vector-search and full-text kernels bake dictionary-derived
+    constants into the compiled program — key them on the table's
+    monotonic dicts_version (O(1)) so a rebuilt/extended table never
+    reuses a kernel compiled against stale dictionaries."""
+    fp = plan.fingerprint()
+    if "vec_" not in fp and "matches" not in fp:
+        return 0
+    return getattr(table, "dicts_version", 0)
+
+
 def decode_codes(values: list, raw: np.ndarray, null=None) -> np.ndarray:
     """Dictionary codes → values (object array); out-of-range/poisoned
     codes become ``null``.  The one decode path for tag and string-field
@@ -94,6 +105,7 @@ class Executor:
         self, plan: SelectPlan, table: DeviceTable, ts_bounds: tuple[int, int]
     ) -> tuple[dict[str, np.ndarray], int]:
         ctx = plan.ctx
+        ctx.table_dicts = table.dicts  # vector search / string-dict exprs
         ts_name = ctx.schema.time_index.name if ctx.schema.time_index else None
 
         key_specs: list[tuple] = []
@@ -194,7 +206,7 @@ class Executor:
         dict_ver = tuple(len(ctx.encoders[c.name]) for c in ctx.schema.tag_columns)
         cache_key = (
             plan.fingerprint(), padded, tuple(cards), dense_ok, num_groups,
-            dict_ver, lo, hi, use_sorted,
+            dict_ver, lo, hi, use_sorted, _vec_fingerprint(plan, table),
             tuple(spec[1] if spec[0] == "time" else spec[0:2] for spec in key_specs if spec[0] != "expr"),
         )
         kernel = self._cache.get(cache_key)
@@ -505,6 +517,7 @@ class Executor:
         self, plan: SelectPlan, table: DeviceTable
     ) -> tuple[dict[str, np.ndarray], int]:
         ctx = plan.ctx
+        ctx.table_dicts = table.dicts  # vector search / string-dict exprs
         ts_name = ctx.schema.time_index.name if ctx.schema.time_index else None
         where_fn = compile_device(plan.where, ctx) if plan.where is not None else None
         lo, hi = plan.time_range
@@ -523,7 +536,7 @@ class Executor:
         dict_ver = tuple(len(ctx.encoders[c.name]) for c in ctx.schema.tag_columns)
         cache_key = (
             "raw", plan.fingerprint(), table.padded_rows, tuple(cols), dict_ver,
-            lo, hi,
+            lo, hi, _vec_fingerprint(plan, table),
         )
         kernel = self._cache.get(cache_key)
         if kernel is None:

@@ -74,6 +74,13 @@ def _match(cond: dict, values: np.ndarray) -> np.ndarray:
         except re.error as e:
             raise InvalidArguments(f"bad regex {cond['regex']!r}: {e}") from None
         return np.array([rx.search(s) is not None for s in strs], dtype=bool)
+    if "match" in cond:
+        # full-text match (shared semantics with SQL matches(); empty-token
+        # queries match nothing)
+        from greptimedb_tpu.storage.index import ft_predicate
+
+        pred = ft_predicate("matches", str(cond["match"]))
+        return np.array([pred(s) for s in strs], dtype=bool)
     if "eq" in cond:
         return np.asarray(strs == str(cond["eq"]), dtype=bool).reshape(n)
     if "exists" in cond:
@@ -123,8 +130,26 @@ def execute_log_query(db, query: dict) -> QueryResult:
         c: (lambda t, ps=tuple(ps): all(p(t) for p in ps))
         for c, ps in per_col.items() if ps
     }
+    # full-text "match" filters on string FIELD columns prune SST files
+    # via the sidecar token sets
+    from greptimedb_tpu.storage.index import tokenize
+
+    from greptimedb_tpu.datatypes.types import ConcreteDataType as _CDT
+
+    ft_tokens: dict[str, list] = {}
+    field_cols = {c.name for c in view.schema.field_columns
+                  if c.dtype in (_CDT.STRING, _CDT.JSON)}
+    for f in query.get("filters") or []:
+        col = f.get("column")
+        if col in field_cols:
+            for cond in f.get("filters") or []:
+                if "match" in cond:
+                    ft_tokens.setdefault(col, []).extend(
+                        tokenize(str(cond["match"]))
+                    )
     host = view.scan_host((lo, hi), columns=want,
-                          tag_preds=tag_preds or None)
+                          tag_preds=tag_preds or None,
+                          ft_tokens=ft_tokens or None)
     n = len(host[ts_name])
     keep = np.ones(n, dtype=bool)
     for f in query.get("filters") or []:

@@ -159,14 +159,15 @@ class CombinedRegionView:
         self._built_for = gen
 
     def scan_host(self, ts_range=(None, None), columns=None, tag_filters=None,
-                  tag_preds=None):
+                  tag_preds=None, ft_tokens=None):
         import numpy as np
 
         from greptimedb_tpu.storage.memtable import SEQ, TSID
         from greptimedb_tpu.storage.region import Region
 
         self._refresh()
-        parts = [r.scan_host(ts_range, columns, tag_filters, tag_preds)
+        parts = [r.scan_host(ts_range, columns, tag_filters, tag_preds,
+                             ft_tokens)
                  for r in self.regions]
         names = list(parts[0].keys())
         merged = {k: np.concatenate([p[k] for p in parts]) for k in names}

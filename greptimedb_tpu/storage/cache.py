@@ -28,6 +28,9 @@ from greptimedb_tpu.storage.memtable import SEQ, TSID
 from greptimedb_tpu.storage.region import Region
 
 
+_DICTS_VERSION = 0  # process-wide monotonic dict-content version
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class DeviceTable:
@@ -45,6 +48,9 @@ class DeviceTable:
     # tag columns whose codes are nondecreasing in row order — unlocks the
     # scatter-free sorted segment reduction in the query executor
     sorted_tags: tuple = ()
+    # monotonic per-build version of ``dicts``: kernels that bake dict-
+    # derived constants (vector/fulltext) key their cache on it
+    dicts_version: int = 0
 
     @property
     def padded_rows(self) -> int:
@@ -64,15 +70,16 @@ class DeviceTable:
             self.num_series,
             tuple((k, tuple(v)) for k, v in sorted(self.dicts.items())),
             tuple(self.sorted_tags),
+            self.dicts_version,
         )
         return children, aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        names, num_series, dict_items, sorted_tags = aux
+        names, num_series, dict_items, sorted_tags, dver = aux
         cols = dict(zip(names, children[:-1]))
         return cls(cols, children[-1], num_series,
-                   {k: list(v) for k, v in dict_items}, sorted_tags)
+                   {k: list(v) for k, v in dict_items}, sorted_tags, dver)
 
 
 def _canonical_column(
@@ -165,8 +172,10 @@ def build_device_table(
                 d = np.diff(codes)
                 if bool((d >= 0).all()) and 1 + int((d != 0).sum()) == tsid_runs:
                     sorted_tags.append(c.name)
+    global _DICTS_VERSION
+    _DICTS_VERSION += 1
     return DeviceTable(dev_cols, jnp.asarray(mask), region.num_series, dicts,
-                       tuple(sorted_tags))
+                       tuple(sorted_tags), _DICTS_VERSION)
 
 
 def _canonical_delta(
@@ -246,8 +255,11 @@ def extend_device_table(
     sorted_tags = (
         table.sorted_tags if region.num_series == table.num_series else ()
     )
+    global _DICTS_VERSION
+    _DICTS_VERSION += 1
     return (
-        DeviceTable(cols, mask, region.num_series, dicts, sorted_tags),
+        DeviceTable(cols, mask, region.num_series, dicts, sorted_tags,
+                    _DICTS_VERSION),
         n_new,
     )
 

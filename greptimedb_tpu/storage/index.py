@@ -75,7 +75,23 @@ class BloomFilter:
 # Term dictionaries above this cardinality are dropped from the sidecar
 # (the bloom still covers equality); bounds sidecar size on high-churn tags.
 VOCAB_LIMIT = 4096
+# distinct TOKENS per string-FIELD column kept for full-text pruning
+TOKEN_LIMIT = 65536
 _MAGIC2 = b"GTIX2\n"
+
+_TOKEN_RE = None
+
+
+def tokenize(text: str) -> list[str]:
+    """Lowercase word tokens (the reference's fulltext default analyzer —
+    tantivy's simple tokenizer — is the same split-on-non-alnum+lowercase;
+    src/index/src/fulltext_index/)."""
+    global _TOKEN_RE
+    if _TOKEN_RE is None:
+        import re
+
+        _TOKEN_RE = re.compile(r"[A-Za-z0-9_]+")
+    return [t.lower() for t in _TOKEN_RE.findall(text)]
 
 
 class ColumnIndex:
@@ -89,6 +105,8 @@ class ColumnIndex:
         self.bloom = bloom
         self.vocab = vocab
         self._vset = set(vocab) if vocab is not None else None
+        self.tokens: set[str] | None = None  # fulltext token set
+        self.has_tombstones = False  # file holds delete rows
 
     def may_contain(self, value) -> bool:
         if self._vset is not None:
@@ -103,11 +121,16 @@ class ColumnIndex:
         return any(pred(t) for t in self.vocab)
 
 
-def build_sst_index(columns: dict[str, np.ndarray], tag_names: list[str]) -> bytes:
-    """Serialize per-tag-column blooms + term dicts for one SST (the
-    puffin blob, reference src/puffin/)."""
+def build_sst_index(columns: dict[str, np.ndarray], tag_names: list[str],
+                    fulltext_columns: list[str] | None = None,
+                    has_tombstones: bool = False) -> bytes:
+    """Serialize per-tag-column blooms + term dicts, plus per-fulltext-
+    column token sets, for one SST (the puffin blob, reference
+    src/puffin/; fulltext backend = the reference's bloom-based variant,
+    src/index/src/fulltext_index/)."""
     blobs: dict[str, bytes] = {}
     vocabs: dict[str, list[str]] = {}
+    tokens: dict[str, list[str]] = {}
     for name in tag_names:
         if name not in columns:
             continue
@@ -118,9 +141,23 @@ def build_sst_index(columns: dict[str, np.ndarray], tag_names: list[str]) -> byt
         blobs[name] = bf.to_bytes()
         if len(uniq) <= VOCAB_LIMIT:
             vocabs[name] = [str(v) for v in uniq]
+    for name in fulltext_columns or ():
+        if name not in columns:
+            continue
+        toks: set[str] = set()
+        for v in columns[name]:
+            if v is None:
+                continue
+            toks.update(tokenize(str(v)))
+            if len(toks) > TOKEN_LIMIT:
+                break
+        if len(toks) <= TOKEN_LIMIT:
+            tokens[name] = sorted(toks)
     header = json.dumps({
         "blooms": {name: len(b) for name, b in blobs.items()},
         "vocabs": vocabs,
+        "tokens": tokens,
+        "tombstones": bool(has_tombstones),
     }).encode("utf-8")
     out = _MAGIC2 + struct.pack("<I", len(header)) + header
     for name in sorted(blobs):
@@ -142,6 +179,14 @@ def load_sst_index(raw: bytes) -> dict[str, ColumnIndex]:
                 header["vocabs"].get(name),
             )
             off += ln
+        for name, toks in header.get("tokens", {}).items():
+            ci = out.get(name)
+            if ci is None:
+                ci = out[name] = ColumnIndex(BloomFilter(64))
+            ci.tokens = set(toks)
+        if header.get("tombstones"):
+            for ci in out.values():
+                ci.has_tombstones = True
         return out
     if not raw.startswith(_MAGIC):
         raise ValueError("bad index blob magic")
@@ -182,3 +227,45 @@ def sst_pred_may_match(
     if ci is None:
         return True
     return ci.any_term_matches(pred)
+
+
+def ft_predicate(name: str, query: str):
+    """matches = AND of query tokens; matches_term = the query's token
+    SEQUENCE appears consecutively (exact-term semantics for terms with
+    non-alnum separators like 'v1.0').  Empty-token queries match NOTHING
+    — a filter must never silently select everything.  The ONE definition
+    of full-text semantics (SQL functions, log-query DSL, pruning)."""
+    qtokens = tokenize(query)
+    if not qtokens:
+        return lambda text: False
+    if name == "matches_term":
+        k = len(qtokens)
+
+        def term_pred(text: str) -> bool:
+            toks = tokenize(text)
+            return any(
+                toks[i:i + k] == qtokens for i in range(len(toks) - k + 1)
+            )
+
+        return term_pred
+
+    qset = set(qtokens)
+
+    def pred(text: str) -> bool:
+        return qset.issubset(tokenize(text))
+
+    return pred
+
+
+def sst_tokens_may_match(
+    index: dict[str, ColumnIndex], column: str, query_tokens: list[str]
+) -> bool:
+    """Full-text file pruning: False only when the token set proves some
+    query token appears NOWHERE in the column (AND semantics).  Files
+    containing tombstones are NEVER pruned: a delete row's fields are
+    null, so its tokens are absent, yet the merge must see it or deleted
+    rows resurrect."""
+    ci = index.get(column)
+    if ci is None or ci.tokens is None or ci.has_tombstones:
+        return True
+    return all(t in ci.tokens for t in query_tokens)

@@ -209,14 +209,15 @@ class LogicalMetricView:
         self._built_for = gen
 
     def scan_host(self, ts_range=(None, None), columns=None, tag_filters=None,
-                  tag_preds=None):
+                  tag_preds=None, ft_tokens=None):
         self._refresh()
         filters = dict(tag_filters or {})
         filters[METRIC_COLUMN] = {self.metric}
         want = None
         if columns is not None:
             want = list(dict.fromkeys(list(columns) + [METRIC_COLUMN]))
-        host = self.physical.scan_host(ts_range, want, filters, tag_preds)
+        host = self.physical.scan_host(ts_range, want, filters, tag_preds,
+                                       ft_tokens)
         sel = host[METRIC_COLUMN] == self.metric  # vectorized object-eq
         from greptimedb_tpu.storage.memtable import TSID
 

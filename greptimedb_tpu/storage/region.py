@@ -501,10 +501,23 @@ class Region:
         from greptimedb_tpu.storage.index import build_sst_index
 
         tag_names = self.tag_names
-        if not tag_names:
+        from greptimedb_tpu.datatypes.types import ConcreteDataType
+
+        # full-text token sets for textual FIELD columns (log lines): the
+        # bloom-based fulltext backend's file-pruning tier.  VECTOR/BINARY
+        # are string-like in storage but tokenizing them is pure waste.
+        ft_cols = [
+            c.name for c in self.schema.field_columns
+            if c.dtype in (ConcreteDataType.STRING, ConcreteDataType.JSON)
+            and c.name in columns
+        ]
+        if not tag_names and not ft_cols:
             return
+        has_tomb = bool((columns[OP] == OP_DELETE).any()) if OP in columns else False
         self.store.write(
-            self._index_path(meta), build_sst_index(columns, tag_names)
+            self._index_path(meta),
+            build_sst_index(columns, tag_names, fulltext_columns=ft_cols,
+                            has_tombstones=has_tomb),
         )
 
     def _sst_index(self, meta) -> dict | None:
@@ -526,6 +539,7 @@ class Region:
         columns: list[str] | None = None,
         tag_filters: dict[str, set] | None = None,
         tag_preds: dict[str, object] | None = None,
+        ft_tokens: dict[str, list] | None = None,
     ) -> dict[str, np.ndarray]:
         """Merged, deduped host columns for the requested time range.
 
@@ -538,9 +552,11 @@ class Region:
         regex matchers) used for FILE-LEVEL pruning only, via the sidecar's
         exact term dictionary (inverted-index analog) — the caller still
         applies the predicate row-wise to the returned columns.
+        ``ft_tokens`` maps string-FIELD columns to full-text query tokens
+        (AND semantics) pruned against the sidecar token sets.
         """
         from greptimedb_tpu.storage.index import (
-            sst_may_match, sst_pred_may_match,
+            sst_may_match, sst_pred_may_match, sst_tokens_may_match,
         )
 
         want = None
@@ -551,7 +567,7 @@ class Region:
         for m in self.sst_files:
             if not m.overlaps(*ts_range):
                 continue
-            if tag_filters or tag_preds:
+            if tag_filters or tag_preds or ft_tokens:
                 idx = self._sst_index(m)
                 if idx is not None:
                     if tag_filters and not sst_may_match(idx, tag_filters):
@@ -559,6 +575,11 @@ class Region:
                     if tag_preds and not all(
                         sst_pred_may_match(idx, col, pred)
                         for col, pred in tag_preds.items()
+                    ):
+                        continue
+                    if ft_tokens and not all(
+                        sst_tokens_may_match(idx, col, toks)
+                        for col, toks in ft_tokens.items()
                     ):
                         continue
             parts.append(read_sst(self.store, m, self.schema, ts_range, want,

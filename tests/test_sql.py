@@ -950,3 +950,149 @@ class TestJoinReviewRegressions:
     def test_multi_column_count_distinct_rejected(self, jdb):
         with pytest.raises(Unsupported):
             jdb.sql("SELECT count(DISTINCT host, cpu) FROM metrics")
+
+
+class TestVectorSearch:
+    @pytest.fixture
+    def vdb(self, db):
+        db.sql("CREATE TABLE docs (id STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "emb VECTOR(3), PRIMARY KEY (id))")
+        db.sql("INSERT INTO docs VALUES "
+               "('d1', 1000, '[1.0, 0.0, 0.0]'), "
+               "('d2', 2000, '[0.0, 1.0, 0.0]'), "
+               "('d3', 3000, '[0.7, 0.7, 0.0]')")
+        return db
+
+    def test_cos_topk(self, vdb):
+        r = vdb.sql("SELECT id, vec_cos_distance(emb, '[1.0,0.0,0.0]') AS d "
+                    "FROM docs ORDER BY d LIMIT 2")
+        assert [x[0] for x in r.rows] == ["d1", "d3"]
+        assert r.rows[0][1] == pytest.approx(0.0, abs=1e-6)
+
+    def test_l2_and_dot(self, vdb):
+        r = vdb.sql("SELECT id, vec_l2sq_distance(emb, '[1.0,0.0,0.0]') AS d"
+                    " FROM docs ORDER BY d")
+        assert [x[0] for x in r.rows] == ["d1", "d3", "d2"]
+        r2 = vdb.sql("SELECT id FROM docs "
+                     "ORDER BY vec_dot_product(emb, '[0.0,2.0,0.0]') DESC "
+                     "LIMIT 1")
+        assert r2.rows == [["d2"]]
+
+    def test_vector_where_device_path(self, vdb):
+        assert vdb.sql(
+            "SELECT count(*) FROM docs "
+            "WHERE vec_l2sq_distance(emb, '[1.0,0.0,0.0]') < 0.6"
+        ).rows == [[2]]
+
+    def test_vector_survives_flush_reopen(self, vdb, tmp_path):
+        vdb._region_of("docs").flush()
+        r = vdb.sql("SELECT id FROM docs "
+                    "ORDER BY vec_cos_distance(emb, '[0.0,1.0,0.0]') LIMIT 1")
+        assert r.rows == [["d2"]]
+
+    def test_bad_literal_errors(self, vdb):
+        with pytest.raises(PlanError):
+            vdb.sql("SELECT vec_cos_distance(emb, 'nope') FROM docs")
+
+
+class TestFullTextSearch:
+    @pytest.fixture
+    def ldb(self, db):
+        db.sql("CREATE TABLE logs (app STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "line STRING, PRIMARY KEY (app))")
+        db.sql("INSERT INTO logs VALUES "
+               "('web', 1000, 'GET /api 200 OK'), "
+               "('web', 2000, 'connection TIMEOUT to db'), "
+               "('web', 3000, 'Error: timeout waiting for lock')")
+        return db
+
+    def test_matches_and_matches_term(self, ldb):
+        assert ldb.sql("SELECT ts FROM logs WHERE matches(line, 'timeout') "
+                       "ORDER BY ts").rows == [[2000], [3000]]
+        # AND semantics across tokens, case-insensitive
+        assert ldb.sql("SELECT count(*) FROM logs "
+                       "WHERE matches(line, 'timeout error')").rows == [[1]]
+        assert ldb.sql("SELECT count(*) FROM logs "
+                       "WHERE matches_term(line, 'OK')").rows == [[1]]
+        # substring of a token is NOT a token match
+        assert ldb.sql("SELECT count(*) FROM logs "
+                       "WHERE matches_term(line, 'time')").rows == [[0]]
+
+    def test_matches_in_aggregate_query(self, ldb):
+        r = ldb.sql("SELECT app, count(*) FROM logs "
+                    "WHERE matches(line, 'timeout') GROUP BY app")
+        assert r.rows == [["web", 2]]
+
+    def test_logquery_match_prunes_files(self, tmp_data_dir_unused=None):
+        from greptimedb_tpu.servers.logquery import execute_log_query
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB()
+        db.sql("CREATE TABLE lg (app STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "line STRING, PRIMARY KEY (app))")
+        r = db._region_of("lg")
+        r.write({"app": ["a"] * 2, "ts": [1000, 2000],
+                 "line": ["alpha beta", "gamma delta"]})
+        r.flush()
+        r.write({"app": ["a"] * 2, "ts": [3000, 4000],
+                 "line": ["epsilon zeta", "eta theta"]})
+        r.flush()
+
+        import greptimedb_tpu.storage.region as regmod
+
+        reads = []
+        real_read = regmod.read_sst
+
+        def counting(store, meta, *a, **k):
+            reads.append(meta.file_id)
+            return real_read(store, meta, *a, **k)
+
+        regmod.read_sst = counting
+        try:
+            out = execute_log_query(db, {
+                "table": {"table": "lg"},
+                "filters": [{"column": "line",
+                             "filters": [{"match": "epsilon"}]}],
+            })
+            assert len(out.rows) == 1
+            assert len(reads) == 1  # first SST pruned by token set
+        finally:
+            regmod.read_sst = real_read
+        db.close()
+
+    def test_ft_kernel_invalidates_after_insert(self, ldb):
+        """Regression: kernels baking fulltext hit-vectors must not serve
+        stale results after new rows change the dictionary."""
+        assert ldb.sql("SELECT count(*) FROM logs "
+                       "WHERE matches(line, 'timeout')").rows == [[2]]
+        ldb.sql("INSERT INTO logs VALUES ('web', 4000, 'another timeout')")
+        assert ldb.sql("SELECT count(*) FROM logs "
+                       "WHERE matches(line, 'timeout')").rows == [[3]]
+
+    def test_matches_term_with_punctuation(self, ldb):
+        ldb.sql("INSERT INTO logs VALUES ('web', 5000, 'upgraded to v1.0 ok')")
+        assert ldb.sql("SELECT ts FROM logs "
+                       "WHERE matches_term(line, 'v1.0')").rows == [[5000]]
+        # empty-token query matches nothing, not everything
+        assert ldb.sql("SELECT count(*) FROM logs "
+                       "WHERE matches(line, '!!!')").rows == [[0]]
+
+    def test_deleted_rows_not_resurrected_by_token_pruning(self):
+        from greptimedb_tpu.servers.logquery import execute_log_query
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB()
+        db.sql("CREATE TABLE dl (app STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "line STRING, PRIMARY KEY (app))")
+        r = db._region_of("dl")
+        r.write({"app": ["a"], "ts": [1000], "line": ["epsilon zeta"]})
+        r.flush()
+        db.sql("DELETE FROM dl WHERE app = 'a' AND ts = 1000")
+        r.flush()  # tombstone SST (no tokens for 'epsilon')
+        out = execute_log_query(db, {
+            "table": {"table": "dl"},
+            "filters": [{"column": "line",
+                         "filters": [{"match": "epsilon"}]}],
+        })
+        assert len(out.rows) == 0  # not resurrected
+        db.close()
