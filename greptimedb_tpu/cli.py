@@ -103,6 +103,54 @@ def cmd_datanode(args) -> int:
     return 0
 
 
+def cmd_meta(args) -> int:
+    """Metadata snapshot/restore (reference greptime cli metadata
+    snapshot, src/cli/src/metadata/snapshot.rs): dump the entire typed
+    kv key-space to a JSON file, or load one back."""
+    import base64
+
+    from greptimedb_tpu.meta.kv import FileKv
+
+    kv_path = f"{args.data_home}/metadata/kv.json"
+    kv = FileKv(kv_path)
+    if args.action == "snapshot":
+        entries = [
+            {"k": k, "v": base64.b64encode(v).decode()}
+            for k, v in kv.range("")
+        ]
+        with open(args.file, "w") as f:
+            json.dump({"version": 1, "entries": entries}, f)
+        print(f"snapshot: {len(entries)} keys -> {args.file}")
+        return 0
+    with open(args.file) as f:
+        snap = json.load(f)
+    # REPLACE the key-space (a merge would resurrect post-snapshot drops)
+    kv.bulk_replace(
+        {e["k"]: base64.b64decode(e["v"]) for e in snap["entries"]}
+    )
+    print(f"restore: {len(snap['entries'])} keys <- {args.file}")
+    return 0
+
+
+def cmd_gc(args) -> int:
+    """Orphaned-object GC sweep over a data home."""
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from greptimedb_tpu.standalone import GreptimeDB
+
+    db = GreptimeDB(args.data_home)
+    try:
+        deleted = db.regions.gc(grace_seconds=args.grace_seconds)
+        print(f"gc: deleted {len(deleted)} orphaned objects")
+        for p in deleted:
+            print(f"  {p}")
+    finally:
+        db.close()
+    return 0
+
+
 def cmd_sql(args) -> int:
     from greptimedb_tpu.standalone import GreptimeDB
 
@@ -276,6 +324,19 @@ def main(argv: list[str] | None = None) -> int:
                          "self-fencing; without it leader leases self-renew "
                          "on write)")
     pd.set_defaults(fn=cmd_datanode)
+
+    pm = sub.add_parser("meta", help="metadata snapshot / restore")
+    pm.add_argument("action", choices=["snapshot", "restore"])
+    pm.add_argument("--data-home", required=True)
+    pm.add_argument("--file", required=True)
+    pm.set_defaults(fn=cmd_meta)
+
+    pg = sub.add_parser("gc", help="delete orphaned storage objects")
+    pg.add_argument("--data-home", required=True)
+    pg.add_argument("--grace-seconds", type=float, default=3600.0)
+    pg.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu)")
+    pg.set_defaults(fn=cmd_gc)
 
     pq_ = sub.add_parser("sql", help="SQL shell / one-shot query")
     pq_.add_argument("--data-home", required=True)

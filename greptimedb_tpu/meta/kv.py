@@ -23,6 +23,15 @@ class KvBackend:
     def delete(self, key: str) -> bool:
         raise NotImplementedError
 
+    def bulk_replace(self, entries: dict[str, bytes]) -> None:
+        """Replace the ENTIRE key-space with ``entries`` (snapshot
+        restore).  Default: delete-all + put-all; backends override with
+        one-shot persistence."""
+        for k, _v in list(self.range("")):
+            self.delete(k)
+        for k, v in entries.items():
+            self.put(k, v)
+
     def range(self, prefix: str) -> list[tuple[str, bytes]]:
         raise NotImplementedError
 
@@ -100,6 +109,10 @@ class FileKv(MemoryKv):
 
     def put(self, key: str, value: bytes) -> None:
         super().put(key, value)
+        self._persist()
+
+    def bulk_replace(self, entries: dict[str, bytes]) -> None:
+        self._data = dict(entries)
         self._persist()
 
     def delete(self, key: str) -> bool:

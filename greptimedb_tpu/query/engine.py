@@ -309,6 +309,8 @@ class QueryEngine:
                 from greptimedb_tpu.query.ast import Column
 
                 for c in ctx.schema:
+                    if c.name.startswith("__") and c.name.endswith("__"):
+                        continue  # internal (join row ids, engine columns)
                     items.append(SelectItem(Column(c.name)))
             else:
                 items.append(item)

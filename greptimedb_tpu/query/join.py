@@ -174,14 +174,28 @@ def execute_join(engine, sel: Select):
 
     data: dict[str, np.ndarray] = {}
     cols_schema: list[ColumnSchema] = []
-    ts_left = lschema.time_index.name
+    # the staged TIME INDEX is a synthetic unique row id: joined rows can
+    # legitimately share (tags, left ts) — a 1:N join repeats the left row
+    # — and the storage engine's keep-last dedup on (series, time) would
+    # silently collapse them.  Both sides' ts columns become INT64 fields.
+    cols_schema.append(ColumnSchema(
+        "__joinrow__", ConcreteDataType.TIMESTAMP_MILLISECOND,
+        SemanticType.TIMESTAMP, nullable=False,
+    ))
+    data["__joinrow__"] = np.arange(len(li), dtype=np.int64)
     for name, arr in lcols.items():
         out_name = lnames[name]
         data[out_name] = arr[li]
         c = lschema.column(name)
-        semantic = c.semantic if name != ts_left else SemanticType.TIMESTAMP
-        cols_schema.append(dataclasses.replace(c, name=out_name,
-                                               semantic=semantic))
+        semantic = (
+            SemanticType.FIELD
+            if c.semantic is SemanticType.TIMESTAMP
+            else c.semantic
+        )
+        dtype = ConcreteDataType.INT64 if c.dtype.is_timestamp else c.dtype
+        cols_schema.append(dataclasses.replace(
+            c, name=out_name, semantic=semantic, dtype=dtype, nullable=True,
+        ))
     miss = ri < 0
     safe_ri = np.where(miss, 0, ri)
     for name, arr in rcols.items():

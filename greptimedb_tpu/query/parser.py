@@ -639,6 +639,7 @@ class Parser:
             self.expect_kw("AS")
             q = self.select()
             return CreateFlow(name, sink, q, expire, comment, ine)
+        external = self.eat_kw("EXTERNAL")
         if self.eat_kw("TABLE"):
             ine = self._if_not_exists()
             name = self.qualified_name()
@@ -698,7 +699,7 @@ class Parser:
                 if not self.eat(Tok.PUNCT, ","):
                     break
             self.expect(Tok.PUNCT, ")")
-            engine = "mito"
+            engine = "file" if external else "mito"
             options: dict = {}
             partitions: list[str] = []
             partition_columns: list[str] = []

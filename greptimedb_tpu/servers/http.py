@@ -159,6 +159,8 @@ class HttpServer:
         r.add_get("/v1/pipelines", self.h_pipeline_list)
         r.add_post("/v1/ingest", self.h_ingest)
         r.add_get("/health", self.h_health)
+        r.add_route("*", "/debug/log_level", self.h_log_level)
+        r.add_get("/debug/prof/cpu", self.h_prof_cpu)
         r.add_get("/ready", self.h_health)
         r.add_get("/metrics", self.h_metrics)
         r.add_get("/config", self.h_config)
@@ -929,6 +931,70 @@ class HttpServer:
             return web.json_response(body, status=status)
 
     # ---- lifecycle -----------------------------------------------------
+    async def h_log_level(self, request):
+        """Dynamic log level (reference src/servers/src/http/dyn_log.rs:
+        POST /debug/log_level with the new level in the body)."""
+        import logging
+
+        root = logging.getLogger()
+        if request.method in ("POST", "PUT"):
+            level = (await request.text()).strip().upper()
+            if level not in ("DEBUG", "INFO", "WARNING", "WARN", "ERROR",
+                             "CRITICAL"):
+                return web.json_response(
+                    {"error": f"unknown level {level!r}"}, status=400)
+            root.setLevel("WARNING" if level == "WARN" else level)
+        return web.json_response(
+            {"level": logging.getLevelName(root.level)})
+
+    async def h_prof_cpu(self, request):
+        """Statistical CPU profile (reference src/servers/src/http/pprof.rs
+        samples for N seconds and returns a report): samples every thread's
+        stack at ~100Hz for ?seconds=N (default 2), returns aggregated
+        frame counts, hottest first."""
+        import asyncio
+        import collections as _collections
+        import sys as _sys
+        import time as _time
+        import traceback as _traceback
+
+        try:
+            seconds = min(float(request.query.get("seconds", "2")), 30.0)
+        except ValueError:
+            return web.json_response(
+                {"error": "seconds must be a number"}, status=400)
+        if getattr(self, "_profiling", False):
+            return web.json_response(
+                {"error": "a profile is already running"}, status=429)
+        self._profiling = True
+
+        def sample():
+            counts: "_collections.Counter[str]" = _collections.Counter()
+            deadline = _time.time() + seconds
+            nsamples = 0
+            while _time.time() < deadline:
+                for frames in _sys._current_frames().values():
+                    stack = _traceback.extract_stack(frames)
+                    if stack:
+                        f = stack[-1]
+                        counts[f"{f.filename}:{f.lineno} {f.name}"] += 1
+                nsamples += 1
+                _time.sleep(0.01)
+            return counts, nsamples
+
+        try:
+            counts, nsamples = await asyncio.get_event_loop(
+            ).run_in_executor(None, sample)
+        finally:
+            self._profiling = False
+        top = counts.most_common(50)
+        body = "\n".join(
+            f"{c:6d} {frame}" for frame, c in top
+        )
+        return web.Response(
+            text=f"samples={nsamples} interval=10ms\n{body}\n",
+            content_type="text/plain")
+
     def start(self) -> None:
         def run_loop():
             loop = asyncio.new_event_loop()

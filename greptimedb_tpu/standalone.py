@@ -291,10 +291,30 @@ class GreptimeDB(TableProvider):
         return self._regions_of(table)[0]
 
     def _table_view(self, table: str):
-        """Region, partitioned merge view, or metric-engine logical view."""
+        """Region, partitioned merge view, metric-engine logical view, or
+        read-only external file view (file engine)."""
         db, name = self._split_name(table)
         if self.metric_engine.is_logical(db, name):
             return self.metric_engine.view(db, name)
+        info = None
+        try:
+            info = self.catalog.get_table(db, name)
+        except TableNotFound:
+            pass
+        if info is not None and info.engine == "file":
+            from greptimedb_tpu.storage.file_engine import FileTableView
+
+            cache = getattr(self, "_file_views", None)
+            if cache is None:
+                cache = self._file_views = {}
+            v = cache.get((db, name))
+            if v is None:
+                v = FileTableView(
+                    name, info.schema, info.options["location"],
+                    info.options.get("format", "parquet"), info.table_id,
+                )
+                cache[(db, name)] = v
+            return v
         regions = self._regions_of(table)
         if len(regions) == 1:
             return regions[0]
@@ -533,6 +553,13 @@ class GreptimeDB(TableProvider):
     def _create_table(self, stmt: CreateTable) -> QueryResult:
         db, name = self._split_name(stmt.name)
         schema = schema_from_create(stmt)
+        if stmt.engine == "file":
+            loc = stmt.options.get("location")
+            if not loc:
+                raise InvalidArguments(
+                    "CREATE EXTERNAL TABLE needs WITH (location='...')"
+                )
+            stmt.options.setdefault("format", "parquet")
         info = self.catalog.create_table(
             db, name, schema,
             engine=stmt.engine,
@@ -542,7 +569,7 @@ class GreptimeDB(TableProvider):
             num_regions=max(len(stmt.partitions), 1),
             if_not_exists=stmt.if_not_exists,
         )
-        if info is not None:
+        if info is not None and stmt.engine != "file":
             for rid in info.region_ids:
                 self.regions.create_region(rid, schema)
         return QueryResult([], [], affected_rows=0)
@@ -575,8 +602,14 @@ class GreptimeDB(TableProvider):
                     )
             info = self.catalog.drop_table(db, name, stmt.if_exists)
             if info is not None:
+                if info.engine == "file":
+                    view = getattr(self, "_file_views", {}).pop(
+                        (db, name), None)
+                    if view is not None:
+                        self.cache.invalidate_region(view.region_id)
                 for rid in info.region_ids:
-                    self.regions.drop_region(rid)
+                    if info.engine != "file":
+                        self.regions.drop_region(rid)
                     self.cache.invalidate_region(rid)
         return QueryResult([], [], affected_rows=1)
 
@@ -616,6 +649,12 @@ class GreptimeDB(TableProvider):
 
     # ---- DML -----------------------------------------------------------
     def _insert(self, stmt: Insert) -> QueryResult:
+        db, name = self._split_name(stmt.table)
+        try:
+            if self.catalog.get_table(db, name).engine == "file":
+                raise Unsupported("external (file engine) tables are read-only")
+        except TableNotFound:
+            pass
         regions = self._regions_of(stmt.table)
         schema = regions[0].schema
         columns, data = insert_rows_to_columns(stmt, schema, self.timezone)

@@ -1,0 +1,169 @@
+"""File engine: read-only regions over external CSV/Parquet/JSON files.
+
+Reference: src/file-engine (1,671 LoC) + src/common/datasource —
+``CREATE EXTERNAL TABLE t (...) WITH (location='...', format='parquet')``
+registers a table whose data lives in user-managed files; scans read the
+files on demand (no WAL, no memtable, no flush).  The view duck-types the
+Region surface the planners and device cache consume, so external files
+flow into the same resident-tensor query path as native tables.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+from greptimedb_tpu.datatypes.batch import DictionaryEncoder
+from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.errors import InvalidArguments, StorageError
+from greptimedb_tpu.storage.memtable import OP, SEQ, TSID
+
+
+def _read_file(path: str, fmt: str):
+    import pyarrow as pa
+
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path)
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        return pacsv.read_csv(path)
+    if fmt == "json":
+        import pyarrow.json as pajson
+
+        return pajson.read_json(path)
+    raise InvalidArguments(f"unsupported external format {fmt!r}")
+
+
+class FileTableView:
+    """One external table; duck-types Region for planner/cache consumers."""
+
+    def __init__(self, name: str, schema: Schema, location: str, fmt: str,
+                 region_id: int):
+        self.schema = schema
+        self.location = location
+        self.format = fmt
+        # negative id space distinct from combined (-hash) and metric
+        # (-(1<<50)-id) views
+        self.region_id = -(1 << 55) - region_id
+        self.encoders: dict[str, DictionaryEncoder] = {
+            c.name: DictionaryEncoder() for c in schema.tag_columns
+        }
+        self._series: dict[tuple, int] = {}
+        self._mtimes: tuple = ()
+        self._host: dict[str, np.ndarray] | None = None
+        self.generation = 0
+        self.base_version = 0  # files change wholesale: full rebuilds only
+
+    @property
+    def tag_names(self) -> list[str]:
+        return [c.name for c in self.schema.tag_columns]
+
+    @property
+    def num_series(self) -> int:
+        self._refresh()
+        return len(self._series)
+
+    def _files(self) -> list[str]:
+        loc = self.location
+        if os.path.isdir(loc):
+            pats = {"parquet": "*.parquet", "csv": "*.csv", "json": "*.json"}
+            return sorted(glob.glob(os.path.join(loc, pats[self.format])))
+        if any(ch in loc for ch in "*?["):
+            return sorted(glob.glob(loc))
+        return [loc]
+
+    def _refresh(self) -> None:
+        files = self._files()
+        try:
+            mtimes = tuple((f, os.path.getmtime(f)) for f in files)
+        except OSError as e:
+            raise StorageError(f"external table location: {e}") from None
+        if self._host is not None and mtimes == self._mtimes:
+            return
+        from greptimedb_tpu.storage.region import Region
+
+        if not files:
+            raise StorageError(
+                f"no {self.format} files at {self.location!r}"
+            )
+        tables = [_read_file(f, self.format) for f in files]
+        cols: dict[str, np.ndarray] = {}
+        n = sum(t.num_rows for t in tables)
+        for c in self.schema:
+            parts = []
+            for t in tables:
+                if c.name not in t.column_names:
+                    raise StorageError(
+                        f"external file missing column {c.name!r}"
+                    )
+                col = t.column(c.name)
+                if c.dtype.is_string_like:
+                    parts.append(np.asarray(col.to_pylist(), dtype=object))
+                elif c.dtype.is_timestamp:
+                    arr = col.to_numpy(zero_copy_only=False)
+                    parts.append(np.asarray(arr).astype("datetime64[ms]")
+                                 .astype(np.int64)
+                                 if arr.dtype.kind == "M"
+                                 else np.asarray(arr).astype(np.int64))
+                else:
+                    parts.append(
+                        col.to_numpy(zero_copy_only=False)
+                        .astype(c.dtype.to_numpy())
+                    )
+            cols[c.name] = np.concatenate(parts) if parts else np.empty(0)
+        # derive series registry + internals exactly like a native region.
+        # MUTATE the existing dicts: planning contexts capture these object
+        # references, so wholesale replacement would strand them
+        self.encoders.clear()
+        self.encoders.update({
+            c.name: DictionaryEncoder() for c in self.schema.tag_columns
+        })
+        self._series.clear()
+        cols[TSID] = Region._encode_tags(self, cols, n)
+        cols[SEQ] = np.arange(1, n + 1, dtype=np.int64)
+        cols[OP] = np.zeros(n, dtype=np.int8)
+        ts_name = self.schema.time_index.name
+        order = np.lexsort((cols[ts_name], cols[TSID]))
+        self._host = {k: v[order] for k, v in cols.items()}
+        self._mtimes = mtimes
+        self.generation += 1
+        self.base_version += 1
+
+    def ts_bounds(self):
+        self._refresh()
+        ts = self._host[self.schema.time_index.name]
+        if not len(ts):
+            return None
+        return (int(ts.min()), int(ts.max()))
+
+    def scan_host(self, ts_range=(None, None), columns=None,
+                  tag_filters=None, tag_preds=None, ft_tokens=None):
+        self._refresh()
+        host = self._host
+        ts = host[self.schema.time_index.name]
+        mask = np.ones(len(ts), dtype=bool)
+        lo, hi = ts_range
+        if lo is not None:
+            mask &= ts >= lo
+        if hi is not None:
+            mask &= ts < hi
+        if tag_filters:
+            for col, values in tag_filters.items():
+                if col in host:
+                    vset = {str(v) for v in values}
+                    mask &= np.array(
+                        [str(v) in vset for v in host[col]], dtype=bool
+                    )
+        keep = None
+        if columns is not None:
+            keep = set(columns) | {TSID, SEQ, OP,
+                                   self.schema.time_index.name}
+        return {
+            k: v[mask] for k, v in host.items()
+            if keep is None or k in keep
+        }

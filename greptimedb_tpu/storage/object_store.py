@@ -33,6 +33,12 @@ class ObjectStore:
         else None and callers fall back to read()."""
         return None
 
+    def last_modified(self, path: str) -> float | None:
+        """Store-level modification time (epoch seconds), or None when the
+        backend cannot tell.  GC grace periods rely on this — never on
+        cache-file mtimes."""
+        return None
+
 
 class FsObjectStore(ObjectStore):
     def __init__(self, root: str):
@@ -85,6 +91,12 @@ class FsObjectStore(ObjectStore):
         p = self._abs(path)
         if os.path.exists(p):
             os.unlink(p)
+
+    def last_modified(self, path: str) -> float | None:
+        try:
+            return os.path.getmtime(self._abs(path))
+        except OSError:
+            return None
 
     def local_path(self, path: str) -> str | None:
         return self._abs(path)

@@ -693,6 +693,51 @@ class RegionEngine:
         self.regions[region_id] = region
         return region
 
+    def gc(self, grace_seconds: float = 3600.0) -> list[str]:
+        """Global GC sweep (reference src/mito2/src/gc.rs + the global GC
+        worker RFC 2025-07-23): delete SST/index objects under open
+        regions' directories that no manifest references and that are
+        older than the grace period (in-flight flushes commit their
+        manifest edit AFTER the object write — grace covers the window).
+        Returns deleted paths."""
+        import re as _re
+        import time as _time
+
+        deleted: list[str] = []
+        now = _time.time()
+        # discover regions from STORAGE, not just open handles — the GC
+        # worker typically runs against a data home with nothing open
+        ids = set(self.regions)
+        for path in self.store.list(""):
+            m = _re.match(r"region_(\d+)/", path)
+            if m:
+                ids.add(int(m.group(1)))
+        for rid in sorted(ids):
+            region = self.regions.get(rid)
+            if region is not None:
+                files = region.sst_files
+            else:
+                manifest = Manifest.open(self.store, f"region_{rid}/manifest")
+                if not manifest.exists:
+                    continue  # not a region we can reason about: skip
+                files = list(manifest.state.files.values())
+            live = {m.path for m in files}
+            live |= {f"region_{rid}/sst/{m.file_id}.idx" for m in files}
+            prefix = f"region_{rid}/sst"
+            for path in self.store.list(prefix):
+                if path in live:
+                    continue
+                if not _re.search(r"\.(parquet|idx)$", path):
+                    continue
+                mtime = self.store.last_modified(path)
+                if mtime is None:
+                    continue  # cannot prove age: never risk an in-flight flush
+                if now - mtime < grace_seconds:
+                    continue
+                self.store.delete(path)
+                deleted.append(path)
+        return deleted
+
     def drop_region(self, region_id: int) -> None:
         region = self.regions.pop(region_id, None)
         prefix = f"region_{region_id}"

@@ -699,3 +699,167 @@ class TestLogQueryApi:
             srv.stop()
             db.close()
 
+
+
+class TestDebugEndpoints:
+    def test_dyn_log_level_and_prof(self):
+        import json as _json
+        import urllib.request
+
+        from greptimedb_tpu.servers.http import HttpServer
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB()
+        srv = HttpServer(db, host="127.0.0.1", port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            out = _json.loads(urllib.request.urlopen(
+                base + "/debug/log_level").read())
+            assert "level" in out
+            req = urllib.request.Request(
+                base + "/debug/log_level", data=b"debug", method="POST")
+            out = _json.loads(urllib.request.urlopen(req).read())
+            assert out["level"] == "DEBUG"
+            req = urllib.request.Request(
+                base + "/debug/log_level", data=b"warning", method="POST")
+            assert _json.loads(urllib.request.urlopen(req).read())[
+                "level"] == "WARNING"
+            prof = urllib.request.urlopen(
+                base + "/debug/prof/cpu?seconds=0.3").read().decode()
+            assert prof.startswith("samples=")
+        finally:
+            srv.stop()
+            db.close()
+
+
+class TestExternalTables:
+    def test_external_parquet_and_csv(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        t = pa.table({"host": ["a", "b", "a"],
+                      "ts": pa.array([1000, 2000, 3000], pa.timestamp("ms")),
+                      "v": [1.0, 2.0, 3.0]})
+        pq.write_table(t, str(tmp_path / "p1.parquet"))
+        (tmp_path / "c.csv").write_text("host,ts,v\na,1000,5.0\nc,4000,7.0\n")
+        db = GreptimeDB()
+        try:
+            db.sql(f"CREATE EXTERNAL TABLE extp (host STRING, ts "
+                   f"TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (host)) "
+                   f"WITH (location='{tmp_path}/p1.parquet', "
+                   f"format='parquet')")
+            assert db.sql("SELECT host, sum(v) FROM extp GROUP BY host "
+                          "ORDER BY host").rows == [["a", 4.0], ["b", 2.0]]
+            db.sql(f"CREATE EXTERNAL TABLE extc (host STRING, ts "
+                   f"TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (host)) "
+                   f"WITH (location='{tmp_path}/c.csv', format='csv')")
+            assert db.sql("SELECT count(*), max(v) FROM extc"
+                          ).rows == [[2, 7.0]]
+            from greptimedb_tpu.errors import Unsupported
+
+            with pytest.raises(Unsupported):
+                db.sql("INSERT INTO extp VALUES ('x', 9000, 1.0)")
+            # joins between native and external tables work
+            db.sql("CREATE TABLE nat (host STRING, ts TIMESTAMP(3) "
+                   "TIME INDEX, w DOUBLE, PRIMARY KEY (host))")
+            db.sql("INSERT INTO nat VALUES ('a', 0, 10.0)")
+            r = db.sql("SELECT n.host, sum(e.v * n.w) FROM nat n "
+                       "JOIN extp e ON n.host = e.host GROUP BY n.host")
+            assert r.rows == [["a", 40.0]]
+        finally:
+            db.close()
+
+
+class TestGcAndMetaSnapshot:
+    def test_gc_deletes_orphans(self, tmp_path):
+        import os
+        import time
+
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(str(tmp_path / "home"))
+        try:
+            db.sql("CREATE TABLE g (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+                   "v DOUBLE, PRIMARY KEY (h))")
+            db.sql("INSERT INTO g VALUES ('a', 1000, 1.0)")
+            r = db._region_of("g")
+            r.flush()
+            rid = r.region_id
+            # plant an orphan object (failed flush leftover)
+            orphan = f"region_{rid}/sst/deadbeef.parquet"
+            db.regions.store.write(orphan, b"junk")
+            lp = db.regions.store.local_path(orphan)
+            old = time.time() - 7200
+            os.utime(lp, (old, old))
+            deleted = db.regions.gc(grace_seconds=3600)
+            assert orphan in deleted
+            # live SSTs untouched
+            assert db.sql("SELECT count(*) FROM g").rows == [[1]]
+        finally:
+            db.close()
+
+    def test_meta_snapshot_restore(self, tmp_path):
+        from greptimedb_tpu.cli import main as cli_main
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        home = str(tmp_path / "home")
+        db = GreptimeDB(home)
+        db.sql("CREATE TABLE ms (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (h))")
+        db.close()
+        snap = str(tmp_path / "meta.json")
+        assert cli_main(["meta", "snapshot", "--data-home", home,
+                         "--file", snap]) == 0
+        home2 = str(tmp_path / "home2")
+        assert cli_main(["meta", "restore", "--data-home", home2,
+                         "--file", snap]) == 0
+        db2 = GreptimeDB(home2)
+        try:
+            # table metadata restored (no data: that's export/import's job)
+            assert db2.sql("SHOW TABLES").rows == [["ms"]]
+        finally:
+            db2.close()
+
+    def test_recreated_external_table_not_stale(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        pq.write_table(pa.table({"host": ["old"], "ts": pa.array(
+            [1000], pa.timestamp("ms")), "v": [1.0]}),
+            str(tmp_path / "a.parquet"))
+        pq.write_table(pa.table({"host": ["new"], "ts": pa.array(
+            [2000], pa.timestamp("ms")), "v": [2.0]}),
+            str(tmp_path / "b.parquet"))
+        db = GreptimeDB()
+        try:
+            ddl = ("CREATE EXTERNAL TABLE e (host STRING, ts TIMESTAMP(3) "
+                   "TIME INDEX, v DOUBLE, PRIMARY KEY (host)) "
+                   "WITH (location='{}', format='parquet')")
+            db.sql(ddl.format(tmp_path / "a.parquet"))
+            assert db.sql("SELECT host FROM e").rows == [["old"]]
+            db.sql("DROP TABLE e")
+            db.sql(ddl.format(tmp_path / "b.parquet"))
+            assert db.sql("SELECT host FROM e").rows == [["new"]]
+        finally:
+            db.close()
+
+    def test_join_star_hides_joinrow(self):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB()
+        try:
+            db.sql("CREATE TABLE a (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+                   "v DOUBLE, PRIMARY KEY (h))")
+            db.sql("CREATE TABLE b (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+                   "w DOUBLE, PRIMARY KEY (h))")
+            db.sql("INSERT INTO a VALUES ('x', 1000, 1.0)")
+            db.sql("INSERT INTO b VALUES ('x', 2000, 2.0)")
+            r = db.sql("SELECT * FROM a JOIN b ON a.h = b.h")
+            assert "__joinrow__" not in r.column_names
+        finally:
+            db.close()
