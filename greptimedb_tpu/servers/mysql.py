@@ -1,10 +1,12 @@
 """MySQL wire protocol server (reference: opensrv-mysql fork, port 4002).
 
-Text protocol only (COM_QUERY), protocol 4.1 with mysql_native_password
-auth (accept-all by default, like the reference without a user provider).
-Covers what MySQL clients/drivers need for SELECT/DDL/DML round trips:
-handshake, OK/ERR/EOF packets, column definitions with type mapping,
-text-encoded result rows, COM_PING/COM_QUIT/COM_INIT_DB.
+Protocol 4.1 with mysql_native_password auth (accept-all by default, like
+the reference without a user provider).  Covers what MySQL clients and
+drivers need for SELECT/DDL/DML round trips: handshake, OK/ERR/EOF
+packets, column definitions with type mapping, text result rows
+(COM_QUERY), and PREPARED STATEMENTS — COM_STMT_PREPARE/EXECUTE/CLOSE/
+RESET with binary parameter decoding and binary result rows, which is
+what connector libraries and BI tools actually use.
 """
 
 from __future__ import annotations
@@ -75,6 +77,9 @@ class _Conn:
         self.caps = 0
         self.session_db = "public"  # per-connection database
         self.session_tz = "UTC"
+        # prepared statements: stmt_id -> (sql, param_positions, types)
+        self._stmt_map: dict[int, list] = {}
+        self._stmt_next = 1
 
     # ---- packet IO -----------------------------------------------------
     async def read_packet(self) -> bytes | None:
@@ -179,12 +184,13 @@ class _Conn:
     def _coldef(self, name: str, type_name: str) -> bytes:
         mtype = _TYPE_MAP.get(type_name, MYSQL_TYPE_VAR_STRING)
         charset = 0x3F if mtype != MYSQL_TYPE_VAR_STRING else 0x21
+        flags = 0x20 if type_name.startswith("UInt") else 0  # UNSIGNED
         return (
             _lenenc_str(b"def") + _lenenc_str(b"") + _lenenc_str(b"")
             + _lenenc_str(b"") + _lenenc_str(name.encode("utf-8"))
             + _lenenc_str(b"") + b"\x0c"
             + struct.pack("<H", charset) + struct.pack("<I", 1024)
-            + bytes([mtype]) + struct.pack("<H", 0) + bytes([0])
+            + bytes([mtype]) + struct.pack("<H", flags) + bytes([0])
             + b"\x00\x00"
         )
 
@@ -238,13 +244,204 @@ class _Conn:
                     await self._query(sql)
                 except Exception:  # noqa: BLE001 (error already sent)
                     pass
+            elif cmd == 0x16:  # COM_STMT_PREPARE
+                self._stmt_prepare(pkt[1:].decode("utf-8", "replace"))
+            elif cmd == 0x17:  # COM_STMT_EXECUTE
+                try:
+                    await self._stmt_execute(pkt)
+                except Exception:  # noqa: BLE001 (error already sent)
+                    pass
+            elif cmd == 0x18:  # COM_STMT_SEND_LONG_DATA: NO response ever
+                pass  # long-data streaming unsupported; execute will error
+            elif cmd == 0x19:  # COM_STMT_CLOSE (no response)
+                if len(pkt) >= 5:
+                    (sid,) = struct.unpack_from("<I", pkt, 1)
+                    self._stmt_map.pop(sid, None)
+            elif cmd == 0x1A:  # COM_STMT_RESET
+                self.send_ok()
             else:
                 self.send_err(f"unsupported command 0x{cmd:02x}", errno=1047,
                               sqlstate=b"08S01")
             await self.writer.drain()
         self.writer.close()
 
-    async def _query(self, sql: str) -> None:
+    # ---- prepared statements (binary protocol) -----------------------
+    @staticmethod
+    def _param_positions(sql: str) -> list[int]:
+        """Positions of real ? placeholders — skipping string literals,
+        quoted identifiers ("...", `...`), -- and /* */ comments, exactly
+        like the engine's lexer."""
+        out = []
+        i, n = 0, len(sql)
+        while i < n:
+            ch = sql[i]
+            if ch == "'":
+                i += 1
+                while i < n:
+                    if sql[i] == "'":
+                        if i + 1 < n and sql[i + 1] == "'":
+                            i += 2
+                            continue
+                        break
+                    i += 1
+            elif ch in ('"', "`"):
+                q = ch
+                i += 1
+                while i < n and sql[i] != q:
+                    i += 1
+            elif ch == "-" and sql.startswith("--", i):
+                while i < n and sql[i] != "\n":
+                    i += 1
+            elif ch == "/" and sql.startswith("/*", i):
+                end = sql.find("*/", i + 2)
+                i = n if end < 0 else end + 1
+            elif ch == "?":
+                out.append(i)
+            i += 1
+        return out
+
+    def _stmt_prepare(self, sql: str) -> None:
+        st = self._stmt_map
+        sid = self._stmt_next
+        self._stmt_next += 1
+        positions = self._param_positions(sql)
+        n_params = len(positions)
+        st[sid] = [sql, positions, None]  # [sql, positions, cached types]
+        # COM_STMT_PREPARE_OK: status, stmt_id, num_columns (0: clients
+        # read the real column set from the execute response), num_params
+        self.send(
+            b"\x00" + struct.pack("<I", sid) + struct.pack("<H", 0)
+            + struct.pack("<H", n_params) + b"\x00" + struct.pack("<H", 0)
+        )
+        if n_params:
+            for i in range(n_params):
+                self.send(self._coldef(f"?{i}", "String"))
+            self.send_eof()
+
+    @staticmethod
+    def _decode_binary_params(pkt: bytes, n_params: int,
+                              cached_types: list | None):
+        """COM_STMT_EXECUTE payload → (python values, types).  Clients
+        send type bytes only when new_params_bound_flag=1 (first execute
+        after a bind); later executes reuse the cached types."""
+        off = 1 + 4 + 1 + 4  # cmd, stmt_id, flags, iteration_count
+        nullmap = pkt[off: off + (n_params + 7) // 8]
+        off += (n_params + 7) // 8
+        new_bound = pkt[off]
+        off += 1
+        types: list = []
+        if new_bound:
+            for _ in range(n_params):
+                types.append((pkt[off], pkt[off + 1]))
+                off += 2
+        elif cached_types:
+            types = cached_types
+        vals: list = []
+        for i in range(n_params):
+            if nullmap[i // 8] & (1 << (i % 8)):
+                vals.append(None)
+                continue
+            t, unsigned = types[i] if types else (0xFD, 0)
+            if t == 0x08:  # LONGLONG
+                (v,) = struct.unpack_from(
+                    "<Q" if unsigned & 0x80 else "<q", pkt, off)
+                off += 8
+            elif t == 0x03:  # LONG
+                (v,) = struct.unpack_from(
+                    "<I" if unsigned & 0x80 else "<i", pkt, off)
+                off += 4
+            elif t == 0x02:  # SHORT
+                (v,) = struct.unpack_from(
+                    "<H" if unsigned & 0x80 else "<h", pkt, off)
+                off += 2
+            elif t == 0x01:  # TINY
+                v = pkt[off] if unsigned & 0x80 else struct.unpack_from(
+                    "<b", pkt, off)[0]
+                off += 1
+            elif t == 0x05:  # DOUBLE
+                (v,) = struct.unpack_from("<d", pkt, off)
+                off += 8
+            elif t == 0x04:  # FLOAT
+                (v,) = struct.unpack_from("<f", pkt, off)
+                off += 4
+            elif t == 0x06:  # NULL
+                v = None
+            else:  # lenenc string-ish (VAR_STRING/STRING/BLOB/DECIMAL...)
+                ln = pkt[off]
+                off += 1
+                if ln == 0xFC:
+                    (ln,) = struct.unpack_from("<H", pkt, off)
+                    off += 2
+                elif ln == 0xFD:
+                    ln = int.from_bytes(pkt[off:off + 3], "little")
+                    off += 3
+                v = pkt[off:off + ln].decode("utf-8", "replace")
+                off += ln
+            vals.append(v)
+        return vals, types
+
+    @staticmethod
+    def _substitute(sql: str, positions: list[int], vals: list) -> str:
+        out = []
+        prev = 0
+        for pos, v in zip(positions, vals):
+            out.append(sql[prev:pos])
+            if v is None:
+                out.append("NULL")
+            elif isinstance(v, (int, float)):
+                out.append(repr(v))
+            else:
+                out.append("'" + str(v).replace("'", "''") + "'")
+            prev = pos + 1
+        out.append(sql[prev:])
+        return "".join(out)
+
+    async def _stmt_execute(self, pkt: bytes) -> None:
+        (sid,) = struct.unpack_from("<I", pkt, 1)
+        st = self._stmt_map
+        if sid not in st:
+            self.send_err(f"unknown statement id {sid}", errno=1243)
+            return
+        sql, positions, cached_types = st[sid]
+        try:
+            vals, types = self._decode_binary_params(
+                pkt, len(positions), cached_types)
+            st[sid][2] = types or cached_types
+            bound = self._substitute(sql, positions, vals)
+        except Exception as e:  # noqa: BLE001
+            self.send_err(f"bad parameter block: {e}", errno=1210)
+            return
+        await self._query(bound, binary=True)
+
+    def send_binary_resultset(self, result) -> None:
+        names = result.column_names
+        types = result.column_types or ["String"] * len(names)
+        mtypes = [_TYPE_MAP.get(t, MYSQL_TYPE_VAR_STRING) for t in types]
+        self.send(_lenenc_int(len(names)))
+        for n, t in zip(names, types):
+            self.send(self._coldef(n, t))
+        self.send_eof()
+        nbm = (len(names) + 7 + 2) // 8
+        for row in result.rows:
+            nullmap = bytearray(nbm)
+            body = b""
+            for i, (v, mt) in enumerate(zip(row, mtypes)):
+                if v is None or (isinstance(v, float) and v != v):
+                    nullmap[(i + 2) // 8] |= 1 << ((i + 2) % 8)
+                    continue
+                unsigned = types[i].startswith("UInt")
+                if mt == MYSQL_TYPE_TINY:
+                    body += struct.pack("<B" if unsigned else "<b", int(v))
+                elif mt == MYSQL_TYPE_LONGLONG:
+                    body += struct.pack("<Q" if unsigned else "<q", int(v))
+                elif mt == MYSQL_TYPE_DOUBLE:
+                    body += struct.pack("<d", float(v))
+                else:
+                    body += _lenenc_str(str(v).encode("utf-8"))
+            self.send(b"\x00" + bytes(nullmap) + body)
+        self.send_eof()
+
+    async def _query(self, sql: str, binary: bool = False) -> None:
         loop = asyncio.get_running_loop()
         stripped = sql.strip().rstrip(";").strip()
         # common client housekeeping queries
@@ -278,7 +475,10 @@ class _Conn:
             self.send_err(str(e), errno=1105, sqlstate=b"HY000")
             raise
         if result.column_names:
-            self.send_resultset(result)
+            if binary:
+                self.send_binary_resultset(result)
+            else:
+                self.send_resultset(result)
         else:
             self.send_ok(result.affected_rows)
 

@@ -215,3 +215,184 @@ class TestMysqlProtocol:
         # exotic SET stays a no-op, not an error
         assert c1.query("SET @@session.autocommit = 1")[0] == "ok"
         c1.quit(); c2.quit()
+
+
+class TestPreparedStatements:
+    def _prepare(self, c: MiniMysqlClient, sql: str):
+        c.seq = 0
+        c._send(b"\x16" + sql.encode())
+        ok = c._read_packet()
+        assert ok[0] == 0x00, ok
+        sid = struct.unpack_from("<I", ok, 1)[0]
+        ncols = struct.unpack_from("<H", ok, 5)[0]
+        nparams = struct.unpack_from("<H", ok, 7)[0]
+        for _ in range(nparams):
+            c._read_packet()  # param defs
+        if nparams:
+            assert c._read_packet()[0] == 0xFE  # EOF
+        return sid, ncols, nparams
+
+    def _execute(self, c: MiniMysqlClient, sid: int, params: list):
+        c.seq = 0
+        body = b"\x17" + struct.pack("<I", sid) + b"\x00" + struct.pack("<I", 1)
+        n = len(params)
+        nullmap = bytearray((n + 7) // 8)
+        types = b""
+        vals = b""
+        for i, p in enumerate(params):
+            if p is None:
+                nullmap[i // 8] |= 1 << (i % 8)
+                types += bytes([0x06, 0])
+            elif isinstance(p, int):
+                types += bytes([0x08, 0])
+                vals += struct.pack("<q", p)
+            elif isinstance(p, float):
+                types += bytes([0x05, 0])
+                vals += struct.pack("<d", p)
+            else:
+                enc = str(p).encode()
+                types += bytes([0xFD, 0])
+                assert len(enc) < 251
+                vals += bytes([len(enc)]) + enc
+        body += bytes(nullmap) + b"\x01" + types + vals
+        c._send(body)
+        first = c._read_packet()
+        if first[0] == 0x00:
+            return ("ok", None)
+        if first[0] == 0xFF:
+            return ("err", first[9:].decode())
+        ncols, _ = c._lenenc(first, 0)
+        coldefs = []
+        for _ in range(ncols):
+            coldefs.append(c._read_packet())
+        assert c._read_packet()[0] == 0xFE
+        # binary rows
+        mtypes = []
+        for col in coldefs:
+            pos = 0
+            for _i in range(4):
+                ln, pos = c._lenenc(col, pos)
+                pos += ln or 0
+            ln, pos = c._lenenc(col, pos)
+            pos += ln  # name
+            ln, pos = c._lenenc(col, pos)
+            pos += ln  # org name
+            pos += 1 + 2 + 4  # 0x0c, charset, length
+            mtypes.append(col[pos])
+        rows = []
+        while True:
+            pkt = c._read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            assert pkt[0] == 0x00
+            nbm = (ncols + 7 + 2) // 8
+            nullmap2 = pkt[1:1 + nbm]
+            pos = 1 + nbm
+            row = []
+            for i, mt in enumerate(mtypes):
+                if nullmap2[(i + 2) // 8] & (1 << ((i + 2) % 8)):
+                    row.append(None)
+                    continue
+                if mt == 0x08:
+                    row.append(struct.unpack_from("<q", pkt, pos)[0])
+                    pos += 8
+                elif mt == 0x05:
+                    row.append(struct.unpack_from("<d", pkt, pos)[0])
+                    pos += 8
+                elif mt == 0x01:
+                    row.append(struct.unpack_from("<b", pkt, pos)[0])
+                    pos += 1
+                else:
+                    ln, pos = c._lenenc(pkt, pos)
+                    row.append(pkt[pos:pos + ln].decode())
+                    pos += ln
+            rows.append(row)
+        return ("rows", rows)
+
+    def test_prepare_execute_roundtrip(self, mysql):
+        c = MiniMysqlClient(mysql.port)
+        c.connect()
+        c.query("CREATE TABLE IF NOT EXISTS ps (h STRING, ts TIMESTAMP(3) "
+                "TIME INDEX, v DOUBLE, PRIMARY KEY (h))")
+        sid, _ncols, nparams = self._prepare(
+            c, "INSERT INTO ps VALUES (?, ?, ?)")
+        assert nparams == 3
+        assert self._execute(c, sid, ["a", 1000, 1.5])[0] == "ok"
+        assert self._execute(c, sid, ["b", 2000, 2.5])[0] == "ok"
+        qid, _, qp = self._prepare(
+            c, "SELECT h, ts, v FROM ps WHERE v > ? ORDER BY h")
+        assert qp == 1
+        kind, rows = self._execute(c, qid, [2.0])
+        assert kind == "rows"
+        assert rows == [["b", 2000, 2.5]]
+        # re-execute with different param reuses the statement
+        kind, rows = self._execute(c, qid, [0.0])
+        assert [r[0] for r in rows] == ["a", "b"]
+        # NULL param + string with quote
+        sid2, _, _ = self._prepare(c, "SELECT count(*) FROM ps WHERE h = ?")
+        kind, rows = self._execute(c, sid2, ["o'brien"])
+        assert rows == [[0]]
+        # close
+        c.seq = 0
+        c._send(b"\x19" + struct.pack("<I", sid))
+        assert c.ping()
+        c.quit()
+
+    def test_execute_unknown_statement(self, mysql):
+        c = MiniMysqlClient(mysql.port)
+        c.connect()
+        out = self._execute(c, 9999, [])
+        assert out[0] == "err"
+        c.quit()
+
+    def test_reexecute_without_rebinding_types(self, mysql):
+        """Clients send type bytes only on the FIRST execute; later
+        executes set new_params_bound_flag=0 and reuse cached types."""
+        c = MiniMysqlClient(mysql.port)
+        c.connect()
+        c.query("CREATE TABLE IF NOT EXISTS ps2 (h STRING, ts TIMESTAMP(3) "
+                "TIME INDEX, v DOUBLE, PRIMARY KEY (h))")
+        c.query("INSERT INTO ps2 VALUES ('a', 1000, 1.0), ('b', 2000, 9.0)")
+        sid, _, _ = self._prepare(c, "SELECT h FROM ps2 WHERE v > ?")
+
+        def execute_flag0(params_blob):
+            c.seq = 0
+            body = (b"\x17" + struct.pack("<I", sid) + b"\x00"
+                    + struct.pack("<I", 1) + b"\x00" + b"\x00" + params_blob)
+            c._send(body)
+            first = c._read_packet()
+            assert first[0] not in (0x00, 0xFF), first
+            ncols, _ = c._lenenc(first, 0)
+            for _ in range(ncols):
+                c._read_packet()
+            assert c._read_packet()[0] == 0xFE
+            rows = 0
+            while True:
+                pkt = c._read_packet()
+                if pkt[0] == 0xFE and len(pkt) < 9:
+                    break
+                rows += 1
+            return rows
+
+        # first execute: bind types (flag=1) via helper
+        kind, rows = self._execute(c, sid, [5.0])
+        assert kind == "rows" and len(rows) == 1
+        # second execute: flag=0, DOUBLE payload, cached type must be used
+        assert execute_flag0(struct.pack("<d", 0.5)) == 2
+        c.quit()
+
+    def test_placeholder_scanner_skips_comments(self, mysql):
+        c = MiniMysqlClient(mysql.port)
+        c.connect()
+        c.query("CREATE TABLE IF NOT EXISTS ps3 (h STRING, ts TIMESTAMP(3) "
+                "TIME INDEX, v DOUBLE, PRIMARY KEY (h))")
+        c.query("INSERT INTO ps3 VALUES ('a', 1000, 5.0)")
+        sid, _, nparams = self._prepare(
+            c, "SELECT h FROM ps3 WHERE v > ? -- threshold?")
+        assert nparams == 1
+        kind, rows = self._execute(c, sid, [1.0])
+        assert rows == [["a"]]
+        sid2, _, np2 = self._prepare(
+            c, "SELECT h FROM ps3 /* what? */ WHERE v > ?")
+        assert np2 == 1
+        c.quit()
