@@ -16,6 +16,7 @@ import struct
 import threading
 
 from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.servers.placeholders import scan_placeholders, sql_literal
 from greptimedb_tpu.servers.tcp import ThreadedTcpServer
 
 # capability flags
@@ -268,37 +269,9 @@ class _Conn:
     # ---- prepared statements (binary protocol) -----------------------
     @staticmethod
     def _param_positions(sql: str) -> list[int]:
-        """Positions of real ? placeholders — skipping string literals,
-        quoted identifiers ("...", `...`), -- and /* */ comments, exactly
-        like the engine's lexer."""
-        out = []
-        i, n = 0, len(sql)
-        while i < n:
-            ch = sql[i]
-            if ch == "'":
-                i += 1
-                while i < n:
-                    if sql[i] == "'":
-                        if i + 1 < n and sql[i + 1] == "'":
-                            i += 2
-                            continue
-                        break
-                    i += 1
-            elif ch in ('"', "`"):
-                q = ch
-                i += 1
-                while i < n and sql[i] != q:
-                    i += 1
-            elif ch == "-" and sql.startswith("--", i):
-                while i < n and sql[i] != "\n":
-                    i += 1
-            elif ch == "/" and sql.startswith("/*", i):
-                end = sql.find("*/", i + 2)
-                i = n if end < 0 else end + 1
-            elif ch == "?":
-                out.append(i)
-            i += 1
-        return out
+        """Positions of real ? placeholders (shared literal/comment skip
+        rules: servers/placeholders.py)."""
+        return [start for start, _end, _no in scan_placeholders(sql, "qmark")]
 
     def _stmt_prepare(self, sql: str) -> None:
         st = self._stmt_map
@@ -386,12 +359,7 @@ class _Conn:
         prev = 0
         for pos, v in zip(positions, vals):
             out.append(sql[prev:pos])
-            if v is None:
-                out.append("NULL")
-            elif isinstance(v, (int, float)):
-                out.append(repr(v))
-            else:
-                out.append("'" + str(v).replace("'", "''") + "'")
+            out.append(sql_literal(v))
             prev = pos + 1
         out.append(sql[prev:])
         return "".join(out)

@@ -1,0 +1,66 @@
+"""Shared SQL placeholder scanning for the wire protocols.
+
+MySQL prepared statements use ``?`` and PostgreSQL uses ``$N``; both
+must skip string literals (with ``''`` doubling), quoted identifiers
+("..." and `...`), ``--`` line comments and ``/* */`` block comments —
+the same skip rules as the engine lexer.  One scanner, parameterised on
+the placeholder style, so the skip rules can't drift between protocols.
+"""
+
+from __future__ import annotations
+
+
+def scan_placeholders(sql: str, style: str) -> list[tuple[int, int, int]]:
+    """Return (start, end, param_no) for each real placeholder.
+
+    style="qmark": ``?`` markers, param_no assigned in order (1-based).
+    style="dollar": ``$N`` markers, param_no = N (may repeat/skip).
+    """
+    out: list[tuple[int, int, int]] = []
+    i, n = 0, len(sql)
+    seq = 0
+    while i < n:
+        ch = sql[i]
+        if ch == "'":
+            i += 1
+            while i < n:
+                if sql[i] == "'":
+                    if i + 1 < n and sql[i + 1] == "'":
+                        i += 2
+                        continue
+                    break
+                i += 1
+        elif ch in ('"', "`"):
+            q = ch
+            i += 1
+            while i < n and sql[i] != q:
+                i += 1
+        elif ch == "-" and sql.startswith("--", i):
+            while i < n and sql[i] != "\n":
+                i += 1
+        elif ch == "/" and sql.startswith("/*", i):
+            end = sql.find("*/", i + 2)
+            i = n if end < 0 else end + 1
+        elif style == "qmark" and ch == "?":
+            seq += 1
+            out.append((i, i + 1, seq))
+        elif (style == "dollar" and ch == "$" and i + 1 < n
+              and sql[i + 1].isdigit()):
+            j = i + 1
+            while j < n and sql[j].isdigit():
+                j += 1
+            out.append((i, j, int(sql[i + 1:j])))
+            i = j - 1
+        i += 1
+    return out
+
+
+def sql_literal(v) -> str:
+    """Injection-safe SQL literal for a bound parameter value."""
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    return "'" + str(v).replace("'", "''") + "'"

@@ -1,19 +1,29 @@
 """PostgreSQL wire protocol server (reference: pgwire 0.40, port 4003).
 
-Protocol v3 simple-query flavor: startup/auth (trust), ParameterStatus,
-RowDescription/DataRow/CommandComplete, ErrorResponse with SQLSTATE,
-ReadyForQuery cycle, Terminate. Enough for psql and simple drivers'
-text-mode queries; the extended (prepared) protocol is a later round.
+Protocol v3, both flavors:
+- simple query (Q): RowDescription/DataRow/CommandComplete cycle — psql.
+- extended query (P/B/D/E/C/S/H): named prepared statements + portals
+  with text AND binary parameter/result formats — what JDBC, psycopg3
+  and asyncpg actually send.  Portals execute lazily on first
+  Describe/Execute and cache their result, so Describe(portal) reports
+  the real result schema; errors suppress further extended messages
+  until Sync, per the protocol's error-recovery rule.
 """
 
 from __future__ import annotations
 
 import asyncio
+import re
 import struct
 import threading
 
 from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.servers.placeholders import scan_placeholders, sql_literal
 from greptimedb_tpu.servers.tcp import ThreadedTcpServer
+
+# trailing LIMIT n [OFFSET m] clause (rewritten to LIMIT 0 by the
+# Describe-statement schema probe)
+_TAIL_LIMIT = re.compile(r"(?is)\blimit\s+\d+(\s+offset\s+\d+)?\s*$")
 
 _OID = {
     "Boolean": 16, "Int8": 21, "Int16": 21, "Int32": 23, "Int64": 20,
@@ -25,6 +35,32 @@ _OID = {
 }
 
 
+class _Prepared:
+    __slots__ = ("sql", "positions", "n_params", "param_oids")
+
+    def __init__(self, sql: str, param_oids: list[int]):
+        self.sql = sql
+        self.positions = scan_placeholders(sql, "dollar")
+        if any(p[2] < 1 for p in self.positions):
+            raise ValueError("there is no parameter $0")
+        self.n_params = max((p[2] for p in self.positions), default=0)
+        # pad/truncate the declared oids to the placeholder count
+        # (0 = unspecified, inferred as text)
+        self.param_oids = (param_oids + [0] * self.n_params)[:self.n_params]
+
+
+class _Portal:
+    __slots__ = ("stmt", "bound_sql", "result_formats", "result", "offset")
+
+    def __init__(self, stmt: _Prepared, bound_sql: str,
+                 result_formats: list[int]):
+        self.stmt = stmt
+        self.bound_sql = bound_sql
+        self.result_formats = result_formats
+        self.result = None  # QueryResult once executed
+        self.offset = 0  # rows already streamed (max_rows suspension)
+
+
 class _PgConn:
     def __init__(self, server: "PostgresServer", reader, writer):
         self.server = server
@@ -32,6 +68,9 @@ class _PgConn:
         self.writer = writer
         self.session_db = "public"  # per-connection database
         self.session_tz = "UTC"
+        self.stmts: dict[str, _Prepared] = {}
+        self.portals: dict[str, _Portal] = {}
+        self._skip_until_sync = False
 
     def _msg(self, tag: bytes, payload: bytes) -> None:
         self.writer.write(tag + struct.pack(">I", len(payload) + 4) + payload)
@@ -95,28 +134,276 @@ class _PgConn:
             await self.writer.drain()
             return False
 
-    def _row_description(self, names, types) -> None:
+    def _row_description(self, names, types, formats=None) -> None:
         out = struct.pack(">H", len(names))
-        for n, t in zip(names, types):
+        for i, (n, t) in enumerate(zip(names, types)):
             oid = _OID.get(t, 25)
+            fmt = formats[i] if formats else 0
             out += (n.encode("utf-8") + b"\x00"
-                    + struct.pack(">IhIhih", 0, 0, oid, -1, -1, 0))
+                    + struct.pack(">IhIhih", 0, 0, oid, -1, -1, fmt))
         self._msg(b"T", out)
 
-    def _data_row(self, row) -> None:
+    @staticmethod
+    def _text_cell(v) -> bytes:
+        if isinstance(v, bool):
+            return b"t" if v else b"f"
+        if isinstance(v, float):
+            return repr(v).encode()
+        return str(v).encode("utf-8")
+
+    @staticmethod
+    def _binary_cell(v, oid: int) -> bytes:
+        if oid == 16:
+            return b"\x01" if v else b"\x00"
+        if oid == 21:
+            return struct.pack(">h", int(v))
+        if oid == 23:
+            return struct.pack(">i", int(v))
+        if oid == 20:
+            return struct.pack(">q", int(v))
+        if oid == 700:
+            return struct.pack(">f", float(v))
+        if oid == 701:
+            return struct.pack(">d", float(v))
+        return _PgConn._text_cell(v)
+
+    def _data_row(self, row, oids=None, formats=None) -> None:
         out = struct.pack(">H", len(row))
-        for v in row:
+        for i, v in enumerate(row):
             if v is None:
                 out += struct.pack(">i", -1)
             else:
-                if isinstance(v, bool):
-                    s = b"t" if v else b"f"
-                elif isinstance(v, float):
-                    s = repr(v).encode()
+                if formats and formats[i] == 1:
+                    s = self._binary_cell(v, oids[i] if oids else 25)
                 else:
-                    s = str(v).encode("utf-8")
+                    s = self._text_cell(v)
                 out += struct.pack(">i", len(s)) + s
         self._msg(b"D", out)
+
+    # ---- extended query protocol --------------------------------------
+    def _ext_error(self, msg: str, code: str = "42000") -> None:
+        """Error in extended mode: report it and ignore every message
+        until the client's Sync (protocol error-recovery rule)."""
+        self._error(msg, code)
+        self._skip_until_sync = True
+
+    def _on_parse(self, body: bytes) -> None:
+        z1 = body.index(b"\x00")
+        name = body[:z1].decode("utf-8", "replace")
+        z2 = body.index(b"\x00", z1 + 1)
+        sql = body[z1 + 1:z2].decode("utf-8", "replace")
+        (n,) = struct.unpack_from(">H", body, z2 + 1)
+        oids = list(struct.unpack_from(f">{n}i", body, z2 + 3)) if n else []
+        try:
+            self.stmts[name] = _Prepared(sql, oids)
+        except ValueError as e:
+            self._ext_error(str(e), "42P02")
+            return
+        self._msg(b"1", b"")  # ParseComplete
+
+    @staticmethod
+    def _decode_param(raw: bytes | None, oid: int, fmt: int):
+        if raw is None:
+            return None
+        if fmt == 1:  # binary by declared oid
+            if oid == 16:
+                return raw != b"\x00"
+            if oid == 21:
+                return struct.unpack(">h", raw)[0]
+            if oid == 23:
+                return struct.unpack(">i", raw)[0]
+            if oid == 20:
+                return struct.unpack(">q", raw)[0]
+            if oid == 700:
+                return struct.unpack(">f", raw)[0]
+            if oid == 701:
+                return struct.unpack(">d", raw)[0]
+            return raw.decode("utf-8", "replace")
+        text = raw.decode("utf-8", "replace")
+        if oid in (20, 21, 23):
+            return int(text)
+        if oid in (700, 701):
+            return float(text)
+        if oid == 16:
+            return text.lower() in ("t", "true", "1", "yes", "on")
+        if oid == 0:
+            # Unspecified OID (lib/pq, psql \bind): postgres infers the
+            # type from context; our nearest analog is to pass
+            # numeric-looking text through as a numeric literal so
+            # comparisons against value/timestamp columns type-check.
+            try:
+                return int(text)
+            except ValueError:
+                try:
+                    return float(text)
+                except ValueError:
+                    return text
+        return text
+
+    def _bind_sql(self, stmt: _Prepared, vals: list) -> str:
+        out, prev = [], 0
+        for start, end, pno in stmt.positions:
+            out.append(stmt.sql[prev:start])
+            out.append(sql_literal(vals[pno - 1]))
+            prev = end
+        out.append(stmt.sql[prev:])
+        return "".join(out)
+
+    def _on_bind(self, body: bytes) -> None:
+        z1 = body.index(b"\x00")
+        portal = body[:z1].decode("utf-8", "replace")
+        z2 = body.index(b"\x00", z1 + 1)
+        sname = body[z1 + 1:z2].decode("utf-8", "replace")
+        stmt = self.stmts.get(sname)
+        if stmt is None:
+            self._ext_error(f'prepared statement "{sname}" does not exist',
+                            "26000")
+            return
+        off = z2 + 1
+        (nf,) = struct.unpack_from(">H", body, off)
+        off += 2
+        pformats = list(struct.unpack_from(f">{nf}h", body, off))
+        off += 2 * nf
+        (np_,) = struct.unpack_from(">H", body, off)
+        off += 2
+        raws: list[bytes | None] = []
+        for _ in range(np_):
+            (vlen,) = struct.unpack_from(">i", body, off)
+            off += 4
+            if vlen < 0:
+                raws.append(None)
+            else:
+                raws.append(body[off:off + vlen])
+                off += vlen
+        (nrf,) = struct.unpack_from(">H", body, off)
+        off += 2
+        rformats = list(struct.unpack_from(f">{nrf}h", body, off))
+        if np_ != stmt.n_params:
+            self._ext_error(
+                f"bind supplies {np_} parameters, statement needs "
+                f"{stmt.n_params}", "08P01")
+            return
+        try:
+            vals = []
+            for i, raw in enumerate(raws):
+                fmt = (pformats[i] if len(pformats) > 1
+                       else (pformats[0] if pformats else 0))
+                vals.append(self._decode_param(raw, stmt.param_oids[i], fmt))
+        except Exception as e:  # noqa: BLE001
+            self._ext_error(f"invalid parameter value: {e}", "22P02")
+            return
+        self.portals[portal] = _Portal(stmt, self._bind_sql(stmt, vals),
+                                       rformats)
+        self._msg(b"2", b"")  # BindComplete
+
+    async def _run_portal(self, portal: _Portal, loop) -> bool:
+        """Execute the portal's bound SQL once; cache the result."""
+        if portal.result is not None:
+            return True
+        try:
+            portal.result, self.session_db, self.session_tz = (
+                await loop.run_in_executor(
+                    self.server._db_executor, self.server.db.sql_in_db,
+                    portal.bound_sql, self.session_db, self.session_tz))
+            return True
+        except GreptimeError as e:
+            self._ext_error(e.msg, "42000")
+        except Exception as e:  # noqa: BLE001
+            self._ext_error(str(e), "XX000")
+        return False
+
+    def _portal_formats(self, portal: _Portal, ncols: int):
+        rf = portal.result_formats
+        if not rf:
+            return [0] * ncols
+        if len(rf) == 1:
+            return rf * ncols
+        return (rf + [0] * ncols)[:ncols]
+
+    async def _on_describe(self, body: bytes, loop) -> None:
+        kind, name = body[:1], body[1:].split(b"\x00")[0].decode(
+            "utf-8", "replace")
+        if kind == b"S":
+            stmt = self.stmts.get(name)
+            if stmt is None:
+                self._ext_error(
+                    f'prepared statement "{name}" does not exist', "26000")
+                return
+            self._msg(b"t", struct.pack(">H", stmt.n_params)
+                      + b"".join(struct.pack(">i", o or 25)
+                                 for o in stmt.param_oids))
+            # Row schema without binding: trial-run SELECT-ish statements
+            # (NULL-substituted when parameterised); NoData otherwise.
+            head = stmt.sql.lstrip().lower()
+            if head.startswith(("select", "show", "tql", "explain", "with",
+                                "describe", "desc", "values")):
+                trial = self._bind_sql(stmt, [None] * stmt.n_params)
+                # schema probe only: don't pay for the rows twice
+                if head.startswith(("select", "with", "values")):
+                    trial = trial.rstrip().rstrip(";").rstrip()
+                    trial, n_subs = _TAIL_LIMIT.subn("LIMIT 0", trial)
+                    if not n_subs:
+                        trial += " LIMIT 0"
+                try:
+                    r, _, _ = await loop.run_in_executor(
+                        self.server._db_executor, self.server.db.sql_in_db,
+                        trial, self.session_db, self.session_tz)
+                    if r.column_names:
+                        types = (r.column_types
+                                 or ["String"] * len(r.column_names))
+                        self._row_description(r.column_names, types)
+                        return
+                except Exception:  # noqa: BLE001 — schema probe only
+                    pass
+            self._msg(b"n", b"")  # NoData
+            return
+        portal = self.portals.get(name)
+        if portal is None:
+            self._ext_error(f'portal "{name}" does not exist', "34000")
+            return
+        if not await self._run_portal(portal, loop):
+            return
+        r = portal.result
+        if r.column_names:
+            types = r.column_types or ["String"] * len(r.column_names)
+            formats = self._portal_formats(portal, len(r.column_names))
+            self._row_description(r.column_names, types, formats)
+        else:
+            self._msg(b"n", b"")
+
+    async def _on_execute(self, body: bytes, loop) -> None:
+        z = body.index(b"\x00")
+        name = body[:z].decode("utf-8", "replace")
+        (max_rows,) = struct.unpack_from(">i", body, z + 1)
+        portal = self.portals.get(name)
+        if portal is None:
+            self._ext_error(f'portal "{name}" does not exist', "34000")
+            return
+        if not await self._run_portal(portal, loop):
+            return
+        r = portal.result
+        low = portal.bound_sql.lower().lstrip().rstrip(";")
+        if r.column_names:
+            types = r.column_types or ["String"] * len(r.column_names)
+            oids = [_OID.get(t, 25) for t in types]
+            formats = self._portal_formats(portal, len(r.column_names))
+            chunk = (r.rows[portal.offset:portal.offset + max_rows]
+                     if max_rows > 0 else r.rows[portal.offset:])
+            for row in chunk:
+                self._data_row(row, oids, formats)
+            portal.offset += len(chunk)
+            if max_rows > 0 and portal.offset < len(r.rows):
+                self._msg(b"s", b"")  # PortalSuspended: more rows remain
+            else:
+                self._msg(b"C", f"SELECT {len(chunk)}\x00".encode())
+        else:
+            self._msg(b"C", _complete_tag(low, r) + b"\x00")
+
+    def _on_close(self, body: bytes) -> None:
+        kind, name = body[:1], body[1:].split(b"\x00")[0].decode(
+            "utf-8", "replace")
+        (self.stmts if kind == b"S" else self.portals).pop(name, None)
+        self._msg(b"3", b"")  # CloseComplete
 
     async def run(self) -> None:
         try:
@@ -130,6 +417,43 @@ class _PgConn:
                 body = await self.reader.readexactly(ln - 4)
                 if tag == b"X":  # Terminate
                     break
+                if self._skip_until_sync and tag != b"S":
+                    continue
+                if tag in (b"P", b"B", b"D", b"E", b"C"):
+                    # malformed frames (missing NUL, truncated counts)
+                    # must produce an ErrorResponse, not kill the task
+                    try:
+                        if tag == b"P":
+                            self._on_parse(body)
+                        elif tag == b"B":
+                            self._on_bind(body)
+                        elif tag == b"D":
+                            await self._on_describe(body, loop)
+                        elif tag == b"E":
+                            await self._on_execute(body, loop)
+                        else:
+                            self._on_close(body)
+                    except Exception as e:  # noqa: BLE001
+                        self._ext_error(
+                            f"malformed {tag.decode()} message: {e}", "08P01")
+                    await self.writer.drain()
+                    continue
+                if tag == b"S":  # Sync
+                    self._skip_until_sync = False
+                    # Drop exhausted portals; keep suspended/unexecuted
+                    # ones alive so cursor-style fetch (pgJDBC fetchSize:
+                    # Execute/Sync ... Execute/Sync) works across cycles.
+                    self.portals = {
+                        k: p for k, p in self.portals.items()
+                        if p.result is None or (p.result.column_names
+                                                and p.offset < len(p.result.rows))
+                    }
+                    self._ready()
+                    await self.writer.drain()
+                    continue
+                if tag == b"H":  # Flush
+                    await self.writer.drain()
+                    continue
                 if tag != b"Q":
                     self._error(f"unsupported message {tag!r}", "0A000")
                     self._ready()

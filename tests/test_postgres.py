@@ -75,6 +75,74 @@ class MiniPgClient:
                 err = body
         return names, rows, complete, err
 
+    # ---- extended protocol ------------------------------------------
+    def _send_msg(self, tag: bytes, body: bytes):
+        self.sock.sendall(tag + struct.pack(">I", len(body) + 4) + body)
+
+    def parse(self, name: str, sql: str, oids=()):
+        body = (name.encode() + b"\x00" + sql.encode() + b"\x00"
+                + struct.pack(">H", len(oids))
+                + b"".join(struct.pack(">i", o) for o in oids))
+        self._send_msg(b"P", body)
+
+    def bind(self, portal: str, stmt: str, params=(), pformats=(),
+             rformats=()):
+        body = portal.encode() + b"\x00" + stmt.encode() + b"\x00"
+        body += struct.pack(">H", len(pformats))
+        body += b"".join(struct.pack(">h", f) for f in pformats)
+        body += struct.pack(">H", len(params))
+        for p in params:
+            if p is None:
+                body += struct.pack(">i", -1)
+            else:
+                raw = p if isinstance(p, bytes) else str(p).encode()
+                body += struct.pack(">i", len(raw)) + raw
+        body += struct.pack(">H", len(rformats))
+        body += b"".join(struct.pack(">h", f) for f in rformats)
+        self._send_msg(b"B", body)
+
+    def describe(self, kind: str, name: str):
+        self._send_msg(b"D", kind.encode() + name.encode() + b"\x00")
+
+    def execute(self, portal: str = "", max_rows: int = 0):
+        self._send_msg(b"E", portal.encode() + b"\x00"
+                       + struct.pack(">i", max_rows))
+
+    def sync(self):
+        self._send_msg(b"S", b"")
+        return self._drain_until_ready()
+
+    @staticmethod
+    def collect(msgs):
+        """msgs → (names, raw rows (bytes cells), complete, err)."""
+        names, rows, complete, err = [], [], None, None
+        for tag, body in msgs:
+            if tag == b"T":
+                nf = struct.unpack(">H", body[:2])[0]
+                pos = 2
+                for _ in range(nf):
+                    nul = body.index(b"\x00", pos)
+                    names.append(body[pos:nul].decode())
+                    pos = nul + 1 + 18
+            elif tag == b"D":
+                nf = struct.unpack(">H", body[:2])[0]
+                pos = 2
+                row = []
+                for _ in range(nf):
+                    ln = struct.unpack(">i", body[pos:pos + 4])[0]
+                    pos += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(body[pos:pos + ln])
+                        pos += ln
+                rows.append(row)
+            elif tag == b"C":
+                complete = body.rstrip(b"\x00").decode()
+            elif tag == b"E":
+                err = body
+        return names, rows, complete, err
+
     def close(self):
         self.sock.sendall(b"X" + struct.pack(">I", 4))
         self.sock.close()
@@ -112,6 +180,157 @@ class TestPostgresProtocol:
         assert err is not None and b"nonexistent" in err
         names, rows, complete, err = c.query("SELECT 1 + 1")
         assert rows == [["2"]] and err is None
+        c.close()
+
+    def test_extended_text_params(self, pg):
+        c = MiniPgClient(pg.port)
+        c.query("CREATE TABLE IF NOT EXISTS ept (h STRING, ts TIMESTAMP(3)"
+                " TIME INDEX, v DOUBLE, PRIMARY KEY (h))")
+        # prepared INSERT with $1..$3 (JDBC flow: P/B/D/E/Sync)
+        c.parse("ins", "INSERT INTO ept VALUES ($1, $2, $3)",
+                oids=(25, 20, 701))
+        c.bind("", "ins", params=("x", "1000", "1.5"))
+        c.describe("P", "")
+        c.execute()
+        msgs = c.sync()
+        tags = [t for t, _ in msgs]
+        assert b"1" in tags and b"2" in tags and b"n" in tags
+        _, _, complete, err = c.collect(msgs)
+        assert err is None and complete == "INSERT 0 1"
+        # NULL param via a fresh bind of the same statement
+        c.parse("ins2", "INSERT INTO ept VALUES ($1, $2, $3)",
+                oids=(25, 20, 701))
+        c.bind("", "ins2", params=("y", "2000", None))
+        c.execute()
+        _, _, complete, err = c.collect(c.sync())
+        assert err is None and complete == "INSERT 0 1"
+        # prepared SELECT with a text param
+        c.parse("sel", "SELECT h, v FROM ept WHERE h = $1", oids=(25,))
+        c.bind("", "sel", params=("x",))
+        c.describe("P", "")
+        c.execute()
+        names, rows, complete, err = c.collect(c.sync())
+        assert err is None
+        assert names == ["h", "v"]
+        assert rows == [[b"x", b"1.5"]]
+        assert complete == "SELECT 1"
+        c.close()
+
+    def test_extended_binary_params_and_results(self, pg):
+        c = MiniPgClient(pg.port)
+        c.query("CREATE TABLE IF NOT EXISTS ebt (h STRING, ts TIMESTAMP(3)"
+                " TIME INDEX, v DOUBLE, PRIMARY KEY (h))")
+        c.query("INSERT INTO ebt VALUES ('a', 5000, 2.25)")
+        # binary int8 + float8 params
+        c.parse("q", "SELECT h, v FROM ebt WHERE ts = $1 AND v > $2",
+                oids=(20, 701))
+        c.bind("", "q",
+               params=(struct.pack(">q", 5000), struct.pack(">d", 1.0)),
+               pformats=(1, 1), rformats=(0, 1))
+        c.execute()
+        names, rows, _, err = c.collect(c.sync())
+        assert err is None
+        assert rows[0][0] == b"a"
+        assert struct.unpack(">d", rows[0][1])[0] == 2.25
+        c.close()
+
+    def test_extended_describe_statement(self, pg):
+        c = MiniPgClient(pg.port)
+        c.parse("ds", "SELECT 1 + 1, 'hi'")
+        c.describe("S", "ds")  # no bind/execute: just Describe then Sync
+        msgs = c.sync()
+        tags = [t for t, _ in msgs]
+        assert b"t" in tags  # ParameterDescription
+        names, _, _, err = c.collect(msgs)
+        assert err is None and len(names) == 2  # trial-run row schema
+        c.close()
+
+    def test_extended_error_recovery(self, pg):
+        c = MiniPgClient(pg.port)
+        # bind to a statement that was never parsed → error, then the
+        # following messages are skipped until Sync
+        c.bind("", "ghost", params=())
+        c.execute()
+        msgs = c.sync()
+        _, _, _, err = c.collect(msgs)
+        assert err is not None and b"ghost" in err
+        # connection still usable, extended and simple both
+        c.parse("ok", "SELECT 41 + 1")
+        c.bind("", "ok")
+        c.execute()
+        _, rows, _, err = c.collect(c.sync())
+        assert err is None and rows == [[b"42"]]
+        names, rows2, _, err = c.query("SELECT 7 * 6")
+        assert err is None and rows2 == [["42"]]
+        c.close()
+
+    def test_extended_max_rows_suspension(self, pg):
+        c = MiniPgClient(pg.port)
+        c.query("CREATE TABLE IF NOT EXISTS mrt (h STRING, ts TIMESTAMP(3)"
+                " TIME INDEX, PRIMARY KEY (h))")
+        c.query("INSERT INTO mrt VALUES ('a',1),('b',2),('c',3),('d',4)")
+        c.parse("mr", "SELECT h FROM mrt ORDER BY h")
+        c.bind("p1", "mr")
+        c.execute("p1", max_rows=3)
+        c.execute("p1", max_rows=3)
+        msgs = c.sync()
+        tags = [t for t, _ in msgs]
+        assert b"s" in tags  # PortalSuspended after the first chunk
+        _, rows, complete, err = c.collect(msgs)
+        assert err is None
+        assert [r[0] for r in rows] == [b"a", b"b", b"c", b"d"]
+        assert complete == "SELECT 1"  # final chunk had 1 row
+        c.close()
+
+    def test_extended_cursor_fetch_across_sync(self, pg):
+        # pgJDBC fetchSize pattern: Execute/Sync ... Execute/Sync on the
+        # same named portal; suspended portals must survive Sync
+        c = MiniPgClient(pg.port)
+        c.query("CREATE TABLE IF NOT EXISTS cft (h STRING, ts TIMESTAMP(3)"
+                " TIME INDEX, PRIMARY KEY (h))")
+        c.query("INSERT INTO cft VALUES ('a',1),('b',2),('c',3)")
+        c.parse("cf", "SELECT h FROM cft ORDER BY h")
+        c.bind("pc", "cf")
+        c.execute("pc", max_rows=2)
+        msgs = c.sync()
+        assert b"s" in [t for t, _ in msgs]  # suspended
+        _, rows1, _, err = c.collect(msgs)
+        assert err is None and [r[0] for r in rows1] == [b"a", b"b"]
+        c.execute("pc", max_rows=2)  # next fetch, new Sync cycle
+        _, rows2, complete, err = c.collect(c.sync())
+        assert err is None and [r[0] for r in rows2] == [b"c"]
+        assert complete == "SELECT 1"
+        # exhausted now → dropped at Sync
+        c.execute("pc", max_rows=2)
+        _, _, _, err = c.collect(c.sync())
+        assert err is not None and b"does not exist" in err
+        c.close()
+
+    def test_extended_untyped_numeric_param(self, pg):
+        # lib/pq-style: no declared OIDs, text-format numeric params
+        c = MiniPgClient(pg.port)
+        c.query("CREATE TABLE IF NOT EXISTS unt (h STRING, ts TIMESTAMP(3)"
+                " TIME INDEX, v DOUBLE, PRIMARY KEY (h))")
+        c.query("INSERT INTO unt VALUES ('a', 1000, 0.5), ('b', 2000, 2.5)")
+        c.parse("uq", "SELECT h FROM unt WHERE v > $1 AND ts < $2")
+        c.bind("", "uq", params=("1.0", "5000"))
+        c.execute()
+        _, rows, _, err = c.collect(c.sync())
+        assert err is None and rows == [[b"b"]]
+        c.close()
+
+    def test_extended_malformed_and_dollar0(self, pg):
+        c = MiniPgClient(pg.port)
+        # $0 is not a valid placeholder → error at Parse, recover at Sync
+        c.parse("z", "SELECT $0")
+        _, _, _, err = c.collect(c.sync())
+        assert err is not None and b"$0" in err
+        # truncated Bind body → ErrorResponse, connection survives
+        c._send_msg(b"B", b"no-nul-terminator")
+        _, _, _, err = c.collect(c.sync())
+        assert err is not None
+        _, rows, _, err = c.query("SELECT 5")
+        assert err is None and rows == [["5"]]
         c.close()
 
     def test_set_and_ssl_decline(self, pg):
