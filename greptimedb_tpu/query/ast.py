@@ -167,6 +167,40 @@ class OrderByItem:
     nulls_first: bool | None = None
 
 
+@dataclass(frozen=True)
+class WindowSpec:
+    """OVER (PARTITION BY ... ORDER BY ...) — unbounded frames only
+    (reference gets frames from DataFusion's WindowExpr; the TPU engine
+    computes windows as vectorized partition-sorted passes)."""
+
+    partition_by: tuple[Expr, ...] = ()
+    order_by: tuple[OrderByItem, ...] = ()
+
+    def __str__(self):
+        parts = []
+        if self.partition_by:
+            parts.append("PARTITION BY "
+                         + ", ".join(str(p) for p in self.partition_by))
+        if self.order_by:
+            parts.append("ORDER BY " + ", ".join(
+                f"{o.expr}{'' if o.asc else ' DESC'}" for o in self.order_by))
+        return " ".join(parts)
+
+
+@dataclass(frozen=True)
+class WindowFunc(Expr):
+    """`fn(args) OVER (spec)` — row_number/rank/dense_rank/lag/lead/
+    first_value/last_value and windowed sum/avg/count/min/max."""
+
+    name: str  # lowercase
+    args: tuple[Expr, ...] = ()
+    spec: WindowSpec = WindowSpec()
+
+    def __str__(self):
+        inner = ", ".join(str(a) for a in self.args)
+        return f"{self.name}({inner}) OVER ({self.spec})"
+
+
 @dataclass
 class JoinClause:
     """INNER / LEFT [OUTER] equi-join (reference: DataFusion joins via
@@ -201,6 +235,19 @@ class Select(Statement):
 def _map_child(v, fn):
     if isinstance(v, Expr):
         return map_expr(v, fn)
+    if isinstance(v, (WindowSpec, OrderByItem)):
+        # expression carriers that aren't Exprs themselves: rebuild with
+        # mapped children so OVER(PARTITION BY ... ORDER BY ...) is
+        # reachable by every map_expr pass (join rewrites, subqueries)
+        import dataclasses as _dc
+
+        changes = {}
+        for f in _dc.fields(v):
+            cv = getattr(v, f.name)
+            nv = _map_child(cv, fn)
+            if nv is not cv:
+                changes[f.name] = nv
+        return _dc.replace(v, **changes) if changes else v
     if isinstance(v, tuple):
         nv = tuple(_map_child(x, fn) for x in v)
         return nv if any(a is not b for a, b in zip(nv, v)) else v

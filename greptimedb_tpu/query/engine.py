@@ -21,6 +21,7 @@ from greptimedb_tpu.query.ast import (
 from greptimedb_tpu.query.exprs import TableContext, eval_host
 from greptimedb_tpu.query.physical import Executor
 from greptimedb_tpu.query.planner import SelectPlan, plan_select
+from greptimedb_tpu.query.window import collect_windows, compute_window
 
 
 @dataclass
@@ -315,6 +316,22 @@ class QueryEngine:
             else:
                 items.append(item)
 
+        # window functions: compute each once into env (eval_host then
+        # resolves WindowFunc nodes by name)
+        wfs: list = []
+        for item in items:
+            if not isinstance(item.expr, Star):
+                collect_windows(item.expr, wfs)
+        for o in plan.order_by:
+            collect_windows(o.expr, wfs)
+        if wfs:
+            if plan.is_agg:
+                raise PlanError(
+                    "window functions over GROUP BY results are not"
+                    " supported; wrap the aggregate in a subquery")
+            for wf in wfs:
+                env[str(wf)] = compute_window(wf, env, n, eval_host)
+
         out_cols: dict[str, np.ndarray] = {}
         for item in items:
             key = item.output_name
@@ -469,6 +486,16 @@ def _infer_type(expr, plan: SelectPlan) -> str:
             return "Float64"
         if expr.name in ("date_bin", "date_trunc"):
             return ctx.schema.time_index.dtype.value if ctx.schema.time_index else "Int64"
+        return "Float64"
+    from greptimedb_tpu.query.ast import WindowFunc as _WF
+    if isinstance(expr, _WF):
+        if expr.name in ("row_number", "rank", "dense_rank", "ntile",
+                         "count"):
+            return "Int64"
+        if expr.name in ("lag", "lead", "first_value", "last_value", "sum",
+                         "min", "max") and expr.args and isinstance(
+                             expr.args[0], Column):
+            return _infer_type(expr.args[0], plan)
         return "Float64"
     if isinstance(expr, Literal):
         v = expr.value

@@ -25,7 +25,7 @@ from greptimedb_tpu.errors import ColumnNotFound, PlanError, Unsupported
 from greptimedb_tpu.ops.time import date_trunc_bucket, time_bucket
 from greptimedb_tpu.query.ast import (
     Between, BinaryOp, Case, Cast, Column, Expr, FuncCall, InList, IntervalLit,
-    IsNull, Literal, Star, UnaryOp,
+    IsNull, Literal, Star, UnaryOp, WindowFunc,
 )
 from greptimedb_tpu.query.parser import parse_timestamp_str
 
@@ -739,6 +739,11 @@ def eval_host(e: Expr, env: dict[str, np.ndarray], n: int):
         if e.name.lower() in lower:
             return env[lower[e.name.lower()]]
         raise ColumnNotFound(e.name)
+    if isinstance(e, WindowFunc):
+        key = str(e)
+        if key in env:
+            return env[key]
+        raise PlanError(f"window function outside SELECT items: {key}")
     if isinstance(e, FuncCall):
         key = str(e)
         if key in env:

@@ -351,6 +351,30 @@ class Parser:
                 fill = self.next().text
         return SelectItem(e, alias, rng, fill)
 
+    def _maybe_window(self, name: str, args: tuple) -> Expr:
+        """After `fn(args)`: consume OVER (...) into a WindowFunc, or
+        return the plain FuncCall."""
+        if not self.eat_kw("OVER"):
+            return FuncCall(name, args)
+        from greptimedb_tpu.query.ast import WindowFunc, WindowSpec
+
+        self.expect(Tok.PUNCT, "(")
+        partition: list[Expr] = []
+        order: list[OrderByItem] = []
+        if self.eat_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition.append(self.expr())
+            while self.eat(Tok.PUNCT, ","):
+                partition.append(self.expr())
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            order.append(self.order_item())
+            while self.eat(Tok.PUNCT, ","):
+                order.append(self.order_item())
+        self.expect(Tok.PUNCT, ")")
+        return WindowFunc(name, args,
+                          WindowSpec(tuple(partition), tuple(order)))
+
     def order_item(self) -> OrderByItem:
         e = self.expr()
         asc = True
@@ -514,7 +538,7 @@ class Parser:
                 if self.at(Tok.OP, "*"):
                     self.next()
                     self.expect(Tok.PUNCT, ")")
-                    return FuncCall(name.lower(), (Star(),))
+                    return self._maybe_window(name.lower(), (Star(),))
                 distinct = self.eat_kw("DISTINCT")
                 args: list[Expr] = []
                 if not self.at(Tok.PUNCT, ")"):
@@ -522,6 +546,8 @@ class Parser:
                     while self.eat(Tok.PUNCT, ","):
                         args.append(self.expr())
                 self.expect(Tok.PUNCT, ")")
+                if not distinct and self.at_kw("OVER"):
+                    return self._maybe_window(name.lower(), tuple(args))
                 return FuncCall(name.lower(), tuple(args), distinct)
             if self.at(Tok.PUNCT, "."):
                 self.next()

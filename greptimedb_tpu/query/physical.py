@@ -513,6 +513,46 @@ class Executor:
         return kernel
 
     # ---- raw (non-aggregate) path -------------------------------------
+    @staticmethod
+    def _topk_spec(plan: SelectPlan, ctx, table: DeviceTable) -> dict | None:
+        """Eligibility for the device top-k raw scan: ORDER BY keys must
+        all be numeric device columns whose code order equals value order
+        (so NOT tags / string-dict fields), LIMIT must be present and
+        small, and the projection must not contain window functions
+        (their value depends on the full row set)."""
+        from greptimedb_tpu.query.ast import WindowFunc
+        from greptimedb_tpu.query.ast import expr_contains
+
+        if plan.limit is None or not plan.order_by or plan.distinct:
+            return None
+        if plan.having is not None:
+            # HAVING filters on the host AFTER the device truncates;
+            # top-k would drop rows the filter needs
+            return None
+        k = plan.limit + (plan.offset or 0)
+        if k > (1 << 16) or k >= table.padded_rows:
+            return None
+        for item in plan.items:
+            if not isinstance(item.expr, Star) and expr_contains(
+                    item.expr, WindowFunc):
+                return None
+        keys = []
+        for o in plan.order_by:
+            e = o.expr
+            if not isinstance(e, Column):
+                return None
+            try:
+                name = ctx.resolve(e.name)
+            except Exception:  # noqa: BLE001
+                return None
+            if name not in table.columns or not ctx.schema.has_column(name):
+                return None
+            c = ctx.schema.column(name)
+            if c.is_tag or c.dtype.is_string_like:
+                return None
+            keys.append((name, o.asc, o.nulls_first))
+        return {"k": k, "keys": tuple(keys)}
+
     def _execute_raw(
         self, plan: SelectPlan, table: DeviceTable
     ) -> tuple[dict[str, np.ndarray], int]:
@@ -533,28 +573,70 @@ class Executor:
             referenced_columns(o.expr, ctx, needed)
         cols = sorted(needed & set(table.columns.keys()))
 
+        # Device top-k: ORDER BY <numeric device columns> LIMIT k sorts and
+        # slices ON DEVICE, so only k rows cross to the host instead of the
+        # whole filtered table (reference: part_sort/windowed-sort execs,
+        # src/query/src/part_sort.rs).  The host re-sorts the k survivors,
+        # so device selection only has to return the right SET.
+        topk = self._topk_spec(plan, ctx, table)
+
         dict_ver = tuple(len(ctx.encoders[c.name]) for c in ctx.schema.tag_columns)
         cache_key = (
             "raw", plan.fingerprint(), table.padded_rows, tuple(cols), dict_ver,
-            lo, hi, _vec_fingerprint(plan, table),
+            lo, hi, _vec_fingerprint(plan, table), topk and tuple(topk.items()),
         )
         kernel = self._cache.get(cache_key)
         if kernel is None:
-
-            @jax.jit
-            def kernel(t: DeviceTable):
-                env = dict(t.columns)
-                mask = t.row_mask
+            def filter_mask(env, row_mask):
+                """The ONE raw-scan filter (shared by both kernels so the
+                top-k path can never diverge from the full scan)."""
+                mask = row_mask
                 if lo is not None and ts_name is not None:
                     mask = mask & (env[ts_name] >= lo)
                 if hi is not None and ts_name is not None:
                     mask = mask & (env[ts_name] < hi)
                 if where_fn is not None:
                     mask = mask & where_fn(env)
-                sub = {c: env[c] for c in cols}
-                packed, new_mask = compact_rows(sub, mask)
-                packed["__n__"] = jnp.sum(mask.astype(jnp.int64))
-                return packed
+                return mask
+
+            if topk is not None:
+                k = topk["k"]
+                spec = topk["keys"]  # ((col, asc, nulls_first), ...)
+
+                @jax.jit
+                def kernel(t: DeviceTable):
+                    env = dict(t.columns)
+                    mask = filter_mask(env, t.row_mask)
+                    keys = []  # minor → major for lexsort
+                    for col, asc, nulls_first in reversed(spec):
+                        v = env[col]
+                        if jnp.issubdtype(v.dtype, jnp.floating):
+                            isnull = jnp.isnan(v)
+                            nf = (not asc) if nulls_first is None else nulls_first
+                            rank = jnp.where(isnull, 0 if nf else 2, 1)
+                            v = jnp.where(isnull, 0, v)
+                        else:
+                            if v.dtype == jnp.bool_:
+                                v = v.astype(jnp.int32)
+                            rank = jnp.ones_like(v, dtype=jnp.int32)
+                        keys.append(v if asc else -v)
+                        keys.append(rank)
+                    keys.append(~mask)  # invalid rows sort last
+                    order = jnp.lexsort(tuple(keys))[:k]
+                    packed = {c: env[c][order] for c in cols}
+                    packed["__n__"] = jnp.minimum(
+                        jnp.sum(mask.astype(jnp.int64)), k)
+                    return packed
+            else:
+
+                @jax.jit
+                def kernel(t: DeviceTable):
+                    env = dict(t.columns)
+                    mask = filter_mask(env, t.row_mask)
+                    sub = {c: env[c] for c in cols}
+                    packed, new_mask = compact_rows(sub, mask)
+                    packed["__n__"] = jnp.sum(mask.astype(jnp.int64))
+                    return packed
 
             self._cache[cache_key] = kernel
         out = kernel(table)

@@ -14,7 +14,7 @@ from dataclasses import dataclass, field
 from greptimedb_tpu.errors import PlanError, Unsupported
 from greptimedb_tpu.query.ast import (
     Between, BinaryOp, Case, Cast, Column, Expr, FuncCall, InList, IntervalLit,
-    IsNull, Literal, OrderByItem, Select, SelectItem, Star, UnaryOp,
+    IsNull, Literal, OrderByItem, Select, SelectItem, Star, UnaryOp, WindowFunc,
 )
 from greptimedb_tpu.query.exprs import (
     AGG_FUNCS, TableContext, collect_aggs, is_aggregate,
@@ -339,6 +339,13 @@ def referenced_columns(e: Expr, ctx: TableContext, out: set[str]) -> None:
     elif isinstance(e, FuncCall):
         for a in e.args:
             referenced_columns(a, ctx, out)
+    elif isinstance(e, WindowFunc):
+        for a in e.args:
+            referenced_columns(a, ctx, out)
+        for p in e.spec.partition_by:
+            referenced_columns(p, ctx, out)
+        for o in e.spec.order_by:
+            referenced_columns(o.expr, ctx, out)
     elif isinstance(e, Between):
         referenced_columns(e.expr, ctx, out)
         referenced_columns(e.low, ctx, out)

@@ -1,0 +1,261 @@
+"""Window functions (OVER clauses) and the device top-k sort path.
+
+Reference gets windows from DataFusion WindowAggExec and part-sort from
+src/query/src/part_sort.rs; here they are vectorized partition-sorted
+passes (query/window.py) and an on-device lexsort+slice (physical.py).
+"""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.errors import PlanError, Unsupported
+from greptimedb_tpu.standalone import GreptimeDB
+
+
+@pytest.fixture
+def db():
+    d = GreptimeDB()
+    yield d
+    d.close()
+
+
+@pytest.fixture
+def w(db):
+    db.sql("CREATE TABLE w (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+           " v DOUBLE, PRIMARY KEY (h))")
+    db.sql("INSERT INTO w VALUES "
+           "('a',1000,1.0),('a',2000,3.0),('a',3000,2.0),"
+           "('b',1000,5.0),('b',2000,4.0)")
+    return db
+
+
+class TestRanking:
+    def test_row_number(self, w):
+        r = w.sql("SELECT h, ts, row_number() OVER (PARTITION BY h"
+                  " ORDER BY ts) AS rn FROM w ORDER BY h, ts")
+        assert [row[2] for row in r.rows] == [1, 2, 3, 1, 2]
+
+    def test_row_number_no_partition(self, w):
+        r = w.sql("SELECT ts, row_number() OVER (ORDER BY v DESC) AS rn"
+                  " FROM w ORDER BY rn")
+        # v: 5,4,3,2,1 → rows by desc v
+        assert [row[1] for row in r.rows] == [1, 2, 3, 4, 5]
+
+    def test_rank_and_dense_rank_with_ties(self, db):
+        db.sql("CREATE TABLE r (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO r VALUES ('x',1,10.0),('x',2,10.0),"
+               "('x',3,20.0),('x',4,30.0)")
+        r = db.sql("SELECT ts, rank() OVER (ORDER BY v) AS rk,"
+                   " dense_rank() OVER (ORDER BY v) AS dr"
+                   " FROM r ORDER BY ts")
+        assert [row[1] for row in r.rows] == [1, 1, 3, 4]
+        assert [row[2] for row in r.rows] == [1, 1, 2, 3]
+
+    def test_ntile(self, w):
+        r = w.sql("SELECT ts, ntile(2) OVER (PARTITION BY h ORDER BY ts)"
+                  " AS t FROM w ORDER BY h, ts")
+        assert [row[1] for row in r.rows] == [1, 1, 2, 1, 2]
+
+
+class TestNavigation:
+    def test_lag_lead(self, w):
+        r = w.sql("SELECT h, ts, lag(v) OVER (PARTITION BY h ORDER BY ts)"
+                  " AS pv, lead(v) OVER (PARTITION BY h ORDER BY ts) AS nv"
+                  " FROM w ORDER BY h, ts")
+        assert [row[2] for row in r.rows] == [None, 1.0, 3.0, None, 5.0]
+        assert [row[3] for row in r.rows] == [3.0, 2.0, None, 4.0, None]
+
+    def test_lag_offset_default(self, w):
+        r = w.sql("SELECT ts, lag(v, 2, -1.0) OVER (PARTITION BY h"
+                  " ORDER BY ts) AS pv FROM w ORDER BY h, ts")
+        assert [row[1] for row in r.rows] == [-1.0, -1.0, 1.0, -1.0, -1.0]
+
+    def test_first_last_value(self, w):
+        r = w.sql("SELECT h, ts, first_value(v) OVER (PARTITION BY h"
+                  " ORDER BY ts) AS fv, last_value(v) OVER (PARTITION BY h"
+                  " ORDER BY ts) AS lv FROM w ORDER BY h, ts")
+        assert [row[2] for row in r.rows] == [1.0, 1.0, 1.0, 5.0, 5.0]
+        # last_value computed over the whole partition (documented)
+        assert [row[3] for row in r.rows] == [2.0, 2.0, 2.0, 4.0, 4.0]
+
+
+class TestWindowedAggregates:
+    def test_running_sum_count_avg(self, w):
+        r = w.sql("SELECT h, ts, sum(v) OVER (PARTITION BY h ORDER BY ts)"
+                  " AS s, count(v) OVER (PARTITION BY h ORDER BY ts) AS c,"
+                  " avg(v) OVER (PARTITION BY h ORDER BY ts) AS a"
+                  " FROM w ORDER BY h, ts")
+        assert [row[2] for row in r.rows] == [1.0, 4.0, 6.0, 5.0, 9.0]
+        assert [row[3] for row in r.rows] == [1, 2, 3, 1, 2]
+        assert [row[4] for row in r.rows] == [1.0, 2.0, 2.0, 5.0, 4.5]
+
+    def test_running_sum_negative_values_partition_reset(self, db):
+        # regression: the per-partition base must be indexed, not
+        # maximum-accumulated (negative sums shrink the prefix)
+        db.sql("CREATE TABLE neg (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO neg VALUES ('a',1,-5.0),('a',2,-7.0),"
+               "('b',1,1.0),('b',2,2.0)")
+        r = db.sql("SELECT h, ts, sum(v) OVER (PARTITION BY h ORDER BY ts)"
+                   " AS s FROM neg ORDER BY h, ts")
+        assert [row[2] for row in r.rows] == [-5.0, -12.0, 1.0, 3.0]
+
+    def test_running_min_max(self, w):
+        r = w.sql("SELECT h, ts, min(v) OVER (PARTITION BY h ORDER BY ts)"
+                  " AS mn, max(v) OVER (PARTITION BY h ORDER BY ts) AS mx"
+                  " FROM w ORDER BY h, ts")
+        assert [row[2] for row in r.rows] == [1.0, 1.0, 1.0, 5.0, 4.0]
+        assert [row[3] for row in r.rows] == [1.0, 3.0, 3.0, 5.0, 5.0]
+
+    def test_peers_share_frame_end(self, db):
+        # RANGE frame: tied ORDER BY values share the cumulative value
+        db.sql("CREATE TABLE pe (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " k DOUBLE, v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO pe VALUES ('x',1,1.0,10.0),('x',2,1.0,20.0),"
+               "('x',3,2.0,30.0)")
+        r = db.sql("SELECT ts, sum(v) OVER (ORDER BY k) AS s FROM pe"
+                   " ORDER BY ts")
+        assert [row[1] for row in r.rows] == [30.0, 30.0, 60.0]
+
+    def test_whole_partition_totals(self, w):
+        r = w.sql("SELECT h, sum(v) OVER (PARTITION BY h) AS s,"
+                  " count(*) OVER (PARTITION BY h) AS c FROM w"
+                  " ORDER BY h, ts")
+        assert [row[1] for row in r.rows] == [6.0, 6.0, 6.0, 9.0, 9.0]
+        assert [row[2] for row in r.rows] == [3, 3, 3, 2, 2]
+
+    def test_count_star_over_all(self, w):
+        r = w.sql("SELECT count(*) OVER () AS c FROM w")
+        assert [row[0] for row in r.rows] == [5] * 5
+
+
+class TestWindowEdges:
+    def test_window_with_where(self, w):
+        r = w.sql("SELECT h, ts, row_number() OVER (PARTITION BY h"
+                  " ORDER BY ts) AS rn FROM w WHERE ts >= 2000"
+                  " ORDER BY h, ts")
+        # window runs over the filtered rows only
+        assert [row[2] for row in r.rows] == [1, 2, 1]
+
+    def test_order_by_window_output(self, w):
+        r = w.sql("SELECT ts, v, row_number() OVER (ORDER BY v DESC) AS rn"
+                  " FROM w ORDER BY rn LIMIT 2")
+        assert [row[1] for row in r.rows] == [5.0, 4.0]
+
+    def test_window_over_group_by_rejected(self, w):
+        with pytest.raises((PlanError, Unsupported)):
+            w.sql("SELECT h, rank() OVER (ORDER BY sum(v)) FROM w"
+                  " GROUP BY h")
+
+    def test_unknown_window_function(self, w):
+        with pytest.raises((PlanError, Unsupported)):
+            w.sql("SELECT percent_rank() OVER (ORDER BY v) FROM w")
+
+    def test_all_null_partition_returns_null(self, db):
+        db.sql("CREATE TABLE an (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO an VALUES ('a',1,NULL),('a',2,NULL),"
+               "('b',1,7.0)")
+        r = db.sql("SELECT h, min(v) OVER (PARTITION BY h) AS mn,"
+                   " sum(v) OVER (PARTITION BY h) AS s,"
+                   " avg(v) OVER (PARTITION BY h) AS a,"
+                   " count(v) OVER (PARTITION BY h) AS c"
+                   " FROM an ORDER BY h, ts")
+        assert r.rows[0][1:] == [None, None, None, 0]
+        assert r.rows[2][1:] == [7.0, 7.0, 7.0, 1]
+
+    def test_running_before_first_nonnull_is_null(self, db):
+        db.sql("CREATE TABLE rb (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO rb VALUES ('a',1,NULL),('a',2,4.0),('a',3,2.0)")
+        r = db.sql("SELECT ts, min(v) OVER (ORDER BY ts) AS mn,"
+                   " sum(v) OVER (ORDER BY ts) AS s FROM rb ORDER BY ts")
+        assert r.rows[0][1:] == [None, None]
+        assert r.rows[1][1:] == [4.0, 4.0]
+        assert r.rows[2][1:] == [2.0, 6.0]
+
+    def test_negative_lag_is_lead(self, w):
+        a = w.sql("SELECT ts, lag(v, -1) OVER (PARTITION BY h ORDER BY ts)"
+                  " AS x FROM w ORDER BY h, ts")
+        b = w.sql("SELECT ts, lead(v, 1) OVER (PARTITION BY h ORDER BY ts)"
+                  " AS x FROM w ORDER BY h, ts")
+        assert a.rows == b.rows
+
+    def test_zero_arg_aggregate_rejected(self, w):
+        with pytest.raises((PlanError, Unsupported, Exception)):
+            w.sql("SELECT sum() OVER () FROM w")
+
+    def test_window_in_join(self, db):
+        # map_expr must descend into OVER(...) for join column rewrites
+        db.sql("CREATE TABLE j1 (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("CREATE TABLE j2 (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " u DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO j1 VALUES ('a',1,1.0),('b',1,2.0)")
+        db.sql("INSERT INTO j2 VALUES ('a',1,10.0),('b',1,20.0)")
+        r = db.sql("SELECT j1.h, rank() OVER (ORDER BY j2.u DESC) AS rk"
+                   " FROM j1 JOIN j2 ON j1.h = j2.h ORDER BY j1.h")
+        assert r.rows == [["a", 2], ["b", 1]]
+
+    def test_window_in_expression(self, w):
+        r = w.sql("SELECT ts, v - lag(v) OVER (PARTITION BY h ORDER BY ts)"
+                  " AS delta FROM w ORDER BY h, ts")
+        deltas = [row[1] for row in r.rows]
+        assert deltas[0] is None or np.isnan(deltas[0])
+        assert deltas[1] == 2.0 and deltas[2] == -1.0
+
+
+class TestDeviceTopK:
+    @pytest.fixture
+    def big(self, db):
+        db.sql("CREATE TABLE big (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        rows = ", ".join(
+            f"('h{i % 7}', {1000 + i * 10}, {float((i * 37) % 100)})"
+            for i in range(500))
+        db.sql("INSERT INTO big VALUES " + rows)
+        return db
+
+    def test_topk_matches_full_sort(self, big):
+        full = big.sql("SELECT h, ts, v FROM big ORDER BY v DESC, ts")
+        k = big.sql("SELECT h, ts, v FROM big ORDER BY v DESC, ts LIMIT 10")
+        assert k.rows == full.rows[:10]
+
+    def test_topk_with_offset(self, big):
+        full = big.sql("SELECT ts, v FROM big ORDER BY v, ts")
+        k = big.sql("SELECT ts, v FROM big ORDER BY v, ts LIMIT 7 OFFSET 3")
+        assert k.rows == full.rows[3:10]
+
+    def test_topk_with_where(self, big):
+        full = big.sql("SELECT ts, v FROM big WHERE v >= 50 ORDER BY ts")
+        k = big.sql("SELECT ts, v FROM big WHERE v >= 50 ORDER BY ts LIMIT 5")
+        assert k.rows == full.rows[:5]
+
+    def test_topk_null_ordering(self, db):
+        db.sql("CREATE TABLE nk (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO nk VALUES ('a',1,1.0),('a',2,NULL),"
+               "('a',3,3.0),('a',4,NULL),('a',5,2.0)")
+        # ASC default: NULLS LAST
+        r = db.sql("SELECT ts, v FROM nk ORDER BY v LIMIT 3")
+        assert [row[1] for row in r.rows] == [1.0, 2.0, 3.0]
+        # DESC default: NULLS FIRST
+        r = db.sql("SELECT ts, v FROM nk ORDER BY v DESC LIMIT 3")
+        assert [row[1] for row in r.rows] == [None, None, 3.0]
+        # explicit NULLS LAST under DESC
+        r = db.sql("SELECT ts, v FROM nk ORDER BY v DESC NULLS LAST LIMIT 3")
+        assert [row[1] for row in r.rows] == [3.0, 2.0, 1.0]
+
+    def test_having_disables_topk(self, big):
+        # HAVING filters host-side after the scan; top-k truncation
+        # before it would drop qualifying rows
+        full = big.sql("SELECT ts, v FROM big HAVING v > 50 ORDER BY v, ts")
+        k = big.sql("SELECT ts, v FROM big HAVING v > 50 ORDER BY v, ts"
+                    " LIMIT 5")
+        assert k.rows == full.rows[:5] and len(k.rows) == 5
+
+    def test_tag_order_falls_back_to_host(self, big):
+        # tags sort lexicographically, not by dict code: host path
+        r = big.sql("SELECT h FROM big ORDER BY h DESC LIMIT 2")
+        assert [row[0] for row in r.rows] == ["h6", "h6"]
