@@ -103,6 +103,16 @@ def cmd_datanode(args) -> int:
     return 0
 
 
+def cmd_kvstore(args) -> int:
+    """Shared metadata-store role process (etcd/RDS analog: an
+    SqliteKv-backed Flight service every metasrv/frontend can point at;
+    reference src/common/meta/src/kv_backend/{etcd,rds})."""
+    from greptimedb_tpu.rpc.kvservice import serve
+
+    serve(args.path, host=args.host, port=args.port)
+    return 0
+
+
 def cmd_meta(args) -> int:
     """Metadata snapshot/restore (reference greptime cli metadata
     snapshot, src/cli/src/metadata/snapshot.rs): dump the entire typed
@@ -324,6 +334,16 @@ def main(argv: list[str] | None = None) -> int:
                          "self-fencing; without it leader leases self-renew "
                          "on write)")
     pd.set_defaults(fn=cmd_datanode)
+
+    pk = sub.add_parser("kvstore",
+                        help="run a shared metadata store (etcd analog)")
+    pk.add_argument("action", choices=["start"])
+    pk.add_argument("--path", required=True,
+                    help="sqlite database file backing the key-space")
+    pk.add_argument("--host", default="127.0.0.1")
+    pk.add_argument("--port", type=int, default=0,
+                    help="0 = pick a free port (printed as JSON on stdout)")
+    pk.set_defaults(fn=cmd_kvstore)
 
     pm = sub.add_parser("meta", help="metadata snapshot / restore")
     pm.add_argument("action", choices=["snapshot", "restore"])

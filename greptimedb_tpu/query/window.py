@@ -215,9 +215,21 @@ def compute_window(wf: WindowFunc, env: dict, n: int, eval_host) -> np.ndarray:
         if not (wf.args and isinstance(wf.args[0], Literal)):
             raise PlanError("ntile(n) requires an integer literal")
         buckets = int(wf.args[0].value)
+        if buckets <= 0:
+            raise PlanError("ntile(n): n must be positive")
         sizes = np.bincount(sp.seg)  # rows per partition
         size_of = sizes[sp.seg]
-        return sp.unsort((sp.pos * buckets) // np.maximum(size_of, 1) + 1)
+        # SQL: the first (size % buckets) buckets get one extra row
+        base = size_of // buckets
+        rem = size_of % buckets
+        big_span = (base + 1) * rem  # rows covered by the larger buckets
+        in_big = sp.pos < big_span
+        tile = np.where(
+            in_big,
+            sp.pos // np.maximum(base + 1, 1) + 1,
+            rem + (sp.pos - big_span) // np.maximum(base, 1) + 1,
+        )
+        return sp.unsort(tile)
 
     if name in ("lag", "lead"):
         vals = np.asarray(eval_host(wf.args[0], env, n), dtype=object)
@@ -269,6 +281,7 @@ def compute_window(wf: WindowFunc, env: dict, n: int, eval_host) -> np.ndarray:
         return sp.unsort(_denullify(sv[last[sp.seg]]))
 
     # windowed aggregates ------------------------------------------------
+    decode = None  # for string min/max: code → value
     if name == "count" and wf.args and isinstance(wf.args[0], Star):
         vals = np.ones(n)
         nulls = np.zeros(n, dtype=bool)
@@ -278,7 +291,23 @@ def compute_window(wf: WindowFunc, env: dict, n: int, eval_host) -> np.ndarray:
             raw = np.full(n, raw[()])
         if raw.dtype == object:
             nulls = np.array([v is None for v in raw], dtype=bool)
-            vals = np.where(nulls, 0, raw).astype(np.float64)
+            numeric = all(
+                isinstance(v, (int, float, np.integer, np.floating))
+                for v in raw[~nulls])
+            if numeric:
+                vals = np.where(nulls, 0, raw).astype(np.float64)
+            elif name == "count":
+                vals = np.zeros(n)  # only the null mask matters
+            elif name in ("min", "max"):
+                # factorized codes are ordered by value, so min/max of
+                # codes IS min/max of values; decode at the end
+                codes, nulls = _factorize(raw, n)
+                uniq = np.unique(raw[~nulls].astype(str))
+                decode = np.array(list(uniq) + [None], dtype=object)
+                vals = codes.astype(np.float64)
+            else:
+                raise PlanError(
+                    f"{name}() over a non-numeric column")
         else:
             vals = raw.astype(np.float64)
             nulls = np.isnan(vals)
@@ -290,6 +319,14 @@ def compute_window(wf: WindowFunc, env: dict, n: int, eval_host) -> np.ndarray:
     # empty frames (no non-null value yet / all-null partition) → NULL
     # for sum/avg/min/max, 0 for count — SQL semantics, matching the
     # grouped path's cnt>0 guard (ops/segment.py)
+    def finish(out, cnt):
+        res = np.where(cnt > 0, out, np.nan)
+        if decode is not None:  # string min/max: codes → values
+            codes = np.where(np.isnan(res), len(decode) - 1,
+                             res).astype(np.int64)
+            res = decode[codes]
+        return sp.unsort(res)
+
     if not wf.spec.order_by:  # whole-partition totals
         cnt = np.bincount(sp.seg, weights=(~snull).astype(float),
                           minlength=nseg)[sp.seg]
@@ -302,7 +339,7 @@ def compute_window(wf: WindowFunc, env: dict, n: int, eval_host) -> np.ndarray:
         else:
             masked = np.where(snull, np.inf if name == "min" else -np.inf, sv)
             out = _seg_totals(sp.seg, masked, nseg, name)[sp.seg]
-        return sp.unsort(np.where(cnt > 0, out, np.nan))
+        return finish(out, cnt)
 
     # running with ORDER BY
     rc = _running(sp, (~snull).astype(float), "count")
@@ -314,4 +351,4 @@ def compute_window(wf: WindowFunc, env: dict, n: int, eval_host) -> np.ndarray:
     else:
         masked = np.where(snull, np.inf if name == "min" else -np.inf, sv)
         out = _running(sp, masked, name)
-    return sp.unsort(np.where(rc > 0, out, np.nan))
+    return finish(out, rc)

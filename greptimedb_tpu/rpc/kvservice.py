@@ -1,0 +1,143 @@
+"""Shared metadata KV service: the etcd analog.
+
+The reference points metasrv at an external etcd/RDS cluster
+(src/common/meta/src/kv_backend/etcd.rs, kv_backend/rds/) so every
+metasrv/frontend process sees one metadata key-space.  Here the same
+role is played by a small Arrow Flight service wrapping any local
+KvBackend (SqliteKv for durability), plus ``RemoteKv`` — a KvBackend
+whose every call is an RPC, so Metasrv/CatalogManager run unmodified
+against a shared remote store.
+
+Values travel base64-encoded inside the JSON action bodies (metadata
+values are small; the data plane never goes through here).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+
+import pyarrow.flight as fl
+
+from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.meta.kv import KvBackend
+
+
+def _e(v: bytes) -> str:
+    return base64.b64encode(v).decode()
+
+
+def _d(s: str | None) -> bytes | None:
+    return None if s is None else base64.b64decode(s)
+
+
+class KvFlightServer(fl.FlightServerBase):
+    """Serves one KvBackend's key-space over Flight do_action."""
+
+    def __init__(self, backing: KvBackend, host: str = "127.0.0.1",
+                 port: int = 0):
+        location = f"grpc://{host}:{port}"
+        super().__init__(location)
+        self.backing = backing
+        self.address = f"{host}:{self.port}"
+
+    def do_action(self, context, action):
+        kind = action.type
+        body = json.loads(action.body.to_pybytes().decode()) if (
+            action.body is not None and len(action.body)
+        ) else {}
+        kv = self.backing
+        if kind == "kv_get":
+            v = kv.get(body["key"])
+            out = {"value": None if v is None else _e(v)}
+        elif kind == "kv_put":
+            kv.put(body["key"], _d(body["value"]))
+            out = {"ok": True}
+        elif kind == "kv_delete":
+            out = {"deleted": kv.delete(body["key"])}
+        elif kind == "kv_range":
+            out = {"entries": [
+                [k, _e(v)] for k, v in kv.range(body.get("prefix", ""))
+            ]}
+        elif kind == "kv_cas":
+            out = {"ok": kv.compare_and_put(
+                body["key"], _d(body.get("expect")), _d(body["value"]))}
+        elif kind == "kv_cad":
+            out = {"ok": kv.compare_and_delete(
+                body["key"], _d(body["expect"]))}
+        elif kind == "kv_bulk_replace":
+            kv.bulk_replace({k: _d(v) for k, v in body["entries"]})
+            out = {"ok": True}
+        elif kind == "health":
+            out = {"ok": True}
+        elif kind == "shutdown":
+            threading.Thread(target=self.shutdown, daemon=True).start()
+            yield fl.Result(json.dumps({"ok": True}).encode())
+            return
+        else:
+            raise GreptimeError(f"unknown kv action {kind}")
+        yield fl.Result(json.dumps(out).encode())
+
+
+class RemoteKv(KvBackend):
+    """KvBackend over a shared KvFlightServer (etcd-analog client).
+
+    CAS/CAD atomicity holds across processes because the server executes
+    them against its backing store's own transactions."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._conn = fl.connect(f"grpc://{address}")
+        self._lock = threading.Lock()  # Flight clients aren't thread-safe
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def _call(self, kind: str, body: dict) -> dict:
+        with self._lock:
+            results = list(self._conn.do_action(
+                fl.Action(kind, json.dumps(body).encode())))
+        return json.loads(results[0].body.to_pybytes().decode())
+
+    def get(self, key: str) -> bytes | None:
+        return _d(self._call("kv_get", {"key": key})["value"])
+
+    def put(self, key: str, value: bytes) -> None:
+        self._call("kv_put", {"key": key, "value": _e(bytes(value))})
+
+    def delete(self, key: str) -> bool:
+        return self._call("kv_delete", {"key": key})["deleted"]
+
+    def range(self, prefix: str) -> list[tuple[str, bytes]]:
+        out = self._call("kv_range", {"prefix": prefix})
+        return [(k, _d(v)) for k, v in out["entries"]]
+
+    def compare_and_put(
+        self, key: str, expect: bytes | None, value: bytes
+    ) -> bool:
+        return self._call("kv_cas", {
+            "key": key,
+            "expect": None if expect is None else _e(bytes(expect)),
+            "value": _e(bytes(value)),
+        })["ok"]
+
+    def compare_and_delete(self, key: str, expect: bytes) -> bool:
+        return self._call("kv_cad", {
+            "key": key, "expect": _e(bytes(expect)),
+        })["ok"]
+
+    def bulk_replace(self, entries: dict[str, bytes]) -> None:
+        self._call("kv_bulk_replace", {
+            "entries": [[k, _e(bytes(v))] for k, v in entries.items()],
+        })
+
+
+def serve(path: str, host: str = "127.0.0.1", port: int = 0) -> None:
+    """Blocking entry point for the metadata-store role process
+    (``greptime kvstore start``)."""
+    from greptimedb_tpu.meta.kv import SqliteKv
+
+    server = KvFlightServer(SqliteKv(path), host, port)
+    print(json.dumps({"address": server.address}), flush=True)
+    server.serve()

@@ -188,7 +188,13 @@ class GreptimeDB(TableProvider):
         *,
         region_options: RegionOptions | None = None,
         cache_capacity_bytes: int = 8 << 30,
+        metadata_store: str | None = None,
     ):
+        """``metadata_store`` selects the kv backend (reference
+        [metadata_store]/meta backend config): None → file-backed (or
+        memory when data_home is None), "sqlite" → SqliteKv (RDS
+        analog), "memory", or "remote://host:port" → shared KvServer
+        (etcd analog)."""
         # sanity-check the accelerator backend: if the configured platform
         # can't initialize (e.g. the TPU relay is down), fall back to CPU
         # rather than failing every query
@@ -207,11 +213,26 @@ class GreptimeDB(TableProvider):
             data_home = self._tmp.name
         self.data_home = data_home
         os.makedirs(data_home, exist_ok=True)
-        self.kv: KvBackend = (
-            MemoryKv()
-            if self.memory_mode
-            else FileKv(os.path.join(data_home, "metadata", "kv.json"))
-        )
+        if metadata_store is None:
+            self.kv: KvBackend = (
+                MemoryKv()
+                if self.memory_mode
+                else FileKv(os.path.join(data_home, "metadata", "kv.json"))
+            )
+        elif metadata_store == "memory":
+            self.kv = MemoryKv()
+        elif metadata_store == "sqlite":
+            from greptimedb_tpu.meta.kv import SqliteKv
+
+            self.kv = SqliteKv(
+                os.path.join(data_home, "metadata", "kv.sqlite"))
+        elif metadata_store.startswith("remote://"):
+            from greptimedb_tpu.rpc.kvservice import RemoteKv
+
+            self.kv = RemoteKv(metadata_store[len("remote://"):])
+        else:
+            raise InvalidArguments(
+                f"unknown metadata_store {metadata_store!r}")
         self.catalog = CatalogManager(self.kv)
         self.regions = RegionEngine(
             os.path.join(data_home, "data"), region_options
@@ -268,6 +289,8 @@ class GreptimeDB(TableProvider):
 
     def close(self) -> None:
         self.regions.close()
+        if hasattr(self.kv, "close"):
+            self.kv.close()
 
     # ---- TableProvider -------------------------------------------------
     def _split_name(self, table: str) -> tuple[str, str]:

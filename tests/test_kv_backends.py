@@ -1,0 +1,218 @@
+"""SqliteKv (RDS analog) and RemoteKv/KvFlightServer (etcd analog).
+
+Reference: src/common/meta/src/kv_backend/{etcd.rs,rds/}; every backend
+must satisfy the same KvBackend contract, so the conformance suite is
+parameterized over all of them.
+"""
+
+import os
+import threading
+
+import pytest
+
+from greptimedb_tpu.meta.kv import FileKv, MemoryKv, SqliteKv
+
+
+def _mk_memory(tmp):
+    return MemoryKv()
+
+
+def _mk_file(tmp):
+    return FileKv(os.path.join(tmp, "kv.json"))
+
+
+def _mk_sqlite(tmp):
+    return SqliteKv(os.path.join(tmp, "kv.sqlite"))
+
+
+@pytest.fixture(params=[_mk_memory, _mk_file, _mk_sqlite],
+                ids=["memory", "file", "sqlite"])
+def kv(request, tmp_path):
+    backend = request.param(str(tmp_path))
+    yield backend
+    if hasattr(backend, "close"):
+        backend.close()
+
+
+class TestKvConformance:
+    def test_get_put_delete(self, kv):
+        assert kv.get("a") is None
+        kv.put("a", b"1")
+        assert kv.get("a") == b"1"
+        kv.put("a", b"2")  # overwrite
+        assert kv.get("a") == b"2"
+        assert kv.delete("a") is True
+        assert kv.delete("a") is False
+        assert kv.get("a") is None
+
+    def test_range_sorted_prefix(self, kv):
+        for k in ("t/b", "t/a", "u/x", "t/c", "s/1"):
+            kv.put(k, k.encode())
+        assert [k for k, _ in kv.range("t/")] == ["t/a", "t/b", "t/c"]
+        assert len(kv.range("")) == 5
+        assert kv.range("zz") == []
+
+    def test_range_astral_and_uffff_keys(self, kv):
+        # prefix scans must see keys whose suffix starts above U+FFFF
+        kv.put("t/plain", b"1")
+        kv.put("t/￿x", b"2")
+        kv.put("t/\U0001F600name", b"3")
+        assert len(kv.range("t/")) == 3
+
+    def test_range_keys_with_like_metachars(self, kv):
+        # % and _ are SQL LIKE wildcards; range must treat them literally
+        kv.put("a%b", b"1")
+        kv.put("a_c", b"2")
+        kv.put("axc", b"3")
+        assert [k for k, _ in kv.range("a%")] == ["a%b"]
+        assert [k for k, _ in kv.range("a_")] == ["a_c"]
+
+    def test_compare_and_put(self, kv):
+        assert kv.compare_and_put("k", None, b"v1") is True
+        assert kv.compare_and_put("k", None, b"v2") is False
+        assert kv.compare_and_put("k", b"wrong", b"v2") is False
+        assert kv.compare_and_put("k", b"v1", b"v2") is True
+        assert kv.get("k") == b"v2"
+
+    def test_compare_and_delete(self, kv):
+        kv.put("k", b"v")
+        assert kv.compare_and_delete("k", b"other") is False
+        assert kv.compare_and_delete("k", b"v") is True
+        assert kv.get("k") is None
+        assert kv.compare_and_delete("k", b"v") is False
+
+    def test_bulk_replace(self, kv):
+        kv.put("old", b"x")
+        kv.bulk_replace({"n1": b"1", "n2": b"2"})
+        assert kv.get("old") is None
+        assert [k for k, _ in kv.range("")] == ["n1", "n2"]
+
+    def test_binary_values(self, kv):
+        blob = bytes(range(256))
+        kv.put("bin", blob)
+        assert kv.get("bin") == blob
+
+    def test_cas_contention(self, kv):
+        kv.put("ctr", b"0")
+        wins = []
+
+        def bump():
+            for _ in range(50):
+                while True:
+                    cur = kv.get("ctr")
+                    if kv.compare_and_put(
+                            "ctr", cur, str(int(cur) + 1).encode()):
+                        wins.append(1)
+                        break
+
+        ts = [threading.Thread(target=bump) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert kv.get("ctr") == b"200" and len(wins) == 200
+
+
+class TestSqliteDurability:
+    def test_survives_reopen(self, tmp_path):
+        path = os.path.join(str(tmp_path), "kv.sqlite")
+        kv = SqliteKv(path)
+        kv.put("catalog/t1", b"schema")
+        kv.compare_and_put("lease", None, b"node-1")
+        kv.close()
+        kv2 = SqliteKv(path)
+        assert kv2.get("catalog/t1") == b"schema"
+        assert kv2.get("lease") == b"node-1"
+        kv2.close()
+
+
+class TestRemoteKv:
+    @pytest.fixture
+    def remote(self, tmp_path):
+        from greptimedb_tpu.rpc.kvservice import KvFlightServer, RemoteKv
+
+        backing = SqliteKv(os.path.join(str(tmp_path), "shared.sqlite"))
+        server = KvFlightServer(backing)
+        t = threading.Thread(target=server.serve, daemon=True)
+        t.start()
+        client = RemoteKv(server.address)
+        yield client, backing, server
+        client.close()
+        server.shutdown()
+        backing.close()
+
+    def test_roundtrip(self, remote):
+        client, backing, _ = remote
+        client.put("k", b"v")
+        assert client.get("k") == b"v"
+        assert backing.get("k") == b"v"  # really remote, same store
+        assert client.get("missing") is None
+        assert client.delete("k") is True
+        assert client.delete("k") is False
+
+    def test_range_and_cas(self, remote):
+        client, _, _ = remote
+        client.put("r/1", b"a")
+        client.put("r/2", bytes(range(7)))
+        assert client.range("r/") == [("r/1", b"a"),
+                                      ("r/2", bytes(range(7)))]
+        assert client.compare_and_put("c", None, b"1") is True
+        assert client.compare_and_put("c", None, b"2") is False
+        assert client.compare_and_put("c", b"1", b"2") is True
+        assert client.compare_and_delete("c", b"1") is False
+        assert client.compare_and_delete("c", b"2") is True
+
+    def test_two_clients_share_keyspace(self, remote):
+        from greptimedb_tpu.rpc.kvservice import RemoteKv
+
+        client, _, server = remote
+        other = RemoteKv(server.address)
+        client.put("shared", b"from-1")
+        assert other.get("shared") == b"from-1"
+        # CAS from the second client sees the first's write
+        assert other.compare_and_put("shared", b"from-1", b"from-2")
+        assert client.get("shared") == b"from-2"
+        other.close()
+
+    def test_bulk_replace_remote(self, remote):
+        client, _, _ = remote
+        client.put("gone", b"x")
+        client.bulk_replace({"a": b"1"})
+        assert client.get("gone") is None
+        assert client.get("a") == b"1"
+
+
+class TestStandaloneOnBackends:
+    def test_sqlite_metadata_store_durable(self, tmp_path):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        home = str(tmp_path / "home")
+        db = GreptimeDB(home, metadata_store="sqlite")
+        db.sql("CREATE TABLE st (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO st VALUES ('a', 1000, 1.5)")
+        db.close()
+        db2 = GreptimeDB(home, metadata_store="sqlite")
+        assert db2.sql("SELECT h, v FROM st").rows == [["a", 1.5]]
+        assert os.path.exists(os.path.join(home, "metadata", "kv.sqlite"))
+        db2.close()
+
+    def test_remote_metadata_store(self, tmp_path):
+        from greptimedb_tpu.rpc.kvservice import KvFlightServer
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        backing = SqliteKv(os.path.join(str(tmp_path), "meta.sqlite"))
+        server = KvFlightServer(backing)
+        threading.Thread(target=server.serve, daemon=True).start()
+
+        home = str(tmp_path / "home")
+        db = GreptimeDB(home, metadata_store=f"remote://{server.address}")
+        db.sql("CREATE TABLE rt (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO rt VALUES ('a', 1000, 2.5)")
+        assert db.sql("SELECT v FROM rt").rows == [[2.5]]
+        # the catalog really lives in the shared store
+        assert any("rt" in k for k, _ in backing.range(""))
+        db.close()
+        server.shutdown()
+        backing.close()

@@ -57,6 +57,35 @@ class TestRanking:
                   " AS t FROM w ORDER BY h, ts")
         assert [row[1] for row in r.rows] == [1, 1, 2, 1, 2]
 
+    def test_ntile_remainder_to_leading_buckets(self, db):
+        # SQL: first (n % buckets) buckets get the extra row → 3,3,2,2
+        db.sql("CREATE TABLE nt (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " PRIMARY KEY (h))")
+        db.sql("INSERT INTO nt VALUES " + ",".join(
+            f"('x',{i})" for i in range(1, 11)))
+        r = db.sql("SELECT ts, ntile(4) OVER (ORDER BY ts) AS t FROM nt"
+                   " ORDER BY ts")
+        assert [row[1] for row in r.rows] == [1, 1, 1, 2, 2, 2, 3, 3, 4, 4]
+        with pytest.raises((PlanError, Unsupported)):
+            db.sql("SELECT ntile(0) OVER (ORDER BY ts) FROM nt")
+
+    def test_string_count_min_max_window(self, db):
+        # NULL strings surface as "" engine-wide (device columns have no
+        # null repr — the documented storage design), so they count as
+        # present and sort first
+        db.sql("CREATE TABLE sw (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " name STRING, PRIMARY KEY (h))")
+        db.sql("INSERT INTO sw VALUES ('a',1,'zeta'),('a',2,NULL),"
+               "('a',3,'alpha'),('b',1,'mid')")
+        r = db.sql("SELECT h, count(name) OVER (PARTITION BY h) AS c,"
+                   " min(name) OVER (PARTITION BY h) AS mn,"
+                   " max(name) OVER (PARTITION BY h) AS mx"
+                   " FROM sw ORDER BY h, ts")
+        assert r.rows[0][1:] == [3, "", "zeta"]
+        assert r.rows[3][1:] == [1, "mid", "mid"]
+        with pytest.raises((PlanError, Unsupported)):
+            db.sql("SELECT sum(name) OVER () FROM sw")
+
 
 class TestNavigation:
     def test_lag_lead(self, w):
