@@ -252,6 +252,14 @@ class Metasrv:
             kv, services={"datanodes": self.datanodes, "metasrv": self}
         )
         self.procedures.register(RegionMigrationProcedure)
+        from greptimedb_tpu.meta.reconciliation import (
+            ReconcileCatalogProcedure, ReconcileDatabaseProcedure,
+            ReconcileTableProcedure,
+        )
+
+        self.procedures.register(ReconcileTableProcedure)
+        self.procedures.register(ReconcileDatabaseProcedure)
+        self.procedures.register(ReconcileCatalogProcedure)
         self.maintenance_mode = False
 
     # ---- membership ----------------------------------------------------
@@ -370,3 +378,31 @@ class Metasrv:
                        now_ms: float) -> dict:
         """Manual migration (reference admin migrate_region function)."""
         return self._submit_migration(region_id, from_node, to_node, now_ms)
+
+    # ---- reconciliation (reference reconciliation/manager.rs) ----------
+    def reconcile_table(self, db: str, table: str,
+                        strategy: str = "use_latest") -> dict:
+        from greptimedb_tpu.meta.reconciliation import ReconcileTableProcedure
+
+        return self.procedures.submit(ReconcileTableProcedure(state={
+            "db": db, "table": table, "strategy": strategy,
+        }))
+
+    def reconcile_database(self, db: str,
+                           strategy: str = "use_latest") -> dict:
+        from greptimedb_tpu.meta.reconciliation import (
+            ReconcileDatabaseProcedure,
+        )
+
+        return self.procedures.submit(ReconcileDatabaseProcedure(state={
+            "db": db, "strategy": strategy,
+        }))
+
+    def reconcile_catalog(self, strategy: str = "use_latest") -> dict:
+        from greptimedb_tpu.meta.reconciliation import (
+            ReconcileCatalogProcedure,
+        )
+
+        return self.procedures.submit(ReconcileCatalogProcedure(state={
+            "strategy": strategy,
+        }))

@@ -1,0 +1,317 @@
+"""Reconciliation: repair drift between metadata and region reality.
+
+Reference: src/common/meta/src/reconciliation/{manager,reconcile_catalog,
+reconcile_database,reconcile_table}.rs + the admin functions
+src/common/function/src/admin/reconcile_*.rs.  Metadata can drift from
+what datanodes actually host (crashed DDL, lost routes, online schema
+growth in the metric engine, manual data moves); reconciliation walks
+catalog → database → table, makes region reality match the routes, and
+resolves schema disagreements by strategy:
+
+- ``use_latest`` (default): the schema with the most columns wins when
+  the candidates form a subset chain (online growth only ever adds
+  columns); incomparable schemas are reported, never guessed.
+- ``use_metasrv``: the catalog's schema is kept; drift is reported.
+- ``use_datanode``: the hosting region's schema wins.
+
+Cluster mode runs as journaled procedures (resumable, locked per
+table); standalone mode reconciles the embedded catalog against the
+local RegionEngine (``ADMIN reconcile_table(...)`` & friends).
+"""
+
+from __future__ import annotations
+
+import time
+
+from greptimedb_tpu.errors import GreptimeError, InvalidArguments
+from greptimedb_tpu.meta.catalog import CatalogManager
+from greptimedb_tpu.meta.procedure import Procedure, Status
+
+STRATEGIES = ("use_latest", "use_metasrv", "use_datanode")
+
+
+def _colnames(schema) -> set[str]:
+    return {c.name for c in schema}
+
+
+def resolve_schema(catalog_schema, region_schemas: list, strategy: str):
+    """→ (resolved_schema | None, conflict: bool).  None = keep catalog."""
+    if strategy not in STRATEGIES:
+        raise InvalidArguments(f"unknown resolve strategy {strategy!r}")
+    if strategy == "use_metasrv" or not region_schemas:
+        return None, False
+    candidates = [catalog_schema] + list(region_schemas)
+    if strategy == "use_datanode":
+        candidates = list(region_schemas)
+    best = max(candidates, key=lambda s: len(list(s)))
+    best_cols = _colnames(best)
+    for s in candidates:
+        if not _colnames(s) <= best_cols:
+            return None, True  # incomparable: report, don't guess
+    if _colnames(best) == _colnames(catalog_schema) and strategy != "use_datanode":
+        return None, False
+    if strategy == "use_datanode" and best.to_dict() == catalog_schema.to_dict():
+        return None, False
+    return best, False
+
+
+def _reconcile_region(ms, rid: int, schema, now_ms: float) -> list[str]:
+    """Make one region's reality match its route; returns fix labels."""
+    fixes: list[str] = []
+    routed = ms.region_route(rid)
+    hosts = {
+        nid: dn.roles.get(rid, "follower")
+        for nid, dn in ms.datanodes.items()
+        if dn.alive and rid in dn.engine.regions
+    }
+    leaders = [n for n, r in hosts.items() if r == "leader"]
+
+    if routed is None or routed not in ms.datanodes or not ms.datanodes[routed].alive:
+        new = (leaders[0] if leaders
+               else next(iter(sorted(hosts)), None))
+        if new is None:
+            new = ms.select_target(exclude=set())
+        if new is None:
+            fixes.append(f"region {rid}: unplaceable (no alive node)")
+            return fixes
+        ms.set_region_route(rid, new)
+        fixes.append(f"region {rid}: routed to node {new}")
+        routed = new
+
+    if routed not in hosts:
+        instr = {"kind": "open_region", "region_id": rid, "role": "leader"}
+        if schema is not None:
+            instr["schema"] = schema.to_dict()
+        ms.datanodes[routed].handle_instruction(instr, now_ms)
+        fixes.append(f"region {rid}: opened as leader on node {routed}")
+    elif hosts[routed] != "leader":
+        ms.datanodes[routed].handle_instruction(
+            {"kind": "upgrade_region", "region_id": rid}, now_ms)
+        fixes.append(f"region {rid}: promoted on node {routed}")
+
+    for nid in leaders:
+        if nid != routed:
+            # stray leader (split brain after bad failover): downgrade
+            # (flushes its buffered writes durably) then re-open as a
+            # read replica; the route is the source of truth
+            dn = ms.datanodes[nid]
+            dn.handle_instruction(
+                {"kind": "downgrade_region", "region_id": rid}, now_ms)
+            instr = {"kind": "open_region", "region_id": rid,
+                     "role": "follower"}
+            if schema is not None:
+                instr["schema"] = schema.to_dict()
+            dn.handle_instruction(instr, now_ms)
+            fixes.append(f"region {rid}: demoted stray leader on node {nid}")
+    return fixes
+
+
+def reconcile_table_inline(ms, kv, db: str, table: str,
+                           strategy: str = "use_latest") -> dict:
+    """One full table reconciliation pass against a Metasrv."""
+    if strategy not in STRATEGIES:
+        raise InvalidArguments(f"unknown resolve strategy {strategy!r}")
+    cat = CatalogManager(kv)
+    info = cat.get_table(db, table)
+    now_ms = time.time() * 1000.0
+    fixes: list[str] = []
+    for rid in info.region_ids:
+        fixes.extend(_reconcile_region(ms, rid, info.schema, now_ms))
+
+    region_schemas = []
+    for rid in info.region_ids:
+        routed = ms.region_route(rid)
+        dn = ms.datanodes.get(routed)
+        if dn is not None and rid in dn.engine.regions:
+            region_schemas.append(dn.engine.regions[rid].schema)
+    resolved, conflict = resolve_schema(info.schema, region_schemas, strategy)
+    if conflict:
+        fixes.append("schema conflict: candidates are not a subset chain"
+                     " (left unresolved)")
+    elif resolved is not None:
+        info.schema = resolved
+        cat.update_table(info)
+        fixes.append("catalog schema updated from region reality")
+    return {"table": f"{db}.{table}", "strategy": strategy, "fixes": fixes}
+
+
+class ReconcileTableProcedure(Procedure):
+    """Journaled per-table reconciliation (reference reconcile_table.rs):
+    region steps persist progress so a crashed coordinator resumes."""
+
+    type_name = "reconcile_table"
+
+    def execute(self, ctx) -> Status:
+        st = self.state
+        ms = ctx.services["metasrv"]
+        phase = st.get("phase", "start")
+        if phase == "start":
+            if st.get("strategy", "use_latest") not in STRATEGIES:
+                raise InvalidArguments(
+                    f"unknown resolve strategy {st['strategy']!r}")
+            cat = CatalogManager(ctx.kv)
+            info = cat.get_table(st["db"], st["table"])
+            st["region_ids"] = list(info.region_ids)
+            st["i"] = 0
+            st["fixes"] = []
+            st["phase"] = "regions"
+            return Status.executing()
+        if phase == "regions":
+            cat = CatalogManager(ctx.kv)
+            info = cat.get_table(st["db"], st["table"])
+            if st["i"] < len(st["region_ids"]):
+                rid = st["region_ids"][st["i"]]
+                st["fixes"].extend(_reconcile_region(
+                    ms, rid, info.schema, time.time() * 1000.0))
+                st["i"] += 1
+                return Status.executing()
+            st["phase"] = "schema"
+            return Status.executing()
+        if phase == "schema":
+            cat = CatalogManager(ctx.kv)
+            info = cat.get_table(st["db"], st["table"])
+            region_schemas = []
+            for rid in st["region_ids"]:
+                routed = ms.region_route(rid)
+                dn = ms.datanodes.get(routed)
+                if dn is not None and rid in dn.engine.regions:
+                    region_schemas.append(dn.engine.regions[rid].schema)
+            resolved, conflict = resolve_schema(
+                info.schema, region_schemas, st.get("strategy", "use_latest"))
+            if conflict:
+                st["fixes"].append("schema conflict: candidates are not a"
+                                   " subset chain (left unresolved)")
+            elif resolved is not None:
+                info.schema = resolved
+                cat.update_table(info)
+                st["fixes"].append("catalog schema updated from region"
+                                   " reality")
+            return Status.done({
+                "table": f"{st['db']}.{st['table']}",
+                "strategy": st.get("strategy", "use_latest"),
+                "fixes": st["fixes"],
+            })
+        raise GreptimeError(f"unknown reconcile phase {phase}")
+
+    def lock_keys(self) -> list[str]:
+        return [f"table/{self.state['db']}/{self.state['table']}"]
+
+
+class ReconcileDatabaseProcedure(Procedure):
+    """All tables in one database, one table per journaled step."""
+
+    type_name = "reconcile_database"
+
+    def execute(self, ctx) -> Status:
+        st = self.state
+        ms = ctx.services["metasrv"]
+        if "tables" not in st:
+            cat = CatalogManager(ctx.kv)
+            st["tables"] = [t.name for t in cat.list_tables(st["db"])]
+            st["i"] = 0
+            st["reports"] = []
+            return Status.executing()
+        if st["i"] < len(st["tables"]):
+            st["reports"].append(reconcile_table_inline(
+                ms, ctx.kv, st["db"], st["tables"][st["i"]],
+                st.get("strategy", "use_latest")))
+            st["i"] += 1
+            return Status.executing()
+        return Status.done({"database": st["db"], "reports": st["reports"]})
+
+    def lock_keys(self) -> list[str]:
+        return [f"database/{self.state['db']}"]
+
+
+class ReconcileCatalogProcedure(Procedure):
+    """Every database (reference reconcile_catalog.rs)."""
+
+    type_name = "reconcile_catalog"
+
+    def execute(self, ctx) -> Status:
+        st = self.state
+        ms = ctx.services["metasrv"]
+        if "dbs" not in st:
+            cat = CatalogManager(ctx.kv)
+            st["dbs"] = cat.list_databases()
+            st["i"] = 0
+            st["reports"] = []
+            return Status.executing()
+        if st["i"] < len(st["dbs"]):
+            cat = CatalogManager(ctx.kv)
+            db = st["dbs"][st["i"]]
+            for t in cat.list_tables(db):
+                st["reports"].append(reconcile_table_inline(
+                    ms, ctx.kv, db, t.name, st.get("strategy", "use_latest")))
+            st["i"] += 1
+            return Status.executing()
+        return Status.done({"reports": st["reports"]})
+
+
+# ---- standalone mode ----------------------------------------------------
+
+def reconcile_standalone(db, database: str | None = None,
+                         table: str | None = None,
+                         strategy: str = "use_latest") -> dict:
+    """Reconcile the embedded catalog against the local RegionEngine
+    (standalone's analog of the cluster procedures): reopen referenced
+    regions that exist on storage but aren't open, adopt region schema
+    growth into the catalog, and report orphan region directories."""
+    if strategy not in STRATEGIES:
+        raise InvalidArguments(f"unknown resolve strategy {strategy!r}")
+    from greptimedb_tpu.errors import RegionNotFound
+
+    reports = []
+    dbs = [database] if database else db.catalog.list_databases()
+    referenced: set[int] = set()
+    for dbname in dbs:
+        tables = ([db.catalog.get_table(dbname, table)] if table
+                  else db.catalog.list_tables(dbname))
+        for info in tables:
+            if info.engine == "file":
+                continue  # external tables have no regions
+            fixes: list[str] = []
+            region_schemas = []
+            for rid in info.region_ids:
+                referenced.add(rid)
+                region = db.regions.regions.get(rid)
+                if region is None:
+                    try:
+                        region = db.regions.open_region(rid)
+                        fixes.append(f"region {rid}: reopened from storage")
+                    except RegionNotFound:
+                        fixes.append(f"region {rid}: MISSING on storage")
+                        continue
+                region_schemas.append(region.schema)
+            resolved, conflict = resolve_schema(
+                info.schema, region_schemas, strategy)
+            if conflict:
+                fixes.append("schema conflict: candidates are not a subset"
+                             " chain (left unresolved)")
+            elif resolved is not None:
+                info.schema = resolved
+                db.catalog.update_table(info)
+                fixes.append("catalog schema updated from region reality")
+            reports.append({
+                "table": f"{dbname}.{info.name}",
+                "fixes": fixes,
+            })
+    report = {"strategy": strategy, "reports": reports}
+    if table is None and database is None:
+        # orphan sweep is only sound at full-catalog scope: a narrower
+        # run's `referenced` set would flag other databases' live
+        # regions as orphans
+        orphans: set[int] = set(
+            rid for rid in db.regions.regions
+            if rid not in referenced and rid > 0)
+        for path in db.regions.store.list(""):
+            head = path.split("/", 1)[0]
+            if head.startswith("region_"):
+                try:
+                    rid = int(head[len("region_"):])
+                except ValueError:
+                    continue
+                if rid not in referenced and rid > 0:
+                    orphans.add(rid)
+        report["orphan_regions"] = sorted(orphans)
+    return report

@@ -421,6 +421,16 @@ class Use(Statement):
 
 
 @dataclass
+class Admin(Statement):
+    """ADMIN fn(args...) — management functions run as statements
+    (reference src/common/function/src/admin/: flush/compact/reconcile,
+    statements/admin.rs)."""
+
+    func: str  # lowercase
+    args: tuple = ()  # literal values
+
+
+@dataclass
 class Tql(Statement):
     """TQL EVAL (start, end, step) <promql> — reference statements/tql.rs."""
 

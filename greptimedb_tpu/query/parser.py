@@ -218,7 +218,28 @@ class Parser:
             return self.copy()
         if kw == "SET":
             return self.set_var()
+        if kw == "ADMIN":
+            return self.admin()
         raise SyntaxError_(f"unrecognized statement keyword: {t.text!r} at {t.pos}")
+
+    def admin(self) -> Statement:
+        """ADMIN fn('arg', ...) — reference statements/admin.rs."""
+        from greptimedb_tpu.query.ast import Admin
+
+        self.expect_kw("ADMIN")
+        name = self.ident().lower()
+        args: list = []
+        if self.eat(Tok.PUNCT, "("):
+            while not self.at(Tok.PUNCT, ")"):
+                e = self.expr()
+                if not isinstance(e, Literal):
+                    raise SyntaxError_(
+                        f"ADMIN {name}: arguments must be literals")
+                args.append(e.value)
+                if not self.eat(Tok.PUNCT, ","):
+                    break
+            self.expect(Tok.PUNCT, ")")
+        return Admin(name, tuple(args))
 
     # ---- SELECT ---------------------------------------------------------
     def select_or_union(self) -> Statement:

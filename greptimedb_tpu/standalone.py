@@ -21,10 +21,10 @@ from greptimedb_tpu.errors import (
 from greptimedb_tpu.meta.catalog import DEFAULT_DB, CatalogManager, TableInfo
 from greptimedb_tpu.meta.kv import FileKv, KvBackend, MemoryKv
 from greptimedb_tpu.query.ast import (
-    AlterTable, ColumnDef, CreateDatabase, CreateFlow, CreateTable, Delete,
-    DescribeTable, DropDatabase, DropFlow, DropTable, Explain, Insert, Select,
-    ShowCreateTable, ShowDatabases, ShowFlows, ShowTables, Statement, Tql,
-    TruncateTable, Use,
+    Admin, AlterTable, ColumnDef, CreateDatabase, CreateFlow, CreateTable,
+    Delete, DescribeTable, DropDatabase, DropFlow, DropTable, Explain, Insert,
+    Select, ShowCreateTable, ShowDatabases, ShowFlows, ShowTables, Statement,
+    Tql, TruncateTable, Use,
 )
 from greptimedb_tpu.query.engine import QueryEngine, QueryResult, TableProvider
 from greptimedb_tpu.query.exprs import TableContext
@@ -516,6 +516,8 @@ class GreptimeDB(TableProvider):
             return QueryResult([], [], affected_rows=1)
         if isinstance(stmt, AlterTable):
             return self._alter_table(stmt)
+        if isinstance(stmt, Admin):
+            return self._admin(stmt)
         if isinstance(stmt, ShowDatabases):
             from greptimedb_tpu.meta import information_schema as info
 
@@ -635,6 +637,62 @@ class GreptimeDB(TableProvider):
                         self.regions.drop_region(rid)
                     self.cache.invalidate_region(rid)
         return QueryResult([], [], affected_rows=1)
+
+    def _admin(self, stmt) -> QueryResult:
+        """ADMIN functions (reference src/common/function/src/admin/):
+        flush/compact by table or region, and reconciliation."""
+        import json as _json
+
+        from greptimedb_tpu.meta.reconciliation import reconcile_standalone
+
+        name, args = stmt.func, list(stmt.args)
+
+        def result(payload) -> QueryResult:
+            return QueryResult(
+                [f"ADMIN {name}"],
+                [[payload if isinstance(payload, str)
+                  else _json.dumps(payload)]],
+                column_types=["String"])
+
+        if name in ("flush_table", "compact_table"):
+            if len(args) != 1:
+                raise InvalidArguments(f"ADMIN {name}(table_name)")
+            for region in self._regions_of(str(args[0])):
+                region.flush()
+                if name == "compact_table":
+                    region.compact()
+            return result("ok")
+        if name in ("flush_region", "compact_region"):
+            if len(args) != 1:
+                raise InvalidArguments(f"ADMIN {name}(region_id)")
+            try:
+                rid = int(args[0])
+            except (TypeError, ValueError):
+                raise InvalidArguments(
+                    f"ADMIN {name}: region id must be an integer")
+            region = self.regions.regions.get(rid)
+            if region is None:
+                raise TableNotFound(f"region {args[0]} not open")
+            region.flush()
+            if name == "compact_region":
+                region.compact()
+            return result("ok")
+        if name == "reconcile_table":
+            if not args:
+                raise InvalidArguments(
+                    "ADMIN reconcile_table(table_name[, strategy])")
+            db, table = self._split_name(str(args[0]))
+            strategy = str(args[1]) if len(args) > 1 else "use_latest"
+            return result(reconcile_standalone(
+                self, db, table, strategy=strategy))
+        if name == "reconcile_database":
+            db = str(args[0]) if args else self.current_db
+            strategy = str(args[1]) if len(args) > 1 else "use_latest"
+            return result(reconcile_standalone(self, db, strategy=strategy))
+        if name == "reconcile_catalog":
+            strategy = str(args[0]) if args else "use_latest"
+            return result(reconcile_standalone(self, strategy=strategy))
+        raise Unsupported(f"ADMIN function {name}")
 
     def _alter_table(self, stmt: AlterTable) -> QueryResult:
         db, name = self._split_name(stmt.table)

@@ -1,0 +1,210 @@
+"""Reconciliation: metadata vs region reality repair.
+
+Reference: src/common/meta/src/reconciliation/ + ADMIN functions
+src/common/function/src/admin/reconcile_*.rs.  Tests inject drift
+(lost routes, stray leaders, schema growth, closed/orphan regions) and
+assert the reconcilers repair exactly what the strategy allows.
+"""
+
+import json
+
+import pytest
+
+from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+from greptimedb_tpu.datatypes.types import ConcreteDataType as T
+from greptimedb_tpu.datatypes.types import SemanticType as S
+from greptimedb_tpu.errors import GreptimeError, InvalidArguments, Unsupported
+from greptimedb_tpu.meta.catalog import CatalogManager
+from greptimedb_tpu.meta.cluster import Datanode, Metasrv
+from greptimedb_tpu.meta.kv import MemoryKv
+from greptimedb_tpu.standalone import GreptimeDB
+
+
+def schema(extra: tuple = ()):
+    return Schema((
+        ColumnSchema("h", T.STRING, S.TAG),
+        ColumnSchema("ts", T.TIMESTAMP_MILLISECOND, S.TIMESTAMP),
+        ColumnSchema("v", T.FLOAT64, S.FIELD),
+    ) + extra)
+
+
+class TestClusterReconcile:
+    def make(self, tmp_path, n=2):
+        kv = MemoryKv()
+        ms = Metasrv(kv)
+        nodes = []
+        for i in range(n):
+            dn = Datanode(i, str(tmp_path))
+            ms.register_datanode(dn)
+            nodes.append(dn)
+        cat = CatalogManager(kv)
+        cat.create_database("public", if_not_exists=True)
+        return ms, nodes, cat, kv
+
+    def seed_table(self, ms, nodes, cat, name="t", rid=2001):
+        info = cat.create_table("public", name, schema())
+        info.region_ids = [rid]
+        cat.update_table(info)
+        nodes[0].handle_instruction(
+            {"kind": "open_region", "region_id": rid, "role": "leader",
+             "schema": schema().to_dict()}, 0.0)
+        ms.set_region_route(rid, 0)
+        return info, rid
+
+    def test_noop_when_consistent(self, tmp_path):
+        ms, nodes, cat, _ = self.make(tmp_path)
+        self.seed_table(ms, nodes, cat)
+        out = ms.reconcile_table("public", "t")
+        assert out["fixes"] == []
+
+    def test_lost_route_restored_from_leader(self, tmp_path):
+        ms, nodes, cat, kv = self.make(tmp_path)
+        _, rid = self.seed_table(ms, nodes, cat)
+        kv.delete(f"__meta/route/region/{rid}")
+        out = ms.reconcile_table("public", "t")
+        assert any("routed to node 0" in f for f in out["fixes"])
+        assert ms.region_route(rid) == 0
+
+    def test_route_points_at_nonhosting_node(self, tmp_path):
+        ms, nodes, cat, _ = self.make(tmp_path)
+        _, rid = self.seed_table(ms, nodes, cat)
+        ms.set_region_route(rid, 1)  # drift: node 1 doesn't host rid
+        out = ms.reconcile_table("public", "t")
+        assert any("opened as leader on node 1" in f for f in out["fixes"])
+        assert any("demoted stray leader on node 0" in f
+                   for f in out["fixes"])
+        assert nodes[1].roles[rid] == "leader"
+        assert nodes[0].roles[rid] == "follower"
+
+    def test_stray_second_leader_demoted(self, tmp_path):
+        ms, nodes, cat, _ = self.make(tmp_path)
+        _, rid = self.seed_table(ms, nodes, cat)
+        # split brain: node 1 also believes it leads
+        nodes[1].handle_instruction(
+            {"kind": "open_region", "region_id": rid, "role": "leader",
+             "schema": schema().to_dict()}, 0.0)
+        out = ms.reconcile_table("public", "t")
+        assert any("demoted stray leader on node 1" in f
+                   for f in out["fixes"])
+        assert nodes[0].roles[rid] == "leader"
+        assert nodes[1].roles[rid] == "follower"
+
+    def test_schema_growth_adopted_use_latest(self, tmp_path):
+        ms, nodes, cat, _ = self.make(tmp_path)
+        _, rid = self.seed_table(ms, nodes, cat)
+        # region grew a label column online (metric-engine style)
+        region = nodes[0].engine.regions[rid]
+        region.add_tag_column("pod")
+        out = ms.reconcile_table("public", "t")
+        assert any("schema updated" in f for f in out["fixes"])
+        assert "pod" in {c.name for c in cat.get_table("public", "t").schema}
+
+    def test_schema_growth_kept_use_metasrv(self, tmp_path):
+        ms, nodes, cat, _ = self.make(tmp_path)
+        _, rid = self.seed_table(ms, nodes, cat)
+        nodes[0].engine.regions[rid].add_tag_column("pod")
+        out = ms.reconcile_table("public", "t", strategy="use_metasrv")
+        assert not any("schema updated" in f for f in out["fixes"])
+        assert "pod" not in {
+            c.name for c in cat.get_table("public", "t").schema}
+
+    def test_reconcile_database_and_catalog(self, tmp_path):
+        ms, nodes, cat, kv = self.make(tmp_path)
+        self.seed_table(ms, nodes, cat, name="t1", rid=2001)
+        self.seed_table(ms, nodes, cat, name="t2", rid=2002)
+        kv.delete("__meta/route/region/2002")
+        out = ms.reconcile_database("public")
+        assert len(out["reports"]) == 2
+        fixed = [r for r in out["reports"] if r["fixes"]]
+        assert len(fixed) == 1 and "t2" in fixed[0]["table"]
+        out2 = ms.reconcile_catalog()
+        assert all(not r["fixes"] for r in out2["reports"])  # now clean
+
+    def test_procedure_journaled(self, tmp_path):
+        ms, nodes, cat, _ = self.make(tmp_path)
+        self.seed_table(ms, nodes, cat)
+        ms.reconcile_table("public", "t")
+        hist = ms.procedures.history()
+        assert any(h["type"] == "reconcile_table" and h["status"] == "done"
+                   for h in hist)
+
+    def test_bad_strategy_rejected(self, tmp_path):
+        ms, nodes, cat, _ = self.make(tmp_path)
+        self.seed_table(ms, nodes, cat)
+        with pytest.raises((GreptimeError, InvalidArguments)):
+            ms.reconcile_database("public", strategy="use_magic")
+        with pytest.raises((GreptimeError, InvalidArguments)):
+            ms.reconcile_table("public", "t", strategy="use_magic")
+
+    def test_stray_leader_demotion_flushes(self, tmp_path):
+        # the stray's buffered writes must be durably flushed on demotion
+        ms, nodes, cat, _ = self.make(tmp_path)
+        _, rid = self.seed_table(ms, nodes, cat)
+        nodes[1].handle_instruction(
+            {"kind": "open_region", "region_id": rid, "role": "leader",
+             "schema": schema().to_dict()}, 0.0)
+        nodes[1].lease_until_ms[rid] = 1e15
+        nodes[1].write(rid, {"h": ["s"], "ts": [9000], "v": [9.0]}, 1.0)
+        assert nodes[1].engine.regions[rid].memtable.num_rows > 0
+        ms.reconcile_table("public", "t")
+        assert nodes[1].roles[rid] == "follower"
+        assert nodes[1].engine.regions[rid].memtable.num_rows == 0  # flushed
+
+
+class TestStandaloneAdmin:
+    @pytest.fixture
+    def db(self):
+        d = GreptimeDB()
+        yield d
+        d.close()
+
+    def test_flush_and_compact_table(self, db, tmp_path):
+        db.sql("CREATE TABLE ft (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO ft VALUES ('a', 1000, 1.0)")
+        region = db._region_of("ft")
+        assert region.memtable.num_rows == 1
+        assert db.sql("ADMIN flush_table('ft')").rows == [["ok"]]
+        assert region.memtable.num_rows == 0 and region.sst_files
+        assert db.sql("ADMIN compact_table('ft')").rows == [["ok"]]
+
+    def test_reconcile_reopens_closed_region(self, tmp_path):
+        home = str(tmp_path / "home")
+        db = GreptimeDB(home)
+        db.sql("CREATE TABLE rr (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO rr VALUES ('a', 1000, 1.0)")
+        db.sql("ADMIN flush_table('rr')")
+        # drift: the region object vanished (e.g. crashed worker)
+        rid = db.catalog.get_table("public", "rr").region_ids[0]
+        region = db.regions.regions.pop(rid)
+        region.wal.close()
+        out = json.loads(db.sql("ADMIN reconcile_table('rr')").rows[0][0])
+        assert any("reopened" in f for f in out["reports"][0]["fixes"])
+        assert db.sql("SELECT v FROM rr").rows == [[1.0]]
+        db.close()
+
+    def test_reconcile_adopts_region_schema_growth(self, db):
+        db.sql("CREATE TABLE sg (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db._region_of("sg").add_tag_column("pod")
+        out = json.loads(db.sql("ADMIN reconcile_table('sg')").rows[0][0])
+        assert any("schema updated" in f for f in out["reports"][0]["fixes"])
+        desc = db.sql("DESC TABLE sg")
+        assert "pod" in [r[0] for r in desc.rows]
+
+    def test_reconcile_catalog_reports_orphans(self, tmp_path):
+        home = str(tmp_path / "home")
+        db = GreptimeDB(home)
+        db.sql("CREATE TABLE ok (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " PRIMARY KEY (h))")
+        from tests.test_reconciliation import schema as mk_schema
+
+        db.regions.create_region(999123, mk_schema())
+        out = json.loads(db.sql("ADMIN reconcile_catalog()").rows[0][0])
+        assert 999123 in out["orphan_regions"]
+        db.close()
+
+    def test_unknown_admin_fn(self, db):
+        with pytest.raises(Unsupported):
+            db.sql("ADMIN frobnicate('x')")
