@@ -1,0 +1,258 @@
+"""Approximate sketch aggregates: HyperLogLog and UDDSketch, TPU-native.
+
+Reference: src/common/function/src/aggrs/approximate/{hll,uddsketch}.rs +
+scalars/hll_count.rs.  The reference folds rows into per-group sketch
+objects on the CPU; here the sketches ARE segment reductions —
+
+- ``hll(x)``: hash rows elementwise (splitmix64 on the value's bit
+  pattern), scatter-MAX the leading-zero ranks into a [groups,
+  registers] grid, one pass, no hash tables.
+- ``uddsketch_state(limit, err, x)``: log-γ bucket index elementwise,
+  scatter-ADD into a [groups, buckets] grid.
+
+States serialize as small base64 strings so they can be stored in
+tables and re-aggregated later: ``hll_merge``/``uddsketch_merge``
+decode every DISTINCT stored state into a dense matrix at kernel-build
+time (the same dictionary-vocabulary trick as vector search) and merge
+on device with the same segment reductions.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import math
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+HLL_PRECISION = 12
+HLL_M = 1 << HLL_PRECISION  # 4096 registers, ~1.6% standard error
+
+
+def _shr32(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    return jax.lax.shift_right_logical(x, jnp.int32(k))
+
+
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 32-bit finalizer (all ops TPU-native: no 64-bit bitcasts,
+    which the TPU X64 rewrite refuses)."""
+    x = x ^ _shr32(x, 16)
+    x = x * jnp.int32(-2048144789)  # 0x85EBCA6B
+    x = x ^ _shr32(x, 13)
+    x = x * jnp.int32(-1028477387)  # 0xC2B2AE35
+    return x ^ _shr32(x, 16)
+
+
+def hll_fold(vals: jnp.ndarray, gid: jnp.ndarray, ng: int,
+             mask: jnp.ndarray) -> jnp.ndarray:
+    """→ [ng, HLL_M] int32 register grid (max leading-zero rank + 1).
+
+    The hash input is three 32-bit words derived WITHOUT 64-bit
+    bitcasts (the TPU X64 rewrite refuses those): the value's integer
+    part split into int64 hi/lo words plus the first 30 fraction bits.
+    Values differing in integer part or in the first ~2^-30 of fraction
+    hash independently — full precision for int64 ids and timestamp
+    and telemetry doubles (a 32-bit output hash is sound to ~10^8
+    distinct values).
+    """
+    v = vals.astype(jnp.float64)
+    ok = mask & ~jnp.isnan(v) & jnp.isfinite(v)
+    vi = jnp.floor(v)
+    k = jnp.clip(vi, -9.2e18, 9.2e18).astype(jnp.int64)
+    lo = (k & jnp.int64(0xFFFFFFFF)).astype(jnp.int32)
+    hi = jax.lax.shift_right_logical(k, jnp.int64(32)).astype(jnp.int32)
+    frac = ((v - vi) * jnp.float64(1 << 30)).astype(jnp.int32)
+    h1 = _mix32(lo ^ _mix32(hi ^ _mix32(frac)))
+    h2 = _mix32((frac + jnp.int32(-1640531527)) ^ h1)  # 0x9E3779B9
+    idx = _shr32(h1, 32 - HLL_PRECISION).astype(jnp.int32)  # top P bits
+    w = _shr32(h2, 1)  # 31 usable bits, non-negative
+    top = jnp.floor(jnp.log2(jnp.maximum(w, 1).astype(jnp.float32)))
+    rho = jnp.where(w > 0, 31 - top, 32).astype(jnp.int32)
+    cell = jnp.where(ok, gid.astype(jnp.int64) * HLL_M + idx, ng * HLL_M)
+    grid = jnp.zeros(ng * HLL_M + 1, dtype=jnp.int32)
+    grid = grid.at[cell].max(jnp.where(ok, rho, 0))
+    return grid[:-1].reshape(ng, HLL_M)
+
+
+def hll_merge_fold(codes: jnp.ndarray, vocab_regs: jnp.ndarray,
+                   gid: jnp.ndarray, ng: int,
+                   mask: jnp.ndarray) -> jnp.ndarray:
+    """Merge stored states: gather each row's register vector by its
+    dictionary code, segment-MAX per group → [ng, HLL_M]."""
+    nv = vocab_regs.shape[0]
+    safe = jnp.clip(codes, 0, max(nv - 1, 0))
+    rows = vocab_regs[safe]  # [n, M]
+    ok = mask & (codes >= 0) & (codes < nv)
+    rows = jnp.where(ok[:, None], rows, 0)
+    ids = jnp.where(ok, gid, ng).astype(jnp.int32)
+    grid = jnp.zeros((ng + 1, HLL_M), dtype=jnp.int32)
+    grid = grid.at[ids].max(rows)
+    return grid[:ng]
+
+
+def hll_estimate(regs: np.ndarray) -> float:
+    """Standard HLL estimator with linear-counting small-range bias fix."""
+    m = float(HLL_M)
+    alpha = 0.7213 / (1 + 1.079 / m)
+    est = alpha * m * m / float(np.sum(np.power(2.0, -regs.astype(float))))
+    zeros = int(np.sum(regs == 0))
+    if est <= 2.5 * m and zeros > 0:
+        est = m * math.log(m / zeros)
+    return est
+
+
+def encode_hll(regs: np.ndarray) -> str:
+    raw = zlib.compress(regs.astype(np.uint8).tobytes(), 1)
+    return "HLL1:" + base64.b64encode(raw).decode()
+
+
+def decode_hll(state: str) -> np.ndarray | None:
+    if not isinstance(state, str) or not state.startswith("HLL1:"):
+        return None
+    try:
+        raw = zlib.decompress(base64.b64decode(state[5:]))
+        regs = np.frombuffer(raw, dtype=np.uint8)
+        if len(regs) != HLL_M:
+            return None
+        return regs.astype(np.int32)
+    except Exception:  # noqa: BLE001 — malformed state → NULL
+        return None
+
+
+# ---- UDDSketch ----------------------------------------------------------
+
+def udd_gamma(error_rate: float) -> float:
+    if not 0.0 < error_rate < 1.0:
+        raise ValueError(f"error_rate {error_rate} out of (0, 1)")
+    return (1.0 + error_rate) / (1.0 - error_rate)
+
+
+_K_SENTINEL = 1 << 30
+
+
+def udd_fold(vals: jnp.ndarray, gid: jnp.ndarray, ng: int,
+             mask: jnp.ndarray, gamma: float, nb: int) -> jnp.ndarray:
+    """→ [ng, nb+2] int64: bucket counts + (base_start, collapse c).
+
+    Base bucket key k covers (γ^(k-1), γ^k].  Like real UDDSketch, a
+    group whose key span exceeds nb COLLAPSES: its buckets widen to
+    c = 2^j base keys (γ_eff = γ^c), with c chosen per group from the
+    segment min/max key span — all inside the one device pass.  The
+    grid starts at base_start = floor(k_min / c) * c, so collapsed
+    buckets align to absolute multiples of c and states remain
+    mergeable in base-γ key space.  Only positive finite values count
+    (the UDDSketch domain)."""
+    v = vals.astype(jnp.float64)
+    ok = mask & (v > 0) & jnp.isfinite(v)
+    k = jnp.ceil(
+        jnp.log(jnp.maximum(v, 1e-300)) / math.log(gamma)).astype(jnp.int64)
+    ids = jnp.where(ok, gid, ng).astype(jnp.int32)
+    kmin = jnp.full(ng + 1, _K_SENTINEL, dtype=jnp.int64)
+    kmin = kmin.at[ids].min(jnp.where(ok, k, _K_SENTINEL))
+    kmax = jnp.full(ng + 1, -_K_SENTINEL, dtype=jnp.int64)
+    kmax = kmax.at[ids].max(jnp.where(ok, k, -_K_SENTINEL))
+    span = jnp.maximum(kmax[:ng] - kmin[:ng] + 1, 1)
+    # c = next power of two of ceil((span+2) / nb) — +2 pads for the
+    # base-alignment shift so ceil-indexed buckets never exceed nb;
+    # exp2/log2 on small ints
+    need = jnp.ceil((span.astype(jnp.float64) + 2) / nb)
+    c = jnp.exp2(jnp.ceil(jnp.log2(jnp.maximum(need, 1.0)))).astype(jnp.int64)
+    c = jnp.maximum(c, 1)
+    base = jnp.floor_divide(kmin[:ng], c) * c
+    c_row = c[jnp.clip(gid, 0, ng - 1)]
+    base_row = base[jnp.clip(gid, 0, ng - 1)]
+    # upper-edge convention: base key k belongs to γ_eff bucket
+    # ceil(k/c) — matches the state doc ("bucket K covers
+    # (γ_eff^(K-1), γ_eff^K]") and the merge re-key rule
+    idx = jnp.clip(
+        jnp.floor_divide(k - base_row + c_row - 1, c_row), 0, nb - 1)
+    cell = jnp.where(ok, gid.astype(jnp.int64) * nb + idx, ng * nb)
+    grid = jnp.zeros(ng * nb + 1, dtype=jnp.int64)
+    grid = grid.at[cell].add(jnp.where(ok, 1, 0))
+    return jnp.concatenate(
+        [grid[:-1].reshape(ng, nb), kmin[:ng, None], c[:, None]], axis=1)
+
+
+def udd_merge_fold(codes: jnp.ndarray, vocab_counts: jnp.ndarray,
+                   cfg_ids: jnp.ndarray, gid: jnp.ndarray, ng: int,
+                   mask: jnp.ndarray) -> jnp.ndarray:
+    """→ [ng, nb+2]: merged bucket counts plus per-group (min, max) of
+    the selected rows' sketch-config ids.  Mixing configs is only an
+    error when the rows a query ACTUALLY selects mix them — the host
+    codec checks min==max per group, not the whole stored vocabulary."""
+    nv = vocab_counts.shape[0]
+    safe = jnp.clip(codes, 0, max(nv - 1, 0))
+    rows = vocab_counts[safe]
+    cfg = cfg_ids[safe]
+    ok = mask & (codes >= 0) & (codes < nv) & (cfg >= 0)
+    rows = jnp.where(ok[:, None], rows, 0)
+    ids = jnp.where(ok, gid, ng).astype(jnp.int32)
+    grid = jnp.zeros((ng + 1, vocab_counts.shape[1]), dtype=jnp.int64)
+    grid = grid.at[ids].add(rows.astype(jnp.int64))
+    big = jnp.int64(1 << 30)
+    cmin = jnp.full(ng + 1, big, dtype=jnp.int64)
+    cmin = cmin.at[ids].min(jnp.where(ok, cfg.astype(jnp.int64), big))
+    cmax = jnp.full(ng + 1, -1, dtype=jnp.int64)
+    cmax = cmax.at[ids].max(jnp.where(ok, cfg.astype(jnp.int64), -1))
+    return jnp.concatenate(
+        [grid[:ng], cmin[:ng, None], cmax[:ng, None]], axis=1)
+
+
+def encode_udd_doc(sparse: dict[int, int], gamma_base: float, c: int,
+                   nb: int) -> str:
+    """State doc: keys are ABSOLUTE γ_eff-unit bucket indices where
+    γ_eff = γ_base^c (c = collapse factor, a power of two)."""
+    doc = json.dumps({
+        "g": round(gamma_base ** c, 12), "gb": round(gamma_base, 12),
+        "x": int(c), "n": int(nb),
+        "c": {int(k): int(v) for k, v in sparse.items()},
+    }, separators=(",", ":"))
+    return "UDD1:" + base64.b64encode(doc.encode()).decode()
+
+
+def encode_udd(row: np.ndarray, gamma_base: float, nb: int) -> str:
+    """[counts..., k_min, c] fold row → state string."""
+    counts, kmin, c = row[:nb], int(row[nb]), max(int(row[nb + 1]), 1)
+    if kmin >= _K_SENTINEL:  # no valid values in the group
+        return encode_udd_doc({}, gamma_base, 1, nb)
+    base = (kmin // c) * c
+    sparse = {base // c + int(i): int(v)
+              for i, v in enumerate(counts) if v}
+    return encode_udd_doc(sparse, gamma_base, c, nb)
+
+
+def decode_udd(state: str):
+    """→ (gamma_eff, gamma_base, c, nb, {key: count}) or None."""
+    if not isinstance(state, str) or not state.startswith("UDD1:"):
+        return None
+    try:
+        doc = json.loads(base64.b64decode(state[5:]))
+        g = float(doc["g"])
+        return (g, float(doc.get("gb", g)), int(doc.get("x", 1)),
+                int(doc["n"]),
+                {int(k): int(v) for k, v in doc["c"].items()})
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def udd_quantile(state: str, q: float) -> float | None:
+    """uddsketch_calc: value estimate at quantile q ∈ [0, 1]."""
+    dec = decode_udd(state)
+    if dec is None or not 0.0 <= q <= 1.0:
+        return None
+    gamma, _gb, _c, _nb, counts = dec
+    total = sum(counts.values())
+    if total == 0:
+        return None
+    target = q * (total - 1)
+    seen = 0
+    for k in sorted(counts):
+        seen += counts[k]
+        if seen > target:
+            # bucket k covers (γ^(k-1), γ^k]; midpoint estimator
+            return 2.0 * gamma ** k / (gamma + 1.0)
+    k = max(counts)
+    return 2.0 * gamma ** k / (gamma + 1.0)

@@ -13,6 +13,7 @@ expressions, final projections incl. strings) over small numpy columns.
 from __future__ import annotations
 
 import fnmatch
+import json
 import re
 
 import jax.numpy as jnp
@@ -32,6 +33,9 @@ from greptimedb_tpu.query.parser import parse_timestamp_str
 AGG_FUNCS = {
     "count", "sum", "min", "max", "avg", "mean", "first_value", "last_value",
     "stddev", "stddev_pop", "var", "var_pop", "count_distinct",
+    # approximate sketches (reference aggrs/approximate/)
+    "hll", "hll_merge", "uddsketch_state", "uddsketch_merge",
+    "approx_distinct",
 }
 
 
@@ -215,6 +219,89 @@ _HOST_FUNCS = {
     ),
     "substr": lambda args, n: _per_row(args, n, _substr),
 }
+
+
+def _geo_fn(name: str, fn, arity: int):
+    """Wrap a geo primitive: wrong arity is a planning error; per-row
+    NULL in → NULL out and bad VALUES → NULL (the reference geo
+    functions are null-propagating, helpers.rs)."""
+    def run(args, n):
+        if len(args) != arity:
+            raise PlanError(f"{name}() takes {arity} arguments,"
+                            f" got {len(args)}")
+
+        def one(*vals):
+            if any(v is None for v in vals):
+                return None
+            try:
+                return fn(*vals)
+            except (ValueError, IndexError):
+                return None
+        return _per_row(args, n, one)
+    return run
+
+
+def _hll_count(args, n):
+    """hll_count(state) → approximate distinct count (reference
+    scalars/hll_count.rs)."""
+    from greptimedb_tpu.ops import sketch as sk
+
+    def one(state):
+        regs = sk.decode_hll(state)
+        return None if regs is None else int(round(sk.hll_estimate(regs)))
+    return _per_row(args, n, one)
+
+
+def _uddsketch_calc(args, n):
+    """uddsketch_calc(quantile, state) (reference uddsketch.rs docs)."""
+    from greptimedb_tpu.ops import sketch as sk
+
+    if len(args) != 2:
+        raise Unsupported("uddsketch_calc(quantile, state)")
+    # args may arrive (q, states) with q scalar — normalize to per-row
+    q, states = args
+    swapped = [states, q]
+
+    def one(state, quantile):
+        try:
+            return sk.udd_quantile(state, float(quantile))
+        except (TypeError, ValueError):
+            return None
+    return _per_row(swapped, n, one)
+
+
+_HOST_FUNCS["hll_count"] = _hll_count
+_HOST_FUNCS["uddsketch_calc"] = _uddsketch_calc
+
+
+def _register_geo():
+    from greptimedb_tpu.ops import geo as g
+
+    _HOST_FUNCS.update({
+        # reference src/common/function/src/scalars/geo/geohash.rs
+        "geohash": _geo_fn(
+            "geohash", lambda lat, lng, p: g.geohash_encode(
+                float(lat), float(lng), int(p)), 3),
+        "geohash_neighbours": _geo_fn(
+            "geohash_neighbours",
+            lambda lat, lng, p: json.dumps(g.geohash_neighbours(
+                g.geohash_encode(float(lat), float(lng), int(p)))), 3),
+        # wkt.rs + measure.rs
+        "wkt_point_from_latlng": _geo_fn(
+            "wkt_point_from_latlng",
+            lambda lat, lng: f"POINT({float(lng)} {float(lat)})", 2),
+        "st_distance": _geo_fn(
+            "st_distance",
+            lambda a, b: g.euclidean_distance_deg(str(a), str(b)), 2),
+        "st_distance_sphere_m": _geo_fn(
+            "st_distance_sphere_m",
+            lambda a, b: g.haversine_distance_m(str(a), str(b)), 2),
+        "st_area": _geo_fn(
+            "st_area", lambda a: g.polygon_area_deg2(str(a)), 1),
+    })
+
+
+_register_geo()
 
 
 def _substr(v, start, ln=None):

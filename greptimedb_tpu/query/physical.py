@@ -48,7 +48,7 @@ def _vec_fingerprint(plan, table) -> int:
     monotonic dicts_version (O(1)) so a rebuilt/extended table never
     reuses a kernel compiled against stale dictionaries."""
     fp = plan.fingerprint()
-    if "vec_" not in fp and "matches" not in fp:
+    if "vec_" not in fp and "matches" not in fp and "_merge" not in fp:
         return 0
     return getattr(table, "dicts_version", 0)
 
@@ -72,6 +72,10 @@ class Executor:
 
     def __init__(self):
         self._cache: dict[tuple, object] = {}
+        # decoded sketch-merge vocab matrices by (agg, column, dicts
+        # version): repeat queries must not re-decode/re-upload thousands
+        # of stored states per execution
+        self._sketch_cache: dict[tuple, object] = {}
 
     # ------------------------------------------------------------------
     def execute(
@@ -106,6 +110,7 @@ class Executor:
     ) -> tuple[dict[str, np.ndarray], int]:
         ctx = plan.ctx
         ctx.table_dicts = table.dicts  # vector search / string-dict exprs
+        ctx.table_dicts_version = getattr(table, "dicts_version", 0)
         ts_name = ctx.schema.time_index.name if ctx.schema.time_index else None
 
         key_specs: list[tuple] = []
@@ -174,6 +179,7 @@ class Executor:
         # dominant scatter/cumsum passes ~10x
         batched: list[tuple[str, str, str]] = []  # (out_name, op, column)
         agg_specs = []
+        sketch_codecs: dict[str, tuple] = {}
         for agg in plan.aggs:
             op = {"avg": "mean", "mean": "mean", "sum": "sum",
                   "count": "count"}.get(agg.name)
@@ -195,9 +201,18 @@ class Executor:
             if col is not None:
                 batched.append((str(agg), op, col))
             else:
-                agg_specs.append(
-                    (str(agg), self._compile_agg(agg, ctx, ts_name, seg_fn))
-                )
+                fn = self._compile_agg(agg, ctx, ts_name, seg_fn)
+                agg_specs.append((str(agg), fn))
+                # sketch aggregates come back as [groups, width] grids;
+                # the codec comes off the compiled fn so fold and
+                # serialization can never disagree on (γ, nb)
+                if agg.name in ("hll", "hll_merge"):
+                    sketch_codecs[str(agg)] = ("hll",)
+                elif agg.name == "uddsketch_state":
+                    sketch_codecs[str(agg)] = ("udd",) + fn._udd_meta
+                elif agg.name == "uddsketch_merge":
+                    sketch_codecs[str(agg)] = (
+                        "udd_merge",) + fn._udd_merge_meta
 
         padded = table.padded_rows
         num_groups = (
@@ -244,7 +259,35 @@ class Executor:
             env[k.name] = col
             env[str(k.expr)] = col
         for name, _ in agg_specs:
-            env[name] = out[name][gmask]
+            v = out[name][gmask]
+            codec = sketch_codecs.get(name)
+            if codec is not None:
+                from greptimedb_tpu.ops import sketch as sk
+
+                if codec[0] == "hll":
+                    v = np.array([sk.encode_hll(r) for r in v], dtype=object)
+                elif codec[0] == "udd":
+                    v = np.array(
+                        [sk.encode_udd(r, codec[1], codec[2]) for r in v],
+                        dtype=object)
+                else:  # udd_merge: [counts..., cfg_min, cfg_max] per group
+                    configs, kmin_all, width, c_star = codec[1:5]
+                    rows = []
+                    for r in v:
+                        cmin, cmax = int(r[-2]), int(r[-1])
+                        if cmax < 0:  # no valid state rows in the group
+                            rows.append(None)
+                            continue
+                        if cmin != cmax:
+                            raise ExecutionError(
+                                "uddsketch_merge: selected rows mix sketch"
+                                " gamma configs (error_rate)")
+                        sparse = {kmin_all + i: int(c)
+                                  for i, c in enumerate(r[:width]) if c}
+                        rows.append(sk.encode_udd_doc(
+                            sparse, configs[cmin], c_star, width))
+                    v = np.array(rows, dtype=object)
+            env[name] = v
         for name, _op, _col in batched:
             env[name] = out[name][gmask]
         return env, n
@@ -252,6 +295,18 @@ class Executor:
     def _compile_agg(self, agg: FuncCall, ctx, ts_name: str | None,
                      seg_fn=segment_reduce):
         name = agg.name
+        if name in ("hll", "uddsketch_state", "hll_merge",
+                    "uddsketch_merge"):
+            return self._compile_sketch_agg(agg, ctx)
+        if name == "approx_distinct":
+            # exact on device: sort-unique segment count is fast on TPU,
+            # so the "approximation" can afford to be exact
+            if not agg.args or isinstance(agg.args[0], Star):
+                raise PlanError("approx_distinct needs a column argument")
+            arg_fn = compile_device(agg.args[0], ctx)
+            return lambda env, gid, ng, mask: segment_distinct_count(
+                arg_fn(env), gid, ng, mask
+            )
         if agg.distinct or name == "count_distinct":
             if name not in ("count", "count_distinct"):
                 raise Unsupported(f"DISTINCT is only supported for count()"
@@ -331,6 +386,119 @@ class Executor:
 
             return fn
         raise Unsupported(f"aggregate {name}")
+
+    def _compile_sketch_agg(self, agg: FuncCall, ctx):
+        """hll/uddsketch_state fold raw rows into [groups, width] sketch
+        grids on device; the *_merge variants decode every DISTINCT
+        stored state into a dense vocab matrix at build time (the vector
+        -search dictionary trick) and reduce those (ops/sketch.py)."""
+        from greptimedb_tpu.ops import sketch as sk
+        from greptimedb_tpu.query.ast import Literal
+
+        name = agg.name
+        if name == "hll":
+            if len(agg.args) != 1:
+                raise PlanError("hll(column)")
+            arg_fn = compile_device(agg.args[0], ctx)
+            return lambda env, gid, ng, mask: sk.hll_fold(
+                arg_fn(env), gid, ng, mask)
+        if name == "uddsketch_state":
+            if (len(agg.args) != 3
+                    or not isinstance(agg.args[0], Literal)
+                    or not isinstance(agg.args[1], Literal)):
+                raise PlanError(
+                    "uddsketch_state(bucket_limit, error_rate, column)")
+            nb = max(8, min(int(agg.args[0].value), 4096))
+            try:
+                gamma = sk.udd_gamma(float(agg.args[1].value))
+            except ValueError as e:
+                raise PlanError(str(e))
+            arg_fn = compile_device(agg.args[2], ctx)
+
+            def sfn(env, gid, ng, mask, gamma=gamma, nb=nb):
+                return sk.udd_fold(arg_fn(env), gid, ng, mask, gamma, nb)
+
+            sfn._udd_meta = (gamma, nb)  # the ONE (γ, nb) for encoding
+            return sfn
+        # merge variants: the argument is a string column of stored states
+        arg = agg.args[0] if agg.args else None
+        if not isinstance(arg, Column):
+            raise PlanError(f"{name}(state_column)")
+        col = ctx.resolve(arg.name)
+        ckey = (str(agg), col, getattr(ctx, "table_dicts_version", 0))
+        cached = self._sketch_cache.get(ckey)
+        if cached is not None:
+            return cached
+        vocab = list(getattr(ctx, "table_dicts", {}).get(col, []))
+        if name == "hll_merge":
+            mat = np.zeros((max(len(vocab), 1), sk.HLL_M), dtype=np.int32)
+            for i, s in enumerate(vocab):
+                regs = sk.decode_hll(s)
+                if regs is not None:
+                    mat[i] = regs
+            dev = jnp.asarray(mat)
+            fn = lambda env, gid, ng, mask: sk.hll_merge_fold(  # noqa: E731
+                env[col], dev, gid, ng, mask)
+            self._sketch_cache[ckey] = fn
+            return fn
+        # uddsketch_merge: state keys are absolute base-γ-derived bucket
+        # indices, so states merge regardless of their per-group offsets;
+        # only the BASE γ must agree (differing collapse factors merge by
+        # re-collapsing to the coarsest, exactly UDDSketch's operation).
+        # Each vocab row gets a config (base γ) id and the kernel folds
+        # per-group config min/max, so only queries whose SELECTED rows
+        # actually mix base γ fail — at result time, not per vocabulary.
+        metas = [sk.decode_udd(s) for s in vocab]
+        configs: list[float] = []
+        cfg_ids = np.full(max(len(vocab), 1), -1, dtype=np.int32)
+        for i, m in enumerate(metas):
+            if m is None:
+                continue
+            gb = round(m[1], 12)
+            if gb not in configs:
+                configs.append(gb)
+            cfg_ids[i] = configs.index(gb)
+        c_star = max((m[2] for m in metas if m is not None), default=1)
+        # the combined key range may exceed the grid even at c_star:
+        # re-collapse globally (more doubling) until it fits — never
+        # clamp counts into an edge bucket
+        base_lo = min(((min(m[4]) - 1) * m[2] + 1
+                       for m in metas if m is not None and m[4]), default=0)
+        base_hi = max((max(m[4]) * m[2]
+                       for m in metas if m is not None and m[4]), default=0)
+        while (base_hi - base_lo + 1) / c_star > 4096:
+            c_star *= 2
+        # re-express every state's keys in c_star units (upper-edge rule)
+        all_keys: list[int] = []
+        rekeyed: list[dict[int, int] | None] = []
+        for m in metas:
+            if m is None:
+                rekeyed.append(None)
+                continue
+            _g, _gb, c, _nb, counts = m
+            conv: dict[int, int] = {}
+            for k, cnt in counts.items():
+                kk = -((-k * c) // c_star)  # ceil(k*c / c_star)
+                conv[kk] = conv.get(kk, 0) + cnt
+            rekeyed.append(conv)
+            all_keys.extend(conv.keys())
+        kmin_all = min(all_keys) if all_keys else 0
+        width = min(max(all_keys) - kmin_all + 1, 4097) if all_keys else 8
+        mat = np.zeros((max(len(vocab), 1), width), dtype=np.int64)
+        for i, conv in enumerate(rekeyed):
+            if conv is None:
+                continue
+            for k, cnt in conv.items():
+                mat[i, min(max(k - kmin_all, 0), width - 1)] += cnt
+        dev = jnp.asarray(mat)
+        dev_cfg = jnp.asarray(cfg_ids)
+
+        def fn(env, gid, ng, mask):
+            return sk.udd_merge_fold(env[col], dev, dev_cfg, gid, ng, mask)
+
+        fn._udd_merge_meta = (configs, kmin_all, width, c_star)
+        self._sketch_cache[ckey] = fn
+        return fn
 
     def _build_agg_kernel(
         self, key_specs, dense_ok, num_groups, cards, where_fn, agg_specs,

@@ -53,6 +53,8 @@ def _denullify(out: np.ndarray) -> np.ndarray:
 WINDOW_FUNCS = {
     "row_number", "rank", "dense_rank", "ntile", "lag", "lead",
     "first_value", "last_value", "sum", "avg", "count", "min", "max",
+    # anomaly scoring (reference src/common/function/src/scalars/anomaly/)
+    "anomaly_score_zscore", "anomaly_score_mad", "anomaly_score_iqr",
 }
 
 
@@ -279,6 +281,61 @@ def compute_window(wf: WindowFunc, env: dict, n: int, eval_host) -> np.ndarray:
         last = np.zeros(nseg, dtype=np.int64)
         last[sp.seg] = np.arange(n)  # last write wins
         return sp.unsort(_denullify(sv[last[sp.seg]]))
+
+    if name.startswith("anomaly_score_"):
+        raw = np.asarray(eval_host(wf.args[0], env, n), dtype=np.float64)
+        if raw.ndim == 0:
+            raw = np.full(n, float(raw))
+        sv = raw[sp.idx]
+        out = np.zeros(n)
+        nseg = int(sp.seg[-1]) + 1
+        if name == "anomaly_score_zscore":
+            # vectorized TWO-pass variance (one-pass s2-cnt*mean² loses
+            # all precision for large means and goes negative for
+            # constant partitions)
+            ok = ~np.isnan(sv)
+            v = np.where(ok, sv, 0.0)
+            cnt = np.bincount(sp.seg, weights=ok.astype(float),
+                              minlength=nseg)
+            mean = np.bincount(sp.seg, weights=v,
+                               minlength=nseg) / np.maximum(cnt, 1)
+            centered = np.where(ok, (sv - mean[sp.seg]) ** 2, 0.0)
+            ss = np.bincount(sp.seg, weights=centered, minlength=nseg)
+            std = np.sqrt(ss / np.maximum(cnt - 1, 1))
+            m_r, s_r, c_r = mean[sp.seg], std[sp.seg], cnt[sp.seg]
+            # float-noise floor: a "constant" partition's two-pass std is
+            # ~eps*|mean|, which must score 0, not astronomically
+            tiny = np.finfo(np.float64).eps * np.maximum(np.abs(m_r), 1.0) * 8
+            dev = np.abs(sv - m_r)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                score = np.where(
+                    s_r > tiny, dev / s_r,
+                    np.where(dev <= tiny, 0.0, np.inf))
+            score = np.where((c_r < 2) | ~ok, np.nan, score)
+            return sp.unsort(score)
+        for s in range(nseg):  # mad/iqr need per-partition quantile sorts
+            m = sp.seg == s
+            vals = sv[m]
+            ok = ~np.isnan(vals)
+            v = vals[ok]
+            score = np.full(len(vals), np.nan)
+            if len(v) >= 2:
+                if name == "anomaly_score_mad":
+                    med = np.median(v)
+                    mad = np.median(np.abs(v - med)) * 1.4826
+                    score[ok] = (np.abs(v - med) / mad if mad > 0
+                                 else np.where(v == med, 0.0, np.inf))
+                else:  # iqr, k=1.5
+                    q1, q3 = np.percentile(v, [25, 75])
+                    iqr = q3 - q1
+                    lo_f, hi_f = q1 - 1.5 * iqr, q3 + 1.5 * iqr
+                    dist = np.maximum(lo_f - v, v - hi_f)
+                    if iqr > 0:
+                        score[ok] = np.where(dist > 0, dist / iqr, 0.0)
+                    else:
+                        score[ok] = np.where(dist > 0, np.inf, 0.0)
+            out[m] = score
+        return sp.unsort(out)
 
     # windowed aggregates ------------------------------------------------
     decode = None  # for string min/max: code → value

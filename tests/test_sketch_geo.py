@@ -1,0 +1,258 @@
+"""Sketch aggregates (HLL / UDDSketch), geo scalars, anomaly windows.
+
+Reference: src/common/function/src/aggrs/approximate/{hll,uddsketch}.rs,
+scalars/{hll_count.rs,geo/,anomaly/}.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.errors import GreptimeError, PlanError
+from greptimedb_tpu.standalone import GreptimeDB
+
+
+@pytest.fixture(scope="module")
+def db():
+    d = GreptimeDB()
+    d.sql("CREATE TABLE sk (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+          " v DOUBLE, PRIMARY KEY (h))")
+    rows = ", ".join(
+        f"('h{i % 3}', {1000 + i}, {float(i % 500)})" for i in range(3000))
+    d.sql("INSERT INTO sk VALUES " + rows)
+    yield d
+    d.close()
+
+
+class TestHll:
+    def test_estimate_close_to_exact(self, db):
+        r = db.sql("SELECT h, hll_count(hll(v)) AS approx,"
+                   " approx_distinct(v) AS exact FROM sk GROUP BY h"
+                   " ORDER BY h")
+        for _h, approx, exact in r.rows:
+            assert abs(approx - exact) / exact < 0.05  # P=12 → ~1.6% σ
+
+    def test_states_merge_like_direct(self, db):
+        # store per-group states, then merge-reaggregate across ALL groups
+        r = db.sql("SELECT h, hll(v) AS state FROM sk GROUP BY h ORDER BY h")
+        db.sql("CREATE TABLE IF NOT EXISTS hstates (h STRING, ts"
+               " TIMESTAMP(3) TIME INDEX, state STRING, PRIMARY KEY (h))")
+        for i, (h, state) in enumerate(r.rows):
+            db.sql(f"INSERT INTO hstates VALUES ('{h}', {i}, '{state}')")
+        merged = db.sql(
+            "SELECT hll_count(hll_merge(state)) FROM hstates").rows[0][0]
+        direct = db.sql("SELECT hll_count(hll(v)) FROM sk").rows[0][0]
+        assert merged == direct  # identical registers → identical estimate
+
+    def test_hll_large_int64_ids(self, db):
+        # regression: f32-based hashing collapsed ids beyond 2^24
+        # (BIGINT stays exact on device, unlike DOUBLE which is f32
+        # by the engine-wide design)
+        d = GreptimeDB()
+        d.sql("CREATE TABLE big (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+              " id BIGINT, PRIMARY KEY (h))")
+        rows = ", ".join(
+            f"('x', {i}, {10_000_000_000 + i})" for i in range(1000))
+        d.sql("INSERT INTO big VALUES " + rows)
+        approx = d.sql("SELECT hll_count(hll(id)) FROM big").rows[0][0]
+        exact = d.sql("SELECT approx_distinct(id) FROM big").rows[0][0]
+        assert exact == 1000
+        assert abs(approx - 1000) / 1000 < 0.05
+        d.close()
+
+    def test_hll_count_null_for_garbage(self, db):
+        r = db.sql("SELECT hll_count('not-a-state')")
+        assert r.rows[0][0] is None
+
+    def test_hll_time_bucketed(self, db):
+        r = db.sql("SELECT date_trunc('second', ts) AS b,"
+                   " hll_count(hll(v)) AS c FROM sk GROUP BY b ORDER BY b")
+        assert len(r.rows) >= 2 and all(row[1] > 0 for row in r.rows)
+
+
+class TestUddsketch:
+    def test_quantiles_within_error(self, db):
+        r = db.sql("SELECT h,"
+                   " uddsketch_calc(0.5, uddsketch_state(128, 0.05, v)) AS p50,"
+                   " uddsketch_calc(0.95, uddsketch_state(128, 0.05, v)) AS p95"
+                   " FROM sk GROUP BY h ORDER BY h")
+        for _h, p50, p95 in r.rows:
+            assert abs(p50 - 250) / 250 < 0.1
+            assert abs(p95 - 475) / 475 < 0.1
+
+    def test_states_merge_like_direct(self, db):
+        r = db.sql("SELECT h, uddsketch_state(128, 0.05, v) AS s FROM sk"
+                   " GROUP BY h ORDER BY h")
+        db.sql("CREATE TABLE IF NOT EXISTS ustates (h STRING, ts"
+               " TIMESTAMP(3) TIME INDEX, s STRING, PRIMARY KEY (h))")
+        for i, (h, s) in enumerate(r.rows):
+            db.sql(f"INSERT INTO ustates VALUES ('{h}', {i}, '{s}')")
+        merged = db.sql(
+            "SELECT uddsketch_calc(0.5, uddsketch_merge(s)) FROM ustates"
+        ).rows[0][0]
+        direct = db.sql(
+            "SELECT uddsketch_calc(0.5, uddsketch_state(128, 0.05, v))"
+            " FROM sk").rows[0][0]
+        assert merged == pytest.approx(direct)
+
+    def test_collapse_on_wide_range(self, db):
+        # data spanning more keys than bucket_limit collapses resolution
+        # (γ_eff = γ^2^j) instead of saturating the top bucket
+        d = GreptimeDB()
+        d.sql("CREATE TABLE wr (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+              " v DOUBLE, PRIMARY KEY (h))")
+        rows = ", ".join(
+            f"('x', {i}, {float((i * 7) % 200)})" for i in range(4000))
+        d.sql("INSERT INTO wr VALUES " + rows)
+        # err=0.02 → γ^128 ≈ 168 < range 199: needs one collapse
+        p99 = d.sql("SELECT uddsketch_calc(0.99,"
+                    " uddsketch_state(128, 0.02, v)) FROM wr").rows[0][0]
+        assert abs(p99 - 197) / 197 < 0.09  # one collapse ⇒ ~γ² bucket
+        p50 = d.sql("SELECT uddsketch_calc(0.5,"
+                    " uddsketch_state(128, 0.02, v)) FROM wr").rows[0][0]
+        assert abs(p50 - 100) / 100 < 0.09
+        d.close()
+
+    def test_collapsed_quantiles_within_gamma_eff_bound(self, db):
+        # regression: floor-indexed collapse biased all quantiles low,
+        # past the (γ_eff-1)/(γ_eff+1) midpoint-estimator bound
+        d = GreptimeDB()
+        d.sql("CREATE TABLE cb (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+              " v DOUBLE, PRIMARY KEY (h))")
+        vals = np.logspace(-2, np.log10(1.4e5), 400)
+        rows = ", ".join(f"('x', {i}, {vals[i]})" for i in range(400))
+        d.sql("INSERT INTO cb VALUES " + rows)
+        import math
+
+        from greptimedb_tpu.ops import sketch as sk
+
+        state = d.sql(
+            "SELECT uddsketch_state(16, 0.02, v) FROM cb").rows[0][0]
+        g_eff = sk.decode_udd(state)[0]
+        bound = (g_eff - 1) / (g_eff + 1) * 1.05  # small slack
+        for q in (0.1, 0.5, 0.9):
+            est = d.sql(f"SELECT uddsketch_calc({q},"
+                        f" uddsketch_state(16, 0.02, v)) FROM cb").rows[0][0]
+            true = float(np.quantile(vals, q))
+            assert abs(est - true) / true <= bound, (q, est, true, bound)
+        d.close()
+
+    def test_merge_far_apart_ranges_recollapses(self, db):
+        # regression: merging states with far-apart key ranges clamped
+        # counts into an edge bucket (quantiles off by orders of
+        # magnitude); now the merge re-collapses until the span fits
+        d = GreptimeDB()
+        d.sql("CREATE TABLE fa (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+              " v DOUBLE, PRIMARY KEY (h))")
+        d.sql("INSERT INTO fa VALUES " + ", ".join(
+            [f"('lo', {i}, {1e-30 * (1 + i)})" for i in range(10)]
+            + [f"('hi', {100 + i}, {1e30 * (1 + i)})" for i in range(10)]))
+        s = d.sql("SELECT h, uddsketch_state(128, 0.01, v) AS s FROM fa"
+                  " GROUP BY h ORDER BY h")
+        d.sql("CREATE TABLE fas (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+              " s STRING, PRIMARY KEY (h))")
+        for i, (h, st) in enumerate(s.rows):
+            d.sql(f"INSERT INTO fas VALUES ('{h}', {i}, '{st}')")
+        q9 = d.sql("SELECT uddsketch_calc(0.9, uddsketch_merge(s))"
+                   " FROM fas").rows[0][0]
+        assert q9 > 1e28, q9  # was ~3.7e5 with edge-bucket clamping
+        d.close()
+
+    def test_merge_mixed_configs(self, db):
+        d = GreptimeDB()
+        d.sql("CREATE TABLE ms (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+              " v DOUBLE, PRIMARY KEY (h))")
+        d.sql("INSERT INTO ms VALUES ('a',1,5.0),('a',2,6.0)")
+        s1 = d.sql("SELECT uddsketch_state(128, 0.05, v) FROM ms").rows[0][0]
+        s2 = d.sql("SELECT uddsketch_state(128, 0.01, v) FROM ms").rows[0][0]
+        d.sql("CREATE TABLE mstates (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+              " s STRING, PRIMARY KEY (h))")
+        d.sql(f"INSERT INTO mstates VALUES ('old', 1, '{s1}'),"
+              f" ('new', 2, '{s2}')")
+        # selecting ONLY one config merges fine despite the mixed vocab
+        r = d.sql("SELECT uddsketch_calc(0.5, uddsketch_merge(s))"
+                  " FROM mstates WHERE ts >= 2")
+        assert r.rows[0][0] is not None
+        # selecting both configs is a real error
+        with pytest.raises(GreptimeError, match="mix"):
+            d.sql("SELECT uddsketch_calc(0.5, uddsketch_merge(s))"
+                  " FROM mstates")
+        d.close()
+
+    def test_bad_error_rate_rejected(self, db):
+        with pytest.raises(GreptimeError):
+            db.sql("SELECT uddsketch_state(128, 1.5, v) FROM sk")
+
+    def test_calc_on_garbage_is_null(self, db):
+        assert db.sql(
+            "SELECT uddsketch_calc(0.5, 'junk')").rows[0][0] is None
+
+
+class TestGeo:
+    def test_geohash_known_value(self, db):
+        r = db.sql("SELECT geohash(37.7749, -122.4194, 9)")
+        assert r.rows[0][0] == "9q8yyk8yt"  # San Francisco
+
+    def test_geohash_neighbours(self, db):
+        r = db.sql("SELECT geohash_neighbours(37.7749, -122.4194, 5)")
+        ns = json.loads(r.rows[0][0])
+        assert len(ns) == 8 and "9q8yy" not in ns
+        assert all(len(x) == 5 for x in ns)
+
+    def test_st_distance_sphere_m(self, db):
+        # SF ↔ NYC ≈ 4,130 km
+        r = db.sql("SELECT st_distance_sphere_m("
+                   "'POINT(-122.4194 37.7749)', 'POINT(-73.9857 40.7484)')")
+        assert r.rows[0][0] == pytest.approx(4_130_000, rel=0.01)
+
+    def test_st_distance_and_point_builder(self, db):
+        r = db.sql("SELECT st_distance('POINT(0 0)', 'POINT(3 4)'),"
+                   " wkt_point_from_latlng(37.0, -122.0)")
+        assert r.rows[0][0] == pytest.approx(5.0)
+        assert r.rows[0][1] == "POINT(-122.0 37.0)"
+
+    def test_st_area(self, db):
+        r = db.sql("SELECT st_area('POLYGON((0 0, 4 0, 4 3, 0 3, 0 0))')")
+        assert r.rows[0][0] == pytest.approx(12.0)
+
+    def test_invalid_inputs_are_null(self, db):
+        r = db.sql("SELECT geohash(999.0, 0.0, 5), st_area('nonsense')")
+        assert r.rows[0] == [None, None]
+
+
+class TestAnomalyWindows:
+    @pytest.fixture(scope="class")
+    def an(self):
+        d = GreptimeDB()
+        d.sql("CREATE TABLE an (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+              " v DOUBLE, PRIMARY KEY (h))")
+        d.sql("INSERT INTO an VALUES ('a',1,1.0),('a',2,1.1),('a',3,0.9),"
+              "('a',4,1.0),('a',5,10.0),('b',1,5.0),('b',2,5.0),('b',3,5.0)")
+        yield d
+        d.close()
+
+    def test_zscore_flags_outlier(self, an):
+        r = an.sql("SELECT ts, anomaly_score_zscore(v) OVER (PARTITION"
+                   " BY h) AS s FROM an WHERE h = 'a' ORDER BY ts")
+        scores = [row[1] for row in r.rows]
+        assert scores[4] == max(scores) and scores[4] > 1.5
+        assert all(s < 1 for s in scores[:4])
+
+    def test_mad_flags_outlier(self, an):
+        r = an.sql("SELECT ts, anomaly_score_mad(v) OVER (PARTITION BY h)"
+                   " AS s FROM an WHERE h = 'a' ORDER BY ts")
+        scores = [row[1] for row in r.rows]
+        assert scores[4] > 10 and all(s < 2 for s in scores[:4])
+
+    def test_iqr_inliers_zero(self, an):
+        r = an.sql("SELECT ts, anomaly_score_iqr(v) OVER (PARTITION BY h)"
+                   " AS s FROM an WHERE h = 'a' ORDER BY ts")
+        scores = [row[1] for row in r.rows]
+        assert scores[4] > 0 and scores[0] == 0.0
+
+    def test_constant_partition(self, an):
+        # zero deviation: score 0 for values equal to the center
+        r = an.sql("SELECT ts, anomaly_score_zscore(v) OVER (PARTITION"
+                   " BY h) AS s FROM an WHERE h = 'b' ORDER BY ts")
+        assert [row[1] for row in r.rows] == [0.0, 0.0, 0.0]
