@@ -408,11 +408,13 @@ class Executor:
                     or not isinstance(agg.args[1], Literal)):
                 raise PlanError(
                     "uddsketch_state(bucket_limit, error_rate, column)")
-            nb = max(8, min(int(agg.args[0].value), 4096))
             try:
+                nb = max(8, min(int(agg.args[0].value), 4096))
                 gamma = sk.udd_gamma(float(agg.args[1].value))
-            except ValueError as e:
-                raise PlanError(str(e))
+            except (ValueError, TypeError) as e:
+                raise PlanError(
+                    f"uddsketch_state(bucket_limit, error_rate, column):"
+                    f" {e}")
             arg_fn = compile_device(agg.args[2], ctx)
 
             def sfn(env, gid, ng, mask, gamma=gamma, nb=nb):
@@ -425,10 +427,13 @@ class Executor:
         if not isinstance(arg, Column):
             raise PlanError(f"{name}(state_column)")
         col = ctx.resolve(arg.name)
-        ckey = (str(agg), col, getattr(ctx, "table_dicts_version", 0))
+        # keyed by (agg, column); only the NEWEST dicts version is kept —
+        # versions are monotonic, stale matrices can never hit again
+        ckey = (str(agg), col)
+        ver = getattr(ctx, "table_dicts_version", 0)
         cached = self._sketch_cache.get(ckey)
-        if cached is not None:
-            return cached
+        if cached is not None and cached[0] == ver:
+            return cached[1]
         vocab = list(getattr(ctx, "table_dicts", {}).get(col, []))
         if name == "hll_merge":
             mat = np.zeros((max(len(vocab), 1), sk.HLL_M), dtype=np.int32)
@@ -439,7 +444,7 @@ class Executor:
             dev = jnp.asarray(mat)
             fn = lambda env, gid, ng, mask: sk.hll_merge_fold(  # noqa: E731
                 env[col], dev, gid, ng, mask)
-            self._sketch_cache[ckey] = fn
+            self._sketch_cache[ckey] = (ver, fn)
             return fn
         # uddsketch_merge: state keys are absolute base-γ-derived bucket
         # indices, so states merge regardless of their per-group offsets;
@@ -497,7 +502,7 @@ class Executor:
             return sk.udd_merge_fold(env[col], dev, dev_cfg, gid, ng, mask)
 
         fn._udd_merge_meta = (configs, kmin_all, width, c_star)
-        self._sketch_cache[ckey] = fn
+        self._sketch_cache[ckey] = (ver, fn)
         return fn
 
     def _build_agg_kernel(

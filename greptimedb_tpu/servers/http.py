@@ -22,13 +22,14 @@ from __future__ import annotations
 
 import asyncio
 import json
+import re
 import threading
 import time
 
 import numpy as np
 from aiohttp import web
 
-from greptimedb_tpu.errors import GreptimeError, StatusCode
+from greptimedb_tpu.errors import GreptimeError, InvalidArguments, StatusCode
 from greptimedb_tpu.query.engine import QueryResult
 from greptimedb_tpu.utils import telemetry
 from greptimedb_tpu.utils.snappy import decompress as snappy_decompress
@@ -138,6 +139,7 @@ class HttpServer:
         r.add_post("/v1/influxdb/api/v2/write", self.h_influx_write)
         r.add_post("/v1/influxdb/write", self.h_influx_write)
         r.add_post("/v1/otlp/v1/metrics", self.h_otlp_metrics)
+        r.add_post("/v1/otel-arrow/v1/metrics", self.h_otel_arrow_metrics)
         r.add_post("/v1/loki/api/v1/push", self.h_loki_push)
         r.add_post("/v1/logs", self.h_log_query)
         r.add_post("/v1/otlp/v1/traces", self.h_otlp_traces)
@@ -426,43 +428,141 @@ class HttpServer:
             body_json, status = _error_json(e)
             return web.json_response(body_json, status=status)
 
+    async def h_otel_arrow_metrics(self, request: web.Request) -> web.Response:
+        """OTel-Arrow (OTAP) columnar metrics ingest (reference
+        src/servers/src/otel_arrow.rs + otel-arrow-rust).  The body is
+        an Arrow IPC stream of flattened univariate metric batches —
+        columns: metric name (``name``/``metric_name``), a time column
+        (``time_unix_nano``/``ts``/``timestamp``), a value column
+        (``value``/``double_value``/``int_value``), every other column
+        an attribute (tag).  Transport differs from the reference (HTTP
+        body instead of a gRPC ArrowMetricsService stream — this server
+        is HTTP-first; the in-cluster bulk path is Flight do_put), the
+        data model is the same: one record batch, zero row-wise decode.
+        """
+        import pyarrow.ipc as pa_ipc
+
+        try:
+            body = await request.read()
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": f"body: {e}"}, status=400)
+
+        def run():
+            import io
+
+            try:
+                reader = pa_ipc.open_stream(io.BytesIO(body))
+                tbl = reader.read_all()
+            except Exception as e:
+                raise InvalidArguments(f"bad arrow ipc stream: {e}")
+            names = set(tbl.column_names)
+            name_col = next(
+                (c for c in ("name", "metric_name") if c in names), None)
+            time_col = next(
+                (c for c in ("time_unix_nano", "ts", "timestamp")
+                 if c in names), None)
+            val_col = next(
+                (c for c in ("value", "double_value", "int_value")
+                 if c in names), None)
+            if not (name_col and time_col and val_col):
+                raise InvalidArguments(
+                    "otel-arrow batch needs name, time and value columns")
+            metric_names = tbl.column(name_col).to_pylist()
+            times = tbl.column(time_col).to_pylist()
+            vals = tbl.column(val_col).to_pylist()
+            if any(v is None for v in metric_names) or any(
+                    t is None for t in times) or any(
+                    v is None for v in vals):
+                raise InvalidArguments(
+                    "otel-arrow batch has null name/time/value cells")
+            if time_col == "time_unix_nano":
+                times = [t // 1_000_000 for t in times]
+            attr_cols = {
+                c: tbl.column(c).to_pylist() for c in tbl.column_names
+                if c not in (name_col, time_col, val_col)
+            }
+            per_table: dict[str, list[int]] = {}
+            for i, m in enumerate(metric_names):
+                # prometheus-style name normalization (reference
+                # translate_metric_name/normalize_metric_name): dots and
+                # other specials → '_' so names never split as db.table
+                safe = re.sub(r"[^a-zA-Z0-9_:]", "_", str(m))
+                per_table.setdefault(safe, []).append(i)
+            total = 0
+            for table, idxs in per_table.items():
+                tags = sorted(attr_cols)
+                cols: dict[str, list] = {
+                    k: [str(attr_cols[k][i]) if attr_cols[k][i] is not None
+                        else "" for i in idxs]
+                    for k in tags
+                }
+                cols["ts"] = [times[i] for i in idxs]
+                cols["val"] = [vals[i] for i in idxs]
+                cols["__tags__"] = tags
+                cols["__fields__"] = ["val"]
+                total += _ingest_columns(self.db, table, cols)
+            return total
+
+        try:
+            n = await self._call(run)
+            M_INGEST_ROWS.labels("otel_arrow").inc(n)
+            return web.json_response({"status": {"status_code": 0},
+                                      "rows": n})
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
     async def h_loki_push(self, request: web.Request) -> web.Response:
-        """Loki JSON push (reference src/servers/src/http/loki.rs): streams
-        land in ``loki_logs`` with stream labels as tags and the line in
+        """Loki push (reference src/servers/src/http/loki.rs), BOTH wire
+        forms: JSON and snappy-compressed protobuf (logproto.PushRequest
+        — what promtail/the Grafana agent actually send).  Streams land
+        in ``loki_logs`` with stream labels as tags and the line in
         ``line`` (string field)."""
         try:
             body = await request.read()
         except Exception as e:  # noqa: BLE001 (bad content encoding etc.)
             return web.json_response({"error": f"body: {e}"}, status=400)
         ctype = request.content_type or ""
-        if "json" not in ctype:
-            return web.json_response(
-                {"error": "only JSON Loki push is supported"}, status=400)
-        try:
-            payload = json.loads(body)
-        except json.JSONDecodeError as e:
-            return web.json_response({"error": f"bad json: {e}"}, status=400)
-
-        def run():
-            rows: list[tuple[dict, str, int]] = []
+        rows: list[tuple[dict, str, int]] = []
+        if "json" in ctype:
+            try:
+                payload = json.loads(body)
+            except json.JSONDecodeError as e:
+                return web.json_response(
+                    {"error": f"bad json: {e}"}, status=400)
             for stream in payload.get("streams", []):
-                labels = {
-                    # labels named like reserved columns are renamed
-                    (str(k) + "_label" if str(k) in ("ts", "line") else str(k)):
-                        str(v)
-                    for k, v in (stream.get("stream") or {}).items()
-                }
+                labels = (stream.get("stream") or {}).items()
+                labels = {str(k): str(v) for k, v in labels}
                 for entry in stream.get("values", []):
-                    from greptimedb_tpu.errors import InvalidArguments
-
                     try:
                         ts_ns = int(entry[0])
                         line = str(entry[1])
                     except (ValueError, TypeError, IndexError) as e:
-                        raise InvalidArguments(
-                            f"bad loki entry {entry!r}: {e}"
-                        ) from None
+                        return web.json_response(
+                            {"error": f"bad loki entry {entry!r}: {e}"},
+                            status=400)
                     rows.append((labels, line, ts_ns // 1_000_000))
+        else:  # protobuf variant: snappy(logproto.PushRequest)
+            from greptimedb_tpu.servers.protocols import parse_loki_push
+
+            try:
+                raw = snappy_decompress(body)
+            except Exception:  # noqa: BLE001 — some clients skip snappy
+                raw = body
+            try:
+                rows = parse_loki_push(raw)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": f"bad protobuf push: {e}"}, status=400)
+
+        # labels named like reserved columns are renamed
+        rows = [
+            ({(k + "_label" if k in ("ts", "line") else k): v
+              for k, v in labels.items()}, line, ts)
+            for labels, line, ts in rows
+        ]
+
+        def run():
             if not rows:
                 return 0
             tag_names = sorted({k for lab, _l, _t in rows for k in lab})
@@ -1103,14 +1203,16 @@ def _ingest_columns(db, table: str, cols: dict) -> int:
         info = db.catalog.get_table(dbname, name)
         missing_tags = [t for t in tag_names if not info.schema.has_column(t)]
         if missing_tags:
-            # silently dropping tags would lose series identity; adding tag
-            # columns online (reference supports it) lands in a later round
-            from greptimedb_tpu.errors import InvalidArguments
-
-            raise InvalidArguments(
-                f"table {name} lacks tag columns {missing_tags}; "
-                "online tag addition is not yet supported"
-            )
+            # online tag addition (reference alter-on-demand,
+            # src/operator/src/insert.rs): existing series extend their
+            # key with the empty-string label — same machinery as the
+            # metric engine's label growth
+            for t in missing_tags:
+                for region in db._regions_of(f"{dbname}.{name}"):
+                    region.add_tag_column(t)
+            regions0 = db._regions_of(f"{dbname}.{name}")
+            info.schema = regions0[0].schema
+            db.catalog.update_table(info)
         for f in field_names:
             if not info.schema.has_column(f):
                 db.execute_statement(AlterTable(

@@ -273,3 +273,79 @@ def parse_remote_write(body: bytes) -> dict[str, dict[str, list]]:
             cols["val"].append(val)
         out[table] = {"__tags__": tag_names, "__fields__": ["val"], **cols}
     return out
+
+
+# ---------------------------------------------------------------------------
+# Loki protobuf push (snappy logproto.PushRequest)
+# ---------------------------------------------------------------------------
+
+def _parse_loki_labels(s: str) -> dict[str, str]:
+    """`{job="api", env="prod"}` → dict (Loki's label-set string form)."""
+    out: dict[str, str] = {}
+    s = s.strip()
+    if s.startswith("{"):
+        s = s[1:]
+    if s.endswith("}"):
+        s = s[:-1]
+    i, n = 0, len(s)
+    while i < n:
+        while i < n and s[i] in ", \t":
+            i += 1
+        j = i
+        while j < n and s[j] not in "=":
+            j += 1
+        name = s[i:j].strip()
+        i = j + 1
+        if i < n and s[i] == '"':
+            i += 1
+            val = []
+            while i < n and s[i] != '"':
+                if s[i] == "\\" and i + 1 < n:
+                    i += 1
+                val.append(s[i])
+                i += 1
+            i += 1  # closing quote
+            if name:
+                out[name] = "".join(val)
+        else:  # unquoted (not produced by real clients; be lenient)
+            j = i
+            while j < n and s[j] not in ",}":
+                j += 1
+            if name:
+                out[name] = s[i:j].strip()
+            i = j
+    return out
+
+
+def parse_loki_push(body: bytes) -> list[tuple[dict, str, int]]:
+    """logproto.PushRequest → [(labels, line, ts_ms)].
+
+    PushRequest{ streams=1: StreamAdapter{ labels=1 (label-set string),
+    entries=2: EntryAdapter{ timestamp=1 (Timestamp{seconds=1,nanos=2}),
+    line=2 } } } — the snappy layer is the caller's concern.
+    """
+    rows: list[tuple[dict, str, int]] = []
+    for field, _wt, stream_bytes in _pb_fields(body):
+        if field != 1:
+            continue
+        labels: dict[str, str] = {}
+        entries: list[tuple[int, str]] = []
+        for f2, _wt2, v2 in _pb_fields(stream_bytes):
+            if f2 == 1:  # labels string
+                labels = _parse_loki_labels(v2.decode("utf-8", "replace"))
+            elif f2 == 2:  # EntryAdapter
+                secs = nanos = 0
+                line = ""
+                for f3, _wt3, v3 in _pb_fields(v2):
+                    if f3 == 1:  # Timestamp
+                        for f4, _wt4, v4 in _pb_fields(v3):
+                            if f4 == 1:
+                                secs = _zigzag_or_signed(v4)
+                            elif f4 == 2:
+                                nanos = _zigzag_or_signed(v4)
+                    elif f3 == 2:
+                        line = v3.decode("utf-8", "replace")
+                entries.append((secs * 1000 + nanos // 1_000_000, line))
+        for ts_ms, line in entries:
+            rows.append((labels, line, ts_ms))
+    return rows

@@ -275,13 +275,19 @@ class TestHttpApi:
 
 
 class TestReviewRegressions:
-    def test_new_tag_rejected_not_dropped(self, server):
+    def test_new_tag_added_online_not_dropped(self, server):
+        # online tag addition (reference alter-on-demand): the second
+        # write's new label column is ADDED; earlier rows read ""
         http(server, "/v1/influxdb/api/v2/write?precision=ms",
              method="POST", body=b"ttags,host=a v=1.0 1000")
-        code, raw = http(server, "/v1/influxdb/api/v2/write?precision=ms",
-                         method="POST", body=b"ttags,host=a,region=us v=2.0 2000")
-        assert code == 400
-        assert b"region" in raw
+        code, _raw = http(server, "/v1/influxdb/api/v2/write?precision=ms",
+                          method="POST",
+                          body=b"ttags,host=a,region=us v=2.0 2000")
+        assert code == 204
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT host, region, v FROM ttags ORDER BY ts"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert rows == [["a", "", 1.0], ["a", "us", 2.0]]
 
     def test_bad_lp_timestamp_is_400(self, server):
         code, _ = http(server, "/v1/influxdb/write", method="POST",
@@ -399,10 +405,74 @@ class TestOtlpAndLoki:
         assert rows == [["web", "error", "boom happened"],
                         ["web", "error", "again"]]
 
+    def test_loki_protobuf_push(self, server):
+        # promtail wire form: snappy(logproto.PushRequest)
+        def varint(v):
+            out = b""
+            while True:
+                b7 = v & 0x7F
+                v >>= 7
+                out += bytes([b7 | (0x80 if v else 0)])
+                if not v:
+                    return out
+
+        def field(num, payload):
+            return varint((num << 3) | 2) + varint(len(payload)) + payload
+
+        # EntryAdapter: timestamp (field 1, message) + line (field 2)
+        ts_msg = (varint(1 << 3 | 0) + varint(1700000099)
+                  + varint(2 << 3 | 0) + varint(500_000_000))
+        entry = field(1, ts_msg) + field(2, b"proto boom")
+        stream = field(1, b'{job="api", env="prod"}') + field(2, entry)
+        push = field(1, stream)
+        code, _ = http(server, "/v1/loki/api/v1/push", method="POST",
+                       body=snappy.compress(push),
+                       headers={"Content-Type": "application/x-protobuf"})
+        assert code == 204
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT job, env, line FROM loki_logs"
+                    " WHERE job = 'api'"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert rows == [["api", "prod", "proto boom"]]
+
     def test_loki_bad_payload(self, server):
         code, _ = http(server, "/v1/loki/api/v1/push", method="POST",
                        body=b"not json",
                        headers={"Content-Type": "application/json"})
+        assert code == 400
+
+    def test_otel_arrow_metrics(self, server):
+        import io
+
+        import pyarrow as pa
+        import pyarrow.ipc as pa_ipc
+
+        tbl = pa.table({
+            "name": ["otap_cpu", "otap_cpu", "otap_mem"],
+            "time_unix_nano": [1700000000_000000000, 1700000001_000000000,
+                               1700000000_000000000],
+            "value": [0.5, 0.7, 1024.0],
+            "host": ["h1", "h2", "h1"],
+        })
+        buf = io.BytesIO()
+        with pa_ipc.new_stream(buf, tbl.schema) as w:
+            w.write_table(tbl)
+        code, raw = http(server, "/v1/otel-arrow/v1/metrics", method="POST",
+                         body=buf.getvalue())
+        assert code == 200 and json.loads(raw)["rows"] == 3
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT host, val FROM otap_cpu ORDER BY ts"}))
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert [r[0] for r in rows] == ["h1", "h2"]
+        assert rows[0][1] == pytest.approx(0.5)
+        assert rows[1][1] == pytest.approx(0.7)  # f32 device storage
+        code, raw = http(server, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT val FROM otap_mem"}))
+        assert json.loads(raw)["output"][0]["records"]["rows"] == [[1024.0]]
+
+    def test_otel_arrow_bad_body(self, server):
+        code, _ = http(server, "/v1/otel-arrow/v1/metrics", method="POST",
+                       body=b"not arrow")
         assert code == 400
 
     def test_loki_bad_entry_and_gzip(self, server):
