@@ -1,0 +1,195 @@
+"""Flow sharding: flownode role + flow routes + failover reassignment.
+
+Reference: the flownode role (src/flow/src/adapter/flownode_impl.rs),
+flow routes in the typed key-space
+(src/common/meta/src/key/flow/flow_route.rs), flownode selection during
+CREATE FLOW (src/common/meta/src/ddl/create_flow.rs:126), and the
+metasrv-driven reassignment of flows off dead flownodes.
+
+Each Flownode runs its OWN FlowEngine holding only the flows routed to
+it; the control plane assigns flows least-loaded, persists routes, and
+mirror-dispatches source-table writes to every alive node (an engine
+ignores tables none of its flows source — so dispatch needs no route
+lookup on the hot path).  When a flownode dies, its flows re-register
+on a survivor from their durable SQL and reseed state: streaming flows
+backfill from the source, batching flows mark their full source range
+dirty so the next trigger rebuilds every window.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from greptimedb_tpu.errors import FlowNotFound, GreptimeError
+from greptimedb_tpu.flow.engine import FlowEngine, flow_to_sql
+from greptimedb_tpu.query.ast import CreateFlow
+
+# NOT under FlowEngine._KV_PREFIX ("__flow/"): the engine's restore
+# parses everything under its prefix as SQL
+ROUTE_PREFIX = "__flowroute/"
+FLOWNODE_STALE_MS = 30_000.0
+
+
+class Flownode:
+    """One flow-executing node (reference flownode role): its engine
+    holds only the flows routed here."""
+
+    def __init__(self, node_id: int, db):
+        self.node_id = node_id
+        self.db = db  # frontend handle: source queries + sink writes
+        self.engine = FlowEngine(db, restore=False)
+        self.alive = True
+        self.last_heartbeat_ms = 0.0
+
+    def heartbeat(self, now_ms: float) -> dict:
+        if not self.alive:
+            raise GreptimeError(f"flownode {self.node_id} is down")
+        self.last_heartbeat_ms = now_ms
+        return {
+            "node_id": self.node_id,
+            "flows": sorted(self.engine.flows),
+            "ts": now_ms,
+        }
+
+
+class FlowControlPlane:
+    """Metasrv-side flow management: routes, selection, failover."""
+
+    def __init__(self, kv):
+        self.kv = kv
+        self.nodes: dict[int, Flownode] = {}
+
+    # ---- membership ----------------------------------------------------
+    def register_flownode(self, node: Flownode) -> None:
+        self.nodes[node.node_id] = node
+
+    def _alive_nodes(self) -> list[Flownode]:
+        return [n for n in self.nodes.values() if n.alive]
+
+    def select_flownode(self) -> Flownode | None:
+        """Least-loaded alive flownode (reference create_flow peer
+        selection)."""
+        alive = self._alive_nodes()
+        if not alive:
+            return None
+        return min(alive, key=lambda n: (len(n.engine.flows), n.node_id))
+
+    # ---- routes --------------------------------------------------------
+    def route(self, name: str) -> int | None:
+        rec = self.kv.get_json(ROUTE_PREFIX + name)
+        return None if rec is None else rec["node"]
+
+    def routes(self) -> dict[str, int]:
+        return {
+            k[len(ROUTE_PREFIX):]: json.loads(v)["node"]
+            for k, v in self.kv.range(ROUTE_PREFIX)
+        }
+
+    # ---- DDL -----------------------------------------------------------
+    def create_flow(self, stmt: CreateFlow) -> int:
+        """Assign + register; returns the owning node id."""
+        existing = self.route(stmt.name)
+        if existing is not None:
+            if stmt.if_not_exists:
+                return existing
+            from greptimedb_tpu.errors import FlowAlreadyExists
+
+            raise FlowAlreadyExists(stmt.name)
+        target = self.select_flownode()
+        if target is None:
+            raise GreptimeError("no alive flownode to host the flow")
+        target.engine.create_flow(stmt)  # persists durable SQL in kv
+        self.kv.put_json(ROUTE_PREFIX + stmt.name, {"node": target.node_id})
+        return target.node_id
+
+    def drop_flow(self, name: str, if_exists: bool = False) -> None:
+        node_id = self.route(name)
+        if node_id is None:
+            if if_exists:
+                return
+            raise FlowNotFound(name)
+        node = self.nodes.get(node_id)
+        if node is not None and name in node.engine.flows:
+            node.engine.drop_flow(name)
+        else:
+            # owner gone: the durable SQL still needs deleting
+            self.kv.delete(FlowEngine._KV_PREFIX + name)
+        self.kv.delete(ROUTE_PREFIX + name)
+
+    # ---- data plane ----------------------------------------------------
+    def on_write(self, table: str, ts_values, data=None,
+                 appendable: bool = True) -> None:
+        """Mirror-dispatch: every alive engine sees the chunk; engines
+        without a flow on this source ignore it (reference mirror
+        insert to flownodes)."""
+        for node in self._alive_nodes():
+            if node.engine.flows:
+                node.engine.on_write(table, ts_values, data, appendable)
+
+    def run_all(self) -> int:
+        return sum(n.engine.run_all() for n in self._alive_nodes())
+
+    # ---- failover ------------------------------------------------------
+    def tick(self, now_ms: float | None = None) -> list[str]:
+        """Reassign flows off dead/stale flownodes; returns moved names."""
+        from greptimedb_tpu.query.parser import parse_sql
+
+        now_ms = time.time() * 1000.0 if now_ms is None else now_ms
+        moved: list[str] = []
+        for name, node_id in self.routes().items():
+            node = self.nodes.get(node_id)
+            dead = (
+                node is None or not node.alive
+                or (node.last_heartbeat_ms
+                    and now_ms - node.last_heartbeat_ms > FLOWNODE_STALE_MS)
+            )
+            if not dead:
+                continue
+            raw = self.kv.get(FlowEngine._KV_PREFIX + name)
+            if raw is None:
+                self.kv.delete(ROUTE_PREFIX + name)
+                continue
+            target = self.select_flownode()
+            if target is None or target.node_id == node_id:
+                continue
+            if node is not None:
+                # deregister from the stale owner (its engine object may
+                # come back alive): two live owners would double-run the
+                # flow and survive DROP — but keep the durable SQL,
+                # drop_flow() owns that
+                node.engine.flows.pop(name, None)
+            stmt = parse_sql(raw.decode())[0]
+            task = target.engine._register(stmt)
+            # reseed: streaming backfills from source automatically;
+            # batching marks the full source range dirty so the next
+            # trigger rebuilds every window (writes during the outage
+            # left no dirty marks anywhere)
+            if task.mode == "streaming":
+                task.needs_backfill = True
+            else:
+                self._mark_full_range_dirty(target, task)
+            self.kv.put_json(ROUTE_PREFIX + name,
+                             {"node": target.node_id})
+            moved.append(name)
+        return moved
+
+    @staticmethod
+    def _mark_full_range_dirty(node: Flownode, task) -> None:
+        # union of ALL source partitions' bounds — a single-region view
+        # would miss windows living only in other partitions
+        lo = hi = None
+        try:
+            regions = node.db._regions_of(task.source_table)
+        except Exception:  # noqa: BLE001 — missing source
+            regions = []
+        for region in regions:
+            b = region.ts_bounds() if hasattr(region, "ts_bounds") else None
+            if b is None:
+                continue
+            lo = b[0] if lo is None else min(lo, b[0])
+            hi = b[1] if hi is None else max(hi, b[1])
+        if lo is None:
+            return
+        w = task.window_ms
+        task.dirty.update(range((lo // w) * w, (hi // w) * w + w, w))

@@ -117,10 +117,13 @@ def flow_to_sql(stmt: CreateFlow) -> str:
 class FlowEngine:
     _KV_PREFIX = "__flow/"
 
-    def __init__(self, db):
+    def __init__(self, db, restore: bool = True):
+        # restore=False: sharded flownodes (flow/cluster.py) register
+        # only the flows their routes assign, not the whole key-space
         self.db = db
         self.flows: dict[str, FlowTask] = {}
-        self._restore()
+        if restore:
+            self._restore()
 
     def _restore(self) -> None:
         """Rebuild flows from their durable SQL (reference persists flow

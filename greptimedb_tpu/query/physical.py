@@ -111,6 +111,7 @@ class Executor:
         ctx = plan.ctx
         ctx.table_dicts = table.dicts  # vector search / string-dict exprs
         ctx.table_dicts_version = getattr(table, "dicts_version", 0)
+        ctx.sketch_table = plan.table
         ts_name = ctx.schema.time_index.name if ctx.schema.time_index else None
 
         key_specs: list[tuple] = []
@@ -427,9 +428,11 @@ class Executor:
         if not isinstance(arg, Column):
             raise PlanError(f"{name}(state_column)")
         col = ctx.resolve(arg.name)
-        # keyed by (agg, column); only the NEWEST dicts version is kept —
-        # versions are monotonic, stale matrices can never hit again
-        ckey = (str(agg), col)
+        # keyed by (agg, column, table); only the NEWEST dicts version is
+        # kept — the version counter is process-wide monotonic, so stale
+        # matrices can never hit again (table in the key is belt-and-
+        # suspenders against any future per-table versioning)
+        ckey = (str(agg), col, getattr(ctx, "sketch_table", None))
         ver = getattr(ctx, "table_dicts_version", 0)
         cached = self._sketch_cache.get(ckey)
         if cached is not None and cached[0] == ver:

@@ -523,46 +523,45 @@ class HttpServer:
         except Exception as e:  # noqa: BLE001 (bad content encoding etc.)
             return web.json_response({"error": f"body: {e}"}, status=400)
         ctype = request.content_type or ""
-        rows: list[tuple[dict, str, int]] = []
-        if "json" in ctype:
-            try:
-                payload = json.loads(body)
-            except json.JSONDecodeError as e:
-                return web.json_response(
-                    {"error": f"bad json: {e}"}, status=400)
-            for stream in payload.get("streams", []):
-                labels = (stream.get("stream") or {}).items()
-                labels = {str(k): str(v) for k, v in labels}
-                for entry in stream.get("values", []):
-                    try:
-                        ts_ns = int(entry[0])
-                        line = str(entry[1])
-                    except (ValueError, TypeError, IndexError) as e:
-                        return web.json_response(
-                            {"error": f"bad loki entry {entry!r}: {e}"},
-                            status=400)
-                    rows.append((labels, line, ts_ns // 1_000_000))
-        else:  # protobuf variant: snappy(logproto.PushRequest)
-            from greptimedb_tpu.servers.protocols import parse_loki_push
-
-            try:
-                raw = snappy_decompress(body)
-            except Exception:  # noqa: BLE001 — some clients skip snappy
-                raw = body
-            try:
-                rows = parse_loki_push(raw)
-            except Exception as e:  # noqa: BLE001
-                return web.json_response(
-                    {"error": f"bad protobuf push: {e}"}, status=400)
-
-        # labels named like reserved columns are renamed
-        rows = [
-            ({(k + "_label" if k in ("ts", "line") else k): v
-              for k, v in labels.items()}, line, ts)
-            for labels, line, ts in rows
-        ]
 
         def run():
+            # decompress/decode on the executor thread, never the event
+            # loop — promtail batches can be tens of MB
+            rows: list[tuple[dict, str, int]] = []
+            if "json" in ctype:
+                try:
+                    payload = json.loads(body)
+                except json.JSONDecodeError as e:
+                    raise InvalidArguments(f"bad json: {e}")
+                for stream in payload.get("streams", []):
+                    labels = (stream.get("stream") or {}).items()
+                    labels = {str(k): str(v) for k, v in labels}
+                    for entry in stream.get("values", []):
+                        try:
+                            ts_ns = int(entry[0])
+                            line = str(entry[1])
+                        except (ValueError, TypeError, IndexError) as e:
+                            raise InvalidArguments(
+                                f"bad loki entry {entry!r}: {e}")
+                        rows.append((labels, line, ts_ns // 1_000_000))
+            else:  # protobuf variant: snappy(logproto.PushRequest)
+                from greptimedb_tpu.servers.protocols import parse_loki_push
+
+                try:
+                    raw = snappy_decompress(body)
+                except Exception:  # noqa: BLE001 — some clients skip snappy
+                    raw = body
+                try:
+                    rows = parse_loki_push(raw)
+                except Exception as e:  # noqa: BLE001
+                    raise InvalidArguments(f"bad protobuf push: {e}")
+
+            # labels named like reserved columns are renamed
+            rows = [
+                ({(k + "_label" if k in ("ts", "line") else k): v
+                  for k, v in labels.items()}, line, ts)
+                for labels, line, ts in rows
+            ]
             if not rows:
                 return 0
             tag_names = sorted({k for lab, _l, _t in rows for k in lab})
@@ -1207,11 +1206,11 @@ def _ingest_columns(db, table: str, cols: dict) -> int:
             # src/operator/src/insert.rs): existing series extend their
             # key with the empty-string label — same machinery as the
             # metric engine's label growth
-            for t in missing_tags:
-                for region in db._regions_of(f"{dbname}.{name}"):
+            tag_regions = db._regions_of(f"{dbname}.{name}")
+            for region in tag_regions:
+                for t in missing_tags:
                     region.add_tag_column(t)
-            regions0 = db._regions_of(f"{dbname}.{name}")
-            info.schema = regions0[0].schema
+            info.schema = tag_regions[0].schema
             db.catalog.update_table(info)
         for f in field_names:
             if not info.schema.has_column(f):

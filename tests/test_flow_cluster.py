@@ -1,0 +1,159 @@
+"""Flow sharding: flownode role, routes, mirror dispatch, failover.
+
+Reference: flow routes (src/common/meta/src/key/flow/flow_route.rs),
+flownode selection (src/common/meta/src/ddl/create_flow.rs), flownode
+role + reassignment.
+"""
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.errors import FlowAlreadyExists, GreptimeError
+from greptimedb_tpu.flow.cluster import FlowControlPlane, Flownode
+from greptimedb_tpu.query.parser import parse_sql
+from greptimedb_tpu.standalone import GreptimeDB
+
+
+@pytest.fixture
+def db():
+    d = GreptimeDB()
+    d.sql("CREATE TABLE src (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+          " v DOUBLE, PRIMARY KEY (h))")
+    yield d
+    d.close()
+
+
+@pytest.fixture
+def plane(db):
+    cp = FlowControlPlane(db.kv)
+    for i in range(2):
+        cp.register_flownode(Flownode(i, db))
+    return cp
+
+
+def _flow_stmt(name, sink="sink1"):
+    return parse_sql(
+        f"CREATE FLOW {name} SINK TO {sink} AS SELECT"
+        " date_bin(INTERVAL '1 minute', ts) AS w, h, sum(v) AS s,"
+        " count(*) AS c FROM src GROUP BY w, h")[0]
+
+
+def _ingest(db, plane, rows):
+    region = db._region_of("src")
+    data = {
+        "h": [r[0] for r in rows],
+        "ts": [r[1] for r in rows],
+        "v": [r[2] for r in rows],
+    }
+    region.write(data)
+    plane.on_write("src", data["ts"], data, appendable=True)
+
+
+class TestRoutingAndDispatch:
+    def test_least_loaded_assignment(self, db, plane):
+        n0 = plane.create_flow(_flow_stmt("f1", "s1"))
+        n1 = plane.create_flow(_flow_stmt("f2", "s2"))
+        assert {n0, n1} == {0, 1}  # spread across both nodes
+        assert plane.routes() == {"f1": n0, "f2": n1}
+        # the flow lives ONLY on its owner
+        owner = plane.nodes[n0]
+        other = plane.nodes[1 - n0]
+        assert "f1" in owner.engine.flows and "f1" not in other.engine.flows
+
+    def test_duplicate_rejected(self, db, plane):
+        plane.create_flow(_flow_stmt("f1", "s1"))
+        with pytest.raises(FlowAlreadyExists):
+            plane.create_flow(_flow_stmt("f1", "s1"))
+        stmt = _flow_stmt("f1", "s1")
+        stmt.if_not_exists = True
+        assert plane.create_flow(stmt) == plane.route("f1")
+
+    def test_mirror_dispatch_and_sink(self, db, plane):
+        plane.create_flow(_flow_stmt("fd", "sinkd"))
+        _ingest(db, plane, [("a", 1_000, 1.0), ("a", 2_000, 2.0),
+                            ("b", 61_000, 5.0)])
+        plane.run_all()
+        rows = db.sql("SELECT h, s, c FROM sinkd ORDER BY h").rows
+        assert rows == [["a", 3.0, 2], ["b", 5.0, 1]]
+
+    def test_drop_flow(self, db, plane):
+        plane.create_flow(_flow_stmt("fx", "sx"))
+        owner = plane.nodes[plane.route("fx")]
+        plane.drop_flow("fx")
+        assert plane.route("fx") is None
+        assert "fx" not in owner.engine.flows
+        plane.drop_flow("fx", if_exists=True)  # idempotent
+        with pytest.raises(GreptimeError):
+            plane.drop_flow("fx")
+
+    def test_no_alive_flownode(self, db, plane):
+        for n in plane.nodes.values():
+            n.alive = False
+        with pytest.raises(GreptimeError, match="no alive flownode"):
+            plane.create_flow(_flow_stmt("fz", "sz"))
+
+
+class TestFlowFailover:
+    def test_dead_node_flows_reassigned_and_state_rebuilt(self, db, plane):
+        node_id = plane.create_flow(_flow_stmt("ff", "sinkf"))
+        _ingest(db, plane, [("a", 1_000, 1.0), ("a", 2_000, 2.0)])
+        plane.run_all()
+        assert db.sql("SELECT s FROM sinkf").rows == [[3.0]]
+
+        # owner dies; writes continue while it's down
+        plane.nodes[node_id].alive = False
+        region = db._region_of("src")
+        region.write({"h": ["a"], "ts": [3_000], "v": [4.0]})
+        plane.on_write("src", [3_000], {"h": ["a"], "ts": [3_000],
+                                        "v": [4.0]}, appendable=True)
+
+        moved = plane.tick(now_ms=1.0)
+        assert moved == ["ff"]
+        new_owner = plane.route("ff")
+        assert new_owner != node_id
+        assert "ff" in plane.nodes[new_owner].engine.flows
+        plane.run_all()
+        # the write during the outage is reflected after reassignment
+        assert db.sql("SELECT s, c FROM sinkf").rows == [[7.0, 3]]
+
+    def test_stale_heartbeat_triggers_reassign(self, db, plane):
+        node_id = plane.create_flow(_flow_stmt("fh", "sinkh"))
+        plane.nodes[node_id].heartbeat(1000.0)
+        assert plane.tick(now_ms=2000.0) == []  # fresh
+        moved = plane.tick(now_ms=1000.0 + 31_000.0)  # stale
+        assert moved == ["fh"]
+        # the stale-but-alive old owner must NOT keep a ghost copy
+        assert "fh" not in plane.nodes[node_id].engine.flows
+        # DROP reaches the (single) live owner
+        plane.drop_flow("fh")
+        assert all("fh" not in n.engine.flows for n in plane.nodes.values())
+
+    def test_routes_do_not_break_engine_restore(self, db, plane):
+        # regression: route keys under the engine's SQL prefix crashed
+        # FlowEngine._restore (routes parsed as SQL)
+        from greptimedb_tpu.flow.engine import FlowEngine
+
+        plane.create_flow(_flow_stmt("fr", "sinkr"))
+        eng = FlowEngine(db)  # restore=True over the same kv
+        assert "fr" in eng.flows
+
+    def test_batching_flow_failover_marks_full_range(self, db, plane):
+        # first_value() is non-decomposable → batching mode
+        stmt = parse_sql(
+            "CREATE FLOW fb SINK TO sinkb AS SELECT"
+            " date_bin(INTERVAL '1 minute', ts) AS w, h,"
+            " first_value(v) AS fv FROM src GROUP BY w, h")[0]
+        node_id = plane.create_flow(stmt)
+        assert plane.nodes[node_id].engine.flows["fb"].mode == "batching"
+        _ingest(db, plane, [("a", 1_000, 1.0), ("b", 61_000, 5.0)])
+        plane.run_all()
+        assert len(db.sql("SELECT * FROM sinkb").rows) == 2
+
+        plane.nodes[node_id].alive = False
+        moved = plane.tick(now_ms=1.0)
+        assert moved == ["fb"]
+        task = plane.nodes[plane.route("fb")].engine.flows["fb"]
+        assert task.dirty  # full source range marked for re-query
+        plane.run_all()
+        rows = db.sql("SELECT h, fv FROM sinkb ORDER BY h").rows
+        assert rows == [["a", 1.0], ["b", 5.0]]
