@@ -67,13 +67,25 @@ class FlowControlPlane:
     def _alive_nodes(self) -> list[Flownode]:
         return [n for n in self.nodes.values() if n.alive]
 
-    def select_flownode(self) -> Flownode | None:
-        """Least-loaded alive flownode (reference create_flow peer
+    @staticmethod
+    def _healthy(node: Flownode | None, now_ms: float) -> bool:
+        """One staleness rule for assignment AND failover: a node that
+        tick() would fail flows off must never be an assignment target."""
+        return (
+            node is not None and node.alive
+            and not (node.last_heartbeat_ms
+                     and now_ms - node.last_heartbeat_ms > FLOWNODE_STALE_MS)
+        )
+
+    def select_flownode(self, now_ms: float | None = None) -> Flownode | None:
+        """Least-loaded HEALTHY flownode (reference create_flow peer
         selection)."""
-        alive = self._alive_nodes()
-        if not alive:
+        now_ms = time.time() * 1000.0 if now_ms is None else now_ms
+        healthy = [n for n in self.nodes.values()
+                   if self._healthy(n, now_ms)]
+        if not healthy:
             return None
-        return min(alive, key=lambda n: (len(n.engine.flows), n.node_id))
+        return min(healthy, key=lambda n: (len(n.engine.flows), n.node_id))
 
     # ---- routes --------------------------------------------------------
     def route(self, name: str) -> int | None:
@@ -139,18 +151,13 @@ class FlowControlPlane:
         moved: list[str] = []
         for name, node_id in self.routes().items():
             node = self.nodes.get(node_id)
-            dead = (
-                node is None or not node.alive
-                or (node.last_heartbeat_ms
-                    and now_ms - node.last_heartbeat_ms > FLOWNODE_STALE_MS)
-            )
-            if not dead:
+            if self._healthy(node, now_ms):
                 continue
             raw = self.kv.get(FlowEngine._KV_PREFIX + name)
             if raw is None:
                 self.kv.delete(ROUTE_PREFIX + name)
                 continue
-            target = self.select_flownode()
+            target = self.select_flownode(now_ms)
             if target is None or target.node_id == node_id:
                 continue
             if node is not None:

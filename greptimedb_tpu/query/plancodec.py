@@ -1,0 +1,92 @@
+"""Versioned logical-plan codec: the substrait analog.
+
+The reference serializes DataFusion plans as substrait protos to ship
+frontend → datanode (src/common/substrait/, dist_plan merge-scan).
+Here the logical plan IS the typed AST (query/ast.py dataclasses), so
+the codec is a structural JSON encoding over a closed registry of node
+types — versioned, transport-agnostic, and safe to decode (only
+whitelisted dataclasses are ever instantiated).
+
+Shipping the STRUCTURE instead of SQL text means the datanode executes
+exactly the plan the frontend derived (e.g. the partial-aggregate
+split) — no re-parse, no dual derivation that could drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from greptimedb_tpu.errors import PlanError
+from greptimedb_tpu.query.ast import (
+    Between, BinaryOp, Case, Cast, Column, FuncCall, InList, InSubquery,
+    IntervalLit, IsNull, JoinClause, Literal, OrderByItem, ScalarSubquery,
+    Select, SelectItem, Star, UnaryOp, WindowFunc, WindowSpec,
+)
+
+VERSION = 1
+
+_NODES = {
+    cls.__name__: cls
+    for cls in (
+        Between, BinaryOp, Case, Cast, Column, FuncCall, InList, InSubquery,
+        IntervalLit, IsNull, JoinClause, Literal, OrderByItem,
+        ScalarSubquery, Select, SelectItem, Star, UnaryOp, WindowFunc,
+        WindowSpec,
+    )
+}
+
+
+def _enc(obj):
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    t = type(obj).__name__
+    if t in _NODES and dataclasses.is_dataclass(obj):
+        return {"_t": t, "f": {
+            f.name: _enc(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }}
+    if isinstance(obj, tuple):
+        return {"_tuple": [_enc(v) for v in obj]}
+    if isinstance(obj, list):
+        return [_enc(v) for v in obj]
+    raise PlanError(f"plan codec: unencodable node {type(obj).__name__}")
+
+
+def _dec(obj):
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, list):
+        return [_dec(v) for v in obj]
+    if isinstance(obj, dict):
+        if "_tuple" in obj:
+            return tuple(_dec(v) for v in obj["_tuple"])
+        t = obj.get("_t")
+        cls = _NODES.get(t)
+        if cls is None:
+            raise PlanError(f"plan codec: unknown node type {t!r}")
+        return cls(**{k: _dec(v) for k, v in obj["f"].items()})
+    raise PlanError(f"plan codec: undecodable value {obj!r}")
+
+
+def encode_plan(sel: Select) -> dict:
+    """Select → versioned wire dict (json-serializable)."""
+    return {"v": VERSION, "plan": _enc(sel)}
+
+
+def decode_plan(doc: dict) -> Select:
+    v = doc.get("v")
+    if v != VERSION:
+        raise PlanError(f"plan codec: unsupported version {v!r}")
+    sel = _dec(doc["plan"])
+    if not isinstance(sel, Select):
+        raise PlanError("plan codec: top-level node is not a Select")
+    return sel
+
+
+def plan_to_json(sel: Select) -> str:
+    return json.dumps(encode_plan(sel), separators=(",", ":"))
+
+
+def plan_from_json(s: str) -> Select:
+    return decode_plan(json.loads(s))

@@ -77,6 +77,19 @@ class DatanodeClient:
         }).encode())
         return self._conn.do_get(ticket).read_all()
 
+    def query_plan(self, plan_doc: dict, table: str,
+                   region_ids: list[int],
+                   timezone: str = "UTC") -> pa.Table:
+        """Ship a STRUCTURAL plan doc (query/plancodec.encode_plan — the
+        substrait analog): the datanode executes exactly this Select, no
+        re-parse, no re-derivation.  Takes the encoded doc so fan-out
+        callers encode once, not once per node."""
+        ticket = fl.Ticket(json.dumps({
+            "mode": "plan", "plan": plan_doc, "table": table,
+            "region_ids": region_ids, "timezone": timezone,
+        }).encode())
+        return self._conn.do_get(ticket).read_all()
+
     def scan(self, table: str, region_ids: list[int],
              ts_range=(None, None)) -> pa.Table:
         ticket = fl.Ticket(json.dumps({

@@ -168,14 +168,21 @@ class DatanodeFlightServer(fl.FlightServerBase):
             host = view.scan_host(ts_range)
             table = _host_scan_to_table(host)
         else:
-            sel = parse_sql(req["sql"])[0]
-            if mode == "partial":
-                plan = split_partial(sel)
-                if plan is None:
-                    raise fl.FlightServerError(
-                        f"query is not partial-decomposable: {req['sql']}"
-                    )
-                sel = plan.partial_select
+            if mode == "plan":
+                # structural plan codec (query/plancodec.py, substrait
+                # analog): execute exactly the shipped Select
+                from greptimedb_tpu.query.plancodec import decode_plan
+
+                sel = decode_plan(req["plan"])
+            else:
+                sel = parse_sql(req["sql"])[0]
+                if mode == "partial":
+                    plan = split_partial(sel)
+                    if plan is None:
+                        raise fl.FlightServerError(
+                            f"query is not partial-decomposable: {req['sql']}"
+                        )
+                    sel = plan.partial_select
             provider = _ScopedProvider(
                 req["table"], view, self.cache, req.get("timezone", "UTC")
             )

@@ -161,13 +161,16 @@ class DistFrontend:
         by_node = self._node_regions(info)
         plan = split_partial(sel)
         if plan is not None:
-            # MergeScan fast path: each datanode re-derives the identical
-            # partial split from the shipped SQL (shared rpc/partial.py)
+            # MergeScan fast path: the frontend derives the partial split
+            # ONCE, encodes it ONCE (plan codec, substrait analog), and
+            # every datanode executes exactly this plan
+            from greptimedb_tpu.query.plancodec import encode_plan
+
+            doc = encode_plan(plan.partial_select)
             parts = []
             for node, rids in by_node.items():
-                table = self.datanodes[node].client.query(
-                    raw_sql, sel.table, rids, mode="partial",
-                    timezone=self.timezone,
+                table = self.datanodes[node].client.query_plan(
+                    doc, sel.table, rids, timezone=self.timezone,
                 )
                 parts.append({
                     name: table.column(name).to_pylist()

@@ -128,6 +128,33 @@ class TestFlowFailover:
         plane.drop_flow("fh")
         assert all("fh" not in n.engine.flows for n in plane.nodes.values())
 
+    def test_stale_node_not_an_assignment_target(self, db):
+        # regression: a stale node hosting multiple flows kept the
+        # surplus flows forever (select picked the stale node itself)
+        # and even received NEW flows
+        import time as _time
+
+        t0 = _time.time() * 1000.0
+        plane = FlowControlPlane(db.kv)
+        for i in range(2):
+            plane.register_flownode(Flownode(i, db))
+        plane.nodes[0].heartbeat(t0)
+        plane.nodes[1].heartbeat(t0)
+        # node 0 hosts two flows, node 1 one
+        for name, sink in (("g1", "s1"), ("g3", "s3")):
+            stmt = _flow_stmt(name, sink)
+            plane.nodes[0].engine.create_flow(stmt)
+            plane.kv.put_json("__flowroute/" + name, {"node": 0})
+        plane.create_flow(_flow_stmt("g2", "s2"))
+        now = t0 + 40_000.0  # node 0 & 1 both stale...
+        plane.nodes[1].heartbeat(now)  # ...node 1 recovers
+        moved = plane.tick(now_ms=now)
+        assert sorted(moved) == ["g1", "g3"]  # BOTH flows leave node 0
+        assert all(v != 0 for v in plane.routes().values())
+        # at that clock, new assignments also avoid the stale node even
+        # though it has zero flows (least-loaded would otherwise pick it)
+        assert plane.select_flownode(now).node_id != 0
+
     def test_routes_do_not_break_engine_restore(self, db, plane):
         # regression: route keys under the engine's SQL prefix crashed
         # FlowEngine._restore (routes parsed as SQL)
