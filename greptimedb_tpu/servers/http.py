@@ -575,7 +575,8 @@ class HttpServer:
                 cols["line"].append(line)
             cols["__tags__"] = tag_names
             cols["__fields__"] = ["line"]
-            return _ingest_columns(self.db, "loki_logs", cols)
+            return _ingest_columns(self.db, "loki_logs", cols,
+                                    append_mode=True)
 
         try:
             n = await self._call(run)
@@ -616,7 +617,8 @@ class HttpServer:
             cols = parse_otlp_traces(body)
             if not cols:
                 return 0
-            return _ingest_columns(self.db, TRACE_TABLE, cols)
+            return _ingest_columns(self.db, TRACE_TABLE, cols,
+                                   append_mode=True)
 
         try:
             n = await self._call(run)
@@ -831,7 +833,8 @@ class HttpServer:
                     "ts": [r[0] for r in rows],
                     "doc": [r[1] for r in rows],
                 }
-                total += _ingest_columns(self.db, table, cols)
+                total += _ingest_columns(self.db, table, cols,
+                                         append_mode=True)
             return total
 
         try:
@@ -888,7 +891,8 @@ class HttpServer:
                 "ts": [r[2] for r in rows],
                 "event": [r[1] for r in rows],
             }
-            return _ingest_columns(self.db, "splunk_events", cols)
+            return _ingest_columns(self.db, "splunk_events", cols,
+                                   append_mode=True)
 
         try:
             n = await self._call(run)
@@ -967,7 +971,7 @@ class HttpServer:
             cols = pipe.run(rows)
             if not cols["ts"]:
                 return 0
-            return _ingest_columns(self.db, table, cols)
+            return _ingest_columns(self.db, table, cols, append_mode=True)
 
         try:
             n = await self._call(run)
@@ -1162,10 +1166,13 @@ def _safe_table(name: str) -> str:
     return out or "es_logs"
 
 
-def _ingest_columns(db, table: str, cols: dict) -> int:
+def _ingest_columns(db, table: str, cols: dict,
+                    append_mode: bool = False) -> int:
     """Auto-creating ingest (reference Inserter auto table creation,
     src/operator/src/insert.rs:178-304): create the table from the first
-    batch's shape, add columns on demand, then write."""
+    batch's shape, add columns on demand, then write.  ``append_mode``
+    creates log/trace-style tables that keep EVERY row (no (series, ts)
+    dedup — reference CREATE TABLE WITH (append_mode='true'))."""
     from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
     from greptimedb_tpu.datatypes.types import ConcreteDataType, SemanticType
     from greptimedb_tpu.query.ast import AlterTable, ColumnDef
@@ -1194,10 +1201,19 @@ def _ingest_columns(db, table: str, cols: dict) -> int:
                                  SemanticType.TIMESTAMP, nullable=False))
         defs += [ColumnSchema(f, field_type(cols[f]), SemanticType.FIELD)
                  for f in field_names]
-        info = db.catalog.create_table(dbname, name, Schema(tuple(defs)),
-                                       if_not_exists=True)
+        info = db.catalog.create_table(
+            dbname, name, Schema(tuple(defs)),
+            options={"append_mode": "true"} if append_mode else None,
+            if_not_exists=True)
         if info is not None:
-            db.regions.create_region(info.region_ids[0], info.schema)
+            opts = None
+            if append_mode:
+                import dataclasses as _dc
+
+                opts = _dc.replace(db.regions.default_options,
+                                   append_mode=True)
+            db.regions.create_region(info.region_ids[0], info.schema,
+                                     options=opts)
     else:
         info = db.catalog.get_table(dbname, name)
         missing_tags = [t for t in tag_names if not info.schema.has_column(t)]

@@ -81,6 +81,24 @@ def parse_simple_yaml(text: str):
                 key = stripped[: m.start()]
                 rest = stripped[m.end():].strip()
                 pos += 1
+                if rest in ("|", "|-"):
+                    # literal block scalar (vrl/script sources): the
+                    # following deeper-indented lines verbatim
+                    block: list[str] = []
+                    block_indent = None
+                    while pos < len(lines):
+                        nxt = lines[pos]
+                        nxt_indent = len(nxt) - len(nxt.lstrip())
+                        if nxt.strip() and nxt_indent <= cur_indent:
+                            break
+                        if block_indent is None and nxt.strip():
+                            block_indent = nxt_indent
+                        block.append(nxt[block_indent or 0:])
+                        pos += 1
+                    text_block = "\n".join(block)
+                    mapping[key.strip()] = (
+                        text_block if rest == "|-" else text_block + "\n")
+                    continue
                 if rest == "":
                     # nested block or empty
                     if pos < len(lines):
@@ -381,7 +399,242 @@ class FilterProcessor(Processor):
         return row
 
 
+class ScriptProcessor(Processor):
+    """vrl-analog transform (reference etl/processor/vrl_processor.rs):
+    a small, SAFE statement language over the row — no Python eval.
+
+    One statement per line/semicolon:
+      .out = <expr>            assignment
+      del(.field)              deletion
+
+    Expressions: literals, ``.field`` refs, + - * / %, comparisons,
+    && || !, and the functions upper/lower/trim/length/to_string/
+    to_int/to_float/contains/starts_with/ends_with/replace/
+    if(cond, then, else).  Errors in a statement null the target
+    (null-propagating like the rest of the ETL processors).
+    """
+
+    @staticmethod
+    def _split_statements(source: str) -> list[str]:
+        """Split on ; / newline OUTSIDE string literals."""
+        out, buf = [], []
+        quote = None
+        i = 0
+        while i < len(source):
+            ch = source[i]
+            if quote:
+                buf.append(ch)
+                if ch == "\\" and i + 1 < len(source):
+                    buf.append(source[i + 1])
+                    i += 1
+                elif ch == quote:
+                    quote = None
+            elif ch in "\"'":
+                quote = ch
+                buf.append(ch)
+            elif ch in ";\n":
+                out.append("".join(buf))
+                buf = []
+            else:
+                buf.append(ch)
+            i += 1
+        out.append("".join(buf))
+        return out
+
+    def __init__(self, source: str):
+        self.statements = []
+        for raw in self._split_statements(source):
+            stmt = raw.strip()
+            if not stmt or stmt.startswith("#"):
+                continue
+            m = re.fullmatch(r"del\(\s*\.([A-Za-z_][A-Za-z0-9_]*)\s*\)", stmt)
+            if m:
+                self.statements.append(("del", m.group(1), None))
+                continue
+            m = re.fullmatch(
+                r"\.([A-Za-z_][A-Za-z0-9_]*)\s*=\s*(.+)", stmt, re.S)
+            if not m:
+                raise Unsupported(f"script statement {stmt!r}")
+            self.statements.append(
+                ("set", m.group(1), _ScriptExpr(m.group(2))))
+
+    def apply(self, row):
+        for op, name, expr in self.statements:
+            if op == "del":
+                row.pop(name, None)
+            else:
+                try:
+                    row[name] = expr.eval(row)
+                except Exception:  # noqa: BLE001 — null-propagating
+                    row[name] = None
+        return row
+
+
+class _ScriptExpr:
+    """Pratt parser + evaluator for the script expression grammar."""
+
+    _TOKEN = re.compile(
+        r"\s*(?:(\d+\.\d+|\d+)|\"((?:[^\"\\]|\\.)*)\"|'((?:[^'\\]|\\.)*)'"
+        r"|\.([A-Za-z_][A-Za-z0-9_]*)|([A-Za-z_][A-Za-z0-9_]*)"
+        r"|(==|!=|<=|>=|&&|\|\||[-+*/%<>()!,]))")
+
+    _FUNCS = {
+        "upper": lambda a: str(a[0]).upper() if a[0] is not None else None,
+        "lower": lambda a: str(a[0]).lower() if a[0] is not None else None,
+        "trim": lambda a: str(a[0]).strip() if a[0] is not None else None,
+        "length": lambda a: len(str(a[0])) if a[0] is not None else None,
+        "to_string": lambda a: None if a[0] is None else str(a[0]),
+        "to_int": lambda a: None if a[0] is None else int(float(a[0])),
+        "to_float": lambda a: None if a[0] is None else float(a[0]),
+        "contains": lambda a: str(a[1]) in str(a[0]),
+        "starts_with": lambda a: str(a[0]).startswith(str(a[1])),
+        "ends_with": lambda a: str(a[0]).endswith(str(a[1])),
+        "replace": lambda a: str(a[0]).replace(str(a[1]), str(a[2])),
+        "if": lambda a: a[1] if a[0] else a[2],
+    }
+
+    def __init__(self, src: str):
+        self.tokens: list[tuple[str, object]] = []
+        pos = 0
+        while pos < len(src):
+            m = self._TOKEN.match(src, pos)
+            if m is None:
+                if src[pos:].strip():
+                    raise Unsupported(f"script token at {src[pos:]!r}")
+                break
+            pos = m.end()
+            num, dq, sq, fieldref, ident, op = m.groups()
+            if num is not None:
+                self.tokens.append(
+                    ("lit", float(num) if "." in num else int(num)))
+            elif dq is not None or sq is not None:
+                s = dq if dq is not None else sq
+                self.tokens.append(
+                    ("lit", s.replace('\\"', '"').replace("\\'", "'")
+                     .replace("\\\\", "\\")))
+            elif fieldref is not None:
+                self.tokens.append(("field", fieldref))
+            elif ident is not None:
+                if ident in ("true", "false"):
+                    self.tokens.append(("lit", ident == "true"))
+                elif ident == "null":
+                    self.tokens.append(("lit", None))
+                elif ident in self._FUNCS:
+                    self.tokens.append(("func", ident))
+                else:
+                    raise Unsupported(f"script identifier {ident!r}")
+            else:
+                self.tokens.append(("op", op))
+        self._i = 0
+        self.ast = self._expr(0)
+        if self._i != len(self.tokens):
+            raise Unsupported("script: trailing tokens")
+
+    _BINDING = {"||": 1, "&&": 2, "==": 3, "!=": 3, "<": 3, ">": 3,
+                "<=": 3, ">=": 3, "+": 4, "-": 4, "*": 5, "/": 5, "%": 5}
+
+    def _peek(self):
+        return self.tokens[self._i] if self._i < len(self.tokens) else None
+
+    def _next(self):
+        if self._i >= len(self.tokens):
+            raise Unsupported("script: unexpected end of expression")
+        t = self.tokens[self._i]
+        self._i += 1
+        return t
+
+    def _expr(self, min_bp: int):
+        kind, val = self._next()
+        if kind == "lit":
+            left = ("lit", val)
+        elif kind == "field":
+            left = ("field", val)
+        elif kind == "func":
+            if self._next() != ("op", "("):
+                raise Unsupported("script: expected ( after function")
+            args = []
+            if self._peek() != ("op", ")"):
+                args.append(self._expr(0))
+                while self._peek() == ("op", ","):
+                    self._next()
+                    args.append(self._expr(0))
+            if self._next() != ("op", ")"):
+                raise Unsupported("script: expected )")
+            left = ("call", val, args)
+        elif kind == "op" and val == "(":
+            left = self._expr(0)
+            if self._next() != ("op", ")"):
+                raise Unsupported("script: expected )")
+        elif kind == "op" and val in ("-", "!"):
+            left = ("unary", val, self._expr(6))
+        else:
+            raise Unsupported(f"script: unexpected {val!r}")
+        while True:
+            t = self._peek()
+            if t is None or t[0] != "op" or t[1] not in self._BINDING:
+                break
+            bp = self._BINDING[t[1]]
+            if bp < min_bp:
+                break
+            self._next()
+            left = ("bin", t[1], left, self._expr(bp + 1))
+        return left
+
+    def eval(self, row: dict):
+        return self._ev(self.ast, row)
+
+    def _ev(self, node, row):
+        k = node[0]
+        if k == "lit":
+            return node[1]
+        if k == "field":
+            return row.get(node[1])
+        if k == "call":
+            if node[1] == "if":  # lazy: only the taken branch evaluates
+                if len(node[2]) != 3:
+                    raise Unsupported("if(cond, then, else)")
+                cond = self._ev(node[2][0], row)
+                return self._ev(node[2][1 if cond else 2], row)
+            return self._FUNCS[node[1]](
+                [self._ev(a, row) for a in node[2]])
+        if k == "unary":
+            v = self._ev(node[2], row)
+            return (not v) if node[1] == "!" else -v
+        op, a, b = node[1], node[2], node[3]
+        if op == "&&":
+            return bool(self._ev(a, row)) and bool(self._ev(b, row))
+        if op == "||":
+            return bool(self._ev(a, row)) or bool(self._ev(b, row))
+        va, vb = self._ev(a, row), self._ev(b, row)
+        if op == "+":
+            if isinstance(va, str) or isinstance(vb, str):
+                return str(va) + str(vb)
+            return va + vb
+        if op == "-":
+            return va - vb
+        if op == "*":
+            return va * vb
+        if op == "/":
+            return va / vb
+        if op == "%":
+            return va % vb
+        if op == "==":
+            return va == vb
+        if op == "!=":
+            return va != vb
+        # numeric-or-string comparisons
+        if op == "<":
+            return va < vb
+        if op == ">":
+            return va > vb
+        if op == "<=":
+            return va <= vb
+        return va >= vb
+
+
 _PROCESSORS = {
+    "script": lambda c: ScriptProcessor(str(c.get("source", ""))),
+    "vrl": lambda c: ScriptProcessor(str(c.get("source", ""))),
     "dissect": lambda c: DissectProcessor(
         _fields_of(c), [str(p) for p in (c.get("patterns") or [])],
         c.get("ignore_missing", True)),

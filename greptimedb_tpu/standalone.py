@@ -189,12 +189,14 @@ class GreptimeDB(TableProvider):
         region_options: RegionOptions | None = None,
         cache_capacity_bytes: int = 8 << 30,
         metadata_store: str | None = None,
+        plugins: list[str] | None = None,
     ):
         """``metadata_store`` selects the kv backend (reference
         [metadata_store]/meta backend config): None → file-backed (or
         memory when data_home is None), "sqlite" → SqliteKv (RDS
         analog), "memory", or "remote://host:port" → shared KvServer
-        (etcd analog)."""
+        (etcd analog).  ``plugins``: module paths loaded via
+        utils/plugins.py (UDFs, processors, auth providers)."""
         # sanity-check the accelerator backend: if the configured platform
         # can't initialize (e.g. the TPU relay is down), fall back to CPU
         # rather than failing every query
@@ -260,6 +262,11 @@ class GreptimeDB(TableProvider):
         from greptimedb_tpu.utils.auth import StaticUserProvider
 
         self.user_provider = StaticUserProvider()
+        self.plugins = None
+        if plugins:
+            from greptimedb_tpu.utils.plugins import load_plugins
+
+            self.plugins = load_plugins(plugins, db=self)
         self.timezone = "UTC"  # SET time_zone / config default_timezone
         # slow-query recorder (reference common-event-recorder + the
         # greptime_private.slow_queries system table): queries slower than
@@ -389,14 +396,19 @@ class GreptimeDB(TableProvider):
         """Execute one or more statements; returns the LAST result."""
         import time as _time
 
+        from greptimedb_tpu.utils.tracing import TRACER
+
         with self._lock:
             t0 = _time.perf_counter()
-            stmts = parse_sql(query)
-            if not stmts:
-                return QueryResult([], [])
-            result = QueryResult([], [])
-            for stmt in stmts:
-                result = self.execute_statement(stmt)
+            with TRACER.span("sql", statement=query[:256]):
+                stmts = parse_sql(query)
+                if not stmts:
+                    return QueryResult([], [])
+                result = QueryResult([], [])
+                for stmt in stmts:
+                    with TRACER.span("execute_statement",
+                                     kind=type(stmt).__name__):
+                        result = self.execute_statement(stmt)
             elapsed_ms = (_time.perf_counter() - t0) * 1000
             if (
                 self.slow_query_threshold_ms > 0
@@ -595,8 +607,18 @@ class GreptimeDB(TableProvider):
             if_not_exists=stmt.if_not_exists,
         )
         if info is not None and stmt.engine != "file":
+            opts = None
+            if str(stmt.options.get("append_mode", "")).lower() in (
+                    "true", "1"):
+                # append-mode table (reference WITH (append_mode='true'),
+                # the log/trace model): every row kept, no (series, ts)
+                # dedup anywhere in the LSM
+                import dataclasses as _dc
+
+                opts = _dc.replace(self.regions.default_options,
+                                   append_mode=True)
             for rid in info.region_ids:
-                self.regions.create_region(rid, schema)
+                self.regions.create_region(rid, schema, options=opts)
         return QueryResult([], [], affected_rows=0)
 
     def _drop_table(self, stmt: DropTable) -> QueryResult:

@@ -58,13 +58,14 @@ class Memtable:
     def is_empty(self) -> bool:
         return self.num_rows == 0
 
-    def freeze(self) -> dict[str, np.ndarray]:
-        """Concatenate, sort by (tsid, ts, seq), dedup keep-last.
+    def freeze(self, dedup: bool = True) -> dict[str, np.ndarray]:
+        """Concatenate, sort by (tsid, ts, seq)[, dedup keep-last].
 
         Matches mito2 flush semantics (handle_write + flush.rs): the SST is
         sorted on the primary key and contains one row per (series, ts) with
         the highest sequence; delete tombstones survive dedup so they can
-        shadow older SSTs until compaction drops them.
+        shadow older SSTs until compaction drops them.  ``dedup=False`` is
+        append mode: every row survives (the log/trace data model).
         """
         if not self._chunks:
             return {}
@@ -75,6 +76,8 @@ class Memtable:
         ts_col = self.schema.time_index.name
         order = np.lexsort((merged[SEQ], merged[ts_col], merged[TSID]))
         merged = {k: v[order] for k, v in merged.items()}
+        if not dedup:
+            return merged
         # keep-last within (tsid, ts): last in sorted order has max seq
         tsid, ts = merged[TSID], merged[ts_col]
         is_last = np.ones(len(tsid), dtype=bool)

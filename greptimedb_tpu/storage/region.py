@@ -48,6 +48,10 @@ class RegionOptions:
     compaction_trigger_files: int = 8  # files per window before merge
     wal_enabled: bool = True
     wal_sync: bool = False
+    # append mode (reference CREATE TABLE WITH (append_mode='true'),
+    # mito2 MergeMode): rows with equal (series, ts) keys are ALL kept —
+    # the log/trace data model, where many events share a millisecond
+    append_mode: bool = False
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -296,7 +300,7 @@ class Region:
     def flush(self) -> SstMeta | None:
         if self.memtable.is_empty:
             return None
-        frozen = self.memtable.freeze()
+        frozen = self.memtable.freeze(dedup=not self.options.append_mode)
         flushed_seq = self.memtable.max_seq
         # storage keeps ts as int64 epoch in schema unit
         meta = write_sst(self.store, f"{self._dir}/sst", self.schema, frozen)
@@ -382,12 +386,13 @@ class Region:
         # re-encode tags: raw values -> codes -> tsid already in file (TSID col)
         order = np.lexsort((merged[SEQ], merged[self.ts_name], merged[TSID]))
         merged = {k: v[order] for k, v in merged.items()}
-        tsid, ts = merged[TSID], merged[self.ts_name]
-        keep = np.ones(len(tsid), dtype=bool)
-        if len(tsid) > 1:
-            same = (tsid[1:] == tsid[:-1]) & (ts[1:] == ts[:-1])
-            keep[:-1] = ~same
-        merged = {k: v[keep] for k, v in merged.items()}
+        if not self.options.append_mode:
+            tsid, ts = merged[TSID], merged[self.ts_name]
+            keep = np.ones(len(tsid), dtype=bool)
+            if len(tsid) > 1:
+                same = (tsid[1:] == tsid[:-1]) & (ts[1:] == ts[:-1])
+                keep[:-1] = ~same
+            merged = {k: v[keep] for k, v in merged.items()}
         full = len(files) == len(self.sst_files) and self.memtable.is_empty
         if full:
             alive = merged[OP] != OP_DELETE
@@ -623,11 +628,12 @@ class Region:
         merged = {k: np.concatenate([p[k] for p in parts]) for k in names}
         order = np.lexsort((merged[SEQ], merged[self.ts_name], merged[TSID]))
         merged = {k: v[order] for k, v in merged.items()}
-        tsid, ts = merged[TSID], merged[self.ts_name]
-        keep = np.ones(len(tsid), dtype=bool)
-        if len(tsid) > 1:
-            same = (tsid[1:] == tsid[:-1]) & (ts[1:] == ts[:-1])
-            keep[:-1] = ~same
+        keep = np.ones(len(merged[TSID]), dtype=bool)
+        if not self.options.append_mode:
+            tsid, ts = merged[TSID], merged[self.ts_name]
+            if len(tsid) > 1:
+                same = (tsid[1:] == tsid[:-1]) & (ts[1:] == ts[:-1])
+                keep[:-1] = ~same
         alive = keep & (merged[OP] != OP_DELETE)
         return {k: v[alive] for k, v in merged.items()}
 

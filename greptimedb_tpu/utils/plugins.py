@@ -1,0 +1,70 @@
+"""Plugin system: user extension hooks loaded from config.
+
+Reference: the plugins crate + plugin options threaded through every
+role's start-up (src/common/plugins/, plugins::setup_*).  A plugin is
+an importable module exposing ``register(api)``; the api object offers
+the supported extension points:
+
+- ``register_scalar_function(name, fn)`` — host scalar UDF, signature
+  ``fn(args, n) -> np.ndarray`` (same contract as query/exprs
+  _HOST_FUNCS).
+- ``register_processor(name, maker)`` — ETL pipeline processor,
+  ``maker(cfg_dict) -> Processor``.
+- ``register_auth_provider(provider)`` — replaces the user provider
+  (must expose ``enabled``/``check_plain`` like auth.StaticUserProvider).
+
+Load failures name the module and re-raise: a half-loaded plugin set
+is worse than a refused start (matching the reference's fail-fast
+plugin setup).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from greptimedb_tpu.errors import InvalidArguments
+
+
+class PluginApi:
+    """Extension points handed to each plugin's register()."""
+
+    def __init__(self, db=None):
+        self.db = db
+        self.loaded: list[str] = []
+
+    def register_scalar_function(self, name: str, fn) -> None:
+        from greptimedb_tpu.query.exprs import _HOST_FUNCS
+
+        if not callable(fn):
+            raise InvalidArguments(f"plugin function {name!r} not callable")
+        _HOST_FUNCS[str(name).lower()] = fn
+
+    def register_processor(self, name: str, maker) -> None:
+        from greptimedb_tpu.servers.pipeline import _PROCESSORS
+
+        if not callable(maker):
+            raise InvalidArguments(f"plugin processor {name!r} not callable")
+        _PROCESSORS[str(name)] = maker
+
+    def register_auth_provider(self, provider) -> None:
+        if self.db is None:
+            raise InvalidArguments(
+                "auth provider plugins need a database instance")
+        self.db.user_provider = provider
+
+
+def load_plugins(module_paths: list[str], db=None) -> PluginApi:
+    """Import each module and call its register(api); fail fast."""
+    api = PluginApi(db)
+    for path in module_paths or []:
+        try:
+            mod = importlib.import_module(path)
+        except ImportError as e:
+            raise InvalidArguments(f"plugin {path!r}: {e}") from e
+        register = getattr(mod, "register", None)
+        if register is None:
+            raise InvalidArguments(
+                f"plugin {path!r} has no register(api) entry point")
+        register(api)
+        api.loaded.append(path)
+    return api

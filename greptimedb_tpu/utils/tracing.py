@@ -1,0 +1,169 @@
+"""Tracing: spans + OTLP/HTTP export.
+
+Reference: common-telemetry's tracing layer exporting OTLP spans to a
+collector (src/common/telemetry/src/tracing_*.rs, config
+[logging].otlp_endpoint).  Spans record into a bounded in-process
+buffer; the exporter encodes ExportTraceServiceRequest protobuf (the
+same wire format servers/trace.py parses — a greptimedb-tpu instance
+can export its own spans to another instance, or to any OTLP
+collector) and POSTs it over HTTP.
+
+Disabled tracers cost one attribute check per span.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import struct
+import threading
+import time
+import urllib.request
+
+# ---- protobuf wire encoding (mirror of servers/protocols._pb_fields) ----
+
+
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b7 = v & 0x7F
+        v >>= 7
+        out += bytes([b7 | (0x80 if v else 0)])
+        if not v:
+            return out
+
+
+def _field(num: int, payload: bytes) -> bytes:
+    """Length-delimited field."""
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _vint_field(num: int, v: int) -> bytes:
+    return _varint(num << 3) + _varint(v)
+
+
+def _fixed64_field(num: int, v: int) -> bytes:
+    return _varint((num << 3) | 1) + struct.pack("<Q", v)
+
+
+def _kv(key: str, value: str) -> bytes:
+    any_value = _field(1, value.encode())  # AnyValue.string_value
+    return _field(1, key.encode()) + _field(2, any_value)
+
+
+def encode_spans(service_name: str, spans: list[dict]) -> bytes:
+    """[span dicts] → ExportTraceServiceRequest bytes."""
+    span_msgs = []
+    for s in spans:
+        msg = _field(1, bytes.fromhex(s["trace_id"]))
+        msg += _field(2, bytes.fromhex(s["span_id"]))
+        if s.get("parent_span_id"):
+            msg += _field(4, bytes.fromhex(s["parent_span_id"]))
+        msg += _field(5, s["name"].encode())
+        msg += _vint_field(6, s.get("kind", 1))  # SPAN_KIND_INTERNAL
+        msg += _fixed64_field(7, s["start_ns"])
+        msg += _fixed64_field(8, s["end_ns"])
+        for k, v in (s.get("attributes") or {}).items():
+            msg += _field(9, _kv(str(k), str(v)))
+        msg += _field(15, _vint_field(2, s.get("status_code", 0)))
+        span_msgs.append(msg)
+    scope_spans = b"".join(_field(2, m) for m in span_msgs)
+    resource = _field(1, _kv("service.name", service_name))
+    resource_spans = _field(1, resource) + _field(2, scope_spans)
+    return _field(1, resource_spans)
+
+
+class Tracer:
+    """Span recorder + OTLP exporter.  One process-wide instance
+    (``TRACER``); enable via configure()."""
+
+    def __init__(self):
+        self.enabled = False
+        self.endpoint: str | None = None
+        self.service_name = "greptimedb-tpu"
+        self.max_buffer = 2048
+        self._spans: list[dict] = []
+        self._lock = threading.Lock()
+        self._tls = threading.local()  # current span id (parenting)
+        self._trace_id_base = os.urandom(12).hex()
+        self._counter = 0
+
+    def configure(self, endpoint: str | None = None,
+                  service_name: str | None = None,
+                  enabled: bool = True) -> None:
+        self.endpoint = endpoint
+        if service_name:
+            self.service_name = service_name
+        self.enabled = enabled
+
+    def disable(self) -> None:
+        self.enabled = False
+        self.endpoint = None
+        with self._lock:
+            self._spans.clear()
+
+    def _next_ids(self) -> tuple[str, str]:
+        with self._lock:
+            self._counter += 1
+            c = self._counter
+        return (self._trace_id_base + struct.pack(">I", c & 0xFFFFFFFF).hex(),
+                os.urandom(8).hex())
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes):
+        if not self.enabled:
+            yield None
+            return
+        parent = getattr(self._tls, "current", None)
+        if parent is not None:
+            trace_id = parent[0]
+            parent_id = parent[1]
+        else:
+            trace_id, _ = self._next_ids()
+            parent_id = ""
+        span_id = os.urandom(8).hex()
+        self._tls.current = (trace_id, span_id)
+        start_ns = time.time_ns()
+        status = 0
+        try:
+            yield span_id
+        except BaseException:
+            status = 2  # STATUS_CODE_ERROR
+            raise
+        finally:
+            self._tls.current = parent
+            rec = {
+                "trace_id": trace_id,
+                "span_id": span_id,
+                "parent_span_id": parent_id,
+                "name": name,
+                "start_ns": start_ns,
+                "end_ns": time.time_ns(),
+                "attributes": {k: v for k, v in attributes.items()},
+                "status_code": status,
+            }
+            with self._lock:
+                self._spans.append(rec)
+                if len(self._spans) > self.max_buffer:
+                    del self._spans[: len(self._spans) - self.max_buffer]
+
+    def drain(self) -> list[dict]:
+        with self._lock:
+            out = self._spans
+            self._spans = []
+        return out
+
+    def flush(self, timeout: float = 10.0) -> int:
+        """Export buffered spans to the OTLP endpoint; returns count."""
+        spans = self.drain()
+        if not spans or not self.endpoint:
+            return 0
+        body = encode_spans(self.service_name, spans)
+        req = urllib.request.Request(
+            self.endpoint, data=body, method="POST",
+            headers={"Content-Type": "application/x-protobuf"})
+        urllib.request.urlopen(req, timeout=timeout).read()
+        return len(spans)
+
+
+TRACER = Tracer()

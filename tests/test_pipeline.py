@@ -99,8 +99,10 @@ transform:
         assert cols["level"] == ["ERROR"]
 
     def test_unknown_processor(self):
+        # NB: "vrl" used to be the canonical unknown processor; it is
+        # now implemented (ScriptProcessor)
         with pytest.raises(Unsupported):
-            Pipeline.from_yaml("x", "processors:\n  - vrl:\n      x: 1\ntransform:\n  - fields:\n      - ts\n    type: epoch\n    index: timestamp")
+            Pipeline.from_yaml("x", "processors:\n  - frobnicate:\n      x: 1\ntransform:\n  - fields:\n      - ts\n    type: epoch\n    index: timestamp")
 
     def test_missing_timestamp_transform(self):
         with pytest.raises(InvalidArguments):

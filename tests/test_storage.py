@@ -1092,3 +1092,38 @@ class TestS3ObjectStore:
         store._request = paged
         assert len(store.list("pg")) == 7
         store._request = real
+
+
+class TestAppendMode:
+    """append_mode tables keep every row (reference WITH (append_mode),
+    mito2 MergeMode) — the log/trace data model."""
+
+    def test_same_key_rows_all_survive(self, tmp_path):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(str(tmp_path / "home"))
+        db.sql("CREATE TABLE lg (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " m STRING, PRIMARY KEY (h)) WITH (append_mode='true')")
+        db.sql("INSERT INTO lg VALUES ('a',1000,'x'),('a',1000,'y')")
+        region = db._region_of("lg")
+        region.flush()  # dedup would happen at freeze
+        db.sql("INSERT INTO lg VALUES ('a',1000,'z')")
+        assert db.sql("SELECT count(*) FROM lg").rows == [[3]]
+        region.compact()  # and at compaction
+        assert db.sql("SELECT count(*) FROM lg").rows == [[3]]
+        db.close()
+        # and across restart (options persisted in the manifest)
+        db2 = GreptimeDB(str(tmp_path / "home"))
+        db2.sql("INSERT INTO lg VALUES ('a',1000,'w')")
+        assert db2.sql("SELECT count(*) FROM lg").rows == [[4]]
+        db2.close()
+
+    def test_default_tables_still_dedup(self, tmp_path):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB()
+        db.sql("CREATE TABLE m (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO m VALUES ('a',1000,1.0),('a',1000,2.0)")
+        assert db.sql("SELECT v FROM m").rows == [[2.0]]
+        db.close()

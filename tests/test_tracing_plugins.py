@@ -1,0 +1,250 @@
+"""OTLP span export, script (vrl-analog) pipeline processor, plugins.
+
+Reference: common-telemetry OTLP tracing export, etl vrl_processor.rs,
+the plugins crate.
+"""
+
+import sys
+import textwrap
+import types
+
+import pytest
+
+from greptimedb_tpu.errors import InvalidArguments, Unsupported
+from greptimedb_tpu.standalone import GreptimeDB
+from greptimedb_tpu.utils.tracing import TRACER, Tracer, encode_spans
+
+
+class TestTracer:
+    def test_disabled_records_nothing(self):
+        t = Tracer()
+        with t.span("x"):
+            pass
+        assert t.drain() == []
+
+    def test_span_recording_and_parenting(self):
+        t = Tracer()
+        t.configure(enabled=True)
+        with t.span("outer", q="SELECT 1"):
+            with t.span("inner"):
+                pass
+        spans = t.drain()
+        assert [s["name"] for s in spans] == ["inner", "outer"]
+        inner, outer = spans
+        assert inner.get("trace_id") == outer["trace_id"]
+        assert inner["parent_span_id"] == outer["span_id"]
+        assert outer["parent_span_id"] == ""
+        assert outer["attributes"] == {"q": "SELECT 1"}
+        assert outer["end_ns"] >= outer["start_ns"]
+
+    def test_error_sets_status(self):
+        t = Tracer()
+        t.configure(enabled=True)
+        with pytest.raises(ValueError):
+            with t.span("boom"):
+                raise ValueError("x")
+        assert t.drain()[0]["status_code"] == 2
+
+    def test_buffer_bounded(self):
+        t = Tracer()
+        t.configure(enabled=True)
+        t.max_buffer = 10
+        for i in range(25):
+            with t.span(f"s{i}"):
+                pass
+        assert len(t.drain()) == 10
+
+    def test_encode_parses_back(self):
+        # round-trip through the server-side OTLP parser
+        from greptimedb_tpu.servers.trace import parse_otlp_traces
+
+        t = Tracer()
+        t.configure(enabled=True)
+        with t.span("hello", table="cpu"):
+            pass
+        body = encode_spans("svc-a", t.drain())
+        cols = parse_otlp_traces(body)
+        assert cols["service_name"] == ["svc-a"]
+        assert cols["span_name"] == ["hello"]
+        assert '"table": "cpu"' in cols["attributes"][0]
+
+    def test_export_to_another_instance(self):
+        # dogfood: instance A's spans land in instance B's trace table
+        from greptimedb_tpu.servers.http import HttpServer
+
+        sink = GreptimeDB()
+        srv = HttpServer(sink, port=0)
+        srv.start()
+        try:
+            src = GreptimeDB()
+            TRACER.configure(
+                endpoint=f"http://127.0.0.1:{srv.port}/v1/otlp/v1/traces",
+                service_name="greptime-src")
+            src.sql("CREATE TABLE t (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+                    " PRIMARY KEY (h))")
+            src.sql("SELECT 1")
+            n = TRACER.flush()
+            assert n >= 4  # sql + execute_statement spans per query
+            rows = sink.sql(
+                "SELECT service_name, span_name FROM opentelemetry_traces"
+                " WHERE service_name = 'greptime-src'").rows
+            assert rows and {r[1] for r in rows} >= {
+                "sql", "execute_statement"}
+            src.close()
+        finally:
+            TRACER.disable()
+            srv.stop()
+            sink.close()
+
+
+class TestScriptProcessor:
+    def run(self, source, row):
+        from greptimedb_tpu.servers.pipeline import ScriptProcessor
+
+        return ScriptProcessor(source).apply(dict(row))
+
+    def test_assignment_and_arithmetic(self):
+        out = self.run(".ms = .s * 1000\n.total = .a + .b",
+                       {"s": 1.5, "a": 2, "b": 3})
+        assert out["ms"] == 1500.0 and out["total"] == 5
+
+    def test_string_functions_and_concat(self):
+        out = self.run(
+            '.lvl = upper(.level); .msg = .host + ": " + .text',
+            {"level": "warn", "host": "h1", "text": "disk"})
+        assert out["lvl"] == "WARN" and out["msg"] == "h1: disk"
+
+    def test_if_and_comparisons(self):
+        src = '.sev = if(.code >= 500, "error", "ok")'
+        assert self.run(src, {"code": 503})["sev"] == "error"
+        assert self.run(src, {"code": 200})["sev"] == "ok"
+
+    def test_del_and_null_propagation(self):
+        out = self.run("del(.secret)\n.x = .missing * 2",
+                       {"secret": "s", "keep": 1})
+        assert "secret" not in out and out["x"] is None and out["keep"] == 1
+
+    def test_nested_and_bool_logic(self):
+        out = self.run(
+            ".flag = contains(.msg, \"err\") && .n > 1 || false",
+            {"msg": "errors", "n": 5})
+        assert out["flag"] is True
+
+    def test_semicolon_inside_string_literal(self):
+        out = self.run('.msg = replace(.msg, ";", ",")', {"msg": "a;b"})
+        assert out["msg"] == "a,b"
+
+    def test_if_is_lazy(self):
+        src = ".rate = if(.total != 0, .hits / .total, 0)"
+        assert self.run(src, {"hits": 4, "total": 2})["rate"] == 2.0
+        assert self.run(src, {"hits": 4, "total": 0})["rate"] == 0
+
+    def test_truncated_expression_is_clean_error(self):
+        with pytest.raises(Unsupported, match="end of expression"):
+            self.run(".x = 1 +", {})
+
+    def test_rejects_arbitrary_code(self):
+        with pytest.raises(Unsupported):
+            self.run(".x = __import__('os')", {})
+        with pytest.raises(Unsupported):
+            self.run("import os", {})
+
+    def test_pipeline_integration(self):
+        db = GreptimeDB()
+        import json as _json
+        import urllib.request
+
+        from greptimedb_tpu.servers.http import HttpServer
+
+        srv = HttpServer(db, port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            pipeline = textwrap.dedent("""
+                processors:
+                  - vrl:
+                      source: |
+                        .level = upper(.level)
+                        .latency_ms = .latency_s * 1000
+                        del(.latency_s)
+                transform:
+                  - fields: [level]
+                    type: string
+                    index: tag
+                  - fields: [latency_ms]
+                    type: float64
+                  - fields: [ts]
+                    type: time
+                    index: timestamp
+            """)
+            req = urllib.request.Request(
+                base + "/v1/pipelines/vrltest", data=pipeline.encode(),
+                method="POST", headers={"Content-Type": "application/x-yaml"})
+            urllib.request.urlopen(req, timeout=10).read()
+            doc = _json.dumps([{"level": "warn", "latency_s": 0.25,
+                                "ts": 1700000000000}])
+            req = urllib.request.Request(
+                base + "/v1/ingest?db=public&table=vrl_logs"
+                       "&pipeline_name=vrltest",
+                data=doc.encode(), method="POST",
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=10).read()
+            rows = db.sql("SELECT level, latency_ms FROM vrl_logs").rows
+            assert rows == [["WARN", 250.0]]
+        finally:
+            srv.stop()
+            db.close()
+
+
+class TestPlugins:
+    def _mk_module(self, name, body):
+        mod = types.ModuleType(name)
+        exec(body, mod.__dict__)
+        sys.modules[name] = mod
+        return mod
+
+    def test_scalar_function_plugin(self):
+        self._mk_module("fake_udf_plugin", textwrap.dedent("""
+            import numpy as np
+            def double_it(args, n):
+                return np.asarray(args[0], dtype=float) * 2
+            def register(api):
+                api.register_scalar_function("double_it", double_it)
+        """))
+        db = GreptimeDB(plugins=["fake_udf_plugin"])
+        assert db.plugins.loaded == ["fake_udf_plugin"]
+        db.sql("CREATE TABLE p (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO p VALUES ('a', 1000, 2.5)")
+        assert db.sql("SELECT double_it(v) FROM p").rows == [[5.0]]
+        db.close()
+
+    def test_processor_plugin(self):
+        self._mk_module("fake_proc_plugin", textwrap.dedent("""
+            class Redact:
+                def __init__(self, cfg):
+                    self.field = cfg.get("field", "msg")
+                def apply(self, row):
+                    if self.field in row:
+                        row[self.field] = "[redacted]"
+                    return row
+            def register(api):
+                api.register_processor("redact", lambda c: Redact(c or {}))
+        """))
+        from greptimedb_tpu.servers.pipeline import _PROCESSORS
+
+        db = GreptimeDB(plugins=["fake_proc_plugin"])
+        assert "redact" in _PROCESSORS
+        proc = _PROCESSORS["redact"]({"field": "msg"})
+        assert proc.apply({"msg": "secret"})["msg"] == "[redacted]"
+        db.close()
+        del _PROCESSORS["redact"]
+
+    def test_missing_plugin_fails_fast(self):
+        with pytest.raises(InvalidArguments, match="no_such_plugin"):
+            GreptimeDB(plugins=["no_such_plugin_xyz"]).close()
+
+    def test_plugin_without_register_rejected(self):
+        self._mk_module("fake_empty_plugin", "x = 1")
+        with pytest.raises(InvalidArguments, match="register"):
+            GreptimeDB(plugins=["fake_empty_plugin"]).close()
