@@ -224,6 +224,136 @@ def _key_column_usage(db):
     return _columns_of(rows, names), types
 
 
+def _process_list(db):
+    """Live statements (reference information_schema/process_list.rs)."""
+    rows = []
+    for t in db.processes.list():
+        rows.append({
+            "id": f"{db.processes.server_addr}/{t.id}",
+            "catalog": "greptime", "schemas": t.database,
+            "query": t.query, "client": t.client,
+            "frontend": db.processes.server_addr,
+            "start_timestamp": int(t.start_ts * 1000),
+            "elapsed_time": int(t.elapsed_ms),
+        })
+    names = ["id", "catalog", "schemas", "query", "client", "frontend",
+             "start_timestamp", "elapsed_time"]
+    types = {n: "String" for n in names}
+    types.update({"start_timestamp": "TimestampMillisecond",
+                  "elapsed_time": "Int64"})
+    return _columns_of(rows, names), types
+
+
+def _region_peers(db):
+    """Region placement (reference information_schema/region_peers.rs).
+    Standalone hosts every region as local leader (peer 0); the cluster
+    route table lives in the metasrv, not here."""
+    rows = []
+    for d in db.catalog.list_databases():
+        for t in db.catalog.list_tables(d):
+            for rid in t.region_ids:
+                peer = 0
+                rows.append({
+                    "table_catalog": "greptime", "table_schema": d,
+                    "table_name": t.name, "region_id": rid,
+                    "peer_id": peer, "peer_addr": "",
+                    "is_leader": "Yes", "status": "ALIVE",
+                    "down_seconds": None,
+                })
+    names = ["table_catalog", "table_schema", "table_name", "region_id",
+             "peer_id", "peer_addr", "is_leader", "status", "down_seconds"]
+    types = {n: "String" for n in names}
+    types.update({"region_id": "UInt64", "peer_id": "UInt64",
+                  "down_seconds": "Int64"})
+    return _columns_of(rows, names), types
+
+
+def _ssts(db):
+    """Per-region SST file inventory (reference information_schema/ssts)."""
+    rows = []
+    for d in db.catalog.list_databases():
+        for t in db.catalog.list_tables(d):
+            for rid in t.region_ids:
+                region = db.regions.regions.get(rid)
+                if region is None:
+                    continue
+                for m in region.sst_files:
+                    rows.append({
+                        "table_schema": d, "table_name": t.name,
+                        "region_id": rid, "file_id": m.file_id,
+                        "file_path": m.path, "level": m.level,
+                        "file_size": m.size_bytes, "num_rows": m.num_rows,
+                        "min_ts": m.ts_min, "max_ts": m.ts_max,
+                    })
+    names = ["table_schema", "table_name", "region_id", "file_id",
+             "file_path", "level", "file_size", "num_rows", "min_ts",
+             "max_ts"]
+    types = {n: "UInt64" for n in names}
+    types.update({"table_schema": "String", "table_name": "String",
+                  "file_id": "String", "file_path": "String",
+                  "min_ts": "TimestampMillisecond",
+                  "max_ts": "TimestampMillisecond"})
+    return _columns_of(rows, names), types
+
+
+def _procedure_info(db):
+    """Journaled procedures (reference information_schema/procedure_info)."""
+    import json as _json
+
+    mgr = db.procedures
+    rows = []
+    for k, raw in mgr.kv.range(mgr._PREFIX):
+        rec = _json.loads(raw)
+        rows.append({
+            "procedure_id": k[len(mgr._PREFIX):],
+            "procedure_type": rec.get("type"),
+            "start_time": None,
+            "end_time": int(rec["ts"] * 1000) if "ts" in rec else None,
+            "status": str(rec.get("status", "")).upper(),
+            "lock_keys": None,
+            "error": rec.get("error"),
+        })
+    names = ["procedure_id", "procedure_type", "start_time", "end_time",
+             "status", "lock_keys", "error"]
+    types = {n: "String" for n in names}
+    types.update({"start_time": "TimestampMillisecond",
+                  "end_time": "TimestampMillisecond"})
+    return _columns_of(rows, names), types
+
+
+def _runtime_metrics(db):
+    """Snapshot of the telemetry registry (reference runtime_metrics)."""
+    from greptimedb_tpu.utils.telemetry import REGISTRY
+
+    now = int(time.time() * 1000)
+    rows = []
+    with REGISTRY._lock:
+        metrics = list(REGISTRY._metrics.values())
+    for m in metrics:
+        with m._lock:  # labels() may insert children concurrently
+            children = sorted(m._children.items())
+        for key, child in children:
+            labels = ", ".join(
+                f"{n}={v}" for n, v in zip(m.label_names, key)
+            ) or None
+            if m.kind == "histogram":
+                value, extra = child.sum, [("_count", float(child.total))]
+            else:
+                value, extra = child.value, []
+            rows.append({"metric_name": m.name, "value": float(value),
+                         "labels": labels, "node": "standalone",
+                         "node_type": "standalone", "timestamp": now})
+            for suffix, v in extra:
+                rows.append({"metric_name": m.name + suffix, "value": v,
+                             "labels": labels, "node": "standalone",
+                             "node_type": "standalone", "timestamp": now})
+    names = ["metric_name", "value", "labels", "node", "node_type",
+             "timestamp"]
+    types = {n: "String" for n in names}
+    types.update({"value": "Float64", "timestamp": "TimestampMillisecond"})
+    return _columns_of(rows, names), types
+
+
 _TABLES = {
     "schemata": _schemata,
     "tables": _tables,
@@ -235,6 +365,11 @@ _TABLES = {
     "cluster_info": _cluster_info,
     "engines": _engines,
     "key_column_usage": _key_column_usage,
+    "process_list": _process_list,
+    "region_peers": _region_peers,
+    "ssts": _ssts,
+    "procedure_info": _procedure_info,
+    "runtime_metrics": _runtime_metrics,
 }
 
 

@@ -475,6 +475,22 @@ class ShowFlows(Statement):
 
 
 @dataclass
+class ShowProcesslist(Statement):
+    """SHOW [FULL] PROCESSLIST (reference show_processlist, backed by the
+    ProcessManager registry)."""
+
+    full: bool = False
+
+
+@dataclass
+class Kill(Statement):
+    """KILL [QUERY] <id> — cooperative query cancellation (reference
+    src/catalog/src/process_manager.rs + statements/kill.rs)."""
+
+    process_id: str
+
+
+@dataclass
 class SetVar(Statement):
     """SET [SESSION|GLOBAL] name = value (time_zone handled; others no-op
     for client compatibility, like the reference)."""

@@ -174,9 +174,14 @@ class QueryEngine:
             return _time.perf_counter()
 
         t = _time.perf_counter()
+        check = getattr(self.provider, "check_cancelled", None)
+        if check is not None:  # cooperative KILL (ProcessManager)
+            check()
         ctx = self.provider.table_context(sel.table)
         plan = plan_select(sel, ctx)
         t = mark("plan_ms", t)
+        if check is not None:
+            check()
         table, ts_bounds = self.provider.device_table(sel.table, plan)
         t = mark("scan_cache_ms", t)
         env, n = self.executor.execute(plan, table, ts_bounds)

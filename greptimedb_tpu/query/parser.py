@@ -220,6 +220,16 @@ class Parser:
             return self.set_var()
         if kw == "ADMIN":
             return self.admin()
+        if kw == "KILL":
+            from greptimedb_tpu.query.ast import Kill
+
+            self.next()
+            self.eat_kw("QUERY", "CONNECTION")
+            tok = self.peek()
+            if tok.kind in (Tok.NUMBER, Tok.STRING):
+                self.next()
+                return Kill(tok.text)
+            return Kill(self.ident())
         raise SyntaxError_(f"unrecognized statement keyword: {t.text!r} at {t.pos}")
 
     def admin(self) -> Statement:
@@ -972,6 +982,16 @@ class Parser:
         if self.eat_kw("CREATE"):
             self.expect_kw("TABLE")
             return ShowCreateTable(self.qualified_name())
+        nxt = self.peek(1)
+        if self.at_kw("PROCESSLIST") or (
+            self.at_kw("FULL")
+            and nxt.kind is Tok.IDENT and nxt.upper == "PROCESSLIST"
+        ):
+            from greptimedb_tpu.query.ast import ShowProcesslist
+
+            full = self.eat_kw("FULL")
+            self.expect_kw("PROCESSLIST")
+            return ShowProcesslist(full=full)
         raise Unsupported(f"unsupported SHOW at {self.peek().pos}")
 
 

@@ -97,7 +97,10 @@ class HttpServer:
         self._runner = None
         # the database is single-writer (region sequence assignment and
         # memtable mutation are unsynchronized, like mito2's per-region
-        # worker loop) — serialize all DB work on one executor thread
+        # worker loop) — serialize all DB work on one executor thread.
+        # Registry-only statements (KILL, SHOW PROCESSLIST) bypass the
+        # pool via db.try_fast_sql so they cannot queue behind the very
+        # query they target.
         from concurrent.futures import ThreadPoolExecutor
 
         self._db_executor = ThreadPoolExecutor(
@@ -196,7 +199,12 @@ class HttpServer:
                     {"code": int(StatusCode.INVALID_ARGUMENTS),
                      "error": "missing sql parameter"}, status=400)
             try:
-                res = await self._call(self.db.sql, sql)
+                # KILL / SHOW PROCESSLIST run inline (sub-ms, registry
+                # lock only) so they never queue behind the statement
+                # they target on the single-worker db executor
+                res = self.db.try_fast_sql(sql)
+                if res is None:
+                    res = await self._call(self.db.sql, sql)
                 M_REQUESTS.labels("/v1/sql", "200").inc()
                 return web.json_response(_result_to_json(res, t0))
             except Exception as e:  # noqa: BLE001

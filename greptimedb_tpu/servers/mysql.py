@@ -426,12 +426,18 @@ class _Conn:
                 column_types=["String"]))
             return
         try:
-            result, self.session_db, self.session_tz = (
-                await loop.run_in_executor(
-                    self.server._db_executor, self.server.db.sql_in_db,
-                    stripped, self.session_db, self.session_tz,
+            # registry-only statements (KILL, SHOW PROCESSLIST) run inline
+            # so they never queue behind the query they target
+            fast = self.server.db.try_fast_sql(stripped)
+            if fast is not None:
+                result = fast
+            else:
+                result, self.session_db, self.session_tz = (
+                    await loop.run_in_executor(
+                        self.server._db_executor, self.server.db.sql_in_db,
+                        stripped, self.session_db, self.session_tz,
+                    )
                 )
-            )
         except GreptimeError as e:
             if low.startswith("set "):
                 # exotic client SETs are compat no-ops, not errors

@@ -301,6 +301,10 @@ class _PgConn:
         if portal.result is not None:
             return True
         try:
+            fast = self.server.db.try_fast_sql(portal.bound_sql)
+            if fast is not None:  # KILL / SHOW PROCESSLIST: no pool queue
+                portal.result = fast
+                return True
             portal.result, self.session_db, self.session_tz = (
                 await loop.run_in_executor(
                     self.server._db_executor, self.server.db.sql_in_db,
@@ -468,13 +472,17 @@ class _PgConn:
                     await self.writer.drain()
                     continue
                 try:
-                    result, self.session_db, self.session_tz = (
-                        await loop.run_in_executor(
-                            self.server._db_executor,
-                            self.server.db.sql_in_db,
-                            sql, self.session_db, self.session_tz,
+                    fast = self.server.db.try_fast_sql(sql)
+                    if fast is not None:  # KILL / SHOW PROCESSLIST
+                        result = fast
+                    else:
+                        result, self.session_db, self.session_tz = (
+                            await loop.run_in_executor(
+                                self.server._db_executor,
+                                self.server.db.sql_in_db,
+                                sql, self.session_db, self.session_tz,
+                            )
                         )
-                    )
                     if result.column_names:
                         types = (result.column_types
                                  or ["String"] * len(result.column_names))

@@ -23,6 +23,11 @@ class ThreadedTcpServer:
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = threading.Event()
         self._start_error: BaseException | None = None
+        # ONE worker: ingest/read handlers call region.write / scan paths
+        # that are unsynchronized by design (mito2-style single worker per
+        # region) and rely on this pool for serialization. Registry-only
+        # statements (KILL, SHOW PROCESSLIST) bypass the pool entirely —
+        # see db.try_fast_sql at the protocol call sites.
         self._db_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"{self.name}-db"
         )

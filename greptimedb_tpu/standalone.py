@@ -273,6 +273,12 @@ class GreptimeDB(TableProvider):
         # the threshold are appended to a private table; 0 disables
         self.slow_query_threshold_ms: float = 0.0
         self._recording_slow_query = False
+        # live query registry (reference src/catalog/src/process_manager.rs):
+        # SHOW PROCESSLIST / information_schema.process_list / KILL <id>
+        from greptimedb_tpu.meta.process import ProcessManager
+
+        self.processes = ProcessManager()
+        self._proc_local = _threading.local()
         # persistent procedure manager (repartition etc.): one instance so
         # table locks are process-wide; RUNNING journals from a crashed
         # process resume here at startup
@@ -392,20 +398,78 @@ class GreptimeDB(TableProvider):
         return self._table_view(table).scan_host(ts_range)
 
     # ---- SQL entry -----------------------------------------------------
-    def sql(self, query: str) -> QueryResult:
-        """Execute one or more statements; returns the LAST result."""
+    def sql(self, query: str, client: str = "",
+            _stmts: list | None = None) -> QueryResult:
+        """Execute one or more statements; returns the LAST result.
+        ``_stmts`` carries pre-parsed statements from sql_in_db so the
+        wire path parses exactly once."""
         import time as _time
 
         from greptimedb_tpu.utils.tracing import TRACER
 
+        # register BEFORE taking the executor lock so statements queued
+        # behind a long query show up in (and are killable from) other
+        # connections' SHOW PROCESSLIST; nested sql() calls (flows,
+        # recorders, sql_in_db) reuse the outer ticket
+        ticket = None
+        if getattr(self._proc_local, "ticket", None) is None:
+            ticket = self.processes.register(query, self.current_db, client)
+            self._proc_local.ticket = ticket
+        try:
+            stmts = _stmts if _stmts is not None else parse_sql(query)
+            fast = self._registry_only(stmts)
+            if fast is not None:
+                return fast
+            return self._sql_locked(stmts, query, _time, TRACER)
+        finally:
+            if ticket is not None:
+                self._proc_local.ticket = None
+                self.processes.deregister(ticket)
+
+    def _registry_only(self, stmts) -> QueryResult | None:
+        """Execute KILL / SHOW PROCESSLIST scripts without the executor
+        lock (they touch only the process registry, which has its own) —
+        else a KILL would queue behind the very statement it is trying to
+        cancel. Returns None if any statement needs the real executor."""
+        from greptimedb_tpu.query.ast import Kill, ShowProcesslist
+
+        if not stmts or not all(
+            isinstance(s, (Kill, ShowProcesslist)) for s in stmts
+        ):
+            return None
+        result = QueryResult([], [])
+        for stmt in stmts:
+            result = self.execute_statement(stmt)
+        return result
+
+    def try_fast_sql(self, query: str) -> QueryResult | None:
+        """Protocol-server entry for registry-only statements: execute
+        KILL / SHOW PROCESSLIST without the db executor pool or lock (so
+        they cannot queue behind the statement they target), returning
+        None for anything else — including unparsable input, which the
+        normal path re-parses to raise its usual error."""
+        try:
+            stmts = parse_sql(query)
+        except Exception:  # noqa: BLE001
+            return None
+        return self._registry_only(stmts)
+
+    def check_cancelled(self) -> None:
+        """Stage-boundary hook: raise Cancelled if this thread's current
+        statement was KILLed from another connection."""
+        t = getattr(self._proc_local, "ticket", None)
+        if t is not None:
+            t.check()
+
+    def _sql_locked(self, stmts, query: str, _time, TRACER) -> QueryResult:
         with self._lock:
             t0 = _time.perf_counter()
             with TRACER.span("sql", statement=query[:256]):
-                stmts = parse_sql(query)
                 if not stmts:
                     return QueryResult([], [])
                 result = QueryResult([], [])
                 for stmt in stmts:
+                    self.check_cancelled()
                     with TRACER.span("execute_statement",
                                      kind=type(stmt).__name__):
                         result = self.execute_statement(stmt)
@@ -469,18 +533,39 @@ class GreptimeDB(TableProvider):
         the connection's database and timezone without leaking either to
         other connections. Returns (result, session db, session tz) —
         USE / SET time_zone move them."""
-        with self._lock:
-            prev_db = self.current_db
-            prev_tz = self.timezone
-            self.current_db = dbname
-            if timezone is not None:
-                self.timezone = timezone
-            try:
-                result = self.sql(query)
-                return result, self.current_db, self.timezone
-            finally:
-                self.current_db = prev_db
-                self.timezone = prev_tz
+        # register the ticket BEFORE blocking on the executor lock so a
+        # wire statement queued behind a long query is visible in (and
+        # killable from) SHOW PROCESSLIST; KILL / SHOW PROCESSLIST
+        # short-circuit without the lock entirely
+        try:
+            stmts = parse_sql(query)
+        except Exception:  # noqa: BLE001 — normal path reports the error
+            stmts = None
+        ticket = None
+        if getattr(self._proc_local, "ticket", None) is None:
+            ticket = self.processes.register(query, dbname)
+            self._proc_local.ticket = ticket
+        try:
+            if stmts is not None:
+                fast = self._registry_only(stmts)
+                if fast is not None:
+                    return fast, dbname, timezone or self.timezone
+            with self._lock:
+                prev_db = self.current_db
+                prev_tz = self.timezone
+                self.current_db = dbname
+                if timezone is not None:
+                    self.timezone = timezone
+                try:
+                    result = self.sql(query, _stmts=stmts)
+                    return result, self.current_db, self.timezone
+                finally:
+                    self.current_db = prev_db
+                    self.timezone = prev_tz
+        finally:
+            if ticket is not None:
+                self._proc_local.ticket = None
+                self.processes.deregister(ticket)
 
     def execute_statement(self, stmt: Statement) -> QueryResult:
         from greptimedb_tpu.query.ast import Union as UnionStmt
@@ -574,7 +659,31 @@ class GreptimeDB(TableProvider):
             return QueryResult([], [], affected_rows=0)
         if isinstance(stmt, (CreateFlow, DropFlow, ShowFlows)):
             return self._flow_statement(stmt)
-        from greptimedb_tpu.query.ast import Copy, SetVar
+        from greptimedb_tpu.query.ast import Copy, Kill, SetVar, ShowProcesslist
+
+        if isinstance(stmt, ShowProcesslist):
+            cols = ["Id", "Catalog", "Schemas", "Query", "Client",
+                    "Frontend", "Elapsed Time"]
+            rows = []
+            for t in self.processes.list():
+                q = t.query if stmt.full else t.query[:100]
+                rows.append([
+                    str(t.id), "greptime", t.database, q, t.client,
+                    self.processes.server_addr,
+                    round(t.elapsed_ms / 1000, 3),
+                ])
+            return QueryResult(cols, rows)
+        if isinstance(stmt, Kill):
+            try:
+                pid = self.processes.parse_id(stmt.process_id)
+            except ValueError:
+                raise InvalidArguments(
+                    f"invalid process id {stmt.process_id!r}"
+                ) from None
+            found = self.processes.kill(pid)
+            if not found:
+                raise InvalidArguments(f"no running query with id {pid}")
+            return QueryResult([], [], affected_rows=1)
 
         if isinstance(stmt, Copy):
             return self._copy(stmt)

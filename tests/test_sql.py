@@ -401,6 +401,199 @@ class TestInformationSchema:
         r2 = cpu.sql("SELECT count(*) FROM information_schema.tables")
         assert r.rows[0][0] < r2.rows[0][0]  # virtual tables have NULL ids
 
+    def test_region_peers_and_ssts(self, cpu):
+        r = cpu.sql("SELECT table_name, is_leader, status FROM "
+                    "information_schema.region_peers")
+        assert ["cpu", "Yes", "ALIVE"] in r.rows
+        cpu._region_of("cpu").flush()
+        r = cpu.sql("SELECT table_name, num_rows, level FROM "
+                    "information_schema.ssts WHERE table_name = 'cpu'")
+        assert r.num_rows == 1 and r.rows[0][1] == 7
+
+    def test_procedure_info(self, cpu):
+        r = cpu.sql("SELECT procedure_type, status FROM "
+                    "information_schema.procedure_info")
+        assert r.num_rows == 0  # empty until a procedure runs
+        from greptimedb_tpu.meta.procedure import Procedure, Status
+
+        class Noop(Procedure):
+            type_name = "test_noop"
+
+            def execute(self, ctx):
+                return Status.done()
+
+        cpu.procedures.register(Noop)
+        cpu.procedures.submit(Noop())
+        r = cpu.sql("SELECT procedure_type, status FROM "
+                    "information_schema.procedure_info")
+        assert ["test_noop", "DONE"] in r.rows
+
+    def test_runtime_metrics(self, cpu):
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+
+        REGISTRY.counter("test_info_schema_total", "x").inc(3)
+        r = cpu.sql("SELECT value FROM information_schema.runtime_metrics "
+                    "WHERE metric_name = 'test_info_schema_total'")
+        assert r.rows and r.rows[0][0] == 3.0
+
+
+class TestProcessList:
+    def test_show_processlist_shows_self(self, cpu):
+        r = cpu.sql("SHOW PROCESSLIST")
+        assert r.num_rows == 1
+        row = dict(zip(r.column_names, r.rows[0]))
+        assert "SHOW PROCESSLIST" in row["Query"]
+        r = cpu.sql("SELECT query FROM information_schema.process_list")
+        assert r.num_rows == 1 and "process_list" in r.rows[0][0]
+
+    def test_kill_unknown_id_errors(self, cpu):
+        from greptimedb_tpu.errors import InvalidArguments
+
+        with pytest.raises(InvalidArguments):
+            cpu.sql("KILL 99999")
+        with pytest.raises(InvalidArguments):
+            cpu.sql("KILL 'not-a-number'")
+
+    def test_kill_cancels_queued_statement(self, cpu):
+        """KILL from another thread cancels the remaining statements of a
+        multi-statement script at the next stage boundary."""
+        import threading
+        import time as _t
+
+        from greptimedb_tpu.errors import Cancelled
+
+        errs = []
+        started = threading.Event()
+
+        orig = cpu.execute_statement
+
+        def slow_execute(stmt):
+            started.set()
+            _t.sleep(0.15)
+            return orig(stmt)
+
+        cpu.execute_statement = slow_execute
+
+        def victim():
+            try:
+                cpu.sql("SELECT 1; SELECT 2; SELECT 3")
+            except Cancelled as e:
+                errs.append(e)
+
+        th = threading.Thread(target=victim)
+        th.start()
+        assert started.wait(5)
+        # the victim registered first → its ticket id is the smallest live id
+        for _ in range(100):
+            procs = cpu.processes.list()
+            if procs:
+                break
+            _t.sleep(0.01)
+        victim_id = procs[0].id
+        cpu.processes.kill(victim_id)
+        th.join(10)
+        cpu.execute_statement = orig
+        assert errs, "victim should have been cancelled"
+        assert not cpu.processes.list()  # ticket deregistered
+
+    def test_kill_statement_roundtrip(self, cpu):
+        t = cpu.processes.register("SELECT sleep_forever()", "public")
+        cpu.sql(f"KILL {t.id}")
+        assert t.cancelled.is_set()
+        cpu.processes.deregister(t)
+
+    def test_kill_addr_form(self, cpu):
+        t = cpu.processes.register("x", "public")
+        cpu.sql(f"KILL 'standalone/{t.id}'")
+        assert t.cancelled.is_set()
+        cpu.processes.deregister(t)
+
+    def test_kill_via_wire_session_bypasses_executor_lock(self, cpu):
+        """sql_in_db (the wire-protocol entry) must run KILL without
+        queueing behind the running statement it targets."""
+        import threading
+        import time as _t
+
+        from greptimedb_tpu.errors import Cancelled
+
+        errs = []
+        started = threading.Event()
+        orig = cpu.execute_statement
+
+        def slow(stmt):
+            started.set()
+            _t.sleep(0.2)
+            return orig(stmt)
+
+        cpu.execute_statement = slow
+
+        def victim():
+            try:
+                cpu.sql_in_db("SELECT 1; SELECT 2; SELECT 3", "public")
+            except Cancelled as e:
+                errs.append(e)
+
+        th = threading.Thread(target=victim)
+        th.start()
+        assert started.wait(5)
+        vid = cpu.processes.list()[0].id
+        t0 = _t.perf_counter()
+        r, _, _ = cpu.sql_in_db(f"KILL {vid}", "public")
+        kill_s = _t.perf_counter() - t0
+        th.join(10)
+        cpu.execute_statement = orig
+        assert errs and r.affected_rows == 1
+        assert kill_s < 0.5, f"KILL queued behind victim ({kill_s:.2f}s)"
+
+    def test_queued_wire_statement_visible_and_killable(self, cpu):
+        """A wire statement blocked on the executor lock must appear in
+        SHOW PROCESSLIST and die via KILL once it acquires the lock."""
+        import threading
+        import time as _t
+
+        from greptimedb_tpu.errors import Cancelled
+
+        release = threading.Event()
+        holding = threading.Event()
+        errs = []
+
+        def holder():
+            with cpu._lock:
+                holding.set()
+                release.wait(5)
+
+        def queued_victim():
+            try:
+                cpu.sql_in_db("SELECT 1", "public")
+            except Cancelled as e:
+                errs.append(e)
+
+        th_hold = threading.Thread(target=holder)
+        th_hold.start()
+        assert holding.wait(5)
+        th_vic = threading.Thread(target=queued_victim)
+        th_vic.start()
+        # victim is queued on the lock — it must still have a live ticket
+        for _ in range(200):
+            procs = cpu.processes.list()
+            if any("SELECT 1" in p.query for p in procs):
+                break
+            _t.sleep(0.01)
+        vic = [p for p in procs if "SELECT 1" in p.query]
+        assert vic, "queued statement invisible to processlist"
+        assert cpu.processes.kill(vic[0].id)
+        release.set()
+        th_vic.join(10)
+        th_hold.join(5)
+        assert errs, "queued victim should be cancelled on lock acquisition"
+
+    def test_show_full_tables_still_unsupported(self, cpu):
+        from greptimedb_tpu.errors import Unsupported
+
+        with pytest.raises(Unsupported):
+            cpu.sql("SHOW FULL TABLES")
+        assert cpu.sql("SHOW FULL PROCESSLIST").num_rows == 1
+
 
 class TestPartitionedTables:
     @pytest.fixture
