@@ -165,6 +165,21 @@ def probe_tpu(
         return False
 
 
+def rerun_on_cpu(reason: str) -> None:
+    """The TPU relay can die mid-run (observed: UNAVAILABLE during a bulk
+    HBM upload). Data generation is cached on disk, so a CPU re-exec
+    skips ingest and still emits the JSON line of record. The child
+    inherits stdout — its JSON line IS this process's output."""
+    import subprocess
+
+    log(f"TPU run failed ({reason}); re-running on CPU backend")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    remaining = max(60, int(BUDGET_S - (time.time() - START)))
+    env["GREPTIME_BENCH_BUDGET_S"] = str(remaining)
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env)
+    raise SystemExit(r.returncode)
+
+
 def main() -> None:
     import jax
 
@@ -204,30 +219,41 @@ def main() -> None:
         f"GROUP BY hostname, hour"
     )
 
-    log("warmup (compile + cache build) ...")
-    t0 = time.time()
-    r = db.sql(sql)
-    first_ms = (time.time() - t0) * 1000
-    _warmup_times.append(first_ms)
-    log(f"  first run: {first_ms:.0f} ms, {r.num_rows} groups")
-    expected_groups = SCALE * window_h
-    assert r.num_rows == expected_groups, (r.num_rows, expected_groups)
-
-    deadline = START + BUDGET_S
-    second_ms = None
-    if time.time() < deadline:
-        t0 = time.time()
-        db.sql(sql)
-        second_ms = (time.time() - t0) * 1000
-        _warmup_times.append(second_ms)
-        log(f"  second run: {second_ms:.0f} ms")
-
-    while len(_times) < 10 and time.time() + (
-        second_ms or first_ms
-    ) / 1000 < deadline:
+    on_cpu = jax.default_backend() == "cpu"
+    try:
+        log("warmup (compile + cache build) ...")
         t0 = time.time()
         r = db.sql(sql)
-        _times.append((time.time() - t0) * 1000)
+        first_ms = (time.time() - t0) * 1000
+        _warmup_times.append(first_ms)
+        log(f"  first run: {first_ms:.0f} ms, {r.num_rows} groups")
+        expected_groups = SCALE * window_h
+        assert r.num_rows == expected_groups, (r.num_rows, expected_groups)
+
+        deadline = START + BUDGET_S
+        second_ms = None
+        if time.time() < deadline:
+            t0 = time.time()
+            db.sql(sql)
+            second_ms = (time.time() - t0) * 1000
+            _warmup_times.append(second_ms)
+            log(f"  second run: {second_ms:.0f} ms")
+
+        while len(_times) < 10 and time.time() + (
+            second_ms or first_ms
+        ) / 1000 < deadline:
+            t0 = time.time()
+            r = db.sql(sql)
+            _times.append((time.time() - t0) * 1000)
+    except AssertionError:
+        raise  # wrong RESULTS must never be masked as device loss
+    except Exception as e:  # noqa: BLE001 — device loss mid-run
+        if _times:
+            log(f"device lost after {len(_times)} runs ({e!r}); emitting")
+        elif not on_cpu:
+            rerun_on_cpu(repr(e))
+        else:
+            raise
 
     if not _times:
         # budget exhausted during warmup: the warm(er) run is the number

@@ -269,10 +269,20 @@ def _region_peers(db):
 
 
 def _ssts(db):
-    """Per-region SST file inventory (reference information_schema/ssts)."""
+    """Per-region SST file inventory (reference information_schema/ssts).
+    SstMeta stores raw ts in the table's native precision; normalize to
+    milliseconds so one column type fits every table."""
     rows = []
     for d in db.catalog.list_databases():
         for t in db.catalog.list_tables(d):
+            ts_col = next((c for c in t.schema if c.is_time_index), None)
+            # per-unit factor raw→ms (ns: /1e6, us: /1e3, ms: 1, s: ×1e3)
+            to_ms = 1
+            if ts_col is not None:
+                name = ts_col.dtype.value.lower()
+                to_ms = {"timestampnanosecond": 1 / 1_000_000,
+                         "timestampmicrosecond": 1 / 1_000,
+                         "timestampsecond": 1_000}.get(name, 1)
             for rid in t.region_ids:
                 region = db.regions.regions.get(rid)
                 if region is None:
@@ -283,7 +293,10 @@ def _ssts(db):
                         "region_id": rid, "file_id": m.file_id,
                         "file_path": m.path, "level": m.level,
                         "file_size": m.size_bytes, "num_rows": m.num_rows,
-                        "min_ts": m.ts_min, "max_ts": m.ts_max,
+                        "min_ts": int(m.ts_min * to_ms) if to_ms != 1
+                        else m.ts_min,
+                        "max_ts": int(m.ts_max * to_ms) if to_ms != 1
+                        else m.ts_max,
                     })
     names = ["table_schema", "table_name", "region_id", "file_id",
              "file_path", "level", "file_size", "num_rows", "min_ts",
@@ -327,26 +340,21 @@ def _runtime_metrics(db):
 
     now = int(time.time() * 1000)
     rows = []
-    with REGISTRY._lock:
-        metrics = list(REGISTRY._metrics.values())
-    for m in metrics:
-        with m._lock:  # labels() may insert children concurrently
-            children = sorted(m._children.items())
-        for key, child in children:
-            labels = ", ".join(
-                f"{n}={v}" for n, v in zip(m.label_names, key)
-            ) or None
-            if m.kind == "histogram":
-                value, extra = child.sum, [("_count", float(child.total))]
-            else:
-                value, extra = child.value, []
-            rows.append({"metric_name": m.name, "value": float(value),
+    for name, kind, label_names, key, child in REGISTRY.snapshot():
+        labels = ", ".join(
+            f"{n}={v}" for n, v in zip(label_names, key)
+        ) or None
+        if kind == "histogram":
+            value, extra = child.sum, [("_count", float(child.total))]
+        else:
+            value, extra = child.value, []
+        rows.append({"metric_name": name, "value": float(value),
+                     "labels": labels, "node": "standalone",
+                     "node_type": "standalone", "timestamp": now})
+        for suffix, v in extra:
+            rows.append({"metric_name": name + suffix, "value": v,
                          "labels": labels, "node": "standalone",
                          "node_type": "standalone", "timestamp": now})
-            for suffix, v in extra:
-                rows.append({"metric_name": m.name + suffix, "value": v,
-                             "labels": labels, "node": "standalone",
-                             "node_type": "standalone", "timestamp": now})
     names = ["metric_name", "value", "labels", "node", "node_type",
              "timestamp"]
     types = {n: "String" for n in names}

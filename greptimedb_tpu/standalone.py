@@ -413,6 +413,10 @@ class GreptimeDB(TableProvider):
         # recorders, sql_in_db) reuse the outer ticket
         ticket = None
         if getattr(self._proc_local, "ticket", None) is None:
+            # self.current_db is read lock-free here; a concurrent wire
+            # session's temporary swap (sql_in_db) can mislabel the
+            # ticket's schema column — display-only, accepted to keep
+            # registration ahead of the lock wait
             ticket = self.processes.register(query, self.current_db, client)
             self._proc_local.ticket = ticket
         try:
@@ -447,7 +451,15 @@ class GreptimeDB(TableProvider):
         KILL / SHOW PROCESSLIST without the db executor pool or lock (so
         they cannot queue behind the statement they target), returning
         None for anything else — including unparsable input, which the
-        normal path re-parses to raise its usual error."""
+        normal path re-parses to raise its usual error.
+
+        A cheap prefix test gates the real parse: this runs synchronously
+        on the server event loop, and a multi-MB INSERT must not pay (or
+        stall other connections on) a full tokenize here."""
+        head = query.lstrip()[:32].upper()
+        if not (head.startswith("KILL") or
+                (head.startswith("SHOW") and "PROCESS" in head)):
+            return None
         try:
             stmts = parse_sql(query)
         except Exception:  # noqa: BLE001

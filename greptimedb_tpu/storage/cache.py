@@ -30,6 +30,26 @@ from greptimedb_tpu.storage.region import Region
 
 _DICTS_VERSION = 0  # process-wide monotonic dict-content version
 
+# One multi-hundred-MB device_put RPC can break the TPU relay tunnel
+# (observed: UNAVAILABLE mid-upload of a 34M-row table). Stream large
+# columns in bounded pieces instead; each piece completes before the
+# next is sent, then a device-side concatenate assembles the column.
+_UPLOAD_CHUNK_BYTES = 64 << 20
+
+
+def _to_device(arr: np.ndarray) -> jnp.ndarray:
+    if arr.nbytes <= _UPLOAD_CHUNK_BYTES:
+        return jnp.asarray(arr)
+    rows = max(1, _UPLOAD_CHUNK_BYTES // max(1, arr.dtype.itemsize))
+    parts = []
+    for i in range(0, len(arr), rows):
+        part = jax.device_put(arr[i:i + rows])
+        part.block_until_ready()
+        parts.append(part)
+    out = jnp.concatenate(parts)
+    out.block_until_ready()
+    return out
+
 
 @jax.tree_util.register_pytree_node_class
 @dataclass
@@ -148,6 +168,7 @@ def build_device_table(
     padded = pad_rows(n)
 
     dev_cols: dict[str, jnp.ndarray] = {}
+    host_canon: dict[str, np.ndarray] = {}
     dicts: dict[str, list] = {}
     for name, arr in host.items():
         if name == SEQ:
@@ -156,19 +177,22 @@ def build_device_table(
         out = np.full(padded, _pad_value(schema, name, vals.dtype),
                       dtype=vals.dtype)
         out[:n] = vals
-        dev_cols[name] = jnp.asarray(out)
+        host_canon[name] = vals
+        dev_cols[name] = _to_device(out)
     mask = np.zeros(padded, dtype=bool)
     mask[:n] = True
     # monotone tag detection: rows are (tsid, ts)-sorted; a tag qualifies
     # for sorted segment reductions when its codes are nondecreasing AND
     # bijective with series runs (each code run is exactly one tsid run, so
-    # ts — and hence any time bucket — is ascending within every code run)
+    # ts — and hence any time bucket — is ascending within every code run).
+    # Detection runs on the host copies — reading dev_cols back would pull
+    # the whole column through the device tunnel again.
     sorted_tags = []
     if n > 0:
-        tsid_runs = 1 + int((np.diff(np.asarray(dev_cols[TSID])[:n]) != 0).sum())
+        tsid_runs = 1 + int((np.diff(host_canon[TSID]) != 0).sum())
         for c in schema.tag_columns:
-            if c.name in dev_cols:
-                codes = np.asarray(dev_cols[c.name])[:n]
+            if c.name in host_canon:
+                codes = host_canon[c.name]
                 d = np.diff(codes)
                 if bool((d >= 0).all()) and 1 + int((d != 0).sum()) == tsid_runs:
                     sorted_tags.append(c.name)

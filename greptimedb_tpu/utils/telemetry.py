@@ -155,6 +155,20 @@ class Registry:
                 self._metrics[name] = m
             return m
 
+    def snapshot(self):
+        """Consistent point-in-time view: (metric_name, kind, label_names,
+        label_values, child) rows, taken under the proper locks. The one
+        supported way to walk the registry from outside (information_schema)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        rows = []
+        for m in metrics:
+            with m._lock:  # labels() may insert children concurrently
+                children = sorted(m._children.items())
+            for key, child in children:
+                rows.append((m.name, m.kind, m.label_names, key, child))
+        return rows
+
     def render(self) -> str:
         """Prometheus text exposition format."""
         out = []
