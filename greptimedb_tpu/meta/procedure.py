@@ -153,17 +153,37 @@ class ProcedureManager:
                         "ts": time.time(),
                     })
                 if status.kind == "done":
+                    self._prune_finished()
                     return status.output
             raise GreptimeError(f"procedure {proc.type_name} exceeded {max_steps} steps")
         finally:
             for lk in locks:
                 self._locks.discard(lk)
 
+    def _prune_finished(self, keep: int = 200) -> None:
+        """Bound journal growth: now that every DDL is a procedure, keep
+        only the most recent finished (DONE/FAILED) journals for
+        information_schema.procedure_info; RUNNING/POISONED stay."""
+        finished = []
+        for k, raw in self.kv.range(self._PREFIX):
+            rec = json.loads(raw)
+            if rec.get("status") in (ProcedureState.DONE.value,
+                                     ProcedureState.FAILED.value):
+                finished.append((rec.get("ts", 0), k))
+        if len(finished) > keep:
+            finished.sort()
+            for _ts, k in finished[:len(finished) - keep]:
+                self.kv.delete(k)
+
     # ------------------------------------------------------------------
     def recover(self) -> list[object]:
         """Resume procedures journaled RUNNING (coordinator restart path).
-        Returns outputs of resumed procedures."""
+        Returns outputs of resumed procedures. One failing resume must not
+        starve the rest — with every DDL journaled, several RUNNING
+        journals after a crash are normal; failures stay journaled FAILED
+        and the first error is re-raised only after all were attempted."""
         out = []
+        first_err: Exception | None = None
         for k, raw in self.kv.range(self._PREFIX):
             rec = json.loads(raw)
             if rec["status"] != ProcedureState.RUNNING.value:
@@ -173,7 +193,13 @@ class ProcedureManager:
                 continue
             proc = cls(state=rec["state"])
             pid = k[len(self._PREFIX):]
-            out.append(self._drive(pid, proc, max_steps=1000))
+            try:
+                out.append(self._drive(pid, proc, max_steps=1000))
+            except Exception as e:  # noqa: BLE001 — journaled FAILED by _drive
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
         return out
 
     def history(self) -> list[dict]:

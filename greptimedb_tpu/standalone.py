@@ -282,11 +282,17 @@ class GreptimeDB(TableProvider):
         # persistent procedure manager (repartition etc.): one instance so
         # table locks are process-wide; RUNNING journals from a crashed
         # process resume here at startup
+        from greptimedb_tpu.meta.ddl import (
+            AlterTableProcedure, CreateTableProcedure, DropTableProcedure,
+        )
         from greptimedb_tpu.meta.procedure import ProcedureManager
         from greptimedb_tpu.meta.repartition import RepartitionProcedure
 
         self.procedures = ProcedureManager(self.kv, services={"db": self})
         self.procedures.register(RepartitionProcedure)
+        self.procedures.register(CreateTableProcedure)
+        self.procedures.register(DropTableProcedure)
+        self.procedures.register(AlterTableProcedure)
         try:
             resumed = self.procedures.recover()
             if resumed:
@@ -455,8 +461,22 @@ class GreptimeDB(TableProvider):
 
         A cheap prefix test gates the real parse: this runs synchronously
         on the server event loop, and a multi-MB INSERT must not pay (or
-        stall other connections on) a full tokenize here."""
-        head = query.lstrip()[:32].upper()
+        stall other connections on) a full tokenize here. Leading SQL
+        comments are skipped so '/* retry */ KILL 7' still takes the
+        fast path (the parser strips them anyway)."""
+        head = query[:4096].lstrip()
+        while True:
+            if head.startswith("--"):
+                _, _, head = head.partition("\n")
+                head = head.lstrip()
+            elif head.startswith("/*"):
+                _, sep, head = head.partition("*/")
+                if not sep:
+                    return None  # unterminated comment: let the parser err
+                head = head.lstrip()
+            else:
+                break
+        head = head[:32].upper()
         if not (head.startswith("KILL") or
                 (head.startswith("SHOW") and "PROCESS" in head)):
             return None
@@ -707,8 +727,11 @@ class GreptimeDB(TableProvider):
             return QueryResult([], [])
         raise Unsupported(f"statement {type(stmt).__name__}")
 
-    # ---- DDL -----------------------------------------------------------
+    # ---- DDL (journaled procedures, reference ddl_manager.rs:99) -------
     def _create_table(self, stmt: CreateTable) -> QueryResult:
+        from greptimedb_tpu.errors import DatabaseNotFound, TableAlreadyExists
+        from greptimedb_tpu.meta.ddl import CreateTableProcedure
+
         db, name = self._split_name(stmt.name)
         schema = schema_from_create(stmt)
         if stmt.engine == "file":
@@ -718,28 +741,25 @@ class GreptimeDB(TableProvider):
                     "CREATE EXTERNAL TABLE needs WITH (location='...')"
                 )
             stmt.options.setdefault("format", "parquet")
-        info = self.catalog.create_table(
-            db, name, schema,
-            engine=stmt.engine,
-            options=stmt.options,
-            partition_exprs=stmt.partitions,
-            partition_columns=stmt.partition_columns,
-            num_regions=max(len(stmt.partitions), 1),
-            if_not_exists=stmt.if_not_exists,
-        )
-        if info is not None and stmt.engine != "file":
-            opts = None
-            if str(stmt.options.get("append_mode", "")).lower() in (
-                    "true", "1"):
-                # append-mode table (reference WITH (append_mode='true'),
-                # the log/trace model): every row kept, no (series, ts)
-                # dedup anywhere in the LSM
-                import dataclasses as _dc
-
-                opts = _dc.replace(self.regions.default_options,
-                                   append_mode=True)
-            for rid in info.region_ids:
-                self.regions.create_region(rid, schema, options=opts)
+        # argument errors surface here, before anything is journaled
+        if not self.catalog.database_exists(db):
+            raise DatabaseNotFound(db)
+        if self.catalog.table_exists(db, name):
+            if stmt.if_not_exists:
+                return QueryResult([], [], affected_rows=0)
+            raise TableAlreadyExists(f"{db}.{name}")
+        # append-mode table (reference WITH (append_mode='true'), the
+        # log/trace model): every row kept, no (series, ts) dedup
+        append = str(stmt.options.get("append_mode", "")).lower() in (
+            "true", "1")
+        self.procedures.submit(CreateTableProcedure(state={
+            "db": db, "name": name, "schema": schema.to_dict(),
+            "engine": stmt.engine, "options": stmt.options,
+            "partition_exprs": stmt.partitions,
+            "partition_columns": stmt.partition_columns,
+            "num_regions": max(len(stmt.partitions), 1),
+            "append_mode": append,
+        }))
         return QueryResult([], [], affected_rows=0)
 
     def _drop_table(self, stmt: DropTable) -> QueryResult:
@@ -768,17 +788,19 @@ class GreptimeDB(TableProvider):
                         f"cannot drop {PHYSICAL_TABLE}: {len(logical)} logical "
                         "metric tables still reference it"
                     )
-            info = self.catalog.drop_table(db, name, stmt.if_exists)
-            if info is not None:
-                if info.engine == "file":
-                    view = getattr(self, "_file_views", {}).pop(
-                        (db, name), None)
-                    if view is not None:
-                        self.cache.invalidate_region(view.region_id)
-                for rid in info.region_ids:
-                    if info.engine != "file":
-                        self.regions.drop_region(rid)
-                    self.cache.invalidate_region(rid)
+            if existing is None:
+                if not stmt.if_exists:
+                    raise TableNotFound(f"{db}.{name}")
+                continue
+            if existing.engine == "file":
+                view = getattr(self, "_file_views", {}).pop((db, name), None)
+                if view is not None:
+                    self.cache.invalidate_region(view.region_id)
+            from greptimedb_tpu.meta.ddl import DropTableProcedure
+
+            self.procedures.submit(DropTableProcedure(state={
+                "db": db, "name": name, "if_exists": stmt.if_exists,
+            }))
         return QueryResult([], [], affected_rows=1)
 
     def _admin(self, stmt) -> QueryResult:
@@ -853,22 +875,11 @@ class GreptimeDB(TableProvider):
             return QueryResult([], [], affected_rows=0)
         else:
             raise Unsupported(f"alter {stmt.action}")
-        info.schema = new_schema
-        self.catalog.update_table(info)
-        # region schema change: flush current data then swap schema
-        for rid in info.region_ids:
-            region = self.regions.regions.get(rid)
-            if region is not None:
-                region.flush()
-                region.schema = new_schema
-                region.manifest.commit(
-                    {"kind": "schema", "schema": new_schema.to_dict()}
-                )
-                region.memtable.schema = new_schema
-                self.cache.invalidate_region(region.region_id)
-        view = self._views.pop(f"{db}.{name}", None)
-        if view is not None:
-            self.cache.invalidate_region(view.region_id)
+        from greptimedb_tpu.meta.ddl import AlterTableProcedure
+
+        self.procedures.submit(AlterTableProcedure(state={
+            "db": db, "name": name, "new_schema": new_schema.to_dict(),
+        }))
         return QueryResult([], [], affected_rows=0)
 
     # ---- DML -----------------------------------------------------------
