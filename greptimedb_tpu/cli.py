@@ -37,6 +37,8 @@ def cmd_standalone(args) -> int:
             wal_sync=opts.wal.sync,
         ),
         cache_capacity_bytes=opts.storage.cache_capacity_gb << 30,
+        ingest_quota_bytes=(opts.memory.ingest_quota_mb << 20) or None,
+        ingest_quota_policy=opts.memory.ingest_policy,
     )
     if opts.default_timezone and opts.default_timezone != "UTC":
         db.set_timezone(opts.default_timezone)

@@ -136,6 +136,10 @@ class StorageError(GreptimeError):
     status_code = StatusCode.STORAGE_UNAVAILABLE
 
 
+class ResourcesExhausted(GreptimeError):
+    status_code = StatusCode.RUNTIME_RESOURCES_EXHAUSTED
+
+
 class Cancelled(GreptimeError):
     status_code = StatusCode.CANCELLED
 

@@ -61,11 +61,9 @@ class CreateTableProcedure(Procedure):
                         db.regions.default_options, append_mode=True
                     )
                 for rid in st["info"]["region_ids"]:
-                    try:
-                        db.regions.create_region(rid, schema, options=opts)
-                    except StorageError:
-                        # resume: region materialized by a prior attempt
-                        db.regions.open_region(rid)
+                    # idempotent: adopts a region materialized by a prior
+                    # attempt; real storage failures propagate untouched
+                    db.regions.ensure_region(rid, schema, options=opts)
             return Status.done(output=st["info"])
         raise StorageError(f"create_table: unknown step {step!r}")
 

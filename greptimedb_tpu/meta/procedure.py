@@ -82,10 +82,16 @@ class ProcedureManager:
     _PREFIX = "__procedure/"
 
     def __init__(self, kv: KvBackend, services: dict | None = None):
+        import threading
+
         self.kv = kv
         self.services = services or {}
         self._registry: dict[str, type[Procedure]] = {}
         self._locks: set[str] = set()
+        # guards check-and-acquire of lock keys: standalone serializes DDL
+        # behind the db lock, but the manager must be safe on its own
+        # (metasrv handlers, direct submit() from tests/tools)
+        self._locks_mu = threading.Lock()
 
     def register(self, cls: type[Procedure]) -> None:
         self._registry[cls.type_name] = cls
@@ -111,10 +117,11 @@ class ProcedureManager:
                 raise GreptimeError(
                     f"resource {lk} is poisoned by a failed procedure"
                 )
-            if lk in self._locks:
-                raise GreptimeError(f"procedure lock busy: {lk}")
-        for lk in locks:
-            self._locks.add(lk)
+        with self._locks_mu:  # atomic check-and-acquire of ALL keys
+            busy = [lk for lk in locks if lk in self._locks]
+            if busy:
+                raise GreptimeError(f"procedure lock busy: {busy[0]}")
+            self._locks.update(locks)
         try:
             ctx = ProcedureContext(self.kv, self, pid, self.services)
             # write-ahead journal BEFORE the first step: a crash during step 1
@@ -157,8 +164,9 @@ class ProcedureManager:
                     return status.output
             raise GreptimeError(f"procedure {proc.type_name} exceeded {max_steps} steps")
         finally:
-            for lk in locks:
-                self._locks.discard(lk)
+            with self._locks_mu:
+                for lk in locks:
+                    self._locks.discard(lk)
 
     def _prune_finished(self, keep: int = 200) -> None:
         """Bound journal growth: now that every DDL is a procedure, keep

@@ -1012,6 +1012,7 @@ class HttpServer:
             "version": "greptimedb-tpu-0.1.0",
             "devices": [str(d) for d in jax.devices()],
             "tables": len(self.db.catalog.list_tables(self.db.current_db)),
+            "memory": self.db.memory.usage(),
         })
 
     async def h_promql(self, request: web.Request) -> web.Response:

@@ -190,6 +190,8 @@ class GreptimeDB(TableProvider):
         cache_capacity_bytes: int = 8 << 30,
         metadata_store: str | None = None,
         plugins: list[str] | None = None,
+        ingest_quota_bytes: int | None = None,
+        ingest_quota_policy: str = "reject",
     ):
         """``metadata_store`` selects the kv backend (reference
         [metadata_store]/meta backend config): None → file-backed (or
@@ -240,6 +242,29 @@ class GreptimeDB(TableProvider):
             os.path.join(data_home, "data"), region_options
         )
         self.cache = RegionCacheManager(cache_capacity_bytes)
+        # workload memory quotas (reference common-memory-manager): the
+        # ingest write-buffer quota reclaims by flushing the largest
+        # memtable before rejecting; the device cache registers for
+        # observability (its LRU already enforces capacity_bytes)
+        from greptimedb_tpu.utils.memory import WorkloadMemoryManager
+
+        self.memory = WorkloadMemoryManager()
+        self.memory.register(
+            "ingest", ingest_quota_bytes,
+            # list() snapshots the dict (atomic under the GIL): usage is
+            # read from the event loop (/status) while executor threads
+            # add regions via CREATE TABLE
+            usage_fn=lambda: sum(
+                r.memtable.bytes
+                for r in list(self.regions.regions.values())
+            ),
+            reclaim_fn=self._flush_largest_memtable,
+            policy=ingest_quota_policy,
+        )
+        self.memory.register(
+            "device_cache", None, usage_fn=lambda: self.cache._bytes,
+        )
+        self.regions.memory = self.memory
         self.engine = QueryEngine(self)
         # nested (sub)queries route through the full statement dispatch so
         # information_schema / pg_catalog subqueries resolve
@@ -305,6 +330,23 @@ class GreptimeDB(TableProvider):
             import sys as _sys
 
             print(f"procedure recovery failed: {e}", file=_sys.stderr)
+
+    def _flush_largest_memtable(self, needed_bytes: int) -> None:
+        """Ingest-quota reclaimer: flush memtables largest-first until the
+        needed headroom exists (mito's write-buffer-full flush trigger)."""
+        regions = sorted(
+            list(self.regions.regions.values()),
+            key=lambda r: r.memtable.bytes, reverse=True,
+        )
+        freed = 0
+        for r in regions:
+            if freed >= needed_bytes:
+                break
+            b = r.memtable.bytes
+            if b == 0:
+                break
+            r.flush()
+            freed += b
 
     def close(self) -> None:
         self.regions.close()
@@ -741,7 +783,11 @@ class GreptimeDB(TableProvider):
                     "CREATE EXTERNAL TABLE needs WITH (location='...')"
                 )
             stmt.options.setdefault("format", "parquet")
-        # argument errors surface here, before anything is journaled
+        # argument errors surface here, before anything is journaled.
+        # This exists-precheck + submit sequence is atomic in-process:
+        # every DDL statement executes under self._lock (_sql_locked), so
+        # two CREATE IF NOT EXISTS cannot interleave between the check
+        # and the procedure's catalog commit.
         if not self.catalog.database_exists(db):
             raise DatabaseNotFound(db)
         if self.catalog.table_exists(db, name):

@@ -67,12 +67,16 @@ class Region:
         wal_dir: str | None,
         options: RegionOptions,
         log_store: "LogStore | None" = None,
+        memory=None,
     ):
         self.region_id = region_id
         self.store = store
         self.schema = schema
         self.options = options
         self.manifest = manifest
+        # optional WorkloadMemoryManager: write() admits incoming batches
+        # against the engine-wide ingest (write-buffer) quota
+        self.memory = memory
         self._dir = f"region_{region_id}"
         if log_store is not None:
             # injected WAL (remote/shared log — storage/remote_wal.py)
@@ -154,6 +158,10 @@ class Region:
         """Synchronous write of one row group; returns the sequence."""
         ts_name = self.ts_name
         n = len(data[ts_name])
+        if self.memory is not None:
+            # rough batch footprint: ~16B/cell covers the typical mix of
+            # f64/int64 values plus object-array overhead for tags
+            self.memory.admit("ingest", n * len(data) * 16)
         cols: dict[str, np.ndarray] = {}
         for c in self.schema:
             if c.name not in data:
@@ -645,7 +653,8 @@ class RegionEngine:
     def __init__(self, data_home: str,
                  default_options: RegionOptions | None = None,
                  log_store_factory=None,
-                 store: "ObjectStore | None" = None):
+                 store: "ObjectStore | None" = None,
+                 memory=None):
         self.data_home = data_home
         # default: local disk; pass an S3ObjectStore (storage/s3.py) for
         # cloud storage — WAL stays local/remote-broker either way
@@ -656,6 +665,9 @@ class RegionEngine:
         # factory (e.g. RemoteLogStore over a SharedLogBroker) makes the
         # node (nearly) stateless: failover replays from shared infra
         self.log_store_factory = log_store_factory
+        # optional WorkloadMemoryManager shared by all regions (ingest
+        # write-buffer quota); settable post-init by the embedding app
+        self.memory = memory
 
     def _log_store(self, region_id: int):
         if self.log_store_factory is None:
@@ -678,9 +690,25 @@ class RegionEngine:
         manifest.commit({"kind": "options", "options": opts.to_dict()})
         region = Region(region_id, self.store, schema, manifest,
                         self._wal_dir(region_id), opts,
-                        log_store=self._log_store(region_id))
+                        log_store=self._log_store(region_id),
+                        memory=self.memory)
         self.regions[region_id] = region
         return region
+
+    def ensure_region(
+        self, region_id: int, schema: Schema,
+        options: RegionOptions | None = None,
+    ) -> Region:
+        """Idempotent create-or-open for resumable procedures: an open
+        region or an on-disk manifest from a prior attempt is adopted;
+        only a genuinely absent region is created. Real storage failures
+        propagate untouched (never masked as already-exists)."""
+        if region_id in self.regions:
+            return self.regions[region_id]
+        manifest = Manifest.open(self.store, f"region_{region_id}/manifest")
+        if manifest.exists:
+            return self.open_region(region_id)
+        return self.create_region(region_id, schema, options)
 
     def open_region(self, region_id: int, take_ownership: bool = True) -> Region:
         """Open an existing region.  ``take_ownership=False`` = follower open:
@@ -694,7 +722,8 @@ class RegionEngine:
         opts = RegionOptions(**manifest.state.options) if manifest.state.options else self.default_options
         region = Region(region_id, self.store, manifest.state.schema, manifest,
                         self._wal_dir(region_id), opts,
-                        log_store=self._log_store(region_id))
+                        log_store=self._log_store(region_id),
+                        memory=self.memory)
         region.replay_wal(repair=take_ownership)
         self.regions[region_id] = region
         return region

@@ -35,6 +35,14 @@ class StorageOptions:
 
 
 @dataclass
+class MemoryOptions:
+    """Workload quotas (reference common-memory-manager). 0 = unlimited."""
+
+    ingest_quota_mb: int = 0
+    ingest_policy: str = "reject"  # reject | best_effort
+
+
+@dataclass
 class DeviceOptions:
     platform: str = ""  # "" = jax default; "cpu" forces host
     mesh_shards: int = 0  # 0 = all available devices
@@ -74,6 +82,7 @@ class StandaloneOptions:
     postgres: PostgresOptions = field(default_factory=PostgresOptions)
     wal: WalOptions = field(default_factory=WalOptions)
     storage: StorageOptions = field(default_factory=StorageOptions)
+    memory: MemoryOptions = field(default_factory=MemoryOptions)
     device: DeviceOptions = field(default_factory=DeviceOptions)
 
 

@@ -1,0 +1,104 @@
+"""Workload memory quotas: bounded host/HBM working sets with policies.
+
+Equivalent of the reference's common-memory-manager crate (SURVEY.md §2.9:
+workload memory quotas with policies/guards, src/common/memory-manager/
+{policy.rs,guard.rs}): named workloads (ingest write-buffer, query device
+cache, query build working set) each get a byte quota and a policy for
+what happens at the ceiling — reclaim (flush/evict) first, then reject
+with RUNTIME_RESOURCES_EXHAUSTED or proceed best-effort.
+
+Accounting is PULL-based: each workload's live usage is read from the
+owning component (memtable bytes, cache LRU bytes) at admission time, so
+there is exactly one source of truth and no double bookkeeping.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+from greptimedb_tpu.errors import ResourcesExhausted
+from greptimedb_tpu.utils.telemetry import REGISTRY
+
+_M_REJECTED = REGISTRY.counter(
+    "greptime_memory_admissions_rejected_total",
+    "admissions rejected at quota", labels=("workload",))
+_M_RECLAIMS = REGISTRY.counter(
+    "greptime_memory_reclaims_total",
+    "reclaim passes triggered by admission pressure", labels=("workload",))
+
+
+@dataclass
+class Workload:
+    name: str
+    quota_bytes: int | None  # None = unlimited
+    usage_fn: Callable[[], int]
+    reclaim_fn: Callable[[int], None] | None = None
+    policy: str = "reject"  # "reject" | "best_effort"
+
+
+class WorkloadMemoryManager:
+    """Admission control per workload. Components call
+    ``admit(workload, nbytes)`` before a large allocation; the manager
+    reads live usage, runs the workload's reclaimer once under pressure,
+    then applies the policy."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._workloads: dict[str, Workload] = {}
+
+    def register(
+        self,
+        name: str,
+        quota_bytes: int | None,
+        usage_fn: Callable[[], int],
+        reclaim_fn: Callable[[int], None] | None = None,
+        policy: str = "reject",
+    ) -> None:
+        if policy not in ("reject", "best_effort"):
+            raise ValueError(f"unknown memory policy {policy!r}")
+        with self._lock:
+            self._workloads[name] = Workload(
+                name, quota_bytes, usage_fn, reclaim_fn, policy
+            )
+
+    def set_quota(self, name: str, quota_bytes: int | None) -> None:
+        with self._lock:
+            self._workloads[name].quota_bytes = quota_bytes
+
+    def admit(self, name: str, nbytes: int) -> None:
+        with self._lock:
+            w = self._workloads.get(name)
+        if w is None or w.quota_bytes is None:
+            return
+        used = w.usage_fn()
+        if used + nbytes <= w.quota_bytes:
+            return
+        if w.reclaim_fn is not None:
+            _M_RECLAIMS.labels(name).inc()
+            # ask for the actual deficit, not the batch size: usage may
+            # have drifted far past quota (estimates undershoot), and the
+            # reclaimer stops as soon as it frees what was requested
+            w.reclaim_fn(used + nbytes - w.quota_bytes)
+            if w.usage_fn() + nbytes <= w.quota_bytes:
+                return
+        if w.policy == "best_effort":
+            return
+        _M_REJECTED.labels(name).inc()
+        raise ResourcesExhausted(
+            f"workload {name!r} over memory quota: "
+            f"{w.usage_fn()} + {nbytes} > {w.quota_bytes} bytes"
+        )
+
+    def usage(self) -> dict[str, dict]:
+        with self._lock:
+            workloads = list(self._workloads.values())
+        return {
+            w.name: {
+                "used_bytes": int(w.usage_fn()),
+                "quota_bytes": w.quota_bytes,
+                "policy": w.policy,
+            }
+            for w in workloads
+        }

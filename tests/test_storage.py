@@ -1127,3 +1127,66 @@ class TestAppendMode:
         db.sql("INSERT INTO m VALUES ('a',1000,1.0),('a',1000,2.0)")
         assert db.sql("SELECT v FROM m").rows == [[2.0]]
         db.close()
+
+
+class TestWorkloadMemoryQuotas:
+    """Workload memory manager (reference common-memory-manager):
+    ingest write-buffer quota with flush-reclaim then reject."""
+
+    def test_reclaim_flushes_largest_memtable(self, tmp_path):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(str(tmp_path), ingest_quota_bytes=64 * 1024)
+        try:
+            db.sql("CREATE TABLE mq (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+                   " v DOUBLE, PRIMARY KEY (h))")
+            region = db._region_of("mq")
+            # fill past the quota: reclaim flushes instead of rejecting
+            for i in range(40):
+                vals = ", ".join(
+                    f"('h{j}', {i * 1000 + j}, {float(j)})" for j in range(64)
+                )
+                db.sql(f"INSERT INTO mq VALUES {vals}")
+            assert len(region.sst_files) >= 1, "quota pressure must flush"
+            total = db.sql("SELECT count(*) FROM mq").rows[0][0]
+            assert total == 40 * 64  # nothing lost to reclaim
+        finally:
+            db.close()
+
+    def test_reject_policy_without_reclaimable_data(self):
+        import pytest
+
+        from greptimedb_tpu.errors import ResourcesExhausted
+        from greptimedb_tpu.utils.memory import WorkloadMemoryManager
+
+        m = WorkloadMemoryManager()
+        m.register("ingest", 1000, usage_fn=lambda: 990)
+        with pytest.raises(ResourcesExhausted):
+            m.admit("ingest", 100)
+        m.admit("ingest", 5)  # under quota passes
+
+    def test_best_effort_policy_proceeds(self):
+        from greptimedb_tpu.utils.memory import WorkloadMemoryManager
+
+        m = WorkloadMemoryManager()
+        m.register("x", 10, usage_fn=lambda: 1000, policy="best_effort")
+        m.admit("x", 10)  # over quota but tolerated
+
+    def test_unregistered_and_unlimited_admit(self):
+        from greptimedb_tpu.utils.memory import WorkloadMemoryManager
+
+        m = WorkloadMemoryManager()
+        m.admit("nope", 1 << 40)  # unknown workload: no-op
+        m.register("u", None, usage_fn=lambda: 0)
+        m.admit("u", 1 << 40)  # unlimited
+
+    def test_usage_snapshot(self, tmp_path):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(str(tmp_path), ingest_quota_bytes=1 << 20)
+        try:
+            u = db.memory.usage()
+            assert u["ingest"]["quota_bytes"] == 1 << 20
+            assert "device_cache" in u
+        finally:
+            db.close()
