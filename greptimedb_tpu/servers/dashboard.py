@@ -1,0 +1,224 @@
+"""Embedded web dashboard: a zero-dependency query console at /dashboard.
+
+Equivalent of the reference's embedded dashboard
+(src/servers/src/http.rs:1252 serves a bundled web UI): one
+self-contained HTML page — SQL and PromQL consoles with table output,
+a schema browser, and live /status. No external assets, so it works
+air-gapped, and styling is a small neutral palette that follows the
+OS light/dark preference.
+"""
+
+DASHBOARD_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>greptimedb-tpu</title>
+<style>
+:root {
+  color-scheme: light dark;
+  --bg: #f7f7f8; --panel: #ffffff; --ink: #1a1a1f; --muted: #6b6b76;
+  --line: #e3e3e8; --accent: #3e63dd; --err: #b4232c; --ok: #1a7f37;
+}
+@media (prefers-color-scheme: dark) {
+  :root { --bg:#131318; --panel:#1c1c23; --ink:#e8e8ec; --muted:#9a9aa5;
+          --line:#2c2c35; --accent:#7b9bf2; --err:#ff7b84; --ok:#57c274; }
+}
+* { box-sizing: border-box; }
+body { margin:0; font:14px/1.45 system-ui, sans-serif;
+       background:var(--bg); color:var(--ink); }
+header { display:flex; align-items:baseline; gap:12px;
+         padding:10px 16px; border-bottom:1px solid var(--line); }
+header h1 { font-size:15px; margin:0; }
+header .sub { color:var(--muted); font-size:12px; }
+main { display:grid; grid-template-columns: 220px 1fr; gap:12px;
+       padding:12px 16px; max-width:1200px; }
+nav, section.card { background:var(--panel); border:1px solid var(--line);
+       border-radius:8px; padding:10px; }
+nav h2, section.card h2 { font-size:12px; text-transform:uppercase;
+       letter-spacing:.04em; color:var(--muted); margin:2px 0 8px; }
+nav ul { list-style:none; margin:0; padding:0; font-size:13px; }
+nav li { padding:2px 4px; border-radius:4px; cursor:pointer;
+         overflow:hidden; text-overflow:ellipsis; white-space:nowrap; }
+nav li:hover { background:var(--bg); color:var(--accent); }
+#right { display:flex; flex-direction:column; gap:12px; min-width:0; }
+.tabs { display:flex; gap:4px; margin-bottom:8px; }
+.tabs button { border:1px solid var(--line); background:var(--bg);
+  color:var(--ink); border-radius:6px 6px 0 0; padding:4px 14px;
+  cursor:pointer; font:inherit; }
+.tabs button.on { background:var(--panel); border-bottom-color:var(--panel);
+  color:var(--accent); font-weight:600; }
+textarea { width:100%; min-height:72px; font:13px/1.4 ui-monospace,monospace;
+  background:var(--bg); color:var(--ink); border:1px solid var(--line);
+  border-radius:6px; padding:8px; resize:vertical; }
+.row { display:flex; gap:8px; align-items:center; margin-top:8px; }
+.row input { font:13px ui-monospace,monospace; background:var(--bg);
+  color:var(--ink); border:1px solid var(--line); border-radius:6px;
+  padding:5px 8px; width:130px; }
+button.run { background:var(--accent); color:#fff; border:none;
+  border-radius:6px; padding:6px 18px; font:inherit; cursor:pointer; }
+#meta { color:var(--muted); font-size:12px; }
+#meta.err { color:var(--err); }
+.scroll { overflow:auto; max-height:440px; margin-top:10px; }
+table { border-collapse:collapse; width:100%; font-size:13px; }
+th, td { text-align:left; padding:4px 10px; border-bottom:1px solid var(--line);
+  white-space:nowrap; font-variant-numeric: tabular-nums; }
+th { position:sticky; top:0; background:var(--panel); color:var(--muted);
+  font-weight:600; }
+td.num { text-align:right; }
+#statusbox { font:12px ui-monospace,monospace; white-space:pre-wrap;
+  color:var(--muted); margin:0; }
+</style>
+</head>
+<body>
+<header>
+  <h1>greptimedb-tpu</h1>
+  <span class="sub">TPU-native observability database · <a href="/metrics">/metrics</a> · <a href="/config">/config</a></span>
+</header>
+<main>
+  <nav>
+    <h2>Tables</h2>
+    <ul id="tables"></ul>
+    <h2 style="margin-top:14px">Status</h2>
+    <pre id="statusbox">loading…</pre>
+  </nav>
+  <div id="right">
+    <section class="card">
+      <div class="tabs">
+        <button id="tab-sql" class="on">SQL</button>
+        <button id="tab-promql">PromQL</button>
+      </div>
+      <div id="pane-sql">
+        <textarea id="sql" spellcheck="false">SELECT * FROM information_schema.tables LIMIT 20</textarea>
+        <div class="row">
+          <button class="run" id="run-sql">Run</button>
+          <span id="meta"></span>
+        </div>
+      </div>
+      <div id="pane-promql" style="display:none">
+        <textarea id="promql" spellcheck="false">up</textarea>
+        <div class="row">
+          <label>start <input id="p-start" value="-1h"></label>
+          <label>end <input id="p-end" value="now"></label>
+          <label>step <input id="p-step" value="60" size="5"></label>
+          <button class="run" id="run-promql">Run</button>
+          <span id="pmeta"></span>
+        </div>
+      </div>
+      <div class="scroll"><table id="out"></table></div>
+    </section>
+  </div>
+</main>
+<script>
+const $ = (id) => document.getElementById(id);
+function esc(v) {
+  // attribute-safe: table names may contain arbitrary characters
+  // (backtick-quoted identifiers) and are interpolated into attributes
+  return String(v ?? "").replace(/[&<>"']/g, c => ({
+    "&":"&amp;", "<":"&lt;", ">":"&gt;", '"':"&quot;", "'":"&#39;"}[c]));
+}
+function renderTable(cols, rows) {
+  const numeric = cols.map((_, i) =>
+    rows.length > 0 && rows.every(r => r[i] === null || typeof r[i] === "number"));
+  $("out").innerHTML =
+    "<thead><tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr></thead>" +
+    "<tbody>" + rows.map(r => "<tr>" + r.map((v, i) =>
+      `<td${numeric[i] ? ' class="num"' : ""}>${esc(v)}</td>`).join("") +
+      "</tr>").join("") + "</tbody>";
+}
+async function runSql(q) {
+  const t0 = performance.now();
+  $("meta").className = ""; $("meta").textContent = "running…";
+  const resp = await fetch("/v1/sql?sql=" + encodeURIComponent(q), {method: "POST"});
+  const j = await resp.json();
+  const ms = (performance.now() - t0).toFixed(1);
+  if (!resp.ok || j.error) {
+    $("meta").className = "err";
+    $("meta").textContent = `${j.error || resp.status} (code ${j.code ?? "?"})`;
+    renderTable([], []);
+    return;
+  }
+  const out = (j.output && j.output[0]) || {};
+  if (out.records) {
+    const cols = out.records.schema.column_schemas.map(c => c.name);
+    renderTable(cols, out.records.rows);
+    $("meta").textContent = `${out.records.rows.length} rows · ${ms} ms`;
+  } else {
+    renderTable(["affected rows"], [[out.affectedrows ?? 0]]);
+    $("meta").textContent = `OK · ${ms} ms`;
+  }
+}
+function promTime(s) {
+  s = s.trim();
+  if (s === "now") return Date.now() / 1000;
+  const m = s.match(/^-(\\d+)([smhd])$/);
+  if (m) return Date.now() / 1000 - (+m[1]) * {s:1, m:60, h:3600, d:86400}[m[2]];
+  return +s;
+}
+async function runPromql() {
+  const q = $("promql").value;
+  $("pmeta").className = ""; $("pmeta").textContent = "running…";
+  const u = `/v1/prometheus/api/v1/query_range?query=${encodeURIComponent(q)}` +
+    `&start=${promTime($("p-start").value)}&end=${promTime($("p-end").value)}` +
+    `&step=${$("p-step").value}`;
+  const j = await (await fetch(u)).json();
+  if (j.status !== "success") {
+    $("pmeta").className = "err";
+    $("pmeta").textContent = j.error || "query failed";
+    renderTable([], []);
+    return;
+  }
+  const series = j.data.result;
+  const rows = [];
+  for (const s of series) {
+    const lbl = Object.entries(s.metric).map(([k, v]) => `${k}=${v}`).join(", ");
+    for (const [ts, v] of s.values || (s.value ? [s.value] : [])) {
+      rows.push([lbl, new Date(ts * 1000).toISOString(), +v]);
+    }
+  }
+  renderTable(["series", "time", "value"], rows);
+  $("pmeta").textContent = `${series.length} series · ${rows.length} points`;
+}
+async function refreshSidebar() {
+  try {
+    const j = await (await fetch("/v1/sql?sql=" + encodeURIComponent(
+      "SELECT table_schema, table_name FROM information_schema.tables" +
+      " WHERE table_schema != 'information_schema' ORDER BY table_name"
+    ), {method: "POST"})).json();
+    const rows = j.output[0].records.rows;
+    $("tables").innerHTML = rows.map(([s, t]) =>
+      `<li data-t="${esc(s)}.${esc(t)}" title="${esc(s)}.${esc(t)}">${esc(t)}</li>`).join("");
+    for (const li of $("tables").children) {
+      li.onclick = () => {
+        $("sql").value = `SELECT * FROM ${li.dataset.t} LIMIT 100`;
+        runSql($("sql").value);
+      };
+    }
+  } catch (e) { /* sidebar is best-effort */ }
+  try {
+    const st = await (await fetch("/status")).json();
+    $("statusbox").textContent = JSON.stringify(st, null, 1);
+  } catch (e) { $("statusbox").textContent = "status unavailable"; }
+}
+$("run-sql").onclick = () => runSql($("sql").value);
+$("run-promql").onclick = runPromql;
+$("sql").addEventListener("keydown", e => {
+  if ((e.ctrlKey || e.metaKey) && e.key === "Enter") runSql($("sql").value);
+});
+$("promql").addEventListener("keydown", e => {
+  if ((e.ctrlKey || e.metaKey) && e.key === "Enter") runPromql();
+});
+for (const t of ["sql", "promql"]) {
+  $("tab-" + t).onclick = () => {
+    for (const o of ["sql", "promql"]) {
+      $("tab-" + o).classList.toggle("on", o === t);
+      $("pane-" + o).style.display = o === t ? "" : "none";
+    }
+  };
+}
+refreshSidebar();
+setInterval(refreshSidebar, 10000);  // keep tables + /status live
+</script>
+</body>
+</html>
+"""

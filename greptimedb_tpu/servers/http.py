@@ -81,6 +81,8 @@ def _error_json(e: Exception) -> tuple[dict, int]:
             StatusCode.UNSUPPORTED: 400,
             StatusCode.TABLE_ALREADY_EXISTS: 409,
             StatusCode.DATABASE_ALREADY_EXISTS: 409,
+            # deliberate backpressure (memory quota), not a server fault
+            StatusCode.RUNTIME_RESOURCES_EXHAUSTED: 503,
         }.get(code, 500)
         return {"code": int(code), "error": e.msg, "execution_time_ms": 0}, http
     return {"code": int(StatusCode.INTERNAL), "error": str(e)}, 500
@@ -170,6 +172,8 @@ class HttpServer:
         r.add_get("/metrics", self.h_metrics)
         r.add_get("/config", self.h_config)
         r.add_get("/status", self.h_status)
+        r.add_get("/dashboard", self.h_dashboard)
+        r.add_get("/dashboard/", self.h_dashboard)
         return app
 
     async def _call(self, fn, *args):
@@ -1004,6 +1008,12 @@ class HttpServer:
         }
         return web.Response(text=json.dumps(cfg, indent=2),
                             content_type="text/plain")
+
+    async def h_dashboard(self, request: web.Request) -> web.Response:
+        """Embedded web UI (reference src/servers/src/http.rs:1252)."""
+        from greptimedb_tpu.servers.dashboard import DASHBOARD_HTML
+
+        return web.Response(text=DASHBOARD_HTML, content_type="text/html")
 
     async def h_status(self, request: web.Request) -> web.Response:
         import jax

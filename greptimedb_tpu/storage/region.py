@@ -678,12 +678,15 @@ class RegionEngine:
         return os.path.join(self.data_home, f"region_{region_id}", "wal")
 
     def create_region(
-        self, region_id: int, schema: Schema, options: RegionOptions | None = None
+        self, region_id: int, schema: Schema,
+        options: RegionOptions | None = None,
+        _manifest: "Manifest | None" = None,
     ) -> Region:
         if region_id in self.regions:
             raise StorageError(f"region {region_id} already open")
         opts = options or self.default_options
-        manifest = Manifest.open(self.store, f"region_{region_id}/manifest")
+        manifest = _manifest if _manifest is not None else Manifest.open(
+            self.store, f"region_{region_id}/manifest")
         if manifest.exists:
             raise StorageError(f"region {region_id} already exists on disk")
         manifest.commit({"kind": "schema", "schema": schema.to_dict()})
@@ -702,21 +705,26 @@ class RegionEngine:
         """Idempotent create-or-open for resumable procedures: an open
         region or an on-disk manifest from a prior attempt is adopted;
         only a genuinely absent region is created. Real storage failures
-        propagate untouched (never masked as already-exists)."""
+        propagate untouched (never masked as already-exists). The manifest
+        opened for the existence probe is handed to the create/open path —
+        manifest open is checkpoint+delta reads, costly on object stores."""
         if region_id in self.regions:
             return self.regions[region_id]
         manifest = Manifest.open(self.store, f"region_{region_id}/manifest")
         if manifest.exists:
-            return self.open_region(region_id)
-        return self.create_region(region_id, schema, options)
+            return self.open_region(region_id, _manifest=manifest)
+        return self.create_region(region_id, schema, options,
+                                  _manifest=manifest)
 
-    def open_region(self, region_id: int, take_ownership: bool = True) -> Region:
+    def open_region(self, region_id: int, take_ownership: bool = True,
+                    _manifest: "Manifest | None" = None) -> Region:
         """Open an existing region.  ``take_ownership=False`` = follower open:
         replay the (possibly leader-shared) WAL read-only, never repairing
         torn tails the live leader may still be appending."""
         if region_id in self.regions:
             return self.regions[region_id]
-        manifest = Manifest.open(self.store, f"region_{region_id}/manifest")
+        manifest = _manifest if _manifest is not None else Manifest.open(
+            self.store, f"region_{region_id}/manifest")
         if not manifest.exists:
             raise RegionNotFound(f"region {region_id} not found in {self.data_home}")
         opts = RegionOptions(**manifest.state.options) if manifest.state.options else self.default_options

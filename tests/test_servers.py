@@ -265,7 +265,17 @@ class TestHttpApi:
         code, raw = http(server, "/config")
         assert code == 200 and b"data_home" in raw
         code, raw = http(server, "/status")
-        assert code == 200 and b"devices" in raw
+        assert code == 200 and b"devices" in raw and b"memory" in raw
+
+    def test_dashboard_served(self, server):
+        code, raw = http(server, "/dashboard")
+        assert code == 200
+        # self-contained page wired to the real endpoints
+        assert b"<!doctype html>" in raw and b"greptimedb-tpu" in raw
+        for endpoint in (b"/v1/sql", b"/v1/prometheus/api/v1/query_range",
+                         b"/status"):
+            assert endpoint in raw
+        assert b'src="http' not in raw  # no external assets
 
     def test_bad_remote_write_body(self, server):
         code, _ = http(server, "/v1/prometheus/write", method="POST",
