@@ -40,6 +40,7 @@ DENSE_LIMIT = 1 << 22
 DISPATCH_STATS = {"sorted": 0, "scatter": 0}
 
 _I64_MAX = np.int64(np.iinfo(np.int64).max)
+_I64_MIN = np.int64(np.iinfo(np.int64).min)
 
 
 def _vec_fingerprint(plan, table) -> int:
@@ -220,19 +221,28 @@ class Executor:
             grid if (dense_ok and key_specs) else (1 if not key_specs else padded)
         )
         dict_ver = tuple(len(ctx.encoders[c.name]) for c in ctx.schema.tag_columns)
+        # time bounds and bucket-grid origins are TRACED kernel arguments,
+        # not closure constants: a rolling window (every dashboard refresh,
+        # every TSBS query) must reuse the compiled program, not recompile.
+        # Shape-bearing parts (step, pow2 bucket count) stay in the key.
         cache_key = (
             plan.fingerprint(), padded, tuple(cards), dense_ok, num_groups,
-            dict_ver, lo, hi, use_sorted, _vec_fingerprint(plan, table),
-            tuple(spec[1] if spec[0] == "time" else spec[0:2] for spec in key_specs if spec[0] != "expr"),
+            dict_ver, use_sorted, _vec_fingerprint(plan, table),
+            tuple((spec[1][0], spec[1][2]) if spec[0] == "time" else spec[0:2]
+                  for spec in key_specs if spec[0] != "expr"),
         )
         kernel = self._cache.get(cache_key)
         if kernel is None:
             kernel = self._build_agg_kernel(
                 key_specs, dense_ok, num_groups, cards, where_fn, agg_specs,
-                ts_name, lo, hi, use_sorted, batched,
+                ts_name, use_sorted, batched,
             )
             self._cache[cache_key] = kernel
-        out = kernel(table)
+        ts_lo = np.int64(lo) if lo is not None else _I64_MIN
+        ts_hi = np.int64(hi) if hi is not None else _I64_MAX
+        starts = tuple(np.int64(spec[1][1])
+                       for spec in key_specs if spec[0] == "time")
+        out = kernel(table, ts_lo, ts_hi, starts)
         out = {k: np.asarray(v) for k, v in out.items()}
 
         gmask = out.pop("__gmask__").astype(bool)
@@ -510,17 +520,24 @@ class Executor:
 
     def _build_agg_kernel(
         self, key_specs, dense_ok, num_groups, cards, where_fn, agg_specs,
-        ts_name, lo, hi, use_sorted=False, batched=(),
+        ts_name, use_sorted=False, batched=(),
     ):
+        # map key_specs index -> ordinal into the traced time_starts tuple
+        time_ordinal = {
+            i: t for t, i in enumerate(
+                i for i, s in enumerate(key_specs) if s[0] == "time"
+            )
+        }
+
         @jax.jit
-        def kernel(table: DeviceTable):
+        def kernel(table: DeviceTable, ts_lo, ts_hi, time_starts):
             env = dict(table.columns)
             pad_mask = table.row_mask  # padding rows, pre-WHERE
             mask = table.row_mask
-            if lo is not None and ts_name is not None:
-                mask = mask & (env[ts_name] >= lo)
-            if hi is not None and ts_name is not None:
-                mask = mask & (env[ts_name] < hi)
+            if ts_name is not None:
+                # ts_lo/ts_hi are traced (sentinel min/max when unbounded):
+                # a moving window re-runs this same compiled program
+                mask = mask & (env[ts_name] >= ts_lo) & (env[ts_name] < ts_hi)
             if where_fn is not None:
                 mask = mask & where_fn(env)
 
@@ -544,8 +561,10 @@ class Executor:
                     if spec[0] == "tag":
                         codes.append(env[spec[1]])
                     else:
-                        step, start, nb = spec[1]
-                        idx = bucket_index(env[ts_name], step, start)
+                        step, _start, nb = spec[1]
+                        idx = bucket_index(
+                            env[ts_name], step, time_starts[time_ordinal[i]]
+                        )
                         if use_sorted:
                             # WHERE-excluded rows clamp (keeps ids sorted and
                             # they are mask-neutral); PADDING rows must still
@@ -564,12 +583,14 @@ class Executor:
             else:
                 # iterative collision-free ranking
                 combined = None
-                for spec in key_specs:
+                for i, spec in enumerate(key_specs):
                     if spec[0] == "tag":
                         vals = env[spec[1]].astype(jnp.int64)
                     elif spec[0] == "time":
-                        step, start, nb = spec[1]
-                        vals = bucket_index(env[ts_name], step, start)
+                        step, _start, nb = spec[1]
+                        vals = bucket_index(
+                            env[ts_name], step, time_starts[time_ordinal[i]]
+                        )
                     else:
                         vals = spec[1](env).astype(jnp.int64)
                     if combined is None:
@@ -614,9 +635,10 @@ class Executor:
                     if spec[0] == "tag":
                         out[f"__key{i}__"] = comps[pos]
                     else:
-                        step, start, nb = spec[1]
+                        step, _start, nb = spec[1]
                         out[f"__key{i}__"] = (
-                            comps[pos].astype(jnp.int64) * step + start
+                            comps[pos].astype(jnp.int64) * step
+                            + time_starts[time_ordinal[i]]
                         )
             elif key_specs:
                 # sparse path: representative row per group via segment_min
@@ -633,7 +655,8 @@ class Executor:
                     if spec[0] == "tag":
                         kv = env[spec[1]][safe_rep]
                     elif spec[0] == "time":
-                        step, start, nb = spec[1]
+                        step, _start, nb = spec[1]
+                        start = time_starts[time_ordinal[i]]
                         bucket = bucket_index(env[ts_name], step, start)
                         kv = (bucket * step + start)[safe_rep]
                     else:
@@ -759,18 +782,17 @@ class Executor:
         dict_ver = tuple(len(ctx.encoders[c.name]) for c in ctx.schema.tag_columns)
         cache_key = (
             "raw", plan.fingerprint(), table.padded_rows, tuple(cols), dict_ver,
-            lo, hi, _vec_fingerprint(plan, table), topk and tuple(topk.items()),
+            _vec_fingerprint(plan, table), topk and tuple(topk.items()),
         )
         kernel = self._cache.get(cache_key)
         if kernel is None:
-            def filter_mask(env, row_mask):
+            def filter_mask(env, row_mask, ts_lo, ts_hi):
                 """The ONE raw-scan filter (shared by both kernels so the
-                top-k path can never diverge from the full scan)."""
+                top-k path can never diverge from the full scan). Time
+                bounds arrive traced — moving windows reuse the kernel."""
                 mask = row_mask
-                if lo is not None and ts_name is not None:
-                    mask = mask & (env[ts_name] >= lo)
-                if hi is not None and ts_name is not None:
-                    mask = mask & (env[ts_name] < hi)
+                if ts_name is not None:
+                    mask = mask & (env[ts_name] >= ts_lo) & (env[ts_name] < ts_hi)
                 if where_fn is not None:
                     mask = mask & where_fn(env)
                 return mask
@@ -780,9 +802,9 @@ class Executor:
                 spec = topk["keys"]  # ((col, asc, nulls_first), ...)
 
                 @jax.jit
-                def kernel(t: DeviceTable):
+                def kernel(t: DeviceTable, ts_lo, ts_hi):
                     env = dict(t.columns)
-                    mask = filter_mask(env, t.row_mask)
+                    mask = filter_mask(env, t.row_mask, ts_lo, ts_hi)
                     keys = []  # minor → major for lexsort
                     for col, asc, nulls_first in reversed(spec):
                         v = env[col]
@@ -806,16 +828,20 @@ class Executor:
             else:
 
                 @jax.jit
-                def kernel(t: DeviceTable):
+                def kernel(t: DeviceTable, ts_lo, ts_hi):
                     env = dict(t.columns)
-                    mask = filter_mask(env, t.row_mask)
+                    mask = filter_mask(env, t.row_mask, ts_lo, ts_hi)
                     sub = {c: env[c] for c in cols}
                     packed, new_mask = compact_rows(sub, mask)
                     packed["__n__"] = jnp.sum(mask.astype(jnp.int64))
                     return packed
 
             self._cache[cache_key] = kernel
-        out = kernel(table)
+        out = kernel(
+            table,
+            np.int64(lo) if lo is not None else _I64_MIN,
+            np.int64(hi) if hi is not None else _I64_MAX,
+        )
         n = int(out.pop("__n__"))
         env: dict[str, np.ndarray] = {}
         for c in cols:

@@ -98,22 +98,25 @@ def _substitute_aliases(e: Expr, aliases: dict[str, Expr]) -> Expr:
     return e
 
 
-def extract_time_range(
+def split_time_range(
     where: Expr | None, ctx: TableContext
-) -> tuple[int | None, int | None]:
-    """Conjunctive time bounds on the time index for scan pruning.
+) -> tuple[int | None, int | None, Expr | None]:
+    """Conjunctive time bounds on the time index for scan pruning, PLUS
+    the residual WHERE with the consumed conjuncts removed.
 
     Only top-level AND conjuncts are considered (reference: scan-hint
-    optimizer extracts the same). Returns half-open [lo, hi)."""
+    optimizer extracts the same). Returns half-open [lo, hi) and the
+    residual expression (None when everything was consumed). Removing
+    the consumed conjuncts matters beyond avoiding double evaluation:
+    the physical layer passes lo/hi as TRACED kernel arguments, so a
+    rolling time window reuses one compiled kernel — but only if the
+    timestamps are also gone from the plan fingerprint's WHERE text."""
     lo: int | None = None
     hi: int | None = None
 
-    def visit(e: Expr) -> None:
+    def consume(e: Expr) -> bool:
+        """True if this conjunct is fully captured by (lo, hi)."""
         nonlocal lo, hi
-        if isinstance(e, BinaryOp) and e.op == "AND":
-            visit(e.left)
-            visit(e.right)
-            return
         if isinstance(e, Between) and not e.negated:
             if isinstance(e.expr, Column) and ctx.is_ts(e.expr.name):
                 if isinstance(e.low, Literal) and isinstance(e.high, Literal):
@@ -121,7 +124,8 @@ def extract_time_range(
                     h = ctx.ts_literal(e.high.value) + 1  # BETWEEN inclusive
                     lo = l if lo is None else max(lo, l)
                     hi = h if hi is None else min(hi, h)
-            return
+                    return True
+            return False
         if isinstance(e, BinaryOp) and e.op in ("<", "<=", ">", ">=", "="):
             col, lit, op = None, None, e.op
             if isinstance(e.left, Column) and isinstance(e.right, Literal):
@@ -130,7 +134,7 @@ def extract_time_range(
                 col, lit = e.right, e.left
                 op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
             if col is None or not ctx.is_ts(col.name):
-                return
+                return False
             v = ctx.ts_literal(lit.value)
             if op == ">=":
                 lo = v if lo is None else max(lo, v)
@@ -140,12 +144,37 @@ def extract_time_range(
                 hi = v if hi is None else min(hi, v)
             elif op == "<=":
                 hi = v + 1 if hi is None else min(hi, v + 1)
-            elif op == "=":
+            else:  # "="
                 lo = v if lo is None else max(lo, v)
                 hi = v + 1 if hi is None else min(hi, v + 1)
+            return True
+        return False
 
-    if where is not None:
-        visit(where)
+    def walk(e: Expr) -> Expr | None:
+        """Residual of the AND-tree after removing consumed conjuncts."""
+        if isinstance(e, BinaryOp) and e.op == "AND":
+            left = walk(e.left)
+            right = walk(e.right)
+            if left is None:
+                return right
+            if right is None:
+                return left
+            if left is e.left and right is e.right:
+                return e
+            return BinaryOp("AND", left, right)
+        return None if consume(e) else e
+
+    if where is None:
+        return None, None, None
+    residual = walk(where)
+    return lo, hi, residual
+
+
+def extract_time_range(
+    where: Expr | None, ctx: TableContext
+) -> tuple[int | None, int | None]:
+    """Bounds-only view of split_time_range (distributed planner, joins)."""
+    lo, hi, _ = split_time_range(where, ctx)
     return lo, hi
 
 
@@ -305,13 +334,14 @@ def plan_select(sel: Select, ctx: TableContext) -> SelectPlan:
                             new_aggs.append(p)
                 aggs = new_aggs
 
+    ts_lo, ts_hi, residual_where = split_time_range(where, ctx)
     return SelectPlan(
         select=sel,
         ctx=ctx,
         table=sel.table or "",
         items=items,
-        where=where,
-        time_range=extract_time_range(where, ctx),
+        where=residual_where,
+        time_range=(ts_lo, ts_hi),
         is_agg=is_agg,
         group_keys=group_keys,
         aggs=aggs,

@@ -129,23 +129,29 @@ function renderTable(cols, rows) {
 async function runSql(q) {
   const t0 = performance.now();
   $("meta").className = ""; $("meta").textContent = "running…";
-  const resp = await fetch("/v1/sql?sql=" + encodeURIComponent(q), {method: "POST"});
-  const j = await resp.json();
-  const ms = (performance.now() - t0).toFixed(1);
-  if (!resp.ok || j.error) {
+  try {
+    const resp = await fetch("/v1/sql?sql=" + encodeURIComponent(q), {method: "POST"});
+    const j = await resp.json();
+    const ms = (performance.now() - t0).toFixed(1);
+    if (!resp.ok || j.error) {
+      $("meta").className = "err";
+      $("meta").textContent = `${j.error || resp.status} (code ${j.code ?? "?"})`;
+      renderTable([], []);
+      return;
+    }
+    const out = (j.output && j.output[0]) || {};
+    if (out.records) {
+      const cols = out.records.schema.column_schemas.map(c => c.name);
+      renderTable(cols, out.records.rows);
+      $("meta").textContent = `${out.records.rows.length} rows · ${ms} ms`;
+    } else {
+      renderTable(["affected rows"], [[out.affectedrows ?? 0]]);
+      $("meta").textContent = `OK · ${ms} ms`;
+    }
+  } catch (e) {  // network failure / non-JSON body (proxy error page)
     $("meta").className = "err";
-    $("meta").textContent = `${j.error || resp.status} (code ${j.code ?? "?"})`;
+    $("meta").textContent = `request failed: ${e.message || e}`;
     renderTable([], []);
-    return;
-  }
-  const out = (j.output && j.output[0]) || {};
-  if (out.records) {
-    const cols = out.records.schema.column_schemas.map(c => c.name);
-    renderTable(cols, out.records.rows);
-    $("meta").textContent = `${out.records.rows.length} rows · ${ms} ms`;
-  } else {
-    renderTable(["affected rows"], [[out.affectedrows ?? 0]]);
-    $("meta").textContent = `OK · ${ms} ms`;
   }
 }
 function promTime(s) {
@@ -158,26 +164,32 @@ function promTime(s) {
 async function runPromql() {
   const q = $("promql").value;
   $("pmeta").className = ""; $("pmeta").textContent = "running…";
-  const u = `/v1/prometheus/api/v1/query_range?query=${encodeURIComponent(q)}` +
-    `&start=${promTime($("p-start").value)}&end=${promTime($("p-end").value)}` +
-    `&step=${$("p-step").value}`;
-  const j = await (await fetch(u)).json();
-  if (j.status !== "success") {
-    $("pmeta").className = "err";
-    $("pmeta").textContent = j.error || "query failed";
-    renderTable([], []);
-    return;
-  }
-  const series = j.data.result;
-  const rows = [];
-  for (const s of series) {
-    const lbl = Object.entries(s.metric).map(([k, v]) => `${k}=${v}`).join(", ");
-    for (const [ts, v] of s.values || (s.value ? [s.value] : [])) {
-      rows.push([lbl, new Date(ts * 1000).toISOString(), +v]);
+  try {
+    const u = `/v1/prometheus/api/v1/query_range?query=${encodeURIComponent(q)}` +
+      `&start=${promTime($("p-start").value)}&end=${promTime($("p-end").value)}` +
+      `&step=${$("p-step").value}`;
+    const j = await (await fetch(u)).json();
+    if (j.status !== "success") {
+      $("pmeta").className = "err";
+      $("pmeta").textContent = j.error || "query failed";
+      renderTable([], []);
+      return;
     }
+    const series = j.data.result;
+    const rows = [];
+    for (const s of series) {
+      const lbl = Object.entries(s.metric).map(([k, v]) => `${k}=${v}`).join(", ");
+      for (const [ts, v] of s.values || (s.value ? [s.value] : [])) {
+        rows.push([lbl, new Date(ts * 1000).toISOString(), +v]);
+      }
+    }
+    renderTable(["series", "time", "value"], rows);
+    $("pmeta").textContent = `${series.length} series · ${rows.length} points`;
+  } catch (e) {  // network failure / non-JSON body
+    $("pmeta").className = "err";
+    $("pmeta").textContent = `request failed: ${e.message || e}`;
+    renderTable([], []);
   }
-  renderTable(["series", "time", "value"], rows);
-  $("pmeta").textContent = `${series.length} series · ${rows.length} points`;
 }
 async function refreshSidebar() {
   try {

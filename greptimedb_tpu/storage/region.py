@@ -680,13 +680,11 @@ class RegionEngine:
     def create_region(
         self, region_id: int, schema: Schema,
         options: RegionOptions | None = None,
-        _manifest: "Manifest | None" = None,
     ) -> Region:
         if region_id in self.regions:
             raise StorageError(f"region {region_id} already open")
         opts = options or self.default_options
-        manifest = _manifest if _manifest is not None else Manifest.open(
-            self.store, f"region_{region_id}/manifest")
+        manifest = Manifest.open(self.store, f"region_{region_id}/manifest")
         if manifest.exists:
             raise StorageError(f"region {region_id} already exists on disk")
         manifest.commit({"kind": "schema", "schema": schema.to_dict()})
@@ -713,8 +711,10 @@ class RegionEngine:
         manifest = Manifest.open(self.store, f"region_{region_id}/manifest")
         if manifest.exists:
             return self.open_region(region_id, _manifest=manifest)
-        return self.create_region(region_id, schema, options,
-                                  _manifest=manifest)
+        # create path re-opens fresh: the immediately-pre-commit existence
+        # re-check is what makes two nodes racing create on a shared object
+        # store fail loudly instead of committing duplicate schema actions
+        return self.create_region(region_id, schema, options)
 
     def open_region(self, region_id: int, take_ownership: bool = True,
                     _manifest: "Manifest | None" = None) -> Region:
