@@ -105,6 +105,22 @@ def cmd_datanode(args) -> int:
     return 0
 
 
+def cmd_frontend(args) -> int:
+    """Frontend role process: stateless HTTP SQL router over remote
+    datanodes + a shared metadata store (reference
+    src/cmd/src/frontend.rs)."""
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from greptimedb_tpu.rpc.frontend import serve_frontend
+
+    host, port = args.http_addr.rsplit(":", 1)
+    serve_frontend(args.kvstore, args.datanode or [],
+                   host=host, port=int(port))
+    return 0
+
+
 def cmd_kvstore(args) -> int:
     """Shared metadata-store role process (etcd/RDS analog: an
     SqliteKv-backed Flight service every metasrv/frontend can point at;
@@ -336,6 +352,22 @@ def main(argv: list[str] | None = None) -> int:
                          "self-fencing; without it leader leases self-renew "
                          "on write)")
     pd.set_defaults(fn=cmd_datanode)
+
+    pf = sub.add_parser("frontend",
+                        help="run a stateless frontend (HTTP SQL router)")
+    pf.add_argument("action", choices=["start"])
+    pf.add_argument("--kvstore", default=None,
+                    help="shared metadata store: remote://host:port "
+                         "(omit = private in-memory catalog)")
+    pf.add_argument("--datanode", action="append", default=[],
+                    metavar="ID=HOST:PORT",
+                    help="register a datanode (repeatable)")
+    pf.add_argument("--http-addr", default="127.0.0.1:0",
+                    help="bind address; port 0 = pick free "
+                         "(printed as JSON on stdout)")
+    pf.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu)")
+    pf.set_defaults(fn=cmd_frontend)
 
     pk = sub.add_parser("kvstore",
                         help="run a shared metadata store (etcd analog)")

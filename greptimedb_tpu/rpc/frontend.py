@@ -240,3 +240,110 @@ class DistFrontend:
         if sel.limit is not None:
             res.rows[:] = res.rows[: sel.limit]
         return res
+
+
+# ---------------------------------------------------------------------------
+# Frontend role process: HTTP SQL over the distributed engine
+# (reference src/cmd/src/frontend.rs — a stateless router binding the
+# protocol surface to remote datanodes + a shared metadata store)
+# ---------------------------------------------------------------------------
+
+
+def _make_frontend_http(frontend: DistFrontend, host: str, port: int):
+    """Frontend-role HTTP server on the shared ThreadedAiohttpApp
+    machinery (one loop-hosting recipe for every aiohttp server):
+    /v1/sql with the greptime JSON envelope, /health, /status. Query
+    execution is the DistFrontend MergeScan path; the full protocol zoo
+    stays on standalone (the reference's frontend serves more, but SQL
+    is the spine every BI/driver integration needs)."""
+    from greptimedb_tpu.servers.http import ThreadedAiohttpApp
+
+    class FrontendHttp(ThreadedAiohttpApp):
+        thread_name = "greptime-frontend-http"
+
+        def __init__(self):
+            self.frontend = frontend
+            self.host = host
+            self.port = port
+
+        def build_app(self):
+            import asyncio as _asyncio
+            import time as _time
+
+            from aiohttp import web
+
+            from greptimedb_tpu.servers.http import (
+                _error_json, _result_to_json,
+            )
+
+            async def h_sql(request):
+                t0 = _time.perf_counter()
+                sql = request.query.get("sql")
+                if not sql and request.method == "POST":
+                    form = await request.post()
+                    sql = form.get("sql")
+                if not sql:
+                    return web.json_response(
+                        {"code": 1004, "error": "missing sql parameter"},
+                        status=400)
+                try:
+                    res = await _asyncio.get_running_loop().run_in_executor(
+                        None, self.frontend.sql, sql)
+                    return web.json_response(_result_to_json(res, t0))
+                except Exception as e:  # noqa: BLE001
+                    body, status = _error_json(e)
+                    return web.json_response(body, status=status)
+
+            async def h_health(request):
+                return web.json_response({})
+
+            async def h_status(request):
+                return web.json_response({
+                    "version": "greptimedb-tpu-0.1.0",
+                    "role": "frontend",
+                    "datanodes": {
+                        str(nid): dn.address
+                        for nid, dn in self.frontend.datanodes.items()
+                    },
+                    "tables": len(self.frontend.catalog.list_tables(
+                        self.frontend.db)),
+                })
+
+            app = web.Application()
+            app.router.add_route("*", "/v1/sql", h_sql)
+            app.router.add_get("/health", h_health)
+            app.router.add_get("/status", h_status)
+            return app
+
+    return FrontendHttp()
+
+
+def serve_frontend(kvstore: str | None, datanodes: list[str],
+                   host: str = "127.0.0.1", port: int = 4000) -> None:
+    """Blocking entry point for the frontend role process
+    (``greptime frontend start``)."""
+    import json as _json
+
+    kv = None
+    if kvstore:
+        from greptimedb_tpu.rpc.kvservice import RemoteKv
+
+        kv = RemoteKv(kvstore[len("remote://"):]
+                      if kvstore.startswith("remote://") else kvstore)
+    fe = DistFrontend(kv=kv)
+    for spec in datanodes:
+        nid, addr = spec.split("=", 1)
+        fe.add_datanode(int(nid), addr)
+    srv = _make_frontend_http(fe, host=host, port=port)
+    srv.start()
+    print(_json.dumps({"role": "frontend",
+                       "address": f"{srv.host}:{srv.port}"}), flush=True)
+    import signal
+    import threading
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    srv.stop()
+    fe.close()

@@ -88,7 +88,61 @@ def _error_json(e: Exception) -> tuple[dict, int]:
     return {"code": int(StatusCode.INTERNAL), "error": str(e)}, 500
 
 
-class HttpServer:
+class ThreadedAiohttpApp:
+    """The ONE loop-hosting recipe for aiohttp servers on a daemon
+    thread: build_app() on the loop thread, bind (port 0 = pick free),
+    fail loudly if boot does not complete or errors, stop via the
+    loop's own teardown. HttpServer and the frontend-role server both
+    use this — boot/shutdown fixes land in one place."""
+
+    thread_name = "greptime-http"
+
+    def build_app(self):  # pragma: no cover — subclass contract
+        raise NotImplementedError
+
+    def start(self) -> None:
+        if getattr(self, "_started", None) is None:
+            self._started = threading.Event()
+
+        def run_loop():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            try:
+                app = self.build_app()
+                runner = web.AppRunner(app)
+                loop.run_until_complete(runner.setup())
+                site = web.TCPSite(runner, self.host, self.port)
+                loop.run_until_complete(site.start())
+                self._runner = runner
+                if self.port == 0:
+                    self.port = runner.addresses[0][1]
+            except BaseException as e:  # noqa: BLE001 — surfaced by start()
+                self._start_error = e
+                self._started.set()
+                return
+            self._started.set()
+            loop.run_forever()
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
+
+        self._start_error = None
+        self._thread = threading.Thread(target=run_loop, daemon=True,
+                                        name=self.thread_name)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("http server failed to start (boot timeout)")
+        if self._start_error is not None:
+            raise self._start_error
+
+    def stop(self) -> None:
+        if getattr(self, "_loop", None) is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if getattr(self, "_thread", None) is not None:
+            self._thread.join(timeout=5)
+
+
+class HttpServer(ThreadedAiohttpApp):
     def __init__(self, db, host: str = "127.0.0.1", port: int = 4000):
         self.db = db
         self.host = host
@@ -1117,35 +1171,7 @@ class HttpServer:
             text=f"samples={nsamples} interval=10ms\n{body}\n",
             content_type="text/plain")
 
-    def start(self) -> None:
-        def run_loop():
-            loop = asyncio.new_event_loop()
-            asyncio.set_event_loop(loop)
-            self._loop = loop
-            app = self.build_app()
-            runner = web.AppRunner(app)
-            loop.run_until_complete(runner.setup())
-            site = web.TCPSite(runner, self.host, self.port)
-            loop.run_until_complete(site.start())
-            self._runner = runner
-            if self.port == 0:
-                self.port = runner.addresses[0][1]
-            self._started.set()
-            loop.run_forever()
-            loop.run_until_complete(runner.cleanup())
-            loop.close()
-
-        self._thread = threading.Thread(target=run_loop, daemon=True,
-                                        name="greptime-http")
-        self._thread.start()
-        if not self._started.wait(timeout=10):
-            raise RuntimeError("http server failed to start")
-
-    def stop(self) -> None:
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+    # start()/stop() come from ThreadedAiohttpApp
 
 
 def _parse_prom_time(raw) -> float:

@@ -399,3 +399,89 @@ class TestRemoteWalFailover:
                 if p.poll() is None:
                     p.terminate()
                     p.wait(timeout=10)
+
+
+class TestFrontendRoleProcess:
+    def test_four_process_cluster_sql_over_http(self, tmp_path):
+        """kvstore + 2 datanodes + frontend as REAL OS processes; SQL over
+        the frontend's HTTP port; a second frontend sharing the kvstore
+        sees the same catalog (stateless frontends, reference
+        src/cmd/src/frontend.rs)."""
+        import urllib.parse
+        import urllib.request
+
+        procs = []
+
+        def spawn(argv):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "greptimedb_tpu.cli", *argv],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, cwd="/root/repo",
+            )
+            procs.append(p)
+            return json.loads(p.stdout.readline())
+
+        def q(port, sql):
+            u = (f"http://127.0.0.1:{port}/v1/sql?sql="
+                 + urllib.parse.quote(sql))
+            with urllib.request.urlopen(u, timeout=30) as r:
+                return json.load(r)
+
+        try:
+            kv = spawn(["kvstore", "start",
+                        "--path", str(tmp_path / "meta.sqlite")])
+            dn1 = spawn(["datanode", "start", "--node-id", "1",
+                         "--data-home", str(tmp_path / "dn1"),
+                         "--platform", "cpu"])
+            dn2 = spawn(["datanode", "start", "--node-id", "2",
+                         "--data-home", str(tmp_path / "dn2"),
+                         "--platform", "cpu"])
+            fe = spawn(["frontend", "start",
+                        "--kvstore", f"remote://{kv['address']}",
+                        "--datanode", f"1={dn1['address']}",
+                        "--datanode", f"2={dn2['address']}",
+                        "--platform", "cpu"])
+            port = int(fe["address"].rsplit(":", 1)[1])
+
+            r = q(port, "CREATE TABLE pt (h STRING, ts TIMESTAMP(3) TIME "
+                        "INDEX, v DOUBLE, PRIMARY KEY (h)) "
+                        "PARTITION ON COLUMNS (h) (h < 'm', h >= 'm')")
+            assert r["code"] == 0
+            vals = ", ".join(
+                f"('{h}', {i * 1000}, {float(i)})"
+                for i, h in enumerate(["alpha", "zulu", "beta", "yank"] * 5)
+            )
+            r = q(port, f"INSERT INTO pt VALUES {vals}")
+            assert r["code"] == 0 and r["output"][0]["affectedrows"] == 20
+            r = q(port, "SELECT h, count(*), max(v) FROM pt GROUP BY h "
+                        "ORDER BY h")
+            rows = r["output"][0]["records"]["rows"]
+            assert rows == [["alpha", 5, 16.0], ["beta", 5, 18.0],
+                            ["yank", 5, 19.0], ["zulu", 5, 17.0]]
+
+            # health/status surface
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=10
+            ) as resp:
+                st = json.load(resp)
+            assert st["role"] == "frontend" and st["tables"] == 1
+
+            # a SECOND stateless frontend over the same kvstore serves the
+            # same table without any local state
+            fe2 = spawn(["frontend", "start",
+                         "--kvstore", f"remote://{kv['address']}",
+                         "--datanode", f"1={dn1['address']}",
+                         "--datanode", f"2={dn2['address']}",
+                         "--platform", "cpu"])
+            port2 = int(fe2["address"].rsplit(":", 1)[1])
+            r = q(port2, "SELECT count(*) FROM pt")
+            assert r["output"][0]["records"]["rows"] == [[20]]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
