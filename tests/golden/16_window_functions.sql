@@ -1,0 +1,6 @@
+CREATE TABLE w (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO w VALUES ('a',1000,5.0),('a',2000,3.0),('a',3000,8.0),('b',1000,2.0),('b',2000,9.0);
+SELECT h, ts, v, row_number() OVER (PARTITION BY h ORDER BY ts) rn FROM w ORDER BY h, ts;
+SELECT h, ts, v, rank() OVER (ORDER BY v) r, dense_rank() OVER (ORDER BY v) dr FROM w ORDER BY h, ts;
+SELECT h, ts, v, lag(v) OVER (PARTITION BY h ORDER BY ts) prev, lead(v) OVER (PARTITION BY h ORDER BY ts) nxt FROM w ORDER BY h, ts;
+SELECT h, ts, sum(v) OVER (PARTITION BY h ORDER BY ts) running FROM w ORDER BY h, ts

@@ -1,0 +1,7 @@
+CREATE TABLE s (h STRING, ts TIMESTAMP(3) TIME INDEX, msg STRING, PRIMARY KEY (h));
+INSERT INTO s VALUES ('a',1000,'Hello World'),('b',2000,'  padded  '),('c',3000,'abcdef');
+SELECT h, upper(msg), lower(msg), length(msg) FROM s ORDER BY h;
+SELECT h, trim(msg), substr(msg, 2, 3) FROM s ORDER BY h;
+SELECT h, concat(h, ':', msg) FROM s ORDER BY h;
+SELECT h FROM s WHERE msg LIKE '%World%';
+SELECT h FROM s WHERE msg LIKE 'abc%'

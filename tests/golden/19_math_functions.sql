@@ -1,0 +1,5 @@
+CREATE TABLE m (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO m VALUES ('a',1000,4.0),('b',2000,-2.5),('c',3000,100.0);
+SELECT h, abs(v), sqrt(abs(v)), round(v) FROM m ORDER BY h;
+SELECT h, floor(v), ceil(v), clamp(v, 0, 50) FROM m ORDER BY h;
+SELECT h, ln(abs(v)), log10(abs(v)) FROM m ORDER BY h

@@ -1,0 +1,6 @@
+CREATE TABLE hd (h STRING, r STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h, r));
+INSERT INTO hd VALUES ('a','east',1000,1.0),('a','west',1000,2.0),('b','east',1000,3.0),('b','east',2000,4.0),('c','west',1000,5.0);
+SELECT h, sum(v) s FROM hd GROUP BY h HAVING sum(v) > 2 ORDER BY h;
+SELECT DISTINCT r FROM hd ORDER BY r;
+SELECT h, count(DISTINCT r) FROM hd GROUP BY h ORDER BY h;
+SELECT r, avg(v) FROM hd GROUP BY r HAVING count(*) >= 2 ORDER BY r

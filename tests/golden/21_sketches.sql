@@ -1,0 +1,5 @@
+CREATE TABLE sk (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO sk VALUES ('a',1000,1.0),('a',2000,2.0),('a',3000,2.0),('b',1000,3.0),('b',2000,4.0),('b',3000,5.0);
+SELECT h, approx_distinct(v) FROM sk GROUP BY h ORDER BY h;
+SELECT h, hll_count(hll(v)) FROM sk GROUP BY h ORDER BY h;
+SELECT h, uddsketch_calc(0.5, uddsketch_state(64, 0.05, v)) p50 FROM sk GROUP BY h ORDER BY h

@@ -1,0 +1,5 @@
+CREATE TABLE g (h STRING, ts TIMESTAMP(3) TIME INDEX, lat DOUBLE, lon DOUBLE, PRIMARY KEY (h));
+INSERT INTO g VALUES ('sf',1000,37.7749,-122.4194),('nyc',2000,40.7128,-74.0060);
+SELECT h, geohash(lat, lon, 6) FROM g ORDER BY h;
+SELECT round(st_distance_sphere_m(wkt_point_from_latlng(37.7749, -122.4194), wkt_point_from_latlng(40.7128, -74.0060)) / 1000) km;
+SELECT ipv4_string_to_num('10.0.0.1') n, ipv4_num_to_string(3232235777) s

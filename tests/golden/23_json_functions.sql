@@ -1,0 +1,5 @@
+CREATE TABLE j (h STRING, ts TIMESTAMP(3) TIME INDEX, doc STRING, PRIMARY KEY (h));
+INSERT INTO j VALUES ('a',1000,'{"user": "kim", "n": 3, "ok": true}'),('b',2000,'{"user": "lee", "n": 7, "nested": {"x": 1}}');
+SELECT h, json_get_string(doc, 'user'), json_get_int(doc, 'n') FROM j ORDER BY h;
+SELECT h, json_get_bool(doc, 'ok') FROM j ORDER BY h;
+SELECT h FROM j WHERE json_path_exists(doc, 'nested.x')

@@ -1,0 +1,5 @@
+CREATE TABLE p (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h)) PARTITION ON COLUMNS (h) (h < 'm', h >= 'm');
+INSERT INTO p VALUES ('alpha',1000,1.0),('zulu',1000,2.0),('beta',2000,3.0),('yank',2000,4.0);
+SELECT count(*) FROM p;
+SELECT h, sum(v) FROM p GROUP BY h ORDER BY h;
+SELECT count(*) FROM information_schema.partitions WHERE table_name = 'p'

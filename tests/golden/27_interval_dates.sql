@@ -1,0 +1,6 @@
+CREATE TABLE dt (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO dt VALUES ('a','2024-06-15 10:17:45',1.0),('b','2024-06-15 11:42:03',2.0);
+SELECT h, date_trunc('hour', ts) FROM dt ORDER BY h;
+SELECT h, date_bin(INTERVAL '15 minutes', ts) FROM dt ORDER BY h;
+SELECT h, to_unixtime(ts) FROM dt ORDER BY h;
+SELECT count(*) FROM dt WHERE ts >= '2024-06-15 11:00:00'

@@ -1,0 +1,4 @@
+CREATE TABLE vt (h STRING, ts TIMESTAMP(3) TIME INDEX, emb VECTOR(3), PRIMARY KEY (h));
+INSERT INTO vt VALUES ('a',1000,'[1.0, 0.0, 0.0]'),('b',2000,'[0.0, 1.0, 0.0]'),('c',3000,'[0.7, 0.7, 0.0]');
+SELECT h, round(vec_cos_distance(emb, '[1.0, 0.0, 0.0]') * 1000) d FROM vt ORDER BY d, h LIMIT 2;
+SELECT h, vec_dot_product(emb, '[1.0, 1.0, 0.0]') FROM vt ORDER BY h

@@ -1,0 +1,4 @@
+CREATE TABLE logs (svc STRING, ts TIMESTAMP(3) TIME INDEX, msg STRING, PRIMARY KEY (svc)) WITH (append_mode = 'true');
+INSERT INTO logs VALUES ('api',1000,'connection timeout to db-1'),('api',2000,'request ok in 12ms'),('web',3000,'Timeout waiting for upstream');
+SELECT svc, msg FROM logs WHERE matches_term(msg, 'timeout') ORDER BY ts;
+SELECT count(*) FROM logs WHERE matches(msg, 'connection timeout')
