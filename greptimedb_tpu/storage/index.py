@@ -123,18 +123,24 @@ class ColumnIndex:
 
 def build_sst_index(columns: dict[str, np.ndarray], tag_names: list[str],
                     fulltext_columns: list[str] | None = None,
-                    has_tombstones: bool = False) -> bytes:
+                    has_tombstones: bool = False,
+                    tag_uniques: dict[str, list] | None = None) -> bytes:
     """Serialize per-tag-column blooms + term dicts, plus per-fulltext-
     column token sets, for one SST (the puffin blob, reference
     src/puffin/; fulltext backend = the reference's bloom-based variant,
-    src/index/src/fulltext_index/)."""
+    src/index/src/fulltext_index/).  ``tag_uniques`` (precomputed distinct
+    values, e.g. from dictionary codes) skips the per-row unique pass."""
     blobs: dict[str, bytes] = {}
     vocabs: dict[str, list[str]] = {}
     tokens: dict[str, list[str]] = {}
     for name in tag_names:
-        if name not in columns:
+        pre = (tag_uniques or {}).get(name)
+        if pre is not None:
+            uniq = np.asarray(sorted(str(v) for v in pre), dtype=object)
+        elif name in columns:
+            uniq = np.unique(columns[name].astype(object))
+        else:
             continue
-        uniq = np.unique(columns[name].astype(object))
         bf = BloomFilter.for_keys(len(uniq))
         for v in uniq:
             bf.add(v)

@@ -21,6 +21,14 @@ OP = "__op__"  # 0 = put, 1 = delete tombstone
 OP_PUT = 0
 OP_DELETE = 1
 
+# per-tag dictionary-code companion columns carried through memtable
+# chunks so flush/index/cache paths never re-hash raw tag strings
+TAGCODE_PREFIX = "__tagcode_"
+
+
+def tagcode_col(tag_name: str) -> str:
+    return f"{TAGCODE_PREFIX}{tag_name}__"
+
 
 class Memtable:
     def __init__(self, schema: Schema):

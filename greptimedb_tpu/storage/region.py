@@ -23,7 +23,9 @@ from greptimedb_tpu.datatypes.batch import DictionaryEncoder
 from greptimedb_tpu.datatypes.schema import Schema, default_fill_array
 from greptimedb_tpu.errors import InvalidArguments, RegionNotFound, StorageError
 from greptimedb_tpu.storage.manifest import Manifest
-from greptimedb_tpu.storage.memtable import Memtable, OP, OP_DELETE, OP_PUT, SEQ, TSID
+from greptimedb_tpu.storage.memtable import (
+    Memtable, OP, OP_DELETE, OP_PUT, SEQ, TAGCODE_PREFIX, TSID, tagcode_col,
+)
 from greptimedb_tpu.storage.object_store import FsObjectStore, ObjectStore
 from greptimedb_tpu.storage.sst import SstMeta, read_sst, write_sst
 from greptimedb_tpu.storage.wal import (
@@ -123,25 +125,81 @@ class Region:
         return list(self.manifest.state.files.values())
 
     # ---- write path ---------------------------------------------------
-    def _encode_tags(self, columns: dict[str, np.ndarray], n: int) -> np.ndarray:
-        """tags → per-column codes (mutating region dicts) → __tsid__."""
+    def _encode_tags(
+        self, columns: dict[str, np.ndarray], n: int,
+        out_codes: dict[str, np.ndarray] | None = None,
+    ) -> np.ndarray:
+        """tags → per-column codes (mutating region dicts) → __tsid__.
+
+        ``out_codes`` (when given) receives the per-column int32 code
+        arrays so downstream consumers (SST dictionary pages, bloom index,
+        device canonicalization) never re-hash the raw strings."""
         tag_cols = self.tag_names
         if not tag_cols:
             return np.zeros(n, dtype=np.int64)
+        import pandas as pd
+
         code_arrays = []
         for name in tag_cols:
-            vals = columns[name]
+            vals = np.asarray(columns[name], dtype=object)
             enc = self.encoders[name]
-            # encode via unique values only: tag columns repeat heavily
-            uniq, inv = np.unique(np.asarray(vals, dtype=object), return_inverse=True)
+            # hash-factorize (O(n), no object-array sort): tag columns
+            # repeat heavily, so python cost is paid per UNIQUE value only
+            inv, uniq = pd.factorize(vals, use_na_sentinel=False)
+            if any(not isinstance(v, str) for v in uniq):
+                # NULL tags (None/NaN from factorize) encode as "" — the
+                # device dictionary space has no null representation (same
+                # rule as add_tag_column backfill); non-string scalars
+                # stringify so a poisoned vocab can never wedge flush
+                uniq = np.array(
+                    ["" if v is None or (isinstance(v, float) and v != v)
+                     else str(v) if not isinstance(v, str) else v
+                     for v in uniq], dtype=object)
             codes = np.fromiter(
-                (enc.get_or_insert(v) for v in uniq), dtype=np.int64, count=len(uniq)
+                (enc.get_or_insert(v) for v in uniq), dtype=np.int64,
+                count=len(uniq),
             )
-            code_arrays.append(codes[inv])
-        # vectorized any-arity series resolution: unique rows of the stacked
-        # code matrix, then a small python loop over UNIQUE keys only (the
-        # metric-engine physical region routinely has many tag columns, so
-        # no per-row python fallback is acceptable on the ingest hot path)
+            col_codes = codes[inv]
+            if out_codes is not None:
+                out_codes[name] = col_codes.astype(np.int32)
+            code_arrays.append(col_codes)
+        # vectorized any-arity series resolution: pack per-column codes
+        # into one int64 key when the combined bit width fits (exact,
+        # injective), factorize the packed ints, then a python loop over
+        # UNIQUE keys only (the metric-engine physical region routinely
+        # has many tag columns, so no per-row python fallback is
+        # acceptable on the ingest hot path)
+        if len(code_arrays) == 1:
+            packed = code_arrays[0]
+            widths = None
+        else:
+            widths = [
+                max(int(a.max()) if n else 0, 1).bit_length()
+                for a in code_arrays
+            ]
+            if sum(widths) <= 62:
+                packed = code_arrays[0]
+                for a, w in zip(code_arrays[1:], widths[1:]):
+                    packed = (packed << np.int64(w)) | a
+            else:  # astronomically wide key space: exact structured unique
+                packed = None
+        if packed is not None:
+            inv2, uniq_packed = pd.factorize(packed)
+            # first-occurrence row per unique packed key (reversed write:
+            # the earliest row wins), to recover the exact code tuple
+            first_row = np.empty(len(uniq_packed), dtype=np.int64)
+            rev = np.arange(n - 1, -1, -1)
+            first_row[inv2[rev]] = rev
+            tsids = np.empty(len(uniq_packed), dtype=np.int64)
+            for j in range(len(uniq_packed)):
+                r = int(first_row[j])
+                key = tuple(int(a[r]) for a in code_arrays)
+                tsid = self._series.get(key)
+                if tsid is None:
+                    tsid = len(self._series)
+                    self._series[key] = tsid
+                tsids[j] = tsid
+            return tsids[inv2]
         code_mat = np.stack(code_arrays, axis=1)  # [n, k] int64
         uniq_rows, inv2 = np.unique(code_mat, axis=0, return_inverse=True)
         tsids = np.empty(len(uniq_rows), dtype=np.int64)
@@ -203,18 +261,26 @@ class Region:
         seq = self.next_seq
         self.next_seq += 1
         chunk = dict(cols)
-        chunk[TSID] = self._encode_tags(cols, n)
+        tag_codes: dict[str, np.ndarray] = {}
+        chunk[TSID] = self._encode_tags(cols, n, out_codes=tag_codes)
+        for tname, tcodes in tag_codes.items():
+            chunk[tagcode_col(tname)] = tcodes
         chunk[SEQ] = np.full(n, seq, dtype=np.int64)
         chunk[OP] = np.full(n, op, dtype=np.int8)
 
-        # durability first (reference handle_write.rs: WAL before memtable)
-        wal_cols = {}
-        for k, v in chunk.items():
-            # object-dtype (string) columns: pa.array over the python list
-            # preserves None as arrow nulls (astype(str) would corrupt NULL
-            # into the literal 'None' across crash recovery)
-            wal_cols[k] = pa.array(v.tolist() if v.dtype == object else v)
-        self.wal.append(seq, encode_write(wal_cols))
+        # durability first (reference handle_write.rs: WAL before memtable);
+        # non-durable stores (Noop) skip serialization entirely — encoding
+        # 10 columns of a million-row batch for /dev/null is pure overhead
+        if getattr(self.wal, "durable", True):
+            wal_cols = {}
+            for k, v in chunk.items():
+                if k.startswith(TAGCODE_PREFIX):
+                    continue  # codes are derivable; replay recomputes them
+                # object-dtype (string) columns: pa.array over the python
+                # list preserves None as arrow nulls (astype(str) would
+                # corrupt NULL into the literal 'None' across recovery)
+                wal_cols[k] = pa.array(v.tolist() if v.dtype == object else v)
+            self.wal.append(seq, encode_write(wal_cols))
         # memtable stores ts as int64 under the schema's ts column name
         mt_chunk = dict(chunk)
         mt_chunk[self.ts_name] = chunk[self.ts_name].astype(np.int64)
@@ -311,7 +377,10 @@ class Region:
         frozen = self.memtable.freeze(dedup=not self.options.append_mode)
         flushed_seq = self.memtable.max_seq
         # storage keeps ts as int64 epoch in schema unit
-        meta = write_sst(self.store, f"{self._dir}/sst", self.schema, frozen)
+        meta = write_sst(
+            self.store, f"{self._dir}/sst", self.schema, frozen,
+            tag_dicts={k: enc.values() for k, enc in self.encoders.items()},
+        )
         self._write_sst_index(meta, frozen)
         self.manifest.commit(
             {
@@ -356,7 +425,10 @@ class Region:
                         np.int64 if c.dtype.is_timestamp else c.dtype.to_numpy()
                     )
             n = len(chunk[self.ts_name])
-            chunk[TSID] = self._encode_tags(chunk, n)
+            tag_codes: dict[str, np.ndarray] = {}
+            chunk[TSID] = self._encode_tags(chunk, n, out_codes=tag_codes)
+            for tname, tcodes in tag_codes.items():
+                chunk[tagcode_col(tname)] = tcodes
             chunk[SEQ] = cols[SEQ].to_numpy(zero_copy_only=False)
             chunk[OP] = cols[OP].to_numpy(zero_copy_only=False).astype(np.int8)
             self.memtable.append(chunk)
@@ -527,10 +599,21 @@ class Region:
         if not tag_names and not ft_cols:
             return
         has_tomb = bool((columns[OP] == OP_DELETE).any()) if OP in columns else False
+        # distinct values per tag from the dictionary-code companion
+        # columns when present: unique over int32 codes beats unique over
+        # object strings by an order of magnitude on wide batches
+        tag_uniques: dict[str, list] = {}
+        for name in tag_names:
+            codes = columns.get(tagcode_col(name))
+            if codes is None:
+                continue
+            vocab = self.encoders[name].values()
+            tag_uniques[name] = [vocab[int(c)] for c in np.unique(codes)]
         self.store.write(
             self._index_path(meta),
             build_sst_index(columns, tag_names, fulltext_columns=ft_cols,
-                            has_tombstones=has_tomb),
+                            has_tombstones=has_tomb,
+                            tag_uniques=tag_uniques or None),
         )
 
     def _sst_index(self, meta) -> dict | None:

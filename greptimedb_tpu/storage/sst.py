@@ -80,8 +80,16 @@ def write_sst(
     columns: dict[str, np.ndarray],
     level: int = 0,
     row_group_size: int = 256 * 1024,
+    tag_dicts: dict[str, list] | None = None,
 ) -> SstMeta:
-    """Write one sorted SST; caller guarantees (tsid, ts, seq) order."""
+    """Write one sorted SST; caller guarantees (tsid, ts, seq) order.
+
+    ``tag_dicts`` + ``__tagcode_<name>__`` companion columns (write path)
+    build the Parquet dictionary pages directly from region codes — no
+    per-row string hashing; compaction inputs lack codes and take the
+    hash-encode fallback."""
+    from greptimedb_tpu.storage.memtable import tagcode_col
+
     ts_col = schema.time_index.name
     n = len(columns[SEQ])
     file_id = uuid.uuid4().hex
@@ -92,9 +100,24 @@ def write_sst(
     for f in target:
         col = columns[f.name]
         if pa.types.is_dictionary(f.type):
-            arrays.append(
-                pa.array(col.astype(object), type=pa.utf8()).dictionary_encode()
-            )
+            codes = columns.get(tagcode_col(f.name))
+            vocab = (tag_dicts or {}).get(f.name)
+            if codes is not None and vocab is not None:
+                # SST-local dictionary: remap region codes to this file's
+                # distinct values — embedding the region-lifetime vocab
+                # would bloat every SST of a long-lived churning region
+                uniq_codes = np.unique(codes)
+                local = np.searchsorted(uniq_codes, codes).astype(np.int32)
+                arrays.append(pa.DictionaryArray.from_arrays(
+                    pa.array(local, type=pa.int32()),
+                    pa.array([vocab[int(c)] for c in uniq_codes],
+                             type=pa.utf8()),
+                ))
+            else:
+                arrays.append(
+                    pa.array(col.astype(object), type=pa.utf8())
+                    .dictionary_encode()
+                )
         else:
             arrays.append(pa.array(col, type=f.type))
     table = pa.Table.from_arrays(arrays, schema=target)

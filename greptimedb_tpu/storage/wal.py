@@ -30,6 +30,10 @@ _SEGMENT_TARGET = 64 * 1024 * 1024
 class LogStore:
     """Interface (reference store-api logstore.rs:51)."""
 
+    # False = appends are dropped (Noop): writers may skip payload
+    # serialization entirely — the encode cost is pure waste
+    durable = True
+
     def append(self, sequence: int, payload: bytes) -> None:
         raise NotImplementedError
 
@@ -143,6 +147,8 @@ class FileLogStore(LogStore):
 
 class NoopLogStore(LogStore):
     """WAL-less mode for benchmarks (reference src/log-store/src/noop/)."""
+
+    durable = False
 
     def append(self, sequence: int, payload: bytes) -> None:
         pass
