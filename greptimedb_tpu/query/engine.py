@@ -182,9 +182,33 @@ class QueryEngine:
         t = mark("plan_ms", t)
         if check is not None:
             check()
-        table, ts_bounds = self.provider.device_table(sel.table, plan)
-        t = mark("scan_cache_ms", t)
-        env, n = self.executor.execute(plan, table, ts_bounds)
+        # dense time-grid fast path: regular-cadence metric tables lower
+        # (tags × time bucket) aggregation to reshape+reduce — no scatter
+        env = n = None
+        scanned = 0
+        import os as _os
+
+        grid_fn = getattr(self.provider, "grid_table", None)
+        if _os.environ.get("GREPTIME_GRID", "auto") == "off":
+            grid_fn = None  # A/B escape hatch: force the row path
+        if grid_fn is not None:
+            from greptimedb_tpu.query.physical import grid_plan_candidate
+
+            if grid_plan_candidate(plan):
+                grid, ts_bounds = grid_fn(sel.table, plan)
+                if grid is not None:
+                    t = mark("scan_cache_ms", t)
+                    res = self.executor.execute_grid(plan, grid, ts_bounds)
+                    if res is not None:
+                        env, n = res
+                        scanned = grid.spad * grid.tpad
+                        if metrics is not None:
+                            metrics["grid"] = True
+        if env is None:
+            table, ts_bounds = self.provider.device_table(sel.table, plan)
+            t = mark("scan_cache_ms", t)
+            env, n = self.executor.execute(plan, table, ts_bounds)
+            scanned = table.padded_rows
         t = mark("device_exec_ms", t)
         if plan.sliding is not None:
             env, n = _apply_sliding(plan, env, n)
@@ -192,7 +216,7 @@ class QueryEngine:
         mark("shape_ms", t)
         if metrics is not None:
             metrics["output_rows"] = len(result.rows)
-            metrics["scanned_rows_padded"] = table.padded_rows
+            metrics["scanned_rows_padded"] = scanned
         return result
 
     def explain(self, sel: Select) -> str:

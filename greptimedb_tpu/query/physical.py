@@ -37,7 +37,64 @@ DENSE_LIMIT = 1 << 22
 
 # diagnostics: counts every aggregate dispatch (including kernel-cache
 # hits) by which segment strategy it used; tests assert coverage
-DISPATCH_STATS = {"sorted": 0, "scatter": 0}
+DISPATCH_STATS = {"sorted": 0, "scatter": 0, "grid": 0}
+
+_GRID_OPS = {"avg": "mean", "mean": "mean", "sum": "sum", "count": "count",
+             "min": "min", "max": "max"}
+
+
+def grid_plan_candidate(plan) -> bool:
+    """Cheap pre-build eligibility for the dense-grid executor: structure
+    and referenced columns only (grid step/shape checks need the built
+    grid and happen in execute_grid).  Called BEFORE the provider builds a
+    grid, so an obviously ineligible plan never pays the build."""
+    from greptimedb_tpu.storage.grid import grid_float_fields
+
+    ctx = plan.ctx
+    if not plan.is_agg:
+        return False
+    time_keys = 0
+    for k in plan.group_keys:
+        if k.kind == "time":
+            time_keys += 1
+        elif k.kind != "tag":
+            return False
+    if time_keys > 1:
+        return False
+    ts = ctx.schema.time_index
+    if ts is None:
+        return False
+    gridcols = set(grid_float_fields(ctx.schema))
+    tags = {c.name for c in ctx.schema.tag_columns}
+    ok_refs = gridcols | tags | {ts.name}
+    for agg in plan.aggs:
+        op = _GRID_OPS.get(agg.name)
+        if op is None or agg.distinct:
+            return False
+        if not agg.args or isinstance(agg.args[0], Star):
+            if agg.name != "count":
+                return False
+            continue
+        if len(agg.args) > 1:
+            return False
+        refs: set = set()
+        try:
+            referenced_columns(agg.args[0], ctx, refs)
+        except Exception:  # noqa: BLE001
+            return False
+        # tag refs inside numeric aggregates would aggregate dictionary
+        # codes; the row path rejects them too — fall back for parity
+        if not refs <= ok_refs or (refs & tags):
+            return False
+    if plan.where is not None:
+        refs = set()
+        try:
+            referenced_columns(plan.where, ctx, refs)
+        except Exception:  # noqa: BLE001
+            return False
+        if not refs <= ok_refs:
+            return False
+    return True
 
 _I64_MAX = np.int64(np.iinfo(np.int64).max)
 _I64_MIN = np.int64(np.iinfo(np.int64).min)
@@ -302,6 +359,303 @@ class Executor:
         for name, _op, _col in batched:
             env[name] = out[name][gmask]
         return env, n
+
+    # ---- dense time-grid path -----------------------------------------
+    def execute_grid(
+        self, plan: SelectPlan, grid, ts_bounds: tuple[int, int]
+    ) -> tuple[dict[str, np.ndarray], int] | None:
+        """Aggregate over a GridTable: reshape+reduce per time bucket, then
+        a tiny series-axis segment merge — no row scatter at any scale.
+
+        Returns None when this plan/grid combination is ineligible (query
+        bucket not a multiple of the grid step, unsupported agg shape…);
+        the caller falls back to the row-oriented DeviceTable path.
+
+        Reference counterpart: RangeSelectExec + the hash aggregate
+        (src/query/src/range_select/plan.rs:273) — here the time bucketing
+        is a tensor reshape because the data layout already IS the range
+        grid (SURVEY.md §5.7, §7.1)."""
+        ctx = plan.ctx
+        ts_name = ctx.schema.time_index.name
+        tag_keys = [k for k in plan.group_keys if k.kind == "tag"]
+        time_keys = [k for k in plan.group_keys if k.kind == "time"]
+        if len(time_keys) > 1:
+            return None
+        gridcols = set(grid.field_names)
+
+        # agg specs: (out_name, op, arg_fn|None, no_nan_plain)
+        specs: list[tuple] = []
+        try:
+            for agg in plan.aggs:
+                op = _GRID_OPS.get(agg.name)
+                if op is None or agg.distinct:
+                    return None
+                if not agg.args or isinstance(agg.args[0], Star):
+                    specs.append((str(agg), "count", None, True))
+                    continue
+                arg = agg.args[0]
+                refs: set = set()
+                referenced_columns(arg, ctx, refs)
+                if not refs <= gridcols | {ts_name}:
+                    return None
+                no_nan_plain = False
+                if isinstance(arg, Column):
+                    real = ctx.resolve(arg.name)
+                    if real in gridcols:
+                        ci = grid.field_names.index(real)
+                        no_nan_plain = bool(
+                            grid.no_nan[ci] if ci < len(grid.no_nan) else False
+                        )
+                specs.append(
+                    (str(agg), op, compile_device(arg, ctx), no_nan_plain)
+                )
+            where_fn = None
+            if plan.where is not None:
+                refs = set()
+                referenced_columns(plan.where, ctx, refs)
+                tags = {c.name for c in ctx.schema.tag_columns}
+                if not refs <= gridcols | tags | {ts_name}:
+                    return None
+                where_fn = compile_device(plan.where, ctx)
+        except (PlanError, Unsupported):
+            return None
+
+        # time-bucket geometry: R grid points per query bucket, left pad
+        # so every R-block lies in exactly one bucket (pad_left static per
+        # (start, step) alignment class; rolling windows keep it constant)
+        g_step = grid.step
+        lo, hi = plan.time_range
+        if time_keys:
+            step_q, start, _nb = self._time_key_params(
+                time_keys[0], plan, ts_bounds
+            )
+            if g_step <= 0 or step_q % g_step != 0:
+                return None
+            r = step_q // g_step
+            q = (grid.ts0 - start) // g_step  # python floor division: exact
+            pad_left = int(q % r)
+            nb = -(-(pad_left + grid.tpad) // r)
+            bts0 = np.int64(start + (q // r) * step_q)
+        else:
+            r = grid.tpad
+            pad_left = 0
+            nb = 1
+            step_q = 0
+            bts0 = np.int64(0)
+
+        cards_tag = [
+            _pow2(max(len(ctx.encoders[k.column]), 1)) for k in tag_keys
+        ]
+        ngt = 1
+        for c in cards_tag:
+            ngt *= c
+        if ngt * nb > DENSE_LIMIT:
+            return None
+        DISPATCH_STATS["grid"] += 1
+
+        dict_ver = tuple(
+            len(ctx.encoders[c.name]) for c in ctx.schema.tag_columns
+        )
+        tag_order = tuple(sorted(grid.tag_codes))
+        cache_key = (
+            "grid", plan.fingerprint(), grid.spad, grid.tpad,
+            grid.field_names, grid.ts0, g_step, r, pad_left, nb,
+            tuple(cards_tag), dict_ver, grid.no_nan, bool(time_keys),
+            tag_order,
+        )
+        kernel = self._cache.get(cache_key)
+        if kernel is None:
+            kernel = self._build_grid_kernel(
+                grid.field_names, ts_name, tag_order,
+                [k.column for k in tag_keys], cards_tag,
+                bool(time_keys), r, pad_left, nb, step_q,
+                where_fn, specs, grid.ts0, g_step,
+            )
+            self._cache[cache_key] = kernel
+        ts_lo = np.int64(lo) if lo is not None else _I64_MIN
+        ts_hi = np.int64(hi) if hi is not None else _I64_MAX
+        out = kernel(
+            grid.values, grid.valid,
+            tuple(grid.tag_codes[t] for t in tag_order),
+            ts_lo, ts_hi, bts0,
+        )
+        out = {k: np.asarray(v) for k, v in out.items()}
+
+        gmask = out.pop("__gmask__").astype(bool)
+        n = int(gmask.sum())
+        env: dict[str, np.ndarray] = {}
+        # internal flatten order: tag keys (in appearance order) then the
+        # time bucket; emit per original plan key index
+        comps_src = out["__comps__"]
+        tag_pos = 0
+        for i, k in enumerate(plan.group_keys):
+            if k.kind == "tag":
+                raw = comps_src[tag_pos][gmask]
+                col = decode_codes(ctx.encoders[k.column].values(), raw)
+                tag_pos += 1
+            else:
+                raw = out["__bts__"][gmask]
+                col = raw
+            env[k.name] = col
+            env[str(k.expr)] = col
+        for name, _op, _fn, _nn in specs:
+            env[name] = out[name][gmask]
+        return env, n
+
+    def _build_grid_kernel(
+        self, field_names, ts_name, tag_order, tag_cols, cards_tag, has_time,
+        r, pad_left, nb, step_q, where_fn, specs, ts0, g_step,
+    ):
+        ngt = 1
+        for c in cards_tag:
+            ngt *= c
+
+        @jax.jit
+        def kernel(values, valid, tag_arrays, ts_lo, ts_hi, bts0):
+            # raw arrays, not the GridTable pytree: the pytree's aux data
+            # (nt, dicts, …) changes on every append extension and would
+            # force a retrace; the arrays' shapes are the real shape class
+            spad, tpad = valid.shape
+            tag_codes = dict(zip(tag_order, tag_arrays))
+            ts_axis = ts0 + jnp.arange(tpad, dtype=jnp.int64) * g_step
+            env = {
+                name: values[ci]  # [S, T] plane, time contiguous
+                for ci, name in enumerate(field_names)
+            }
+            for tname, codes in tag_codes.items():
+                env[tname] = codes[:, None]
+            env[ts_name] = ts_axis[None, :]
+            v2 = valid & ((ts_axis >= ts_lo) & (ts_axis < ts_hi))[None, :]
+            if where_fn is not None:
+                v2 = v2 & jnp.broadcast_to(where_fn(env), v2.shape)
+
+            pad_right = nb * r - pad_left - tpad
+
+            def breduce(x, fill, mode):
+                """[…, S, T] → […, S, NB]: per-bucket reduction over the
+                CONTIGUOUS time axis (vectorizes along memory order)."""
+                widths = [(0, 0)] * (x.ndim - 1) + [(pad_left, pad_right)]
+                xp = jnp.pad(x, widths, constant_values=fill)
+                xp = xp.reshape(x.shape[:-1] + (nb, r))
+                if mode == "sum":
+                    return xp.sum(axis=-1)
+                if mode == "min":
+                    return xp.min(axis=-1)
+                return xp.max(axis=-1)
+
+            # series → tag-group ids (poison -1 → routed to segment ngt)
+            if tag_cols:
+                codes = [tag_codes[c] for c in tag_cols]
+                gid_s, _tot = combine_keys(codes, cards_tag)
+            else:
+                gid_s = jnp.zeros(spad, dtype=jnp.int64)
+            ids = jnp.where(
+                (gid_s >= 0) & (gid_s < ngt), gid_s, ngt
+            ).astype(jnp.int32)
+
+            def gseg(x, segf=jax.ops.segment_sum):
+                """[…, S, NB] → [ngt, …, NB]: series-axis merge (tiny)."""
+                lead = jnp.moveaxis(x, -2, 0) if x.ndim > 2 else x
+                return segf(lead, ids, num_segments=ngt + 1)[:ngt]
+
+            cnt_all_sb = breduce(v2.astype(jnp.int32), 0, "sum")
+            cnt_all = gseg(cnt_all_sb.astype(jnp.int64))  # [ngt, NB]
+
+            # assemble per-class stacks along axis 0 (planes stay [S, T])
+            sum_items, min_items, max_items = [], [], []
+            cnt_items = []  # args needing their own (non-shared) count
+            for name, op, arg_fn, no_nan_plain in specs:
+                if op == "count" and arg_fn is None:
+                    continue  # count(*): shared cnt_all
+                x = jnp.broadcast_to(
+                    jnp.asarray(arg_fn(env), dtype=jnp.float32),
+                    (spad, tpad),
+                )
+                m = v2 if no_nan_plain else (v2 & ~jnp.isnan(x))
+                shared_cnt = no_nan_plain
+                if op in ("sum", "mean"):
+                    sum_items.append((name, x, m))
+                elif op == "min":
+                    min_items.append((name, x, m))
+                elif op == "max":
+                    max_items.append((name, x, m))
+                if (op in ("mean", "count", "min", "max")
+                        and not shared_cnt):
+                    cnt_items.append((name, m))
+
+            out = {}
+            cnts: dict[str, jnp.ndarray] = {}
+            if cnt_items:
+                M = jnp.stack([m for _n, m in cnt_items], axis=0)
+                cg = gseg(
+                    breduce(M.astype(jnp.int32), 0, "sum").astype(jnp.int64)
+                )  # [ngt, K, NB]
+                for j, (name, _m) in enumerate(cnt_items):
+                    cnts[name] = cg[:, j]
+            sums: dict[str, jnp.ndarray] = {}
+            if sum_items:
+                X = jnp.stack(
+                    [jnp.where(m, x, 0.0) for _n, x, m in sum_items], axis=0
+                )
+                sg = gseg(breduce(X, 0.0, "sum"))  # [ngt, K, NB]
+                for j, (name, _x, _m) in enumerate(sum_items):
+                    sums[name] = sg[:, j]
+            for items, mode, fill, segf in (
+                (min_items, "min", jnp.inf, jax.ops.segment_min),
+                (max_items, "max", -jnp.inf, jax.ops.segment_max),
+            ):
+                if not items:
+                    continue
+                X = jnp.stack(
+                    [jnp.where(m, x, fill) for _n, x, m in items], axis=0
+                )
+                merged = gseg(breduce(X, fill, mode), segf)  # [ngt, K, NB]
+                for j, (name, _x, _m) in enumerate(items):
+                    v = merged[:, j]
+                    c = cnts.get(name, cnt_all)
+                    out[name] = jnp.where(c > 0, v, jnp.nan).reshape(-1)
+            for name, op, arg_fn, no_nan_plain in specs:
+                if name in out:
+                    continue  # min/max already materialized
+                if op == "count":
+                    c = cnt_all if (arg_fn is None or no_nan_plain) else (
+                        cnts[name]
+                    )
+                    out[name] = c.reshape(-1)
+                elif op == "sum":
+                    out[name] = sums[name].reshape(-1)
+                else:  # mean
+                    c = cnt_all if no_nan_plain else cnts[name]
+                    out[name] = jnp.where(
+                        c > 0,
+                        sums[name] / jnp.maximum(c, 1).astype(jnp.float32),
+                        jnp.nan,
+                    ).reshape(-1)
+
+            if not tag_cols and not has_time:
+                # global aggregate: SQL returns exactly one row even when
+                # zero rows matched (count()=0, min/max=NULL)
+                out["__gmask__"] = jnp.ones(1, dtype=bool)
+            else:
+                out["__gmask__"] = (cnt_all > 0).reshape(-1)
+            # group-key materialization: arithmetic decomposition over the
+            # (tags…, bucket) grid — replicated, no gather
+            from greptimedb_tpu.ops.segment import decompose_keys
+
+            ng = ngt * nb
+            comps = decompose_keys(
+                jnp.arange(ng, dtype=jnp.int64), list(cards_tag) + [nb]
+            )
+            out["__comps__"] = jnp.stack(comps[:-1]) if cards_tag else (
+                jnp.zeros((0, ng), dtype=jnp.int32)
+            )
+            if has_time:
+                out["__bts__"] = (
+                    bts0 + comps[-1].astype(jnp.int64) * step_q
+                )
+            return out
+
+        return kernel
 
     def _compile_agg(self, agg: FuncCall, ctx, ts_name: str | None,
                      seg_fn=segment_reduce):

@@ -441,6 +441,14 @@ class GreptimeDB(TableProvider):
         dt = self.cache.get(view)
         return dt, view.ts_bounds() or (0, 0)
 
+    def grid_table(self, table: str, plan: SelectPlan):
+        """Dense time-grid resident table (storage/grid.py) for eligible
+        single-region tables; (None, bounds) otherwise — the engine falls
+        back to the row-oriented DeviceTable path."""
+        view = self._table_view(table)
+        gt = self.cache.get_grid(view)
+        return gt, view.ts_bounds() or (0, 0)
+
     def host_columns(self, table: str, ts_range=(None, None)) -> dict:
         """Raw host scan for operators that run host-side (join matching)."""
         return self._table_view(table).scan_host(ts_range)

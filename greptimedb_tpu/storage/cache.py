@@ -30,6 +30,14 @@ from greptimedb_tpu.storage.region import Region
 
 _DICTS_VERSION = 0  # process-wide monotonic dict-content version
 
+
+def next_dicts_version() -> int:
+    """Shared monotonic version for dictionary-derived compiled constants
+    (used by both DeviceTable and GridTable builds)."""
+    global _DICTS_VERSION
+    _DICTS_VERSION += 1
+    return _DICTS_VERSION
+
 # One multi-hundred-MB device_put RPC can break the TPU relay tunnel
 # (observed: UNAVAILABLE mid-upload of a 34M-row table). Stream large
 # columns in bounded pieces instead; each piece completes before the
@@ -290,7 +298,8 @@ def extend_device_table(
 
 @dataclass
 class _Entry:
-    table: DeviceTable
+    # DeviceTable, GridTable, or None (negative grid-eligibility cache)
+    table: object
     delta_pos: int | None = None  # consumed append-log position
     live_rows: int = 0
 
@@ -389,13 +398,76 @@ class RegionCacheManager:
         self._shrink()
         return table
 
+    def get_grid(self, region):
+        """Dense-grid resident table for a region (storage/grid.py), or
+        None when the region is ineligible (cached negatively per
+        base_version so queries don't re-probe every time).  Pure appends
+        extend the resident grid device-side; structure changes rebuild."""
+        from greptimedb_tpu.storage.grid import (
+            build_grid_table, extend_grid_table,
+        )
+
+        base_ver = getattr(region, "base_version", None)
+        append_log = getattr(region, "_append_log", None)
+        if base_ver is None or append_log is None:
+            return None  # duck-typed views (joins, staged scans): row path
+        key = (region.region_id, "grid", base_ver)
+        entry = self._lru.get(key)
+        if entry is not None:
+            if entry.delta_pos == len(append_log):
+                self.hits += 1
+                self._lru.move_to_end(key)
+                return entry.table
+            if entry.table is None:
+                # negative entry: re-probe only after substantial growth —
+                # an ineligible (irregular/sparse) region must not pay a
+                # full eligibility scan per query
+                appended = sum(
+                    len(c[TSID]) for c in append_log[entry.delta_pos:]
+                )
+                if appended <= max(self.min_extend_rows,
+                                   entry.live_rows * self.rebuild_fraction):
+                    return None
+            else:
+                chunks = append_log[entry.delta_pos:]
+                self.extends += 1
+                self._bytes -= entry.table.nbytes()
+                extended = extend_grid_table(entry.table, region, chunks)
+                if extended is not None:
+                    entry.table = extended
+                    entry.delta_pos = len(append_log)
+                    self._bytes += entry.table.nbytes()
+                    self._lru.move_to_end(key)
+                    self._shrink()
+                    return entry.table
+                self._bytes += entry.table.nbytes()  # undo; evict next
+            self._evict(key)  # delta does not fit the resident shape
+
+        self.misses += 1
+        table = build_grid_table(region)
+        rows_now = region.memtable.num_rows + sum(
+            m.num_rows for m in region.sst_files
+        )
+        entry = _Entry(table, delta_pos=len(append_log), live_rows=rows_now)
+        stale = [
+            k for k in self._lru
+            if k[0] == key[0] and k[1:2] == ("grid",) and k[2] != base_ver
+        ]
+        for k in stale:
+            self._evict(k)
+        self._lru[key] = entry
+        if table is not None:
+            self._bytes += table.nbytes()
+        self._shrink()
+        return table
+
     def _shrink(self) -> None:
         while self._bytes > self.capacity and len(self._lru) > 1:
             self._evict(next(iter(self._lru)))
 
     def _evict(self, key) -> None:
         e = self._lru.pop(key, None)
-        if e is not None:
+        if e is not None and e.table is not None:
             self._bytes -= e.table.nbytes()
 
     def invalidate_region(self, region_id: int) -> None:

@@ -1,0 +1,319 @@
+"""Dense time-grid resident layout: [series, time, field] tensors.
+
+The TPU-first answer to the reference's two hot-loop layouts — mito2's
+(tsid, ts)-sorted row batches (src/mito2/src/read/seq_scan.rs) and the
+PromQL RangeArray dictionary-range view (src/promql/src/range_array.rs:65).
+Metric data is (near-)regularly sampled, so instead of sorting rows and
+scatter-reducing group aggregates, the region materializes a dense
+``values[series, timestep, field]`` tensor plus a ``valid[series,
+timestep]`` mask.  Aggregation by (tags × time bucket) then lowers to
+reshape + reduce — no scatter, no gather, no sort — which is the shape
+XLA:TPU tiles perfectly onto the VPU/MXU and which even a single CPU core
+executes at memory bandwidth (SURVEY.md §5.7: "blockwise windowed
+evaluation replaces RangeArray with gather-free rolling windows").
+
+Eligibility is decided per region build: timestamps must share a coarse
+enough GCD step (regular sampling), the dense grid must fit the byte
+budget, and occupancy must clear a floor.  Irregular/sparse data keeps the
+row-oriented DeviceTable path (storage/cache.py) — the grid is a second
+resident representation, not a replacement.
+
+Incremental protocol mirrors the DeviceTable one: pure time-forward
+appends scatter into the padded tail of the resident tensors device-side;
+structure changes (flush/compaction/upsert) rebuild.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from greptimedb_tpu.storage.memtable import OP, OP_DELETE, SEQ, TSID
+
+# padding granularity: each distinct (Spad, Tpad) is a compile shape class.
+# T gets coarse alignment (appends grow it constantly); S changes rarely.
+_T_ALIGN = 2048
+_S_ALIGN = 256
+_MIN_DENSITY = float(os.environ.get("GREPTIME_GRID_MIN_DENSITY", "0.1"))
+_BUDGET = int(os.environ.get("GREPTIME_GRID_BUDGET_BYTES", str(6 << 30)))
+# stream uploads in bounded pieces (same rationale as cache._to_device:
+# one huge device_put RPC can wedge the TPU relay tunnel)
+_UPLOAD_CHUNK_BYTES = 64 << 20
+
+
+def _pad_to(n: int, align: int) -> int:
+    """Small sizes get pow2 buckets, larger ones align to ``align``."""
+    if n <= 0:
+        return align if align < 64 else 64
+    if n < align:
+        return 1 << max(6, (n - 1).bit_length())
+    return -(-n // align) * align
+
+
+def _to_device_rows(arr: np.ndarray) -> jnp.ndarray:
+    """Chunked host→device upload (relay-safe): flatten, stream bounded
+    pieces, reshape on device (free — same layout)."""
+    if arr.nbytes <= _UPLOAD_CHUNK_BYTES:
+        return jnp.asarray(arr)
+    flat = arr.reshape(-1)
+    per = max(1, _UPLOAD_CHUNK_BYTES // max(1, arr.dtype.itemsize))
+    parts = []
+    for i in range(0, flat.shape[0], per):
+        p = jax.device_put(flat[i:i + per])
+        p.block_until_ready()
+        parts.append(p)
+    out = jnp.concatenate(parts).reshape(arr.shape)
+    out.block_until_ready()
+    return out
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class GridTable:
+    """One region's dense-grid resident tensors.
+
+    ts of grid point t = ``ts0 + t * step`` for t < ``nt``; padding points
+    (t >= nt) and padding series (s >= num_series) have valid=False.
+    """
+
+    values: jnp.ndarray              # [C, Spad, Tpad] float32 — field-major
+    # planes keep the time axis contiguous, so per-bucket reductions and
+    # rolling windows vectorize along memory order on both CPU and TPU
+    valid: jnp.ndarray               # [Spad, Tpad] bool
+    tag_codes: dict[str, jnp.ndarray]  # per-tag [Spad] int32 (pad = -1)
+    ts0: int
+    step: int
+    nt: int                          # live timesteps
+    num_series: int                  # live series
+    field_names: tuple               # C order (float FIELD columns)
+    dicts: dict[str, list] = field(default_factory=dict)
+    # per-field "no NaN observed at build": count() can reuse the shared
+    # validity reduction instead of a per-field isnan pass
+    no_nan: tuple = ()
+    dicts_version: int = 0
+
+    @property
+    def spad(self) -> int:
+        return int(self.valid.shape[0])
+
+    @property
+    def tpad(self) -> int:
+        return int(self.valid.shape[1])
+
+    def nbytes(self) -> int:
+        total = self.values.nbytes + self.valid.nbytes
+        for v in self.tag_codes.values():
+            total += v.nbytes
+        return total
+
+    def tree_flatten(self):
+        names = sorted(self.tag_codes)
+        children = (self.values, self.valid) + tuple(
+            self.tag_codes[n] for n in names
+        )
+        aux = (
+            tuple(names), self.ts0, self.step, self.nt, self.num_series,
+            self.field_names,
+            tuple((k, tuple(v)) for k, v in sorted(self.dicts.items())),
+            self.no_nan, self.dicts_version,
+        )
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        (names, ts0, step, nt, ns, fields, dict_items, no_nan, dver) = aux
+        values, valid = children[0], children[1]
+        tags = dict(zip(names, children[2:]))
+        return cls(values, valid, tags, ts0, step, nt, ns, fields,
+                   {k: list(v) for k, v in dict_items}, no_nan, dver)
+
+
+def grid_float_fields(schema) -> list[str]:
+    return [c.name for c in schema.field_columns if c.dtype.is_float]
+
+
+def _series_tag_matrix(region, spad: int) -> dict[str, np.ndarray]:
+    """Per-tag code arrays indexed by tsid, padded with the poison code -1."""
+    tags = region.tag_names
+    s = region.num_series
+    out = {name: np.full(spad, -1, dtype=np.int32) for name in tags}
+    for key, tsid in region._series.items():
+        for j, name in enumerate(tags):
+            out[name][tsid] = key[j]
+    return out
+
+
+def _gather_parts(region, fields: list[str]):
+    """Region parts (SSTs then memtable chunks) in last-write-wins order.
+
+    SSTs sort by seq_max: flush emits monotonically increasing sequence
+    ranges, and TWCS-compacted files never share (series, ts) keys with
+    files of other time windows, so per-key ordering reduces to per-file
+    ordering.  Memtable chunks follow in append order.
+    """
+    from greptimedb_tpu.storage.sst import read_sst
+
+    ts_name = region.ts_name
+    want = [ts_name, TSID, SEQ, OP] + fields
+    parts = []
+    for m in sorted(region.sst_files, key=lambda m: m.seq_max):
+        parts.append(read_sst(region.store, m, region.schema, columns=want))
+    for chunk in region.memtable.snapshot_chunks():
+        # within-chunk duplicates resolve by scatter order (later row wins),
+        # matching keep-max-seq: rows in a chunk share one sequence and
+        # arrive in insert order
+        parts.append(chunk)
+    return parts
+
+
+def infer_grid_step(parts, ts_name: str, ts0: int) -> int:
+    """GCD of (ts - ts0) across all rows — one vectorized pass, no sort."""
+    g = np.int64(0)
+    for p in parts:
+        ts = p[ts_name]
+        if len(ts):
+            g = np.gcd(g, np.gcd.reduce(ts.astype(np.int64) - ts0))
+    return int(g)
+
+
+def build_grid_table(region, budget_bytes: int | None = None):
+    """Attempt the dense-grid build; returns None when ineligible
+    (irregular sampling, too sparse, over budget, stringly fields only)."""
+    fields = grid_float_fields(region.schema)
+    if not fields or region.schema.time_index is None:
+        return None
+    if region.options.append_mode:
+        # append mode preserves duplicate (series, ts) rows; the grid is
+        # keyed by (series, timestep) and would silently dedup them
+        return None
+    bounds = region.ts_bounds()
+    if bounds is None:
+        return None  # empty region: nothing to accelerate
+    ts0, ts_max = bounds
+    s = region.num_series
+    if s == 0:
+        return None
+    budget = budget_bytes if budget_bytes is not None else _BUDGET
+    c = len(fields)
+    ts_name = region.ts_name
+
+    parts = _gather_parts(region, fields)
+    total_rows = sum(len(p[TSID]) for p in parts)
+    if total_rows == 0:
+        return None
+    step = infer_grid_step(parts, ts_name, ts0)
+    if step <= 0:
+        step = 1  # single distinct timestamp
+    nt = (ts_max - ts0) // step + 1
+    spad = _pad_to(s, _S_ALIGN)
+    tpad = _pad_to(nt, _T_ALIGN)
+    grid_bytes = spad * tpad * (4 * c + 1)
+    if grid_bytes > budget:
+        return None
+    if total_rows / max(s * nt, 1) < _MIN_DENSITY:
+        return None
+
+    values = np.full((c, spad, tpad), np.nan, dtype=np.float32)
+    valid = np.zeros((spad, tpad), dtype=bool)
+    no_nan = [True] * c
+    for p in parts:
+        tsid = p[TSID].astype(np.int64)
+        if not len(tsid):
+            continue
+        tidx = (p[ts_name].astype(np.int64) - ts0) // step
+        for ci, name in enumerate(fields):
+            col = p[name]
+            if col.dtype != np.float32:
+                col = col.astype(np.float32)
+            # conservative: tombstone rows (null fields) may clear no_nan
+            # — costs one extra isnan pass at query time, never wrong
+            if no_nan[ci] and bool(np.isnan(col).any()):
+                no_nan[ci] = False
+            values[ci][tsid, tidx] = col
+        op = p[OP]
+        valid[tsid, tidx] = op != OP_DELETE
+    tag_codes = _series_tag_matrix(region, spad)
+    dicts = {name: region.encoders[name].values() for name in region.tag_names}
+    from greptimedb_tpu.storage.cache import next_dicts_version
+
+    return GridTable(
+        values=_to_device_rows(values),
+        valid=_to_device_rows(valid),
+        tag_codes={k: jnp.asarray(v) for k, v in tag_codes.items()},
+        ts0=int(ts0),
+        step=int(step),
+        nt=int(nt),
+        num_series=s,
+        field_names=tuple(fields),
+        dicts=dicts,
+        no_nan=tuple(no_nan),
+        dicts_version=next_dicts_version(),
+    )
+
+
+def extend_grid_table(table: GridTable, region, chunks):
+    """Scatter pure-append chunks into the resident grid device-side.
+
+    Returns the extended GridTable, or None when the delta does not fit
+    the resident shape/step (caller rebuilds).  Precondition (enforced by
+    Region's append log): chunks are PUT-only with strictly newer
+    timestamps, so no resident cell is overwritten — only new cells are
+    set."""
+    ts_name = region.ts_name
+    fields = table.field_names
+    new_series = region.num_series
+    if new_series > table.spad:
+        return None
+    tsid = np.concatenate([c[TSID] for c in chunks]).astype(np.int64)
+    if not len(tsid):
+        return table
+    ts = np.concatenate(
+        [np.asarray(c[ts_name], dtype=np.int64) for c in chunks]
+    )
+    rel = ts - table.ts0
+    step = table.step
+    if step <= 0 or bool((rel % step != 0).any()):
+        return None  # off-grid timestamps: sampling changed
+    tidx = rel // step
+    new_nt = int(tidx.max()) + 1
+    if new_nt > table.tpad:
+        return None
+    cols = []
+    no_nan = list(table.no_nan)
+    for ci, name in enumerate(fields):
+        col = np.concatenate(
+            [np.asarray(c[name], dtype=np.float32) for c in chunks]
+        )
+        if no_nan[ci] and bool(np.isnan(col).any()):
+            no_nan[ci] = False
+        cols.append(col)
+    delta = np.stack(cols, axis=0)  # [C, n]
+    values = table.values.at[
+        :, jnp.asarray(tsid), jnp.asarray(tidx)
+    ].set(jnp.asarray(delta))
+    valid = table.valid.at[jnp.asarray(tsid), jnp.asarray(tidx)].set(True)
+    tag_codes = table.tag_codes
+    if new_series > table.num_series:
+        host_tags = _series_tag_matrix(region, table.spad)
+        tag_codes = {k: jnp.asarray(v) for k, v in host_tags.items()}
+    from greptimedb_tpu.storage.cache import next_dicts_version
+
+    return GridTable(
+        values=values,
+        valid=valid,
+        tag_codes=tag_codes,
+        ts0=table.ts0,
+        step=step,
+        nt=max(table.nt, new_nt),
+        num_series=new_series,
+        field_names=fields,
+        dicts={name: region.encoders[name].values()
+               for name in region.tag_names},
+        no_nan=tuple(no_nan),
+        dicts_version=next_dicts_version(),
+    )

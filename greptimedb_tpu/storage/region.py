@@ -146,15 +146,17 @@ class Region:
             # hash-factorize (O(n), no object-array sort): tag columns
             # repeat heavily, so python cost is paid per UNIQUE value only
             inv, uniq = pd.factorize(vals, use_na_sentinel=False)
-            if any(not isinstance(v, str) for v in uniq):
+            if any(
+                v is None or (isinstance(v, float) and v != v) for v in uniq
+            ):
                 # NULL tags (None/NaN from factorize) encode as "" — the
                 # device dictionary space has no null representation (same
-                # rule as add_tag_column backfill); non-string scalars
-                # stringify so a poisoned vocab can never wedge flush
+                # rule as add_tag_column backfill); a None in the vocab
+                # would wedge every subsequent flush.  Integer-typed tags
+                # pass through untouched.
                 uniq = np.array(
                     ["" if v is None or (isinstance(v, float) and v != v)
-                     else str(v) if not isinstance(v, str) else v
-                     for v in uniq], dtype=object)
+                     else v for v in uniq], dtype=object)
             codes = np.fromiter(
                 (enc.get_or_insert(v) for v in uniq), dtype=np.int64,
                 count=len(uniq),

@@ -1,0 +1,174 @@
+"""Dense time-grid executor: equivalence vs the row-oriented path.
+
+Every query here runs twice — grid path (default) and GREPTIME_GRID=off
+(row DeviceTable path) — on the same data; results must agree.  The row
+path is itself golden-tested, so agreement pins the grid kernels.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.query.physical import DISPATCH_STATS
+from greptimedb_tpu.standalone import GreptimeDB
+
+
+def _rows(res):
+    return sorted(
+        res.rows, key=lambda r: tuple("" if v is None else str(v) for v in r)
+    )
+
+
+def _assert_rows_close(a, b, sql):
+    assert len(a) == len(b), f"{len(a)} vs {len(b)} rows: {sql}"
+    for ra, rb in zip(a, b):
+        assert len(ra) == len(rb), sql
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) or isinstance(vb, float):
+                # f32 accumulation order differs between the reshape
+                # reduction and the scatter reduction
+                assert va == pytest.approx(vb, rel=2e-5, abs=1e-5), (
+                    f"{va} vs {vb}: {sql}")
+            else:
+                assert va == vb, f"{va} vs {vb}: {sql}"
+
+
+def run_both(db, sql, expect_grid=True):
+    before = DISPATCH_STATS["grid"]
+    r_grid = db.sql(sql)
+    used = DISPATCH_STATS["grid"] > before
+    assert used == expect_grid, (
+        f"grid used={used}, expected {expect_grid}: {sql}"
+    )
+    os.environ["GREPTIME_GRID"] = "off"
+    try:
+        r_row = db.sql(sql)
+    finally:
+        os.environ.pop("GREPTIME_GRID", None)
+    assert r_grid.column_names == r_row.column_names, sql
+    _assert_rows_close(_rows(r_grid), _rows(r_row), sql)
+    return r_grid
+
+
+@pytest.fixture
+def db(tmp_path):
+    d = GreptimeDB(str(tmp_path / "g"))
+    d.sql(
+        "CREATE TABLE cpu (host STRING, dc STRING, "
+        "ts TIMESTAMP(3) TIME INDEX, usage DOUBLE, mem DOUBLE, "
+        "PRIMARY KEY (host, dc))"
+    )
+    rng = np.random.default_rng(3)
+    rows = []
+    t0 = 1700000000000
+    for k in range(240):  # 240 steps @ 5s for 6 hosts: regular cadence
+        for h in range(6):
+            u = round(float(rng.uniform(0, 100)), 3)
+            m = "NULL" if (k * 6 + h) % 17 == 0 else round(
+                float(rng.uniform(0, 64)), 3)
+            rows.append(
+                f"('h{h}','dc{h % 2}',{t0 + k * 5000},{u},{m})"
+            )
+    d.sql("INSERT INTO cpu VALUES " + ",".join(rows))
+    d._region_of("cpu").flush()
+    yield d
+    d.close()
+
+
+def test_double_groupby(db):
+    r = run_both(db, "SELECT host, date_trunc('minute', ts) AS m, "
+                     "avg(usage), avg(mem) FROM cpu GROUP BY host, m")
+    # 240 steps @5s = 1200s spanning 21 partial minutes (t0 not aligned)
+    assert r.num_rows == 6 * 21
+
+
+def test_key_order_time_first(db):
+    run_both(db, "SELECT date_trunc('minute', ts) AS m, host, avg(usage) "
+                 "FROM cpu GROUP BY m, host")
+
+
+def test_all_ops(db):
+    run_both(db, "SELECT dc, count(*), count(mem), sum(usage), min(mem), "
+                 "max(usage), avg(mem) FROM cpu GROUP BY dc")
+
+
+def test_global_agg(db):
+    r = run_both(db, "SELECT count(*), avg(usage) FROM cpu")
+    assert r.num_rows == 1
+
+
+def test_global_agg_empty_window(db):
+    r = run_both(db, "SELECT count(*), max(usage) FROM cpu WHERE ts < 5")
+    assert r.rows[0][0] == 0 and r.rows[0][1] is None
+
+
+def test_time_window_and_tag_filter(db):
+    run_both(db, "SELECT host, date_trunc('minute', ts) AS m, avg(usage) "
+                 "FROM cpu WHERE ts >= 1700000300000 AND ts < 1700000900000 "
+                 "AND dc = 'dc0' GROUP BY host, m")
+
+
+def test_field_predicate(db):
+    run_both(db, "SELECT host, count(*) FROM cpu WHERE usage > 50 "
+                 "GROUP BY host")
+
+
+def test_expression_agg(db):
+    run_both(db, "SELECT host, avg(usage + mem), sum(usage * 2) "
+                 "FROM cpu GROUP BY host")
+
+
+def test_unaligned_window_start(db):
+    # window start not aligned to the minute buckets nor the 5s grid
+    run_both(db, "SELECT date_trunc('minute', ts) AS m, sum(usage) "
+                 "FROM cpu WHERE ts >= 1700000302000 GROUP BY m")
+
+
+def test_delete_excluded(db):
+    db.sql("DELETE FROM cpu WHERE host = 'h1' AND dc = 'dc1' "
+           "AND ts = 1700000000000")
+    run_both(db, "SELECT host, count(*) FROM cpu GROUP BY host")
+
+
+def test_append_extension(db):
+    # first query builds the grid; appends then extend it device-side
+    run_both(db, "SELECT host, count(*) FROM cpu GROUP BY host")
+    t = 1700000000000 + 240 * 5000
+    db.sql(f"INSERT INTO cpu VALUES ('h0','dc0',{t},50.0,32.0),"
+           f"('h6','dc0',{t},60.0,16.0)")  # h6 = new series
+    r = run_both(db, "SELECT host, count(*) FROM cpu GROUP BY host")
+    counts = dict((row[0], row[1]) for row in r.rows)
+    assert counts["h6"] == 1 and counts["h0"] == 241
+
+
+def test_irregular_falls_back(tmp_path):
+    db = GreptimeDB(str(tmp_path / "i"))
+    db.sql("CREATE TABLE ev (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+           "v DOUBLE, PRIMARY KEY (h))")
+    rng = np.random.default_rng(5)
+    t = 1700000000000
+    vals = []
+    for _ in range(500):
+        t += int(rng.integers(1, 50000))  # ragged millisecond gaps
+        vals.append(f"('x',{t},{float(rng.uniform())})")
+    db.sql("INSERT INTO ev VALUES " + ",".join(vals))
+    db._region_of("ev").flush()
+    run_both(db, "SELECT h, count(*), avg(v) FROM ev GROUP BY h",
+             expect_grid=False)
+    db.close()
+
+
+def test_unsupported_aggs_fall_back(db):
+    run_both(db, "SELECT host, count(DISTINCT dc) FROM cpu GROUP BY host",
+             expect_grid=False)
+    run_both(db, "SELECT host, stddev(usage) FROM cpu GROUP BY host",
+             expect_grid=False)
+
+
+def test_grid_vs_row_after_flush_cycles(db):
+    # second flush (structure change) → grid rebuild on next query
+    t = 1700000000000 + 300 * 5000
+    db.sql(f"INSERT INTO cpu VALUES ('h2','dc0',{t},10.0,1.0)")
+    db._region_of("cpu").flush()
+    run_both(db, "SELECT host, max(usage) FROM cpu GROUP BY host")
