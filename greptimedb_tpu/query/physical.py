@@ -410,12 +410,17 @@ class Executor:
                     (str(agg), op, compile_device(arg, ctx), no_nan_plain)
                 )
             where_fn = None
+            where_series = False
             if plan.where is not None:
                 refs = set()
                 referenced_columns(plan.where, ctx, refs)
                 tags = {c.name for c in ctx.schema.tag_columns}
                 if not refs <= gridcols | tags | {ts_name}:
                     return None
+                # tag-only predicates reduce to a per-series [S] mask that
+                # multiplies the already-reduced [S, NB] partials — the
+                # big [S, T] reduce itself stays mask-free
+                where_series = refs <= tags
                 where_fn = compile_device(plan.where, ctx)
         except (PlanError, Unsupported):
             return None
@@ -443,13 +448,40 @@ class Executor:
             step_q = 0
             bts0 = np.int64(0)
 
+        # window slicing: restrict the reduce to the buckets the query's
+        # time range touches.  The slice START is a traced argument (so
+        # rolling windows reuse one compiled kernel); the slice WIDTH is
+        # static per window-length class.  Only an in-bounds, bucket-
+        # aligned slice qualifies — otherwise the kernel pads the full
+        # axis exactly as before.
+        b_lo = 0
+        s0 = 0
+        nbw, w_raw, pad_l, pad_r = nb, grid.tpad, pad_left, (
+            nb * r - pad_left - grid.tpad
+        )
+        if time_keys and lo is not None and hi is not None and step_q > 0:
+            cand_lo = max(0, int((lo - int(bts0)) // step_q))
+            cand_hi = min(nb, int(-(-(hi - int(bts0)) // step_q)))
+            if cand_hi <= cand_lo:
+                cand_hi = cand_lo + 1
+            raw0 = cand_lo * r - pad_left
+            raw1 = (cand_hi - cand_lo) * r + raw0
+            if raw0 >= 0 and raw1 <= grid.tpad:
+                b_lo, s0 = cand_lo, raw0
+                nbw, w_raw = cand_hi - cand_lo, raw1 - raw0
+                pad_l = pad_r = 0
+
         cards_tag = [
             _pow2(max(len(ctx.encoders[k.column]), 1)) for k in tag_keys
         ]
         ngt = 1
         for c in cards_tag:
             ngt *= c
-        if ngt * nb > DENSE_LIMIT:
+        if ngt * nbw > DENSE_LIMIT:
+            return None
+        if r >= (1 << 24):
+            # per-(series, bucket) counts ride an f32 einsum, exact only
+            # below 2^24; absurdly wide buckets take the row path
             return None
         DISPATCH_STATS["grid"] += 1
 
@@ -459,17 +491,17 @@ class Executor:
         tag_order = tuple(sorted(grid.tag_codes))
         cache_key = (
             "grid", plan.fingerprint(), grid.spad, grid.tpad,
-            grid.field_names, grid.ts0, g_step, r, pad_left, nb,
-            tuple(cards_tag), dict_ver, grid.no_nan, bool(time_keys),
-            tag_order,
+            grid.field_names, grid.ts0, g_step, r, nbw, w_raw, pad_l,
+            pad_r, tuple(cards_tag), dict_ver, grid.no_nan,
+            bool(time_keys), tag_order, where_series,
         )
         kernel = self._cache.get(cache_key)
         if kernel is None:
             kernel = self._build_grid_kernel(
                 grid.field_names, ts_name, tag_order,
                 [k.column for k in tag_keys], cards_tag,
-                bool(time_keys), r, pad_left, nb, step_q,
-                where_fn, specs, grid.ts0, g_step,
+                bool(time_keys), r, nbw, w_raw, pad_l, pad_r, step_q,
+                where_fn, where_series, specs, grid.ts0, g_step,
             )
             self._cache[cache_key] = kernel
         ts_lo = np.int64(lo) if lo is not None else _I64_MIN
@@ -477,7 +509,8 @@ class Executor:
         out = kernel(
             grid.values, grid.valid,
             tuple(grid.tag_codes[t] for t in tag_order),
-            ts_lo, ts_hi, bts0,
+            ts_lo, ts_hi, np.int64(int(bts0) + b_lo * step_q),
+            np.int32(s0),
         )
         out = {k: np.asarray(v) for k, v in out.items()}
 
@@ -504,44 +537,102 @@ class Executor:
 
     def _build_grid_kernel(
         self, field_names, ts_name, tag_order, tag_cols, cards_tag, has_time,
-        r, pad_left, nb, step_q, where_fn, specs, ts0, g_step,
+        r, nbw, w_raw, pad_l, pad_r, step_q, where_fn, where_series, specs,
+        ts0, g_step,
     ):
+        """Kernel over the sliced query window [s0, s0 + w_raw).
+
+        Two structural wins over the old full-axis masked reduce:
+        (1) the reduce reads only the window's buckets — a dynamic slice
+        with traced start / static width, so rolling windows reuse one
+        compiled kernel; (2) zero-filled invalid cells (storage/grid.py)
+        mean the values plane is read exactly once with NO elementwise
+        mask in the common case (plain no-NaN columns, tag-only or absent
+        WHERE) — the ts-range indicator rides a tiny [NB, R] weight
+        matrix whose broadcast multiply fuses into the reduce for ~free
+        (measured: masked where() path 526 ms vs 155 ms pure on the TSBS
+        window; this formulation hits ~same-as-pure)."""
         ngt = 1
         for c in cards_tag:
             ngt *= c
+        nb = nbw
 
         @jax.jit
-        def kernel(values, valid, tag_arrays, ts_lo, ts_hi, bts0):
+        def kernel(values, valid, tag_arrays, ts_lo, ts_hi, bts0, s0):
             # raw arrays, not the GridTable pytree: the pytree's aux data
             # (nt, dicts, …) changes on every append extension and would
             # force a retrace; the arrays' shapes are the real shape class
-            spad, tpad = valid.shape
+            spad = valid.shape[0]
             tag_codes = dict(zip(tag_order, tag_arrays))
-            ts_axis = ts0 + jnp.arange(tpad, dtype=jnp.int64) * g_step
+
+            def sl(x):
+                return jax.lax.dynamic_slice_in_dim(
+                    x, s0, w_raw, axis=x.ndim - 1
+                )
+
+            valid_w = sl(valid)
+            ts_axis = ts0 + (
+                s0.astype(jnp.int64) + jnp.arange(w_raw, dtype=jnp.int64)
+            ) * g_step
             env = {
-                name: values[ci]  # [S, T] plane, time contiguous
+                name: sl(values[ci])  # [S, W] plane, time contiguous
                 for ci, name in enumerate(field_names)
             }
             for tname, codes in tag_codes.items():
                 env[tname] = codes[:, None]
             env[ts_name] = ts_axis[None, :]
-            v2 = valid & ((ts_axis >= ts_lo) & (ts_axis < ts_hi))[None, :]
+            tmask = (ts_axis >= ts_lo) & (ts_axis < ts_hi)  # [W]
+
+            def padlast(x, fill):
+                if pad_l == 0 and pad_r == 0:
+                    return x
+                widths = [(0, 0)] * (x.ndim - 1) + [(pad_l, pad_r)]
+                return jnp.pad(x, widths, constant_values=fill)
+
+            # per-timestep weights in bucket layout (tiny): w4 carries the
+            # ts-range indicator; ones4 is pure bucket structure for paths
+            # whose elementwise mask already includes the range
+            w4 = padlast(tmask.astype(jnp.float32), 0.0).reshape(nb, r)
+            ones4 = padlast(
+                jnp.ones((w_raw,), jnp.float32), 0.0
+            ).reshape(nb, r)
+
+            def bdot(x, w):
+                """[S, W] → [S, NB] f32: weighted bucket reduction.  The
+                broadcast multiply fuses into the reduce (measured ~free
+                vs the plain reduce on XLA:CPU; a dot_general here is 5x
+                slower when the operand is a dynamic-slice fusion)."""
+                xp = padlast(x.astype(jnp.float32), 0.0)
+                return (xp.reshape(x.shape[0], nb, r) * w).sum(axis=-1)
+
+            # tag-only WHERE: one [S] mask multiplied into the reduced
+            # [S, NB] partials — the big reduce stays mask-free
+            smf = None
+            elementwise = False
             if where_fn is not None:
-                v2 = v2 & jnp.broadcast_to(where_fn(env), v2.shape)
+                if where_series:
+                    env_s = {t: c for t, c in tag_codes.items()}
+                    smf = jnp.broadcast_to(
+                        where_fn(env_s), (spad,)
+                    ).astype(jnp.float32)
+                else:
+                    elementwise = True
 
-            pad_right = nb * r - pad_left - tpad
+            v2 = None
 
-            def breduce(x, fill, mode):
-                """[…, S, T] → […, S, NB]: per-bucket reduction over the
-                CONTIGUOUS time axis (vectorizes along memory order)."""
-                widths = [(0, 0)] * (x.ndim - 1) + [(pad_left, pad_right)]
-                xp = jnp.pad(x, widths, constant_values=fill)
-                xp = xp.reshape(x.shape[:-1] + (nb, r))
-                if mode == "sum":
-                    return xp.sum(axis=-1)
-                if mode == "min":
-                    return xp.min(axis=-1)
-                return xp.max(axis=-1)
+            def get_v2():
+                """Elementwise liveness mask [S, W]; built only for paths
+                that cannot ride the mask-free einsum (WHERE touching
+                fields/ts, NaN-bearing columns, min/max)."""
+                nonlocal v2
+                if v2 is None:
+                    m = valid_w & tmask[None, :]
+                    if elementwise:
+                        m = m & jnp.broadcast_to(where_fn(env), m.shape)
+                    elif smf is not None:
+                        m = m & (smf > 0)[:, None]
+                    v2 = m
+                return v2
 
             # series → tag-group ids (poison -1 → routed to segment ngt)
             if tag_cols:
@@ -554,66 +645,73 @@ class Executor:
             ).astype(jnp.int32)
 
             def gseg(x, segf=jax.ops.segment_sum):
-                """[…, S, NB] → [ngt, …, NB]: series-axis merge (tiny)."""
-                lead = jnp.moveaxis(x, -2, 0) if x.ndim > 2 else x
-                return segf(lead, ids, num_segments=ngt + 1)[:ngt]
+                """[S, NB] → [ngt, NB]: series-axis merge (tiny)."""
+                return segf(x, ids, num_segments=ngt + 1)[:ngt]
 
-            cnt_all_sb = breduce(v2.astype(jnp.int32), 0, "sum")
+            # shared count: per-(series, bucket) counts are ≤ R < 2^24 so
+            # the f32 einsum is exact; the series merge runs in int64
+            if elementwise:
+                cnt_all_sb = bdot(get_v2(), ones4)
+            else:
+                cnt_all_sb = bdot(valid_w, w4)
+                if smf is not None:
+                    cnt_all_sb = cnt_all_sb * smf[:, None]
             cnt_all = gseg(cnt_all_sb.astype(jnp.int64))  # [ngt, NB]
-
-            # assemble per-class stacks along axis 0 (planes stay [S, T])
-            sum_items, min_items, max_items = [], [], []
-            cnt_items = []  # args needing their own (non-shared) count
-            for name, op, arg_fn, no_nan_plain in specs:
-                if op == "count" and arg_fn is None:
-                    continue  # count(*): shared cnt_all
-                x = jnp.broadcast_to(
-                    jnp.asarray(arg_fn(env), dtype=jnp.float32),
-                    (spad, tpad),
-                )
-                m = v2 if no_nan_plain else (v2 & ~jnp.isnan(x))
-                shared_cnt = no_nan_plain
-                if op in ("sum", "mean"):
-                    sum_items.append((name, x, m))
-                elif op == "min":
-                    min_items.append((name, x, m))
-                elif op == "max":
-                    max_items.append((name, x, m))
-                if (op in ("mean", "count", "min", "max")
-                        and not shared_cnt):
-                    cnt_items.append((name, m))
 
             out = {}
             cnts: dict[str, jnp.ndarray] = {}
-            if cnt_items:
-                M = jnp.stack([m for _n, m in cnt_items], axis=0)
-                cg = gseg(
-                    breduce(M.astype(jnp.int32), 0, "sum").astype(jnp.int64)
-                )  # [ngt, K, NB]
-                for j, (name, _m) in enumerate(cnt_items):
-                    cnts[name] = cg[:, j]
             sums: dict[str, jnp.ndarray] = {}
-            if sum_items:
-                X = jnp.stack(
-                    [jnp.where(m, x, 0.0) for _n, x, m in sum_items], axis=0
+            min_items, max_items, cnt_items = [], [], []
+            for name, op, arg_fn, no_nan_plain in specs:
+                if op == "count" and (arg_fn is None or no_nan_plain):
+                    continue  # resolves to the shared cnt_all
+                x = jnp.broadcast_to(
+                    jnp.asarray(arg_fn(env), dtype=jnp.float32),
+                    (spad, w_raw),
                 )
-                sg = gseg(breduce(X, 0.0, "sum"))  # [ngt, K, NB]
-                for j, (name, _x, _m) in enumerate(sum_items):
-                    sums[name] = sg[:, j]
+                if op in ("sum", "mean"):
+                    if no_nan_plain and not elementwise:
+                        # fast path: zero-filled invalid cells contribute
+                        # +0 — raw plane straight into the einsum
+                        sb = bdot(x, w4)
+                        if smf is not None:
+                            sb = sb * smf[:, None]
+                    else:
+                        m = get_v2() if no_nan_plain else (
+                            get_v2() & ~jnp.isnan(x)
+                        )
+                        sb = bdot(jnp.where(m, x, 0.0), ones4)
+                        if not no_nan_plain:
+                            cnt_items.append((name, m))
+                    sums[name] = gseg(sb)
+                else:
+                    m = get_v2() if no_nan_plain else (
+                        get_v2() & ~jnp.isnan(x)
+                    )
+                    if op == "min":
+                        min_items.append((name, x, m))
+                    elif op == "max":
+                        max_items.append((name, x, m))
+                    if not no_nan_plain:
+                        cnt_items.append((name, m))
+
+            for name, m in cnt_items:
+                cnts[name] = gseg(bdot(m, ones4).astype(jnp.int64))
+
+            def breduce(x, fill, mode):
+                xp = padlast(x, fill).reshape(x.shape[:-1] + (nb, r))
+                return xp.min(axis=-1) if mode == "min" else xp.max(axis=-1)
+
             for items, mode, fill, segf in (
                 (min_items, "min", jnp.inf, jax.ops.segment_min),
                 (max_items, "max", -jnp.inf, jax.ops.segment_max),
             ):
-                if not items:
-                    continue
-                X = jnp.stack(
-                    [jnp.where(m, x, fill) for _n, x, m in items], axis=0
-                )
-                merged = gseg(breduce(X, fill, mode), segf)  # [ngt, K, NB]
-                for j, (name, _x, _m) in enumerate(items):
-                    v = merged[:, j]
+                for name, x, m in items:
+                    red = breduce(jnp.where(m, x, fill), fill, mode)
+                    merged = gseg(red, segf)
                     c = cnts.get(name, cnt_all)
-                    out[name] = jnp.where(c > 0, v, jnp.nan).reshape(-1)
+                    out[name] = jnp.where(c > 0, merged, jnp.nan).reshape(-1)
+
             for name, op, arg_fn, no_nan_plain in specs:
                 if name in out:
                     continue  # min/max already materialized

@@ -92,8 +92,9 @@ class GridTable:
     num_series: int                  # live series
     field_names: tuple               # C order (float FIELD columns)
     dicts: dict[str, list] = field(default_factory=dict)
-    # per-field "no NaN observed at build": count() can reuse the shared
-    # validity reduction instead of a per-field isnan pass
+    # per-field "finite everywhere written" (no NaN *or* ±inf): count()
+    # reuses the shared validity reduction, and sums may ride the
+    # mask-free weighted reduce (inf would break its 0-weight products)
     no_nan: tuple = ()
     dicts_version: int = 0
 
@@ -218,7 +219,13 @@ def build_grid_table(region, budget_bytes: int | None = None):
     if total_rows / max(s * nt, 1) < _MIN_DENSITY:
         return None
 
-    values = np.full((c, spad, tpad), np.nan, dtype=np.float32)
+    # zero-fill, not NaN: ``valid`` is the sole source of truth for cell
+    # liveness, so never-written cells contribute +0 to sums and the hot
+    # aggregate kernel can lower to a plain (mask-free) einsum/matmul —
+    # MXU-shaped on TPU, ~3x fewer bytes on CPU (no where() temp).  Cells
+    # holding a *written* NaN (tombstone fields, real NaN data) keep the
+    # NaN and clear ``no_nan``, which routes queries to the masked path.
+    values = np.zeros((c, spad, tpad), dtype=np.float32)
     valid = np.zeros((spad, tpad), dtype=bool)
     no_nan = [True] * c
     for p in parts:
@@ -226,17 +233,27 @@ def build_grid_table(region, budget_bytes: int | None = None):
         if not len(tsid):
             continue
         tidx = (p[ts_name].astype(np.int64) - ts0) // step
+        op = p[OP]
+        dels = op == OP_DELETE
+        any_dels = bool(dels.any())
         for ci, name in enumerate(fields):
             col = p[name]
             if col.dtype != np.float32:
                 col = col.astype(np.float32)
-            # conservative: tombstone rows (null fields) may clear no_nan
-            # — costs one extra isnan pass at query time, never wrong
-            if no_nan[ci] and bool(np.isnan(col).any()):
+            if any_dels:
+                # tombstones must land as 0.0 whatever their field payload
+                # (schema DEFAULTs fill deleted rows with non-zero values):
+                # the mask-free sum fast path relies on invalid cells
+                # contributing exactly +0
+                col = np.where(dels, np.float32(0.0), col)
+            # no_nan really means "finite everywhere written": written NaN
+            # breaks count-by-validity, and written ±inf would turn the
+            # fast path's inf*0 weight products into NaN — either routes
+            # the column to the masked kernel path
+            if no_nan[ci] and not bool(np.isfinite(col).all()):
                 no_nan[ci] = False
             values[ci][tsid, tidx] = col
-        op = p[OP]
-        valid[tsid, tidx] = op != OP_DELETE
+        valid[tsid, tidx] = ~dels
     tag_codes = _series_tag_matrix(region, spad)
     dicts = {name: region.encoders[name].values() for name in region.tag_names}
     from greptimedb_tpu.storage.cache import next_dicts_version
@@ -289,7 +306,7 @@ def extend_grid_table(table: GridTable, region, chunks):
         col = np.concatenate(
             [np.asarray(c[name], dtype=np.float32) for c in chunks]
         )
-        if no_nan[ci] and bool(np.isnan(col).any()):
+        if no_nan[ci] and not bool(np.isfinite(col).all()):
             no_nan[ci] = False
         cols.append(col)
     delta = np.stack(cols, axis=0)  # [C, n]

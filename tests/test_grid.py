@@ -172,3 +172,42 @@ def test_grid_vs_row_after_flush_cycles(db):
     db.sql(f"INSERT INTO cpu VALUES ('h2','dc0',{t},10.0,1.0)")
     db._region_of("cpu").flush()
     run_both(db, "SELECT host, max(usage) FROM cpu GROUP BY host")
+
+
+def test_delete_with_default_fill_excluded_from_sums(tmp_path):
+    # tombstone rows carry schema DEFAULT fills in their field payload;
+    # the mask-free sum fast path must not count them (review r4 finding)
+    db = GreptimeDB(str(tmp_path / "d"))
+    db.sql("CREATE TABLE m (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+           "v DOUBLE DEFAULT 2.0, PRIMARY KEY (h))")
+    t0 = 1700000000000
+    db.sql("INSERT INTO m VALUES " + ",".join(
+        f"('a',{t0 + k * 1000},10.0)" for k in range(50)))
+    db.sql(f"DELETE FROM m WHERE h = 'a' AND ts = {t0 + 10 * 1000}")
+    db._region_of("m").flush()
+    r = run_both(db, "SELECT h, sum(v), avg(v), count(v) FROM m GROUP BY h")
+    assert r.rows == [["a", 490.0, 10.0, 49]]
+    db.close()
+
+
+def test_inf_values_take_masked_path(tmp_path):
+    # written ±inf must not meet the 0/1 weight multiply (inf*0 = NaN)
+    db = GreptimeDB(str(tmp_path / "inf"))
+    db.sql("CREATE TABLE m (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+           "v DOUBLE, PRIMARY KEY (h))")
+    t0 = 1700000000000
+    vals = [f"('a',{t0 + k * 1000},1.0)" for k in range(50)]
+    vals[5] = f"('a',{t0 + 5000},1e39)"  # overflows f32 → inf in the grid
+    db.sql("INSERT INTO m VALUES " + ",".join(vals))
+    db._region_of("m").flush()
+    # window excludes the inf row: sums over [t0+10s, t0+50s) stay finite
+    r = run_both(
+        db,
+        f"SELECT h, sum(v), count(v) FROM m "
+        f"WHERE ts >= {t0 + 10000} AND ts < {t0 + 50000} GROUP BY h",
+    )
+    assert r.rows == [["a", 40.0, 40]]
+    # window including it yields inf (matches the row path semantics)
+    r2 = run_both(db, "SELECT h, sum(v) FROM m GROUP BY h")
+    assert r2.rows[0][1] == float("inf")
+    db.close()
