@@ -56,13 +56,19 @@ INGEST_BASELINE_ROWS_S = 326_839.28  # docs/benchmarks/tsbs/v0.12.0.md:15-20
 _ingest_rate: list[float] = []  # rows/s, filled by build_db on generation
 
 
+def _db_dir() -> str:
+    # scale-scoped: a reduced-scale TPU retry must never ingest into the
+    # full-scale table (mixed-scale data would corrupt every later run)
+    return os.path.join(DATA_DIR, f"db_{SCALE}_{HOURS}")
+
+
 def build_db():
     from greptimedb_tpu.standalone import GreptimeDB
     from greptimedb_tpu.storage.region import RegionOptions
 
-    marker = os.path.join(DATA_DIR, f"ready_{SCALE}_{HOURS}")
+    marker = os.path.join(_db_dir(), f"ready_{SCALE}_{HOURS}")
     db = GreptimeDB(
-        DATA_DIR,
+        _db_dir(),
         # hourly flushes into one 24h TWCS window re-merge the whole window
         # every 8 files — O(N^2) rewriting that ate the r02 budget. The
         # bench's TWCS window matches the flush cadence instead.
@@ -117,7 +123,7 @@ def build_db():
     # persist next to the ready marker: the CPU re-exec child (TPU died
     # mid-query) and post-generation SIGTERMs must still report the rate
     # this build actually measured
-    with open(os.path.join(DATA_DIR, "ingest_rate.json"), "w") as f:
+    with open(os.path.join(_db_dir(), "ingest_rate.json"), "w") as f:
         json.dump({"rows_per_s": rate}, f)
     with open(marker, "w") as f:
         f.write("ok")
@@ -128,25 +134,32 @@ _times: list[float] = []
 _warmup_times: list[float] = []  # SIGTERM fallback when no timed run finished
 _emitted = False
 _backend = "unknown"
+_phase = "startup"  # where a TPU death happened, for the diagnostic
 
 
 def _headline(times: list[float]) -> str:
     value = float(np.median(times))
-    return json.dumps({
+    line = {
         "metric": "tsbs_double_groupby_all_ms",
         "value": round(value, 2),
         "unit": "ms",
         "vs_baseline": round(value / BASELINE_MS, 4),
         "backend": _backend,
         "runs": len(times),
-    })
+        "scale": SCALE,
+    }
+    if SCALE != 4000:
+        # latency scales ~linearly in (series x window) volume on this
+        # bandwidth-bound kernel; note it so the number isn't misread
+        line["note"] = f"reduced scale {SCALE}/4000; not baseline-comparable"
+    return json.dumps(line)
 
 
 def _ingest_line() -> str | None:
     rate = _ingest_rate[0] if _ingest_rate else None
     if rate is None:
         try:  # measured by an earlier invocation of this same build
-            with open(os.path.join(DATA_DIR, "ingest_rate.json")) as f:
+            with open(os.path.join(_db_dir(), "ingest_rate.json")) as f:
                 rate = float(json.load(f)["rows_per_s"])
         except (OSError, ValueError, KeyError):
             return None
@@ -191,70 +204,205 @@ def _on_term(signum, frame):
 
 
 def probe_tpu(
-    timeout_s: int = int(os.environ.get("GREPTIME_BENCH_PROBE_S", "45")),
+    timeout_s: int = int(os.environ.get("GREPTIME_BENCH_PROBE_S", "60")),
 ) -> bool:
-    """Check the TPU backend responds (the axon relay can wedge; a hung
-    bench is worse than a CPU bench). Probe in a subprocess with timeout."""
+    """Check the TPU backend responds, CAPTURING the failure mode rather
+    than silently falling back (round-3 verdict item #1).  The probe
+    subprocess prints phase markers; on hang/death the partial output
+    says exactly how far it got (observed failure modes so far:
+    jax.devices() blocking indefinitely inside axon PJRT client init —
+    no error, the relay's claim leg never completes)."""
     import subprocess
 
     code = (
-        "import jax, jax.numpy as jnp;"
-        "x = jnp.ones((128,128));"
-        "(x @ x).block_until_ready();"
-        "print('ok')"
+        "import jax\n"
+        "print('phase: device discovery', flush=True)\n"
+        "print('devices:', jax.devices(), flush=True)\n"
+        "import jax.numpy as jnp\n"
+        "print('phase: 128x128 matmul', flush=True)\n"
+        "x = jnp.ones((128,128)); (x @ x).block_until_ready()\n"
+        "import numpy as np, jax as j\n"
+        "print('phase: 64MB upload', flush=True)\n"
+        "d = j.device_put(np.ones((1<<24,), np.float32))\n"
+        "d.block_until_ready()\n"
+        "print('probe ok', flush=True)\n"
     )
     try:
         r = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, timeout=timeout_s
+            [sys.executable, "-c", code], capture_output=True,
+            timeout=timeout_s,
         )
-        return b"ok" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
+        out, err, timed_out = r.stdout, r.stderr, False
+    except subprocess.TimeoutExpired as e:
+        out, err, timed_out = e.stdout or b"", e.stderr or b"", True
+    text = out.decode(errors="replace")
+    if b"probe ok" in out:
+        dev_line = next(
+            (l for l in text.splitlines() if l.startswith("devices:")), ""
+        )
+        if "CpuDevice" in dev_line:
+            # healthy jax but no accelerator: fall through to the CPU
+            # path WITHOUT arming the TPU-retry machinery
+            log(f"no TPU backend present ({dev_line.strip()})")
+            return False
+        return True
+    phases = [l for l in text.splitlines() if l.startswith("phase:")]
+    last = phases[-1] if phases else "(before device discovery)"
+    how = f"hung >{timeout_s}s" if timed_out else "died"
+    log(f"TPU DIAG: probe {how} at {last}")
+    tail = err.decode(errors="replace").strip().splitlines()[-6:]
+    for l in tail:
+        log(f"TPU DIAG: stderr: {l}")
+    return False
 
 
 def rerun_on_cpu(reason: str) -> None:
     """The TPU relay can die mid-run (observed: UNAVAILABLE during a bulk
-    HBM upload). Data generation is cached on disk, so a CPU re-exec
-    skips ingest and still emits the JSON line of record. The child
-    inherits stdout — its JSON line IS this process's output."""
+    HBM upload; indefinite hangs in PJRT init). Data generation is cached
+    on disk, so a re-exec skips ingest and still emits the JSON line of
+    record. First TPU failure at full scale retries TPU once at reduced
+    scale (smaller uploads fit under the relay's observed limits); after
+    that, CPU. The child inherits stdout — its JSON line IS this
+    process's output."""
     import subprocess
 
-    log(f"TPU run failed ({reason}); re-running on CPU backend")
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env = dict(os.environ)
     remaining = max(60, int(BUDGET_S - (time.time() - START)))
     env["GREPTIME_BENCH_BUDGET_S"] = str(remaining)
+    retry_scale = int(os.environ.get("GREPTIME_BENCH_TPU_RETRY_SCALE", "800"))
+    if (_backend not in ("cpu", "unknown") and SCALE > retry_scale
+            and "GREPTIME_BENCH_TPU_RETRIED" not in os.environ):
+        log(f"TPU DIAG: failed during {_phase} ({reason}); "
+            f"retrying TPU at scale={retry_scale}")
+        env["GREPTIME_BENCH_TPU_RETRIED"] = "1"
+        env["GREPTIME_BENCH_ORIG_SCALE"] = str(SCALE)
+        env["GREPTIME_BENCH_SCALE"] = str(retry_scale)
+    else:
+        log(f"TPU DIAG: failed during {_phase} ({reason}); "
+            "re-running on CPU backend")
+        env["JAX_PLATFORMS"] = "cpu"
+        # a reduced-scale TPU retry must not shrink the CPU number too
+        env["GREPTIME_BENCH_SCALE"] = os.environ.get(
+            "GREPTIME_BENCH_ORIG_SCALE", str(SCALE)
+        )
     r = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env)
     raise SystemExit(r.returncode)
 
 
+def _machine_tag() -> str:
+    """Scope the persistent compile cache to this machine: round-3's
+    cache carried XLA:CPU AOT artifacts across hosts with different CPU
+    features ('could lead to SIGILL' warnings, wrong-machine code)."""
+    import hashlib
+    import platform
+
+    basis = platform.machine() + platform.processor()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    basis += line
+                    break
+    except OSError:
+        pass
+    return hashlib.md5(basis.encode()).hexdigest()[:10]
+
+
+def prepare_grid(db) -> None:
+    """Materialize the resident grid OUTSIDE any timed section: restore
+    the host tensors from the on-disk snapshot when the region matches
+    (seconds), else build from the SSTs (the expensive path) and persist
+    the snapshot for every later invocation on this data dir."""
+    global _phase
+    from greptimedb_tpu.storage.grid import (
+        load_grid_snapshot, save_grid_snapshot,
+    )
+
+    region = db._table_view("cpu")
+    snap = os.path.join(_db_dir(), "grid_snap")
+    t0 = time.time()
+    _phase = "grid snapshot restore (device upload)"
+    table = load_grid_snapshot(snap, region)
+    if table is not None:
+        db.cache.install_grid(region, table)
+        log(f"grid restored from snapshot in {time.time() - t0:.0f}s "
+            f"({table.nbytes() / 1e9:.2f} GB resident)")
+        return
+    _phase = "grid build from SSTs (device upload)"
+    log("building resident grid from SSTs ...")
+    table, _bounds = db.grid_table("cpu", None)
+    if table is None:
+        log("WARNING: region ineligible for the dense grid; row path")
+        return
+    log(f"grid built in {time.time() - t0:.0f}s; persisting snapshot ...")
+    try:
+        save_grid_snapshot(table, region, snap)
+    except OSError as e:
+        log(f"snapshot persist failed (non-fatal): {e}")
+
+
 def main() -> None:
+    global _phase
     import jax
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
 
-    if os.environ.get("JAX_PLATFORMS"):
+    envp = os.environ.get("JAX_PLATFORMS", "")
+    on_cpu = False
+    if envp == "cpu":
         # the runtime image preimports jax, so the env var alone is too late
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    elif not probe_tpu():
-        log("WARNING: TPU backend unresponsive; falling back to CPU for this run")
         jax.config.update("jax_platforms", "cpu")
+        on_cpu = True
+    elif envp and os.environ.get("GREPTIME_BENCH_FORCE_PLATFORM"):
+        # operator escape hatch: honor the env var without probing (e.g.
+        # a relay slower than the probe timeout that does recover)
+        jax.config.update("jax_platforms", envp)
+    elif probe_tpu():
+        if envp:
+            jax.config.update("jax_platforms", envp)
+    else:
+        log("WARNING: TPU backend unresponsive (diagnostics above); "
+            "falling back to CPU for this run")
+        orig = os.environ.get("GREPTIME_BENCH_ORIG_SCALE")
+        if orig and orig != str(SCALE):
+            # reduced-scale TPU retry child whose relay is now fully
+            # wedged: the CPU number must be full scale — re-exec
+            rerun_on_cpu("probe failed in reduced-scale retry child")
+        jax.config.update("jax_platforms", "cpu")
+        on_cpu = True
 
-    # Persistent compilation cache: kills the multi-minute first-run compile
-    # on repeat driver invocations (jit programs are keyed by shape class,
-    # so the warm cache from data generation runs carries over).
-    cache_dir = os.path.join(DATA_DIR, "jax_cache")
+    # Persistent compilation cache, scoped per machine (see _machine_tag):
+    # kills the first-run compile on repeat driver invocations.
+    cache_dir = os.path.join(DATA_DIR, f"jax_cache_{_machine_tag()}")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception as e:  # cache is an optimisation, never a blocker
         log(f"compile cache unavailable: {e}")
+    else:
+        log(f"compile cache: {os.path.basename(cache_dir)} (machine-scoped;"
+            " note: XLA:CPU may still print AOT 'machine feature' mismatch"
+            " warnings on SAME-machine loads — pseudo-features like"
+            " prefer-no-gather never appear in host detection; benign here"
+            " because the dir is keyed to this host's real cpuinfo flags)")
 
-    db = build_db()
     global _backend
-    _backend = jax.default_backend()
-    log(f"jax devices: {jax.devices()} ({time.time() - START:.0f}s elapsed)")
+    if not on_cpu:
+        _backend = envp or "tpu"  # set BEFORE any op that can wedge: the
+        # except path must never query jax (backend init can itself hang)
+    try:
+        _phase = "data build/ingest"
+        db = build_db()
+        _backend = jax.default_backend()
+        log(f"jax devices: {jax.devices()} "
+            f"({time.time() - START:.0f}s elapsed)")
+        prepare_grid(db)  # bulk device upload: the relay's favorite crash
+    except Exception as e:  # noqa: BLE001
+        if on_cpu:
+            raise
+        rerun_on_cpu(repr(e))
 
     # TSBS double-groupby-all: avg of all 10 metrics by (hostname, hour)
     # over a 12h window (window shrinks with GREPTIME_BENCH_HOURS)
@@ -268,9 +416,9 @@ def main() -> None:
         f"GROUP BY hostname, hour"
     )
 
-    on_cpu = jax.default_backend() == "cpu"
     try:
-        log("warmup (compile + cache build) ...")
+        _phase = "first query (compile)"
+        log("warmup (compile) ...")
         t0 = time.time()
         r = db.sql(sql)
         first_ms = (time.time() - t0) * 1000
@@ -279,18 +427,32 @@ def main() -> None:
         expected_groups = SCALE * window_h
         assert r.num_rows == expected_groups, (r.num_rows, expected_groups)
 
+        _phase = "warm second run"
         deadline = START + BUDGET_S
-        second_ms = None
-        if time.time() < deadline:
+        second_ms = first_ms
+        if time.time() < deadline or first_ms < 30_000:
             t0 = time.time()
             db.sql(sql)
             second_ms = (time.time() - t0) * 1000
             _warmup_times.append(second_ms)
             log(f"  second run: {second_ms:.0f} ms")
 
-        while len(_times) < 10 and time.time() + (
-            second_ms or first_ms
-        ) / 1000 < deadline:
+        # the 10-run warm median is the number of record (round-3 verdict
+        # item #2): when each run is affordable, run all 10 regardless of
+        # the soft budget — the overshoot is bounded (hard cap below);
+        # only genuinely slow runs degrade to however many fit
+        _phase = "timed runs"
+        hard_cap = deadline + 300
+        while len(_times) < 10:
+            now = time.time()
+            # estimate from the slowest recent run, not just the warm-up:
+            # an evicted grid mid-loop must tighten the overshoot bound
+            est_ms = max(second_ms, _times[-1] if _times else 0.0)
+            affordable = now + est_ms / 1000 < deadline or (
+                est_ms < 30_000 and now + est_ms / 1000 < hard_cap
+            )
+            if not affordable:
+                break
             t0 = time.time()
             r = db.sql(sql)
             _times.append((time.time() - t0) * 1000)
@@ -306,7 +468,7 @@ def main() -> None:
 
     if not _times:
         # budget exhausted during warmup: the warm(er) run is the number
-        _times.append(second_ms if second_ms is not None else first_ms)
+        _times.append(second_ms)
     log(f"runs: {[f'{t:.0f}' for t in _times]} ms; groups={r.num_rows} "
         f"({time.time() - START:.0f}s elapsed)")
     emit(_times)

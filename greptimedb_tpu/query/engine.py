@@ -417,7 +417,7 @@ class QueryEngine:
         for name in names:
             col = out_cols[name][idx]
             lst = col.tolist()
-            if col.dtype.kind == "f":
+            if col.dtype.kind == "f" and bool(np.isnan(col).any()):
                 lst = [None if v != v else v for v in lst]
             elif col.dtype.kind == "O":
                 lst = [_pyval(v) for v in lst]

@@ -461,6 +461,28 @@ class RegionCacheManager:
         self._shrink()
         return table
 
+    def install_grid(self, region, table) -> None:
+        """Adopt an externally built resident GridTable (snapshot restore:
+        storage/grid.py load_grid_snapshot) as the region's current grid
+        entry, exactly as if get_grid had built it."""
+        key = (region.region_id, "grid", region.base_version)
+        rows_now = region.memtable.num_rows + sum(
+            m.num_rows for m in region.sst_files
+        )
+        # same stale-version sweep as get_grid's miss path: entries for
+        # other base_versions are dead weight that would count against
+        # capacity and could shrink-evict the fresh grid
+        for k in [
+            k for k in self._lru
+            if k[0] == key[0] and k[1:2] == ("grid",)
+        ]:
+            self._evict(k)
+        self._lru[key] = _Entry(
+            table, delta_pos=len(region._append_log), live_rows=rows_now
+        )
+        self._bytes += table.nbytes()
+        self._shrink()
+
     def _shrink(self) -> None:
         while self._bytes > self.capacity and len(self._lru) > 1:
             self._evict(next(iter(self._lru)))

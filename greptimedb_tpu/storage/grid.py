@@ -273,6 +273,92 @@ def build_grid_table(region, budget_bytes: int | None = None):
     )
 
 
+def _region_fingerprint(region) -> dict:
+    """Cheap identity of a region's resident data: SST set + memtable
+    volume.  A snapshot built from the same fingerprint maps to identical
+    grid tensors, so re-opening processes (bench re-runs, restarts) can
+    mmap the host tensors instead of re-scanning every SST."""
+    return {
+        "ssts": sorted(
+            (m.file_id, int(m.seq_max), int(m.num_rows))
+            for m in region.sst_files
+        ),
+        "memtable_rows": int(region.memtable.num_rows),
+        "num_series": int(region.num_series),
+        "fields": grid_float_fields(region.schema),
+    }
+
+
+def save_grid_snapshot(table: GridTable, region, path: str) -> None:
+    """Persist the dense host tensors next to the region data (mito2's
+    write-through file cache idea, src/mito2/src/cache/write_cache.rs:1,
+    applied to the resident layout): np arrays + a json manifest."""
+    import json
+
+    os.makedirs(path, exist_ok=True)
+    np.save(os.path.join(path, "values.npy"), np.asarray(table.values))
+    np.save(os.path.join(path, "valid.npy"), np.asarray(table.valid))
+    np.savez(os.path.join(path, "tags.npz"),
+             **{k: np.asarray(v) for k, v in table.tag_codes.items()})
+    meta = {
+        "ts0": table.ts0, "step": table.step, "nt": table.nt,
+        "num_series": table.num_series,
+        "field_names": list(table.field_names),
+        "dicts": {k: list(v) for k, v in table.dicts.items()},
+        "no_nan": list(table.no_nan),
+        "fingerprint": _region_fingerprint(region),
+    }
+    tmp = os.path.join(path, "meta.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(path, "meta.json"))
+
+
+def load_grid_snapshot(path: str, region):
+    """Rebuild a resident GridTable from a snapshot, verifying the region
+    fingerprint still matches; returns None on any mismatch/corruption
+    (caller falls back to the SST scan build)."""
+    import json
+
+    from greptimedb_tpu.storage.cache import next_dicts_version
+
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        fp = _region_fingerprint(region)
+        saved = meta["fingerprint"]
+        saved["ssts"] = [tuple(s) for s in saved["ssts"]]
+        if saved != {**fp, "ssts": list(fp["ssts"])}:
+            return None
+        # the restored tag codes are decoded against the region's CURRENT
+        # encoders at query time — a different code assignment (WAL
+        # replay order, rebuilt dictionaries) must refuse the snapshot
+        if {k: list(v) for k, v in meta["dicts"].items()} != {
+            name: list(region.encoders[name].values())
+            for name in region.tag_names
+        }:
+            return None
+        values = np.load(os.path.join(path, "values.npy"), mmap_mode="r")
+        valid = np.load(os.path.join(path, "valid.npy"), mmap_mode="r")
+        tags = np.load(os.path.join(path, "tags.npz"))
+    except Exception:  # noqa: BLE001 — any corruption (incl. BadZipFile
+        # from a truncated .npz) must mean "no snapshot", never a crash
+        return None
+    return GridTable(
+        values=_to_device_rows(values),
+        valid=_to_device_rows(valid),
+        tag_codes={k: jnp.asarray(tags[k]) for k in tags.files},
+        ts0=int(meta["ts0"]),
+        step=int(meta["step"]),
+        nt=int(meta["nt"]),
+        num_series=int(meta["num_series"]),
+        field_names=tuple(meta["field_names"]),
+        dicts={k: list(v) for k, v in meta["dicts"].items()},
+        no_nan=tuple(meta["no_nan"]),
+        dicts_version=next_dicts_version(),
+    )
+
+
 def extend_grid_table(table: GridTable, region, chunks):
     """Scatter pure-append chunks into the resident grid device-side.
 

@@ -211,3 +211,32 @@ def test_inf_values_take_masked_path(tmp_path):
     r2 = run_both(db, "SELECT h, sum(v) FROM m GROUP BY h")
     assert r2.rows[0][1] == float("inf")
     db.close()
+
+
+def test_grid_snapshot_roundtrip(db, tmp_path):
+    # snapshot persist/restore: same tensors, installed as the live entry
+    from greptimedb_tpu.storage.grid import (
+        load_grid_snapshot, save_grid_snapshot,
+    )
+
+    region = db._table_view("cpu")
+    table, _ = db.grid_table("cpu", None)
+    assert table is not None
+    snap = str(tmp_path / "snap")
+    save_grid_snapshot(table, region, snap)
+    restored = load_grid_snapshot(snap, region)
+    assert restored is not None
+    np.testing.assert_array_equal(
+        np.asarray(restored.values), np.asarray(table.values))
+    np.testing.assert_array_equal(
+        np.asarray(restored.valid), np.asarray(table.valid))
+    assert restored.dicts == table.dicts
+    assert restored.no_nan == table.no_nan
+    db.cache.install_grid(region, restored)
+    r = run_both(db, "SELECT host, avg(usage), count(*) FROM cpu GROUP BY host")
+    assert r.num_rows == 6
+    # mutate the region: fingerprint mismatch → restore refuses
+    t = 1700000000000 + 400 * 5000
+    db.sql(f"INSERT INTO cpu VALUES ('h0','dc0',{t},1.0,1.0)")
+    db._region_of("cpu").flush()
+    assert load_grid_snapshot(snap, region) is None
