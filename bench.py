@@ -322,7 +322,7 @@ def prepare_grid(db) -> None:
     snap = os.path.join(_db_dir(), "grid_snap")
     t0 = time.time()
     _phase = "grid snapshot restore (device upload)"
-    table = load_grid_snapshot(snap, region)
+    table = load_grid_snapshot(snap, region, mesh=db.mesh)
     if table is not None:
         db.cache.install_grid(region, table)
         log(f"grid restored from snapshot in {time.time() - t0:.0f}s "

@@ -241,7 +241,27 @@ class GreptimeDB(TableProvider):
         self.regions = RegionEngine(
             os.path.join(data_home, "data"), region_options
         )
-        self.cache = RegionCacheManager(cache_capacity_bytes)
+        # multi-device: form the series-axis mesh so resident grids shard
+        # across chips and the aggregate kernels run SPMD with XLA-
+        # inserted collectives (reference MergeScanExec fan-out/merge,
+        # src/query/src/dist_plan/merge_scan.rs:210 — here the exchange
+        # is GSPMD over ICI, not a Flight shuffle). GREPTIME_MESH=off
+        # forces single-device execution for A/B comparison.
+        self.mesh = None
+        if os.environ.get("GREPTIME_MESH", "auto") != "off":
+            try:
+                devs = _jax.devices()
+            except RuntimeError:
+                devs = []
+            if len(devs) > 1:
+                from jax.sharding import Mesh as _Mesh
+
+                self.mesh = _Mesh(
+                    np.array(devs), (os.environ.get("GREPTIME_MESH_AXIS",
+                                                    "shard"),)
+                )
+        self.cache = RegionCacheManager(cache_capacity_bytes,
+                                        mesh=self.mesh)
         # workload memory quotas (reference common-memory-manager): the
         # ingest write-buffer quota reclaims by flushing the largest
         # memtable before rejecting; the device cache registers for

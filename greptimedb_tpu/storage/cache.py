@@ -315,13 +315,16 @@ class RegionCacheManager:
     scans keep generation-keyed full rebuilds.
     """
 
-    def __init__(self, capacity_bytes: int = 8 << 30):
+    def __init__(self, capacity_bytes: int = 8 << 30, mesh=None):
         # delta volume beyond max(min_extend_rows, fraction * resident
         # rows) → full rebuild (restores sorted-tag eligibility and
         # compacts fragmentation); small deltas always extend
         self.rebuild_fraction = 0.25
         self.min_extend_rows = 4096
         self.capacity = capacity_bytes
+        # device mesh for series-axis sharding of resident grids (set by
+        # GreptimeDB when >1 device is visible); None = single device
+        self.mesh = mesh
         self._lru: "collections.OrderedDict[tuple, _Entry]" = (
             collections.OrderedDict()
         )
@@ -432,7 +435,8 @@ class RegionCacheManager:
                 chunks = append_log[entry.delta_pos:]
                 self.extends += 1
                 self._bytes -= entry.table.nbytes()
-                extended = extend_grid_table(entry.table, region, chunks)
+                extended = extend_grid_table(entry.table, region, chunks,
+                                             mesh=self.mesh)
                 if extended is not None:
                     entry.table = extended
                     entry.delta_pos = len(append_log)
@@ -444,7 +448,7 @@ class RegionCacheManager:
             self._evict(key)  # delta does not fit the resident shape
 
         self.misses += 1
-        table = build_grid_table(region)
+        table = build_grid_table(region, mesh=self.mesh)
         rows_now = region.memtable.num_rows + sum(
             m.num_rows for m in region.sst_files
         )

@@ -55,9 +55,13 @@ def _pad_to(n: int, align: int) -> int:
     return -(-n // align) * align
 
 
-def _to_device_rows(arr: np.ndarray) -> jnp.ndarray:
+def _to_device_rows(arr: np.ndarray, sharding=None) -> jnp.ndarray:
     """Chunked host→device upload (relay-safe): flatten, stream bounded
-    pieces, reshape on device (free — same layout)."""
+    pieces, reshape on device (free — same layout).  With a sharding the
+    array lands distributed across the mesh in one placement (multi-chip
+    meshes have per-chip links, not the single-relay bottleneck)."""
+    if sharding is not None:
+        return jax.device_put(arr, sharding)
     if arr.nbytes <= _UPLOAD_CHUNK_BYTES:
         return jnp.asarray(arr)
     flat = arr.reshape(-1)
@@ -182,9 +186,34 @@ def infer_grid_step(parts, ts_name: str, ts0: int) -> int:
     return int(g)
 
 
-def build_grid_table(region, budget_bytes: int | None = None):
+def grid_shardings(mesh, spad: int):
+    """NamedShardings splitting the series axis across the mesh, or None
+    when the padded series count does not tile the mesh.  The aggregate
+    kernel (query/physical.py) is pure jnp over these arrays, so GSPMD
+    partitions it automatically — per-shard bucket partials with XLA-
+    inserted all-reduces over ICI at the tiny [groups, buckets] merge
+    (the MergeScanExec fan-out/merge of the reference,
+    src/query/src/dist_plan/merge_scan.rs:210,335, as compiler-inserted
+    collectives instead of a Flight shuffle)."""
+    if mesh is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    d = mesh.devices.size
+    if d <= 1 or spad % d != 0:
+        return None
+    axis = mesh.axis_names[0]
+    return {
+        "values": NamedSharding(mesh, P(None, axis, None)),
+        "valid": NamedSharding(mesh, P(axis, None)),
+        "tags": NamedSharding(mesh, P(axis)),
+    }
+
+
+def build_grid_table(region, budget_bytes: int | None = None, mesh=None):
     """Attempt the dense-grid build; returns None when ineligible
-    (irregular sampling, too sparse, over budget, stringly fields only)."""
+    (irregular sampling, too sparse, over budget, stringly fields only).
+    With a mesh, the resident tensors shard on the series axis."""
     fields = grid_float_fields(region.schema)
     if not fields or region.schema.time_index is None:
         return None
@@ -258,10 +287,14 @@ def build_grid_table(region, budget_bytes: int | None = None):
     dicts = {name: region.encoders[name].values() for name in region.tag_names}
     from greptimedb_tpu.storage.cache import next_dicts_version
 
+    sh = grid_shardings(mesh, spad)
     return GridTable(
-        values=_to_device_rows(values),
-        valid=_to_device_rows(valid),
-        tag_codes={k: jnp.asarray(v) for k, v in tag_codes.items()},
+        values=_to_device_rows(values, sh and sh["values"]),
+        valid=_to_device_rows(valid, sh and sh["valid"]),
+        tag_codes={
+            k: _to_device_rows(np.asarray(v), sh and sh["tags"])
+            for k, v in tag_codes.items()
+        },
         ts0=int(ts0),
         step=int(step),
         nt=int(nt),
@@ -314,7 +347,7 @@ def save_grid_snapshot(table: GridTable, region, path: str) -> None:
     os.replace(tmp, os.path.join(path, "meta.json"))
 
 
-def load_grid_snapshot(path: str, region):
+def load_grid_snapshot(path: str, region, mesh=None):
     """Rebuild a resident GridTable from a snapshot, verifying the region
     fingerprint still matches; returns None on any mismatch/corruption
     (caller falls back to the SST scan build)."""
@@ -344,10 +377,14 @@ def load_grid_snapshot(path: str, region):
     except Exception:  # noqa: BLE001 — any corruption (incl. BadZipFile
         # from a truncated .npz) must mean "no snapshot", never a crash
         return None
+    sh = grid_shardings(mesh, int(valid.shape[0]))
     return GridTable(
-        values=_to_device_rows(values),
-        valid=_to_device_rows(valid),
-        tag_codes={k: jnp.asarray(tags[k]) for k in tags.files},
+        values=_to_device_rows(values, sh and sh["values"]),
+        valid=_to_device_rows(valid, sh and sh["valid"]),
+        tag_codes={
+            k: _to_device_rows(np.asarray(tags[k]), sh and sh["tags"])
+            for k in tags.files
+        },
         ts0=int(meta["ts0"]),
         step=int(meta["step"]),
         nt=int(meta["nt"]),
@@ -359,7 +396,7 @@ def load_grid_snapshot(path: str, region):
     )
 
 
-def extend_grid_table(table: GridTable, region, chunks):
+def extend_grid_table(table: GridTable, region, chunks, mesh=None):
     """Scatter pure-append chunks into the resident grid device-side.
 
     Returns the extended GridTable, or None when the delta does not fit
@@ -403,7 +440,11 @@ def extend_grid_table(table: GridTable, region, chunks):
     tag_codes = table.tag_codes
     if new_series > table.num_series:
         host_tags = _series_tag_matrix(region, table.spad)
-        tag_codes = {k: jnp.asarray(v) for k, v in host_tags.items()}
+        sh = grid_shardings(mesh, table.spad)
+        tag_codes = {
+            k: _to_device_rows(v, sh and sh["tags"])
+            for k, v in host_tags.items()
+        }
     from greptimedb_tpu.storage.cache import next_dicts_version
 
     return GridTable(

@@ -142,3 +142,59 @@ class TestDistAgg:
         assert len(p1) > 1  # regression: used to collapse to one partition
         for k in p1:
             np.testing.assert_array_equal(p1[k], p2[k])  # deterministic
+
+
+class TestMeshSql:
+    """sql()-level mesh execution: GreptimeDB auto-forms the 8-device
+    mesh (conftest's virtual CPU devices), the resident grid shards on
+    the series axis, and results must equal the single-device row path
+    (round-2/3 verdict: the mesh must be reachable from GreptimeDB.sql,
+    reference src/query/src/dist_plan/merge_scan.rs:210,335)."""
+
+    def test_north_star_sql_on_mesh(self, tmp_path):
+        import os
+
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(str(tmp_path / "m"))
+        assert db.mesh is not None and db.mesh.devices.size == 8
+        db.sql("CREATE TABLE cpu (hostname STRING, ts TIMESTAMP(3) "
+               "TIME INDEX, u DOUBLE, s DOUBLE, PRIMARY KEY (hostname))")
+        t0 = 1451606400000
+        rows = [f"('host_{h}',{t0 + k * 10000},{(h * 7 + k) % 100},"
+                f"{(h + 3 * k) % 50})"
+                for k in range(360) for h in range(48)]
+        db.sql("INSERT INTO cpu VALUES " + ",".join(rows))
+        db._region_of("cpu").flush()
+        sql = ("SELECT hostname, date_trunc('hour', ts) AS hr, avg(u), "
+               "max(s), count(*) FROM cpu GROUP BY hostname, hr")
+        r_mesh = db.sql(sql)
+        gt, _ = db.grid_table("cpu", None)
+        assert gt is not None and "shard" in str(gt.values.sharding)
+        os.environ["GREPTIME_GRID"] = "off"
+        try:
+            r_row = db.sql(sql)
+        finally:
+            os.environ.pop("GREPTIME_GRID", None)
+        key = lambda r: (r[0], r[1])
+        a, b = sorted(r_mesh.rows, key=key), sorted(r_row.rows, key=key)
+        assert len(a) == len(b) == 48
+        for ra, rb in zip(a, b):
+            assert ra[:2] == rb[:2]
+            np.testing.assert_allclose(
+                [float(v) for v in ra[2:]], [float(v) for v in rb[2:]],
+                rtol=2e-5)
+        db.close()
+
+    def test_mesh_off_escape_hatch(self, tmp_path):
+        import os
+
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        os.environ["GREPTIME_MESH"] = "off"
+        try:
+            db = GreptimeDB(str(tmp_path / "s"))
+            assert db.mesh is None
+            db.close()
+        finally:
+            os.environ.pop("GREPTIME_MESH", None)
