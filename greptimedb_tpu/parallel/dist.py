@@ -141,7 +141,17 @@ class DistAggExecutor:
         table: ShardedTable,
         key_specs: list[tuple],
         agg_specs: list[tuple],
+        *,
+        ts_column: str | None = None,
+        where_fn=None,
+        where_cols: tuple = (),
+        where_key: str | None = None,
+        time_range: tuple = (None, None),
     ) -> dict[str, np.ndarray]:
+        """``agg_specs``: (out, op, col) with op in sum/count/min/max/mean
+        plus first/last (value at extreme ``ts_column``).  ``where_fn``
+        (compiled over ``where_cols``) and ``time_range`` filter rows
+        inside the shard — the pushed-down WHERE of the partial plan."""
         cards = []
         for spec in key_specs:
             if spec[0] == "tag":
@@ -153,27 +163,54 @@ class DistAggExecutor:
         grid = 1
         for c in cards:
             grid *= c
-        key = (tuple(key_specs), tuple(agg_specs), grid, table.rows_per_shard)
+        tr_flags = (time_range[0] is not None, time_range[1] is not None)
+        # rolling windows must reuse one compiled kernel: the range bounds
+        # are TRACED arguments; the WHERE keys by its expression text (a
+        # fresh compile_device closure per query must still cache-hit)
+        key = (tuple(key_specs), tuple(agg_specs), grid,
+               table.rows_per_shard, ts_column, where_key, tr_flags)
         kern = self._cache.get(key)
         if kern is None:
-            kern = self._build(key_specs, agg_specs, cards, grid)
+            kern = self._build(key_specs, agg_specs, cards, grid,
+                               ts_column, where_fn, where_cols, tr_flags)
             self._cache[key] = kern
-        names = sorted({s[2] for s in agg_specs if s[2]}
-                       | {s[1] for s in key_specs if s[0] == "tag"}
-                       | {s[1] for s in key_specs if s[0] == "time"})
+        names = self._col_names(key_specs, agg_specs, ts_column, where_cols)
         args = [table.columns[n] for n in names]
-        out = kern(table.row_mask, *args)
+        lo = np.int64(time_range[0] if time_range[0] is not None else 0)
+        hi = np.int64(time_range[1] if time_range[1] is not None else 0)
+        out = kern(table.row_mask, lo, hi, *args)
         return {k: np.asarray(v) for k, v in out.items()}
 
-    def _build(self, key_specs, agg_specs, cards, grid):
-        names = sorted({s[2] for s in agg_specs if s[2]}
-                       | {s[1] for s in key_specs if s[0] == "tag"}
-                       | {s[1] for s in key_specs if s[0] == "time"})
+    @staticmethod
+    def _col_names(key_specs, agg_specs, ts_column=None, where_cols=()):
+        names = ({s[2] for s in agg_specs if s[2]}
+                 | {s[1] for s in key_specs if s[0] == "tag"}
+                 | {s[1] for s in key_specs if s[0] == "time"}
+                 | set(where_cols))
+        if ts_column:  # first/last picks and the time-range filter
+            names.add(ts_column)
+        return sorted(names)
+
+    def _build(self, key_specs, agg_specs, cards, grid, ts_column=None,
+               where_fn=None, where_cols=(), tr_flags=(False, False)):
+        names = self._col_names(key_specs, agg_specs, ts_column, where_cols)
         name_idx = {n: i for i, n in enumerate(names)}
         mesh = self.mesh
 
-        def local(mask, *cols):
+        i64 = jnp.iinfo(jnp.int64)
+
+        def local(mask, lo, hi, *cols):
             env = {n: cols[name_idx[n]] for n in names}
+            # pushed-down filters (the partial plan's WHERE + time range;
+            # lo/hi are traced so rolling windows share one kernel)
+            if where_fn is not None:
+                mask = mask & jnp.broadcast_to(where_fn(env), mask.shape)
+            if ts_column is not None and any(tr_flags):
+                ts_arr = env[ts_column]
+                if tr_flags[0]:
+                    mask = mask & (ts_arr >= lo)
+                if tr_flags[1]:
+                    mask = mask & (ts_arr < hi)
             codes = []
             for spec in key_specs:
                 if spec[0] == "tag":
@@ -223,13 +260,48 @@ class DistAggExecutor:
                             cnt > 0, total / jnp.maximum(cnt, 1), jnp.nan
                         )
                 elif op in ("min", "max"):
-                    fill = jnp.inf if op == "min" else -jnp.inf
                     fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
-                    part = fn(
-                        jnp.where(m, v, fill).astype(jnp.float32), ids,
-                        num_segments=ns,
-                    )[:grid]
+                    if is_f:
+                        fill = jnp.inf if op == "min" else -jnp.inf
+                        vv = jnp.where(m, v, fill).astype(jnp.float32)
+                    else:
+                        # int64 stays exact: pick-pair companion
+                        # timestamps (min(ts)/max(ts)) merge bit-exact,
+                        # matching the Flight path's int semantics
+                        fill = i64.max if op == "min" else i64.min
+                        vv = jnp.where(m, v.astype(jnp.int64), fill)
+                    part = fn(vv, ids, num_segments=ns)[:grid]
                     merged = _MERGE[op](part, SHARD_AXIS)
+                    cnt = count_of(col, v, m)
+                    if is_f:
+                        out[out_name] = jnp.where(cnt > 0, merged, jnp.nan)
+                    else:
+                        out[out_name] = jnp.where(cnt > 0, merged, 0)
+                elif op in ("first", "last"):
+                    # value at the extreme timestamp: local pick, then a
+                    # ts-extreme collective and a winner-selection pmax —
+                    # the mesh twin of rpc/partial.py's pick-pair merge
+                    from greptimedb_tpu.ops.segment import (
+                        segment_first_last,
+                    )
+
+                    ext_ts, val = segment_first_last(
+                        env[ts_column], v.astype(jnp.float32), ids, grid,
+                        m, last=(op == "last"),
+                    )
+                    local_has = jax.ops.segment_sum(
+                        m.astype(jnp.int32), ids, num_segments=ns
+                    )[:grid] > 0
+                    if op == "last":
+                        sent = jnp.where(local_has, ext_ts, i64.min)
+                        g_ts = jax.lax.pmax(sent, SHARD_AXIS)
+                    else:
+                        sent = jnp.where(local_has, ext_ts, i64.max)
+                        g_ts = jax.lax.pmin(sent, SHARD_AXIS)
+                    win = local_has & (sent == g_ts)
+                    merged = jax.lax.pmax(
+                        jnp.where(win, val, -jnp.inf), SHARD_AXIS
+                    )
                     cnt = count_of(col, v, m)
                     out[out_name] = jnp.where(cnt > 0, merged, jnp.nan)
                 else:
@@ -243,7 +315,181 @@ class DistAggExecutor:
         smapped = shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(SHARD_AXIS),) * (1 + len(names)),
+            in_specs=(P(SHARD_AXIS), P(), P()) + (P(SHARD_AXIS),) * len(names),
             out_specs=P(),
         )
         return jax.jit(smapped)
+
+
+def execute_select_on_mesh(
+    executor: DistAggExecutor,
+    table: ShardedTable,
+    sel,
+    ctx,
+    ts_bounds: tuple[int, int],
+):
+    """Run a partial-decomposable Select on the mesh executor, finished by
+    the SHARED merge definition (rpc/partial.py merge_partials) — ONE
+    commutativity split for both the cross-process Flight exchange and
+    the ICI collective exchange (round-3 verdict #7; reference
+    src/query/src/dist_plan/commutativity.rs:116).
+
+    Returns (column_names, rows) unordered, or None when the query is not
+    mesh-decomposable (caller falls back to single-device / SQL text).
+    Expr group keys are supported when they reference tag columns only:
+    the mesh aggregates at (tag-combo x bucket) granularity and the host
+    fold through merge_partials collapses combos sharing one expr value.
+    """
+    from greptimedb_tpu.query.ast import Column, Star
+    from greptimedb_tpu.query.exprs import compile_device, eval_host
+    from greptimedb_tpu.query.planner import plan_select, referenced_columns
+    from greptimedb_tpu.rpc.partial import merge_partials, split_partial
+
+    ts_name = (ctx.schema.time_index.name
+               if ctx.schema.time_index is not None else None)
+    pplan = split_partial(sel, ts_column=ts_name)
+    if pplan is None:
+        return None
+    psel = pplan.partial_select
+    try:
+        plan = plan_select(sel, ctx)
+    except Exception:  # noqa: BLE001 — planner rejection = not mesh-able
+        return None
+    gk_by_str = {str(k.expr): k for k in plan.group_keys}
+    tag_names = {c.name for c in ctx.schema.tag_columns}
+
+    ops_map = {"sum": "sum", "count": "count", "min": "min", "max": "max",
+               "first_value": "first", "last_value": "last"}
+    tag_cols: list[str] = []
+    time_spec = None
+    key_exprs: list[tuple] = []  # (alias, expr, kind, extra)
+    agg_specs: list[tuple] = []
+    for it in psel.items:
+        alias = it.alias
+        if alias in pplan.key_cols:
+            gk = gk_by_str.get(str(it.expr))
+            if gk is None:
+                return None
+            if gk.kind == "tag":
+                if gk.column not in tag_cols:
+                    tag_cols.append(gk.column)
+                key_exprs.append((alias, it.expr, "tag", gk.column))
+            elif gk.kind == "time":
+                if time_spec is not None or ts_name is None:
+                    return None  # one time key on the dense bucket axis
+                lo, hi = plan.time_range
+                data_lo, data_hi = ts_bounds
+                lo = data_lo if lo is None else max(lo, data_lo)
+                hi = data_hi + 1 if hi is None else min(hi, data_hi + 1)
+                if hi <= lo:
+                    hi = lo + 1
+                step = gk.step or 1
+                start = gk.origin + ((lo - gk.origin) // step) * step
+                nb = max(1, -(-(hi - start) // step))
+                time_spec = (ts_name, step, start, nb)
+                key_exprs.append((alias, it.expr, "time", None))
+            else:
+                refs: set = set()
+                referenced_columns(it.expr, ctx, refs)
+                if not refs <= tag_names:
+                    return None  # field-expr keys: no dense bound
+                for c in sorted(refs):
+                    if c not in tag_cols:
+                        tag_cols.append(c)
+                key_exprs.append((alias, it.expr, "expr", tuple(sorted(refs))))
+        else:
+            fc = it.expr
+            op = ops_map.get(getattr(fc, "name", None))
+            if op is None:
+                return None
+            if not fc.args or isinstance(fc.args[0], Star):
+                col = None
+                if op != "count":
+                    return None
+            elif isinstance(fc.args[0], Column):
+                col = ctx.resolve(fc.args[0].name)
+                if col in tag_names:
+                    # aggregating a dictionary-encoded tag would emit raw
+                    # codes (same guard as query/physical.py:805-811)
+                    return None
+            else:
+                return None  # computed agg args: single-device path
+            agg_specs.append((alias, op, col))
+
+    cards = [max(len(ctx.encoders[c]), 1) for c in tag_cols]
+    key_specs: list[tuple] = [
+        ("tag", c, card) for c, card in zip(tag_cols, cards)
+    ]
+    if time_spec is not None:
+        key_specs.append(("time",) + time_spec)
+        cards.append(time_spec[3])
+
+    where_fn, where_cols = None, ()
+    if plan.where is not None:
+        refs = set()
+        referenced_columns(plan.where, ctx, refs)
+        try:
+            where_fn = compile_device(plan.where, ctx)
+        except Exception:  # noqa: BLE001
+            return None
+        where_cols = tuple(ctx.resolve(c) for c in sorted(refs))
+    needs_ts = (
+        ts_name is not None
+        and (plan.time_range != (None, None)
+             or any(s[1] in ("first", "last") for s in agg_specs))
+    )
+    out = executor.aggregate(
+        table, key_specs, agg_specs,
+        ts_column=ts_name if needs_ts else None,
+        where_fn=where_fn, where_cols=where_cols,
+        where_key=str(plan.where) if plan.where is not None else None,
+        time_range=plan.time_range,
+    )
+
+    # ---- host fold through the shared merge ---------------------------
+    cnt = out["__count__"]
+    keep = np.nonzero(cnt > 0)[0]
+    comps = (np.unravel_index(keep, tuple(cards)) if cards
+             else (np.zeros(len(keep), dtype=np.int64),))
+    env_host: dict[str, np.ndarray] = {}
+    for i, c in enumerate(tag_cols):
+        decoded = np.asarray(ctx.encoders[c].values(), dtype=object)
+        env_host[c] = decoded[comps[i]]
+    part: dict[str, list] = {}
+    for alias, expr, kind, extra in key_exprs:
+        if kind == "tag":
+            part[alias] = env_host[extra].tolist()
+        elif kind == "time":
+            _tsn, step, start, _nb = time_spec
+            part[alias] = (start + comps[-1].astype(np.int64) * step).tolist()
+        else:
+            v = eval_host(expr, dict(env_host), len(keep))
+            arr = np.asarray(v, dtype=object)
+            if arr.ndim == 0:
+                arr = np.full(len(keep), arr.item(), dtype=object)
+            part[alias] = arr.tolist()
+    for alias, _op, _col in agg_specs:
+        vals = np.asarray(out[alias])[keep]
+        if vals.dtype.kind == "f":
+            part[alias] = [None if v != v else float(v) for v in vals]
+        else:
+            part[alias] = vals.tolist()
+    return merge_partials(pplan, [part])
+
+
+def shard_region(region, mesh, ts_range: tuple = (None, None)) -> ShardedTable:
+    """ShardedTable from a region's host scan, tags dictionary-encoded to
+    device codes (the convention compile_device expects).  String FIELD
+    columns are dropped — the mesh aggregates numerics; a query touching
+    them is not mesh-decomposable anyway."""
+    cols = region.scan_host(ts_range)
+    tagset = {c.name for c in region.schema.tag_columns}
+    out: dict[str, np.ndarray] = {}
+    for name, arr in cols.items():
+        if name in tagset and arr.dtype.kind in ("O", "U", "S"):
+            out[name] = region.encoders[name].encode(arr).astype(np.int32)
+        elif arr.dtype.kind == "O":
+            continue
+        else:
+            out[name] = arr
+    return shard_table(out, mesh)

@@ -177,7 +177,10 @@ class DatanodeFlightServer(fl.FlightServerBase):
             else:
                 sel = parse_sql(req["sql"])[0]
                 if mode == "partial":
-                    plan = split_partial(sel)
+                    ts_col = (view.schema.time_index.name
+                              if view.schema.time_index is not None
+                              else None)
+                    plan = split_partial(sel, ts_column=ts_col)
                     if plan is None:
                         raise fl.FlightServerError(
                             f"query is not partial-decomposable: {req['sql']}"

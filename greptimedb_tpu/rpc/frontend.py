@@ -159,7 +159,9 @@ class DistFrontend:
             raise Unsupported("tableless SELECT on the distributed frontend")
         info = self.catalog.get_table(self.db, sel.table)
         by_node = self._node_regions(info)
-        plan = split_partial(sel)
+        ts_col = (info.schema.time_index.name
+                  if info.schema.time_index is not None else None)
+        plan = split_partial(sel, ts_column=ts_col)
         if plan is not None:
             # MergeScan fast path: the frontend derives the partial split
             # ONCE, encodes it ONCE (plan codec, substrait analog), and

@@ -8,10 +8,12 @@ the frontend merges partial states and finishes the plan.  Here the
 and shipped as SQL text — both sides share this module so the partial
 schema and the merge spec are derived identically.
 
-Decomposable aggregates: sum/count/min/max/avg (mean).  avg ships as
-(sum, count) partials.  Anything else — DISTINCT, sliding RANGE windows,
-HAVING, OFFSET, first/last — falls back to raw-scan shipping (the
-frontend pulls filtered rows and finishes locally).
+Decomposable aggregates: sum/count/min/max/avg (mean), plus
+first_value/last_value when the caller supplies the time-index column —
+they ship as (value-at-extreme-ts, extreme-ts) pick pairs.  Anything
+else — DISTINCT, sliding RANGE windows, HAVING, OFFSET — falls back to
+raw-scan shipping (the frontend pulls filtered rows and finishes
+locally).
 """
 
 from __future__ import annotations
@@ -20,7 +22,13 @@ from dataclasses import dataclass, replace
 
 from greptimedb_tpu.query.ast import FuncCall, Select, SelectItem
 
-# merge op applied on the frontend over the per-datanode partial columns
+# merge op applied on the frontend over the per-datanode partial columns.
+# Merge ops are either scalar ("sum"/"min"/"max") or a pick pair
+# ("pick_min"/"pick_max", companion) — the value column adopts the
+# incoming value exactly when the companion (timestamp) column improves,
+# which is how first_value/last_value decompose: each shard ships its
+# local (value-at-extreme-ts, extreme-ts) and the merge keeps the pair
+# with the globally extreme ts (commutativity.rs:116 step aggregation).
 _PARTIALS: dict[str, list[tuple[str, str]]] = {
     # agg -> [(partial agg fn, merge op)]
     "sum": [("sum", "sum")],
@@ -30,6 +38,8 @@ _PARTIALS: dict[str, list[tuple[str, str]]] = {
     "avg": [("sum", "sum"), ("count", "sum")],
     "mean": [("sum", "sum"), ("count", "sum")],
 }
+# aggs whose partials need the time index as a companion column
+_PICK_PARTIALS = {"first_value": "min", "last_value": "max"}
 
 
 @dataclass(frozen=True)
@@ -50,11 +60,13 @@ class MergeItem:
 class PartialPlan:
     partial_select: Select  # execute on each datanode
     key_cols: tuple[str, ...]  # partial-result column names of group keys
-    merge_cols: dict[str, str]  # partial col -> merge op (sum/min/max)
+    # partial col -> merge op: "sum"/"min"/"max", or ("pick_min"|"pick_max",
+    # companion_col) for first/last value-at-extreme-timestamp pairs
+    merge_cols: dict[str, object]
     items: tuple[MergeItem, ...]  # original output columns in order
 
 
-def split_partial(sel: Select) -> PartialPlan | None:
+def split_partial(sel: Select, ts_column: str | None = None) -> PartialPlan | None:
     """Return the partial split, or None when the query must ship raw rows.
 
     Mirrors Commutativity::Commutative vs ::Unsupported in the reference
@@ -91,6 +103,26 @@ def split_partial(sel: Select) -> PartialPlan | None:
             key_cols.append(kname)
             continue
         if isinstance(it.expr, FuncCall) and not it.expr.distinct:
+            if it.expr.name in _PICK_PARTIALS and ts_column:
+                from greptimedb_tpu.query.ast import Column
+
+                ext = _PICK_PARTIALS[it.expr.name]
+                vcol, tcol = f"__a{i}_0", f"__a{i}_1"
+                partial_items.append(SelectItem(
+                    FuncCall(it.expr.name, it.expr.args, distinct=False),
+                    alias=vcol,
+                ))
+                partial_items.append(SelectItem(
+                    FuncCall(ext, (Column(ts_column),), distinct=False),
+                    alias=tcol,
+                ))
+                merge_cols[vcol] = (f"pick_{ext}", tcol)
+                merge_cols[tcol] = ext
+                merge_items.append(MergeItem(
+                    it.output_name, "agg", agg=it.expr.name,
+                    partial_cols=(vcol, tcol),
+                ))
+                continue
             specs = _PARTIALS.get(it.expr.name)
             if specs is None:
                 return None
@@ -140,11 +172,30 @@ def split_partial(sel: Select) -> PartialPlan | None:
     )
 
 
-def merge_into(slot: dict, values: dict, merge_cols: dict[str, str]) -> None:
+def merge_into(slot: dict, values: dict, merge_cols: dict) -> None:
     """Fold one partial row into an accumulator slot — the ONE definition
-    of partial-merge semantics (None-tolerant sum/min/max), shared by the
-    distributed frontend merge and the streaming flow engine."""
+    of partial-merge semantics (None-tolerant sum/min/max + first/last
+    pick pairs), shared by the distributed frontend merge, the mesh
+    executor's host fold, and the streaming flow engine."""
+    # pick pairs first: they must compare against the companion's value
+    # BEFORE this row's scalar merge updates it
     for c, op in merge_cols.items():
+        if not isinstance(op, tuple):
+            continue
+        mode, companion = op
+        v_ts = values.get(companion)
+        cur_ts = slot.get(companion)
+        if v_ts is None:
+            continue
+        better = (
+            cur_ts is None
+            or (v_ts < cur_ts if mode == "pick_min" else v_ts > cur_ts)
+        )
+        if better:
+            slot[c] = values[c]
+    for c, op in merge_cols.items():
+        if isinstance(op, tuple):
+            continue
         v = values[c]
         cur = slot[c]
         if v is None:

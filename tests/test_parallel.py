@@ -198,3 +198,85 @@ class TestMeshSql:
             db.close()
         finally:
             os.environ.pop("GREPTIME_MESH", None)
+
+
+class TestUnifiedSplitOnMesh:
+    """execute_select_on_mesh: the SAME split_partial that feeds the
+    Flight exchange drives the ICI-collective executor (verdict #7) —
+    incl. first/last pick collectives and tag-expr group keys folded
+    host-side through the shared merge_partials."""
+
+    @pytest.fixture
+    def db8(self, tmp_path):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(str(tmp_path / "u"))
+        db.sql("CREATE TABLE cpu (host STRING, dc STRING, ts TIMESTAMP(3) "
+               "TIME INDEX, u DOUBLE, PRIMARY KEY (host, dc))")
+        t0 = 1700000000000
+        rows = [f"('h{i % 8}','dc{i % 3}',{t0 + (i // 24) * 5000},"
+                f"{(i * 13) % 101})" for i in range(4800)]
+        db.sql("INSERT INTO cpu VALUES " + ",".join(rows))
+        db._region_of("cpu").flush()
+        yield db
+        db.close()
+
+    def _run(self, db, sql):
+        from greptimedb_tpu.parallel.dist import (
+            DistAggExecutor, create_mesh, execute_select_on_mesh,
+            shard_region,
+        )
+        from greptimedb_tpu.query.parser import parse_sql
+
+        region = db._table_view("cpu")
+        mesh = create_mesh(8)
+        table = shard_region(region, mesh)
+        ex = DistAggExecutor(mesh)
+        sel = parse_sql(sql)[0]
+        res = execute_select_on_mesh(
+            ex, table, sel, db.table_context("cpu"), region.ts_bounds())
+        assert res is not None, f"not mesh-decomposable: {sql}"
+        return res
+
+    def _compare(self, db, sql, nkeys=2):
+        names, rows_m = self._run(db, sql)
+        ref = db.sql(sql)
+        assert names == ref.column_names
+        key = lambda r: tuple(str(x) for x in r[:nkeys])
+        a, b = sorted(rows_m, key=key), sorted(ref.rows, key=key)
+        assert len(a) == len(b)
+        for ra, rb in zip(a, b):
+            for va, vb in zip(ra, rb):
+                if isinstance(va, float) and isinstance(vb, float):
+                    assert va == pytest.approx(vb, rel=1e-4, abs=1e-4)
+                else:
+                    assert str(va) == str(vb), (sql, ra, rb)
+
+    def test_first_last_avg_on_mesh(self, db8):
+        self._compare(
+            db8,
+            "SELECT host, date_trunc('minute', ts) AS m, avg(u), "
+            "last_value(u), first_value(u), count(*) FROM cpu "
+            "GROUP BY host, m",
+        )
+
+    def test_where_and_time_range_pushdown(self, db8):
+        t0 = 1700000000000
+        self._compare(
+            db8,
+            f"SELECT host, min(u), sum(u) FROM cpu WHERE dc = 'dc1' "
+            f"AND ts >= {t0 + 20000} GROUP BY host",
+            nkeys=1,
+        )
+
+    def test_tag_expr_key_folds_on_host(self, db8):
+        # upper(host) is NOT device-compilable — the single-device dense
+        # path can't group by it, but the mesh path aggregates at tag
+        # granularity and folds the expr host-side via merge_partials
+        names, rows = self._run(
+            db8, "SELECT upper(host) AS H, sum(u), count(*) FROM cpu "
+                 "GROUP BY H")
+        assert names == ["H", "sum(u)", "count(*)"]
+        got = {r[0]: r[2] for r in rows}
+        assert set(got) == {f"H{i}" for i in range(8)}
+        assert sum(got.values()) == 4800
