@@ -145,7 +145,7 @@ class DistAggExecutor:
         ts_column: str | None = None,
         where_fn=None,
         where_cols: tuple = (),
-        where_key: str | None = None,
+        where_key=None,
         time_range: tuple = (None, None),
     ) -> dict[str, np.ndarray]:
         """``agg_specs``: (out, op, col) with op in sum/count/min/max/mean
@@ -285,8 +285,10 @@ class DistAggExecutor:
                         segment_first_last,
                     )
 
+                    vv = (v if is_f
+                          else v.astype(jnp.int64))  # ints stay exact
                     ext_ts, val = segment_first_last(
-                        env[ts_column], v.astype(jnp.float32), ids, grid,
+                        env[ts_column], vv, ids, grid,
                         m, last=(op == "last"),
                     )
                     local_has = jax.ops.segment_sum(
@@ -299,11 +301,14 @@ class DistAggExecutor:
                         sent = jnp.where(local_has, ext_ts, i64.max)
                         g_ts = jax.lax.pmin(sent, SHARD_AXIS)
                     win = local_has & (sent == g_ts)
+                    cand_fill = -jnp.inf if is_f else i64.min
                     merged = jax.lax.pmax(
-                        jnp.where(win, val, -jnp.inf), SHARD_AXIS
+                        jnp.where(win, val, cand_fill), SHARD_AXIS
                     )
                     cnt = count_of(col, v, m)
-                    out[out_name] = jnp.where(cnt > 0, merged, jnp.nan)
+                    out[out_name] = jnp.where(
+                        cnt > 0, merged, jnp.nan if is_f else 0
+                    )
                 else:
                     raise Unsupported(f"dist agg {op}")
             out["__count__"] = count_of(
@@ -347,6 +352,8 @@ def execute_select_on_mesh(
 
     ts_name = (ctx.schema.time_index.name
                if ctx.schema.time_index is not None else None)
+    if ts_bounds is None:  # empty region (ts_bounds() -> None)
+        ts_bounds = (0, 0)
     pplan = split_partial(sel, ts_column=ts_name)
     if pplan is None:
         return None
@@ -423,6 +430,16 @@ def execute_select_on_mesh(
     if time_spec is not None:
         key_specs.append(("time",) + time_spec)
         cards.append(time_spec[3])
+    from greptimedb_tpu.query.physical import DENSE_LIMIT
+
+    total_groups = 1
+    for c in cards:
+        total_groups *= c
+    if total_groups > DENSE_LIMIT:
+        # same cap as the single-device dense path (physical.py): an
+        # unbounded bucket grid (e.g. GROUP BY raw ts, step=1) would
+        # allocate [grid]-sized buffers per aggregate
+        return None
 
     where_fn, where_cols = None, ()
     if plan.where is not None:
@@ -438,11 +455,21 @@ def execute_select_on_mesh(
         and (plan.time_range != (None, None)
              or any(s[1] in ("first", "last") for s in agg_specs))
     )
+    needed = executor._col_names(
+        key_specs, agg_specs, ts_name if needs_ts else None, where_cols)
+    if not set(needed) <= set(table.columns):
+        return None  # e.g. string FIELD columns dropped by shard_region
+    # the where closure bakes dictionary codes at compile time, so the
+    # kernel cache must key on (table, expr text, dictionary versions) —
+    # a new tag value recompiles instead of hitting a stale predicate
+    dict_ver = tuple(
+        len(ctx.encoders[c.name]) for c in ctx.schema.tag_columns)
     out = executor.aggregate(
         table, key_specs, agg_specs,
         ts_column=ts_name if needs_ts else None,
         where_fn=where_fn, where_cols=where_cols,
-        where_key=str(plan.where) if plan.where is not None else None,
+        where_key=(sel.table, str(plan.where), dict_ver)
+        if plan.where is not None else (sel.table, None, dict_ver),
         time_range=plan.time_range,
     )
 

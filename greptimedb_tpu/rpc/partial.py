@@ -87,7 +87,7 @@ def split_partial(sel: Select, ts_column: str | None = None) -> PartialPlan | No
     group_strs = [str(g) for g in sel.group_by]
     partial_items: list[SelectItem] = []
     key_cols: list[str] = []
-    merge_cols: dict[str, str] = {}
+    merge_cols: dict[str, object] = {}
     merge_items: list[MergeItem] = []
     matched_groups: set[str] = set()
 
