@@ -56,9 +56,14 @@ class CreateTableProcedure(Procedure):
             if st["engine"] != "file":
                 schema = Schema.from_dict(st["schema"])
                 opts = None
+                overrides = {}
                 if st.get("append_mode"):
+                    overrides["append_mode"] = True
+                if st.get("ttl_ms"):
+                    overrides["ttl_ms"] = int(st["ttl_ms"])
+                if overrides:
                     opts = dataclasses.replace(
-                        db.regions.default_options, append_mode=True
+                        db.regions.default_options, **overrides
                     )
                 for rid in st["info"]["region_ids"]:
                     # idempotent: adopts a region materialized by a prior
@@ -66,6 +71,61 @@ class CreateTableProcedure(Procedure):
                     db.regions.ensure_region(rid, schema, options=opts)
             return Status.done(output=st["info"])
         raise StorageError(f"create_table: unknown step {step!r}")
+
+
+class AlterOptionsProcedure(Procedure):
+    """state: {step, db, name, options} — journaled ALTER TABLE SET/UNSET
+    of table options (``options`` is the full post-change dict).  Same
+    crash-resume contract as the other DDL procedures: catalog commit
+    first, then idempotent per-region manifest commits — a crash between
+    them resumes and re-applies the region step."""
+
+    type_name = "ddl/alter_options"
+
+    def lock_keys(self) -> list[str]:
+        return [f"table/{self.state['db']}.{self.state['name']}"]
+
+    def execute(self, ctx: ProcedureContext) -> Status:
+        db = _db_service(ctx)
+        st = self.state
+        step = st.get("step", "metadata")
+        opts = st["options"]
+        if step == "metadata":
+            info = db.catalog.get_table(st["db"], st["name"])
+            info.options = dict(opts)
+            db.catalog.update_table(info)
+            st["step"] = "regions"
+            return Status.executing()
+        if step == "regions":
+            from greptimedb_tpu.utils.config import parse_duration_ms
+
+            overrides = {
+                "ttl_ms": parse_duration_ms(opts["ttl"]) if opts.get("ttl")
+                else None,
+                "append_mode": str(opts.get("append_mode", "")).lower()
+                in ("true", "1"),
+            }
+            if opts.get("compaction_window"):
+                overrides["compaction_window_ms"] = parse_duration_ms(
+                    opts["compaction_window"]) or 24 * 3600 * 1000
+            info = db.catalog.get_table(st["db"], st["name"])
+            for rid in info.region_ids:
+                region = db.regions.regions.get(rid)
+                if region is None:
+                    try:
+                        region = db.regions.open_region(rid)
+                    except RegionNotFound:
+                        continue  # file-engine/virtual: no LSM region
+                region.options = dataclasses.replace(
+                    region.options, **overrides)
+                region.manifest.commit(
+                    {"kind": "options",
+                     "options": region.options.to_dict()}
+                )
+                region.apply_ttl()
+                db.cache.invalidate_region(region.region_id)
+            return Status.done()
+        raise StorageError(f"alter_options: unknown step {step!r}")
 
 
 class DropTableProcedure(Procedure):

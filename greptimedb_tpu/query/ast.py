@@ -389,9 +389,10 @@ class DropDatabase(Statement):
 @dataclass
 class AlterTable(Statement):
     table: str
-    action: str  # add_column | drop_column | rename
+    action: str  # add_column | drop_column | rename | set_options | unset_option
     column: ColumnDef | None = None
-    name: str | None = None  # drop column name / rename target
+    name: str | None = None  # drop column name / rename target / option key
+    options: dict | None = None  # set_options payload (e.g. {'ttl': '1d'})
 
 
 @dataclass

@@ -960,6 +960,20 @@ class Parser:
         if self.eat_kw("RENAME"):
             self.eat_kw("TO")
             return AlterTable(table, "rename", name=self.ident())
+        if self.eat_kw("SET"):
+            # ALTER TABLE t SET 'ttl'='1d' / SET ttl='1d', ... (reference
+            # mito_engine_options: change table options online)
+            opts: dict = {}
+            while True:
+                k = self.ident() if not self.at(Tok.STRING) else self.next().text
+                self.expect(Tok.OP, "=")
+                opts[k.lower()] = self.next().text
+                if not self.eat(Tok.PUNCT, ","):
+                    break
+            return AlterTable(table, "set_options", options=opts)
+        if self.eat_kw("UNSET"):
+            k = self.ident() if not self.at(Tok.STRING) else self.next().text
+            return AlterTable(table, "unset_option", name=k.lower())
         raise Unsupported(f"unsupported ALTER at {self.peek().pos}")
 
     def show(self) -> Statement:

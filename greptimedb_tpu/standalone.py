@@ -826,6 +826,16 @@ class GreptimeDB(TableProvider):
         # log/trace model): every row kept, no (series, ts) dedup
         append = str(stmt.options.get("append_mode", "")).lower() in (
             "true", "1")
+        # retention (reference WITH (ttl='7d')): validated here so a bad
+        # duration fails the statement, enforced at flush/compaction
+        ttl_ms = None
+        if stmt.options.get("ttl"):
+            from greptimedb_tpu.utils.config import parse_duration_ms
+
+            try:
+                ttl_ms = parse_duration_ms(stmt.options["ttl"])
+            except ValueError as e:
+                raise InvalidArguments(str(e)) from None
         self.procedures.submit(CreateTableProcedure(state={
             "db": db, "name": name, "schema": schema.to_dict(),
             "engine": stmt.engine, "options": stmt.options,
@@ -833,6 +843,7 @@ class GreptimeDB(TableProvider):
             "partition_columns": stmt.partition_columns,
             "num_regions": max(len(stmt.partitions), 1),
             "append_mode": append,
+            "ttl_ms": ttl_ms,
         }))
         return QueryResult([], [], affected_rows=0)
 
@@ -933,6 +944,39 @@ class GreptimeDB(TableProvider):
             return result(reconcile_standalone(self, strategy=strategy))
         raise Unsupported(f"ADMIN function {name}")
 
+    _ALTERABLE_OPTIONS = {"ttl", "append_mode", "compaction_window",
+                          "comment"}
+
+    def _alter_table_options(self, db: str, name: str, info,
+                             stmt: AlterTable) -> QueryResult:
+        """ALTER TABLE SET/UNSET table options (reference
+        src/store-api/src/mito_engine_options.rs), journaled through
+        AlterOptionsProcedure so a crash between the catalog commit and
+        the per-region manifest commits resumes instead of diverging."""
+        from greptimedb_tpu.meta.ddl import AlterOptionsProcedure
+        from greptimedb_tpu.utils.config import parse_duration_ms
+
+        new_opts = dict(info.options)
+        if stmt.action == "set_options":
+            for k in (stmt.options or {}):
+                if k not in self._ALTERABLE_OPTIONS:
+                    raise Unsupported(f"ALTER TABLE SET {k!r}")
+            new_opts.update(stmt.options or {})
+        else:
+            if stmt.name not in self._ALTERABLE_OPTIONS:
+                raise Unsupported(f"ALTER TABLE UNSET {stmt.name!r}")
+            new_opts.pop(stmt.name, None)
+        for k in ("ttl", "compaction_window"):  # fail BEFORE any commit
+            if new_opts.get(k):
+                try:
+                    parse_duration_ms(new_opts[k])
+                except ValueError as e:
+                    raise InvalidArguments(str(e)) from None
+        self.procedures.submit(AlterOptionsProcedure(state={
+            "db": db, "name": name, "options": new_opts,
+        }))
+        return QueryResult([], [], affected_rows=0)
+
     def _alter_table(self, stmt: AlterTable) -> QueryResult:
         db, name = self._split_name(stmt.table)
         info = self.catalog.get_table(db, name)
@@ -947,6 +991,8 @@ class GreptimeDB(TableProvider):
         elif stmt.action == "rename":
             self.catalog.rename_table(db, name, stmt.name)
             return QueryResult([], [], affected_rows=0)
+        elif stmt.action in ("set_options", "unset_option"):
+            return self._alter_table_options(db, name, info, stmt)
         else:
             raise Unsupported(f"alter {stmt.action}")
         from greptimedb_tpu.meta.ddl import AlterTableProcedure

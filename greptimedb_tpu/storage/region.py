@@ -54,6 +54,11 @@ class RegionOptions:
     # mito2 MergeMode): rows with equal (series, ts) keys are ALL kept —
     # the log/trace data model, where many events share a millisecond
     append_mode: bool = False
+    # retention (reference WITH (ttl='7d'), src/store-api/src/
+    # mito_engine_options.rs): SSTs whose newest row is older than
+    # now - ttl are dropped whole at flush/compaction time; None = keep
+    # forever
+    ttl_ms: int | None = None
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -450,9 +455,47 @@ class Region:
         return out
 
     def _maybe_compact(self) -> None:
+        self.apply_ttl()
         for _win, files in self._windows().items():
             if len(files) >= self.options.compaction_trigger_files:
                 self.compact_files(files)
+
+    @staticmethod
+    def _now_ms() -> int:
+        import time as _time
+
+        return int(_time.time() * 1000)
+
+    def apply_ttl(self) -> int:
+        """Drop SSTs fully past the retention window (reference TWCS
+        picker expiration, src/mito2/src/compaction/twcs.rs + ttl in
+        src/store-api/src/mito_engine_options.rs).  Whole-file drops
+        only — a file with any live row stays until a later sweep.
+        Returns the number of files dropped."""
+        ttl = self.options.ttl_ms
+        if not ttl:
+            return 0
+        from greptimedb_tpu.datatypes.types import TimeUnit
+
+        # SST ts_max is in the table's native time unit — convert the
+        # ms cutoff (a TIMESTAMP(0) table must not compare seconds
+        # against milliseconds: that expires everything instantly)
+        unit = self.schema.time_index.dtype.time_unit
+        cutoff = TimeUnit.MILLISECOND.convert(self._now_ms() - ttl, unit)
+        expired = [m for m in self.sst_files if m.ts_max < cutoff]
+        if not expired:
+            return 0
+        self.manifest.commit({
+            "kind": "edit", "add": [],
+            "remove": [m.file_id for m in expired],
+        })
+        for m in expired:
+            self.store.delete(m.path)
+            self.store.delete(self._index_path(m))
+            self._index_cache.pop(m.file_id, None)
+        self.generation += 1
+        self._mark_structure_change()
+        return len(expired)
 
     def compact_files(self, files: list[SstMeta]) -> SstMeta:
         """Merge SSTs: sort, dedup keep-last, drop tombstones fully covered.
@@ -504,6 +547,7 @@ class Region:
         src/common/function/src/admin.rs compact_region)."""
         if self.memtable.num_rows:
             self.flush()
+        self.apply_ttl()
         files = self.sst_files
         if files:
             self.compact_files(files)

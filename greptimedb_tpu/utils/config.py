@@ -8,6 +8,7 @@ convention with ``__`` as the section separator; later layers win.
 from __future__ import annotations
 
 import os
+import re
 import tomllib
 from dataclasses import dataclass, field, fields, is_dataclass
 
@@ -137,3 +138,31 @@ def to_dict(obj) -> dict:
         v = getattr(obj, f.name)
         out[f.name] = to_dict(v) if is_dataclass(v) else v
     return out
+
+
+_DUR_RE = re.compile(r"(\d+)\s*(us|ms|s|m|h|d|w|y)")
+
+
+def parse_duration_ms(text: str) -> int | None:
+    """Humantime-style duration -> milliseconds (reference accepts e.g.
+    ttl='7d', '1h 30m'; src/store-api/src/mito_engine_options.rs).
+    'forever'/''/'0' -> None (keep forever)."""
+    s = str(text).strip().lower()
+    if s in ("", "forever", "0"):
+        return None
+    zero_ok = False
+    units = {"us": 0.001, "ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+             "d": 86_400_000, "w": 7 * 86_400_000, "y": 365 * 86_400_000}
+    pos = 0
+    total = 0.0
+    for m in _DUR_RE.finditer(s):
+        if s[pos:m.start()].strip():
+            raise ValueError(f"invalid duration {text!r}")
+        total += int(m.group(1)) * units[m.group(2)]
+        pos = m.end()
+        zero_ok = True
+    if pos != len(s.rstrip()) or not zero_ok:
+        raise ValueError(f"invalid duration {text!r}")
+    if total == 0:
+        return None  # '0s' == forever, same as '0' (humantime semantics)
+    return max(int(total), 1)  # sub-ms ttl rounds up, never to 'forever'

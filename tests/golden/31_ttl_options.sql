@@ -1,0 +1,11 @@
+CREATE TABLE sensor (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h)) WITH (ttl='7d');
+SHOW CREATE TABLE sensor;
+ALTER TABLE sensor SET 'ttl'='36h';
+SHOW CREATE TABLE sensor;
+ALTER TABLE sensor UNSET 'ttl';
+SHOW CREATE TABLE sensor;
+ALTER TABLE sensor SET ttl='forever';
+INSERT INTO sensor VALUES ('a', 1000, 1.5), ('b', 2000, 2.5);
+SELECT h, v FROM sensor ORDER BY h;
+ADMIN flush_table('sensor');
+SELECT count(*) FROM sensor
