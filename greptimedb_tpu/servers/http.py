@@ -29,7 +29,9 @@ import time
 import numpy as np
 from aiohttp import web
 
-from greptimedb_tpu.errors import GreptimeError, InvalidArguments, StatusCode
+from greptimedb_tpu.errors import (
+    GreptimeError, InvalidArguments, StatusCode, TableNotFound,
+)
 from greptimedb_tpu.query.engine import QueryResult
 from greptimedb_tpu.utils import telemetry
 from greptimedb_tpu.utils.snappy import decompress as snappy_decompress
@@ -195,9 +197,11 @@ class HttpServer(ThreadedAiohttpApp):
         r.add_get("/v1/prometheus/api/v1/label/{name}/values", self.h_prom_label_values)
         r.add_route("*", "/v1/prometheus/api/v1/series", self.h_prom_series)
         r.add_post("/v1/prometheus/write", self.h_remote_write)
+        r.add_post("/v1/prometheus/read", self.h_remote_read)
         r.add_post("/v1/influxdb/api/v2/write", self.h_influx_write)
         r.add_post("/v1/influxdb/write", self.h_influx_write)
         r.add_post("/v1/otlp/v1/metrics", self.h_otlp_metrics)
+        r.add_post("/v1/otlp/v1/logs", self.h_otlp_logs)
         r.add_post("/v1/otel-arrow/v1/metrics", self.h_otel_arrow_metrics)
         r.add_post("/v1/loki/api/v1/push", self.h_loki_push)
         r.add_post("/v1/logs", self.h_log_query)
@@ -489,6 +493,136 @@ class HttpServer(ThreadedAiohttpApp):
         try:
             n = await self._call(run)
             M_INGEST_ROWS.labels("otlp_metrics").inc(n)
+            return web.json_response({"partialSuccess": {}})
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+
+    async def h_remote_read(self, request: web.Request) -> web.Response:
+        """Prometheus remote read (reference src/servers/src/http/
+        prom_store.rs): snappy ReadRequest in, snappy ReadResponse of raw
+        samples out — series resolved by the same inverted-index matcher
+        machinery the PromQL engine uses."""
+        from greptimedb_tpu.promql.engine import SelectorData
+        from greptimedb_tpu.promql.parser import LabelMatcher
+        from greptimedb_tpu.servers.protocols import (
+            encode_read_response, parse_remote_read,
+        )
+        from greptimedb_tpu.storage.memtable import TSID
+        from greptimedb_tpu.utils.snappy import compress as snappy_compress
+
+        body = await request.read()
+        if request.headers.get("Content-Encoding", "snappy").lower() == "snappy":
+            try:
+                body = snappy_decompress(body)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": f"snappy: {e}"}, status=400)
+
+        def run():
+            queries = parse_remote_read(body)
+            results = []
+            for q in queries:
+                metric = next(
+                    (v for op, n, v in q["matchers"]
+                     if n == "__name__" and op == "="), None)
+                if metric is None:
+                    raise InvalidArguments(
+                        "remote read needs an equality __name__ matcher")
+                matchers = [LabelMatcher(n, op, v)
+                            for op, n, v in q["matchers"]
+                            if n != "__name__"]
+                try:
+                    data = SelectorData(self.db, metric)
+                except TableNotFound:
+                    results.append([])  # unknown metric: empty, not 5xx
+                    continue
+                tsids, labels = data.select_series(matchers)
+                field = data.field_column(matchers)
+                # equality matchers prune SSTs via the bloom sidecars
+                tag_filters = {
+                    m.name: {m.value} for m in matchers
+                    if m.op == "=" and m.name != "__field__"
+                } or None
+                host = data.region.scan_host(
+                    (q["start_ms"], q["end_ms"] + 1),
+                    tag_filters=tag_filters)
+                import numpy as _np
+
+                row_tsid = _np.asarray(host[TSID])
+                keep = _np.isin(row_tsid, tsids)
+                row_tsid = row_tsid[keep]
+                ts_col = _np.asarray(
+                    host[data.schema.time_index.name])[keep]
+                val_col = _np.asarray(host[field])[keep]
+                # scan_host rows are (tsid, ts)-sorted already: one
+                # unique() split instead of a per-row Python loop
+                uniq, starts = _np.unique(row_tsid, return_index=True)
+                bounds = _np.append(starts, len(row_tsid))
+                by_tsid = {int(t): i for i, t in enumerate(tsids)}
+                series = []
+                for j, t in enumerate(uniq):
+                    li = by_tsid.get(int(t))
+                    if li is None:
+                        continue
+                    sl = slice(bounds[j], bounds[j + 1])
+                    vals, tss = val_col[sl], ts_col[sl]
+                    ok = vals == vals  # NaN = absent
+                    if not ok.any():
+                        continue
+                    lab = dict(labels[li])
+                    lab["__name__"] = metric
+                    series.append((lab, list(zip(
+                        vals[ok].tolist(), tss[ok].tolist()))))
+                results.append(series)
+            return snappy_compress(encode_read_response(results))
+
+        try:
+            payload = await self._call(run)
+            return web.Response(
+                body=payload,
+                content_type="application/x-protobuf",
+                headers={"Content-Encoding": "snappy"},
+            )
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_otlp_logs(self, request: web.Request) -> web.Response:
+        """OTLP/HTTP logs ingest (reference src/servers/src/otlp/logs.rs):
+        protobuf ExportLogsServiceRequest → rows in the log table
+        (x-greptime-log-table-name, default opentelemetry_logs), optionally
+        shaped by a named pipeline (x-greptime-pipeline-name; the default
+        identity mapping mirrors greptime_identity)."""
+        from greptimedb_tpu.servers.otlp import parse_otlp_logs
+
+        table = request.headers.get("x-greptime-log-table-name",
+                                    "opentelemetry_logs")
+        pname = request.headers.get("x-greptime-pipeline-name")
+        try:
+            body = await request.read()
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": f"body: {e}"}, status=400)
+
+        def run():
+            rows = parse_otlp_logs(body)
+            if not rows:
+                return 0
+            if pname and pname != "greptime_identity":
+                pipe = self._pipelines().get(pname)
+                cols = pipe.run(rows)
+            else:
+                names = list(rows[0].keys())
+                cols = {k: [r.get(k) for r in rows] for k in names}
+                cols["__tags__"] = []
+                cols["__fields__"] = [n for n in names if n != "ts"]
+            if not cols.get("ts"):
+                return 0
+            return _ingest_columns(self.db, table, cols, append_mode=True)
+
+        try:
+            n = await self._call(run)
+            M_INGEST_ROWS.labels("otlp_logs").inc(n)
             return web.json_response({"partialSuccess": {}})
         except Exception as e:  # noqa: BLE001
             body_json, status = _error_json(e)

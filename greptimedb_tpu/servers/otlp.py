@@ -24,7 +24,9 @@ from greptimedb_tpu.servers.protocols import _pb_fields
 
 
 def parse_any_value(data: bytes):
-    """opentelemetry.proto.common.v1.AnyValue → typed python value."""
+    """opentelemetry.proto.common.v1.AnyValue → typed python value,
+    including composites (array[5], kvlist[6], bytes[7]) — log/span
+    attributes carry them and logs.rs preserves them."""
     for f, _wt, v in _pb_fields(data):
         if f == 1:
             return v.decode("utf-8", "replace")
@@ -34,6 +36,18 @@ def parse_any_value(data: bytes):
             return _signed(v)
         if f == 4:
             return struct.unpack("<d", v)[0]
+        if f == 5:  # ArrayValue{values=1}
+            return [parse_any_value(x) for ff, _w, x in _pb_fields(v)
+                    if ff == 1]
+        if f == 6:  # KeyValueList{values=1}
+            out = {}
+            for ff, _w, x in _pb_fields(v):
+                if ff == 1:
+                    k, val = parse_key_value(x)
+                    out[k] = val
+            return out
+        if f == 7:  # bytes
+            return v.hex()
     return None
 
 
@@ -214,3 +228,100 @@ def _safe_tag(k: str) -> str:
     """Attribute keys colliding with reserved output columns are renamed
     (an attribute literally named 'ts' or 'val' would corrupt the batch)."""
     return k + "_attr" if k in ("ts", "val") else k
+
+
+# ---------------------------------------------------------------------------
+# OTLP logs (reference src/servers/src/otlp/logs.rs)
+# ---------------------------------------------------------------------------
+
+def parse_otlp_logs(body: bytes) -> list[dict]:
+    """ExportLogsServiceRequest → flat rows (reference logs.rs column
+    model: timestamp, trace/span ids, severity, body, and the three
+    attribute scopes as JSON strings).
+
+    Wire: ExportLogsServiceRequest.resource_logs[1] → ResourceLogs{
+    resource[1]{attributes[1]}, scope_logs[2]: ScopeLogs{scope[1]{name[1],
+    version[2]}, log_records[2]: LogRecord{time_unix_nano[1] fixed64,
+    severity_number[2], severity_text[3], body[5], attributes[6],
+    flags[8] fixed32, trace_id[9], span_id[10],
+    observed_time_unix_nano[11] fixed64}}}."""
+    import json as _json
+
+    rows: list[dict] = []
+    for f, _wt, rl in _pb_fields(body):
+        if f != 1:
+            continue
+        resource_attrs: dict = {}
+        scope_logs = []
+        for f2, _wt2, v2 in _pb_fields(rl):
+            if f2 == 1:  # Resource
+                for f3, _wt3, v3 in _pb_fields(v2):
+                    if f3 == 1:
+                        k, val = parse_key_value(v3)
+                        resource_attrs[k] = val
+            elif f2 == 2:
+                scope_logs.append(v2)
+        for sl in scope_logs:
+            scope_name = scope_version = ""
+            scope_attrs: dict = {}
+            records = []
+            for f2, _wt2, v2 in _pb_fields(sl):
+                if f2 == 1:  # InstrumentationScope
+                    for f3, _wt3, v3 in _pb_fields(v2):
+                        if f3 == 1:
+                            scope_name = v3.decode("utf-8", "replace")
+                        elif f3 == 2:
+                            scope_version = v3.decode("utf-8", "replace")
+                        elif f3 == 3:
+                            k, val = parse_key_value(v3)
+                            scope_attrs[k] = val
+                elif f2 == 2:
+                    records.append(v2)
+            for rec in records:
+                ts_ns = obs_ns = 0
+                sev_num = 0
+                sev_text = ""
+                body_val = None
+                attrs: dict = {}
+                flags = 0
+                trace_id = span_id = ""
+                for f3, wt3, v3 in _pb_fields(rec):
+                    if f3 == 1:
+                        ts_ns = _fixed64_u(v3)
+                    elif f3 == 2:
+                        sev_num = v3
+                    elif f3 == 3:
+                        sev_text = v3.decode("utf-8", "replace")
+                    elif f3 == 5:
+                        body_val = parse_any_value(v3)
+                    elif f3 == 6:
+                        k, val = parse_key_value(v3)
+                        attrs[k] = val
+                    elif f3 == 8:
+                        flags = int.from_bytes(v3, "little") if (
+                            isinstance(v3, bytes)) else int(v3)
+                    elif f3 == 9:
+                        trace_id = v3.hex()
+                    elif f3 == 10:
+                        span_id = v3.hex()
+                    elif f3 == 11:
+                        obs_ns = _fixed64_u(v3)
+                ns = ts_ns or obs_ns
+                rows.append({
+                    "ts": ns // 1_000_000,
+                    "trace_id": trace_id,
+                    "span_id": span_id,
+                    "trace_flags": int(flags),
+                    "scope_name": scope_name,
+                    "scope_version": scope_version,
+                    "severity_number": int(sev_num),
+                    "severity_text": sev_text,
+                    "body": (body_val if isinstance(body_val, str)
+                             else _json.dumps(body_val, ensure_ascii=False)),
+                    "log_attributes": _json.dumps(attrs, ensure_ascii=False),
+                    "scope_attributes": _json.dumps(scope_attrs,
+                                                    ensure_ascii=False),
+                    "resource_attributes": _json.dumps(resource_attrs,
+                                                       ensure_ascii=False),
+                })
+    return rows

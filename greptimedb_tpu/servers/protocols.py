@@ -349,3 +349,73 @@ def parse_loki_push(body: bytes) -> list[tuple[dict, str, int]]:
         for ts_ms, line in entries:
             rows.append((labels, line, ts_ms))
     return rows
+
+
+# ---------------------------------------------------------------------------
+# Prometheus remote read (snappy prometheus.ReadRequest/ReadResponse)
+# Reference: src/servers/src/http/prom_store.rs + src/servers/src/prom_store.rs
+# ---------------------------------------------------------------------------
+
+# LabelMatcher.Type enum (remote.proto): EQ=0, NEQ=1, RE=2, NRE=3
+_READ_MATCHER_OPS = {0: "=", 1: "!=", 2: "=~", 3: "!~"}
+
+
+def parse_remote_read(body: bytes) -> list[dict]:
+    """prometheus.ReadRequest → [{start_ms, end_ms,
+    matchers: [(op, name, value)]}] (hints are advisory; ignored)."""
+    queries: list[dict] = []
+    for f, _wt, qb in _pb_fields(body):
+        if f != 1:  # queries
+            continue
+        q = {"start_ms": 0, "end_ms": 0, "matchers": []}
+        for f2, _wt2, v2 in _pb_fields(qb):
+            if f2 == 1:
+                q["start_ms"] = _zigzag_or_signed(v2)
+            elif f2 == 2:
+                q["end_ms"] = _zigzag_or_signed(v2)
+            elif f2 == 3:  # LabelMatcher{type=1, name=2, value=3}
+                mtype, mname, mval = 0, "", ""
+                for f3, _wt3, v3 in _pb_fields(v2):
+                    if f3 == 1:
+                        mtype = v3
+                    elif f3 == 2:
+                        mname = v3.decode("utf-8")
+                    elif f3 == 3:
+                        mval = v3.decode("utf-8")
+                op = _READ_MATCHER_OPS.get(mtype)
+                if op is None:
+                    raise InvalidArguments(
+                        f"unknown matcher type {mtype}")
+                q["matchers"].append((op, mname, mval))
+        queries.append(q)
+    return queries
+
+
+from greptimedb_tpu.utils.proto import (  # the ONE wire encoder
+    pb_len as _pb_len, pb_tag as _pb_tag, pb_varint as _pb_varint,
+)
+
+
+def encode_read_response(
+    results: list[list[tuple[dict, list[tuple[float, int]]]]],
+) -> bytes:
+    """[(labels, [(value, ts_ms), ...]), ...] per query →
+    prometheus.ReadResponse bytes (caller snappy-compresses)."""
+    import struct
+
+    out = bytearray()
+    for series_list in results:
+        qr = bytearray()
+        for labels, samples in series_list:
+            ts_msg = bytearray()
+            for name in sorted(labels):
+                lab = _pb_len(1, name.encode()) + _pb_len(
+                    2, str(labels[name]).encode())
+                ts_msg += _pb_len(1, lab)
+            for value, ts in samples:
+                smp = (_pb_tag(1, 1) + struct.pack("<d", float(value))
+                       + _pb_tag(2, 0) + _pb_varint(int(ts) & ((1 << 64) - 1)))
+                ts_msg += _pb_len(2, smp)
+            qr += _pb_len(1, bytes(ts_msg))
+        out += _pb_len(1, bytes(qr))
+    return bytes(out)

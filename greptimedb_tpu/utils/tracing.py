@@ -20,30 +20,10 @@ import threading
 import time
 import urllib.request
 
-# ---- protobuf wire encoding (mirror of servers/protocols._pb_fields) ----
-
-
-def _varint(v: int) -> bytes:
-    out = b""
-    while True:
-        b7 = v & 0x7F
-        v >>= 7
-        out += bytes([b7 | (0x80 if v else 0)])
-        if not v:
-            return out
-
-
-def _field(num: int, payload: bytes) -> bytes:
-    """Length-delimited field."""
-    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
-
-
-def _vint_field(num: int, v: int) -> bytes:
-    return _varint(num << 3) + _varint(v)
-
-
-def _fixed64_field(num: int, v: int) -> bytes:
-    return _varint((num << 3) | 1) + struct.pack("<Q", v)
+from greptimedb_tpu.utils.proto import (  # the ONE wire encoder
+    pb_fixed64 as _fixed64_field, pb_len as _field, pb_varint as _varint,
+    pb_vint_field as _vint_field,
+)
 
 
 def _kv(key: str, value: str) -> bytes:

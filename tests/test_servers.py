@@ -100,20 +100,9 @@ class TestLineProtocol:
                 parse_line_protocol(bad)
 
 
-def _pb_varint(v: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = v & 0x7F
-        v >>= 7
-        if v:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
-
-
-def _pb_len(field: int, payload: bytes) -> bytes:
-    return _pb_varint((field << 3) | 2) + _pb_varint(len(payload)) + payload
+from greptimedb_tpu.utils.proto import (
+    pb_len as _pb_len, pb_varint as _pb_varint,
+)
 
 
 def make_write_request(series: list[tuple[dict, list[tuple[float, int]]]]) -> bytes:
@@ -943,3 +932,166 @@ class TestGcAndMetaSnapshot:
             assert "__joinrow__" not in r.column_names
         finally:
             db.close()
+
+
+def _decode_read_response(raw: bytes) -> list[list[tuple[dict, list]]]:
+    from greptimedb_tpu.servers.protocols import _pb_fields
+
+    results = []
+    for f, _wt, qr in _pb_fields(raw):
+        if f != 1:
+            continue
+        series = []
+        for f2, _wt2, ts_msg in _pb_fields(qr):
+            if f2 != 1:
+                continue
+            labels, samples = {}, []
+            for f3, _wt3, v3 in _pb_fields(ts_msg):
+                if f3 == 1:
+                    name = value = ""
+                    for f4, _wt4, v4 in _pb_fields(v3):
+                        if f4 == 1:
+                            name = v4.decode()
+                        elif f4 == 2:
+                            value = v4.decode()
+                    labels[name] = value
+                elif f3 == 2:
+                    val, ts = 0.0, 0
+                    for f4, wt4, v4 in _pb_fields(v3):
+                        if f4 == 1:
+                            val = struct.unpack("<d", v4)[0]
+                        elif f4 == 2:
+                            ts = v4
+                    samples.append((val, ts))
+            series.append((labels, samples))
+        results.append(series)
+    return results
+
+
+class TestPromRemoteRead:
+    def test_write_then_remote_read(self, server):
+        ts0 = 1700001000000
+        pb = make_write_request([
+            ({"__name__": "rr_metric", "job": "api", "inst": "a"},
+             [(1.5, ts0), (2.5, ts0 + 10_000)]),
+            ({"__name__": "rr_metric", "job": "web", "inst": "b"},
+             [(9.0, ts0 + 5_000)]),
+        ])
+        code, _ = http(server, "/v1/prometheus/write", method="POST",
+                       body=snappy.compress(pb),
+                       headers={"Content-Encoding": "snappy"})
+        assert code == 204
+        # ReadRequest{queries=1:{start=1,end=2,matchers=3:{type=1,name=2,value=3}}}
+        def matcher(mtype, name, value):
+            m = b""
+            if mtype:
+                m += _pb_varint(1 << 3) + _pb_varint(mtype)
+            m += _pb_len(2, name.encode()) + _pb_len(3, value.encode())
+            return _pb_len(3, m)
+
+        q = (_pb_varint(1 << 3) + _pb_varint(ts0 & ((1 << 64) - 1))
+             + _pb_varint(2 << 3) + _pb_varint((ts0 + 60_000) & ((1 << 64) - 1))
+             + matcher(0, "__name__", "rr_metric")
+             + matcher(0, "job", "api"))
+        req = _pb_len(1, q)
+        code, raw = http(server, "/v1/prometheus/read", method="POST",
+                         body=snappy.compress(req),
+                         headers={"Content-Encoding": "snappy"})
+        assert code == 200, raw
+        results = _decode_read_response(snappy.decompress(raw))
+        assert len(results) == 1
+        series = results[0]
+        assert len(series) == 1
+        labels, samples = series[0]
+        assert labels["__name__"] == "rr_metric"
+        assert labels["job"] == "api" and labels["inst"] == "a"
+        assert samples == [(1.5, ts0), (2.5, ts0 + 10_000)]
+
+    def test_regex_matcher_and_missing_metric(self, server):
+        def matcher(mtype, name, value):
+            m = b""
+            if mtype:
+                m += _pb_varint(1 << 3) + _pb_varint(mtype)
+            m += _pb_len(2, name.encode()) + _pb_len(3, value.encode())
+            return _pb_len(3, m)
+
+        ts0 = 1700001000000
+        q = (_pb_varint(1 << 3) + _pb_varint(0)
+             + _pb_varint(2 << 3) + _pb_varint((ts0 + 60_000))
+             + matcher(0, "__name__", "rr_metric")
+             + matcher(2, "job", "a.*|w.*"))
+        code, raw = http(server, "/v1/prometheus/read", method="POST",
+                         body=snappy.compress(_pb_len(1, q)),
+                         headers={"Content-Encoding": "snappy"})
+        assert code == 200
+        got = _decode_read_response(snappy.decompress(raw))
+        assert len(got[0]) == 2  # both series match the regex
+        # unknown metric -> empty result, not an error
+        q2 = (_pb_varint(1 << 3) + _pb_varint(0)
+              + _pb_varint(2 << 3) + _pb_varint(ts0)
+              + matcher(0, "__name__", "nope"))
+        code, raw = http(server, "/v1/prometheus/read", method="POST",
+                         body=snappy.compress(_pb_len(1, q2)),
+                         headers={"Content-Encoding": "snappy"})
+        assert code == 200
+        assert _decode_read_response(snappy.decompress(raw)) == [[]]
+
+
+def make_otlp_logs(records: list[dict]) -> bytes:
+    """Build an ExportLogsServiceRequest from simple record dicts."""
+    def any_str(s):
+        return _pb_len(1, s.encode())
+
+    def kv(k, v):
+        return _pb_len(1, k.encode()) + _pb_len(2, any_str(v))
+
+    recs = b""
+    for r in records:
+        body = b""
+        body += _pb_varint((1 << 3) | 1) + struct.pack(
+            "<Q", r["ts_ns"])  # time_unix_nano fixed64
+        body += _pb_varint(2 << 3) + _pb_varint(r.get("severity_number", 9))
+        body += _pb_len(3, r.get("severity_text", "INFO").encode())
+        body += _pb_len(5, any_str(r["body"]))
+        for k, v in r.get("attrs", {}).items():
+            body += _pb_len(6, kv(k, v))
+        if r.get("trace_id"):
+            body += _pb_len(9, bytes.fromhex(r["trace_id"]))
+        recs += _pb_len(2, body)
+    scope = _pb_len(1, _pb_len(1, b"my-lib") + _pb_len(2, b"1.2.3"))
+    scope_logs = _pb_len(2, scope + recs)
+    resource = _pb_len(1, _pb_len(1, kv("service.name", "checkout")))
+    return _pb_len(1, resource + scope_logs)
+
+
+class TestOtlpLogs:
+    def test_ingest_and_query(self, server):
+        payload = make_otlp_logs([
+            {"ts_ns": 1700000001000 * 10**6, "body": "user login ok",
+             "attrs": {"user": "alice"}, "trace_id": "ab" * 16},
+            {"ts_ns": 1700000002000 * 10**6, "body": "payment failed",
+             "severity_text": "ERROR", "severity_number": 17},
+        ])
+        code, raw = http(server, "/v1/otlp/v1/logs", method="POST",
+                         body=payload)
+        assert code == 200, raw
+        q = urllib.parse.urlencode({
+            "sql": "SELECT severity_text, body, trace_id, "
+                   "resource_attributes FROM opentelemetry_logs ORDER BY ts"})
+        code, raw = http(server, f"/v1/sql?{q}")
+        rows = json.loads(raw)["output"][0]["records"]["rows"]
+        assert len(rows) == 2
+        assert rows[0][1] == "user login ok" and rows[0][2] == "ab" * 16
+        assert rows[1][0] == "ERROR"
+        assert json.loads(rows[0][3]) == {"service.name": "checkout"}
+
+    def test_custom_table_header(self, server):
+        payload = make_otlp_logs([
+            {"ts_ns": 1700000003000 * 10**6, "body": "x"}])
+        code, _ = http(server, "/v1/otlp/v1/logs", method="POST",
+                       body=payload,
+                       headers={"x-greptime-log-table-name": "applogs"})
+        assert code == 200
+        q = urllib.parse.urlencode({"sql": "SELECT count(*) FROM applogs"})
+        code, raw = http(server, f"/v1/sql?{q}")
+        assert json.loads(raw)["output"][0]["records"]["rows"] == [[1]]
