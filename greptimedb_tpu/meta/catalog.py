@@ -154,6 +154,12 @@ class CatalogManager:
         self.kv.put_json(key, info.to_dict())
         return info
 
+    def get_engine(self, db: str, name: str) -> str | None:
+        """Engine name only, without rebuilding the Schema — the per-query
+        view check on the hot SELECT path."""
+        raw = self.kv.get_json(self._table_key(db, name))
+        return None if raw is None else raw.get("engine", "mito")
+
     def get_table(self, db: str, name: str) -> TableInfo:
         raw = self.kv.get_json(self._table_key(db, name))
         if raw is None:
@@ -165,6 +171,58 @@ class CatalogManager:
 
     def update_table(self, info: TableInfo) -> None:
         self.kv.put_json(self._table_key(info.database, info.name), info.to_dict())
+
+    def restore_table(self, info: TableInfo) -> None:
+        """Re-register a previously dropped table verbatim (undrop —
+        reference src/common/meta/src/ddl/drop_table.rs recycle bin):
+        table_id and region_ids are preserved so the on-disk region data
+        lines up."""
+        key = self._table_key(info.database, info.name)
+        if self.kv.get(key) is not None:
+            raise TableAlreadyExists(f"{info.database}.{info.name}")
+        self.kv.put_json(key, info.to_dict())
+
+    # ---- recycle bin (reference purge_dropped_table.rs) ----------------
+    @staticmethod
+    def _recycle_key(db: str, name: str, table_id: int,
+                     dropped_at_ms: int) -> str:
+        # table_id disambiguates same-name drops landing in one ms
+        return f"__recycle__/{db}.{name}/{table_id}/{dropped_at_ms}"
+
+    def recycle_put(self, info: TableInfo, dropped_at_ms: int) -> None:
+        self.kv.put_json(
+            self._recycle_key(info.database, info.name, info.table_id,
+                              dropped_at_ms),
+            {"info": info.to_dict(), "dropped_at_ms": dropped_at_ms},
+        )
+
+    def recycle_list(self, db: str | None = None) -> list[dict]:
+        """Entries newest-first: [{info, dropped_at_ms, key}]."""
+        import json as _json
+
+        out = []
+        for key, raw_bytes in self.kv.range("__recycle__/"):
+            raw = _json.loads(raw_bytes)
+            if db is not None and raw["info"].get("database") != db:
+                continue
+            raw["key"] = key
+            out.append(raw)
+        out.sort(key=lambda e: -e["dropped_at_ms"])
+        return out
+
+    def recycle_take(self, db: str, name: str) -> dict | None:
+        """Pop the NEWEST recycle entry for db.name (undrop restores the
+        most recent drop)."""
+        matches = [e for e in self.recycle_list(db)
+                   if e["info"].get("name") == name]
+        if not matches:
+            return None
+        entry = matches[0]
+        self.kv.delete(entry["key"])
+        return entry
+
+    def recycle_remove(self, key: str) -> None:
+        self.kv.delete(key)
 
     def drop_table(self, db: str, name: str, if_exists: bool = False) -> TableInfo | None:
         key = self._table_key(db, name)

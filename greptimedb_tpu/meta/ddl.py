@@ -137,6 +137,8 @@ class DropTableProcedure(Procedure):
         return [f"table/{self.state['db']}.{self.state['name']}"]
 
     def execute(self, ctx: ProcedureContext) -> Status:
+        import time as _time
+
         db = _db_service(ctx)
         st = self.state
         step = st.get("step", "metadata")
@@ -146,24 +148,42 @@ class DropTableProcedure(Procedure):
             if db.catalog.table_exists(st["db"], st["name"]):
                 info = db.catalog.get_table(st["db"], st["name"])
                 st["info"] = info.to_dict()
-                st["step"] = "delete"
+                st["step"] = "recycle"
                 return Status.executing()
             if st.get("info") is not None:
                 st["step"] = "regions"  # resume: entry already deleted
                 return Status.executing(persist=False)
             return Status.done()  # if_exists pre-checked by the caller
+        if step == "recycle":
+            # soft delete (reference purge_dropped_table.rs): the catalog
+            # entry moves to the recycle bin; region data stays on disk
+            # until ADMIN undrop_table or a purge sweep.  Recycle-put is
+            # idempotent on resume (same dropped_at key rewritten).
+            from greptimedb_tpu.meta.catalog import TableInfo
+
+            info = TableInfo.from_dict(st["info"])
+            if info.engine in ("mito", "metric_physical"):
+                if "dropped_at_ms" not in st:
+                    st["dropped_at_ms"] = int(_time.time() * 1000)
+                db.catalog.recycle_put(info, st["dropped_at_ms"])
+            st["step"] = "delete"
+            return Status.executing()
         if step == "delete":
             db.catalog.drop_table(st["db"], st["name"], if_exists=True)
             st["step"] = "regions"
             return Status.executing()
         if step == "regions":
             info = st["info"]
+            soft = info["engine"] in ("mito", "metric_physical")
             for rid in info["region_ids"]:
                 if info["engine"] != "file":
-                    try:
-                        db.regions.drop_region(rid)
-                    except RegionNotFound:
-                        pass  # resume: already dropped
+                    if soft:
+                        db.regions.close_region(rid)
+                    else:
+                        try:
+                            db.regions.drop_region(rid)
+                        except RegionNotFound:
+                            pass  # resume: already dropped
                 db.cache.invalidate_region(rid)
             return Status.done(output=info)
         raise StorageError(f"drop_table: unknown step {step!r}")

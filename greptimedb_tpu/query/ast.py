@@ -396,6 +396,25 @@ class AlterTable(Statement):
 
 
 @dataclass
+class CreateView(Statement):
+    """CREATE [OR REPLACE] VIEW name AS <select> (reference
+    src/common/meta/src/ddl/create_view.rs). ``definition`` keeps the
+    SELECT's verbatim SQL text — the kv-stored form, re-parsed and
+    expanded at query time."""
+
+    name: str
+    definition: str
+    or_replace: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropView(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class ShowTables(Statement):
     database: str | None = None
     like: str | None = None

@@ -284,3 +284,76 @@ def execute_join(engine, sel: Select):
     inner = QueryEngine(SingleTableProvider(region))
     inner.dispatch = engine.dispatch  # nested subqueries still resolve
     return inner.execute_select(staged)
+
+
+def stage_result_region(res):
+    """Materialize a QueryResult into an ephemeral in-memory region —
+    the staging half of view expansion (reference: views are logical
+    plans substituted at plan time, src/common/meta/src/ddl/
+    create_view.rs; here the definition evaluates first and the outer
+    query runs over the staged rows).
+
+    Column mapping: strings → TAGS (dictionary encoding keeps the grid /
+    group-by machinery effective), the FIRST timestamp-typed column →
+    TIME INDEX, ints/bools → INT64 FIELDS, everything else → FLOAT64.
+    The region is append-mode: view output rows may legitimately share
+    (tags, ts) and must never dedup."""
+    import numpy as np
+
+    from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+    from greptimedb_tpu.datatypes.types import ConcreteDataType, SemanticType
+    from greptimedb_tpu.storage.manifest import Manifest
+    from greptimedb_tpu.storage.object_store import MemoryObjectStore
+    from greptimedb_tpu.storage.region import Region, RegionOptions
+
+    n = len(res.rows)
+    names = res.column_names
+    types = res.column_types or ["String"] * len(names)
+    ts_col = next(
+        (nm for nm, t in zip(names, types) if t.startswith("Timestamp")),
+        None,
+    )
+    schema_cols: list[ColumnSchema] = []
+    data: dict[str, np.ndarray] = {}
+    if ts_col is None:
+        schema_cols.append(ColumnSchema(
+            "__viewrow__", ConcreteDataType.TIMESTAMP_MILLISECOND,
+            SemanticType.TIMESTAMP, nullable=False))
+        data["__viewrow__"] = np.arange(n, dtype=np.int64)
+    for i, (nm, t) in enumerate(zip(names, types)):
+        vals = [r[i] for r in res.rows]
+        if nm == ts_col:
+            try:
+                dtype = ConcreteDataType(t)
+            except ValueError:
+                dtype = ConcreteDataType.TIMESTAMP_MILLISECOND
+            schema_cols.append(ColumnSchema(
+                nm, dtype, SemanticType.TIMESTAMP, nullable=False))
+            data[nm] = np.array(
+                [0 if v is None else int(v) for v in vals], dtype=np.int64)
+        elif t == "String":
+            schema_cols.append(ColumnSchema(
+                nm, ConcreteDataType.STRING, SemanticType.TAG))
+            data[nm] = np.array(
+                ["" if v is None else str(v) for v in vals], dtype=object)
+        elif t in ("Int64", "Int32", "Int16", "Int8", "UInt64", "UInt32",
+                   "Boolean") or t.startswith("Timestamp"):
+            schema_cols.append(ColumnSchema(
+                nm, ConcreteDataType.INT64, SemanticType.FIELD))
+            data[nm] = np.array(
+                [0 if v is None else int(v) for v in vals], dtype=np.int64)
+        else:
+            schema_cols.append(ColumnSchema(
+                nm, ConcreteDataType.FLOAT64, SemanticType.FIELD))
+            data[nm] = np.array(
+                [np.nan if v is None else float(v) for v in vals],
+                dtype=np.float64)
+    schema = Schema(tuple(schema_cols))
+    store = MemoryObjectStore()
+    manifest = Manifest.open(store, "region_1/manifest")
+    manifest.commit({"kind": "schema", "schema": schema.to_dict()})
+    region = Region(1, store, schema, manifest, None,
+                    RegionOptions(wal_enabled=False, append_mode=True))
+    if n:
+        region.write(data)
+    return region

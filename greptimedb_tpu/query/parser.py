@@ -11,7 +11,7 @@ import datetime
 
 from greptimedb_tpu.errors import SyntaxError_, Unsupported
 from greptimedb_tpu.query.ast import (
-    AlterTable, Between, BinaryOp, Case, Cast, Column, ColumnDef, CreateDatabase,
+    AlterTable, CreateView, DropView, Between, BinaryOp, Case, Cast, Column, ColumnDef, CreateDatabase,
     CreateFlow, CreateTable, Delete, DescribeTable, DropDatabase, DropFlow,
     DropTable, Explain, Expr, FuncCall, InList, InSubquery, Insert,
     IntervalLit, IsNull, JoinClause, ScalarSubquery,
@@ -696,6 +696,23 @@ class Parser:
             self.expect_kw("AS")
             q = self.select()
             return CreateFlow(name, sink, q, expire, comment, ine)
+        or_replace = False
+        if self.at_kw("OR"):
+            self.next()
+            self.expect_kw("REPLACE")
+            or_replace = True
+        if self.eat_kw("VIEW"):
+            ine = self._if_not_exists()
+            name = self.qualified_name()
+            self.expect_kw("AS")
+            start = self.peek().pos
+            self.select_or_union()  # validate eagerly; text is the store
+            end = (self.peek().pos if not self.at(Tok.EOF)
+                   else len(self.sql))
+            return CreateView(name, self.sql[start:end].strip(),
+                              or_replace=or_replace, if_not_exists=ine)
+        if or_replace:
+            raise Unsupported("CREATE OR REPLACE is only for VIEW")
         external = self.eat_kw("EXTERNAL")
         if self.eat_kw("TABLE"):
             ine = self._if_not_exists()
@@ -927,6 +944,9 @@ class Parser:
         if self.eat_kw("FLOW"):
             ie = self._if_exists()
             return DropFlow(self.qualified_name(), ie)
+        if self.eat_kw("VIEW"):
+            ie = self._if_exists()
+            return DropView(self.qualified_name(), ie)
         self.expect_kw("TABLE")
         ie = self._if_exists()
         names = [self.qualified_name()]

@@ -16,15 +16,16 @@ import numpy as np
 from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
 from greptimedb_tpu.datatypes.types import ConcreteDataType, SemanticType
 from greptimedb_tpu.errors import (
-    InvalidArguments, PlanError, TableNotFound, Unsupported,
+    InvalidArguments, PlanError, TableAlreadyExists, TableNotFound,
+    Unsupported,
 )
 from greptimedb_tpu.meta.catalog import DEFAULT_DB, CatalogManager, TableInfo
 from greptimedb_tpu.meta.kv import FileKv, KvBackend, MemoryKv
 from greptimedb_tpu.query.ast import (
     Admin, AlterTable, ColumnDef, CreateDatabase, CreateFlow, CreateTable,
-    Delete, DescribeTable, DropDatabase, DropFlow, DropTable, Explain, Insert,
-    Select, ShowCreateTable, ShowDatabases, ShowFlows, ShowTables, Statement,
-    Tql, TruncateTable, Use,
+    CreateView, Delete, DescribeTable, DropDatabase, DropFlow, DropTable,
+    DropView, Explain, Insert, Select, ShowCreateTable, ShowDatabases,
+    ShowFlows, ShowTables, Statement, Tql, TruncateTable, Use,
 )
 from greptimedb_tpu.query.engine import QueryEngine, QueryResult, TableProvider
 from greptimedb_tpu.query.exprs import TableContext
@@ -691,6 +692,19 @@ class GreptimeDB(TableProvider):
                 sel = copy.copy(stmt)
                 sel.table = f"{info.INFORMATION_SCHEMA}.{stmt.table}"
                 return info.execute(self, sel)
+            if stmt.table is not None:
+                vdb, vname = self._split_name(stmt.table)
+                if self.catalog.get_engine(vdb, vname) == "view":
+                    if stmt.joins:
+                        raise Unsupported(
+                            "views cannot participate in JOIN yet")
+                    return self._execute_view_select(
+                        stmt, self.catalog.get_table(vdb, vname))
+                for j in stmt.joins:
+                    jdb, jname = self._split_name(j.table)
+                    if self.catalog.get_engine(jdb, jname) == "view":
+                        raise Unsupported(
+                            "views cannot participate in JOIN yet")
             return self.engine.execute_select(stmt)
         if isinstance(stmt, Tql):
             return self._execute_tql(stmt)
@@ -698,6 +712,10 @@ class GreptimeDB(TableProvider):
             return self._explain(stmt)
         if isinstance(stmt, CreateTable):
             return self._create_table(stmt)
+        if isinstance(stmt, CreateView):
+            return self._create_view(stmt)
+        if isinstance(stmt, DropView):
+            return self._drop_view(stmt)
         if isinstance(stmt, CreateDatabase):
             self.catalog.create_database(stmt.name, stmt.if_not_exists)
             return QueryResult([], [], affected_rows=1)
@@ -847,6 +865,76 @@ class GreptimeDB(TableProvider):
         }))
         return QueryResult([], [], affected_rows=0)
 
+    def _create_view(self, stmt: CreateView) -> QueryResult:
+        """CREATE [OR REPLACE] VIEW: the definition SQL persists in the
+        catalog (reference src/common/meta/src/ddl/create_view.rs — view
+        metadata in kv, expanded at plan time)."""
+        db, name = self._split_name(stmt.name)
+        if self.catalog.table_exists(db, name):
+            existing = self.catalog.get_table(db, name)
+            if stmt.or_replace and existing.engine == "view":
+                self.catalog.drop_table(db, name)
+            elif stmt.if_not_exists:
+                return QueryResult([], [], affected_rows=0)
+            else:
+                raise TableAlreadyExists(f"{db}.{name}")
+        # cycle guard at definition time: a view may not reference itself
+        parsed = parse_sql(stmt.definition)
+        if not parsed or not isinstance(parsed[0], (Select,)) and (
+                parsed[0].__class__.__name__ != "Union"):
+            raise InvalidArguments("view definition must be a SELECT")
+        self.catalog.create_table(
+            db, name, Schema(tuple()), engine="view",
+            options={"definition": stmt.definition}, num_regions=0,
+        )
+        return QueryResult([], [], affected_rows=0)
+
+    def _drop_view(self, stmt: DropView) -> QueryResult:
+        db, name = self._split_name(stmt.name)
+        try:
+            info = self.catalog.get_table(db, name)
+        except TableNotFound:
+            if stmt.if_exists:
+                return QueryResult([], [], affected_rows=0)
+            raise
+        if info.engine != "view":
+            raise InvalidArguments(f"{db}.{name} is a table, not a view")
+        self.catalog.drop_table(db, name)
+        return QueryResult([], [], affected_rows=0)
+
+    _VIEW_DEPTH_LIMIT = 16
+
+    def _execute_view_select(self, sel: Select, vinfo) -> QueryResult:
+        """Expand a view at query time: evaluate the stored definition
+        through the full dispatch (views over views, unions, joins all
+        work), stage the result as an ephemeral in-memory region, and run
+        the outer SELECT over it."""
+        import dataclasses
+
+        depth = getattr(self._proc_local, "view_depth", 0)
+        if depth >= self._VIEW_DEPTH_LIMIT:
+            raise PlanError(
+                f"view expansion exceeded depth {self._VIEW_DEPTH_LIMIT} "
+                "(recursive views?)")
+        self._proc_local.view_depth = depth + 1
+        try:
+            inner_stmts = parse_sql(vinfo.options["definition"])
+            inner_res = self.execute_statement(inner_stmts[0])
+        finally:
+            self._proc_local.view_depth = depth
+        from greptimedb_tpu.query.engine import (
+            QueryEngine, SingleTableProvider,
+        )
+        from greptimedb_tpu.query.join import stage_result_region
+
+        region = stage_result_region(inner_res)
+        staged = dataclasses.replace(
+            sel, table="__view__", table_alias=None,
+        )
+        inner = QueryEngine(SingleTableProvider(region, self.timezone))
+        inner.dispatch = self.execute_statement
+        return inner.execute_select(staged)
+
     def _drop_table(self, stmt: DropTable) -> QueryResult:
         from greptimedb_tpu.storage.metric_engine import PHYSICAL_TABLE
 
@@ -877,6 +965,9 @@ class GreptimeDB(TableProvider):
                 if not stmt.if_exists:
                     raise TableNotFound(f"{db}.{name}")
                 continue
+            if existing.engine == "view":
+                raise InvalidArguments(
+                    f"{db}.{name} is a view — use DROP VIEW")
             if existing.engine == "file":
                 view = getattr(self, "_file_views", {}).pop((db, name), None)
                 if view is not None:
@@ -927,6 +1018,45 @@ class GreptimeDB(TableProvider):
             if name == "compact_region":
                 region.compact()
             return result("ok")
+        if name == "undrop_table":
+            # restore the NEWEST recycle-bin entry (reference recycle bin,
+            # src/common/meta/src/ddl/drop_table.rs + purge_dropped_table)
+            if len(args) != 1:
+                raise InvalidArguments("ADMIN undrop_table(table_name)")
+            dbname, tname = self._split_name(str(args[0]))
+            if self.catalog.table_exists(dbname, tname):
+                raise TableAlreadyExists(
+                    f"{dbname}.{tname} exists; cannot undrop over it")
+            entry = self.catalog.recycle_take(dbname, tname)
+            if entry is None:
+                raise TableNotFound(
+                    f"{dbname}.{tname} is not in the recycle bin")
+            info = TableInfo.from_dict(entry["info"])
+            self.catalog.restore_table(info)
+            for rid in info.region_ids:
+                self.regions.open_region(rid)
+            return result("ok")
+        if name == "purge_recycle_bin":
+            # hard-delete recycled tables older than the given duration
+            # (default: everything)
+            from greptimedb_tpu.utils.config import parse_duration_ms
+
+            import time as _time
+
+            older_ms = parse_duration_ms(str(args[0])) if args else 0
+            cutoff = int(_time.time() * 1000) - (older_ms or 0)
+            purged = 0
+            for entry in self.catalog.recycle_list():
+                if entry["dropped_at_ms"] > cutoff:
+                    continue
+                for rid in entry["info"].get("region_ids", []):
+                    try:
+                        self.regions.drop_region(rid)
+                    except Exception:  # noqa: BLE001 — already gone
+                        pass
+                self.catalog.recycle_remove(entry["key"])
+                purged += 1
+            return result({"purged_tables": purged})
         if name == "reconcile_table":
             if not args:
                 raise InvalidArguments(

@@ -910,6 +910,13 @@ class RegionEngine:
                 deleted.append(path)
         return deleted
 
+    def close_region(self, region_id: int) -> None:
+        """Detach a region WITHOUT deleting its objects (recycle-bin drop:
+        the data must survive until undrop or purge)."""
+        region = self.regions.pop(region_id, None)
+        if region is not None:
+            region.wal.close()
+
     def drop_region(self, region_id: int) -> None:
         region = self.regions.pop(region_id, None)
         prefix = f"region_{region_id}"

@@ -1,0 +1,12 @@
+CREATE TABLE metrics (host STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+INSERT INTO metrics VALUES ('a', 1000, 1.0), ('a', 2000, 3.0), ('b', 1000, 10.0), ('b', 2000, 20.0);
+CREATE VIEW host_avg AS SELECT host, avg(v) AS av FROM metrics GROUP BY host;
+SELECT host, av FROM host_avg ORDER BY host;
+SELECT host FROM host_avg WHERE av > 5 ORDER BY host;
+SHOW TABLES;
+CREATE OR REPLACE VIEW host_avg AS SELECT host, max(v) AS av FROM metrics GROUP BY host;
+SELECT host, av FROM host_avg ORDER BY host;
+DROP VIEW host_avg;
+DROP TABLE metrics;
+ADMIN undrop_table('metrics');
+SELECT count(*) FROM metrics

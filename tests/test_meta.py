@@ -473,8 +473,11 @@ class TestDdlProcedures:
             db2.close()
 
     def test_resume_drop_after_metadata_crash(self, tmp_path):
-        """Crash after the catalog delete but before regions are removed:
-        restart must finish dropping the orphan regions."""
+        """Crash after the catalog delete but before regions are
+        detached: restart must finish the drop.  Since the recycle bin
+        (soft delete), mito region DATA must survive the resumed drop —
+        it belongs to the recycle entry until undrop/purge — but the
+        region must not stay attached to the engine."""
         from greptimedb_tpu.standalone import GreptimeDB
 
         db = GreptimeDB(str(tmp_path))
@@ -484,19 +487,24 @@ class TestDdlProcedures:
         info = db.catalog.get_table("public", "dt")
         rid = info.region_ids[0]
         db.catalog.drop_table("public", "dt")
+        db.catalog.recycle_put(info, dropped_at_ms=123)
         db.kv.put_json("__procedure/deadbeef0002", {
             "type": "ddl/drop_table",
             "state": {"db": "public", "name": "dt", "if_exists": False,
-                      "info": info.to_dict(), "step": "regions"},
+                      "info": info.to_dict(), "step": "regions",
+                      "dropped_at_ms": 123},
             "status": "running", "ts": 0,
         })
         db.close()
         db2 = GreptimeDB(str(tmp_path))
         try:
-            from greptimedb_tpu.errors import RegionNotFound
-
-            with pytest.raises(RegionNotFound):
-                db2.regions.open_region(rid)
+            assert rid not in db2.regions.regions  # detached by resume
+            r = db2.regions.open_region(rid)  # data retained for undrop
+            assert len(r.scan_host()["ts"]) == 1
+            db2.regions.close_region(rid)
+            res = db2.sql("ADMIN undrop_table('dt')")
+            assert res.rows[0][0] == "ok"
+            assert db2.sql("SELECT count(*) FROM dt").rows == [[1]]
         finally:
             db2.close()
 

@@ -1,0 +1,137 @@
+"""CREATE VIEW / DROP VIEW / recycle-bin undrop (reference
+src/common/meta/src/ddl/create_view.rs, purge_dropped_table.rs)."""
+
+import pytest
+
+from greptimedb_tpu.errors import (
+    PlanError, TableAlreadyExists, TableNotFound,
+)
+from greptimedb_tpu.standalone import GreptimeDB
+
+
+@pytest.fixture
+def db(tmp_path):
+    d = GreptimeDB(str(tmp_path / "v"))
+    d.sql("CREATE TABLE cpu (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+          "u DOUBLE, PRIMARY KEY (host))")
+    d.sql("INSERT INTO cpu VALUES " + ",".join(
+        f"('h{i % 4}',{1700000000000 + i * 1000},{i % 7})"
+        for i in range(400)))
+    yield d
+    d.close()
+
+
+class TestViews:
+    def test_view_over_aggregate(self, db):
+        db.sql("CREATE VIEW busy AS SELECT host, date_trunc('minute', ts) "
+               "AS m, avg(u) AS au FROM cpu GROUP BY host, m")
+        r = db.sql("SELECT host, count(*) FROM busy GROUP BY host "
+                   "ORDER BY host")
+        assert [row[0] for row in r.rows] == ["h0", "h1", "h2", "h3"]
+        # WHERE + projection over the view
+        assert db.sql("SELECT count(*) FROM busy WHERE host = 'h2'"
+                      ).rows[0][0] > 0
+
+    def test_nested_views_and_replace(self, db):
+        db.sql("CREATE VIEW v1 AS SELECT host, u FROM cpu WHERE u > 3")
+        db.sql("CREATE VIEW v2 AS SELECT host, count(*) AS c FROM v1 "
+               "GROUP BY host")
+        assert db.sql("SELECT sum(c) FROM v2").rows[0][0] == \
+            db.sql("SELECT count(*) FROM cpu WHERE u > 3").rows[0][0]
+        db.sql("CREATE OR REPLACE VIEW v1 AS SELECT host, u FROM cpu "
+               "WHERE u > 5")
+        assert db.sql("SELECT sum(c) FROM v2").rows[0][0] == \
+            db.sql("SELECT count(*) FROM cpu WHERE u > 5").rows[0][0]
+
+    def test_view_survives_reopen(self, db, tmp_path):
+        db.sql("CREATE VIEW vv AS SELECT host, u FROM cpu")
+        home = db.data_home
+        db.close()
+        db2 = GreptimeDB(home)
+        assert db2.sql("SELECT count(*) FROM vv").rows == [[400]]
+        db2.close()
+
+    def test_create_view_name_clash_and_drop(self, db):
+        with pytest.raises(TableAlreadyExists):
+            db.sql("CREATE VIEW cpu AS SELECT host, u FROM cpu")
+        db.sql("CREATE VIEW dv AS SELECT host, u FROM cpu")
+        with pytest.raises(Exception):
+            db.sql("DROP VIEW cpu")  # cpu is a table, not a view
+        db.sql("DROP VIEW dv")
+        with pytest.raises(TableNotFound):
+            db.sql("SELECT * FROM dv")
+        db.sql("DROP VIEW IF EXISTS dv")  # idempotent
+
+    def test_recursive_view_bounded(self, db):
+        db.sql("CREATE VIEW r1 AS SELECT host, u FROM cpu")
+        # redefine r1 in terms of itself via OR REPLACE
+        db.sql("CREATE OR REPLACE VIEW r1 AS SELECT host, u FROM r1")
+        with pytest.raises(PlanError):
+            db.sql("SELECT count(*) FROM r1")
+
+
+class TestRecycleBin:
+    def test_drop_undrop_roundtrip(self, db):
+        before = db.sql("SELECT count(*), sum(u) FROM cpu").rows
+        db.sql("DROP TABLE cpu")
+        with pytest.raises(TableNotFound):
+            db.sql("SELECT count(*) FROM cpu")
+        db.sql("ADMIN undrop_table('cpu')")
+        assert db.sql("SELECT count(*), sum(u) FROM cpu").rows == before
+        # inserts still work post-restore (WAL/seq state intact)
+        db.sql("INSERT INTO cpu VALUES ('h9', 1700009999000, 1.0)")
+        assert db.sql("SELECT count(*) FROM cpu").rows == [[401]]
+
+    def test_undrop_survives_restart(self, db):
+        db.sql("DROP TABLE cpu")
+        home = db.data_home
+        db.close()
+        db2 = GreptimeDB(home)
+        db2.sql("ADMIN undrop_table('cpu')")
+        assert db2.sql("SELECT count(*) FROM cpu").rows == [[400]]
+        db2.close()
+
+    def test_undrop_blocked_by_recreation(self, db):
+        db.sql("DROP TABLE cpu")
+        db.sql("CREATE TABLE cpu (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (h))")
+        with pytest.raises(TableAlreadyExists):
+            db.sql("ADMIN undrop_table('cpu')")
+
+    def test_purge_deletes_data(self, db):
+        db.sql("DROP TABLE cpu")
+        rid_dirs = [p for p in db.regions.store.list("")
+                    if p.startswith("region_")]
+        assert rid_dirs  # data still on disk while recycled
+        r = db.sql("ADMIN purge_recycle_bin()")
+        assert "1" in r.rows[0][0]
+        with pytest.raises(TableNotFound):
+            db.sql("ADMIN undrop_table('cpu')")
+
+    def test_purge_age_filter(self, db):
+        db.sql("DROP TABLE cpu")
+        r = db.sql("ADMIN purge_recycle_bin('7d')")  # too young to purge
+        assert "0" in r.rows[0][0]
+        db.sql("ADMIN undrop_table('cpu')")  # still restorable
+        assert db.sql("SELECT count(*) FROM cpu").rows == [[400]]
+
+
+def test_if_not_exists_and_join_guard(db):
+    db.sql("CREATE VIEW IF NOT EXISTS ine AS SELECT host, u FROM cpu")
+    db.sql("CREATE VIEW IF NOT EXISTS ine AS SELECT host FROM cpu")  # no-op
+    assert db.sql("SELECT count(*) FROM ine").rows == [[400]]
+    from greptimedb_tpu.errors import Unsupported
+
+    with pytest.raises(Unsupported):
+        db.sql("SELECT * FROM ine JOIN cpu ON ine.host = cpu.host")
+    with pytest.raises(Unsupported):
+        db.sql("SELECT * FROM cpu JOIN ine ON ine.host = cpu.host")
+
+
+def test_drop_table_on_view_rejected(db):
+    from greptimedb_tpu.errors import InvalidArguments
+
+    db.sql("CREATE VIEW pv AS SELECT host, u FROM cpu")
+    with pytest.raises(InvalidArguments):
+        db.sql("DROP TABLE pv")
+    assert db.sql("SELECT count(*) FROM pv").rows == [[400]]
