@@ -50,10 +50,22 @@ def cmd_standalone(args) -> int:
         db.user_provider = StaticUserProvider.from_lines(
             [str(u) for u in opts.auth.users]
         )
+    from greptimedb_tpu.utils.tls import TlsConfig, context_from_config
+
+    def _tls_ctx(o):
+        return context_from_config(
+            TlsConfig(cert_path=o.tls_cert_path or None,
+                      key_path=o.tls_key_path or None,
+                      mode=o.tls_mode),
+            opts.storage.data_home,
+        )
+
     host, port = opts.http.addr.rsplit(":", 1)
     servers = []
     try:
-        srv = HttpServer(db, host=host, port=int(port))
+        http_ctx = _tls_ctx(opts.http)
+        srv = HttpServer(db, host=host, port=int(port),
+                         ssl_context=http_ctx)
         srv.start()
         servers.append(srv)
         extra = []
@@ -61,7 +73,10 @@ def cmd_standalone(args) -> int:
             from greptimedb_tpu.servers.mysql import MysqlServer
 
             mh, mp = opts.mysql.addr.rsplit(":", 1)
-            mysql_srv = MysqlServer(db, host=mh, port=int(mp))
+            mysql_srv = MysqlServer(
+                db, host=mh, port=int(mp),
+                ssl_context=_tls_ctx(opts.mysql),
+                tls_require=opts.mysql.tls_mode == "require")
             mysql_srv.start()
             servers.append(mysql_srv)
             extra.append(f"mysql://{mh}:{mysql_srv.port}")
@@ -69,11 +84,17 @@ def cmd_standalone(args) -> int:
             from greptimedb_tpu.servers.postgres import PostgresServer
 
             ph, pp = opts.postgres.addr.rsplit(":", 1)
-            pg_srv = PostgresServer(db, host=ph, port=int(pp))
+            pg_srv = PostgresServer(
+                db, host=ph, port=int(pp),
+                ssl_context=_tls_ctx(opts.postgres),
+                auth_mode=opts.postgres.auth_mode,
+                tls_require=opts.postgres.tls_mode == "require")
             pg_srv.start()
             servers.append(pg_srv)
             extra.append(f"postgres://{ph}:{pg_srv.port}")
-        print(f"greptimedb-tpu standalone listening on http://{host}:{srv.port}"
+        scheme = "https" if http_ctx is not None else "http"
+        print("greptimedb-tpu standalone listening on "
+              f"{scheme}://{host}:{srv.port}"
               + (" " + " ".join(extra) if extra else "")
               + f" (data_home={opts.storage.data_home}, devices={jax.devices()})")
         import signal

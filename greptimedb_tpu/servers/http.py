@@ -114,7 +114,9 @@ class ThreadedAiohttpApp:
                 app = self.build_app()
                 runner = web.AppRunner(app)
                 loop.run_until_complete(runner.setup())
-                site = web.TCPSite(runner, self.host, self.port)
+                site = web.TCPSite(
+                    runner, self.host, self.port,
+                    ssl_context=getattr(self, "ssl_context", None))
                 loop.run_until_complete(site.start())
                 self._runner = runner
                 if self.port == 0:
@@ -145,10 +147,12 @@ class ThreadedAiohttpApp:
 
 
 class HttpServer(ThreadedAiohttpApp):
-    def __init__(self, db, host: str = "127.0.0.1", port: int = 4000):
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 4000, *,
+                 ssl_context=None):
         self.db = db
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
         self._thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = threading.Event()

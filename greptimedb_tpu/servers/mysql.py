@@ -26,6 +26,7 @@ CLIENT_SECURE_CONNECTION = 0x8000
 CLIENT_PLUGIN_AUTH = 0x80000
 CLIENT_CONNECT_WITH_DB = 0x8
 CLIENT_DEPRECATE_EOF = 0x1000000
+CLIENT_SSL = 0x800
 
 SERVER_CAPS = (
     CLIENT_LONG_PASSWORD | CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION
@@ -112,15 +113,18 @@ class _Conn:
     async def handshake(self) -> bool:
         import os as _os
 
+        caps = SERVER_CAPS
+        if self.server.ssl_context is not None:
+            caps |= CLIENT_SSL
         salt = self.salt = _os.urandom(20).replace(b"\x00", b"\x01")
         payload = (
             b"\x0a" + b"8.4.2-greptimedb-tpu\x00"
             + struct.pack("<I", threading.get_ident() & 0xFFFFFFFF)
             + salt[:8] + b"\x00"
-            + struct.pack("<H", SERVER_CAPS & 0xFFFF)
+            + struct.pack("<H", caps & 0xFFFF)
             + bytes([0x21])  # utf8_general_ci
             + struct.pack("<H", 0x0002)  # status
-            + struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF)
+            + struct.pack("<H", (caps >> 16) & 0xFFFF)
             + bytes([21])  # auth data len
             + b"\x00" * 10
             + salt[8:] + b"\x00"
@@ -133,7 +137,29 @@ class _Conn:
             resp = await self.read_packet()
         except (asyncio.IncompleteReadError, ConnectionError):
             return False
-        if resp is None or len(resp) < 32:
+        if resp is None:
+            return False
+        if (self.server.ssl_context is not None and len(resp) >= 4
+                and struct.unpack("<I", resp[:4])[0] & CLIENT_SSL):
+            # SSLRequest (a short handshake response: caps + max packet +
+            # charset + 23 filler, NO username): switch to TLS, then read
+            # the real handshake response over the encrypted stream
+            from greptimedb_tpu.utils.tls import upgrade_server_tls
+
+            self.reader, self.writer = await upgrade_server_tls(
+                self.reader, self.writer, self.server.ssl_context)
+            try:
+                resp = await self.read_packet()
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return False
+            if resp is None:
+                return False
+        elif self.server.tls_require:
+            self.send_err("server requires TLS connections",
+                          errno=3159, sqlstate=b"HY000")
+            await self.writer.drain()
+            return False
+        if len(resp) < 32:
             return False
         self.caps = struct.unpack("<I", resp[:4])[0]
         # username at offset 32 (after max_packet, charset, 23 reserved)
@@ -462,8 +488,12 @@ class MysqlServer(ThreadedTcpServer):
 
     name = "greptime-mysql"
 
-    def __init__(self, db, host: str = "127.0.0.1", port: int = 4002):
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 4002, *,
+                 ssl_context=None, tls_require: bool = False):
         super().__init__(db, host, port)
+        self.ssl_context = ssl_context  # STARTTLS after the capability
+        # handshake (MySQL protocol's SSLRequest), like opensrv's TLS
+        self.tls_require = tls_require and ssl_context is not None
 
     async def _handle(self, reader, writer) -> None:
         await _Conn(self, reader, writer).run()

@@ -83,17 +83,89 @@ class _PgConn:
                   + b"M" + msg.encode("utf-8") + b"\x00" + b"\x00")
         self._msg(b"E", fields)
 
+    async def _scram_auth(self, provider, user: str) -> bool:
+        """SCRAM-SHA-256 SASL exchange (reference pgwire's SCRAM path;
+        algorithm in utils/auth.ScramSha256Server)."""
+        from greptimedb_tpu.utils.auth import ScramSha256Server
+
+        async def read_p() -> bytes | None:
+            tag = await self.reader.readexactly(1)
+            ln = struct.unpack(">I", await self.reader.readexactly(4))[0]
+            body = await self.reader.readexactly(ln - 4)
+            return body if tag == b"p" else None
+
+        def fail():
+            self._error("password authentication failed for "
+                        f'user "{user}"', "28P01")
+
+        # AuthenticationSASL with the mechanism list
+        self._msg(b"R", struct.pack(">I", 10) + b"SCRAM-SHA-256\x00\x00")
+        await self.writer.drain()
+        body = await read_p()
+        if body is None:
+            fail()
+            await self.writer.drain()
+            return False
+        # SASLInitialResponse: mechanism cstr + int32 len + payload
+        nul = body.find(b"\x00")
+        mech = body[:nul].decode("utf-8", "replace")
+        rest = body[nul + 1:]
+        (plen,) = struct.unpack(">i", rest[:4])
+        client_first = rest[4:4 + plen].decode("utf-8", "replace") if (
+            plen >= 0) else ""
+        if mech != "SCRAM-SHA-256":
+            fail()
+            await self.writer.drain()
+            return False
+        scram = ScramSha256Server(provider, user)
+        try:
+            server_first = scram.first(client_first)
+        except ValueError:
+            fail()
+            await self.writer.drain()
+            return False
+        self._msg(b"R", struct.pack(">I", 11) + server_first.encode())
+        await self.writer.drain()
+        body = await read_p()
+        if body is None:
+            fail()
+            await self.writer.drain()
+            return False
+        ok, server_final = scram.final(body.decode("utf-8", "replace"))
+        if not ok:
+            fail()
+            await self.writer.drain()
+            return False
+        self._msg(b"R", struct.pack(">I", 12) + server_final.encode())
+        return True
+
     async def startup(self) -> bool:
         while True:
             hdr = await self.reader.readexactly(4)
             ln = struct.unpack(">I", hdr)[0]
             body = await self.reader.readexactly(ln - 4)
             code = struct.unpack(">I", body[:4])[0]
-            if code == 80877103:  # SSLRequest → decline
-                self.writer.write(b"N")
+            if code == 80877103:  # SSLRequest
+                ctx = self.server.ssl_context
+                if ctx is None:
+                    self.writer.write(b"N")
+                    await self.writer.drain()
+                    continue
+                self.writer.write(b"S")
                 await self.writer.drain()
+                from greptimedb_tpu.utils.tls import upgrade_server_tls
+
+                self.reader, self.writer = await upgrade_server_tls(
+                    self.reader, self.writer, ctx)
+                self._tls_active = True
                 continue
             if code == 196608:  # protocol 3.0
+                if self.server.tls_require and not getattr(
+                        self, "_tls_active", False):
+                    self._error("server requires TLS (sslmode=require)",
+                                "28000")
+                    await self.writer.drain()
+                    return False
                 params = {}
                 parts = body[4:].split(b"\x00")
                 for i in range(0, len(parts) - 1, 2):
@@ -103,7 +175,12 @@ class _PgConn:
                 if db:
                     self.session_db = db
                 provider = getattr(self.server.db, "user_provider", None)
-                if provider is not None and provider.enabled:
+                if provider is not None and provider.enabled and (
+                        self.server.auth_mode == "scram"):
+                    if not await self._scram_auth(
+                            provider, params.get("user", "")):
+                        return False
+                elif provider is not None and provider.enabled:
                     # AuthenticationCleartextPassword
                     self._msg(b"R", struct.pack(">I", 3))
                     await self.writer.drain()
@@ -537,8 +614,16 @@ class PostgresServer(ThreadedTcpServer):
 
     name = "greptime-pg"
 
-    def __init__(self, db, host: str = "127.0.0.1", port: int = 4003):
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 4003, *,
+                 ssl_context=None, auth_mode: str = "cleartext",
+                 tls_require: bool = False):
         super().__init__(db, host, port)
+        # TLS via SSLRequest upgrade; auth_mode "scram" switches password
+        # auth to SCRAM-SHA-256 (reference pgwire default with TLS);
+        # tls_require rejects clients that skip the upgrade
+        self.ssl_context = ssl_context
+        self.auth_mode = auth_mode
+        self.tls_require = tls_require and ssl_context is not None
 
     async def _handle(self, reader, writer) -> None:
         await _PgConn(self, reader, writer).run()

@@ -80,3 +80,80 @@ class StaticUserProvider:
             )
         )
         return hmac.compare_digest(auth_response, expected)
+
+
+class ScramSha256Server:
+    """Server side of SCRAM-SHA-256 (RFC 5802/7677) — the PostgreSQL
+    SASL auth the reference gets from pgwire (src/servers/src/postgres/,
+    config/standalone.example.toml:14-27).
+
+    One instance per connection attempt:
+        first()  — client-first-message → server-first-message
+        final()  — client-final-message → (ok, server-final-message)
+    """
+
+    ITERATIONS = 4096
+
+    def __init__(self, provider: StaticUserProvider, username: str):
+        import os as _os
+
+        self.provider = provider
+        self.username = username
+        self.server_nonce = base64.b64encode(_os.urandom(18)).decode()
+        self.salt = _os.urandom(16)
+        self._client_first_bare = ""
+        self._server_first = ""
+        self.nonce = ""
+
+    def first(self, client_first: str) -> str:
+        # "n,,n=<user>,r=<cnonce>" (we ignore channel binding gs2 header)
+        parts = client_first.split(",")
+        if len(parts) < 4 or not parts[2].startswith("n=") or (
+                not parts[3].startswith("r=")):
+            raise ValueError("malformed SCRAM client-first message")
+        if parts[2][2:] and parts[2][2:] != self.username:
+            # PostgreSQL itself ignores n= and authenticates the startup
+            # user; a DIFFERENT n= must not swap identities mid-auth
+            raise ValueError("SCRAM n= username does not match startup user")
+        cnonce = parts[3][2:]
+        self._client_first_bare = ",".join(parts[2:])
+        self.nonce = cnonce + self.server_nonce
+        self._server_first = (
+            f"r={self.nonce},s={base64.b64encode(self.salt).decode()},"
+            f"i={self.ITERATIONS}"
+        )
+        return self._server_first
+
+    def final(self, client_final: str) -> tuple[bool, str]:
+        import hashlib as _hashlib
+
+        attrs = dict(p.split("=", 1) for p in client_final.split(",")
+                     if "=" in p)
+        if attrs.get("r") != self.nonce:
+            return False, ""
+        proof_b64 = attrs.get("p", "")
+        without_proof = client_final[: client_final.rfind(",p=")]
+        auth_message = ",".join([
+            self._client_first_bare, self._server_first, without_proof,
+        ]).encode()
+        password = self.provider.users.get(self.username)
+        if password is None:
+            return False, ""
+        salted = _hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), self.salt, self.ITERATIONS)
+        client_key = hmac.new(salted, b"Client Key", _hashlib.sha256).digest()
+        stored_key = _hashlib.sha256(client_key).digest()
+        client_sig = hmac.new(stored_key, auth_message,
+                              _hashlib.sha256).digest()
+        try:
+            proof = base64.b64decode(proof_b64)
+        except Exception:  # noqa: BLE001
+            return False, ""
+        recovered_key = bytes(a ^ b for a, b in zip(proof, client_sig))
+        if not hmac.compare_digest(
+                _hashlib.sha256(recovered_key).digest(), stored_key):
+            return False, ""
+        server_key = hmac.new(salted, b"Server Key", _hashlib.sha256).digest()
+        server_sig = hmac.new(server_key, auth_message,
+                              _hashlib.sha256).digest()
+        return True, "v=" + base64.b64encode(server_sig).decode()

@@ -18,6 +18,10 @@ class HttpOptions:
     addr: str = "127.0.0.1:4000"
     timeout_s: float = 30.0
     body_limit_mb: int = 64
+    # [http.tls] (reference config/standalone.example.toml:14-27)
+    tls_mode: str = "disable"  # disable | require | self_signed
+    tls_cert_path: str = ""
+    tls_key_path: str = ""
 
 
 @dataclass
@@ -53,12 +57,19 @@ class DeviceOptions:
 class MysqlOptions:
     enable: bool = True
     addr: str = "127.0.0.1:4002"
+    tls_mode: str = "disable"
+    tls_cert_path: str = ""
+    tls_key_path: str = ""
 
 
 @dataclass
 class PostgresOptions:
     enable: bool = True
     addr: str = "127.0.0.1:4003"
+    tls_mode: str = "disable"
+    tls_cert_path: str = ""
+    tls_key_path: str = ""
+    auth_mode: str = "scram"  # scram | cleartext (with a user provider)
 
 
 @dataclass

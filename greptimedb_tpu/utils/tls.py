@@ -1,0 +1,220 @@
+"""TLS for the wire protocols (reference: config/standalone.example.toml
+:14-27 — per-server `tls` sections with cert/key paths and watch).
+
+One ssl.SSLContext builder shared by HTTP, MySQL (STARTTLS after the
+capability handshake) and PostgreSQL (SSLRequest upgrade), plus a
+self-signed generator for dev/test (`tls_mode = "self_signed"`).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import ssl
+from dataclasses import dataclass
+
+
+@dataclass
+class TlsConfig:
+    cert_path: str | None = None
+    key_path: str | None = None
+    # "disable" | "require" | "self_signed" (generate under data_home)
+    mode: str = "disable"
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "disable"
+
+
+def make_server_context(cert_path: str, key_path: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx
+
+
+def generate_self_signed(out_dir: str, common_name: str = "localhost",
+                         days: int = 365) -> tuple[str, str]:
+    """Write (cert.pem, key.pem) under ``out_dir`` and return their
+    paths; reused if already present."""
+    cert_path = os.path.join(out_dir, "cert.pem")
+    key_path = os.path.join(out_dir, "key.pem")
+    if os.path.exists(cert_path) and os.path.exists(key_path):
+        return cert_path, key_path
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(out_dir, exist_ok=True)
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(
+            x509.SubjectAlternativeName([
+                x509.DNSName(common_name),
+                x509.IPAddress(ipaddress.IPv4Address("127.0.0.1")),
+            ]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    with open(key_path, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        ))
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    return cert_path, key_path
+
+
+def context_from_config(cfg: TlsConfig, data_home: str) -> ssl.SSLContext | None:
+    if not cfg.enabled:
+        return None
+    if cfg.mode == "self_signed":
+        cert, key = generate_self_signed(os.path.join(data_home, "tls"))
+        return make_server_context(cert, key)
+    if not cfg.cert_path or not cfg.key_path:
+        # "require" with no cert is a misconfiguration — failing startup
+        # beats silently serving a generated self-signed cert
+        raise ValueError(
+            f"tls_mode={cfg.mode!r} needs tls_cert_path and tls_key_path "
+            "(or tls_mode='self_signed')")
+    return make_server_context(cfg.cert_path, cfg.key_path)
+
+
+# ---------------------------------------------------------------------------
+# STARTTLS upgrade for asyncio-stream servers (MySQL SSLRequest, PG
+# SSLRequest).  asyncio's writer.start_tls() loses any bytes the
+# StreamReader already buffered — and MySQL clients send their TLS
+# ClientHello immediately after SSLRequest without waiting for an ack,
+# so the hello routinely arrives in the same segment and the handshake
+# resets.  This MemoryBIO pipe seeds those swallowed bytes into the
+# handshake instead.
+# ---------------------------------------------------------------------------
+
+class _TlsPipe:
+    def __init__(self, reader, writer, ssl_obj, inc, out):
+        self.reader = reader
+        self.writer = writer
+        self.obj = ssl_obj
+        self.inc = inc
+        self.out = out
+        self.buf = bytearray()
+
+    async def pump_out(self) -> None:
+        if self.out.pending:
+            self.writer.write(self.out.read())
+            await self.writer.drain()
+
+    async def fill(self) -> bool:
+        """Decrypt more plaintext into buf; False at clean EOF."""
+        while True:
+            try:
+                data = self.obj.read(65536)
+            except ssl.SSLWantReadError:
+                data = b""
+            except ssl.SSLZeroReturnError:
+                return False
+            if data:
+                self.buf += data
+                return True
+            await self.pump_out()
+            raw = await self.reader.read(65536)
+            if not raw:
+                return False
+            self.inc.write(raw)
+
+
+class TlsStreamReader:
+    def __init__(self, pipe: _TlsPipe):
+        self._p = pipe
+
+    async def readexactly(self, n: int) -> bytes:
+        import asyncio
+
+        while len(self._p.buf) < n:
+            if not await self._p.fill():
+                raise asyncio.IncompleteReadError(bytes(self._p.buf), n)
+        out = bytes(self._p.buf[:n])
+        del self._p.buf[:n]
+        return out
+
+    async def read(self, n: int = -1) -> bytes:
+        if not self._p.buf:
+            await self._p.fill()
+        take = len(self._p.buf) if n < 0 else min(n, len(self._p.buf))
+        out = bytes(self._p.buf[:take])
+        del self._p.buf[:take]
+        return out
+
+
+class TlsStreamWriter:
+    def __init__(self, pipe: _TlsPipe):
+        self._p = pipe
+
+    def write(self, data: bytes) -> None:
+        self._p.obj.write(data)
+
+    async def drain(self) -> None:
+        await self._p.pump_out()
+
+    def close(self) -> None:
+        try:
+            self._p.obj.unwrap()
+        except ssl.SSLError:
+            pass
+        if self._p.out.pending:
+            self._p.writer.write(self._p.out.read())
+        self._p.writer.close()
+
+    def get_extra_info(self, name, default=None):
+        return self._p.writer.get_extra_info(name, default)
+
+
+async def upgrade_server_tls(reader, writer, ctx: ssl.SSLContext):
+    """Perform the server-side TLS handshake over established asyncio
+    streams and return (reader, writer) replacements.  Any bytes the
+    StreamReader buffered past the upgrade-request packet are fed to the
+    handshake first."""
+    inc, out = ssl.MemoryBIO(), ssl.MemoryBIO()
+    obj = ctx.wrap_bio(inc, out, server_side=True)
+    buffered = getattr(reader, "_buffer", None)
+    if buffered is None and not isinstance(reader, TlsStreamReader):
+        # the whole point of this helper is recovering bytes the stream
+        # reader swallowed; a reader shape we can't introspect would
+        # deadlock the handshake silently — fail loudly instead
+        raise RuntimeError(
+            f"cannot STARTTLS over {type(reader).__name__}: no _buffer")
+    if buffered:
+        inc.write(bytes(buffered))
+        buffered.clear()
+    while True:
+        try:
+            obj.do_handshake()
+            break
+        except ssl.SSLWantReadError:
+            if out.pending:
+                writer.write(out.read())
+                await writer.drain()
+            data = await reader.read(65536)
+            if not data:
+                raise ConnectionResetError("EOF during TLS handshake")
+            inc.write(data)
+    if out.pending:
+        writer.write(out.read())
+        await writer.drain()
+    pipe = _TlsPipe(reader, writer, obj, inc, out)
+    return TlsStreamReader(pipe), TlsStreamWriter(pipe)

@@ -1,0 +1,324 @@
+"""TLS on HTTP/MySQL/PostgreSQL + PostgreSQL SCRAM-SHA-256 (reference
+config/standalone.example.toml:14-27 per-server tls sections; pgwire
+SCRAM auth)."""
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import socket
+import ssl
+import struct
+import urllib.request
+
+import pytest
+
+from greptimedb_tpu.standalone import GreptimeDB
+from greptimedb_tpu.utils.tls import (
+    generate_self_signed, make_server_context,
+)
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    return generate_self_signed(str(d))
+
+
+@pytest.fixture
+def db():
+    d = GreptimeDB()
+    d.sql("CREATE TABLE t (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+          "v DOUBLE, PRIMARY KEY (h))")
+    d.sql("INSERT INTO t VALUES ('a', 1000, 1.5)")
+    yield d
+    d.close()
+
+
+def _client_ctx():
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    return ctx
+
+
+class TestHttpTls:
+    def test_https_sql(self, db, certs):
+        from greptimedb_tpu.servers.http import HttpServer
+
+        srv = HttpServer(db, port=0,
+                         ssl_context=make_server_context(*certs))
+        srv.start()
+        try:
+            import urllib.parse
+
+            q = urllib.parse.urlencode({"sql": "SELECT count(*) FROM t"})
+            resp = urllib.request.urlopen(
+                f"https://127.0.0.1:{srv.port}/v1/sql?{q}",
+                context=_client_ctx())
+            body = json.load(resp)
+            assert body["output"][0]["records"]["rows"] == [[1]]
+        finally:
+            srv.stop()
+
+
+class TestPgTls:
+    def _ssl_connect(self, port):
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.sendall(struct.pack(">II", 8, 80877103))  # SSLRequest
+        assert s.recv(1) == b"S"
+        return _client_ctx().wrap_socket(s)
+
+    def test_sslrequest_upgrade_and_query(self, db, certs):
+        from greptimedb_tpu.servers.postgres import PostgresServer
+
+        pg = PostgresServer(db, port=0,
+                            ssl_context=make_server_context(*certs))
+        pg.start()
+        try:
+            s = self._ssl_connect(pg.port)
+            body = struct.pack(">I", 196608) + b"user\x00root\x00\x00"
+            s.sendall(struct.pack(">I", len(body) + 4) + body)
+            # drain to ReadyForQuery
+            def read_msg():
+                tag = s.recv(1)
+                ln = struct.unpack(">I", _recvn(s, 4))[0]
+                return tag, _recvn(s, ln - 4)
+
+            def _recvn(sk, n):
+                buf = b""
+                while len(buf) < n:
+                    c = sk.recv(n - len(buf))
+                    assert c
+                    buf += c
+                return buf
+
+            while True:
+                tag, _ = read_msg()
+                if tag == b"Z":
+                    break
+            q = b"SELECT count(*) FROM t\x00"
+            s.sendall(b"Q" + struct.pack(">I", len(q) + 4) + q)
+            rows = []
+            while True:
+                tag, bd = read_msg()
+                if tag == b"D":
+                    rows.append(bd)
+                if tag == b"Z":
+                    break
+            assert len(rows) == 1 and rows[0].endswith(b"1")
+            s.close()
+        finally:
+            pg.stop()
+
+    def test_decline_without_ctx(self, db):
+        from greptimedb_tpu.servers.postgres import PostgresServer
+
+        pg = PostgresServer(db, port=0)
+        pg.start()
+        try:
+            s = socket.create_connection(("127.0.0.1", pg.port), timeout=5)
+            s.sendall(struct.pack(">II", 8, 80877103))
+            assert s.recv(1) == b"N"
+            s.close()
+        finally:
+            pg.stop()
+
+
+def _scram_client_exchange(sock, user, password):
+    """Minimal SCRAM-SHA-256 client over an open pg socket (RFC 7677)."""
+    def read_msg():
+        tag = sock.recv(1)
+        ln = struct.unpack(">I", _recvn(4))[0]
+        return tag, _recvn(ln - 4)
+
+    def _recvn(n):
+        buf = b""
+        while len(buf) < n:
+            c = sock.recv(n - len(buf))
+            assert c, "closed"
+            buf += c
+        return buf
+
+    body = struct.pack(">I", 196608) + (
+        b"user\x00" + user.encode() + b"\x00\x00")
+    sock.sendall(struct.pack(">I", len(body) + 4) + body)
+    tag, bd = read_msg()
+    assert tag == b"R" and struct.unpack(">I", bd[:4])[0] == 10
+    assert b"SCRAM-SHA-256" in bd
+    cnonce = base64.b64encode(os.urandom(18)).decode()
+    cf_bare = f"n={user},r={cnonce}"
+    payload = ("n,," + cf_bare).encode()
+    sasl = (b"SCRAM-SHA-256\x00" + struct.pack(">i", len(payload))
+            + payload)
+    sock.sendall(b"p" + struct.pack(">I", len(sasl) + 4) + sasl)
+    tag, bd = read_msg()
+    if tag == b"E":
+        return False, None
+    assert struct.unpack(">I", bd[:4])[0] == 11
+    server_first = bd[4:].decode()
+    attrs = dict(p.split("=", 1) for p in server_first.split(","))
+    nonce, salt, it = attrs["r"], base64.b64decode(attrs["s"]), int(attrs["i"])
+    assert nonce.startswith(cnonce)
+    salted = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, it)
+    ckey = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+    skey = hashlib.sha256(ckey).digest()
+    cf_wo = f"c=biws,r={nonce}"
+    auth_msg = ",".join([cf_bare, server_first, cf_wo]).encode()
+    csig = hmac.new(skey, auth_msg, hashlib.sha256).digest()
+    proof = bytes(a ^ b for a, b in zip(ckey, csig))
+    final = (cf_wo + ",p=" + base64.b64encode(proof).decode()).encode()
+    sock.sendall(b"p" + struct.pack(">I", len(final) + 4) + final)
+    tag, bd = read_msg()
+    if tag == b"E":
+        return False, None
+    assert struct.unpack(">I", bd[:4])[0] == 12
+    server_sig = dict(
+        p.split("=", 1) for p in bd[4:].decode().split(","))["v"]
+    # drain to ReadyForQuery
+    while True:
+        tag, _bd = read_msg()
+        if tag == b"Z":
+            break
+    return True, server_sig
+
+
+class TestPgScram:
+    @pytest.fixture
+    def auth_db(self):
+        from greptimedb_tpu.utils.auth import StaticUserProvider
+
+        d = GreptimeDB()
+        d.user_provider = StaticUserProvider({"alice": "wonder=land:42"})
+        d.sql("CREATE TABLE t (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+              "v DOUBLE, PRIMARY KEY (h))")
+        yield d
+        d.close()
+
+    def test_scram_success_and_server_signature(self, auth_db):
+        from greptimedb_tpu.servers.postgres import PostgresServer
+
+        pg = PostgresServer(auth_db, port=0, auth_mode="scram")
+        pg.start()
+        try:
+            s = socket.create_connection(("127.0.0.1", pg.port), timeout=5)
+            ok, server_sig = _scram_client_exchange(
+                s, "alice", "wonder=land:42")
+            assert ok and server_sig
+            s.close()
+        finally:
+            pg.stop()
+
+    def test_scram_wrong_password(self, auth_db):
+        from greptimedb_tpu.servers.postgres import PostgresServer
+
+        pg = PostgresServer(auth_db, port=0, auth_mode="scram")
+        pg.start()
+        try:
+            s = socket.create_connection(("127.0.0.1", pg.port), timeout=5)
+            ok, _ = _scram_client_exchange(s, "alice", "nope")
+            assert not ok
+            s.close()
+        finally:
+            pg.stop()
+
+
+class TestMysqlTls:
+    def test_starttls_handshake_and_query(self, db, certs):
+        from greptimedb_tpu.servers.mysql import MysqlServer
+
+        srv = MysqlServer(db, port=0,
+                          ssl_context=make_server_context(*certs))
+        srv.start()
+        try:
+            raw = socket.create_connection(("127.0.0.1", srv.port),
+                                           timeout=5)
+
+            def read_pkt(sk):
+                hdr = b""
+                while len(hdr) < 4:
+                    hdr += sk.recv(4 - len(hdr))
+                ln = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+                body = b""
+                while len(body) < ln:
+                    body += sk.recv(ln - len(body))
+                return body, hdr[3]
+
+            greeting, _seq = read_pkt(raw)
+            # server must advertise CLIENT_SSL (0x800) in the low caps
+            nul = greeting.index(b"\x00", 1)
+            lo = struct.unpack("<H", greeting[nul + 1 + 4 + 8 + 1:][:2])[0]
+            assert lo & 0x800
+            # SSLRequest: caps incl CLIENT_SSL, short packet, seq 1
+            caps = 0x200 | 0x8000 | 0x1 | 0x800
+            sslreq = struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23
+            raw.sendall(bytes([len(sslreq) & 0xFF, 0, 0, 1]) + sslreq)
+            tls = _client_ctx().wrap_socket(raw)
+            # real handshake response over TLS, seq 2
+            resp = (struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23
+                    + b"root\x00" + b"\x00")
+            tls.sendall(bytes([len(resp) & 0xFF, 0, 0, 2]) + resp)
+            ok, _ = read_pkt(tls)
+            assert ok[0] == 0x00, ok
+            # COM_QUERY over TLS
+            q = b"\x03" + b"SELECT count(*) FROM t"
+            tls.sendall(bytes([len(q) & 0xFF, 0, 0, 0]) + q)
+            col_count, _ = read_pkt(tls)
+            assert col_count == b"\x01"
+            tls.close()
+        finally:
+            srv.stop()
+
+
+class TestTlsRequire:
+    def test_pg_rejects_plaintext_when_required(self, db, certs):
+        from greptimedb_tpu.servers.postgres import PostgresServer
+
+        pg = PostgresServer(db, port=0,
+                            ssl_context=make_server_context(*certs),
+                            tls_require=True)
+        pg.start()
+        try:
+            s = socket.create_connection(("127.0.0.1", pg.port), timeout=5)
+            body = struct.pack(">I", 196608) + b"user\x00root\x00\x00"
+            s.sendall(struct.pack(">I", len(body) + 4) + body)
+            tag = s.recv(1)
+            assert tag == b"E"  # ErrorResponse, not auth/ready
+            s.close()
+        finally:
+            pg.stop()
+
+    def test_mysql_rejects_plaintext_when_required(self, db, certs):
+        from greptimedb_tpu.servers.mysql import MysqlServer
+
+        srv = MysqlServer(db, port=0,
+                          ssl_context=make_server_context(*certs),
+                          tls_require=True)
+        srv.start()
+        try:
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+            hdr = b""
+            while len(hdr) < 4:
+                hdr += s.recv(4 - len(hdr))
+            ln = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+            while ln:
+                ln -= len(s.recv(ln))
+            caps = 0x200 | 0x8000 | 0x1  # no CLIENT_SSL
+            resp = (struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23
+                    + b"root\x00" + b"\x00")
+            s.sendall(bytes([len(resp) & 0xFF, 0, 0, 1]) + resp)
+            hdr = b""
+            while len(hdr) < 4:
+                hdr += s.recv(4 - len(hdr))
+            first = s.recv(1)
+            assert first == b"\xff"  # ERR packet
+            s.close()
+        finally:
+            srv.stop()
+
+    def test_require_mode_needs_cert(self, tmp_path):
+        from greptimedb_tpu.utils.tls import TlsConfig, context_from_config
+
+        with pytest.raises(ValueError):
+            context_from_config(TlsConfig(mode="require"), str(tmp_path))
