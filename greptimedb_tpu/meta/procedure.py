@@ -198,12 +198,31 @@ class ProcedureManager:
                 continue
             cls = self._registry.get(rec["type"])
             if cls is None:
+                # an unknown type must never stay RUNNING forever: its
+                # half-applied state would never converge (the chaos
+                # fuzzer caught exactly this for an unregistered class).
+                # Journal it FAILED so operators see it in
+                # information_schema.procedure_info instead of a
+                # permanent stuck runner.
+                rec["status"] = ProcedureState.FAILED.value
+                rec["error"] = f"type {rec['type']!r} not registered"
+                self.kv.put_json(k, rec)
+                if first_err is None:
+                    first_err = GreptimeError(rec["error"])
                 continue
             proc = cls(state=rec["state"])
             pid = k[len(self._PREFIX):]
             try:
                 out.append(self._drive(pid, proc, max_steps=1000))
-            except Exception as e:  # noqa: BLE001 — journaled FAILED by _drive
+            except Exception as e:  # noqa: BLE001
+                # _drive journals FAILED for step errors, but pre-step
+                # rejections (poisoned lock, lock busy) raise BEFORE any
+                # journal write — finalize here so no record stays RUNNING
+                cur = json.loads(self.kv.get(k) or b"{}")
+                if cur.get("status") == ProcedureState.RUNNING.value:
+                    cur["status"] = ProcedureState.FAILED.value
+                    cur["error"] = str(e)
+                    self.kv.put_json(k, cur)
                 if first_err is None:
                     first_err = e
         if first_err is not None:

@@ -329,7 +329,8 @@ class GreptimeDB(TableProvider):
         # table locks are process-wide; RUNNING journals from a crashed
         # process resume here at startup
         from greptimedb_tpu.meta.ddl import (
-            AlterTableProcedure, CreateTableProcedure, DropTableProcedure,
+            AlterOptionsProcedure, AlterTableProcedure, CreateTableProcedure,
+            DropTableProcedure,
         )
         from greptimedb_tpu.meta.procedure import ProcedureManager
         from greptimedb_tpu.meta.repartition import RepartitionProcedure
@@ -339,6 +340,7 @@ class GreptimeDB(TableProvider):
         self.procedures.register(CreateTableProcedure)
         self.procedures.register(DropTableProcedure)
         self.procedures.register(AlterTableProcedure)
+        self.procedures.register(AlterOptionsProcedure)
         try:
             resumed = self.procedures.recover()
             if resumed:
