@@ -362,6 +362,114 @@ def _runtime_metrics(db):
     return _columns_of(rows, names), types
 
 
+def _views(db):
+    """Reference src/catalog/src/system_schema/information_schema/views.rs."""
+    rows = []
+    for d in db.catalog.list_databases():
+        for t in db.catalog.list_tables(d):
+            if t.engine != "view":
+                continue
+            rows.append({
+                "table_catalog": "greptime", "table_schema": d,
+                "table_name": t.name,
+                "view_definition": t.options.get("definition", ""),
+                "check_option": None, "is_updatable": "NO",
+                "definer": "greptime", "security_type": None,
+                "character_set_client": "utf8",
+                "collation_connection": "utf8_bin",
+            })
+    names = ["table_catalog", "table_schema", "table_name",
+             "view_definition", "check_option", "is_updatable", "definer",
+             "security_type", "character_set_client",
+             "collation_connection"]
+    return _columns_of(rows, names), {n: "String" for n in names}
+
+
+def _triggers(db):
+    # no trigger support (reference table exists but is likewise empty
+    # for mito tables)
+    names = ["trigger_catalog", "trigger_schema", "trigger_name",
+             "event_manipulation", "event_object_table", "action_statement",
+             "action_timing"]
+    return _columns_of([], names), {n: "String" for n in names}
+
+
+def _table_constraints(db):
+    """PRIMARY KEY (tags) + TIME INDEX as constraints (reference
+    information_schema/table_constraints.rs)."""
+    rows = []
+    for d in db.catalog.list_databases():
+        for t in db.catalog.list_tables(d):
+            if t.engine == "view":
+                continue
+            if any(c.is_tag for c in t.schema):
+                rows.append({
+                    "constraint_catalog": "def", "constraint_schema": d,
+                    "constraint_name": "PRIMARY", "table_schema": d,
+                    "table_name": t.name, "constraint_type": "PRIMARY KEY",
+                    "enforced": "YES",
+                })
+            if t.schema.time_index is not None:
+                rows.append({
+                    "constraint_catalog": "def", "constraint_schema": d,
+                    "constraint_name": "TIME INDEX", "table_schema": d,
+                    "table_name": t.name, "constraint_type": "TIME INDEX",
+                    "enforced": "YES",
+                })
+    names = ["constraint_catalog", "constraint_schema", "constraint_name",
+             "table_schema", "table_name", "constraint_type", "enforced"]
+    return _columns_of(rows, names), {n: "String" for n in names}
+
+
+def _check_constraints(db):
+    names = ["constraint_catalog", "constraint_schema", "constraint_name",
+             "check_clause"]
+    return _columns_of([], names), {n: "String" for n in names}
+
+
+def _character_sets(db):
+    rows = [{"character_set_name": "utf8", "default_collate_name":
+             "utf8_bin", "description": "UTF-8 Unicode", "maxlen": 4}]
+    names = ["character_set_name", "default_collate_name", "description",
+             "maxlen"]
+    types = {n: "String" for n in names}
+    types["maxlen"] = "Int64"
+    return _columns_of(rows, names), types
+
+
+def _collations(db):
+    rows = [{"collation_name": "utf8_bin", "character_set_name": "utf8",
+             "id": 83, "is_default": "Yes", "is_compiled": "Yes",
+             "sortlen": 1}]
+    names = ["collation_name", "character_set_name", "id", "is_default",
+             "is_compiled", "sortlen"]
+    types = {n: "String" for n in names}
+    types.update({"id": "Int64", "sortlen": "Int64"})
+    return _columns_of(rows, names), types
+
+
+def _recycle_bin(db):
+    """Soft-dropped tables awaiting undrop/purge (reference
+    greptime_private.recycle_bin, purge_dropped_table.rs)."""
+    rows = []
+    for e in db.catalog.recycle_list():
+        info = e["info"]
+        rows.append({
+            "table_schema": info.get("database"),
+            "table_name": info.get("name"),
+            "table_id": info.get("table_id"),
+            "engine": info.get("engine"),
+            "dropped_at": e.get("dropped_at_ms"),
+            "region_ids": ",".join(str(r) for r in
+                                   info.get("region_ids", [])),
+        })
+    names = ["table_schema", "table_name", "table_id", "engine",
+             "dropped_at", "region_ids"]
+    types = {n: "String" for n in names}
+    types.update({"table_id": "Int64", "dropped_at": "Int64"})
+    return _columns_of(rows, names), types
+
+
 _TABLES = {
     "schemata": _schemata,
     "tables": _tables,
@@ -378,6 +486,13 @@ _TABLES = {
     "ssts": _ssts,
     "procedure_info": _procedure_info,
     "runtime_metrics": _runtime_metrics,
+    "views": _views,
+    "triggers": _triggers,
+    "table_constraints": _table_constraints,
+    "check_constraints": _check_constraints,
+    "character_sets": _character_sets,
+    "collations": _collations,
+    "recycle_bin": _recycle_bin,
 }
 
 

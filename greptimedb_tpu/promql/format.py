@@ -1,0 +1,62 @@
+"""Prometheus API response payloads — the ONE formatting definition,
+shared by the HTTP API (servers/http.py) and the gRPC PromQL gateway
+(rpc/promgateway.py; reference src/servers/src/grpc/prom_query_gateway.rs
+reuses the HTTP handlers' types the same way)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def fmt_val(v: float) -> str:
+    if np.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+def instant_payload(res, steps) -> dict:
+    vals = np.asarray(res.values, dtype=np.float64)
+    result = []
+    for s, lab in enumerate(res.labels):
+        v = vals[s, -1]
+        if not np.isnan(v):
+            result.append({
+                "metric": {k: str(x) for k, x in lab.items()},
+                "value": [steps[-1] / 1000.0, fmt_val(v)],
+            })
+    return {"status": "success",
+            "data": {"resultType": "vector", "result": result}}
+
+
+def range_payload(res, steps) -> dict:
+    vals = np.asarray(res.values, dtype=np.float64)
+    result = []
+    for s, lab in enumerate(res.labels):
+        pts = [
+            [steps[t] / 1000.0, fmt_val(vals[s, t])]
+            for t in range(len(steps))
+            if not np.isnan(vals[s, t])
+        ]
+        if pts:
+            result.append({"metric": {k: str(v) for k, v in lab.items()},
+                           "values": pts})
+    return {"status": "success",
+            "data": {"resultType": "matrix", "result": result}}
+
+
+def evaluate(db, query: str, start_s: float, end_s: float,
+             step_s: float, lookback_s: float | None = None) -> dict:
+    """Parse + evaluate + format in one call (instant when start == end)."""
+    from greptimedb_tpu.promql.engine import (
+        DEFAULT_LOOKBACK_S, PromEvaluator,
+    )
+    from greptimedb_tpu.promql.parser import parse_promql
+
+    expr = parse_promql(query)
+    ev = PromEvaluator(db, start_s, end_s, step_s,
+                       lookback_s or DEFAULT_LOOKBACK_S)
+    res = ev.eval(expr)
+    steps = ev.steps_ms()
+    if start_s == end_s:
+        return instant_payload(res, steps)
+    return range_payload(res, steps)

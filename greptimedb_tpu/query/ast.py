@@ -281,6 +281,15 @@ def map_expr(e, fn):
     return fn(e2)
 
 
+def split_conjuncts(e) -> list:
+    """Flatten a WHERE tree into its AND-ed conjuncts (empty for None)."""
+    if e is None:
+        return []
+    if isinstance(e, BinaryOp) and e.op.upper() == "AND":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
 def expr_contains(e, types) -> bool:
     """True when any node in the tree is an instance of ``types``."""
     found = False
@@ -305,6 +314,19 @@ class ScalarSubquery(Expr):
 
     def __str__(self):
         return "(<subquery>)"
+
+
+@dataclass(frozen=True)
+class Exists(Expr):
+    """[NOT] EXISTS (SELECT ...). Uncorrelated forms resolve to a
+    boolean Literal; equality-correlated forms decorrelate to an InList
+    membership test (the reference relies on DataFusion's subquery
+    decorrelation, src/query/src/datafusion.rs)."""
+
+    select: object
+
+    def __str__(self):
+        return "EXISTS (...)"
 
 
 @dataclass(frozen=True)

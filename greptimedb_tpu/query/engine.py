@@ -15,8 +15,8 @@ import numpy as np
 
 from greptimedb_tpu.errors import PlanError, TableNotFound, Unsupported
 from greptimedb_tpu.query.ast import (
-    Expr, InList, InSubquery, Literal, ScalarSubquery, Select, SelectItem,
-    Star,
+    Exists, Expr, InList, InSubquery, Literal, ScalarSubquery, Select,
+    SelectItem, Star,
 )
 from greptimedb_tpu.query.exprs import TableContext, eval_host
 from greptimedb_tpu.query.physical import Executor
@@ -103,11 +103,13 @@ class QueryEngine:
         run = self.dispatch if self.dispatch is not None else self.execute_select
         return run(sub)
 
-    def _rewrite_subqueries(self, e):
-        """Uncorrelated subqueries → literals (scalar) / IN lists, bottom-up
-        via the shared map_expr walker (the reference relies on DataFusion's
-        subquery support, src/query/src/datafusion.rs:141; correlated
-        subqueries are not supported here)."""
+    def _rewrite_subqueries(self, e, outer: Select | None = None):
+        """Subqueries → literals / IN lists, bottom-up via the shared
+        map_expr walker (the reference relies on DataFusion's subquery
+        support + decorrelation, src/query/src/datafusion.rs:141).
+        EXISTS decorrelates: equality correlations against the outer
+        table become a membership test over the inner side's DISTINCT
+        key values."""
         from greptimedb_tpu.query.ast import map_expr
 
         def resolve(node):
@@ -129,9 +131,83 @@ class QueryEngine:
                     return Literal(bool(node.negated))
                 items = tuple(Literal(r[0]) for r in res.rows)
                 return InList(node.expr, items, node.negated)
+            if isinstance(node, Exists):
+                return self._rewrite_exists(node, outer)
             return node
 
         return map_expr(e, resolve)
+
+    def _rewrite_exists(self, node: Exists, outer: Select | None):
+        """EXISTS (SELECT ...): uncorrelated → boolean literal; a single
+        equality correlation `inner_col = outer_col` → decorrelated
+        membership `outer_col IN (SELECT DISTINCT inner_col FROM ...)`
+        (NOT EXISTS arrives as NOT wrapping this node and negates the
+        resulting mask vectorized)."""
+        import dataclasses
+
+        from greptimedb_tpu.query.ast import BinaryOp, Column, split_conjuncts
+
+        sub: Select = node.select
+        corr = []  # (inner Column, outer Column expr)
+        rest = []
+        # a column is an OUTER correlation ONLY when explicitly qualified
+        # with the outer table's name/alias (`hosts.h`): unqualified and
+        # inner-qualified names (incl. joined subquery tables) stay inner
+        # — misclassifying an inner-to-inner equality would silently bind
+        # a stripped name against the outer table
+        outer_names = set()
+        if outer is not None and outer.table is not None:
+            outer_names = {outer.table, outer.table_alias} - {None}
+            short = outer.table.rsplit(".", 1)[-1]
+            outer_names.add(short)
+
+        def is_outer(c: Column) -> bool:
+            return c.table is not None and c.table in outer_names
+
+        for conj in split_conjuncts(sub.where):
+            if (isinstance(conj, BinaryOp) and conj.op == "="
+                    and isinstance(conj.left, Column)
+                    and isinstance(conj.right, Column)):
+                lo, ro = is_outer(conj.left), is_outer(conj.right)
+                if lo and not ro:
+                    corr.append((conj.right, conj.left))
+                    continue
+                if ro and not lo:
+                    corr.append((conj.left, conj.right))
+                    continue
+            rest.append(conj)
+
+        if not corr:
+            res = self._run_nested(sub)
+            return Literal(res.num_rows > 0)
+        if (sub.limit is not None or sub.offset is not None
+                or sub.group_by or sub.having is not None):
+            # decorrelation would silently drop these clauses (LIMIT 0
+            # means EXISTS is always false!) — refuse instead
+            raise Unsupported(
+                "correlated EXISTS with LIMIT/OFFSET/GROUP BY/HAVING")
+        if len(corr) > 1:
+            raise Unsupported(
+                "correlated EXISTS supports one equality correlation")
+        inner_col, outer_col = corr[0]
+        new_where = None
+        for c in rest:
+            new_where = c if new_where is None else BinaryOp(
+                "AND", new_where, c)
+        inner_sel = dataclasses.replace(
+            sub,
+            items=[SelectItem(Column(inner_col.name))],
+            where=new_where,
+            distinct=True,
+            group_by=[], order_by=[], limit=None, offset=None,
+        )
+        res = self._run_nested(inner_sel)
+        vals = [r[0] for r in res.rows if r[0] is not None]
+        if not vals:
+            return Literal(False)
+        # strip the outer qualifier: the outer plan resolves bare names
+        return InList(Column(outer_col.name),
+                      tuple(Literal(v) for v in vals))
 
     def _resolve_subqueries(self, sel: Select) -> Select:
         import dataclasses
@@ -140,18 +216,20 @@ class QueryEngine:
 
         touched = [sel.where, sel.having] + [it.expr for it in sel.items]
         if not any(
-            e is not None and expr_contains(e, (ScalarSubquery, InSubquery))
+            e is not None and expr_contains(
+                e, (ScalarSubquery, InSubquery, Exists))
             for e in touched
         ):
             return sel
         return dataclasses.replace(
             sel,
-            where=(self._rewrite_subqueries(sel.where)
+            where=(self._rewrite_subqueries(sel.where, sel)
                    if sel.where is not None else None),
-            having=(self._rewrite_subqueries(sel.having)
+            having=(self._rewrite_subqueries(sel.having, sel)
                     if sel.having is not None else None),
             items=[
-                dataclasses.replace(it, expr=self._rewrite_subqueries(it.expr))
+                dataclasses.replace(
+                    it, expr=self._rewrite_subqueries(it.expr, sel))
                 for it in sel.items
             ],
         )

@@ -692,7 +692,7 @@ def _compile_vec_distance(e: FuncCall, ctx: TableContext):
     return fn
 
 
-FT_FUNCS = ("matches", "matches_term")
+FT_FUNCS = ("matches", "matches_term", "matches_score")
 
 
 def _ft_pred(name: str, query: str):
@@ -719,6 +719,41 @@ def _compile_ft_match(e: FuncCall, ctx: TableContext):
         if enc is None:
             raise Unsupported(f"{e.name}: column {col.name} has no dictionary")
         vocab = enc.values()
+    if e.name == "matches_score":
+        # TF-IDF relevance (reference: tantivy BM25 ranking,
+        # src/index/src/fulltext_index/): per-DISTINCT-term tf vectors,
+        # idf over the vocabulary as the corpus, gathered to rows by code
+        import math
+
+        from greptimedb_tpu.storage.index import ft_score
+
+        qtokens, tf_vector = ft_score(lit.value)
+        n_terms = max(len(vocab), 1)
+        tfs = []
+        dfs = [0] * len(qtokens)
+        for t in vocab:
+            v = tf_vector(str(t))
+            tfs.append(v)
+            for j, x in enumerate(v):
+                if x:
+                    dfs[j] += 1
+        idf = [
+            math.log(1.0 + (n_terms - df + 0.5) / (df + 0.5))
+            for df in dfs
+        ]
+        scores = np.asarray(
+            [sum(w * i for w, i in zip(v, idf)) for v in tfs],
+            dtype=np.float64,
+        ) if vocab else np.zeros(1, dtype=np.float64)
+        sc = jnp.asarray(scores)
+
+        def score_fn(env, col_name=real, s=sc):
+            codes = env[col_name]
+            safe = jnp.clip(codes, 0, s.shape[0] - 1)
+            return jnp.where(codes >= 0, s[safe], 0.0)
+
+        return score_fn
+
     pred = _ft_pred(e.name, lit.value)
     hits = jnp.asarray(
         np.asarray([bool(pred(str(t))) for t in vocab], dtype=bool)
@@ -852,13 +887,29 @@ def eval_host(e: Expr, env: dict[str, np.ndarray], n: int):
             lit = next((a for a in e.args if isinstance(a, Literal)), None)
             if col is None or lit is None or not isinstance(lit.value, str):
                 raise Unsupported(f"{e.name} needs a column and a literal")
-            pred = _ft_pred(e.name, lit.value)
             vals = np.asarray(eval_host(col, env, n), dtype=object)
             uniq, inv = np.unique(
                 np.array(["" if v is None else str(v) for v in vals],
                          dtype=object),
                 return_inverse=True,
             )
+            if e.name == "matches_score":
+                import math
+
+                from greptimedb_tpu.storage.index import ft_score
+
+                qtokens, tf_vector = ft_score(lit.value)
+                tfs = [tf_vector(str(u)) for u in uniq]
+                dfs = [sum(1 for v in tfs if v[j]) for j in
+                       range(len(qtokens))]
+                n_docs = max(len(uniq), 1)
+                idf = [math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+                       for df in dfs]
+                scores = np.asarray(
+                    [sum(w * i for w, i in zip(v, idf)) for v in tfs],
+                    dtype=np.float64)
+                return scores[inv]
+            pred = _ft_pred(e.name, lit.value)
             hits = np.asarray([pred(str(u)) for u in uniq], dtype=bool)
             return hits[inv]
         if e.name in VEC_FUNCS:

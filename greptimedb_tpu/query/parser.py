@@ -11,7 +11,7 @@ import datetime
 
 from greptimedb_tpu.errors import SyntaxError_, Unsupported
 from greptimedb_tpu.query.ast import (
-    AlterTable, CreateView, DropView, Between, BinaryOp, Case, Cast, Column, ColumnDef, CreateDatabase,
+    AlterTable, CreateView, DropView, Between, Exists, BinaryOp, Case, Cast, Column, ColumnDef, CreateDatabase,
     CreateFlow, CreateTable, Delete, DescribeTable, DropDatabase, DropFlow,
     DropTable, Explain, Expr, FuncCall, InList, InSubquery, Insert,
     IntervalLit, IsNull, JoinClause, ScalarSubquery,
@@ -531,6 +531,13 @@ class Parser:
         if t.kind is Tok.STRING:
             self.next()
             return Literal(t.text)
+        if self.at_kw("EXISTS") and self.peek(1).kind is Tok.PUNCT and (
+                self.peek(1).text == "("):
+            self.next()
+            self.expect(Tok.PUNCT, "(")
+            sub = self.select()
+            self.expect(Tok.PUNCT, ")")
+            return Exists(sub)
         if self.eat(Tok.PUNCT, "("):
             if self.at_kw("SELECT"):
                 sub = self.select()

@@ -293,12 +293,6 @@ class HttpServer(ThreadedAiohttpApp):
 
         return await self._call(run)
 
-    @staticmethod
-    def _fmt_val(v: float) -> str:
-        if np.isinf(v):
-            return "+Inf" if v > 0 else "-Inf"
-        return repr(float(v))
-
     async def h_prom_range(self, request: web.Request) -> web.Response:
         try:
             query = await self._param(request, "query")
@@ -307,21 +301,10 @@ class HttpServer(ThreadedAiohttpApp):
             step = _parse_prom_duration(await self._param(request, "step", "60"))
             with M_LATENCY.labels("/v1/prometheus/api/v1/query_range").time():
                 res, steps = await self._eval_promql(query, start, end, step)
-            vals = np.asarray(res.values, dtype=np.float64)
-            result = []
-            for s, lab in enumerate(res.labels):
-                pts = [
-                    [steps[t] / 1000.0, self._fmt_val(vals[s, t])]
-                    for t in range(len(steps))
-                    if not np.isnan(vals[s, t])
-                ]
-                if pts:
-                    result.append({"metric": {k: str(v) for k, v in lab.items()},
-                                   "values": pts})
+            from greptimedb_tpu.promql.format import range_payload
+
             M_REQUESTS.labels("/v1/prometheus/api/v1/query_range", "200").inc()
-            return web.json_response(
-                {"status": "success",
-                 "data": {"resultType": "matrix", "result": result}})
+            return web.json_response(range_payload(res, steps))
         except Exception as e:  # noqa: BLE001
             M_REQUESTS.labels("/v1/prometheus/api/v1/query_range", "400").inc()
             return web.json_response(
@@ -334,19 +317,10 @@ class HttpServer(ThreadedAiohttpApp):
             t = _parse_prom_time(await self._param(request, "time", str(time.time())))
             with M_LATENCY.labels("/v1/prometheus/api/v1/query").time():
                 res, steps = await self._eval_promql(query, t, t, 1)
-            vals = np.asarray(res.values, dtype=np.float64)
-            result = []
-            for s, lab in enumerate(res.labels):
-                v = vals[s, -1]
-                if not np.isnan(v):
-                    result.append({
-                        "metric": {k: str(x) for k, x in lab.items()},
-                        "value": [steps[-1] / 1000.0, self._fmt_val(v)],
-                    })
+            from greptimedb_tpu.promql.format import instant_payload
+
             M_REQUESTS.labels("/v1/prometheus/api/v1/query", "200").inc()
-            return web.json_response(
-                {"status": "success",
-                 "data": {"resultType": "vector", "result": result}})
+            return web.json_response(instant_payload(res, steps))
         except Exception as e:  # noqa: BLE001
             M_REQUESTS.labels("/v1/prometheus/api/v1/query", "400").inc()
             return web.json_response(

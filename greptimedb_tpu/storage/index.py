@@ -78,20 +78,40 @@ VOCAB_LIMIT = 4096
 # distinct TOKENS per string-FIELD column kept for full-text pruning
 TOKEN_LIMIT = 65536
 _MAGIC2 = b"GTIX2\n"
+# bump when tokenize() changes: stale token sets in old sidecars must be
+# IGNORED (no pruning), never consulted — they would over-prune queries
+# whose tokens the old analyzer never produced (e.g. CJK bigrams)
+_TOKENIZER_VERSION = 2
 
 _TOKEN_RE = None
 
 
 def tokenize(text: str) -> list[str]:
-    """Lowercase word tokens (the reference's fulltext default analyzer —
-    tantivy's simple tokenizer — is the same split-on-non-alnum+lowercase;
-    src/index/src/fulltext_index/)."""
+    """Lowercase word tokens + CJK bigrams.
+
+    Latin/digit runs split on non-alnum and lowercase (the reference's
+    fulltext default analyzer — tantivy's simple tokenizer).  CJK runs
+    emit character BIGRAMS (single char when the run is length 1): the
+    dictionary-free analog of the reference's tantivy-jieba Chinese
+    tokenizer (src/index/Cargo.toml:43-44) — bigram indexing is the
+    standard CJK fallback when no segmentation dictionary ships."""
     global _TOKEN_RE
     if _TOKEN_RE is None:
         import re
 
-        _TOKEN_RE = re.compile(r"[A-Za-z0-9_]+")
-    return [t.lower() for t in _TOKEN_RE.findall(text)]
+        _TOKEN_RE = re.compile(
+            r"[A-Za-z0-9_]+|[\u3040-\u30ff\u3400-\u4dbf\u4e00-\u9fff"
+            r"\uf900-\ufaff\uac00-\ud7af]+"
+        )
+    out: list[str] = []
+    for run in _TOKEN_RE.findall(text):
+        if run[0].isascii():
+            out.append(run.lower())
+        elif len(run) == 1:
+            out.append(run)
+        else:
+            out.extend(run[i:i + 2] for i in range(len(run) - 1))
+    return out
 
 
 class ColumnIndex:
@@ -163,6 +183,7 @@ def build_sst_index(columns: dict[str, np.ndarray], tag_names: list[str],
         "blooms": {name: len(b) for name, b in blobs.items()},
         "vocabs": vocabs,
         "tokens": tokens,
+        "tokv": _TOKENIZER_VERSION,
         "tombstones": bool(has_tombstones),
     }).encode("utf-8")
     out = _MAGIC2 + struct.pack("<I", len(header)) + header
@@ -185,11 +206,15 @@ def load_sst_index(raw: bytes) -> dict[str, ColumnIndex]:
                 header["vocabs"].get(name),
             )
             off += ln
-        for name, toks in header.get("tokens", {}).items():
-            ci = out.get(name)
-            if ci is None:
-                ci = out[name] = ColumnIndex(BloomFilter(64))
-            ci.tokens = set(toks)
+        if header.get("tokv") == _TOKENIZER_VERSION:
+            # token sets from a different analyzer version are DROPPED:
+            # pruning against them would hide rows whose tokens the old
+            # analyzer never produced (no tokens = no pruning = correct)
+            for name, toks in header.get("tokens", {}).items():
+                ci = out.get(name)
+                if ci is None:
+                    ci = out[name] = ColumnIndex(BloomFilter(64))
+                ci.tokens = set(toks)
         if header.get("tombstones"):
             for ci in out.values():
                 ci.has_tombstones = True
@@ -261,6 +286,29 @@ def ft_predicate(name: str, query: str):
         return qset.issubset(tokenize(text))
 
     return pred
+
+
+def ft_score(query: str):
+    """TF-IDF-shaped relevance scoring: returns (query_tokens, tf_vector)
+    where tf_vector(text) gives per-query-token saturated term
+    frequencies; the caller applies IDF over whatever corpus it scans
+    (the table dictionary on the device path, the batch's distinct
+    values on the host path — scores are a per-query ranking heuristic,
+    not comparable across paths).  The reference's ranking comes from
+    tantivy's BM25 (src/index/src/fulltext_index/); this is the same
+    shape without per-SST global statistics: tf saturation (BM25 k1=1.2)
+    x corpus IDF.  Score 0.0 = no overlap (use `matches` to filter)."""
+    qtokens = list(dict.fromkeys(tokenize(query)))  # uniq, stable order
+
+    def tf_vector(text: str) -> list[float]:
+        toks = tokenize(text)
+        out = []
+        for q in qtokens:
+            tf = toks.count(q)
+            out.append((tf * 2.2) / (tf + 1.2) if tf else 0.0)
+        return out
+
+    return qtokens, tf_vector
 
 
 def sst_tokens_may_match(

@@ -707,3 +707,30 @@ class TestAdvisorRegressions:
         assert sorted(host["v"].tolist()) == [1.0, 2.0]  # caught up
         seq = nodes[1].write(rid, {"h": ["c"], "ts": [3000], "v": [3.0]}, 5.0)
         assert seq >= 3  # sequence advanced past the leader's writes
+
+
+def test_information_schema_breadth(tmp_path):
+    """Round-4 breadth: views/constraints/recycle_bin virtual tables."""
+    from greptimedb_tpu.standalone import GreptimeDB
+
+    db = GreptimeDB(str(tmp_path / "isb"))
+    db.sql("CREATE TABLE t (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+           "v DOUBLE, PRIMARY KEY (h))")
+    db.sql("CREATE VIEW vw AS SELECT h, v FROM t")
+    r = db.sql("SELECT table_name, view_definition FROM "
+               "information_schema.views")
+    assert r.rows == [["vw", "SELECT h, v FROM t"]]
+    r = db.sql("SELECT constraint_type FROM "
+               "information_schema.table_constraints "
+               "WHERE table_name = 't' ORDER BY constraint_type")
+    assert [x[0] for x in r.rows] == ["PRIMARY KEY", "TIME INDEX"]
+    db.sql("DROP TABLE t")
+    r = db.sql("SELECT table_name FROM information_schema.recycle_bin")
+    assert r.rows == [["t"]]
+    n = db.sql("SELECT count(*) FROM information_schema.tables "
+               "WHERE table_schema = 'information_schema'").rows[0][0]
+    assert n >= 22, n
+    for vt in ("triggers", "check_constraints", "character_sets",
+               "collations"):
+        db.sql(f"SELECT * FROM information_schema.{vt}")
+    db.close()

@@ -1290,3 +1290,74 @@ class TestFullTextSearch:
         })
         assert len(out.rows) == 0  # not resurrected
         db.close()
+
+
+class TestExistsSubqueries:
+    """[NOT] EXISTS with equality decorrelation (reference sqlness
+    subquery cases under tests/cases/standalone/common/select/)."""
+
+    @pytest.fixture
+    def db2(self, tmp_path):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        d = GreptimeDB(str(tmp_path / "ex"))
+        d.sql("CREATE TABLE hosts (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+              "up DOUBLE, PRIMARY KEY (h))")
+        d.sql("CREATE TABLE alerts (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+              "sev DOUBLE, PRIMARY KEY (h))")
+        d.sql("INSERT INTO hosts VALUES ('a',1000,1.0),('b',1000,1.0),"
+              "('c',1000,0.0)")
+        d.sql("INSERT INTO alerts VALUES ('a',1000,3.0),('c',2000,5.0)")
+        yield d
+        d.close()
+
+    def test_correlated_exists(self, db2):
+        r = db2.sql("SELECT h FROM hosts WHERE EXISTS (SELECT 1 FROM "
+                    "alerts WHERE alerts.h = hosts.h) ORDER BY h")
+        assert r.rows == [["a"], ["c"]]
+
+    def test_correlated_not_exists(self, db2):
+        r = db2.sql("SELECT h FROM hosts WHERE NOT EXISTS (SELECT 1 FROM "
+                    "alerts WHERE alerts.h = hosts.h) ORDER BY h")
+        assert r.rows == [["b"]]
+
+    def test_correlated_exists_extra_predicate(self, db2):
+        r = db2.sql("SELECT h FROM hosts WHERE EXISTS (SELECT 1 FROM "
+                    "alerts WHERE alerts.h = hosts.h AND sev > 4)")
+        assert r.rows == [["c"]]
+
+    def test_uncorrelated_exists(self, db2):
+        assert db2.sql("SELECT count(*) FROM hosts WHERE EXISTS "
+                       "(SELECT 1 FROM alerts)").rows == [[3]]
+        assert db2.sql("SELECT count(*) FROM hosts WHERE EXISTS "
+                       "(SELECT 1 FROM alerts WHERE sev > 99)").rows == [[0]]
+        assert db2.sql("SELECT count(*) FROM hosts WHERE NOT EXISTS "
+                       "(SELECT 1 FROM alerts WHERE sev > 99)").rows == [[3]]
+
+    def test_exists_combined_with_predicate(self, db2):
+        r = db2.sql("SELECT h FROM hosts WHERE up > 0 AND EXISTS "
+                    "(SELECT 1 FROM alerts WHERE alerts.h = hosts.h)")
+        assert r.rows == [["a"]]
+
+
+def test_matches_score_and_cjk(tmp_path):
+    from greptimedb_tpu.standalone import GreptimeDB
+
+    db = GreptimeDB(str(tmp_path / "ft"))
+    db.sql("CREATE TABLE logs (svc STRING, ts TIMESTAMP(3) TIME INDEX, "
+           "msg STRING, PRIMARY KEY (svc)) WITH (append_mode='true')")
+    db.sql("INSERT INTO logs VALUES "
+           "('a',1,'database error connecting error'),"
+           "('a',2,'all good here'),('a',3,'one error only'),"
+           "('a',4,'数据库连接失败')")
+    r = db.sql("SELECT msg, matches_score(msg, 'error') AS s FROM logs "
+               "WHERE matches(msg, 'error') ORDER BY s DESC")
+    assert [row[0] for row in r.rows] == [
+        "database error connecting error", "one error only"]
+    assert r.rows[0][1] > r.rows[1][1] > 0
+    # CJK bigram tokenization (dictionary-free jieba analog)
+    assert db.sql("SELECT msg FROM logs WHERE matches(msg, '数据库')"
+                  ).rows == [["数据库连接失败"]]
+    assert db.sql("SELECT count(*) FROM logs WHERE matches(msg, '失败')"
+                  ).rows == [[1]]
+    db.close()
