@@ -281,6 +281,19 @@ def map_expr(e, fn):
     return fn(e2)
 
 
+def walk_columns(e) -> list:
+    """All Column nodes in an expression tree (shared walker client)."""
+    out: list = []
+
+    def visit(node):
+        if isinstance(node, Column):
+            out.append(node)
+        return node
+
+    map_expr(e, visit)
+    return out
+
+
 def split_conjuncts(e) -> list:
     """Flatten a WHERE tree into its AND-ed conjuncts (empty for None)."""
     if e is None:

@@ -186,9 +186,27 @@ class QueryEngine:
             # means EXISTS is always false!) — refuse instead
             raise Unsupported(
                 "correlated EXISTS with LIMIT/OFFSET/GROUP BY/HAVING")
+        from greptimedb_tpu.query.exprs import is_aggregate
+
+        if any(is_aggregate(it.expr) for it in sub.items):
+            # an aggregate subquery yields exactly one row per outer value
+            # (EXISTS is then unconditionally true) — membership over the
+            # correlation column would wrongly drop unmatched outer rows
+            raise Unsupported("correlated EXISTS over an aggregate")
         if len(corr) > 1:
             raise Unsupported(
                 "correlated EXISTS supports one equality correlation")
+        # any OTHER outer reference left in the residual WHERE would bind
+        # to the inner table by bare name (exprs.py resolution fallback)
+        # and silently evaluate wrong — refuse
+        from greptimedb_tpu.query.ast import walk_columns
+
+        for conj in rest:
+            for c in walk_columns(conj):
+                if is_outer(c):
+                    raise Unsupported(
+                        "correlated EXISTS supports outer references only "
+                        "as a single equality correlation")
         inner_col, outer_col = corr[0]
         new_where = None
         for c in rest:

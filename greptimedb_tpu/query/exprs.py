@@ -721,31 +721,11 @@ def _compile_ft_match(e: FuncCall, ctx: TableContext):
         vocab = enc.values()
     if e.name == "matches_score":
         # TF-IDF relevance (reference: tantivy BM25 ranking,
-        # src/index/src/fulltext_index/): per-DISTINCT-term tf vectors,
-        # idf over the vocabulary as the corpus, gathered to rows by code
-        import math
+        # src/index/src/fulltext_index/): the shared corpus scorer over
+        # the dictionary vocabulary, gathered to rows by code
+        from greptimedb_tpu.storage.index import ft_score_corpus
 
-        from greptimedb_tpu.storage.index import ft_score
-
-        qtokens, tf_vector = ft_score(lit.value)
-        n_terms = max(len(vocab), 1)
-        tfs = []
-        dfs = [0] * len(qtokens)
-        for t in vocab:
-            v = tf_vector(str(t))
-            tfs.append(v)
-            for j, x in enumerate(v):
-                if x:
-                    dfs[j] += 1
-        idf = [
-            math.log(1.0 + (n_terms - df + 0.5) / (df + 0.5))
-            for df in dfs
-        ]
-        scores = np.asarray(
-            [sum(w * i for w, i in zip(v, idf)) for v in tfs],
-            dtype=np.float64,
-        ) if vocab else np.zeros(1, dtype=np.float64)
-        sc = jnp.asarray(scores)
+        sc = jnp.asarray(ft_score_corpus(lit.value, list(vocab)))
 
         def score_fn(env, col_name=real, s=sc):
             codes = env[col_name]
@@ -894,21 +874,9 @@ def eval_host(e: Expr, env: dict[str, np.ndarray], n: int):
                 return_inverse=True,
             )
             if e.name == "matches_score":
-                import math
+                from greptimedb_tpu.storage.index import ft_score_corpus
 
-                from greptimedb_tpu.storage.index import ft_score
-
-                qtokens, tf_vector = ft_score(lit.value)
-                tfs = [tf_vector(str(u)) for u in uniq]
-                dfs = [sum(1 for v in tfs if v[j]) for j in
-                       range(len(qtokens))]
-                n_docs = max(len(uniq), 1)
-                idf = [math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
-                       for df in dfs]
-                scores = np.asarray(
-                    [sum(w * i for w, i in zip(v, idf)) for v in tfs],
-                    dtype=np.float64)
-                return scores[inv]
+                return ft_score_corpus(lit.value, list(uniq))[inv]
             pred = _ft_pred(e.name, lit.value)
             hits = np.asarray([pred(str(u)) for u in uniq], dtype=bool)
             return hits[inv]

@@ -311,6 +311,28 @@ def ft_score(query: str):
     return qtokens, tf_vector
 
 
+def ft_score_corpus(query: str, corpus) -> "np.ndarray":
+    """Score every text in ``corpus`` against ``query`` — the ONE
+    TF-IDF computation, shared by the device (dictionary vocabulary) and
+    host (batch distinct values) paths so the BM25 constants can never
+    diverge between them."""
+    import math
+
+    import numpy as np
+
+    qtokens, tf_vector = ft_score(query)
+    tfs = [tf_vector(str(t)) for t in corpus]
+    n_docs = max(len(tfs), 1)
+    dfs = [sum(1 for v in tfs if v[j]) for j in range(len(qtokens))]
+    idf = [math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5)) for df in dfs]
+    if not tfs:
+        return np.zeros(1, dtype=np.float64)
+    return np.asarray(
+        [sum(w * i for w, i in zip(v, idf)) for v in tfs],
+        dtype=np.float64,
+    )
+
+
 def sst_tokens_may_match(
     index: dict[str, ColumnIndex], column: str, query_tokens: list[str]
 ) -> bool:

@@ -1339,6 +1339,23 @@ class TestExistsSubqueries:
                     "(SELECT 1 FROM alerts WHERE alerts.h = hosts.h)")
         assert r.rows == [["a"]]
 
+    def test_exists_unsupported_shapes_refused(self, db2):
+        from greptimedb_tpu.errors import Unsupported
+
+        # outer reference outside the equality correlation
+        with pytest.raises(Unsupported):
+            db2.sql("SELECT h FROM hosts WHERE EXISTS (SELECT 1 FROM "
+                    "alerts WHERE alerts.h = hosts.h AND "
+                    "alerts.ts > hosts.ts)")
+        # aggregate subquery (always one row -> EXISTS always true)
+        with pytest.raises(Unsupported):
+            db2.sql("SELECT h FROM hosts WHERE EXISTS (SELECT max(sev) "
+                    "FROM alerts WHERE alerts.h = hosts.h)")
+        # LIMIT inside correlated EXISTS
+        with pytest.raises(Unsupported):
+            db2.sql("SELECT h FROM hosts WHERE EXISTS (SELECT 1 FROM "
+                    "alerts WHERE alerts.h = hosts.h LIMIT 0)")
+
 
 def test_matches_score_and_cjk(tmp_path):
     from greptimedb_tpu.standalone import GreptimeDB
