@@ -534,3 +534,37 @@ class TestFirstLastPartials:
             "GROUP BY host ORDER BY host"
         )
         assert res.rows == [["a", 42.0, 1.0], ["z", 11.0, 7.0]]
+
+
+class TestPromGateway:
+    def test_prom_query_over_flight(self, tmp_path):
+        """PromQL over the gRPC substrate (reference
+        src/servers/src/grpc/prom_query_gateway.rs analog)."""
+        import threading
+
+        from greptimedb_tpu.rpc.promgateway import (
+            PromGatewayServer, prom_query,
+        )
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(str(tmp_path / "pg"))
+        db.sql("CREATE TABLE up (job STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "val DOUBLE, PRIMARY KEY (job))")
+        db.sql("INSERT INTO up VALUES ('api', 1700000000000, 1.0), "
+               "('web', 1700000000000, 0.0)")
+        srv = PromGatewayServer(db)
+        threading.Thread(target=srv.serve, daemon=True).start()
+        try:
+            out = prom_query(srv.address, "up", time=1700000000.0)
+            assert out["status"] == "success"
+            got = {r["metric"]["job"]: r["value"][1]
+                   for r in out["data"]["result"]}
+            assert got == {"api": "1.0", "web": "0.0"}
+            rng = prom_query(srv.address, "up", start=1700000000.0,
+                             end=1700000060.0, step=30)
+            assert rng["data"]["resultType"] == "matrix"
+            bad = prom_query(srv.address, "up{{{")
+            assert bad["status"] == "error"
+        finally:
+            srv.shutdown()
+            db.close()
