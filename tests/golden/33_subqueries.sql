@@ -1,0 +1,11 @@
+CREATE TABLE hosts (h STRING, ts TIMESTAMP(3) TIME INDEX, up DOUBLE, PRIMARY KEY (h));
+CREATE TABLE alerts (h STRING, ts TIMESTAMP(3) TIME INDEX, sev DOUBLE, PRIMARY KEY (h));
+INSERT INTO hosts VALUES ('a', 1000, 1.0), ('b', 1000, 1.0), ('c', 1000, 0.0);
+INSERT INTO alerts VALUES ('a', 1000, 3.0), ('c', 2000, 5.0);
+SELECT h FROM hosts WHERE EXISTS (SELECT 1 FROM alerts WHERE alerts.h = hosts.h) ORDER BY h;
+SELECT h FROM hosts WHERE NOT EXISTS (SELECT 1 FROM alerts WHERE alerts.h = hosts.h) ORDER BY h;
+SELECT h FROM hosts WHERE EXISTS (SELECT 1 FROM alerts WHERE alerts.h = hosts.h AND sev > 4) ORDER BY h;
+SELECT h FROM hosts WHERE h IN (SELECT h FROM alerts) ORDER BY h;
+SELECT h FROM hosts WHERE h NOT IN (SELECT h FROM alerts) ORDER BY h;
+SELECT h, up FROM hosts WHERE up = (SELECT max(up) FROM hosts) ORDER BY h;
+SELECT count(*) FROM hosts WHERE EXISTS (SELECT 1 FROM alerts)
