@@ -463,6 +463,7 @@ class ShowDatabases(Statement):
 @dataclass
 class ShowCreateTable(Statement):
     table: str
+    view: bool = False  # SHOW CREATE VIEW
 
 
 @dataclass

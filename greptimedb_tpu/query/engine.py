@@ -349,7 +349,14 @@ class QueryEngine:
             filt.append(str(plan.where))
         if filt:
             lines.append(f"Filter: {' AND '.join(filt)}")
-        lines.append(f"TpuScan: table={plan.table} (HBM-resident, masked)")
+        mesh = getattr(self.provider, "mesh", None)
+        if mesh is not None:
+            lines.append(
+                f"TpuScan: table={plan.table} (HBM-resident, series axis "
+                f"sharded over {mesh.devices.size}-device mesh, GSPMD "
+                "collectives)")
+        else:
+            lines.append(f"TpuScan: table={plan.table} (HBM-resident, masked)")
         return "\n".join(f"{'  ' * i}{l}" for i, l in enumerate(lines))
 
     # ------------------------------------------------------------------

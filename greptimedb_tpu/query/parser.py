@@ -1021,7 +1021,9 @@ class Parser:
         if self.eat_kw("FLOWS"):
             return ShowFlows()
         if self.eat_kw("CREATE"):
-            self.expect_kw("TABLE")
+            if not self.eat_kw("TABLE"):
+                self.expect_kw("VIEW")
+                return ShowCreateTable(self.qualified_name(), view=True)
             return ShowCreateTable(self.qualified_name())
         nxt = self.peek(1)
         if self.at_kw("PROCESSLIST") or (

@@ -1360,6 +1360,13 @@ class GreptimeDB(TableProvider):
     def _show_create(self, stmt: ShowCreateTable) -> QueryResult:
         db, name = self._split_name(stmt.table)
         info = self.catalog.get_table(db, name)
+        if info.engine == "view" or stmt.view:
+            if info.engine != "view":
+                raise InvalidArguments(f"{db}.{name} is a table, not a view")
+            text = (f'CREATE VIEW "{info.name}" AS '
+                    f'{info.options.get("definition", "")}')
+            return QueryResult(["View", "Create View"],
+                               [[info.name, text]])
         lines = [f"CREATE TABLE IF NOT EXISTS \"{info.name}\" ("]
         defs = []
         for c in info.schema:
