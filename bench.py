@@ -334,6 +334,13 @@ def prepare_grid(db) -> None:
     if table is None:
         log("WARNING: region ineligible for the dense grid; row path")
         return
+    if _backend != "cpu":
+        # persisting would pull the multi-GB resident tensors BACK through
+        # the relay (its observed failure mode is exactly bulk transfers);
+        # TPU runs rebuild from SSTs instead
+        log(f"grid built in {time.time() - t0:.0f}s (snapshot persist "
+            "skipped on accelerator backend)")
+        return
     log(f"grid built in {time.time() - t0:.0f}s; persisting snapshot ...")
     try:
         save_grid_snapshot(table, region, snap)
