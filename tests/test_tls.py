@@ -322,3 +322,51 @@ class TestTlsRequire:
 
         with pytest.raises(ValueError):
             context_from_config(TlsConfig(mode="require"), str(tmp_path))
+
+
+def test_scram_over_tls_combined(certs):
+    """The production shape: SSLRequest upgrade, then SCRAM-SHA-256 over
+    the encrypted stream, then a query."""
+    from greptimedb_tpu.servers.postgres import PostgresServer
+    from greptimedb_tpu.utils.auth import StaticUserProvider
+
+    db = GreptimeDB()
+    db.user_provider = StaticUserProvider({"bob": "s3cr3t"})
+    db.sql("CREATE TABLE t (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+           "v DOUBLE, PRIMARY KEY (h))")
+    db.sql("INSERT INTO t VALUES ('a', 1000, 7.5)")
+    pg = PostgresServer(db, port=0, ssl_context=make_server_context(*certs),
+                        auth_mode="scram", tls_require=True)
+    pg.start()
+    try:
+        raw = socket.create_connection(("127.0.0.1", pg.port), timeout=5)
+        raw.sendall(struct.pack(">II", 8, 80877103))
+        assert raw.recv(1) == b"S"
+        s = _client_ctx().wrap_socket(raw)
+        ok, server_sig = _scram_client_exchange(s, "bob", "s3cr3t")
+        assert ok and server_sig
+        q = b"SELECT v FROM t\x00"
+        s.sendall(b"Q" + struct.pack(">I", len(q) + 4) + q)
+        saw_row = False
+        while True:
+            tag = s.recv(1)
+            ln = struct.unpack(">I", _recvn_sock(s, 4))[0]
+            body = _recvn_sock(s, ln - 4)
+            if tag == b"D":
+                saw_row = body.endswith(b"7.5")
+            if tag == b"Z":
+                break
+        assert saw_row
+        s.close()
+    finally:
+        pg.stop()
+        db.close()
+
+
+def _recvn_sock(sock, n):
+    buf = b""
+    while len(buf) < n:
+        c = sock.recv(n - len(buf))
+        assert c, "closed"
+        buf += c
+    return buf
