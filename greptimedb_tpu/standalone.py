@@ -682,6 +682,16 @@ class GreptimeDB(TableProvider):
 
             if info.is_information_schema(stmt.table):
                 return info.execute(self, stmt)
+            if stmt.table and stmt.table.lower() == \
+                    "greptime_private.recycle_bin":
+                # reference location of the soft-drop listing
+                # (purge_dropped_table.rs); same builder as
+                # information_schema.recycle_bin
+                import copy
+
+                sel = copy.copy(stmt)
+                sel.table = f"{info.INFORMATION_SCHEMA}.recycle_bin"
+                return info.execute(self, sel)
             if info.is_pg_catalog(stmt.table):
                 return info.execute_pg_catalog(self, stmt)
             if (
