@@ -154,7 +154,15 @@ class FlowEngine:
         # where the frontend reapplies, but a flow has no such finisher
         from greptimedb_tpu.rpc.partial import split_partial
 
-        plan = split_partial(sel)
+        ts_col = None
+        try:
+            ti = self.db.table_context(sel.table).schema.time_index
+            ts_col = ti.name if ti is not None else None
+        except Exception:  # noqa: BLE001 — source missing: batching mode
+            pass
+        # with the time index known, first/last decompose into pick pairs
+        # (value-at-extreme-ts) and stream through the same merge_into
+        plan = split_partial(sel, ts_column=ts_col)
         if plan is not None and not sel.order_by and sel.limit is None:
             task.mode = "streaming"
             task.partial_plan = plan

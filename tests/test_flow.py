@@ -31,9 +31,11 @@ class TestDualEngineSelection:
 
     def test_non_decomposable_query_batches(self, db):
         _mk_source(db)
+        # first/last now STREAM (r4 pick pairs) — use a genuinely
+        # non-decomposable aggregate to pin the batching fallback
         db.sql("CREATE FLOW f2 SINK TO s2 AS SELECT "
                "date_bin(INTERVAL '1 minute', ts) AS w, h, "
-               "first_value(v) AS fv FROM src GROUP BY w, h")
+               "count(DISTINCT v) AS dv FROM src GROUP BY w, h")
         assert db.flow_engine.flows["f2"].mode == "batching"
 
 
@@ -195,3 +197,22 @@ class TestStreamingReviewRegressions:
                "date_bin(INTERVAL '1 minute', ts) AS w, h, sum(v) AS s "
                "FROM src GROUP BY w, h ORDER BY s DESC LIMIT 1")
         assert db.flow_engine.flows["f"].mode == "batching"
+
+
+def test_streaming_first_last_flow(tmp_path):
+    """first/last decompose into pick pairs (rpc/partial.py) and STREAM
+    instead of falling back to batching (round-4)."""
+    from greptimedb_tpu.standalone import GreptimeDB
+
+    db = GreptimeDB(str(tmp_path / "fl"))
+    db.sql("CREATE TABLE src (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+           "v DOUBLE, PRIMARY KEY (h))")
+    db.sql("CREATE FLOW lv SINK TO lv_sink AS SELECT h, last_value(v) AS "
+           "l, first_value(v) AS f FROM src GROUP BY h")
+    assert db.flow_engine.flows["lv"].mode == "streaming"
+    db.sql("INSERT INTO src VALUES ('a', 1000, 1.0), ('a', 3000, 9.0)")
+    db.sql("INSERT INTO src VALUES ('a', 2000, 4.0)")  # mid-ts late row
+    r = db.sql("SELECT l, f FROM lv_sink WHERE h = 'a' "
+               "ORDER BY update_at DESC LIMIT 1")
+    assert r.rows == [[9.0, 1.0]]  # last by ts (not arrival), first by ts
+    db.close()

@@ -165,11 +165,12 @@ class TestFlowFailover:
         assert "fr" in eng.flows
 
     def test_batching_flow_failover_marks_full_range(self, db, plane):
-        # first_value() is non-decomposable → batching mode
+        # count(DISTINCT) is non-decomposable → batching mode
+        # (first/last stream since the r4 pick-pair decomposition)
         stmt = parse_sql(
             "CREATE FLOW fb SINK TO sinkb AS SELECT"
             " date_bin(INTERVAL '1 minute', ts) AS w, h,"
-            " first_value(v) AS fv FROM src GROUP BY w, h")[0]
+            " count(DISTINCT v) AS fv FROM src GROUP BY w, h")[0]
         node_id = plane.create_flow(stmt)
         assert plane.nodes[node_id].engine.flows["fb"].mode == "batching"
         _ingest(db, plane, [("a", 1_000, 1.0), ("b", 61_000, 5.0)])
@@ -183,4 +184,4 @@ class TestFlowFailover:
         assert task.dirty  # full source range marked for re-query
         plane.run_all()
         rows = db.sql("SELECT h, fv FROM sinkb ORDER BY h").rows
-        assert rows == [["a", 1.0], ["b", 5.0]]
+        assert rows == [["a", 1.0], ["b", 1.0]]  # one distinct v each
