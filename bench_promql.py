@@ -50,18 +50,15 @@ def log(msg: str) -> None:
 
 
 _times: list[float] = []
+_warmup_times: list[float] = []  # SIGTERM fallback before any timed run
 _emitted = False
 _backend = "unknown"
 
 
-def emit() -> None:
-    global _emitted
-    if _emitted or not _times:
-        return
-    _emitted = True
-    sec = float(np.median(_times))
+def _line(times: list[float], warmup: bool = False) -> str:
+    sec = float(np.median(times))
     total_samples = SERIES * SAMPLES
-    print(json.dumps({
+    line = {
         "metric": "promql_rate_sum_rows_per_s",
         "value": round(total_samples / sec, 1),
         "unit": "rows/s",
@@ -70,13 +67,41 @@ def emit() -> None:
         "series": SERIES,
         "samples_per_series": SAMPLES,
         "eval_ms": round(sec * 1000, 1),
-        "runs": len(_times),
-    }), flush=True)
+        "runs": len(times),
+    }
+    notes = []
+    if SERIES != 1_000_000:
+        notes.append(f"reduced cardinality {SERIES}/1000000")
+    if warmup:
+        # killed before any warm run: the number includes JIT compile
+        # and understates steady-state throughput
+        notes.append("warmup-only (includes compile)")
+    if notes:
+        line["note"] = "; ".join(notes)
+    return json.dumps(line)
+
+
+def emit(times: list[float] | None = None) -> None:
+    global _emitted
+    times = times if times is not None else _times
+    if _emitted or not times:
+        return
+    _emitted = True
+    print(_line(times), flush=True)
 
 
 def _on_term(signum, frame):
-    if not _emitted and _times:
-        emit()
+    # async-signal context: the main thread may hold the stdout lock, so
+    # print() could raise a reentrancy error — raw os.write instead
+    global _emitted
+    if not _emitted:
+        times = _times or _warmup_times[-1:]
+        if times:
+            _emitted = True
+            # only the FIRST warmup run includes JIT compile; the second
+            # is a clean post-compile measurement
+            wu = not _times and len(_warmup_times) < 2
+            os.write(1, (_line(times, warmup=wu) + "\n").encode())
     os._exit(0 if _emitted else 1)
 
 
@@ -154,10 +179,15 @@ def main() -> None:
 
     log("warmup (compile) ...")
     first = run_once()
+    _warmup_times.append(first)
     log(f"  first: {first * 1000:.0f} ms")
     second = run_once()
+    _warmup_times.append(second)
     log(f"  second: {second * 1000:.0f} ms")
 
+    # EMIT EARLY (round-4 verdict, weak item 1): the r04 driver capture
+    # ended before this child printed anything — the line of record goes
+    # out after 3 timed runs; any further runs only refine the stderr log
     deadline = START + BUDGET_S
     hard_cap = deadline + 300
     while len(_times) < 10:
@@ -166,6 +196,8 @@ def main() -> None:
         if not (now + est < deadline or (est < 30 and now + est < hard_cap)):
             break
         _times.append(run_once())
+        if len(_times) == 3:
+            emit()
     if not _times:
         _times.append(second)
     log(f"runs: {[f'{t * 1000:.0f}' for t in _times]} ms "

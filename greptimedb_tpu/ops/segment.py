@@ -99,9 +99,17 @@ def segment_reduce(
 
     if op == "sum":
         v = values if is_float else values.astype(jnp.int64)
-        return jax.ops.segment_sum(
+        s = jax.ops.segment_sum(
             jnp.where(m, v, 0), ids, num_segments=ns, indices_are_sorted=srt
         )[:num_segments]
+        if not is_float:
+            # ints have no NULL repr on device; 0 matches the int min/max
+            # convention (callers mask empty groups via their count)
+            return s
+        # SQL: SUM over zero rows is NULL, not 0 (surfaces only for
+        # global aggregates — grouped empties are gmask-filtered)
+        cnt = _seg_count(m, ids, ns, srt)[:num_segments]
+        return jnp.where(cnt > 0, s, jnp.nan)
 
     if op in ("min", "max"):
         fn = jax.ops.segment_min if op == "min" else jax.ops.segment_max
@@ -312,7 +320,9 @@ def sorted_segment_reduce(
             v = values.astype(jnp.int64)
             s = cs(jnp.where(m, v, 0))[ends] - cs(jnp.where(m, v, 0))[starts]
         if op == "sum":
-            return s
+            # SQL: float SUM over zero rows is NULL (matches
+            # segment_reduce; ints keep 0 — no device NULL repr)
+            return jnp.where(cnt > 0, s, jnp.nan) if is_float else s
         sf = s.astype(jnp.float32) if not is_float else s
         return jnp.where(cnt > 0, sf / jnp.maximum(cnt, 1).astype(sf.dtype),
                          jnp.nan)

@@ -133,47 +133,71 @@ def udd_gamma(error_rate: float) -> float:
 _K_SENTINEL = 1 << 30
 
 
-def udd_fold(vals: jnp.ndarray, gid: jnp.ndarray, ng: int,
-             mask: jnp.ndarray, gamma: float, nb: int) -> jnp.ndarray:
-    """→ [ng, nb+2] int64: bucket counts + (base_start, collapse c).
-
-    Base bucket key k covers (γ^(k-1), γ^k].  Like real UDDSketch, a
-    group whose key span exceeds nb COLLAPSES: its buckets widen to
-    c = 2^j base keys (γ_eff = γ^c), with c chosen per group from the
-    segment min/max key span — all inside the one device pass.  The
-    grid starts at base_start = floor(k_min / c) * c, so collapsed
-    buckets align to absolute multiples of c and states remain
-    mergeable in base-γ key space.  Only positive finite values count
-    (the UDDSketch domain)."""
+def udd_keys(vals: jnp.ndarray, mask: jnp.ndarray, gamma: float):
+    """→ (base-γ bucket key per row, validity).  Base bucket key k
+    covers (γ^(k-1), γ^k]; only positive finite values count (the
+    UDDSketch domain)."""
     v = vals.astype(jnp.float64)
     ok = mask & (v > 0) & jnp.isfinite(v)
     k = jnp.ceil(
         jnp.log(jnp.maximum(v, 1e-300)) / math.log(gamma)).astype(jnp.int64)
+    return k, ok
+
+
+def udd_key_extremes(k: jnp.ndarray, ok: jnp.ndarray, gid: jnp.ndarray,
+                     ng: int):
+    """Per-group (k_min, k_max) with empty-group sentinels — the piece a
+    distributed fold further reduces with pmin/pmax collectives before
+    bucketing (parallel/dist.py)."""
     ids = jnp.where(ok, gid, ng).astype(jnp.int32)
     kmin = jnp.full(ng + 1, _K_SENTINEL, dtype=jnp.int64)
     kmin = kmin.at[ids].min(jnp.where(ok, k, _K_SENTINEL))
     kmax = jnp.full(ng + 1, -_K_SENTINEL, dtype=jnp.int64)
     kmax = kmax.at[ids].max(jnp.where(ok, k, -_K_SENTINEL))
-    span = jnp.maximum(kmax[:ng] - kmin[:ng] + 1, 1)
-    # c = next power of two of ceil((span+2) / nb) — +2 pads for the
-    # base-alignment shift so ceil-indexed buckets never exceed nb;
-    # exp2/log2 on small ints
+    return kmin[:ng], kmax[:ng]
+
+
+def udd_bucket_counts(k: jnp.ndarray, ok: jnp.ndarray, gid: jnp.ndarray,
+                      ng: int, nb: int, kmin: jnp.ndarray,
+                      kmax: jnp.ndarray):
+    """→ ([ng, nb] counts, [ng] collapse c) from per-group key extremes.
+
+    The ONE definition of the collapse + bucket-index convention (local
+    and mesh folds must agree bit-exactly or their states won't merge):
+    a group whose key span exceeds nb COLLAPSES, buckets widening to
+    c = 2^j base keys (γ_eff = γ^c); c = next power of two of
+    ceil((span+2)/nb), the +2 padding for the base-alignment shift so
+    ceil-indexed buckets never exceed nb.  The grid starts at
+    base = floor(k_min / c) * c, so collapsed buckets align to absolute
+    multiples of c and states remain mergeable in base-γ key space.
+    Upper-edge convention: base key k belongs to γ_eff bucket ceil(k/c)
+    — matches the state doc ("bucket K covers (γ_eff^(K-1), γ_eff^K]")
+    and merge_udd_states' re-key rule."""
+    span = jnp.maximum(kmax - kmin + 1, 1)
     need = jnp.ceil((span.astype(jnp.float64) + 2) / nb)
     c = jnp.exp2(jnp.ceil(jnp.log2(jnp.maximum(need, 1.0)))).astype(jnp.int64)
     c = jnp.maximum(c, 1)
-    base = jnp.floor_divide(kmin[:ng], c) * c
-    c_row = c[jnp.clip(gid, 0, ng - 1)]
-    base_row = base[jnp.clip(gid, 0, ng - 1)]
-    # upper-edge convention: base key k belongs to γ_eff bucket
-    # ceil(k/c) — matches the state doc ("bucket K covers
-    # (γ_eff^(K-1), γ_eff^K]") and the merge re-key rule
+    base = jnp.floor_divide(kmin, c) * c
+    gidc = jnp.clip(gid, 0, ng - 1)
+    c_row = c[gidc]
+    base_row = base[gidc]
     idx = jnp.clip(
         jnp.floor_divide(k - base_row + c_row - 1, c_row), 0, nb - 1)
     cell = jnp.where(ok, gid.astype(jnp.int64) * nb + idx, ng * nb)
     grid = jnp.zeros(ng * nb + 1, dtype=jnp.int64)
     grid = grid.at[cell].add(jnp.where(ok, 1, 0))
+    return grid[:-1].reshape(ng, nb), c
+
+
+def udd_fold(vals: jnp.ndarray, gid: jnp.ndarray, ng: int,
+             mask: jnp.ndarray, gamma: float, nb: int) -> jnp.ndarray:
+    """→ [ng, nb+2] int64: bucket counts + (k_min, collapse c) — the
+    single-device fold; collapse/bucketing live in udd_bucket_counts."""
+    k, ok = udd_keys(vals, mask, gamma)
+    kmin, kmax = udd_key_extremes(k, ok, gid, ng)
+    counts, c = udd_bucket_counts(k, ok, gid, ng, nb, kmin, kmax)
     return jnp.concatenate(
-        [grid[:-1].reshape(ng, nb), kmin[:ng, None], c[:, None]], axis=1)
+        [counts, kmin[:, None], c[:, None]], axis=1)
 
 
 def udd_merge_fold(codes: jnp.ndarray, vocab_counts: jnp.ndarray,
@@ -236,6 +260,62 @@ def decode_udd(state: str):
                 {int(k): int(v) for k, v in doc["c"].items()})
     except Exception:  # noqa: BLE001
         return None
+
+
+def merge_hll_states(a: str | None, b: str | None) -> str | None:
+    """Merge two encoded HLL states (register-wise max) — the host side of
+    the distributed exchange (reference hll.rs merge_batch); None-tolerant
+    so empty shards pass through."""
+    ra = decode_hll(a) if a is not None else None
+    rb = decode_hll(b) if b is not None else None
+    if ra is None:
+        return b if rb is not None else None
+    if rb is None:
+        return a
+    return encode_hll(np.maximum(ra, rb))
+
+
+def merge_udd_states(a: str | None, b: str | None) -> str | None:
+    """Merge two encoded UDDSketch states.  Both must share (γ_base, nb);
+    the coarser collapse factor wins and the finer state re-keys into it
+    (bucket k at factor c1 maps wholly into ceil(k·c1/c2) at c2 ≥ c1
+    because c2 is a multiple of c1 — see udd_fold's alignment invariant).
+    If the union still exceeds nb distinct keys, collapse doubles until
+    it fits, exactly like reference uddsketch compaction."""
+    da = decode_udd(a) if a is not None else None
+    db = decode_udd(b) if b is not None else None
+    if da is None:
+        return b if db is not None else None
+    if db is None:
+        return a
+    _ga, gba, ca, nba, ka = da
+    _gb, gbb, cb, nbb, kb = db
+    if round(gba, 9) != round(gbb, 9) or nba != nbb:
+        raise ValueError(
+            "uddsketch merge: states built with different (error_rate, "
+            "bucket_limit) configs")
+    if not ka:
+        return b
+    if not kb:
+        return a
+
+    def rekey(counts: dict[int, int], c_from: int, c_to: int) -> dict:
+        if c_from == c_to:
+            return dict(counts)
+        m = c_to // c_from
+        out: dict[int, int] = {}
+        for k, v in counts.items():
+            out[-((-k) // m)] = out.get(-((-k) // m), 0) + v
+        return out
+
+    c = max(ca, cb)
+    merged = rekey(ka, ca, c)
+    for k, v in rekey(kb, cb, c).items():
+        merged[k] = merged.get(k, 0) + v
+    while len(merged) > nba:
+        c *= 2
+        merged = rekey(merged, c // 2, c)
+    return encode_udd_doc(merged, gba, c, nba)
 
 
 def udd_quantile(state: str, q: float) -> float | None:

@@ -63,6 +63,12 @@ class ShardedTable:
     def num_shards(self) -> int:
         return self.mesh.devices.size
 
+    def nbytes(self) -> int:
+        total = int(self.row_mask.size * self.row_mask.dtype.itemsize)
+        for a in self.columns.values():
+            total += int(a.size * a.dtype.itemsize)
+        return total
+
 
 def shard_table(
     host_columns: dict[str, np.ndarray],
@@ -218,7 +224,10 @@ class DistAggExecutor:
                 else:
                     _kind, ts_col, step, start, nb = spec
                     codes.append(bucket_index(env[ts_col], step, start))
-            gid, _tot = combine_keys(codes, cards)
+            if codes:
+                gid, _tot = combine_keys(codes, cards)
+            else:  # global aggregate: every row in the one group
+                gid = jnp.zeros(mask.shape, dtype=jnp.int64)
             valid = mask & (gid >= 0)
             ids = jnp.where(valid, gid, grid).astype(jnp.int32)
             ns = grid + 1
@@ -235,7 +244,11 @@ class DistAggExecutor:
                     cnt_cache[col_name] = c
                 return c
 
-            for out_name, op, col in agg_specs:
+            # sketch specs carry a 4th config element: (alias, "udd", col,
+            # (gamma, bucket_limit))
+            spec_extra = {s[0]: s[3] for s in agg_specs if len(s) > 3}
+            for spec_t in agg_specs:
+                out_name, op, col = spec_t[0], spec_t[1], spec_t[2]
                 if op == "count":
                     v = env[col] if col else jnp.zeros(mask.shape, jnp.float32)
                     m = valid & (
@@ -253,7 +266,10 @@ class DistAggExecutor:
                     )[:grid]
                     total = jax.lax.psum(part, SHARD_AXIS)
                     if op == "sum":
-                        out[out_name] = total
+                        # all-NULL groups: SUM is NULL, not 0 (matches
+                        # the single-device segment_reduce semantics)
+                        cnt = count_of(col, v, m)
+                        out[out_name] = jnp.where(cnt > 0, total, jnp.nan)
                     else:
                         cnt = count_of(col, v, m)
                         out[out_name] = jnp.where(
@@ -277,6 +293,35 @@ class DistAggExecutor:
                         out[out_name] = jnp.where(cnt > 0, merged, jnp.nan)
                     else:
                         out[out_name] = jnp.where(cnt > 0, merged, 0)
+                elif op == "hll":
+                    # HLL registers are a commutative max-fold: local
+                    # [grid, M] register grid, then ONE pmax over ICI —
+                    # the sketch IS the exchange format (ops/sketch.py)
+                    from greptimedb_tpu.ops.sketch import hll_fold
+
+                    regs = hll_fold(v, ids, grid, m)
+                    out[out_name] = jax.lax.pmax(regs, SHARD_AXIS)
+                elif op == "udd":
+                    # UDDSketch needs the GLOBAL per-group key span to pick
+                    # one collapse factor before bucketing, so the fold
+                    # interleaves collectives: pmin/pmax the key extremes,
+                    # then the SHARED bucketing (ops/sketch.py
+                    # udd_bucket_counts — one definition of the collapse
+                    # convention) and a psum of the counts
+                    from greptimedb_tpu.ops.sketch import (
+                        udd_bucket_counts, udd_key_extremes, udd_keys,
+                    )
+
+                    gamma, nb = spec_extra[out_name]
+                    kk, okm = udd_keys(v, m, gamma)
+                    kmin_l, kmax_l = udd_key_extremes(kk, okm, gid, grid)
+                    kmin_g = jax.lax.pmin(kmin_l, SHARD_AXIS)
+                    kmax_g = jax.lax.pmax(kmax_l, SHARD_AXIS)
+                    cnts, cc = udd_bucket_counts(
+                        kk, okm, gid, grid, nb, kmin_g, kmax_g)
+                    cnts = jax.lax.psum(cnts, SHARD_AXIS)
+                    out[out_name] = jnp.concatenate(
+                        [cnts, kmin_g[:, None], cc[:, None]], axis=1)
                 elif op in ("first", "last"):
                     # value at the extreme timestamp: local pick, then a
                     # ts-extreme collective and a winner-selection pmax —
@@ -406,7 +451,42 @@ def execute_select_on_mesh(
                 key_exprs.append((alias, it.expr, "expr", tuple(sorted(refs))))
         else:
             fc = it.expr
-            op = ops_map.get(getattr(fc, "name", None))
+            fname = getattr(fc, "name", None)
+            # sketch partials (split_partial's _SKETCH_PARTIALS): the mesh
+            # folds HLL registers / UDD buckets with collectives and the
+            # host fold serializes states for the shared merge
+            if fname == "hll":
+                if (len(fc.args) != 1
+                        or not isinstance(fc.args[0], Column)):
+                    return None
+                col = ctx.resolve(fc.args[0].name)
+                if col in tag_names:
+                    return None
+                agg_specs.append((alias, "hll", col))
+                continue
+            if fname == "uddsketch_state":
+                from greptimedb_tpu.ops.sketch import udd_gamma
+                from greptimedb_tpu.query.ast import Literal as _Lit
+
+                if (len(fc.args) != 3
+                        or not isinstance(fc.args[0], _Lit)
+                        or not isinstance(fc.args[1], _Lit)
+                        or not isinstance(fc.args[2], Column)):
+                    return None
+                try:
+                    # SAME clamp as physical.py _compile_sketch_agg: mesh
+                    # and single-device states must carry identical
+                    # (γ, nb) configs or merge_udd_states refuses them
+                    nb = max(8, min(int(fc.args[0].value), 4096))
+                    gamma = udd_gamma(float(fc.args[1].value))
+                except (ValueError, TypeError):
+                    return None  # single-device path raises the PlanError
+                col = ctx.resolve(fc.args[2].name)
+                if col in tag_names:
+                    return None
+                agg_specs.append((alias, "udd", col, (gamma, nb)))
+                continue
+            op = ops_map.get(fname)
             if op is None:
                 return None
             if not fc.args or isinstance(fc.args[0], Star):
@@ -476,6 +556,14 @@ def execute_select_on_mesh(
     # ---- host fold through the shared merge ---------------------------
     cnt = out["__count__"]
     keep = np.nonzero(cnt > 0)[0]
+    if not key_exprs and len(keep) == 0:
+        # SQL: a global aggregate returns exactly one row even when zero
+        # rows matched (count()=0, other aggregates NULL) — same special
+        # case as the single-device kernel (query/physical.py)
+        part0: dict[str, list] = {}
+        for spec_t in agg_specs:
+            part0[spec_t[0]] = [0 if spec_t[1] == "count" else None]
+        return merge_partials(pplan, [part0])
     comps = (np.unravel_index(keep, tuple(cards)) if cards
              else (np.zeros(len(keep), dtype=np.int64),))
     env_host: dict[str, np.ndarray] = {}
@@ -495,9 +583,19 @@ def execute_select_on_mesh(
             if arr.ndim == 0:
                 arr = np.full(len(keep), arr.item(), dtype=object)
             part[alias] = arr.tolist()
-    for alias, _op, _col in agg_specs:
+    for spec_t in agg_specs:
+        alias, aop = spec_t[0], spec_t[1]
         vals = np.asarray(out[alias])[keep]
-        if vals.dtype.kind == "f":
+        if aop == "hll":
+            from greptimedb_tpu.ops import sketch as sk
+
+            part[alias] = [sk.encode_hll(r) for r in vals]
+        elif aop == "udd":
+            from greptimedb_tpu.ops import sketch as sk
+
+            gamma, nb = spec_t[3]
+            part[alias] = [sk.encode_udd(r, gamma, nb) for r in vals]
+        elif vals.dtype.kind == "f":
             part[alias] = [None if v != v else float(v) for v in vals]
         else:
             part[alias] = vals.tolist()

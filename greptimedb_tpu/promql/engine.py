@@ -88,6 +88,43 @@ class WindowParams:
 _KERNEL_CACHE: dict[WindowParams, object] = {}
 
 
+def _sorted_window_bounds(p: WindowParams, ts, val, tsid, mask, sel_tsids,
+                          start_ms):
+    """Shared window geometry for all window kernels: composite
+    (tsid, rel-ts) sort plus per-(series, step) half-open sample ranges
+    [lo, hi) with LEFT-EXCLUSIVE window semantics (t - range, t] — the
+    ONE definition the stats kernel and the matrix kernels build on.
+
+    Returns (order, key_s, valid, lo, hi, cnt, has, sel_ok, n)."""
+    T = p.num_steps
+    S = p.num_sel
+    n = ts.shape[0]
+    base = start_ms - p.range_ms - 1
+    span = p.step_ms * (T + 2) + p.range_ms + 2
+    K = np.int64(1) << int(span - 1).bit_length() if span > 0 else np.int64(2)
+    # composite sort key; padding/invalid rows to +inf so order holds
+    rel = jnp.clip(ts - base, 0, K - 1)
+    valid = mask & ~jnp.isnan(val) & (ts > base) & (ts - base < K)
+    key = jnp.where(valid, tsid.astype(jnp.int64) * K + rel, _I64_MAX)
+    # data is sorted by (tsid, ts) but NaN/out-of-range rows poke holes;
+    # re-sort keys (cheap vs correctness; XLA sorts well)
+    order = jnp.argsort(key)
+    key_s = key[order]
+    steps = start_ms + p.step_ms * jnp.arange(T, dtype=jnp.int64)  # [T]
+    sel64 = sel_tsids.astype(jnp.int64)  # [S]
+    sel_ok = sel_tsids >= 0
+    skey = jnp.where(sel_ok, sel64, 0) * K  # [S]
+    # window (t - range, t]: left-exclusive
+    lo_k = skey[:, None] + jnp.clip(
+        steps[None, :] - p.range_ms - base + 1, 1, K - 1)
+    hi_k = skey[:, None] + jnp.clip(steps[None, :] - base, 1, K - 1)
+    lo = jnp.searchsorted(key_s, lo_k.reshape(-1), side="left").reshape(S, T)
+    hi = jnp.searchsorted(key_s, hi_k.reshape(-1), side="right").reshape(S, T)
+    cnt = (hi - lo).astype(jnp.int32)
+    has = (cnt > 0) & sel_ok[:, None]
+    return order, key_s, valid, lo, hi, cnt, has, sel_ok, n
+
+
 def _window_kernel(p: WindowParams):
     """Build the jitted kernel computing window stats for selected series.
 
@@ -101,18 +138,10 @@ def _window_kernel(p: WindowParams):
 
     @jax.jit
     def kernel(ts, val, tsid, mask, sel_tsids, start_ms):
-        n = ts.shape[0]
-        base = start_ms - p.range_ms - 1
-        span = p.step_ms * (T + 2) + p.range_ms + 2
-        K = np.int64(1) << int(span - 1).bit_length() if span > 0 else np.int64(2)
-        # composite sort key; padding/invalid rows to +inf so order holds
-        rel = jnp.clip(ts - base, 0, K - 1)
-        valid = mask & ~jnp.isnan(val) & (ts > base) & (ts - base < K)
-        key = jnp.where(valid, tsid.astype(jnp.int64) * K + rel, _I64_MAX)
-        # data is sorted by (tsid, ts) but NaN/out-of-range rows poke holes;
-        # re-sort keys (cheap vs correctness; XLA sorts well)
-        order = jnp.argsort(key)
-        key_s = key[order]
+        order, key_s, valid, lo, hi, cnt, has, sel_ok, n = (
+            _sorted_window_bounds(p, ts, val, tsid, mask, sel_tsids,
+                                  start_ms)
+        )
         val_s = val[order]
         ts_s = ts[order]
         tsid_s = tsid[order]
@@ -141,17 +170,6 @@ def _window_kernel(p: WindowParams):
         cs_tv = cs(jnp.where(valid_s, tsec * val_s.astype(jnp.float64), 0.0))
         cs_t2 = cs(jnp.where(valid_s, tsec * tsec, 0.0))
 
-        steps = start_ms + p.step_ms * jnp.arange(T, dtype=jnp.int64)  # [T]
-        sel64 = sel_tsids.astype(jnp.int64)  # [S]
-        sel_ok = sel_tsids >= 0
-        skey = jnp.where(sel_ok, sel64, 0) * K  # [S]
-        # window (t - range, t]: left-exclusive
-        lo_k = skey[:, None] + jnp.clip(steps[None, :] - p.range_ms - base + 1, 1, K - 1)
-        hi_k = skey[:, None] + jnp.clip(steps[None, :] - base, 1, K - 1)
-        lo = jnp.searchsorted(key_s, lo_k.reshape(-1), side="left").reshape(S, T)
-        hi = jnp.searchsorted(key_s, hi_k.reshape(-1), side="right").reshape(S, T)
-        cnt = (hi - lo).astype(jnp.int32)
-        has = (cnt > 0) & sel_ok[:, None]
         has2 = (cnt >= 2) & sel_ok[:, None]
 
         first_i = jnp.clip(lo, 0, n - 1)
@@ -262,6 +280,100 @@ def _window_kernel(p: WindowParams):
     return kernel
 
 
+def _count_max_kernel(p: WindowParams):
+    """Max samples in any (series, step) window — sizes the matrix
+    kernels' static padded width (one cheap pass, cached per shape)."""
+
+    @jax.jit
+    def kernel(ts, val, tsid, mask, sel_tsids, start_ms):
+        _o, _k, _v, _lo, _hi, cnt, _has, sel_ok, _n = _sorted_window_bounds(
+            p, ts, val, tsid, mask, sel_tsids, start_ms)
+        return jnp.max(jnp.where(sel_ok[:, None], cnt, 0))
+
+    return kernel
+
+
+def _matrix_kernel(p: WindowParams, lmax: int, kind: str):
+    """Window-matrix kernels: gather each (series, step) window's samples
+    (time-ordered, padded to the static width ``lmax``) into a
+    [S*T, lmax] matrix, then
+
+    - ``quantile``: per-row sort + Prometheus linear-interpolation
+      quantile (reference src/promql/src/functions/quantile.rs semantics)
+    - ``mad``: median, then median of |x − median| (mad_over_time)
+    - ``holt``: Holt's linear (double) exponential smoothing scan over
+      the window (reference
+      src/promql/src/functions/double_exponential_smoothing.rs)
+
+    Scalar parameters (φ / sf, tf) arrive as traced [T] f32 vectors so
+    repeated queries share one compiled program.
+    """
+    T, S = p.num_steps, p.num_sel
+
+    @jax.jit
+    def kernel(ts, val, tsid, mask, sel_tsids, start_ms, a1, a2):
+        order, _key_s, _valid, lo, hi, cnt, has, sel_ok, n = (
+            _sorted_window_bounds(p, ts, val, tsid, mask, sel_tsids,
+                                  start_ms)
+        )
+        val_s = val[order]
+        lof = lo.reshape(-1)  # [W] with W = S*T
+        cntf = cnt.reshape(-1)
+        j = jnp.arange(lmax, dtype=jnp.int32)
+        idx = jnp.clip(lof[:, None] + j[None, :], 0, n - 1)
+        rows = val_s[idx]  # [W, L] time-ordered window samples
+        ok = j[None, :] < cntf[:, None]
+        nan = jnp.float32(jnp.nan)
+        inf = jnp.float32(jnp.inf)
+
+        def q_of(sorted_rows, q):
+            """Prometheus quantile over per-row ascending values: linear
+            interpolation between the two straddling order statistics."""
+            rank = q * jnp.maximum(cntf - 1, 0).astype(jnp.float32)
+            lo_r = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, lmax - 1)
+            hi_r = jnp.clip(jnp.ceil(rank).astype(jnp.int32), 0, lmax - 1)
+            vlo = jnp.take_along_axis(sorted_rows, lo_r[:, None], axis=1)[:, 0]
+            vhi = jnp.take_along_axis(sorted_rows, hi_r[:, None], axis=1)[:, 0]
+            return vlo + (vhi - vlo) * (rank - lo_r.astype(jnp.float32))
+
+        if kind == "quantile":
+            srt = jnp.sort(jnp.where(ok, rows, inf), axis=1)
+            qv = jnp.broadcast_to(a1[None, :], (S, T)).reshape(-1)
+            res = q_of(srt, qv)
+            # Prometheus: φ < 0 → -Inf, φ > 1 → +Inf (NaN propagates)
+            res = jnp.where(qv < 0, -inf, jnp.where(qv > 1, inf, res))
+        elif kind == "mad":
+            srt = jnp.sort(jnp.where(ok, rows, inf), axis=1)
+            med = q_of(srt, jnp.float32(0.5))
+            dev = jnp.sort(
+                jnp.where(ok, jnp.abs(rows - med[:, None]), inf), axis=1)
+            res = q_of(dev, jnp.float32(0.5))
+        elif kind == "holt":
+            sf = jnp.broadcast_to(a1[None, :], (S, T)).reshape(-1)
+            tf = jnp.broadcast_to(a2[None, :], (S, T)).reshape(-1)
+            s0 = rows[:, 0]
+            b0 = rows[:, min(1, lmax - 1)] - s0
+
+            def body(i, carry):
+                s, b = carry
+                x = jax.lax.dynamic_slice_in_dim(rows, i, 1, axis=1)[:, 0]
+                act = i < cntf
+                s1 = sf * x + (1 - sf) * (s + b)
+                b1 = tf * (s1 - s) + (1 - tf) * b
+                return jnp.where(act, s1, s), jnp.where(act, b1, b)
+
+            s_fin, _b = jax.lax.fori_loop(1, lmax, body, (s0, b0))
+            # Prometheus needs ≥2 samples and factors in (0, 1)
+            param_ok = (sf > 0) & (sf < 1) & (tf > 0) & (tf < 1)
+            res = jnp.where((cntf >= 2) & param_ok, s_fin, nan)
+        else:  # pragma: no cover
+            raise ValueError(f"matrix kind {kind}")
+        out = jnp.where(cntf > 0, res, nan).reshape(S, T)
+        return jnp.where(has, out, nan)
+
+    return kernel
+
+
 class SelectorData:
     """Host-side prepared state for one table used by selectors."""
 
@@ -362,15 +474,15 @@ class PromEvaluator:
         "minmax": ("min", "max"),
     }
 
-    def _run_window(
-        self, sel: VectorSelector, kind: str, range_ms: int | None = None
-    ) -> tuple[dict, list[dict]]:
-        try:
-            d = self.data_for(sel.metric)
-        except TableNotFound:
-            # unknown metric = empty vector (Prometheus semantics)
-            empty = jnp.zeros((0, self.num_steps), jnp.float32)
-            return {k: empty for k in self._KIND_KEYS[kind]}, []
+    def _prep_window(self, sel: VectorSelector, kind: str,
+                     range_ms: int | None = None):
+        """Shared selector→kernel-args prep for the stats and matrix
+        kernels (ONE definition of pow2 series padding, range/offset/@
+        resolution, and the kernel argument tuple).  Returns
+        (args, p, tsids, labels, pinned, start, rng); raises
+        TableNotFound for unknown metrics (callers map it to an empty
+        vector, Prometheus semantics)."""
+        d = self.data_for(sel.metric)
         fieldcol = d.field_column(sel.matchers)
         tsids, labels = d.select_series(sel.matchers)
         S = max(1, 1 << (max(len(tsids), 1) - 1).bit_length())
@@ -397,23 +509,77 @@ class PromEvaluator:
             total_series=max(d.region.num_series, 1),
             kind=kind,
         )
+        cols = d.table.columns
+        args = (
+            cols[d.ts_name], cols[fieldcol], cols[TSID].astype(jnp.int32),
+            d.table.row_mask, jnp.asarray(sel_padded), np.int64(start),
+        )
+        return args, p, tsids, labels, pinned, start, int(rng)
+
+    def _run_window(
+        self, sel: VectorSelector, kind: str, range_ms: int | None = None
+    ) -> tuple[dict, list[dict]]:
+        try:
+            prep = self._prep_window(sel, kind, range_ms)
+        except TableNotFound:
+            # unknown metric = empty vector (Prometheus semantics)
+            empty = jnp.zeros((0, self.num_steps), jnp.float32)
+            return {k: empty for k in self._KIND_KEYS[kind]}, []
+        args, p, tsids, labels, pinned, start, rng = prep
         kern = _KERNEL_CACHE.get(p)
         if kern is None:
             kern = _window_kernel(p)
             _KERNEL_CACHE[p] = kern
-        cols = d.table.columns
-        out = kern(
-            cols[d.ts_name], cols[fieldcol], cols[TSID].astype(jnp.int32),
-            d.table.row_mask, jnp.asarray(sel_padded), np.int64(start),
-        )
+        out = kern(*args)
         out = {k: v[: len(tsids)] for k, v in out.items()}
         if pinned:
             out = {
                 k: jnp.broadcast_to(v, (v.shape[0], self.num_steps))
                 for k, v in out.items()
             }
-        self._last_window_grid = (start, int(rng), pinned)
+        self._last_window_grid = (start, rng, pinned)
         return out, labels
+
+    def _run_matrix(self, sel: VectorSelector, kind: str,
+                    extras: tuple = ()) -> tuple[jnp.ndarray, list[dict]]:
+        """Matrix-kernel twin of _run_window for the window functions that
+        need per-window order statistics or a sequential scan
+        (quantile_over_time / mad_over_time /
+        double_exponential_smoothing).  ``extras`` are [num_steps] f32
+        parameter vectors (φ / sf, tf)."""
+        import dataclasses
+
+        try:
+            prep = self._prep_window(sel, kind)
+        except TableNotFound:
+            return jnp.zeros((0, self.num_steps), jnp.float32), []
+        args, p, tsids, labels, pinned, _start, _rng = prep
+        num_steps = p.num_steps
+        # the sizing pass reads geometry only — share one compiled count
+        # kernel across matrix kinds
+        ck = dataclasses.replace(p, kind="cnt_max")
+        cnt_kern = _KERNEL_CACHE.get(ck)
+        if cnt_kern is None:
+            cnt_kern = _count_max_kernel(ck)
+            _KERNEL_CACHE[ck] = cnt_kern
+        cnt_max = int(cnt_kern(*args))
+        lmax = max(2, 1 << (max(cnt_max, 1) - 1).bit_length())
+        mk = (p, "matrix", lmax)
+        kern = _KERNEL_CACHE.get(mk)
+        if kern is None:
+            kern = _matrix_kernel(p, lmax, kind)
+            _KERNEL_CACHE[mk] = kern
+        ones = jnp.ones(num_steps, jnp.float32)
+        a1 = (jnp.broadcast_to(jnp.asarray(extras[0], jnp.float32),
+                               (self.num_steps,))[:num_steps]
+              if len(extras) > 0 else ones)
+        a2 = (jnp.broadcast_to(jnp.asarray(extras[1], jnp.float32),
+                               (self.num_steps,))[:num_steps]
+              if len(extras) > 1 else ones)
+        vals = kern(*args, a1, a2)[: len(tsids)]
+        if pinned:
+            vals = jnp.broadcast_to(vals, (vals.shape[0], self.num_steps))
+        return vals, labels
 
     # ---- eval -----------------------------------------------------------
     def eval(self, e: PromExpr) -> EvalResult:
@@ -606,6 +772,26 @@ class PromEvaluator:
             return EvalResult(r.values, labels)
         if f == "sort" or f == "sort_desc":
             return self.eval(e.args[0])  # ordering is a presentation concern
+        if f == "quantile_over_time":
+            if len(e.args) != 2:
+                raise PlanError("quantile_over_time(φ, series[range])")
+            q = self.eval(e.args[0]).values[0]
+            sel = self._selector_arg(e, 1)
+            vals, labels = self._run_matrix(sel, "quantile", (q,))
+            return EvalResult(vals, labels)
+        if f == "mad_over_time":
+            sel = self._selector_arg(e, 0)
+            vals, labels = self._run_matrix(sel, "mad")
+            return EvalResult(vals, labels)
+        if f == "double_exponential_smoothing":
+            if len(e.args) != 3:
+                raise PlanError(
+                    "double_exponential_smoothing(series[range], sf, tf)")
+            sel = self._selector_arg(e, 0)
+            sf = self.eval(e.args[1]).values[0]
+            tf = self.eval(e.args[2]).values[0]
+            vals, labels = self._run_matrix(sel, "holt", (sf, tf))
+            return EvalResult(vals, labels)
         raise Unsupported(f"promql function {f}")
 
     def _selector_arg(self, e: FunctionCall, i: int, want_range: bool = True) -> VectorSelector:

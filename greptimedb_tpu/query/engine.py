@@ -300,6 +300,23 @@ class QueryEngine:
                         scanned = grid.spad * grid.tpad
                         if metrics is not None:
                             metrics["grid"] = True
+        if env is None and _os.environ.get("GREPTIME_MESH", "auto") != "off":
+            # mesh row path: irregular/sparse tables the grid refuses
+            # still aggregate across the device mesh when the query
+            # decomposes at the commutativity boundary (the provider
+            # returns merged-but-unordered rows; ORDER BY/LIMIT — the
+            # non-commutative suffix — finish here)
+            mesh_fn = getattr(self.provider, "mesh_select", None)
+            if mesh_fn is not None and self._mesh_shapeable(sel):
+                mres = mesh_fn(sel)
+                if mres is not None:
+                    t = mark("device_exec_ms", t)
+                    result = self._finish_merged(sel, plan, *mres)
+                    mark("shape_ms", t)
+                    if metrics is not None:
+                        metrics["mesh_rows"] = True
+                        metrics["output_rows"] = len(result.rows)
+                    return result
         if env is None:
             table, ts_bounds = self.provider.device_table(sel.table, plan)
             t = mark("scan_cache_ms", t)
@@ -314,6 +331,35 @@ class QueryEngine:
             metrics["output_rows"] = len(result.rows)
             metrics["scanned_rows_padded"] = scanned
         return result
+
+    @staticmethod
+    def _mesh_shapeable(sel: Select) -> bool:
+        """The mesh path returns merged rows keyed by OUTPUT names; every
+        ORDER BY key must be one (by alias or expression text) or the
+        suffix can't be applied here — fall back to single-device."""
+        names = {it.output_name for it in sel.items
+                 if not isinstance(it.expr, Star)}
+        return all(str(o.expr) in names for o in sel.order_by)
+
+    def _finish_merged(self, sel: Select, plan: SelectPlan,
+                       names: list[str], rows: list[list]) -> QueryResult:
+        """ORDER BY / LIMIT over merged mesh partials (the frontend side
+        of MergeScan, same shaping as rpc/frontend.py _shape)."""
+        if sel.order_by:
+            idx = {n: i for i, n in enumerate(names)}
+
+            def sort_key(row):
+                return [SortVal(row[idx[str(ob.expr)]], ob.asc)
+                        for ob in sel.order_by]
+
+            rows = sorted(rows, key=sort_key)
+        # no OFFSET handling: split_partial refuses OFFSET queries, so
+        # none reaches the mesh path
+        if sel.limit is not None:
+            rows = rows[: sel.limit]
+        return QueryResult(names, rows, column_types=[
+            _infer_type(it.expr, plan) for it in plan.items
+        ])
 
     def explain(self, sel: Select) -> str:
         if sel.table is None:

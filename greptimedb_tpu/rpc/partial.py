@@ -41,6 +41,21 @@ _PARTIALS: dict[str, list[tuple[str, str]]] = {
 # aggs whose partials need the time index as a companion column
 _PICK_PARTIALS = {"first_value": "min", "last_value": "max"}
 
+# sketch-state aggregates: the partial is a serialized sketch state per
+# group (hll/uddsketch fold on each shard), merged host-side by the state
+# mergers in ops/sketch.py (reference hll.rs/uddsketch.rs merge_batch —
+# sketches are the textbook commutative aggregate).  approx_distinct
+# decomposes into an HLL partial whose merged state is estimated at the
+# end (commutativity.rs:116 step aggregation).
+#   agg -> (partial fn name, merge op)
+_SKETCH_PARTIALS = {
+    "approx_distinct": ("hll", "hll_state"),
+    "hll": ("hll", "hll_state"),
+    "hll_merge": ("hll_merge", "hll_state"),
+    "uddsketch_state": ("uddsketch_state", "udd_state"),
+    "uddsketch_merge": ("uddsketch_merge", "udd_state"),
+}
+
 
 @dataclass(frozen=True)
 class MergeItem:
@@ -122,6 +137,17 @@ def split_partial(sel: Select, ts_column: str | None = None) -> PartialPlan | No
                     it.output_name, "agg", agg=it.expr.name,
                     partial_cols=(vcol, tcol),
                 ))
+                continue
+            sketch = _SKETCH_PARTIALS.get(it.expr.name)
+            if sketch is not None:
+                pfn, mop = sketch
+                pname = f"__a{i}_0"
+                partial_items.append(SelectItem(
+                    FuncCall(pfn, it.expr.args, distinct=False), alias=pname))
+                merge_cols[pname] = mop
+                merge_items.append(MergeItem(
+                    it.output_name, "agg", agg=it.expr.name,
+                    partial_cols=(pname,)))
                 continue
             specs = _PARTIALS.get(it.expr.name)
             if specs is None:
@@ -208,6 +234,14 @@ def merge_into(slot: dict, values: dict, merge_cols: dict) -> None:
             slot[c] = min(cur, v)
         elif op == "max":
             slot[c] = max(cur, v)
+        elif op == "hll_state":
+            from greptimedb_tpu.ops.sketch import merge_hll_states
+
+            slot[c] = merge_hll_states(cur, v)
+        elif op == "udd_state":
+            from greptimedb_tpu.ops.sketch import merge_udd_states
+
+            slot[c] = merge_udd_states(cur, v)
 
 
 def merge_partials(
@@ -243,6 +277,14 @@ def merge_partials(
             elif m.agg in ("avg", "mean"):
                 s, c = (slot[p] for p in m.partial_cols)
                 row.append(None if not c else (s if s is None else s / c))
+            elif m.agg == "approx_distinct":
+                from greptimedb_tpu.ops.sketch import (
+                    decode_hll, hll_estimate,
+                )
+
+                regs = decode_hll(slot[m.partial_cols[0]])
+                row.append(0 if regs is None else int(round(
+                    hll_estimate(regs))))
             else:
                 row.append(slot[m.partial_cols[0]])
         rows.append(row)

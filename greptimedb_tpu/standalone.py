@@ -472,6 +472,50 @@ class GreptimeDB(TableProvider):
         gt = self.cache.get_grid(view)
         return gt, view.ts_bounds() or (0, 0)
 
+    def mesh_select(self, sel):
+        """Mesh row path for tables the dense grid refuses (irregular /
+        sparse cadence): shard rows on the series axis across the device
+        mesh and aggregate with ICI collectives through the SAME
+        commutativity split as the Flight exchange (reference
+        src/query/src/dist_plan/merge_scan.rs:210,335 fans out any
+        pushable plan; here the fan-out is shard_map over a resident
+        ShardedTable).  Returns (names, rows) unordered, or None when the
+        query is not mesh-decomposable — the engine falls back to the
+        single-device row path."""
+        if self.mesh is None:
+            return None
+        view = self._table_view(sel.table)
+        if getattr(view, "base_version", None) is None:
+            return None  # duck-typed views (joins, staged scans, system)
+        # fan-out pays only at scale: below the threshold one device wins
+        # (shard_map compile + collective latency vs a single fused kernel)
+        min_rows = int(os.environ.get("GREPTIME_MESH_MIN_ROWS", "65536"))
+        memtable = getattr(view, "memtable", None)
+        if memtable is None:
+            return None  # e.g. FileTableView: no LSM parts to shard
+        live = memtable.num_rows + sum(
+            m.num_rows for m in view.sst_files)
+        if live < min_rows:
+            return None
+        from greptimedb_tpu.rpc.partial import split_partial
+
+        ts_name = (view.schema.time_index.name
+                   if view.schema.time_index is not None else None)
+        if split_partial(sel, ts_column=ts_name) is None:
+            return None  # cheap pre-check before building the shard table
+        from greptimedb_tpu.parallel.dist import (
+            DistAggExecutor, execute_select_on_mesh,
+        )
+
+        st = self.cache.get_sharded(view)
+        if st is None:
+            return None
+        if getattr(self, "_dist_exec", None) is None:
+            self._dist_exec = DistAggExecutor(self.mesh)
+        return execute_select_on_mesh(
+            self._dist_exec, st, sel, self.table_context(sel.table),
+            view.ts_bounds())
+
     def host_columns(self, table: str, ts_range=(None, None)) -> dict:
         """Raw host scan for operators that run host-side (join matching)."""
         return self._table_view(table).scan_host(ts_range)

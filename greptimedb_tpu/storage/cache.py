@@ -465,6 +465,34 @@ class RegionCacheManager:
         self._shrink()
         return table
 
+    def get_sharded(self, region):
+        """Series-sharded row table (parallel/dist.py ShardedTable) for
+        mesh aggregation of irregular/sparse regions that the dense grid
+        refuses.  Keyed by generation: any write rebuilds (row order under
+        the shard permutation is not extendable in place the way grid
+        columns are)."""
+        if self.mesh is None:
+            return None
+        from greptimedb_tpu.parallel.dist import shard_region
+
+        key = (region.region_id, "sharded", region.generation)
+        entry = self._lru.get(key)
+        if entry is not None:
+            self.hits += 1
+            self._lru.move_to_end(key)
+            return entry.table
+        self.misses += 1
+        table = shard_region(region, self.mesh)
+        for k in [
+            k for k in self._lru
+            if k[0] == key[0] and k[1:2] == ("sharded",) and k != key
+        ]:
+            self._evict(k)
+        self._lru[key] = _Entry(table)
+        self._bytes += table.nbytes()
+        self._shrink()
+        return table
+
     def install_grid(self, region, table) -> None:
         """Adopt an externally built resident GridTable (snapshot restore:
         storage/grid.py load_grid_snapshot) as the region's current grid

@@ -31,7 +31,9 @@ class TestMaskedReduce:
     def test_empty(self):
         v = jnp.array([1.0, 2.0])
         m = jnp.array([False, False])
-        assert float(masked_reduce(v, m, "sum")) == 0.0
+        # SQL: every aggregate but count is NULL over zero rows —
+        # including SUM (round-5 review fix; previously 0.0)
+        assert np.isnan(float(masked_reduce(v, m, "sum")))
         assert int(masked_reduce(v, m, "count")) == 0
         assert np.isnan(float(masked_reduce(v, m, "max")))
         assert np.isnan(float(masked_reduce(v, m, "mean")))
@@ -65,7 +67,9 @@ class TestSegmentReduce:
         ids = jnp.array([0, 0, 2], dtype=jnp.int32)
         vals = jnp.array([1.0, 2.0, 3.0])
         got_sum = np.asarray(segment_reduce(vals, ids, 4, "sum"))
-        np.testing.assert_allclose(got_sum, [3.0, 0.0, 3.0, 0.0])
+        # empty segments: SUM is NULL (NaN), like max/mean below
+        np.testing.assert_allclose(got_sum[[0, 2]], [3.0, 3.0])
+        assert np.isnan(got_sum[1]) and np.isnan(got_sum[3])
         got_max = np.asarray(segment_reduce(vals, ids, 4, "max"))
         assert np.isnan(got_max[1]) and np.isnan(got_max[3])
         got_cnt = np.asarray(segment_reduce(vals, ids, 4, "count"))

@@ -200,6 +200,147 @@ class TestMeshSql:
             os.environ.pop("GREPTIME_MESH", None)
 
 
+class TestMeshRowSql:
+    """Engine-level mesh execution for tables the dense grid REFUSES
+    (irregular cadence / sparse series): round-4 verdict item 2 — sql()
+    must shard row-oriented tables too, through the SAME commutativity
+    split as the Flight exchange (reference merge_scan.rs:210,335)."""
+
+    @pytest.fixture
+    def irregular_db(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("GREPTIME_MESH_MIN_ROWS", "100")
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(str(tmp_path / "ir"))
+        db.sql("CREATE TABLE m (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (host))")
+        t0 = 1700000000000
+        jit = np.random.default_rng(7).integers(0, 91, 6000)
+        rows = [f"('h{i % 11}',{t0 + i * 137 + int(jit[i])},{(i * 7) % 103})"
+                for i in range(6000)]
+        db.sql("INSERT INTO m VALUES " + ",".join(rows))
+        db._region_of("m").flush()
+        yield db
+        db.close()
+
+    def _mesh_vs_single(self, db, sql):
+        import os
+
+        from greptimedb_tpu.query.parser import parse_sql
+
+        sel = parse_sql(sql)[0]
+        metrics = {}
+        r_mesh = db.engine.execute_select(sel, metrics)
+        # the jittered cadence must keep the grid path out of the picture
+        assert "grid" not in metrics
+        assert metrics.get("mesh_rows") is True, metrics
+        os.environ["GREPTIME_MESH"] = "off"
+        try:
+            r_ref = db.engine.execute_select(sel)
+        finally:
+            os.environ.pop("GREPTIME_MESH", None)
+        assert r_mesh.column_names == r_ref.column_names
+        return r_mesh, r_ref
+
+    def _assert_rows_match(self, r_mesh, r_ref, sort=True):
+        key = lambda r: tuple(str(x) for x in r)
+        a = sorted(r_mesh.rows, key=key) if sort else r_mesh.rows
+        b = sorted(r_ref.rows, key=key) if sort else r_ref.rows
+        assert len(a) == len(b)
+        for ra, rb in zip(a, b):
+            for va, vb in zip(ra, rb):
+                if isinstance(va, float) and isinstance(vb, float):
+                    assert va == pytest.approx(vb, rel=1e-6, abs=1e-9)
+                else:
+                    assert str(va) == str(vb), (ra, rb)
+
+    def test_basic_aggs_match_single_device(self, irregular_db):
+        r_mesh, r_ref = self._mesh_vs_single(
+            irregular_db,
+            "SELECT host, sum(v), avg(v), count(*), min(v), max(v) "
+            "FROM m GROUP BY host")
+        self._assert_rows_match(r_mesh, r_ref)
+
+    def test_order_by_limit_suffix(self, irregular_db):
+        # the non-commutative suffix (ORDER BY/LIMIT) finishes on the
+        # frontend side of the split — here, in engine._finish_merged
+        r_mesh, r_ref = self._mesh_vs_single(
+            irregular_db,
+            "SELECT host, sum(v) AS s FROM m GROUP BY host "
+            "ORDER BY host LIMIT 5")
+        assert len(r_mesh.rows) == 5
+        self._assert_rows_match(r_mesh, r_ref, sort=False)
+
+    def test_first_last_on_mesh_rows(self, irregular_db):
+        r_mesh, r_ref = self._mesh_vs_single(
+            irregular_db,
+            "SELECT host, first_value(v), last_value(v), count(*) "
+            "FROM m GROUP BY host")
+        self._assert_rows_match(r_mesh, r_ref)
+
+    def test_approx_distinct_on_mesh(self, irregular_db):
+        # single-device approx_distinct is exact (sort-unique); the mesh
+        # merges HLL register states — at 103 distinct values the p=12
+        # linear-counting estimate lands on the exact count (deterministic
+        # splitmix hashing, seed-stable)
+        r_mesh, r_ref = self._mesh_vs_single(
+            irregular_db,
+            "SELECT host, approx_distinct(v) FROM m GROUP BY host")
+        self._assert_rows_match(r_mesh, r_ref)
+
+    def test_sketch_states_on_mesh(self, irregular_db):
+        from greptimedb_tpu.ops.sketch import (
+            decode_hll, hll_estimate, udd_quantile,
+        )
+
+        r_mesh, r_ref = self._mesh_vs_single(
+            irregular_db,
+            "SELECT host, uddsketch_state(128, 0.01, v) AS s, hll(v) AS h "
+            "FROM m GROUP BY host ORDER BY host")
+        for ra, rb in zip(r_mesh.rows, r_ref.rows):
+            assert ra[0] == rb[0]
+            qa, qb = udd_quantile(ra[1], 0.5), udd_quantile(rb[1], 0.5)
+            # same γ but shard-dependent collapse: quantiles agree to the
+            # sketch's error bound, not bit-exactly
+            assert qa == pytest.approx(qb, rel=0.02)
+            ea = hll_estimate(decode_hll(ra[2]))
+            eb = hll_estimate(decode_hll(rb[2]))
+            assert ea == pytest.approx(eb, rel=1e-9)
+
+    def test_global_aggregate_on_mesh(self, irregular_db):
+        # no GROUP BY: one group, gid all-zero (review regression: the
+        # empty key_specs path crashed in combine_keys)
+        r_mesh, r_ref = self._mesh_vs_single(
+            irregular_db,
+            "SELECT count(*), sum(v), avg(v), min(v) FROM m")
+        self._assert_rows_match(r_mesh, r_ref)
+
+    def test_global_aggregate_zero_match_single_row(self, irregular_db):
+        # SQL: a global aggregate returns exactly one row even when zero
+        # rows matched (count=0, other aggregates NULL)
+        r_mesh, r_ref = self._mesh_vs_single(
+            irregular_db,
+            "SELECT count(*), sum(v) FROM m WHERE v > 1e9")
+        assert len(r_mesh.rows) == 1
+        assert r_mesh.rows[0][0] == 0 and r_mesh.rows[0][1] is None
+        self._assert_rows_match(r_mesh, r_ref)
+
+    def test_small_table_stays_single_device(self, tmp_path):
+        from greptimedb_tpu.query.parser import parse_sql
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(str(tmp_path / "sm"))
+        db.sql("CREATE TABLE s (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (host))")
+        db.sql("INSERT INTO s VALUES ('a', 1001, 1.0), ('b', 2003, 2.0)")
+        metrics = {}
+        db.engine.execute_select(
+            parse_sql("SELECT host, sum(v) FROM s GROUP BY host")[0],
+            metrics)
+        assert "mesh_rows" not in metrics  # below GREPTIME_MESH_MIN_ROWS
+        db.close()
+
+
 class TestUnifiedSplitOnMesh:
     """execute_select_on_mesh: the SAME split_partial that feeds the
     Flight exchange drives the ICI-collective executor (verdict #7) —

@@ -186,6 +186,85 @@ class TestOverTime:
         assert res.rows[0][-1] == pytest.approx(807.0, rel=1e-3)
 
 
+class TestMatrixWindowFunctions:
+    """quantile_over_time / mad_over_time / double_exponential_smoothing
+    (round-4 verdict item 9) — hand-computed Prometheus semantics
+    (reference src/promql/src/functions/{quantile,double_exponential_smoothing}.rs)."""
+
+    def make_gauge(self, db, vals, name="g"):
+        db.sql(f"CREATE TABLE {name} (pod STRING, ts TIMESTAMP(3) "
+               f"TIME INDEX, val DOUBLE, PRIMARY KEY (pod))")
+        r = db._region_of(name)
+        ts = np.arange(len(vals)) * 10_000
+        r.write({"pod": ["p"] * len(vals), "ts": ts,
+                 "val": np.asarray(vals, dtype=float)})
+
+    def test_quantile_over_time_interpolation(self, db):
+        self.make_gauge(db, [1.0, 2.0, 3.0, 4.0, 5.0])
+        # window (0, 40]: samples 2,3,4,5 → q=0.5 rank 1.5 → 3.5
+        res = db.sql("TQL EVAL (40, 40, '60') quantile_over_time(0.5, g[40])")
+        assert res.rows[0][-1] == pytest.approx(3.5, rel=1e-6)
+        # q=0.25 over 4 samples: rank 0.75 → 2 + 0.75*(3-2) = 2.75
+        res = db.sql("TQL EVAL (40, 40, '60') quantile_over_time(0.25, g[40])")
+        assert res.rows[0][-1] == pytest.approx(2.75, rel=1e-6)
+        # exact order statistic
+        res = db.sql("TQL EVAL (40, 40, '60') quantile_over_time(1, g[40])")
+        assert res.rows[0][-1] == pytest.approx(5.0, rel=1e-6)
+
+    def test_quantile_out_of_range_phi(self, db):
+        self.make_gauge(db, [1.0, 2.0, 3.0])
+        res = db.sql("TQL EVAL (20, 20, '60') quantile_over_time(1.5, g[20])")
+        assert res.rows[0][-1] == float("inf")
+        res = db.sql("TQL EVAL (20, 20, '60') quantile_over_time(-1, g[20])")
+        assert res.rows[0][-1] == float("-inf")
+
+    def test_quantile_range_query_multi_step(self, db):
+        self.make_gauge(db, [float(i) for i in range(10)])
+        res = db.sql(
+            "TQL EVAL (30, 90, '30') quantile_over_time(0.5, g[30])")
+        # windows (0,30], (30,60], (60,90]: medians 2, 5, 8
+        got = [row[-1] for row in res.rows]
+        assert got == pytest.approx([2.0, 5.0, 8.0])
+
+    def test_mad_over_time(self, db):
+        self.make_gauge(db, [1.0, 1.0, 2.0, 4.0, 8.0])
+        # window (0, 40]: samples 1,2,4,8 → median 3.0 (interp),
+        # |x-med| = 2,1,1,5 sorted 1,1,2,5 → median 1.5
+        res = db.sql("TQL EVAL (40, 40, '60') mad_over_time(g[40])")
+        assert res.rows[0][-1] == pytest.approx(1.5, rel=1e-6)
+
+    def test_double_exponential_smoothing(self, db):
+        vals = [10.0, 12.0, 11.0, 15.0, 14.0]
+        self.make_gauge(db, vals)
+        sf, tf = 0.5, 0.3
+        # hand-rolled Holt over window (0, 40]: samples 12, 11, 15, 14
+        xs = vals[1:]
+        s, b = xs[0], xs[1] - xs[0]
+        for x in xs[1:]:
+            s1 = sf * x + (1 - sf) * (s + b)
+            b = tf * (s1 - s) + (1 - tf) * b
+            s = s1
+        res = db.sql(
+            "TQL EVAL (40, 40, '60') "
+            "double_exponential_smoothing(g[40], 0.5, 0.3)")
+        assert res.rows[0][-1] == pytest.approx(s, rel=1e-5)
+
+    def test_holt_needs_two_samples_and_valid_factors(self, db):
+        self.make_gauge(db, [10.0, 12.0])
+        # window (10, 20] has one sample → no output row (NaN = absent)
+        res = db.sql(
+            "TQL EVAL (20, 20, '60') "
+            "double_exponential_smoothing(g[10], 0.5, 0.3)")
+        assert all(row[-1] is None or row[-1] != row[-1]
+                   for row in res.rows) or not res.rows
+        # sf outside (0,1) → NaN/absent
+        res = db.sql(
+            "TQL EVAL (20, 20, '60') "
+            "double_exponential_smoothing(g[20], 1.5, 0.3)")
+        assert all(row[-1] is None or row[-1] != row[-1]
+                   for row in res.rows) or not res.rows
+
+
 class TestAggregations:
     def setup_pods(self, db):
         make_counter(db, pods=("p1", "p2", "p3"), rates=(5.0, 10.0, 15.0))

@@ -536,6 +536,88 @@ class TestFirstLastPartials:
         assert res.rows == [["a", 42.0, 1.0], ["z", 11.0, 7.0]]
 
 
+class TestSketchPartials:
+    """Sketch aggregates cross the exchange as serialized states (round-4
+    verdict item 3): approx_distinct/hll ship HLL register states,
+    uddsketch ships bucket docs — merged host-side by ops/sketch.py's
+    state mergers (reference hll.rs / uddsketch.rs merge_batch)."""
+
+    def test_split_produces_state_partials(self):
+        sel = parse_sql(
+            "SELECT host, approx_distinct(v), hll(v), "
+            "uddsketch_state(64, 0.05, v) FROM t GROUP BY host")[0]
+        plan = split_partial(sel)
+        assert plan is not None
+        assert plan.merge_cols["__a1_0"] == "hll_state"
+        assert plan.merge_cols["__a2_0"] == "hll_state"
+        assert plan.merge_cols["__a3_0"] == "udd_state"
+        # the approx_distinct partial is an hll() fold, not a count
+        assert plan.partial_select.items[1].expr.name == "hll"
+
+    def test_hll_state_merge_union(self):
+        from greptimedb_tpu.ops.sketch import (
+            decode_hll, encode_hll, hll_estimate, merge_hll_states,
+        )
+
+        a = np.zeros(4096, dtype=np.int32)
+        b = np.zeros(4096, dtype=np.int32)
+        a[:100] = 5
+        b[50:200] = 7
+        merged = decode_hll(merge_hll_states(encode_hll(a), encode_hll(b)))
+        np.testing.assert_array_equal(merged, np.maximum(a, b))
+        # None-tolerant (empty shard)
+        assert merge_hll_states(None, encode_hll(a)) == encode_hll(a)
+        assert merge_hll_states(encode_hll(a), None) == encode_hll(a)
+        assert hll_estimate(merged) >= hll_estimate(a)
+
+    def test_udd_state_merge_rekey(self):
+        from greptimedb_tpu.ops.sketch import (
+            decode_udd, encode_udd_doc, merge_udd_states, udd_gamma,
+        )
+
+        g = udd_gamma(0.05)
+        # same config, different collapse factors: c=1 re-keys into c=2
+        a = encode_udd_doc({10: 3, 11: 5}, g, 1, 64)
+        b = encode_udd_doc({5: 2, 6: 4}, g, 2, 64)
+        merged = decode_udd(merge_udd_states(a, b))
+        _ge, _gb, c, _nb, counts = merged
+        assert c == 2
+        # base keys 10→ceil(10/2)=5, 11→ceil(11/2)=6
+        assert counts == {5: 5, 6: 9}
+        # mismatched configs refuse loudly
+        other = encode_udd_doc({1: 1}, udd_gamma(0.01), 1, 64)
+        with pytest.raises(ValueError):
+            merge_udd_states(a, other)
+
+    def test_cross_process_sketches(self, frontend):
+        frontend.sql(
+            "CREATE TABLE sk (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY (host)) "
+            "PARTITION ON COLUMNS (host) (host < 'm', host >= 'm')"
+        )
+        rows = [f"('{h}', {1000 + i * 100}, {val})"
+                for h in ("a", "z")
+                for i, val in enumerate(range(40))]
+        frontend.sql("INSERT INTO sk VALUES " + ",".join(rows))
+        res = frontend.sql(
+            "SELECT host, approx_distinct(v), count(*) FROM sk "
+            "GROUP BY host ORDER BY host")
+        assert [r[0] for r in res.rows] == ["a", "z"]
+        for r in res.rows:
+            assert r[2] == 40
+            # 40 distinct values, HLL at p=12 is near-exact at this scale
+            assert abs(r[1] - 40) <= 1
+        # uddsketch states survive the exchange and estimate quantiles
+        res2 = frontend.sql(
+            "SELECT host, uddsketch_state(128, 0.01, v) AS s FROM sk "
+            "GROUP BY host ORDER BY host")
+        from greptimedb_tpu.ops.sketch import udd_quantile
+
+        for r in res2.rows:
+            q = udd_quantile(r[1], 0.5)
+            assert q == pytest.approx(19.5, rel=0.15)
+
+
 class TestPromGateway:
     def test_prom_query_over_flight(self, tmp_path):
         """PromQL over the gRPC substrate (reference

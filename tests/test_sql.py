@@ -1378,3 +1378,36 @@ def test_matches_score_and_cjk(tmp_path):
     assert db.sql("SELECT count(*) FROM logs WHERE matches(msg, '失败')"
                   ).rows == [[1]]
     db.close()
+
+
+class TestZeroRowGlobalAggregates:
+    """SQL: a global aggregate over zero matched rows returns exactly one
+    row with count()=0 and every other aggregate NULL — including SUM
+    (round-5 review fix: float paths returned 0.0, int paths 0) and on
+    both segment-reduce implementations."""
+
+    @pytest.fixture
+    def db(self):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB()
+        db.sql("CREATE TABLE t (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "vi BIGINT, vf DOUBLE, PRIMARY KEY (h))")
+        db.sql("INSERT INTO t VALUES ('a', 1000, 5, 1.5), "
+               "('b', 2000, 7, 2.5)")
+        yield db
+        db.close()
+
+    def test_scatter_path(self, db):
+        r = db.sql("SELECT count(*), sum(vi), sum(vf), min(vi), max(vi), "
+                   "avg(vf) FROM t WHERE vf > 100")
+        assert r.rows == [[0, None, None, None, None, None]]
+
+    def test_nonempty_unchanged(self, db):
+        r = db.sql("SELECT count(*), sum(vi), sum(vf) FROM t")
+        assert r.rows == [[2, 12, 4.0]]
+
+    def test_sorted_segments_path(self, db, monkeypatch):
+        monkeypatch.setenv("GREPTIME_SORTED_SEGMENTS", "force")
+        r = db.sql("SELECT sum(vf), count(*) FROM t WHERE vf > 100")
+        assert r.rows == [[None, 0]]
