@@ -113,6 +113,25 @@ class InList(Expr):
 
 
 @dataclass(frozen=True)
+class TupleIn(Expr):
+    """Row-tuple membership: (e1, …, ek) IN {(v11, …, v1k), …}.
+
+    Not parseable SQL — produced by multi-key correlated EXISTS/IN
+    decorrelation (the reference reaches the same semantics through
+    DataFusion's semi-join rewrite, src/query/src/planner.rs).  ``rows``
+    are plain python value tuples (NULL-free: a NULL never equals)."""
+
+    exprs: tuple[Expr, ...]
+    rows: tuple[tuple, ...]
+    negated: bool = False
+
+    def __str__(self):
+        n = " NOT" if self.negated else ""
+        es = ", ".join(map(str, self.exprs))
+        return f"({es}){n} IN <{len(self.rows)} tuples>"
+
+
+@dataclass(frozen=True)
 class IsNull(Expr):
     expr: Expr
     negated: bool = False

@@ -193,9 +193,6 @@ class QueryEngine:
             # (EXISTS is then unconditionally true) — membership over the
             # correlation column would wrongly drop unmatched outer rows
             raise Unsupported("correlated EXISTS over an aggregate")
-        if len(corr) > 1:
-            raise Unsupported(
-                "correlated EXISTS supports one equality correlation")
         # any OTHER outer reference left in the residual WHERE would bind
         # to the inner table by bare name (exprs.py resolution fallback)
         # and silently evaluate wrong — refuse
@@ -206,26 +203,39 @@ class QueryEngine:
                 if is_outer(c):
                     raise Unsupported(
                         "correlated EXISTS supports outer references only "
-                        "as a single equality correlation")
-        inner_col, outer_col = corr[0]
+                        "as equality correlations")
         new_where = None
         for c in rest:
             new_where = c if new_where is None else BinaryOp(
                 "AND", new_where, c)
         inner_sel = dataclasses.replace(
             sub,
-            items=[SelectItem(Column(inner_col.name))],
+            items=[SelectItem(Column(ic.name)) for ic, _oc in corr],
             where=new_where,
             distinct=True,
             group_by=[], order_by=[], limit=None, offset=None,
         )
         res = self._run_nested(inner_sel)
-        vals = [r[0] for r in res.rows if r[0] is not None]
-        if not vals:
+        if len(corr) == 1:
+            vals = [r[0] for r in res.rows if r[0] is not None]
+            if not vals:
+                return Literal(False)
+            # strip the outer qualifier: the outer plan resolves bare names
+            return InList(Column(corr[0][1].name),
+                          tuple(Literal(v) for v in vals))
+        # multi-key correlation: tuple membership over the inner side's
+        # DISTINCT key combinations (the reference reaches this via
+        # DataFusion's semi-join decorrelation).  NULL-bearing tuples can
+        # never equal — drop them.
+        from greptimedb_tpu.query.ast import TupleIn
+
+        rows = tuple(
+            tuple(r) for r in res.rows if all(v is not None for v in r)
+        )
+        if not rows:
             return Literal(False)
-        # strip the outer qualifier: the outer plan resolves bare names
-        return InList(Column(outer_col.name),
-                      tuple(Literal(v) for v in vals))
+        return TupleIn(
+            tuple(Column(oc.name) for _ic, oc in corr), rows)
 
     def _resolve_subqueries(self, sel: Select) -> Select:
         import dataclasses

@@ -486,6 +486,11 @@ def compile_device(e: Expr, ctx: TableContext):
 
         return fn
 
+    from greptimedb_tpu.query.ast import TupleIn as _TupleIn
+
+    if isinstance(e, _TupleIn):
+        return _compile_tuple_in(e, ctx)
+
     if isinstance(e, Case):
         if e.operand is not None:
             whens = tuple(
@@ -747,6 +752,97 @@ def _compile_ft_match(e: FuncCall, ctx: TableContext):
     return fn
 
 
+def _compile_tuple_in(e, ctx: TableContext):
+    """Row-tuple membership on device, O((n + T)·log T): factorize each
+    key column against the tuples' per-column distinct values via
+    searchsorted (tag literals become dictionary codes — absent literals
+    can never match), combine per-column positions into one int64 code,
+    and probe the sorted tuple-code table.  No [n, T] broadcast — scales
+    to large inner sides (the reference reaches the same semantics via
+    a DataFusion semi-join, src/query/src/planner.rs)."""
+    k = len(e.exprs)
+    if k == 0 or not e.rows:
+        neg = e.negated
+        return lambda env: jnp.broadcast_to(
+            jnp.asarray(bool(neg)), next(iter(env.values())).shape)
+
+    col_fns = []
+    col_vals: list[np.ndarray] = []
+    for i, x in enumerate(e.exprs):
+        vals = [r[i] for r in e.rows]
+        if isinstance(x, Column) and ctx.is_tag(x.name):
+            real = ctx.resolve(x.name)
+            enc = ctx.encoders[real]
+            # get() returns -1 for absent literals; column codes are ≥ 0,
+            # so those tuples simply never match
+            arr = np.array([enc.get(v) for v in vals], dtype=np.int64)
+            col_fns.append(
+                lambda env, real=real: env[real].astype(jnp.int64))
+        else:
+            # native-dtype comparison: int-typed columns (incl.
+            # timestamps) compare in exact int64 — a float64 downcast
+            # would collapse ns timestamps above 2^53 (review regression)
+            int_col = False
+            if isinstance(x, Column):
+                try:
+                    cs = ctx.schema.column(ctx.resolve(x.name))
+                    int_col = not (cs.is_tag or cs.dtype.is_float
+                                   or cs.dtype.is_string_like)
+                except Exception:  # noqa: BLE001 — unknown: float compare
+                    pass
+            f = compile_device(x, ctx)
+            try:
+                if int_col and all(
+                        float(v).is_integer() if isinstance(v, float)
+                        else True for v in vals):
+                    arr = np.array([int(v) for v in vals], dtype=np.int64)
+                    col_fns.append(
+                        lambda env, f=f: f(env).astype(jnp.int64))
+                else:
+                    arr = np.array(
+                        [float(v) for v in vals], dtype=np.float64)
+                    col_fns.append(
+                        lambda env, f=f: f(env).astype(jnp.float64))
+            except (TypeError, ValueError):
+                raise Unsupported(
+                    "tuple IN: non-numeric values on a non-tag column")
+        col_vals.append(arr)
+
+    uniqs, invs = [], []
+    prod = 1
+    for arr in col_vals:
+        u, inv = np.unique(arr, return_inverse=True)
+        uniqs.append(u)
+        invs.append(inv.astype(np.int64))
+        prod *= max(len(u), 1)
+    if prod >= (1 << 62):
+        raise Unsupported("tuple IN: combined key space too large")
+    comb = np.zeros(len(e.rows), dtype=np.int64)
+    for u, inv in zip(uniqs, invs):
+        comb = comb * len(u) + inv
+    tcodes = np.unique(comb)
+    neg = e.negated
+
+    def fn(env):
+        ok = None
+        code = None
+        for u, f in zip(uniqs, col_fns):
+            v = f(env)
+            ua = jnp.asarray(u)
+            pos = jnp.searchsorted(ua, v)
+            posc = jnp.clip(pos, 0, len(u) - 1)
+            found = ua[posc] == v
+            ok = found if ok is None else (ok & found)
+            c = posc.astype(jnp.int64)
+            code = c if code is None else code * len(u) + c
+        tc = jnp.asarray(tcodes)
+        p = jnp.clip(jnp.searchsorted(tc, code), 0, len(tcodes) - 1)
+        hit = ok & (tc[p] == code)
+        return ~hit if neg else hit
+
+    return fn
+
+
 def compile_device_func(e: FuncCall, ctx: TableContext):
     name = e.name
     if name in AGG_FUNCS:
@@ -939,6 +1035,19 @@ def eval_host(e: Expr, env: dict[str, np.ndarray], n: int):
         v = np.asarray(eval_host(e.expr, env, n))
         items = [eval_host(i, env, n) for i in e.items]
         res = np.isin(v, np.asarray(items, dtype=v.dtype if v.dtype != object else object))
+        return ~res if e.negated else res
+    from greptimedb_tpu.query.ast import TupleIn as _TupleIn
+
+    if isinstance(e, _TupleIn):
+        arrs = []
+        for x in e.exprs:
+            a = np.asarray(eval_host(x, env, n), dtype=object)
+            if a.ndim == 0:
+                a = np.full(n, a.item(), dtype=object)
+            arrs.append(a)
+        want = set(e.rows)
+        res = np.fromiter(
+            (t in want for t in zip(*arrs)), dtype=bool, count=n)
         return ~res if e.negated else res
     if isinstance(e, IsNull):
         v = eval_host(e.expr, env, n)

@@ -69,13 +69,19 @@ def _factorize(left_vals: np.ndarray, right_vals: np.ndarray):
 
 
 def merge_join(
-    lkeys: list[np.ndarray], rkeys: list[np.ndarray], left: bool
+    lkeys: list[np.ndarray], rkeys: list[np.ndarray], left: bool = False,
+    kind: str | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Vectorized sort-merge: returns (left_idx, right_idx) row pairs;
-    LEFT-join misses get right_idx == -1."""
-    nl = len(lkeys[0])
+    """Vectorized sort-merge: returns (left_idx, right_idx) row pairs.
+
+    ``kind``: inner | left | right | full.  Outer misses carry -1 on the
+    missing side (LEFT: unmatched left rows with right_idx -1; RIGHT the
+    mirror; FULL = LEFT ∪ unmatched right).  ``left=True`` is the legacy
+    spelling of kind="left"."""
+    kind = kind or ("left" if left else "inner")
+    nl, nr = len(lkeys[0]), len(rkeys[0])
     lc = np.zeros(nl, dtype=np.int64)
-    rc = np.zeros(len(rkeys[0]), dtype=np.int64)
+    rc = np.zeros(nr, dtype=np.int64)
     for lv, rv in zip(lkeys, rkeys):
         lcode, rcode = _factorize(lv, rv)
         card = int(max(lcode.max(initial=0), rcode.max(initial=0))) + 1
@@ -92,12 +98,21 @@ def merge_join(
     run_starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
     intra = np.arange(total) - np.repeat(run_starts, counts)
     right_idx = rs[np.repeat(starts, counts) + intra]
-    if left:
+    if kind in ("left", "full"):
         miss = np.nonzero(counts == 0)[0]
         left_idx = np.concatenate([left_idx, miss])
         right_idx = np.concatenate(
             [right_idx, np.full(len(miss), -1, dtype=np.int64)]
         )
+    if kind in ("right", "full"):
+        # right rows whose key never appears on the left
+        ls = np.sort(lc)
+        r_in_l = np.searchsorted(ls, rc, side="right") > np.searchsorted(
+            ls, rc, side="left")
+        rmiss = np.nonzero(~r_in_l)[0]
+        left_idx = np.concatenate(
+            [left_idx, np.full(len(rmiss), -1, dtype=np.int64)])
+        right_idx = np.concatenate([right_idx, rmiss])
     return left_idx, right_idx
 
 
@@ -130,8 +145,14 @@ def execute_join(engine, sel: Select):
     from greptimedb_tpu.query.planner import extract_time_range
 
     try:
-        l_ts_range = extract_time_range(sel.where,
-                                        provider.table_context(lt))
+        # UNSOUND for RIGHT/FULL: excluding a left row changes which
+        # right rows count as unmatched (their NULL-filled output would
+        # differ) — only inner/left may pre-restrict
+        if join.kind in ("inner", "left"):
+            l_ts_range = extract_time_range(sel.where,
+                                            provider.table_context(lt))
+        else:
+            l_ts_range = (None, None)
     except Exception:  # noqa: BLE001 — qualified refs etc.: scan all
         l_ts_range = (None, None)
     lcols_all = host_scan(lt, ts_range=l_ts_range)
@@ -164,7 +185,7 @@ def execute_join(engine, sel: Select):
         lkeys.append(lcols[lcol.name])
         rkeys.append(rcols[rcol.name])
 
-    li, ri = merge_join(lkeys, rkeys, left=join.kind == "left")
+    li, ri = merge_join(lkeys, rkeys, kind=join.kind)
 
     # ---- stage the joined columns into an ephemeral in-memory region ----
     lschema = provider.table_context(lt).schema
@@ -183,51 +204,44 @@ def execute_join(engine, sel: Select):
         SemanticType.TIMESTAMP, nullable=False,
     ))
     data["__joinrow__"] = np.arange(len(li), dtype=np.int64)
-    for name, arr in lcols.items():
-        out_name = lnames[name]
-        data[out_name] = arr[li]
-        c = lschema.column(name)
-        semantic = (
-            SemanticType.FIELD
-            if c.semantic is SemanticType.TIMESTAMP
-            else c.semantic
-        )
-        dtype = ConcreteDataType.INT64 if c.dtype.is_timestamp else c.dtype
-        cols_schema.append(dataclasses.replace(
-            c, name=out_name, semantic=semantic, dtype=dtype, nullable=True,
-        ))
-    miss = ri < 0
-    safe_ri = np.where(miss, 0, ri)
-    for name, arr in rcols.items():
-        out_name = rnames[name]
-        c = rschema.column(name)
-        vals = arr[safe_ri]
-        if miss.any():
-            if c.is_tag or c.dtype.is_string_like:
-                # "" is the engine's NULL-string representation (device
-                # dictionaries cannot hold None)
-                vals = vals.astype(object)
-                vals[miss] = ""
-            elif c.dtype.is_float:
-                vals = vals.astype(np.float64)
-                vals[miss] = np.nan
-            else:  # ints/timestamps: no NULL repr — 0 like empty default
-                vals = vals.copy()
-                vals[miss] = 0
-        semantic = (
-            SemanticType.FIELD
-            if c.semantic is SemanticType.TIMESTAMP
-            else c.semantic
-        )
-        dtype = (
-            ConcreteDataType.INT64
-            if c.dtype.is_timestamp
-            else c.dtype
-        )
-        cols_schema.append(dataclasses.replace(
-            c, name=out_name, semantic=semantic, dtype=dtype, nullable=True,
-        ))
-        data[out_name] = vals
+    def stage_side(cols, schema_side, names, idx):
+        """Gather one side's columns by row index; -1 = outer-join miss,
+        NULL-filled per dtype ("" strings, NaN floats, 0 ints — the
+        engine's device NULL conventions)."""
+        miss = idx < 0
+        safe = np.where(miss, 0, idx)
+        for name, arr in cols.items():
+            out_name = names[name]
+            c = schema_side.column(name)
+            vals = arr[safe]
+            if miss.any():
+                if c.is_tag or c.dtype.is_string_like:
+                    # "" is the engine's NULL-string representation
+                    # (device dictionaries cannot hold None)
+                    vals = vals.astype(object)
+                    vals[miss] = ""
+                elif c.dtype.is_float:
+                    vals = vals.astype(np.float64)
+                    vals[miss] = np.nan
+                else:  # ints/timestamps: no NULL repr — 0 default
+                    vals = vals.copy()
+                    vals[miss] = 0
+            semantic = (
+                SemanticType.FIELD
+                if c.semantic is SemanticType.TIMESTAMP
+                else c.semantic
+            )
+            dtype = (
+                ConcreteDataType.INT64 if c.dtype.is_timestamp else c.dtype
+            )
+            cols_schema.append(dataclasses.replace(
+                c, name=out_name, semantic=semantic, dtype=dtype,
+                nullable=True,
+            ))
+            data[out_name] = vals
+
+    stage_side(lcols, lschema, lnames, li)
+    stage_side(rcols, rschema, rnames, ri)
 
     # rewrite qualified references in the SELECT to the staged names
     # (shared map_expr walker descends every shape, incl. Case.whens)

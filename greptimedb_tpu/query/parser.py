@@ -290,16 +290,22 @@ class Parser:
             table = self.qualified_name()
             if self.peek().kind is Tok.IDENT and not self.at_kw(
                 "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "ALIGN",
-                "UNION", "JOIN", "LEFT", "RIGHT", "INNER", "ON", "AS",
+                "UNION", "JOIN", "LEFT", "RIGHT", "FULL", "INNER", "ON", "AS",
             ):
                 alias = self.ident()
             elif self.eat_kw("AS"):
                 alias = self.ident()
-            while self.at_kw("JOIN", "INNER", "LEFT"):
+            while self.at_kw("JOIN", "INNER", "LEFT", "RIGHT", "FULL"):
                 kind = "inner"
                 if self.eat_kw("LEFT"):
                     self.eat_kw("OUTER")
                     kind = "left"
+                elif self.eat_kw("RIGHT"):
+                    self.eat_kw("OUTER")
+                    kind = "right"
+                elif self.eat_kw("FULL"):
+                    self.eat_kw("OUTER")
+                    kind = "full"
                 else:
                     self.eat_kw("INNER")
                 self.expect_kw("JOIN")

@@ -14,7 +14,7 @@ from dataclasses import dataclass, field
 from greptimedb_tpu.errors import PlanError, Unsupported
 from greptimedb_tpu.query.ast import (
     Between, BinaryOp, Case, Cast, Column, Expr, FuncCall, InList, IntervalLit,
-    IsNull, Literal, OrderByItem, Select, SelectItem, Star, UnaryOp, WindowFunc,
+    IsNull, Literal, OrderByItem, Select, SelectItem, Star, UnaryOp,
 )
 from greptimedb_tpu.query.exprs import (
     AGG_FUNCS, TableContext, collect_aggs, is_aggregate,
@@ -356,41 +356,14 @@ def plan_select(sel: Select, ctx: TableContext) -> SelectPlan:
 
 
 def referenced_columns(e: Expr, ctx: TableContext, out: set[str]) -> None:
-    if isinstance(e, Column):
+    """Resolved column names referenced anywhere in the tree — built on
+    the shared map_expr walker so NEW node types can never be silently
+    missed (a hand-rolled per-node recursion here once dropped TupleIn's
+    columns and misclassified its WHERE as tag-only)."""
+    from greptimedb_tpu.query.ast import walk_columns
+
+    for c in walk_columns(e):
         try:
-            out.add(ctx.resolve(e.name))
-        except Exception:
+            out.add(ctx.resolve(c.name))
+        except Exception:  # noqa: BLE001 — unknown names resolve later
             pass
-    elif isinstance(e, BinaryOp):
-        referenced_columns(e.left, ctx, out)
-        referenced_columns(e.right, ctx, out)
-    elif isinstance(e, UnaryOp):
-        referenced_columns(e.operand, ctx, out)
-    elif isinstance(e, FuncCall):
-        for a in e.args:
-            referenced_columns(a, ctx, out)
-    elif isinstance(e, WindowFunc):
-        for a in e.args:
-            referenced_columns(a, ctx, out)
-        for p in e.spec.partition_by:
-            referenced_columns(p, ctx, out)
-        for o in e.spec.order_by:
-            referenced_columns(o.expr, ctx, out)
-    elif isinstance(e, Between):
-        referenced_columns(e.expr, ctx, out)
-        referenced_columns(e.low, ctx, out)
-        referenced_columns(e.high, ctx, out)
-    elif isinstance(e, InList):
-        referenced_columns(e.expr, ctx, out)
-    elif isinstance(e, IsNull):
-        referenced_columns(e.expr, ctx, out)
-    elif isinstance(e, Cast):
-        referenced_columns(e.expr, ctx, out)
-    elif isinstance(e, Case):
-        if e.operand:
-            referenced_columns(e.operand, ctx, out)
-        for c, v in e.whens:
-            referenced_columns(c, ctx, out)
-            referenced_columns(v, ctx, out)
-        if e.else_:
-            referenced_columns(e.else_, ctx, out)

@@ -1357,6 +1357,167 @@ class TestExistsSubqueries:
                     "alerts WHERE alerts.h = hosts.h LIMIT 0)")
 
 
+class TestMultiKeyExists:
+    """Multi-equality correlated EXISTS → tuple membership (round-4
+    verdict item 6; the reference reaches the same semantics through
+    DataFusion's semi-join decorrelation, src/query/src/planner.rs)."""
+
+    @pytest.fixture
+    def db3(self, tmp_path):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        d = GreptimeDB(str(tmp_path / "mk"))
+        d.sql("CREATE TABLE pods (h STRING, svc STRING, ts TIMESTAMP(3) "
+              "TIME INDEX, up DOUBLE, PRIMARY KEY (h, svc))")
+        d.sql("CREATE TABLE incidents (h STRING, svc STRING, ts "
+              "TIMESTAMP(3) TIME INDEX, sev DOUBLE, PRIMARY KEY (h, svc))")
+        d.sql("INSERT INTO pods VALUES ('a','web',1000,1.0),"
+              "('a','db',1000,1.0),('b','web',1000,1.0),('c','db',1000,1.0)")
+        d.sql("INSERT INTO incidents VALUES ('a','web',1000,3.0),"
+              "('c','db',2000,5.0),('b','db',2000,1.0)")
+        yield d
+        d.close()
+
+    def test_two_key_exists(self, db3):
+        r = db3.sql(
+            "SELECT h, svc FROM pods WHERE EXISTS (SELECT 1 FROM incidents"
+            " WHERE incidents.h = pods.h AND incidents.svc = pods.svc)"
+            " ORDER BY h")
+        assert r.rows == [["a", "web"], ["c", "db"]]
+
+    def test_two_key_not_exists(self, db3):
+        r = db3.sql(
+            "SELECT h, svc FROM pods WHERE NOT EXISTS (SELECT 1 FROM "
+            "incidents WHERE incidents.h = pods.h AND "
+            "incidents.svc = pods.svc) ORDER BY h, svc")
+        assert r.rows == [["a", "db"], ["b", "web"]]
+
+    def test_two_key_exists_with_residual_predicate(self, db3):
+        r = db3.sql(
+            "SELECT h, svc FROM pods WHERE EXISTS (SELECT 1 FROM incidents"
+            " WHERE incidents.h = pods.h AND incidents.svc = pods.svc"
+            " AND sev > 4) ORDER BY h")
+        assert r.rows == [["c", "db"]]
+
+    def test_mixed_key_types(self, tmp_path):
+        # one tag key + one numeric key in the correlation
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        d = GreptimeDB(str(tmp_path / "mx"))
+        d.sql("CREATE TABLE ev (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+              "code DOUBLE, PRIMARY KEY (h))")
+        d.sql("CREATE TABLE allow (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+              "code DOUBLE, PRIMARY KEY (h))")
+        d.sql("INSERT INTO ev VALUES ('a',1000,1.0),('a',2000,2.0),"
+              "('b',1000,1.0)")
+        d.sql("INSERT INTO allow VALUES ('a',1,1.0),('b',1,2.0)")
+        r = d.sql("SELECT h, code FROM ev WHERE EXISTS (SELECT 1 FROM "
+                  "allow WHERE allow.h = ev.h AND allow.code = ev.code) "
+                  "ORDER BY h, code")
+        assert r.rows == [["a", 1.0]]
+        d.close()
+
+    def test_grid_path_with_field_key(self, tmp_path):
+        """Review regression: TupleIn's referenced columns must reach the
+        planner (a vacuously tag-only WHERE crashed the grid executor
+        with KeyError on the field column)."""
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        d = GreptimeDB(str(tmp_path / "gr"))
+        d.sql("CREATE TABLE ev (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+              "code DOUBLE, up DOUBLE, PRIMARY KEY (h))")
+        d.sql("CREATE TABLE allow (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+              "code DOUBLE, PRIMARY KEY (h))")
+        t0 = 1700000000000
+        d.sql("INSERT INTO ev VALUES " + ",".join(
+            f"('h{i % 4}',{t0 + i * 1000},{i % 3},{i})" for i in range(240)))
+        d.sql("INSERT INTO allow VALUES ('h0',1,0.0),('h1',1,1.0)")
+        d._region_of("ev").flush()
+        r = d.sql("SELECT h, count(*) FROM ev WHERE EXISTS (SELECT 1 FROM"
+                  " allow WHERE allow.h = ev.h AND allow.code = ev.code)"
+                  " GROUP BY h ORDER BY h")
+        want = {}
+        allow = {("h0", 0.0), ("h1", 1.0)}
+        for i in range(240):
+            k = (f"h{i % 4}", float(i % 3))
+            if k in allow:
+                want[k[0]] = want.get(k[0], 0) + 1
+        assert {row[0]: row[1] for row in r.rows} == want
+        d.close()
+
+    def test_ns_timestamp_keys_exact(self, tmp_path):
+        """Review regression: int64 keys above 2^53 (ns timestamps) must
+        compare exactly — a float64 downcast collapsed adjacent ns."""
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        d = GreptimeDB(str(tmp_path / "ns"))
+        d.sql("CREATE TABLE ev (h STRING, ts TIMESTAMP(9) TIME INDEX, "
+              "up DOUBLE, PRIMARY KEY (h))")
+        d.sql("CREATE TABLE al (h STRING, ts TIMESTAMP(9) TIME INDEX, "
+              "up DOUBLE, PRIMARY KEY (h))")
+        base = 1600000000000000000
+        d.sql(f"INSERT INTO ev VALUES ('a',{base},1.0),"
+              f"('a',{base + 100},2.0)")
+        d.sql(f"INSERT INTO al VALUES ('a',{base},9.0)")
+        r = d.sql("SELECT h, up FROM ev WHERE EXISTS (SELECT 1 FROM al "
+                  "WHERE al.h = ev.h AND al.ts = ev.ts)")
+        assert r.rows == [["a", 1.0]]
+        d.close()
+
+    def test_refused_shapes_still_loud(self, db3):
+        from greptimedb_tpu.errors import Unsupported
+
+        # non-equality outer reference stays refused even with two
+        # equality correlations present
+        with pytest.raises(Unsupported):
+            db3.sql(
+                "SELECT h FROM pods WHERE EXISTS (SELECT 1 FROM incidents"
+                " WHERE incidents.h = pods.h AND incidents.svc = pods.svc"
+                " AND incidents.ts > pods.ts)")
+
+
+class TestOuterJoins:
+    """RIGHT = mirrored LEFT, FULL = LEFT ∪ unmatched right (round-4
+    verdict item 6; reference reaches these via DataFusion's join
+    surface, src/query/src/datafusion.rs:141)."""
+
+    @pytest.fixture
+    def jdb(self, db):
+        db.sql("CREATE TABLE metrics (host STRING, ts TIMESTAMP(3) "
+               "TIME INDEX, cpu DOUBLE, PRIMARY KEY (host))")
+        db.sql("CREATE TABLE meta (host STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " dc STRING, weight DOUBLE, PRIMARY KEY (host))")
+        db.sql("INSERT INTO metrics VALUES ('a',1000,10.0),('a',2000,20.0),"
+               "('b',1000,30.0),('c',1000,40.0)")
+        db.sql("INSERT INTO meta VALUES ('a',0,'us',1.0),('b',0,'eu',2.0),"
+               "('z',0,'ap',9.0)")
+        return db
+
+    def test_right_join(self, jdb):
+        r = jdb.sql("SELECT m.host, meta.dc, count(*) FROM metrics m "
+                    "RIGHT JOIN meta ON m.host = meta.host "
+                    "GROUP BY m.host, meta.dc ORDER BY meta.dc")
+        # 'z' has no metrics rows: left side NULL-fills ("" for strings)
+        assert r.rows == [["", "ap", 1], ["b", "eu", 1], ["a", "us", 2]]
+
+    def test_full_join(self, jdb):
+        r = jdb.sql("SELECT m.host, meta.dc, count(*) FROM metrics m "
+                    "FULL JOIN meta ON m.host = meta.host "
+                    "GROUP BY m.host, meta.dc ORDER BY m.host, meta.dc")
+        # unmatched left 'c' AND unmatched right 'z' both survive
+        assert r.rows == [["", "ap", 1], ["a", "us", 2], ["b", "eu", 1],
+                          ["c", "", 1]]
+
+    def test_full_outer_spelling_and_values(self, jdb):
+        r = jdb.sql("SELECT m.cpu, meta.weight FROM metrics m "
+                    "FULL OUTER JOIN meta ON m.host = meta.host "
+                    "ORDER BY m.host, meta.dc")
+        vals = {(row[0], row[1]) for row in r.rows}
+        # right-miss row ('c'): weight NaN→None; left-miss row ('z'):
+        # cpu NaN→None
+        assert (40.0, None) in vals and (None, 9.0) in vals
+
+
 def test_matches_score_and_cjk(tmp_path):
     from greptimedb_tpu.standalone import GreptimeDB
 
