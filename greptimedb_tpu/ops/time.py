@@ -40,19 +40,93 @@ def bucket_index(
     return jnp.where(ts >= start, idx, -1)
 
 
-def date_trunc_bucket(ts_ms: jnp.ndarray, unit: str) -> jnp.ndarray:
-    """date_trunc for fixed-width units over ms timestamps (UTC).
+def civil_from_days(z):
+    """Days-since-epoch → (year, month, day), proleptic Gregorian UTC.
 
-    Week truncation aligns to Monday (epoch day 0 was a Thursday, offset 3).
-    Month/year need host-computed edges — see query planner.
-    """
+    Howard Hinnant's civil_from_days in pure floor-division integer
+    arithmetic — works identically on numpy arrays and traced jnp values
+    (python // IS floor division, so the C++ negative-adjustment dance
+    disappears)."""
+    z = z + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + 3 - 12 * (mp >= 10)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def days_from_civil(y, m, d):
+    """(year, month, day) → days since epoch (inverse of
+    civil_from_days; same integer-only arithmetic)."""
+    y = y - (m <= 2)
+    era = y // 400
+    yoe = y - era * 400
+    mp = m + 12 * (m < 3) - 3
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+_DAY_MS = 86_400_000
+
+
+def date_trunc_bucket(ts_ms: jnp.ndarray, unit: str) -> jnp.ndarray:
+    """date_trunc over ms timestamps (UTC).
+
+    Fixed-width units truncate by integer modulo; week aligns to Monday
+    (epoch day 0 was a Thursday, offset 3); month/quarter/year go
+    through the civil-calendar integer conversion — still pure
+    arithmetic, so the SAME code runs on device (traced) and host."""
     u = unit.lower()
     if u == "week":
         w = _FIXED_MS["week"]
-        return ((ts_ms.astype(jnp.int64) + 3 * 86_400_000) // w) * w - 3 * 86_400_000
+        return ((ts_ms.astype(jnp.int64) + 3 * _DAY_MS) // w) * w - 3 * _DAY_MS
     if u in _FIXED_MS:
         return time_bucket(ts_ms, _FIXED_MS[u])
-    raise ValueError(f"date_trunc unit needs host edges: {unit}")
+    if u in ("month", "quarter", "year"):
+        days = ts_ms.astype(jnp.int64) // _DAY_MS
+        y, m, _d = civil_from_days(days)
+        if u == "year":
+            m = m * 0 + 1
+        elif u == "quarter":
+            m = ((m - 1) // 3) * 3 + 1
+        return days_from_civil(y, m, 1) * _DAY_MS
+    raise ValueError(f"unknown date_trunc unit: {unit}")
+
+
+def date_part_of(ms, part: str):
+    """date_part/extract over ms timestamps (UTC) — pure integer
+    arithmetic (civil_from_days), so the ONE implementation serves both
+    the device compile and the host evaluator."""
+    p = part.lower()
+    if p in ("second", "seconds"):
+        return (ms // 1000) % 60
+    if p in ("minute", "minutes"):
+        return (ms // 60_000) % 60
+    if p in ("hour", "hours"):
+        return (ms // 3_600_000) % 24
+    if p in ("dow", "dayofweek"):
+        return (ms // _DAY_MS + 4) % 7  # 0 = Sunday
+    if p in ("epoch",):
+        return ms // 1000
+    days = ms // _DAY_MS
+    y, m, d = civil_from_days(days)
+    if p in ("day", "days"):
+        return d
+    if p in ("month", "months"):
+        return m
+    if p == "quarter":
+        return (m - 1) // 3 + 1
+    if p in ("year", "years"):
+        return y
+    if p in ("doy", "dayofyear"):
+        return days - days_from_civil(y, m * 0 + 1, d * 0 + 1) + 1
+    raise ValueError(f"unknown date_part unit: {part}")
 
 
 def searchsorted_bucket(ts: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:

@@ -284,8 +284,22 @@ class QueryEngine:
         if check is not None:  # cooperative KILL (ProcessManager)
             check()
         ctx = self.provider.table_context(sel.table)
+        from greptimedb_tpu.query.optimizer import optimize_select
+
+        sel, opt_rules = optimize_select(sel, ctx)
         plan = plan_select(sel, ctx)
+        if metrics is not None and opt_rules:
+            metrics["optimizer_rules"] = ",".join(opt_rules)
         t = mark("plan_ms", t)
+        if plan.is_agg and any(
+                k.kind == "expr" for k in plan.group_keys):
+            res = self._execute_expr_key_agg(sel, ctx, plan)
+            if res is not None:
+                mark("device_exec_ms", t)
+                if metrics is not None:
+                    metrics["output_rows"] = len(res.rows)
+                    metrics["expr_key_fold"] = True
+                return res
         if check is not None:
             check()
         # dense time-grid fast path: regular-cadence metric tables lower
@@ -342,6 +356,90 @@ class QueryEngine:
             metrics["scanned_rows_padded"] = scanned
         return result
 
+    def _execute_expr_key_agg(self, sel: Select, ctx,
+                              plan: SelectPlan) -> QueryResult | None:
+        """GROUP BY over computed tag expressions (upper(h), length(h),
+        concat(h, dc), …): aggregate at raw-tag granularity on device,
+        then fold combos sharing one computed key host-side through the
+        SHARED merge (rpc/partial.py) — the single-device twin of the
+        mesh path's host fold (parallel/dist.py execute_select_on_mesh;
+        the reference evaluates expr keys row-wise via DataFusion, here
+        rows never leave the device — only (combo × agg) partials do).
+
+        Returns None when not applicable (non-tag references, refused
+        split, un-shapeable ORDER BY) — caller falls through to the
+        normal path and its error reporting."""
+        import dataclasses
+
+        from greptimedb_tpu.query.ast import Column
+        from greptimedb_tpu.query.planner import referenced_columns
+        from greptimedb_tpu.rpc.partial import merge_partials, split_partial
+
+        if not self._mesh_shapeable(sel):
+            return None
+        ts_name = (ctx.schema.time_index.name
+                   if ctx.schema.time_index is not None else None)
+        pplan = split_partial(sel, ts_column=ts_name)
+        if pplan is None:
+            return None
+        tag_names = {c.name for c in ctx.schema.tag_columns}
+        expr_of_key = {str(k.expr): k for k in plan.group_keys}
+        base_tags: list[str] = []
+        for k in plan.group_keys:
+            if k.kind != "expr":
+                continue
+            refs: set = set()
+            referenced_columns(k.expr, ctx, refs)
+            if not refs or not refs <= tag_names:
+                return None  # field/ts-dependent keys: no tag fold
+            for c in sorted(refs):
+                if c not in base_tags:
+                    base_tags.append(c)
+
+        # inner query: expr-key items become their base tag columns; the
+        # other key items and all partial agg items pass through
+        psel = pplan.partial_select
+        inner_items = []
+        inner_group = [Column(t) for t in base_tags]
+        kept_keys: dict[str, str] = {}  # partial key alias -> "expr"|"col"
+        for it in psel.items:
+            if it.alias in pplan.key_cols:
+                gk = expr_of_key.get(str(it.expr))
+                if gk is not None and gk.kind == "expr":
+                    kept_keys[it.alias] = "expr"
+                    continue  # replaced by base tags
+                kept_keys[it.alias] = "col"
+                inner_items.append(it)
+                inner_group.append(Column(it.alias))
+            else:
+                inner_items.append(it)
+        inner_items = [
+            SelectItem(Column(t), alias=t) for t in base_tags
+        ] + inner_items
+        inner_sel = dataclasses.replace(
+            psel, items=inner_items, group_by=inner_group)
+        res = self.execute_select(inner_sel)
+
+        idx = {n: i for i, n in enumerate(res.column_names)}
+        m = len(res.rows)
+        env_host = {
+            t: np.array([row[idx[t]] for row in res.rows], dtype=object)
+            for t in base_tags
+        }
+        part: dict[str, list] = {}
+        for it in psel.items:
+            alias = it.alias
+            if alias in pplan.key_cols and kept_keys.get(alias) == "expr":
+                v = eval_host(it.expr, dict(env_host), m)
+                arr = np.asarray(v, dtype=object)
+                if arr.ndim == 0:
+                    arr = np.full(m, arr.item(), dtype=object)
+                part[alias] = arr.tolist()
+            else:
+                part[alias] = [row[idx[alias]] for row in res.rows]
+        names, rows = merge_partials(pplan, [part])
+        return self._finish_merged(sel, plan, names, rows)
+
     @staticmethod
     def _mesh_shapeable(sel: Select) -> bool:
         """The mesh path returns merged rows keyed by OUTPUT names; every
@@ -375,8 +473,15 @@ class QueryEngine:
         if sel.table is None:
             return "Projection (const)"
         ctx = self.provider.table_context(sel.table)
+        from greptimedb_tpu.query.optimizer import optimize_select
+
+        sel, opt_rules = optimize_select(sel, ctx)
         plan = plan_select(sel, ctx)
+        if plan.time_range != (None, None):
+            opt_rules = opt_rules + ["time_range_pushdown"]
         lines = []
+        if opt_rules:
+            lines.append(f"Optimizer: [{', '.join(opt_rules)}]")
         if plan.limit is not None:
             lines.append(f"Limit: {plan.limit} offset {plan.offset or 0}")
         if plan.order_by:
@@ -489,6 +594,12 @@ class QueryEngine:
 
     def _shape(self, plan: SelectPlan, env: dict[str, np.ndarray], n: int) -> QueryResult:
         ctx = plan.ctx
+        # host date functions (date_trunc/date_part/…) need the table's
+        # timestamp unit; stash the native→ms factor in the eval env
+        try:
+            env.setdefault("__ts_factor__", ctx.ts_unit_ms_factor())
+        except Exception:  # noqa: BLE001 — no time index
+            pass
         # expand stars
         items: list[SelectItem] = []
         for item in plan.items:

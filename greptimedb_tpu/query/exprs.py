@@ -218,7 +218,107 @@ _HOST_FUNCS = {
         args, n, lambda *vs: "".join("" if v is None else str(v) for v in vs)
     ),
     "substr": lambda args, n: _per_row(args, n, _substr),
+    # string tail (reference src/common/function/src/scalars/string/)
+    "replace": lambda args, n: _per_row(
+        args, n,
+        lambda s, a, b: None if s is None else str(s).replace(str(a), str(b)),
+    ),
+    "reverse": lambda args, n: _per_row(
+        args, n, lambda s: None if s is None else str(s)[::-1]
+    ),
+    "left": lambda args, n: _per_row(
+        args, n, lambda s, k: None if s is None else str(s)[: int(k)]
+    ),
+    "right": lambda args, n: _per_row(
+        args, n,
+        lambda s, k: None if s is None else (
+            str(s)[-int(k):] if int(k) > 0 else ""),
+    ),
+    "split_part": lambda args, n: _per_row(args, n, _split_part),
+    "strpos": lambda args, n: _per_row(
+        args, n,
+        lambda s, sub: None if s is None else str(s).find(str(sub)) + 1,
+    ),
+    "position": lambda args, n: _per_row(
+        args, n,
+        lambda sub, s: None if s is None else str(s).find(str(sub)) + 1,
+    ),
+    "lpad": lambda args, n: _per_row(
+        args, n, lambda s, k, p=" ": _pad(s, k, p, left=True)
+    ),
+    "rpad": lambda args, n: _per_row(
+        args, n, lambda s, k, p=" ": _pad(s, k, p, left=False)
+    ),
+    "repeat": lambda args, n: _per_row(
+        args, n, lambda s, k: None if s is None else str(s) * int(k)
+    ),
+    "starts_with": lambda args, n: _per_row(
+        args, n,
+        lambda s, p: None if s is None else str(s).startswith(str(p)),
+    ),
+    "ends_with": lambda args, n: _per_row(
+        args, n,
+        lambda s, p: None if s is None else str(s).endswith(str(p)),
+    ),
+    # NULL handling (reference DataFusion built-ins)
+    "coalesce": lambda args, n: _per_row(
+        args, n,
+        lambda *vs: next((v for v in vs if not _is_null_val(v)), None),
+    ),
+    "ifnull": lambda args, n: _per_row(
+        args, n, lambda v, alt: alt if _is_null_val(v) else v
+    ),
+    "nvl": lambda args, n: _per_row(
+        args, n, lambda v, alt: alt if _is_null_val(v) else v
+    ),
+    "nullif": lambda args, n: _per_row(
+        args, n, lambda a, b: None if a == b else a
+    ),
+    "greatest": lambda args, n: _per_row(
+        args, n,
+        lambda *vs: max((v for v in vs if not _is_null_val(v)),
+                        default=None),
+    ),
+    "least": lambda args, n: _per_row(
+        args, n,
+        lambda *vs: min((v for v in vs if not _is_null_val(v)),
+                        default=None),
+    ),
 }
+
+
+def _is_null_val(v) -> bool:
+    if v is None:
+        return True
+    try:
+        # NaN of ANY float width (np.float32 is not a python float —
+        # isinstance(float) checks miss device-f32 NaNs)
+        return bool(v != v)
+    except Exception:  # noqa: BLE001 — non-comparable: not null
+        return False
+
+
+def _pad(s, k, p, *, left: bool):
+    """lpad/rpad with the full multi-character fill pattern cycled
+    (PostgreSQL semantics), truncating to length k."""
+    if s is None:
+        return None
+    s = str(s)
+    k = int(k)
+    p = str(p) or " "
+    if len(s) >= k:
+        return s[:k]
+    fill = (p * (k // len(p) + 1))[: k - len(s)]
+    return fill + s if left else s + fill
+
+
+def _split_part(s, delim, idx):
+    """split_part(str, delimiter, n) — 1-based; out of range → ''."""
+    if s is None:
+        return None
+    parts = str(s).split(str(delim))
+    i = int(idx)
+    return parts[i - 1] if 1 <= i <= len(parts) else ""
 
 
 def _geo_fn(name: str, fn, arity: int):
@@ -855,6 +955,11 @@ def compile_device_func(e: FuncCall, ctx: TableContext):
         if len(e.args) < 2:
             raise PlanError("date_bin(interval, ts)")
         iv = e.args[0]
+        if isinstance(iv, Literal) and isinstance(iv.value, str):
+            # date_bin('1 minute', ts): string spelling of the interval
+            from greptimedb_tpu.query.parser import parse_interval_str
+
+            iv = IntervalLit(parse_interval_str(iv.value), iv.value)
         if not isinstance(iv, IntervalLit):
             raise Unsupported("date_bin needs interval literal")
         step = int(iv.ms * ctx.ts_unit_ms_factor())
@@ -896,6 +1001,11 @@ def compile_device_func(e: FuncCall, ctx: TableContext):
         lo = compile_device(e.args[1], ctx)
         hi = compile_device(e.args[2], ctx)
         return lambda env: jnp.clip(a(env), lo(env), hi(env))
+    if name in ("power", "pow"):
+        a = compile_device(e.args[0], ctx)
+        b = compile_device(e.args[1], ctx)
+        return lambda env: jnp.power(
+            jnp.asarray(a(env), dtype=jnp.float64), b(env))
     if name == "coalesce":
         parts = [compile_device(a, ctx) for a in e.args]
 
@@ -911,6 +1021,25 @@ def compile_device_func(e: FuncCall, ctx: TableContext):
         inner = compile_device(e.args[0], ctx)
         factor = ctx.ts_unit_ms_factor() * 1000.0
         return lambda env: (inner(env) / factor).astype(jnp.int64)
+    if name in ("date_part", "datepart"):
+        if (len(e.args) != 2 or not isinstance(e.args[0], Literal)):
+            raise PlanError("date_part(unit, ts)")
+        part = str(e.args[0].value).lower()
+        inner = compile_device(e.args[1], ctx)
+        factor = ctx.ts_unit_ms_factor()
+        from greptimedb_tpu.ops.time import date_part_of
+
+        try:
+            date_part_of(jnp.zeros(1, jnp.int64), part)
+        except ValueError as exc:
+            raise Unsupported(str(exc))
+
+        def fn(env, part=part):
+            ts = inner(env)
+            ms = (ts / factor).astype(jnp.int64) if factor != 1.0 else ts
+            return date_part_of(ms, part)
+
+        return fn
     if name == "now":
         import time as _time
 
@@ -956,6 +1085,9 @@ def eval_host(e: Expr, env: dict[str, np.ndarray], n: int):
         }
         if e.name in table:
             return table[e.name](np.asarray(args[0], dtype=float))
+        if e.name in ("power", "pow"):
+            return np.power(np.asarray(args[0], dtype=float),
+                            np.asarray(args[1], dtype=float))
         if e.name in _HOST_FUNCS:
             return _HOST_FUNCS[e.name](args, n)
         if e.name in FT_FUNCS:
@@ -994,6 +1126,43 @@ def eval_host(e: Expr, env: dict[str, np.ndarray], n: int):
             )
             dists = _vocab_distances(e.name, list(uniq), q)
             return dists[inv]
+        if e.name in ("date_trunc", "date_part", "datepart", "to_unixtime",
+                      "date_format"):
+            # the engine stashes the table's ts-unit factor in env so
+            # host date functions see epoch values in a known unit
+            from greptimedb_tpu.ops.time import (
+                date_part_of, date_trunc_bucket,
+            )
+
+            factor = float(env.get("__ts_factor__", 1.0))
+            tsarg = args[1] if e.name in ("date_trunc", "date_part",
+                                          "datepart") else args[0]
+            ts = np.asarray(tsarg, dtype=np.int64)
+            ms = (ts / factor).astype(np.int64) if factor != 1.0 else ts
+            if e.name == "to_unixtime":
+                return ms // 1000
+            if e.name == "date_trunc":
+                try:
+                    out = date_trunc_bucket(ms, str(args[0]))
+                except ValueError as exc:
+                    raise Unsupported(str(exc))
+                out = np.asarray(out, dtype=np.int64)
+                return ((out * factor).astype(np.int64)
+                        if factor != 1.0 else out)
+            if e.name in ("date_part", "datepart"):
+                try:
+                    return np.asarray(date_part_of(ms, str(args[0])))
+                except ValueError as exc:
+                    raise Unsupported(str(exc))
+            # date_format(ts, fmt): chrono-style strftime per row
+            import datetime as _dt
+
+            fmt = str(args[1])
+            return np.array([
+                _dt.datetime.fromtimestamp(
+                    v / 1000.0, _dt.timezone.utc).strftime(fmt)
+                for v in ms.tolist()
+            ], dtype=object)
         raise Unsupported(f"host function {e.name}")
     if isinstance(e, UnaryOp):
         v = eval_host(e.operand, env, n)
@@ -1078,12 +1247,24 @@ def eval_host(e: Expr, env: dict[str, np.ndarray], n: int):
             done |= cond
         return out
     if isinstance(e, Cast):
+        from greptimedb_tpu.errors import ExecutionError
+
         v = eval_host(e.expr, env, n)
         tn = e.type_name.upper()
-        if "INT" in tn:
-            return np.asarray(v).astype(np.int64)
-        if "DOUBLE" in tn or "FLOAT" in tn or "REAL" in tn:
-            return np.asarray(v).astype(np.float64)
+        try:
+            if "INT" in tn:
+                arr = np.asarray(v)
+                if arr.dtype.kind in ("i", "u"):
+                    return arr.astype(np.int64)  # exact, no f64 detour
+                # strings/floats: float parse then truncate ('1.9' → 1);
+                # big int64s never take this path (review regression:
+                # f64 corrupts ints above 2^53)
+                return arr.astype(np.float64).astype(np.int64)
+            if "DOUBLE" in tn or "FLOAT" in tn or "REAL" in tn:
+                return np.asarray(v).astype(np.float64)
+        except ValueError as exc:
+            # bad literal → coded error, not a bare python ValueError
+            raise ExecutionError(f"cast to {e.type_name}: {exc}")
         if "STRING" in tn or "VARCHAR" in tn or "TEXT" in tn:
             return np.asarray([str(x) for x in np.atleast_1d(np.asarray(v, dtype=object))], dtype=object)
         raise Unsupported(f"host cast to {e.type_name}")

@@ -204,16 +204,30 @@ def execute_join(engine, sel: Select):
         SemanticType.TIMESTAMP, nullable=False,
     ))
     data["__joinrow__"] = np.arange(len(li), dtype=np.int64)
+    _TS_TO_MS = {
+        "TimestampSecond": 1000, "TimestampMillisecond": 1,
+        "TimestampMicrosecond": -1000, "TimestampNanosecond": -1000000,
+    }  # positive = multiply, negative = integer-divide
+
     def stage_side(cols, schema_side, names, idx):
         """Gather one side's columns by row index; -1 = outer-join miss,
         NULL-filled per dtype ("" strings, NaN floats, 0 ints — the
-        engine's device NULL conventions)."""
+        engine's device NULL conventions).  Timestamp columns normalize
+        to MILLISECONDS: the staged schema types them INT64 (unit info
+        is gone), and host date functions assume ms — mixing native
+        units would silently mis-scale them."""
         miss = idx < 0
         safe = np.where(miss, 0, idx)
         for name, arr in cols.items():
             out_name = names[name]
             c = schema_side.column(name)
             vals = arr[safe]
+            if c.dtype.is_timestamp:
+                f = _TS_TO_MS.get(c.dtype.value, 1)
+                if f > 1:
+                    vals = vals.astype(np.int64) * f
+                elif f < 0:
+                    vals = vals.astype(np.int64) // (-f)
             if miss.any():
                 if c.is_tag or c.dtype.is_string_like:
                     # "" is the engine's NULL-string representation

@@ -524,7 +524,15 @@ class Parser:
         if self.at(Tok.OP, "+"):
             self.next()
             return self.unary_expr()
-        return self.primary()
+        e = self.primary()
+        # postgres-style postfix cast: expr::TYPE (two ':' PUNCT tokens)
+        while (self.at(Tok.PUNCT, ":")
+               and self.peek(1).kind is Tok.PUNCT
+               and self.peek(1).text == ":"):
+            self.next()
+            self.next()
+            e = Cast(e, self.type_name())
+        return e
 
     def primary(self) -> Expr:
         t = self.peek()
@@ -577,6 +585,24 @@ class Parser:
                 return Cast(e, type_name)
             # identifier / function call / qualified column
             name = self.ident()
+            if (name.lower() == "position" and self.at(Tok.PUNCT, "(")
+                    and self._position_in_form()):
+                # POSITION(substr IN str) → position(substr, str)
+                self.next()
+                sub = self.unary_expr()
+                self.expect_kw("IN")
+                s = self.expr()
+                self.expect(Tok.PUNCT, ")")
+                return FuncCall("position", (sub, s))
+            if name.lower() == "extract" and self.at(Tok.PUNCT, "("):
+                # EXTRACT(unit FROM expr) → date_part('unit', expr)
+                self.next()
+                unit = self.ident()
+                self.expect_kw("FROM")
+                inner = self.expr()
+                self.expect(Tok.PUNCT, ")")
+                return FuncCall("date_part",
+                                (Literal(unit.lower()), inner))
             if self.at(Tok.PUNCT, "("):
                 self.next()
                 if self.at(Tok.OP, "*"):
@@ -602,6 +628,26 @@ class Parser:
                 return Column(col, table=name)
             return Column(name)
         raise SyntaxError_(f"unexpected token {t.text!r} at {t.pos}")
+
+    def _position_in_form(self) -> bool:
+        """Lookahead: POSITION(expr IN expr) vs plain position(a, b)."""
+        depth = 0
+        i = 0
+        while True:
+            t = self.peek(i)
+            if t.kind is Tok.EOF:
+                return False
+            if t.kind is Tok.PUNCT and t.text == "(":
+                depth += 1
+            elif t.kind is Tok.PUNCT and t.text == ")":
+                depth -= 1
+                if depth <= 0:
+                    return False
+            elif depth == 1 and t.kind is Tok.PUNCT and t.text == ",":
+                return False
+            elif depth == 1 and t.kind is Tok.IDENT and t.upper == "IN":
+                return True
+            i += 1
 
     def case_expr(self) -> Expr:
         self.expect_kw("CASE")

@@ -1083,7 +1083,11 @@ class Executor:
                             env[ts_name], step, time_starts[time_ordinal[i]]
                         )
                     else:
-                        vals = spec[1](env).astype(jnp.int64)
+                        # constant expressions (GROUP BY 1+1 / literal
+                        # aliases) compile to scalars — broadcast to rows
+                        vals = jnp.broadcast_to(
+                            jnp.asarray(spec[1](env)), (n,)
+                        ).astype(jnp.int64)
                     if combined is None:
                         combined = vals
                     else:
@@ -1158,7 +1162,9 @@ class Executor:
                         bucket = bucket_index(env[ts_name], step, start)
                         kv = (bucket * step + start)[safe_rep]
                     else:
-                        kv = spec[1](env).astype(jnp.int64)[safe_rep]
+                        kv = jnp.broadcast_to(
+                            jnp.asarray(spec[1](env)), (n,)
+                        ).astype(jnp.int64)[safe_rep]
                     out[f"__key{i}__"] = kv
             for name, fn in agg_specs:
                 out[name] = fn(env, gid, ng, mask)

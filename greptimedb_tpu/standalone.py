@@ -878,6 +878,8 @@ class GreptimeDB(TableProvider):
 
         db, name = self._split_name(stmt.name)
         schema = schema_from_create(stmt)
+        if stmt.engine == "metric":
+            return self._create_metric_table(db, name, stmt, schema)
         if stmt.engine == "file":
             loc = stmt.options.get("location")
             if not loc:
@@ -919,6 +921,47 @@ class GreptimeDB(TableProvider):
             "append_mode": append,
             "ttl_ms": ttl_ms,
         }))
+        return QueryResult([], [], affected_rows=0)
+
+    def _create_metric_table(self, db, name, stmt, schema) -> QueryResult:
+        """CREATE TABLE … ENGINE = metric: the DDL front of the metric
+        engine (reference src/metric-engine create.rs — physical tables
+        own storage, logical tables multiplex on via row modifiers).
+        Here ALL logical tables share the ONE default physical region
+        (storage/metric_engine.py), so a named physical table becomes a
+        catalog alias over its region ids."""
+        from greptimedb_tpu.errors import TableAlreadyExists
+        from greptimedb_tpu.storage.metric_engine import (
+            PHYSICAL_TABLE, physical_schema,
+        )
+
+        if self.catalog.table_exists(db, name):
+            if stmt.if_not_exists:
+                return QueryResult([], [], affected_rows=0)
+            raise TableAlreadyExists(f"{db}.{name}")
+        if "physical_metric_table" in stmt.options:
+            self.metric_engine.physical_region(db)
+            if name != PHYSICAL_TABLE:
+                info = self.catalog.create_table(
+                    db, name, physical_schema(),
+                    engine="metric_physical", if_not_exists=True,
+                )
+                if info is not None:
+                    phys = self.catalog.get_table(db, PHYSICAL_TABLE)
+                    info.region_ids = list(phys.region_ids)
+                    self.catalog.update_table(info)
+            return QueryResult([], [], affected_rows=0)
+        # logical table (WITH (on_physical_table = '…'): any physical
+        # name accepted — the shared region holds them all)
+        ti = schema.time_index
+        fields = [c for c in schema if c.semantic is SemanticType.FIELD]
+        if (ti is None or ti.name != "ts" or len(fields) != 1
+                or fields[0].name != "val"):
+            raise Unsupported(
+                "metric-engine logical tables use (tags…, ts TIMESTAMP "
+                "TIME INDEX, val DOUBLE) column names")
+        tags = [c.name for c in schema if c.is_tag]
+        self.metric_engine.ensure_logical(name, tags, db)
         return QueryResult([], [], affected_rows=0)
 
     def _create_view(self, stmt: CreateView) -> QueryResult:
@@ -1196,6 +1239,18 @@ class GreptimeDB(TableProvider):
                 raise Unsupported("external (file engine) tables are read-only")
         except TableNotFound:
             pass
+        if self.metric_engine.is_logical(db, name):
+            # logical metric table: route through the metric engine's
+            # multiplexing write (physical region + __metric__ tag)
+            info = self.catalog.get_table(db, name)
+            _columns, data = insert_rows_to_columns(
+                stmt, info.schema, self.timezone)
+            tags = [c.name for c in info.schema if c.is_tag]
+            cols = dict(data)
+            cols["__tags__"] = [t for t in tags if t in cols]
+            cols["__fields__"] = ["val"]
+            n = self.metric_engine.write(name, cols, db)
+            return QueryResult([], [], affected_rows=n)
         regions = self._regions_of(stmt.table)
         schema = regions[0].schema
         columns, data = insert_rows_to_columns(stmt, schema, self.timezone)
@@ -1237,8 +1292,10 @@ class GreptimeDB(TableProvider):
         from greptimedb_tpu.query.ast import BinaryOp, Column, Literal
 
         eq: dict[str, object] = {}
+        general = False
 
         def visit(e):
+            nonlocal general
             if isinstance(e, BinaryOp) and e.op == "AND":
                 visit(e.left)
                 visit(e.right)
@@ -1250,16 +1307,18 @@ class GreptimeDB(TableProvider):
             ):
                 eq[ctx.resolve(e.left.name)] = e.right.value
             else:
-                raise Unsupported(
-                    "DELETE supports tag=value AND ts=value conjunctions"
-                )
+                general = True  # arbitrary predicate: resolve via a scan
 
         if stmt.where is None:
             raise Unsupported("DELETE without WHERE (use TRUNCATE)")
         visit(stmt.where)
         ts_name = region.schema.time_index.name
-        if ts_name not in eq:
-            raise Unsupported("DELETE needs ts = <value>")
+        if general or ts_name not in eq:
+            # general predicate (or key-only conjunction): resolve the
+            # matching (primary key, ts) rows through the query engine,
+            # then tombstone each — the reference reaches the same via
+            # DataFusion resolving the WHERE into delete keys
+            return self._delete_by_scan(stmt, regions, ctx, ts_name)
         data = {k: [ctx.ts_literal(v) if k == ts_name else v] for k, v in eq.items()}
         if len(regions) == 1:
             region.delete(data)
@@ -1274,6 +1333,37 @@ class GreptimeDB(TableProvider):
             for pidx in parts:
                 regions[pidx].delete(data)
         return QueryResult([], [], affected_rows=1)
+
+    def _delete_by_scan(self, stmt, regions, ctx, ts_name) -> QueryResult:
+        """DELETE with an arbitrary WHERE: select the matching
+        (tags…, ts) keys, then issue key-exact tombstones."""
+        from greptimedb_tpu.query.ast import Column, Select, SelectItem
+
+        tag_names = [c.name for c in regions[0].schema.tag_columns]
+        cols = tag_names + [ts_name]
+        sel = Select(
+            items=[SelectItem(Column(c)) for c in cols],
+            table=stmt.table,
+            where=stmt.where,
+        )
+        res = self.engine.execute_select(sel)
+        if not res.rows:
+            return QueryResult([], [], affected_rows=0)
+        data = {c: [row[i] for row in res.rows]
+                for i, c in enumerate(cols)}
+        if len(regions) == 1:
+            regions[0].delete(data)
+        else:
+            from greptimedb_tpu.parallel.partition import split_rows
+
+            rule = self._partition_rule(stmt.table)
+            cols_np = {c: np.asarray(v, dtype=object)
+                       for c, v in data.items()}
+            parts = split_rows(rule, cols_np, len(res.rows))
+            for pidx, idx in parts.items():
+                regions[pidx].delete(
+                    {c: [data[c][i] for i in idx] for c in cols})
+        return QueryResult([], [], affected_rows=len(res.rows))
 
     # ---- COPY TO/FROM ---------------------------------------------------
     def _copy(self, stmt) -> QueryResult:

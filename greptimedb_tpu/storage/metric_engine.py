@@ -47,8 +47,8 @@ class MetricEngine:
         self.db = db
 
     # ---- physical table ------------------------------------------------
-    def physical_region(self):
-        dbname = self.db.current_db
+    def physical_region(self, dbname: str | None = None):
+        dbname = dbname or self.db.current_db
         if not self.db.catalog.table_exists(dbname, PHYSICAL_TABLE):
             info = self.db.catalog.create_table(
                 dbname, PHYSICAL_TABLE, physical_schema(),
@@ -61,15 +61,16 @@ class MetricEngine:
         return self.db._open_or_create(info.region_ids[0], info.schema)
 
     # ---- logical tables ------------------------------------------------
-    def ensure_logical(self, metric: str, tag_names: list[str]) -> None:
+    def ensure_logical(self, metric: str, tag_names: list[str],
+                       dbname: str | None = None) -> None:
         """Register/extend a logical table and grow the physical label set."""
-        region = self.physical_region()
+        region = self.physical_region(dbname)
         for t in tag_names:
             if t == METRIC_COLUMN:
                 raise InvalidArguments(f"{METRIC_COLUMN} is reserved")
             if not region.schema.has_column(t):
                 region.add_tag_column(t)
-        dbname = self.db.current_db
+        dbname = dbname or self.db.current_db
         cols = [ColumnSchema(t, ConcreteDataType.STRING, SemanticType.TAG)
                 for t in tag_names]
         cols.append(ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND,
@@ -108,11 +109,12 @@ class MetricEngine:
             if grown:
                 self.db.catalog.update_table(info)
 
-    def write(self, metric: str, cols: dict) -> int:
+    def write(self, metric: str, cols: dict,
+              dbname: str | None = None) -> int:
         """Route one metric's batch into the physical region."""
         tag_names = list(cols.get("__tags__") or [])
-        self.ensure_logical(metric, tag_names)
-        region = self.physical_region()
+        self.ensure_logical(metric, tag_names, dbname)
+        region = self.physical_region(dbname)
         n = len(cols["ts"])
         data = {METRIC_COLUMN: [metric] * n, "ts": cols["ts"],
                 "val": cols["val"]}

@@ -42,4 +42,6 @@ def tmp_data_dir(tmp_path):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "golden: golden-file SQL/TQL corpus")
+    config.addinivalue_line(
+        "markers", "golden_dist: distributed re-run of the golden corpus")
     config.addinivalue_line("markers", "fuzz: randomized DDL/insert/query fuzzing")

@@ -1,0 +1,8 @@
+CREATE TABLE m (host STRING, ts TIMESTAMP(3) TIME INDEX, cpu DOUBLE, PRIMARY KEY (host));
+CREATE TABLE meta (host STRING, ts TIMESTAMP(3) TIME INDEX, dc STRING, w DOUBLE, PRIMARY KEY (host));
+INSERT INTO m VALUES ('a',1000,10.0),('a',2000,20.0),('b',1000,30.0),('c',1000,40.0);
+INSERT INTO meta VALUES ('a',0,'us',1.0),('b',0,'eu',2.0),('z',0,'ap',9.0);
+SELECT m.host, meta.dc, count(*) FROM m RIGHT JOIN meta ON m.host = meta.host GROUP BY m.host, meta.dc ORDER BY meta.dc;
+SELECT m.host, meta.dc, count(*) FROM m FULL JOIN meta ON m.host = meta.host GROUP BY m.host, meta.dc ORDER BY m.host, meta.dc;
+SELECT m.cpu, meta.w FROM m FULL OUTER JOIN meta ON m.host = meta.host ORDER BY m.host, meta.dc;
+SELECT m.host, meta.dc FROM m LEFT OUTER JOIN meta ON m.host = meta.host ORDER BY m.host, m.ts

@@ -1,0 +1,8 @@
+CREATE TABLE pods (h STRING, svc STRING, ts TIMESTAMP(3) TIME INDEX, up DOUBLE, PRIMARY KEY (h, svc));
+CREATE TABLE inc (h STRING, svc STRING, ts TIMESTAMP(3) TIME INDEX, sev DOUBLE, PRIMARY KEY (h, svc));
+INSERT INTO pods VALUES ('a','web',1000,1.0),('a','db',1000,1.0),('b','web',1000,1.0),('c','db',1000,1.0);
+INSERT INTO inc VALUES ('a','web',1000,3.0),('c','db',2000,5.0),('b','db',2000,1.0);
+SELECT h, svc FROM pods WHERE EXISTS (SELECT 1 FROM inc WHERE inc.h = pods.h AND inc.svc = pods.svc) ORDER BY h, svc;
+SELECT h, svc FROM pods WHERE NOT EXISTS (SELECT 1 FROM inc WHERE inc.h = pods.h AND inc.svc = pods.svc) ORDER BY h, svc;
+SELECT h, svc FROM pods WHERE EXISTS (SELECT 1 FROM inc WHERE inc.h = pods.h AND inc.svc = pods.svc AND sev > 4) ORDER BY h;
+SELECT count(*) FROM pods WHERE EXISTS (SELECT 1 FROM inc)

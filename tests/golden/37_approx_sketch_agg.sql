@@ -1,0 +1,6 @@
+CREATE TABLE s (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO s VALUES ('a',1000,1.0),('a',2000,2.0),('a',3000,3.0),('b',1000,1.0),('b',2000,1.0);
+SELECT h, approx_distinct(v) FROM s GROUP BY h ORDER BY h;
+SELECT approx_distinct(v) FROM s;
+SELECT h, uddsketch_calc(0.5, uddsketch_state(64, 0.05, v)) FROM s GROUP BY h ORDER BY h;
+SELECT hll_count(hll(v)) FROM s

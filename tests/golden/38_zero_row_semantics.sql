@@ -1,0 +1,6 @@
+CREATE TABLE z (h STRING, ts TIMESTAMP(3) TIME INDEX, vi BIGINT, vf DOUBLE, PRIMARY KEY (h));
+INSERT INTO z VALUES ('a',1000,5,1.5),('b',2000,7,2.5);
+SELECT count(*), sum(vi), sum(vf), min(vi), max(vf), avg(vf) FROM z WHERE vf > 100;
+SELECT count(*), sum(vi), sum(vf) FROM z;
+SELECT h, count(*) FROM z WHERE vf > 100 GROUP BY h;
+SELECT count(*) FROM z WHERE h = 'nope'

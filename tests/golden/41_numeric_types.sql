@@ -1,0 +1,6 @@
+CREATE TABLE nt (h STRING, ts TIMESTAMP(3) TIME INDEX, a TINYINT, b SMALLINT, c INT, d BIGINT, e FLOAT, f DOUBLE, g BOOLEAN, PRIMARY KEY (h));
+INSERT INTO nt VALUES ('x',1000,1,2,3,4,1.5,2.5,true),('y',2000,-1,-2,-3,-4,-1.5,-2.5,false);
+SELECT * FROM nt ORDER BY h;
+SELECT sum(a), sum(b), sum(c), sum(d), sum(e), sum(f) FROM nt;
+SELECT h FROM nt WHERE g ORDER BY h;
+DESCRIBE TABLE nt

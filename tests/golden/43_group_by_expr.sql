@@ -1,0 +1,6 @@
+CREATE TABLE g (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO g VALUES ('a',1000,1.0),('a',61000,2.0),('b',1000,3.0),('b',121000,4.0);
+SELECT date_trunc('minute', ts) AS m, count(*) FROM g GROUP BY m ORDER BY m;
+SELECT h, date_bin('1 minute', ts) AS b, sum(v) FROM g GROUP BY h, b ORDER BY h, b;
+SELECT upper(h) AS H, sum(v) FROM g GROUP BY H ORDER BY H;
+SELECT length(h) AS n, count(*) FROM g GROUP BY n ORDER BY n

@@ -1,0 +1,6 @@
+CREATE TABLE hv (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO hv VALUES ('a',1000,1.0),('a',2000,2.0),('b',1000,10.0),('c',1000,5.0),('c',2000,5.0),('c',3000,5.0);
+SELECT h, count(*) AS c FROM hv GROUP BY h HAVING c > 1 ORDER BY h;
+SELECT h, sum(v) AS s FROM hv GROUP BY h HAVING s >= 10 AND count(*) >= 1 ORDER BY h;
+SELECT h, avg(v) FROM hv GROUP BY h HAVING avg(v) > 2 ORDER BY h;
+SELECT h, max(v) - min(v) AS range_v FROM hv GROUP BY h HAVING max(v) - min(v) = 0 ORDER BY h

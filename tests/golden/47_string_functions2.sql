@@ -1,0 +1,10 @@
+CREATE TABLE sf (h STRING, ts TIMESTAMP(3) TIME INDEX, msg STRING, PRIMARY KEY (h));
+INSERT INTO sf VALUES ('a',1000,'Hello World'),('b',2000,'  pad  '),('c',3000,'abc,def,ghi');
+SELECT replace(msg, 'World', 'TPU') FROM sf WHERE h = 'a';
+SELECT trim(msg) FROM sf WHERE h = 'b';
+SELECT split_part(msg, ',', 2) FROM sf WHERE h = 'c';
+SELECT substr(msg, 1, 5) FROM sf WHERE h = 'a';
+SELECT concat(h, ':', msg) FROM sf ORDER BY h;
+SELECT reverse(h) FROM sf ORDER BY h;
+SELECT position('World' IN msg) FROM sf WHERE h = 'a';
+SELECT left(msg, 5), right(msg, 5) FROM sf WHERE h = 'a'

@@ -1,0 +1,7 @@
+CREATE TABLE qt (pod STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (pod));
+INSERT INTO qt VALUES ('p',10000,1.0),('p',20000,2.0),('p',30000,3.0),('p',40000,4.0),('p',50000,5.0);
+TQL EVAL (40, 40, '60') quantile_over_time(0.5, qt[40]);
+TQL EVAL (40, 40, '60') mad_over_time(qt[40]);
+TQL EVAL (40, 40, '60') double_exponential_smoothing(qt[40], 0.5, 0.3);
+TQL EVAL (40, 40, '60') quantile_over_time(1.5, qt[40]);
+TQL EVAL (50, 50, '60') last_over_time(qt[30])

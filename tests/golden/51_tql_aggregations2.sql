@@ -1,0 +1,8 @@
+CREATE TABLE m2 (pod STRING, dc STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (pod, dc));
+INSERT INTO m2 VALUES ('p1','us',10000,1.0),('p2','us',10000,3.0),('p3','eu',10000,5.0);
+TQL EVAL (10, 10, '60') sum by (dc) (m2);
+TQL EVAL (10, 10, '60') count by (dc) (m2);
+TQL EVAL (10, 10, '60') topk(2, m2);
+TQL EVAL (10, 10, '60') quantile(0.5, m2);
+TQL EVAL (10, 10, '60') sum without (pod) (m2);
+TQL EVAL (10, 10, '60') avg(m2)

@@ -1,0 +1,8 @@
+CREATE TABLE tf (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO tf VALUES ('a',1700000000000,1.0),('a',1700003600000,2.0);
+SELECT to_unixtime(ts) FROM tf ORDER BY ts;
+SELECT date_format(ts, '%Y-%m-%d %H:%M:%S') FROM tf ORDER BY ts;
+SELECT extract(hour FROM ts) FROM tf ORDER BY ts;
+SELECT date_part('minute', ts) FROM tf ORDER BY ts;
+SELECT ts + INTERVAL '1 hour' FROM tf ORDER BY ts;
+SELECT date_trunc('day', ts) FROM tf ORDER BY ts

@@ -1,0 +1,9 @@
+CREATE TABLE df (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO df VALUES ('a',1700000000000,1.0),('a',1702592000000,2.0),('b',1672531200000,3.0);
+SELECT date_trunc('month', ts) FROM df ORDER BY ts;
+SELECT date_trunc('year', ts) FROM df ORDER BY ts;
+SELECT date_trunc('week', ts) FROM df ORDER BY ts;
+SELECT date_part('year', ts), date_part('month', ts), date_part('day', ts) FROM df ORDER BY ts;
+SELECT date_part('dow', ts), date_part('doy', ts) FROM df ORDER BY ts;
+SELECT extract(quarter FROM ts) FROM df ORDER BY ts;
+SELECT date_format(ts, '%Y-%m-%dT%H:%M:%S') FROM df ORDER BY ts

@@ -1,0 +1,5 @@
+CREATE TABLE gm (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO gm VALUES ('a',1698796800000,1.0),('a',1700000000000,2.0),('a',1701388800000,4.0),('b',1701388800000,8.0);
+SELECT date_trunc('month', ts) AS m, sum(v) FROM gm GROUP BY m ORDER BY m;
+SELECT h, date_trunc('month', ts) AS m, count(*) FROM gm GROUP BY h, m ORDER BY h, m;
+SELECT date_part('month', ts) AS mo, sum(v) FROM gm GROUP BY mo ORDER BY mo

@@ -1,0 +1,7 @@
+CREATE TABLE ek (h STRING, dc STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h, dc));
+INSERT INTO ek VALUES ('api','us',1000,1.0),('API','eu',2000,2.0),('web','us',3000,3.0),('web','eu',4000,4.0);
+SELECT upper(h) AS H, sum(v), count(*) FROM ek GROUP BY H ORDER BY H;
+SELECT length(h) AS n, count(*) FROM ek GROUP BY n ORDER BY n;
+SELECT concat(h, '/', dc) AS k, max(v) FROM ek GROUP BY k ORDER BY k;
+SELECT upper(h) AS H, first_value(v), last_value(v) FROM ek GROUP BY H ORDER BY H;
+SELECT lower(dc) AS d, approx_distinct(v) FROM ek GROUP BY d ORDER BY d

@@ -1,0 +1,6 @@
+CREATE TABLE nf (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, s STRING, PRIMARY KEY (h));
+INSERT INTO nf VALUES ('a',1000,1.0,'x'),('b',2000,NULL,NULL),('c',3000,3.0,NULL);
+SELECT h, coalesce(v, 0), coalesce(s, 'dflt') FROM nf ORDER BY h;
+SELECT h, greatest(v, 2.0), least(v, 2.0) FROM nf ORDER BY h;
+SELECT h, nvl(v, -1) FROM nf ORDER BY h;
+SELECT coalesce(NULL, NULL, 7) FROM nf WHERE h = 'a'

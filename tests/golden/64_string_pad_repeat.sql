@@ -1,0 +1,6 @@
+CREATE TABLE sp (h STRING, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (h));
+INSERT INTO sp VALUES ('ab',1000),('xyz',2000);
+SELECT lpad(h, 5, '.'), rpad(h, 5, '.') FROM sp ORDER BY h;
+SELECT repeat(h, 2) FROM sp ORDER BY h;
+SELECT starts_with(h, 'a'), ends_with(h, 'z') FROM sp ORDER BY h;
+SELECT strpos(h, 'b') FROM sp ORDER BY h

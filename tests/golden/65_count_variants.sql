@@ -1,0 +1,5 @@
+CREATE TABLE cv (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, s STRING, PRIMARY KEY (h));
+INSERT INTO cv VALUES ('a',1000,1.0,'x'),('a',2000,NULL,'y'),('b',3000,2.0,NULL);
+SELECT count(*), count(v), count(s), count(h) FROM cv;
+SELECT h, count(*), count(v) FROM cv GROUP BY h ORDER BY h;
+SELECT count(DISTINCT h), count(DISTINCT v) FROM cv

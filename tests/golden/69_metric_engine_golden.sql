@@ -1,0 +1,5 @@
+CREATE TABLE phy (ts TIMESTAMP(3) TIME INDEX, val DOUBLE) ENGINE = metric WITH (physical_metric_table = 'true');
+CREATE TABLE m1 (app STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (app)) ENGINE = metric WITH (on_physical_table = 'phy');
+INSERT INTO m1 VALUES ('web',1000,1.5),('db',2000,2.5);
+SELECT app, val FROM m1 ORDER BY app;
+SELECT count(*) FROM m1

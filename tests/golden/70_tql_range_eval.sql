@@ -1,0 +1,6 @@
+CREATE TABLE tr (pod STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (pod));
+INSERT INTO tr VALUES ('p',0,0.0),('p',15000,15.0),('p',30000,30.0),('p',45000,45.0),('p',60000,60.0);
+TQL EVAL (0, 60, '15') tr;
+TQL EVAL (30, 60, '30') rate(tr[30]);
+TQL EVAL (60, 60, '60') avg_over_time(tr[60]);
+TQL EVAL (60, 60, '60') deriv(tr[60])

@@ -1,0 +1,7 @@
+CREATE TABLE ae (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, i BIGINT, PRIMARY KEY (h));
+INSERT INTO ae VALUES ('a',1000,10.0,7),('b',2000,0.0,0);
+SELECT v / i FROM ae ORDER BY h;
+SELECT i % 3 FROM ae ORDER BY h;
+SELECT v * -1, abs(v * -1) FROM ae ORDER BY h;
+SELECT round(v / 3, 2) FROM ae WHERE h = 'a';
+SELECT power(i, 2), sqrt(v) FROM ae ORDER BY h

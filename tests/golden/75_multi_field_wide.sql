@@ -1,0 +1,5 @@
+CREATE TABLE mw (h STRING, ts TIMESTAMP(3) TIME INDEX, c0 DOUBLE, c1 DOUBLE, c2 DOUBLE, c3 DOUBLE, c4 DOUBLE, PRIMARY KEY (h));
+INSERT INTO mw VALUES ('a',1000,1,2,3,4,5),('a',2000,2,3,4,5,6),('b',1000,10,20,30,40,50);
+SELECT h, avg(c0), avg(c1), avg(c2), avg(c3), avg(c4) FROM mw GROUP BY h ORDER BY h;
+SELECT h, sum(c0) + sum(c4) FROM mw GROUP BY h ORDER BY h;
+SELECT max(c0), max(c1), max(c2), max(c3), max(c4) FROM mw

@@ -1,0 +1,7 @@
+CREATE TABLE pa (host STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION ON COLUMNS (host) (host < 'm', host >= 'm');
+INSERT INTO pa VALUES ('a',1000,1.0),('a',2000,3.0),('z',1000,10.0),('z',2000,20.0),('b',1000,5.0);
+SELECT host, avg(v), count(*), max(v) FROM pa GROUP BY host ORDER BY host;
+SELECT count(*), sum(v) FROM pa;
+SELECT host, first_value(v), last_value(v) FROM pa GROUP BY host ORDER BY host;
+SELECT host, approx_distinct(v) FROM pa GROUP BY host ORDER BY host;
+SELECT host, v FROM pa WHERE v > 4 ORDER BY host, ts
