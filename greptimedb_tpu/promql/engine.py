@@ -607,7 +607,8 @@ class PromEvaluator:
         if isinstance(e, BinaryExpr):
             return self.eval_binary(e)
         if isinstance(e, SubqueryExpr):
-            raise Unsupported("subqueries not yet implemented")
+            raise Unsupported(
+                "bare subquery needs an *_over_time function")
         raise Unsupported(f"promql node {type(e).__name__}")
 
     # ---- functions --------------------------------------------------------
@@ -674,6 +675,13 @@ class PromEvaluator:
                     if m.op == "=" and m.name != "__field__"
                 }
             return EvalResult(v[None, :], [lab])
+        if f in self._SUBQ_REDUCERS:
+            sel_i = 1 if f == "quantile_over_time" else 0
+            arg = e.args[sel_i] if len(e.args) > sel_i else None
+            if isinstance(arg, SubqueryExpr):
+                q = (self.eval(e.args[0]).values[0]
+                     if f == "quantile_over_time" else None)
+                return self._eval_subquery_window(f, arg, q)
         if f in ("rate", "increase", "delta"):
             sel = self._selector_arg(e, 0)
             out, labels = self._run_window(sel, "counter")
@@ -793,6 +801,115 @@ class PromEvaluator:
             vals, labels = self._run_matrix(sel, "holt", (sf, tf))
             return EvalResult(vals, labels)
         raise Unsupported(f"promql function {f}")
+
+    # *_over_time reducers applicable to a subquery window matrix
+    _SUBQ_REDUCERS = {
+        "avg_over_time", "sum_over_time", "min_over_time", "max_over_time",
+        "count_over_time", "last_over_time", "first_over_time",
+        "stddev_over_time", "stdvar_over_time", "present_over_time",
+        "quantile_over_time", "mad_over_time",
+    }
+
+    def _eval_subquery_window(self, f: str, sq: SubqueryExpr,
+                              q=None) -> EvalResult:
+        """fn_over_time(expr[range:step]) — PromQL subqueries: evaluate
+        the inner expression on the sub-step grid covering
+        (start − range, end], then reduce each outer step's window of
+        inner evaluations (reference src/promql/src/planner.rs subquery
+        lowering; Prometheus aligns inner steps to absolute multiples of
+        the sub-step)."""
+        range_ms = int(sq.range_s * 1000)
+        sub_ms = max(int((sq.step_s or self.step_ms / 1000.0) * 1000), 1)
+        offset_ms = int(sq.offset_s * 1000)
+        end_ms = (self.start_ms - offset_ms
+                  + self.step_ms * (self.num_steps - 1))
+        lo_ms = self.start_ms - offset_ms - range_ms
+        # inner grid: absolute multiples of sub_ms in (lo, end]
+        t0 = (lo_ms // sub_ms + 1) * sub_ms
+        if t0 > end_ms:
+            t0 = end_ms
+        inner = PromEvaluator(
+            self.db, t0 / 1000.0, end_ms / 1000.0, sub_ms / 1000.0,
+            self.lookback_ms / 1000.0)
+        res = inner.eval(sq.expr)
+        vals = res.values  # [S, TI]
+        if vals.shape[0] == 0:
+            return EvalResult(
+                jnp.zeros((0, self.num_steps), jnp.float32), [])
+        ti = vals.shape[1]
+        K = range_ms // sub_ms + 1
+        steps = (self.start_ms - offset_ms
+                 + self.step_ms * np.arange(self.num_steps, dtype=np.int64))
+        j_lo = (steps - range_ms - t0) // sub_ms + 1  # first j inside
+        k = np.arange(K, dtype=np.int64)
+        idx = j_lo[:, None] + k[None, :]  # [T, K]
+        in_win = (idx >= 0) & (idx < ti) & (
+            (t0 + idx * sub_ms) <= steps[:, None])
+        idxc = jnp.asarray(np.clip(idx, 0, max(ti - 1, 0)))
+        win = vals[:, idxc]  # [S, T, K]
+        m = jnp.asarray(in_win)[None, :, :] & ~jnp.isnan(win)
+        cnt = m.sum(axis=-1)
+        has = cnt > 0
+        nan = jnp.float32(jnp.nan)
+        z = jnp.where(m, win, 0.0)
+        if f == "sum_over_time":
+            out = jnp.where(has, z.sum(-1), nan)
+        elif f == "count_over_time":
+            out = jnp.where(has, cnt.astype(jnp.float32), nan)
+        elif f == "present_over_time":
+            out = jnp.where(has, 1.0, nan)
+        elif f == "avg_over_time":
+            out = jnp.where(has, z.sum(-1) / jnp.maximum(cnt, 1), nan)
+        elif f in ("stddev_over_time", "stdvar_over_time"):
+            mean = z.sum(-1) / jnp.maximum(cnt, 1)
+            var = (jnp.where(m, (win - mean[..., None]) ** 2, 0.0).sum(-1)
+                   / jnp.maximum(cnt, 1))
+            out = jnp.where(
+                has, jnp.sqrt(var) if f == "stddev_over_time" else var, nan)
+        elif f == "min_over_time":
+            out = jnp.where(
+                has, jnp.where(m, win, jnp.inf).min(-1), nan)
+        elif f == "max_over_time":
+            out = jnp.where(
+                has, jnp.where(m, win, -jnp.inf).max(-1), nan)
+        elif f in ("last_over_time", "first_over_time"):
+            # index of the last/first valid sub-evaluation in the window
+            ks = jnp.arange(K)
+            if f == "last_over_time":
+                pick = jnp.where(m, ks, -1).max(-1)
+            else:
+                pick = jnp.where(m, ks, K).min(-1)
+            pickc = jnp.clip(pick, 0, K - 1)
+            out = jnp.where(
+                has, jnp.take_along_axis(win, pickc[..., None], -1)[..., 0],
+                nan)
+        elif f in ("quantile_over_time", "mad_over_time"):
+            srt = jnp.sort(jnp.where(m, win, jnp.inf), axis=-1)
+
+            def q_of(sorted_w, qq):
+                rank = qq * jnp.maximum(cnt - 1, 0).astype(jnp.float32)
+                lo_r = jnp.clip(jnp.floor(rank).astype(jnp.int32), 0, K - 1)
+                hi_r = jnp.clip(jnp.ceil(rank).astype(jnp.int32), 0, K - 1)
+                vlo = jnp.take_along_axis(sorted_w, lo_r[..., None], -1)[..., 0]
+                vhi = jnp.take_along_axis(sorted_w, hi_r[..., None], -1)[..., 0]
+                return vlo + (vhi - vlo) * (rank - lo_r.astype(jnp.float32))
+
+            if f == "quantile_over_time":
+                qv = jnp.broadcast_to(
+                    jnp.asarray(q, jnp.float32)[None, :], cnt.shape)
+                out = q_of(srt, qv)
+                out = jnp.where(qv < 0, -jnp.inf,
+                                jnp.where(qv > 1, jnp.inf, out))
+            else:
+                med = q_of(srt, jnp.float32(0.5))
+                dev = jnp.sort(
+                    jnp.where(m, jnp.abs(win - med[..., None]), jnp.inf),
+                    axis=-1)
+                out = q_of(dev, jnp.float32(0.5))
+            out = jnp.where(has, out, nan)
+        else:  # pragma: no cover — guarded by _SUBQ_REDUCERS
+            raise Unsupported(f"{f} over subquery")
+        return EvalResult(out.astype(jnp.float32), res.labels)
 
     def _selector_arg(self, e: FunctionCall, i: int, want_range: bool = True) -> VectorSelector:
         a = e.args[i]

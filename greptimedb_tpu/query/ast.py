@@ -249,6 +249,8 @@ class Select(Statement):
     align_by: list[Expr] = field(default_factory=list)
     range_: IntervalLit | None = None
     fill: str | None = None
+    # FROM (SELECT …) [alias] — derived table; table carries the alias
+    from_subquery: "Select | None" = None
 
 
 def _map_child(v, fn):
@@ -420,6 +422,7 @@ class Insert(Statement):
     table: str
     columns: list[str]
     rows: list[list[object]]
+    select: "Select | None" = None  # INSERT INTO … SELECT …
 
 
 @dataclass
@@ -472,6 +475,17 @@ class DropView(Statement):
 class ShowTables(Statement):
     database: str | None = None
     like: str | None = None
+    full: bool = False  # SHOW FULL TABLES: adds Table_type
+
+
+@dataclass
+class ShowColumns(Statement):
+    table: str = ""
+
+
+@dataclass
+class ShowIndex(Statement):
+    table: str = ""
 
 
 @dataclass

@@ -379,7 +379,12 @@ class QueryEngine:
             return None
         ts_name = (ctx.schema.time_index.name
                    if ctx.schema.time_index is not None else None)
-        pplan = split_partial(sel, ts_column=ts_name)
+        # HAVING applies AFTER the host fold (its aggregates must be
+        # projected outputs so the merged columns carry them)
+        having = sel.having
+        split_sel = (dataclasses.replace(sel, having=None)
+                     if having is not None else sel)
+        pplan = split_partial(split_sel, ts_column=ts_name)
         if pplan is None:
             return None
         tag_names = {c.name for c in ctx.schema.tag_columns}
@@ -438,6 +443,18 @@ class QueryEngine:
             else:
                 part[alias] = [row[idx[alias]] for row in res.rows]
         names, rows = merge_partials(pplan, [part])
+        if having is not None and rows:
+            envh = {
+                nme: np.array([r[i] for r in rows], dtype=object)
+                for i, nme in enumerate(names)
+            }
+            try:
+                keep = np.broadcast_to(np.asarray(
+                    eval_host(having, envh, len(rows)), dtype=bool),
+                    (len(rows),))
+            except Exception:  # noqa: BLE001 — non-projected agg: refuse
+                return None
+            rows = [r for r, k in zip(rows, keep) if k]
         return self._finish_merged(sel, plan, names, rows)
 
     @staticmethod

@@ -666,6 +666,58 @@ def compile_device(e: Expr, ctx: TableContext):
                     return res if eq else ~res
 
                 return fn
+        # --- dictionary-encoded string FIELD comparisons -------------
+        # string fields ride the DeviceTable's ad-hoc dictionaries
+        # (table_dicts, set by the executor); =/!=/LIKE/regex lower to
+        # code-set membership exactly like tags — the predicate runs
+        # over the VOCABULARY once, then an isin over codes
+        if tag_side is None and op in ("=", "!=", "LIKE", "ILIKE",
+                                       "~", "!~"):
+            field_side = other_f = None
+            for side, oth in ((e.left, e.right), (e.right, e.left)):
+                if (isinstance(side, Column)
+                        and isinstance(oth, Literal)
+                        and isinstance(oth.value, str)
+                        and not ctx.is_tag(side.name)):
+                    try:
+                        cs = ctx.schema.column(ctx.resolve(side.name))
+                    except Exception:  # noqa: BLE001
+                        cs = None
+                    if cs is not None and cs.dtype.is_string_like:
+                        field_side, other_f = side, oth
+                        break
+            if field_side is not None:
+                real = ctx.resolve(field_side.name)
+                vocab = getattr(ctx, "table_dicts", {}).get(real)
+                if vocab is None:
+                    raise Unsupported(
+                        f"string field {real}: comparison needs the "
+                        "resident dictionary (row path only)")
+                if op in ("=", "!="):
+                    pred = lambda v, w=other_f.value: str(v) == w  # noqa: E731
+                elif op in ("LIKE", "ILIKE"):
+                    rx = re.compile(
+                        _like_to_regex(other_f.value),
+                        re.IGNORECASE if op == "ILIKE" else 0)
+                    pred = lambda v, rx=rx: rx.match(str(v)) is not None  # noqa: E731
+                else:
+                    rx = re.compile(other_f.value)
+                    pred = lambda v, rx=rx: rx.search(str(v)) is not None  # noqa: E731
+                codes = np.array(
+                    [i for i, v in enumerate(vocab) if pred(v)],
+                    dtype=np.int32)
+                negate = op in ("!=", "!~")
+
+                def fn(env, codes=codes, real=real, negate=negate):
+                    col = env[real]
+                    hit = (
+                        jnp.zeros(col.shape, bool)
+                        if codes.size == 0
+                        else jnp.isin(col, jnp.asarray(codes))
+                    )
+                    return (~hit & (col >= 0)) if negate else hit
+
+                return fn
         # --- time-index comparisons with string timestamps ---
         ts_side = None
         if isinstance(e.left, Column) and ctx.is_ts(e.left.name):
@@ -1088,6 +1140,10 @@ def eval_host(e: Expr, env: dict[str, np.ndarray], n: int):
         if e.name in ("power", "pow"):
             return np.power(np.asarray(args[0], dtype=float),
                             np.asarray(args[1], dtype=float))
+        if e.name == "clamp":
+            return np.clip(np.asarray(args[0], dtype=float),
+                           np.asarray(args[1], dtype=float),
+                           np.asarray(args[2], dtype=float))
         if e.name in _HOST_FUNCS:
             return _HOST_FUNCS[e.name](args, n)
         if e.name in FT_FUNCS:

@@ -285,9 +285,18 @@ class Parser:
         while self.eat(Tok.PUNCT, ","):
             items.append(self.select_item())
         table = alias = None
+        from_subquery = None
         joins: list[JoinClause] = []
         if self.eat_kw("FROM"):
-            table = self.qualified_name()
+            if self.at(Tok.PUNCT, "("):
+                # derived table: FROM (SELECT …) [AS] alias — the alias
+                # becomes the staged table name (qualified refs resolve)
+                self.next()
+                from_subquery = self.select()
+                self.expect(Tok.PUNCT, ")")
+                table = "__subquery__"
+            else:
+                table = self.qualified_name()
             if self.peek().kind is Tok.IDENT and not self.at_kw(
                 "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "ALIGN",
                 "UNION", "JOIN", "LEFT", "RIGHT", "FULL", "INNER", "ON", "AS",
@@ -295,6 +304,8 @@ class Parser:
                 alias = self.ident()
             elif self.eat_kw("AS"):
                 alias = self.ident()
+            if from_subquery is not None and alias is not None:
+                table, alias = alias, None
             while self.at_kw("JOIN", "INNER", "LEFT", "RIGHT", "FULL"):
                 kind = "inner"
                 if self.eat_kw("LEFT"):
@@ -357,7 +368,7 @@ class Parser:
             where=where,
             group_by=group_by, having=having, order_by=order_by, limit=limit,
             offset=offset, distinct=distinct, align=align, align_by=align_by,
-            fill=fill, range_=range_,
+            fill=fill, range_=range_, from_subquery=from_subquery,
         )
 
     def select_item(self) -> SelectItem:
@@ -961,6 +972,9 @@ class Parser:
             while self.eat(Tok.PUNCT, ","):
                 columns.append(self.ident())
             self.expect(Tok.PUNCT, ")")
+        if self.at_kw("SELECT"):
+            # INSERT INTO t [(cols)] SELECT … (reference insert-select)
+            return Insert(table, columns, [], select=self.select())
         self.expect_kw("VALUES")
         rows: list[list[object]] = []
         while True:
@@ -1062,6 +1076,12 @@ class Parser:
             if self.eat_kw("LIKE"):
                 like = self.expect(Tok.STRING).text
             return ShowDatabases(like)
+        full = False
+        nxt1 = self.peek(1)
+        if (self.at_kw("FULL") and nxt1.kind is Tok.IDENT
+                and nxt1.upper == "TABLES"):
+            self.next()
+            full = True
         if self.eat_kw("TABLES"):
             db = None
             like = None
@@ -1069,7 +1089,17 @@ class Parser:
                 db = self.ident()
             if self.eat_kw("LIKE"):
                 like = self.expect(Tok.STRING).text
-            return ShowTables(db, like)
+            return ShowTables(db, like, full)
+        if self.eat_kw("COLUMNS", "FIELDS"):
+            from greptimedb_tpu.query.ast import ShowColumns
+
+            self.expect_kw("FROM")
+            return ShowColumns(self.qualified_name())
+        if self.eat_kw("INDEX", "INDEXES", "KEYS"):
+            from greptimedb_tpu.query.ast import ShowIndex
+
+            self.expect_kw("FROM")
+            return ShowIndex(self.qualified_name())
         if self.eat_kw("FLOWS"):
             return ShowFlows()
         if self.eat_kw("CREATE"):

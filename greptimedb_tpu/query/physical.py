@@ -106,7 +106,11 @@ def _vec_fingerprint(plan, table) -> int:
     monotonic dicts_version (O(1)) so a rebuilt/extended table never
     reuses a kernel compiled against stale dictionaries."""
     fp = plan.fingerprint()
-    if "vec_" not in fp and "matches" not in fp and "_merge" not in fp:
+    if ("vec_" not in fp and "matches" not in fp and "_merge" not in fp
+            and "'" not in fp):
+        # the quote check is conservative: ANY string literal in the plan
+        # may have compiled against a string-FIELD dictionary (LIKE/=
+        # over table_dicts) — version-key those too
         return 0
     return getattr(table, "dicts_version", 0)
 

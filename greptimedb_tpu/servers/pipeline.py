@@ -384,6 +384,164 @@ class UrlEncodingProcessor(Processor):
         return row
 
 
+_ANSI_RE = re.compile(r"\x1b\[[0-9;]*m")
+
+# digest presets (reference etl/processor/digest.rs:80-86, same regexes)
+_DIGEST_PRESETS = {
+    "numbers": r"\d+",
+    "quoted": r"[\"'“”‘’][^\"'“”‘’]*[\"'“”‘’]",
+    "bracketed": (r"[({\[<「『【〔［｛〈《]"
+                  r"[^(){}\[\]<>「」『』【】〔〕［］｛｝〈〉《》]*"
+                  r"[)}\]>」』】〕］｝〉》]"),
+    "uuid": (r"\b[0-9a-fA-F]{8}\b-[0-9a-fA-F]{4}\b-[0-9a-fA-F]{4}\b-"
+             r"[0-9a-fA-F]{4}\b-[0-9a-fA-F]{12}\b"),
+    "ip": r"((\d{1,3}\.){3}\d{1,3}(:\d+)?|(\[[0-9a-fA-F:]+\])(:\d+)?)",
+}
+
+
+@dataclass
+class DecolorizeProcessor(Processor):
+    """Strip ANSI color escapes (reference decolorize.rs — Loki's
+    decolorize / VRL strip_ansi_escape_codes)."""
+
+    fields: list[str]
+
+    def apply(self, row):
+        for f in self.fields:
+            v = row.get(f)
+            if isinstance(v, str):
+                row[f] = _ANSI_RE.sub("", v)
+        return row
+
+
+@dataclass
+class DigestProcessor(Processor):
+    """Template-ize a log line by removing variable parts — the digest
+    lands in ``<field>_digest`` for occurrence counting / similarity
+    (reference digest.rs: presets numbers/quoted/bracketed/uuid/ip plus
+    custom regex).  Patterns are pre-compiled at build time (hot ingest
+    path; bad user regexes fail the pipeline save, not every row)."""
+
+    fields: list[str]
+    patterns: list["re.Pattern"]
+
+    def apply(self, row):
+        for f in self.fields:
+            v = row.get(f)
+            if isinstance(v, str):
+                out = v
+                for p in self.patterns:
+                    out = p.sub("", out)
+                row[f + "_digest"] = out
+        return row
+
+
+@dataclass
+class SelectProcessor(Processor):
+    """Keep (include) or drop (exclude) the listed fields
+    (reference select.rs)."""
+
+    fields: list[str]
+    type_: str = "include"
+
+    def apply(self, row):
+        if self.type_ == "exclude":
+            for f in self.fields:
+                row.pop(f, None)
+            return row
+        keep = set(self.fields)
+        for k in list(row.keys()):
+            if k not in keep:
+                del row[k]
+        return row
+
+
+@dataclass
+class SimpleExtractProcessor(Processor):
+    """Pull a nested JSON value out by dotted key path into the target
+    field (reference simple_extract.rs — the cheap json_path)."""
+
+    fields: list[str]
+    targets: list[str]
+    key: str
+
+    def apply(self, row):
+        path = [p for p in self.key.split(".") if p]
+        for f, target in zip(self.fields, self.targets):
+            cur = row.get(f)
+            if isinstance(cur, str):
+                try:
+                    cur = json.loads(cur)
+                except ValueError:
+                    cur = None
+            for part in path:
+                if not isinstance(cur, dict):
+                    cur = None
+                    break
+                cur = cur.get(part)
+            row[target] = cur
+        return row
+
+
+@dataclass
+class JoinProcessor(Processor):
+    """Join an array value into one string (reference join.rs)."""
+
+    fields: list[str]
+    separator: str = ","
+
+    def apply(self, row):
+        for f in self.fields:
+            v = row.get(f)
+            if isinstance(v, (list, tuple)):
+                row[f] = self.separator.join(str(x) for x in v)
+        return row
+
+
+# CMCD keys by decoded type (reference cmcd.rs CMCD_KEYS dispatch)
+_CMCD_BOOL = {"bs", "su"}
+_CMCD_INT = {"br", "bl", "d", "dl", "mtp", "rtp", "tb"}
+_CMCD_STR = {"cid", "nrr", "ot", "sf", "sid", "st", "v"}
+
+
+@dataclass
+class CmcdProcessor(Processor):
+    """Parse CMCD (Common Media Client Data, CTA-5004) key-value pairs
+    into ``<field>_<key>`` outputs (reference cmcd.rs): bs/su are
+    valueless booleans, br…tb integers, cid…v strings (quotes
+    stripped), nor percent-decoded, pr float."""
+
+    fields: list[str]
+    ignore_missing: bool = True
+
+    def apply(self, row):
+        for f in self.fields:
+            v = row.get(f)
+            if v is None:
+                if self.ignore_missing:
+                    continue
+                raise InvalidArguments(f"cmcd: missing field {f}")
+            for part in str(v).split(","):
+                k, _, val = part.partition("=")
+                k = k.strip()
+                out = f"{f}_{k}"
+                try:
+                    if k in _CMCD_BOOL:
+                        row[out] = True
+                    elif k in _CMCD_INT:
+                        row[out] = int(val)
+                    elif k == "pr":
+                        row[out] = float(val)
+                    elif k == "nor":
+                        row[out] = urllib.parse.unquote(val.strip('"'))
+                    elif k in _CMCD_STR:
+                        row[out] = val.strip('"')
+                except ValueError:
+                    raise InvalidArguments(
+                        f"cmcd: bad value {part!r} in {f}")
+        return row
+
+
 @dataclass
 class FilterProcessor(Processor):
     fields: list[str]
@@ -632,6 +790,26 @@ class _ScriptExpr:
         return va >= vb
 
 
+def _digest_patterns(cfg) -> list:
+    """Digest presets + custom regexes, validated and compiled at build
+    time — an unknown preset is a config error, not a silent no-op
+    (reference digest.rs DigestPatternInvalid)."""
+    pats = []
+    for p in cfg.get("presets") or []:
+        rx = _DIGEST_PRESETS.get(str(p))
+        if rx is None:
+            raise InvalidArguments(
+                f"digest: unknown preset {p!r} "
+                f"(supported: {sorted(_DIGEST_PRESETS)})")
+        pats.append(re.compile(rx))
+    for r in cfg.get("regex") or []:
+        try:
+            pats.append(re.compile(str(r)))
+        except re.error as exc:
+            raise InvalidArguments(f"digest: bad regex {r!r}: {exc}")
+    return pats
+
+
 _PROCESSORS = {
     "script": lambda c: ScriptProcessor(str(c.get("source", ""))),
     "vrl": lambda c: ScriptProcessor(str(c.get("source", ""))),
@@ -658,6 +836,19 @@ _PROCESSORS = {
     "filter": lambda c: FilterProcessor(
         _fields_of(c), str(c.get("mode", "include")),
         [str(m) for m in (c.get("match") or [])]),
+    "decolorize": lambda c: DecolorizeProcessor(_fields_of(c)),
+    "digest": lambda c: DigestProcessor(_fields_of(c), _digest_patterns(c)),
+    "select": lambda c: SelectProcessor(
+        _fields_of(c), str(c.get("type", "include"))),
+    "simple_extract": lambda c: SimpleExtractProcessor(
+        [str(x).split(",")[0].strip() for x in _fields_of(c)],
+        [(str(x).split(",") + [str(x)])[1].strip()
+         for x in _fields_of(c)],
+        str(c.get("key", ""))),
+    "join": lambda c: JoinProcessor(
+        _fields_of(c), str(c.get("separator", ","))),
+    "cmcd": lambda c: CmcdProcessor(
+        _fields_of(c), c.get("ignore_missing", True)),
 }
 
 

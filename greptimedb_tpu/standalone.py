@@ -738,6 +738,10 @@ class GreptimeDB(TableProvider):
                 return info.execute(self, sel)
             if info.is_pg_catalog(stmt.table):
                 return info.execute_pg_catalog(self, stmt)
+            if stmt.from_subquery is not None:
+                # before the information_schema bare-name rewrite: the
+                # derived table's alias is not a system table name
+                return self._execute_from_subquery(stmt)
             if (
                 stmt.table
                 and "." not in stmt.table
@@ -804,10 +808,48 @@ class GreptimeDB(TableProvider):
             if db == info.INFORMATION_SCHEMA:
                 rows = [[n] for n in sorted(info._TABLES)
                         if _like(n, stmt.like)]
+                if stmt.full:
+                    rows = [r + ["SYSTEM VIEW"] for r in rows]
             else:
-                rows = [[t.name] for t in self.catalog.list_tables(db)
-                        if _like(t.name, stmt.like)]
+                infos = [t for t in self.catalog.list_tables(db)
+                         if _like(t.name, stmt.like)]
+                if stmt.full:
+                    rows = [[t.name,
+                             "VIEW" if t.engine == "view" else "BASE TABLE"]
+                            for t in infos]
+                else:
+                    rows = [[t.name] for t in infos]
+            if stmt.full:
+                return QueryResult(["Tables", "Table_type"], rows)
             return QueryResult(["Tables"], rows)
+        from greptimedb_tpu.query.ast import ShowColumns, ShowIndex
+
+        if isinstance(stmt, ShowColumns):
+            # MySQL SHOW COLUMNS shape (reference show_columns,
+            # src/query/src/sql.rs)
+            view = self._table_view(stmt.table)
+            rows = []
+            for c in view.schema:
+                key = ("PRI" if c.is_tag
+                       else "TIME INDEX" if c.semantic is SemanticType.TIMESTAMP
+                       else "")
+                rows.append([c.name, c.dtype.value,
+                             "Yes" if c.nullable else "No", key])
+            return QueryResult(["Field", "Type", "Null", "Key"], rows)
+        if isinstance(stmt, ShowIndex):
+            view = self._table_view(stmt.table)
+            rows = []
+            seq = 1
+            for c in view.schema:
+                if c.is_tag:
+                    rows.append([stmt.table, "PRIMARY", seq, c.name,
+                                 "greptime-inverted-index-v1"])
+                    seq += 1
+                elif c.semantic is SemanticType.TIMESTAMP:
+                    rows.append([stmt.table, "TIME INDEX", 1, c.name, ""])
+            return QueryResult(
+                ["Table", "Key_name", "Seq_in_index", "Column_name",
+                 "Index_type"], rows)
         if isinstance(stmt, ShowCreateTable):
             return self._show_create(stmt)
         if isinstance(stmt, DescribeTable):
@@ -1010,29 +1052,59 @@ class GreptimeDB(TableProvider):
         the outer SELECT over it."""
         import dataclasses
 
+        inner_res = self._run_staged_inner(
+            lambda: self.execute_statement(
+                parse_sql(vinfo.options["definition"])[0]),
+            "view expansion")
+        staged = dataclasses.replace(
+            sel, table="__view__", table_alias=None,
+        )
+        return self._select_over_staged(staged, inner_res)
+
+    def _run_staged_inner(self, run, what: str):
+        """Depth-guarded inner evaluation shared by view expansion and
+        derived tables (one definition of the recursion bookkeeping)."""
         depth = getattr(self._proc_local, "view_depth", 0)
         if depth >= self._VIEW_DEPTH_LIMIT:
             raise PlanError(
-                f"view expansion exceeded depth {self._VIEW_DEPTH_LIMIT} "
-                "(recursive views?)")
+                f"{what} exceeded depth {self._VIEW_DEPTH_LIMIT}")
         self._proc_local.view_depth = depth + 1
         try:
-            inner_stmts = parse_sql(vinfo.options["definition"])
-            inner_res = self.execute_statement(inner_stmts[0])
+            return run()
         finally:
             self._proc_local.view_depth = depth
+
+    def _select_over_staged(self, staged_sel, inner_res) -> QueryResult:
+        """Stage a QueryResult into an ephemeral region and run the outer
+        select over it — the shared tail of view expansion and derived
+        tables."""
         from greptimedb_tpu.query.engine import (
             QueryEngine, SingleTableProvider,
         )
         from greptimedb_tpu.query.join import stage_result_region
 
         region = stage_result_region(inner_res)
-        staged = dataclasses.replace(
-            sel, table="__view__", table_alias=None,
-        )
         inner = QueryEngine(SingleTableProvider(region, self.timezone))
         inner.dispatch = self.execute_statement
-        return inner.execute_select(staged)
+        return inner.execute_select(staged_sel)
+
+    def _execute_from_subquery(self, sel) -> QueryResult:
+        """Derived table: FROM (SELECT …) [alias] — evaluate the inner
+        select through the full dispatch, stage its rows into an
+        ephemeral region (SAME machinery as view expansion,
+        query/join.stage_result_region), and run the outer select over
+        it.  The reference gets this from DataFusion's subquery planning
+        (src/query/src/planner.rs); here staging keeps the outer query
+        on the normal device path."""
+        if sel.joins:
+            raise Unsupported("derived tables cannot participate in JOIN")
+        import dataclasses
+
+        inner_res = self._run_staged_inner(
+            lambda: self.execute_statement(sel.from_subquery),
+            "subquery nesting")
+        return self._select_over_staged(
+            dataclasses.replace(sel, from_subquery=None), inner_res)
 
     def _drop_table(self, stmt: DropTable) -> QueryResult:
         from greptimedb_tpu.storage.metric_engine import PHYSICAL_TABLE
@@ -1233,6 +1305,16 @@ class GreptimeDB(TableProvider):
 
     # ---- DML -----------------------------------------------------------
     def _insert(self, stmt: Insert) -> QueryResult:
+        if stmt.select is not None:
+            # INSERT INTO … SELECT: evaluate through the full dispatch
+            # (views/information_schema work), then insert positionally
+            import dataclasses as _dc
+
+            res = self.execute_statement(stmt.select)
+            if not res.rows:
+                return QueryResult([], [], affected_rows=0)
+            return self._insert(_dc.replace(
+                stmt, rows=[list(r) for r in res.rows], select=None))
         db, name = self._split_name(stmt.table)
         try:
             if self.catalog.get_table(db, name).engine == "file":

@@ -1,0 +1,4 @@
+CREATE TABLE de (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h)) WITH (append_mode='true');
+DESCRIBE TABLE de;
+SELECT table_name, engine FROM information_schema.tables WHERE table_name = 'de';
+SELECT count(*) FROM information_schema.region_peers WHERE region_id >= 0

@@ -1,0 +1,7 @@
+CREATE TABLE ax (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, w DOUBLE, PRIMARY KEY (h));
+INSERT INTO ax VALUES ('a',1000,1.0,10.0),('a',2000,2.0,20.0),('b',1000,3.0,30.0);
+SELECT h, sum(v) + sum(w) FROM ax GROUP BY h ORDER BY h;
+SELECT h, max(v) - min(v) FROM ax GROUP BY h ORDER BY h;
+SELECT h, sum(v * w) FROM ax GROUP BY h ORDER BY h;
+SELECT h, sum(v) / count(*) FROM ax GROUP BY h ORDER BY h;
+SELECT round(avg(v + w), 1) FROM ax

@@ -1,0 +1,4 @@
+CREATE TABLE "Mixed" ("Host" STRING, ts TIMESTAMP(3) TIME INDEX, "Value" DOUBLE, PRIMARY KEY ("Host"));
+INSERT INTO "Mixed" VALUES ('a',1000,1.0);
+SELECT "Host", "Value" FROM "Mixed";
+SELECT count(*) FROM "Mixed"

@@ -1,0 +1,6 @@
+CREATE TABLE oa (pod STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (pod));
+INSERT INTO oa VALUES ('p',10000,1.0),('p',20000,2.0),('p',30000,3.0),('p',40000,4.0);
+TQL EVAL (40, 40, '60') oa;
+TQL EVAL (40, 40, '60') oa offset 10s;
+TQL EVAL (40, 40, '60') sum_over_time(oa[20] @ 30);
+TQL EVAL (20, 40, '10') oa

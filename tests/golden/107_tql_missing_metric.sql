@@ -1,0 +1,6 @@
+CREATE TABLE known (pod STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (pod));
+INSERT INTO known VALUES ('p',10000,1.0);
+TQL EVAL (10, 10, '60') no_such_metric;
+TQL EVAL (10, 10, '60') known + known;
+TQL EVAL (10, 10, '60') absent(no_such_metric);
+TQL EVAL (10, 10, '60') absent(known)

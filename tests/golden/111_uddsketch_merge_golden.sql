@@ -1,0 +1,6 @@
+CREATE TABLE us (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO us VALUES ('a',1000,1.0),('a',2000,2.0),('a',3000,4.0),('b',1000,8.0),('b',2000,16.0);
+CREATE TABLE states (h STRING, ts TIMESTAMP(3) TIME INDEX, st STRING, PRIMARY KEY (h)) WITH (append_mode='true');
+INSERT INTO states SELECT h, 1000, uddsketch_state(64, 0.05, v) FROM us GROUP BY h;
+SELECT round(uddsketch_calc(0.5, uddsketch_merge(st)) * 100) FROM states;
+SELECT h, round(uddsketch_calc(1.0, uddsketch_state(64, 0.05, v)) * 10) FROM us GROUP BY h ORDER BY h

@@ -1,0 +1,6 @@
+CREATE TABLE hl (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO hl VALUES ('a',1000,1.0),('a',2000,2.0),('b',1000,2.0),('b',2000,3.0);
+CREATE TABLE hstates (h STRING, ts TIMESTAMP(3) TIME INDEX, st STRING, PRIMARY KEY (h)) WITH (append_mode='true');
+INSERT INTO hstates SELECT h, 1000, hll(v) FROM hl GROUP BY h;
+SELECT hll_count(hll_merge(st)) FROM hstates;
+SELECT h, hll_count(hll(v)) FROM hl GROUP BY h ORDER BY h

@@ -1,0 +1,4 @@
+CREATE TABLE an (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO an VALUES ('a',1000,1.0),('a',2000,1.1),('a',3000,0.9),('a',4000,1.0),('a',5000,10.0);
+SELECT h, ts, v FROM an WHERE v > 5 ORDER BY ts;
+SELECT max(v) / avg(v) > 3 FROM an

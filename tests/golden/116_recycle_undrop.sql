@@ -1,0 +1,9 @@
+CREATE TABLE rb (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rb VALUES ('a',1000,1.0),('b',2000,2.0);
+DROP TABLE rb;
+SELECT table_name FROM information_schema.recycle_bin;
+ADMIN undrop_table('rb');
+SELECT h, v FROM rb ORDER BY h;
+DROP TABLE rb;
+ADMIN purge_recycle_bin();
+SELECT count(*) FROM information_schema.recycle_bin

@@ -1,0 +1,5 @@
+CREATE TABLE vs (id STRING, ts TIMESTAMP(3) TIME INDEX, emb VECTOR(4), PRIMARY KEY (id));
+INSERT INTO vs VALUES ('a',1000,'[1,0,0,0]'),('b',2000,'[0,1,0,0]'),('c',3000,'[0.9,0.1,0,0]');
+SELECT id, round(vec_cos_distance(emb, '[1,0,0,0]') * 1000) AS d FROM vs ORDER BY d LIMIT 2;
+SELECT id FROM vs ORDER BY vec_l2sq_distance(emb, '[0,1,0,0]') LIMIT 1;
+SELECT id, vec_dot_product(emb, '[1,1,0,0]') FROM vs ORDER BY id

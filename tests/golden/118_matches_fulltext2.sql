@@ -1,0 +1,6 @@
+CREATE TABLE ft2 (svc STRING, ts TIMESTAMP(3) TIME INDEX, msg STRING, PRIMARY KEY (svc)) WITH (append_mode='true');
+INSERT INTO ft2 VALUES ('a',1,'connection refused to db'),('a',2,'connection ok'),('a',3,'timeout waiting for db');
+SELECT msg FROM ft2 WHERE matches(msg, 'connection') ORDER BY ts;
+SELECT msg FROM ft2 WHERE matches(msg, 'db AND timeout');
+SELECT msg FROM ft2 WHERE matches_term(msg, 'refused');
+SELECT count(*) FROM ft2 WHERE matches(msg, 'connection OR timeout')

@@ -1,0 +1,7 @@
+CREATE TABLE pf (pod STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (pod));
+INSERT INTO pf VALUES ('p',10000,4.0),('p',20000,9.0),('p',30000,16.0);
+TQL EVAL (30, 30, '60') sqrt(pf);
+TQL EVAL (30, 30, '60') ln(pf);
+TQL EVAL (30, 30, '60') ceil(pf / 5);
+TQL EVAL (30, 30, '60') floor(pf / 5);
+TQL EVAL (30, 30, '60') sgn(pf - 9)

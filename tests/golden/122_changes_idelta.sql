@@ -1,0 +1,6 @@
+CREATE TABLE ci (pod STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (pod));
+INSERT INTO ci VALUES ('p',10000,1.0),('p',20000,1.0),('p',30000,5.0),('p',40000,2.0);
+TQL EVAL (40, 40, '60') changes(ci[40]);
+TQL EVAL (40, 40, '60') resets(ci[40]);
+TQL EVAL (40, 40, '60') idelta(ci[40]);
+TQL EVAL (40, 40, '60') delta(ci[40])

@@ -1,0 +1,6 @@
+CREATE TABLE op2 (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO op2 VALUES ('a',1000,1.0),('b',2000,5.0),('c',3000,9.0);
+SELECT h FROM op2 WHERE h = 'a' OR v > 7 ORDER BY h;
+SELECT h FROM op2 WHERE (h = 'a' OR h = 'b') AND v < 3;
+SELECT h FROM op2 WHERE NOT (h = 'a' OR h = 'b');
+SELECT count(*) FROM op2 WHERE v < 2 OR v > 2

@@ -1,0 +1,5 @@
+CREATE TABLE af (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, w DOUBLE, PRIMARY KEY (h));
+INSERT INTO af VALUES ('a',1000,1.0,NULL),('a',2000,NULL,20.0),('b',1000,3.0,30.0);
+SELECT h, count(v), count(w), sum(v), sum(w) FROM af GROUP BY h ORDER BY h;
+SELECT avg(v), avg(w) FROM af;
+SELECT h, min(v), max(w) FROM af GROUP BY h ORDER BY h

@@ -1,0 +1,6 @@
+CREATE TABLE te (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO te VALUES ('',1000,1.0),('with space',2000,2.0),('quote''s',3000,3.0);
+SELECT h, v FROM te ORDER BY ts;
+SELECT count(*) FROM te WHERE h = '';
+SELECT v FROM te WHERE h = 'with space';
+SELECT v FROM te WHERE h = 'quote''s'

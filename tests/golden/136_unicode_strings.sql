@@ -1,0 +1,6 @@
+CREATE TABLE un (h STRING, ts TIMESTAMP(3) TIME INDEX, msg STRING, PRIMARY KEY (h));
+INSERT INTO un VALUES ('a',1000,'héllo wörld'),('b',2000,'数据库测试'),('c',3000,'emoji 🚀 here');
+SELECT msg FROM un ORDER BY h;
+SELECT length(msg) FROM un ORDER BY h;
+SELECT upper(msg) FROM un WHERE h = 'a';
+SELECT count(*) FROM un WHERE msg LIKE '%世%' OR msg LIKE '%测%'

@@ -1,0 +1,4 @@
+CREATE TABLE psq (pod STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (pod));
+INSERT INTO psq VALUES ('p',10000,1.0),('p',20000,3.0),('p',30000,6.0),('p',40000,10.0);
+TQL EVAL (40, 40, '60') max_over_time(rate(psq[20])[40:10]);
+TQL EVAL (40, 40, '60') avg_over_time(psq[30:10])

@@ -1,0 +1,5 @@
+CREATE TABLE mf (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO mf VALUES ('a',1000,1.0),('a',2000,3.0),('b',1000,5.0);
+SELECT h, stddev(v), var(v) FROM mf GROUP BY h ORDER BY h;
+SELECT stddev_pop(v), var_pop(v) FROM mf;
+SELECT h, avg(v), count(*), sum(v) / count(*) FROM mf GROUP BY h ORDER BY h

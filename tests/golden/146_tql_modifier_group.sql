@@ -1,0 +1,7 @@
+CREATE TABLE tm (pod STRING, env STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (pod, env));
+INSERT INTO tm VALUES ('p1','prod',10000,1.0),('p2','prod',10000,2.0),('p1','dev',10000,4.0);
+TQL EVAL (10, 10, '60') sum by (env) (tm);
+TQL EVAL (10, 10, '60') max without (env) (tm);
+TQL EVAL (10, 10, '60') count(tm);
+TQL EVAL (10, 10, '60') bottomk(1, tm);
+TQL EVAL (10, 10, '60') group by (env) (tm)

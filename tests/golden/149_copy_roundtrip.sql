@@ -1,0 +1,6 @@
+CREATE TABLE cp (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO cp VALUES ('a',1000,1.0),('b',2000,2.0);
+COPY cp TO '/tmp/golden_cp_out.parquet';
+CREATE TABLE cp2 (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+COPY cp2 FROM '/tmp/golden_cp_out.parquet';
+SELECT h, v FROM cp2 ORDER BY h

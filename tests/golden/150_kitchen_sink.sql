@@ -1,0 +1,7 @@
+CREATE TABLE ks (region STRING, svc STRING, ts TIMESTAMP(3) TIME INDEX, lat DOUBLE, err BOOLEAN, PRIMARY KEY (region, svc));
+INSERT INTO ks VALUES ('us','api',1000,12.0,false),('us','api',61000,18.0,true),('us','web',1000,25.0,false),('eu','api',1000,30.0,false),('eu','web',61000,45.0,true);
+SELECT region, svc, date_trunc('minute', ts) AS m, avg(lat), count(*) FROM ks GROUP BY region, svc, m ORDER BY region, svc, m;
+SELECT region, count(*) FROM ks WHERE err GROUP BY region ORDER BY region;
+SELECT upper(region) AS R, max(lat) FROM ks GROUP BY R HAVING max(lat) > 20 ORDER BY R;
+SELECT svc, approx_distinct(lat) FROM ks GROUP BY svc ORDER BY svc;
+SELECT region, svc FROM ks WHERE lat BETWEEN 20 AND 40 AND NOT err ORDER BY region, svc

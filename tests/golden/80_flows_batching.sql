@@ -1,0 +1,7 @@
+CREATE TABLE src (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+CREATE FLOW f_sum SINK TO agg_out AS SELECT h, date_trunc('minute', ts) AS m, sum(v) FROM src GROUP BY h, m;
+INSERT INTO src VALUES ('a',1000,1.0),('a',2000,2.0),('b',61000,4.0);
+SELECT * FROM agg_out ORDER BY h, m;
+SHOW FLOWS;
+DROP FLOW f_sum;
+SHOW FLOWS

@@ -1,0 +1,6 @@
+CREATE TABLE ad (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO ad VALUES ('a',1000,1.0);
+ADMIN flush_table('ad');
+ADMIN compact_table('ad');
+SELECT count(*) FROM ad;
+ADMIN reconcile_table('ad')

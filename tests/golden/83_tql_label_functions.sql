@@ -1,0 +1,7 @@
+CREATE TABLE lm (pod STRING, env STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (pod, env));
+INSERT INTO lm VALUES ('p1','prod',10000,1.0),('p2','dev',10000,2.0);
+TQL EVAL (10, 10, '60') label_replace(lm, 'svc', '$1', 'pod', '(p.)');
+TQL EVAL (10, 10, '60') label_join(lm, 'combined', '-', 'pod', 'env');
+TQL EVAL (10, 10, '60') lm{env="prod"};
+TQL EVAL (10, 10, '60') lm{env=~"p.*"};
+TQL EVAL (10, 10, '60') lm{env!="prod"}

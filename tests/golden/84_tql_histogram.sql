@@ -1,0 +1,4 @@
+CREATE TABLE hist_bucket (le STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (le));
+INSERT INTO hist_bucket VALUES ('0.1',10000,5.0),('0.5',10000,9.0),('1',10000,10.0),('+Inf',10000,10.0);
+TQL EVAL (10, 10, '60') histogram_quantile(0.9, hist_bucket);
+TQL EVAL (10, 10, '60') histogram_quantile(0.5, hist_bucket)

@@ -1,0 +1,9 @@
+CREATE TABLE sv (pod STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (pod));
+INSERT INTO sv VALUES ('p1',10000,5.0),('p2',10000,15.0);
+TQL EVAL (10, 10, '60') scalar(sum(sv));
+TQL EVAL (10, 10, '60') vector(42);
+TQL EVAL (10, 10, '60') clamp(sv, 6, 12);
+TQL EVAL (10, 10, '60') clamp_min(sv, 10);
+TQL EVAL (10, 10, '60') clamp_max(sv, 10);
+TQL EVAL (10, 10, '60') abs(-sv);
+TQL EVAL (10, 10, '60') round(sv / 4)

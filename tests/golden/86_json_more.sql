@@ -1,0 +1,7 @@
+CREATE TABLE js (h STRING, ts TIMESTAMP(3) TIME INDEX, doc STRING, PRIMARY KEY (h));
+INSERT INTO js VALUES ('a',1000,'{"user":{"id":7,"name":"ann"},"tags":[1,2]}'),('b',2000,'{"user":{"id":9}}');
+SELECT json_get_int(doc, '$.user.id') FROM js ORDER BY h;
+SELECT json_get_string(doc, '$.user.name') FROM js ORDER BY h;
+SELECT h, json_path_exists(doc, '$.user.name') FROM js ORDER BY h;
+SELECT json_get_float(doc, '$.tags[0]') FROM js WHERE h = 'a';
+SELECT json_is_object(doc) FROM js ORDER BY h

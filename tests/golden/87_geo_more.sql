@@ -1,0 +1,5 @@
+CREATE TABLE ge (h STRING, ts TIMESTAMP(3) TIME INDEX, lat DOUBLE, lon DOUBLE, PRIMARY KEY (h));
+INSERT INTO ge VALUES ('sf',1000,37.7749,-122.4194),('ny',2000,40.7128,-74.0060);
+SELECT geohash(lat, lon, 6) FROM ge ORDER BY h;
+SELECT wkt_point_from_latlng(lat, lon) FROM ge ORDER BY h;
+SELECT round(st_distance_sphere_m(wkt_point_from_latlng(37.7749, -122.4194), wkt_point_from_latlng(lat, lon)) / 1000) FROM ge ORDER BY h

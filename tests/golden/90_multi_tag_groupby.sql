@@ -1,0 +1,6 @@
+CREATE TABLE mt (a STRING, b STRING, c STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (a, b, c));
+INSERT INTO mt VALUES ('x','1','p',1000,1.0),('x','2','p',2000,2.0),('y','1','q',3000,4.0),('y','2','q',4000,8.0);
+SELECT a, b, c, sum(v) FROM mt GROUP BY a, b, c ORDER BY a, b;
+SELECT a, sum(v) FROM mt GROUP BY a ORDER BY a;
+SELECT b, count(*) FROM mt GROUP BY b ORDER BY b;
+SELECT a, c, max(v) FROM mt GROUP BY a, c ORDER BY a

@@ -1,0 +1,6 @@
+CREATE TABLE ng (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO ng VALUES ('a',-86400000,1.0),('b',0,2.0),('c',86400000,3.0);
+SELECT h, ts FROM ng ORDER BY ts;
+SELECT date_trunc('day', ts) FROM ng ORDER BY ts;
+SELECT count(*) FROM ng WHERE ts < 0;
+SELECT date_part('year', ts) FROM ng ORDER BY ts

@@ -1,0 +1,5 @@
+CREATE TABLE fl (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO fl VALUES ('a',3000,30.0),('a',1000,10.0),('a',2000,20.0),('b',5000,50.0),('b',4000,40.0);
+SELECT h, first_value(v), last_value(v) FROM fl GROUP BY h ORDER BY h;
+SELECT first_value(v), last_value(v) FROM fl;
+SELECT h, first_value(ts), last_value(ts) FROM fl GROUP BY h ORDER BY h

@@ -1,0 +1,6 @@
+CREATE TABLE lt (svc STRING, env STRING, ts TIMESTAMP(3) TIME INDEX, lat DOUBLE, PRIMARY KEY (svc, env));
+INSERT INTO lt VALUES ('api','prod',1000,12.5),('api','dev',2000,8.1),('web','prod',3000,30.0),('worker','prod',4000,5.5);
+SELECT svc, env, lat FROM lt WHERE svc LIKE 'w%' ORDER BY svc;
+SELECT svc, max(lat) FROM lt WHERE env = 'prod' GROUP BY svc ORDER BY max(lat) DESC;
+SELECT env, count(DISTINCT svc) FROM lt GROUP BY env ORDER BY env;
+SELECT svc FROM lt WHERE lat BETWEEN 8 AND 13 ORDER BY svc, env

@@ -1,0 +1,7 @@
+CREATE TABLE wm (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO wm VALUES ('a',1000,3.0),('a',2000,1.0),('a',3000,2.0),('b',1000,9.0);
+SELECT h, ts, dense_rank() OVER (ORDER BY v) FROM wm ORDER BY h, ts;
+SELECT h, ts, ntile(2) OVER (ORDER BY v) FROM wm ORDER BY h, ts;
+SELECT h, ts, lead(v) OVER (PARTITION BY h ORDER BY ts) FROM wm ORDER BY h, ts;
+SELECT h, ts, first_value(v) OVER (PARTITION BY h ORDER BY ts) FROM wm ORDER BY h, ts;
+SELECT h, ts, avg(v) OVER (PARTITION BY h ORDER BY ts) FROM wm ORDER BY h, ts

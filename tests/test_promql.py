@@ -467,3 +467,42 @@ class TestReviewRound2:
         make_counter(db, pods=("p1", "p2", "p3"), rates=(5.0, 10.0, 15.0))
         res = db.sql("TQL EVAL (300, 300, '60') quantile(2/4, rate(requests[5m]))")
         assert res.rows[0][-1] == pytest.approx(1.0, rel=1e-5)
+
+
+class TestPromqlSubqueries:
+    """fn_over_time(expr[range:step]) — PromQL subqueries (round-5;
+    reference src/promql/src/planner.rs subquery lowering)."""
+
+    def make(self, db):
+        db.sql("CREATE TABLE sq (pod STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "val DOUBLE, PRIMARY KEY (pod))")
+        r = db._region_of("sq")
+        import numpy as np
+
+        r.write({"pod": ["p"] * 4, "ts": np.arange(1, 5) * 10_000,
+                 "val": np.array([1.0, 3.0, 6.0, 10.0])})
+
+    def test_avg_over_subquery(self, db):
+        self.make(db)
+        # inner instant evals at t=20,30,40 within (10,40] → 3,6,10
+        r = db.sql("TQL EVAL (40, 40, '60') avg_over_time(sq[30:10])")
+        assert r.rows[0][-1] == pytest.approx(19 / 3, rel=1e-5)
+
+    def test_max_over_rate_subquery(self, db):
+        self.make(db)
+        r = db.sql("TQL EVAL (40, 40, '60') "
+                   "max_over_time(rate(sq[20])[40:10])")
+        assert r.rows[0][-1] == pytest.approx(0.4, rel=1e-4)
+
+    def test_quantile_and_count_over_subquery(self, db):
+        self.make(db)
+        r = db.sql("TQL EVAL (40, 40, '60') "
+                   "quantile_over_time(0.5, sq[30:10])")
+        assert r.rows[0][-1] == pytest.approx(6.0, rel=1e-6)
+        r2 = db.sql("TQL EVAL (40, 40, '60') count_over_time(sq[30:10])")
+        assert r2.rows[0][-1] == 3.0
+
+    def test_bare_subquery_refused(self, db):
+        self.make(db)
+        with pytest.raises(Unsupported):
+            db.sql("TQL EVAL (40, 40, '60') sq[30:10]")

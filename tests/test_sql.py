@@ -588,11 +588,11 @@ class TestProcessList:
         th_hold.join(5)
         assert errs, "queued victim should be cancelled on lock acquisition"
 
-    def test_show_full_tables_still_unsupported(self, cpu):
-        from greptimedb_tpu.errors import Unsupported
-
-        with pytest.raises(Unsupported):
-            cpu.sql("SHOW FULL TABLES")
+    def test_show_full_tables_and_processlist(self, cpu):
+        # SHOW FULL TABLES grew support in round 5 (golden 100); the
+        # FULL prefix must still route PROCESSLIST correctly
+        r = cpu.sql("SHOW FULL TABLES")
+        assert r.column_names == ["Tables", "Table_type"]
         assert cpu.sql("SHOW FULL PROCESSLIST").num_rows == 1
 
 
