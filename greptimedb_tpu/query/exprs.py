@@ -638,7 +638,12 @@ def compile_device(e: Expr, ctx: TableContext):
         tag_side = None
         if isinstance(e.left, Column) and ctx.is_tag(e.left.name):
             tag_side, other = e.left, e.right
-        elif isinstance(e.right, Column) and ctx.is_tag(e.right.name):
+        elif (isinstance(e.right, Column) and ctx.is_tag(e.right.name)
+              and op in ("=", "!=", "<>")):
+            # only COMMUTATIVE comparisons may take the tag from the
+            # right side: 'x%' LIKE tag means each tag value is the
+            # PATTERN — silently compiling it as tag LIKE 'x%' would
+            # swap subject and pattern (same rule as string fields)
             tag_side, other = e.right, e.left
         if tag_side is not None and op in ("=", "!=", "LIKE", "ILIKE", "~", "!~"):
             real = ctx.resolve(tag_side.name)

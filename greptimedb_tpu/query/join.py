@@ -184,6 +184,104 @@ def execute_join(engine, sel: Select):
             return "r"
         raise PlanError(f"unknown join column {col.name!r}")
 
+    # predicate pushdown (reference optimizer push_down_filter): WHERE
+    # conjuncts referencing exactly ONE side filter that side BEFORE the
+    # host matcher.  Sound for every join kind because the full WHERE
+    # re-applies after staging: an outer-join row whose partner was
+    # pre-filtered becomes (row, NULLs), and the same single-side
+    # predicate then evaluates NULL → dropped, exactly as if the partner
+    # had matched and failed the predicate.
+    from greptimedb_tpu.query.ast import (
+        Between, InList, IsNull, Literal as _Lit, UnaryOp,
+        split_conjuncts, walk_columns,
+    )
+    from greptimedb_tpu.query.exprs import eval_host
+
+    def _structural_ok(conj) -> bool:
+        """Deterministic, side-effect-free predicate shapes only."""
+        if isinstance(conj, IsNull):
+            return isinstance(conj.expr, Column)
+        if isinstance(conj, (Column, _Lit)):
+            return True
+        if isinstance(conj, UnaryOp):
+            return _structural_ok(conj.operand)
+        if isinstance(conj, BinaryOp):
+            return _structural_ok(conj.left) and _structural_ok(conj.right)
+        if isinstance(conj, Between):
+            return (_structural_ok(conj.expr) and _structural_ok(conj.low)
+                    and _structural_ok(conj.high))
+        if isinstance(conj, InList):
+            return _structural_ok(conj.expr) and all(
+                isinstance(i, _Lit) for i in conj.items)
+        return False  # FuncCall/Case/Cast/subqueries: don't reason about
+
+    def _miss_rejecting(conj, refs, schema_side) -> bool:
+        """True when the predicate evaluates FALSY on a MISS row.
+
+        This engine has no physical NULL: outer-join misses stage as
+        sentinels ('' strings, NaN floats, 0 ints — stage_side), and
+        the re-applied WHERE sees those, NOT SQL NULLs.  So the push
+        condition is empirical: evaluate the predicate on one sentinel
+        row; only predicates a miss cannot satisfy (w >= 2, dc = 'eu')
+        may pre-filter a NULL-producing side.  `w != 1` stays (NaN != 1
+        is True under IEEE), `x IS NULL` stays (the anti-join)."""
+        if not _structural_ok(conj):
+            return False
+        env = {}
+        for c in refs:
+            try:
+                cs = schema_side.column(c.name)
+            except Exception:  # noqa: BLE001
+                return False
+            if cs.is_tag or cs.dtype.is_string_like:
+                v = np.array([""], dtype=object)
+            elif cs.dtype.is_float:
+                v = np.array([np.nan])
+            else:
+                v = np.array([0], dtype=np.int64)
+            env[c.name] = v
+            env[str(c)] = v
+        try:
+            out = np.broadcast_to(
+                np.asarray(eval_host(conj, env, 1)), (1,))
+            return not bool(out[0])
+        except Exception:  # noqa: BLE001
+            return False
+
+    null_producing = {
+        "inner": set(), "left": {"r"}, "right": {"l"}, "full": {"l", "r"},
+    }[join.kind]
+
+    def _prefilter(side: str, cols: dict, schema_side) -> dict:
+        if sel.where is None or not cols:
+            return cols
+        n = len(next(iter(cols.values())))
+        mask = None
+        for conj in split_conjuncts(sel.where):
+            refs = walk_columns(conj)
+            try:
+                if not refs or any(side_of(c) != side for c in refs):
+                    continue
+                if side in null_producing and not _miss_rejecting(
+                        conj, refs, schema_side):
+                    continue
+                env = {c.name: cols[c.name] for c in refs}
+                for c in refs:  # qualified refs resolve too
+                    env[str(c)] = cols[c.name]
+                m = np.broadcast_to(
+                    np.asarray(eval_host(conj, env, n), dtype=bool), (n,))
+            except Exception:  # noqa: BLE001 — not host-evaluable: skip
+                continue
+            mask = m if mask is None else (mask & m)
+        if mask is None:
+            return cols
+        return {k: v[mask] for k, v in cols.items()}
+
+    lschema = provider.table_context(lt).schema
+    rschema = provider.table_context(rt).schema
+    lcols = _prefilter("l", lcols, lschema)
+    rcols = _prefilter("r", rcols, rschema)
+
     lkeys, rkeys = [], []
     for c1, c2 in _equi_pairs(join.on):
         s1, s2 = side_of(c1), side_of(c2)
@@ -215,8 +313,6 @@ def execute_join(engine, sel: Select):
     li, ri = merge_join(lkeys, rkeys, kind=join.kind, max_rows=max_rows)
 
     # ---- stage the joined columns into an ephemeral in-memory region ----
-    lschema = provider.table_context(lt).schema
-    rschema = provider.table_context(rt).schema
     lnames = _names_for(list(lcols), set(rcols), la)
     rnames = _names_for(list(rcols), set(lcols), ra)
 

@@ -1659,3 +1659,92 @@ class TestVectorScaleGuard:
         r = db.sql("SELECT id FROM vg ORDER BY "
                    "vec_cos_distance(emb, '[1,0]') LIMIT 1")
         assert r.rows == [["a"]]
+
+
+class TestJoinPredicatePushdown:
+    """Single-side WHERE conjuncts pre-filter the scans before host
+    matching (reference push_down_filter).  NULL-satisfiable predicates
+    must NOT push into a NULL-producing outer-join side (anti-join)."""
+
+    @pytest.fixture
+    def jdb(self, db):
+        db.sql("CREATE TABLE m2 (host STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " cpu DOUBLE, PRIMARY KEY (host))")
+        db.sql("CREATE TABLE meta2 (host STRING, ts TIMESTAMP(3) "
+               "TIME INDEX, dc STRING, w DOUBLE, PRIMARY KEY (host))")
+        db.sql("INSERT INTO m2 VALUES ('a',1000,10.0),('a',2000,20.0),"
+               "('b',1000,30.0),('c',1000,40.0)")
+        db.sql("INSERT INTO meta2 VALUES ('a',0,'us',1.0),"
+               "('b',0,'eu',2.0)")
+        return db
+
+    def test_inner_pushdown_same_result(self, jdb):
+        r = jdb.sql("SELECT m2.host, meta2.dc FROM m2 JOIN meta2 "
+                    "ON m2.host = meta2.host "
+                    "WHERE m2.cpu > 15 AND meta2.dc = 'eu' ORDER BY m2.host")
+        assert r.rows == [["b", "eu"]]
+
+    def test_left_join_right_side_predicate(self, jdb):
+        # null-rejecting right predicate pushes; (l, NULL) rows then fail
+        # the re-applied WHERE exactly like unmatched-and-filtered rows
+        r = jdb.sql("SELECT m2.host FROM m2 LEFT JOIN meta2 "
+                    "ON m2.host = meta2.host WHERE meta2.w >= 2 "
+                    "ORDER BY m2.host")
+        assert r.rows == [["b"]]
+
+    def test_anti_join_is_null_not_pushed(self, jdb):
+        # classic anti-join: IS NULL is satisfied by the NULL-filled miss
+        # row, so it must NOT pre-filter the right side.  Float columns
+        # NULL-fill as NaN (string misses stage as '' by the engine's
+        # device-NULL convention, so the float column is the detector).
+        r = jdb.sql("SELECT m2.host FROM m2 LEFT JOIN meta2 "
+                    "ON m2.host = meta2.host WHERE meta2.w IS NULL "
+                    "ORDER BY m2.host")
+        assert [row[0] for row in r.rows] == ["c"]
+
+    def test_full_join_predicates_not_pushed_unless_rejecting(self, jdb):
+        r = jdb.sql("SELECT m2.host, meta2.dc FROM m2 FULL JOIN meta2 "
+                    "ON m2.host = meta2.host WHERE meta2.w = 1 "
+                    "ORDER BY m2.host")
+        assert r.rows == [["a", "us"], ["a", "us"]]
+
+
+class TestPushdownMissSemantics:
+    """Review regressions: pushdown must preserve the engine's own
+    miss-row semantics (sentinels, not SQL NULLs)."""
+
+    @pytest.fixture
+    def jdb(self, db):
+        db.sql("CREATE TABLE m3 (host STRING, ts TIMESTAMP(3) TIME INDEX,"
+               " cpu DOUBLE, PRIMARY KEY (host))")
+        db.sql("CREATE TABLE meta3 (host STRING, ts TIMESTAMP(3) "
+               "TIME INDEX, dc STRING, w DOUBLE, PRIMARY KEY (host))")
+        db.sql("INSERT INTO m3 VALUES ('a',1000,10.0),('b',1000,30.0),"
+               "('c',1000,40.0)")
+        db.sql("INSERT INTO meta3 VALUES ('a',0,'us',1.0),"
+               "('b',0,'eu',2.0)")
+        return db
+
+    def test_neq_on_right_side_not_pushed(self, jdb):
+        # NaN != 1 is True under IEEE: a matched-and-dropped row must not
+        # reappear as a NULL-filled miss via pushdown.  Engine semantics:
+        # 'a' (w=1) dropped; 'b' (w=2) kept; 'c' (miss, NaN) kept.
+        r = jdb.sql("SELECT m3.host FROM m3 LEFT JOIN meta3 "
+                    "ON m3.host = meta3.host WHERE meta3.w != 1 "
+                    "ORDER BY m3.host")
+        assert [row[0] for row in r.rows] == ["b", "c"]
+
+    def test_string_neq_not_pushed(self, jdb):
+        # '' != 'us' is True: same trap through the string sentinel
+        r = jdb.sql("SELECT m3.host FROM m3 LEFT JOIN meta3 "
+                    "ON m3.host = meta3.host WHERE meta3.dc != 'us' "
+                    "ORDER BY m3.host")
+        assert [row[0] for row in r.rows] == ["b", "c"]
+
+    def test_tag_literal_on_left_like_refused(self, jdb):
+        from greptimedb_tpu.errors import GreptimeError
+
+        # 'prod%' LIKE host would swap subject and pattern — refuse
+        # loudly rather than silently matching host LIKE 'prod%'
+        with pytest.raises(GreptimeError):
+            jdb.sql("SELECT host FROM m3 WHERE 'prod%' LIKE host")
