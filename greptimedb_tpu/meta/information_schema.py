@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import time
 
-from greptimedb_tpu.errors import TableNotFound
+from greptimedb_tpu.errors import TableNotFound, Unsupported
 from greptimedb_tpu.query.ast import Select
 from greptimedb_tpu.query.engine import QueryResult
 from greptimedb_tpu.query.virtual import execute_virtual_select
@@ -27,8 +27,32 @@ def execute(db, sel: Select) -> QueryResult:
     builder = _TABLES.get(name)
     if builder is None:
         raise TableNotFound(f"information_schema.{name}")
+    if sel.joins:
+        # neither the host mini-engine nor the staging fallback can join
+        # (the staged provider maps every name to one region) — loud
+        raise Unsupported("JOIN over system tables")
     columns, types = builder(db)
-    return execute_virtual_select(sel, columns, types)
+    try:
+        return execute_virtual_select(sel, columns, types)
+    except Unsupported:
+        # beyond the host mini-engine (GROUP BY, non-count aggregates,
+        # expressions of aggregates): stage the virtual table as rows
+        # and run through the REAL engine — system tables get the full
+        # SQL surface at staging cost (they are tiny enumerations)
+        stage = getattr(db, "_select_over_staged", None)
+        if stage is None:
+            raise
+        import dataclasses
+
+        names = list(columns.keys())
+        rows = ([list(r) for r in zip(*(columns[n] for n in names))]
+                if columns and names else [])
+        base = QueryResult(
+            names, rows,
+            column_types=[types.get(n, "String") for n in names]
+            if types else None)
+        return stage(
+            dataclasses.replace(sel, table="__virtual__"), base)
 
 
 def _columns_of(rows: list[dict], names: list[str]) -> dict[str, list]:

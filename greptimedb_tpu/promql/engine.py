@@ -682,6 +682,9 @@ class PromEvaluator:
                 q = (self.eval(e.args[0]).values[0]
                      if f == "quantile_over_time" else None)
                 return self._eval_subquery_window(f, arg, q)
+        if (f in ("rate", "increase", "delta", "irate", "idelta")
+                and e.args and isinstance(e.args[0], SubqueryExpr)):
+            return self._eval_subquery_counter(f, e.args[0])
         if f in ("rate", "increase", "delta"):
             sel = self._selector_arg(e, 0)
             out, labels = self._run_window(sel, "counter")
@@ -700,13 +703,9 @@ class PromEvaluator:
         if f in ("irate", "idelta"):
             sel = self._selector_arg(e, 0)
             out, labels = self._run_window(sel, "irate")
-            dt = (out["last_ts"] - out["prev_ts"]).astype(jnp.float32) / 1000.0
-            dv = out["last_val"] - out["prev_val"]
-            if f == "irate":
-                dv = jnp.where(dv < 0, out["last_val"], dv)  # counter reset
-                vals = jnp.where(dt > 0, dv / dt, jnp.nan)
-            else:
-                vals = jnp.where(dt > 0, dv, jnp.nan)
+            vals = _instant_pair(
+                f, out["last_ts"], out["prev_ts"],
+                out["last_val"], out["prev_val"])
             return EvalResult(vals, labels)
         if f in ("resets", "changes"):
             sel = self._selector_arg(e, 0)
@@ -810,14 +809,11 @@ class PromEvaluator:
         "quantile_over_time", "mad_over_time",
     }
 
-    def _eval_subquery_window(self, f: str, sq: SubqueryExpr,
-                              q=None) -> EvalResult:
-        """fn_over_time(expr[range:step]) — PromQL subqueries: evaluate
-        the inner expression on the sub-step grid covering
-        (start − range, end], then reduce each outer step's window of
-        inner evaluations (reference src/promql/src/planner.rs subquery
-        lowering; Prometheus aligns inner steps to absolute multiples of
-        the sub-step)."""
+    def _subquery_matrix(self, sq: SubqueryExpr):
+        """Shared window-matrix construction for subquery evaluation:
+        inner expr evaluated on the sub-step grid, gathered into
+        [S, T, K] windows.  Returns (win, mask, ts_tk [T, K] ms,
+        steps [T] ms, labels) or None for an empty inner vector."""
         range_ms = int(sq.range_s * 1000)
         sub_ms = max(int((sq.step_s or self.step_ms / 1000.0) * 1000), 1)
         offset_ms = int(sq.offset_s * 1000)
@@ -834,8 +830,7 @@ class PromEvaluator:
         res = inner.eval(sq.expr)
         vals = res.values  # [S, TI]
         if vals.shape[0] == 0:
-            return EvalResult(
-                jnp.zeros((0, self.num_steps), jnp.float32), [])
+            return None
         ti = vals.shape[1]
         K = range_ms // sub_ms + 1
         steps = (self.start_ms - offset_ms
@@ -843,11 +838,84 @@ class PromEvaluator:
         j_lo = (steps - range_ms - t0) // sub_ms + 1  # first j inside
         k = np.arange(K, dtype=np.int64)
         idx = j_lo[:, None] + k[None, :]  # [T, K]
-        in_win = (idx >= 0) & (idx < ti) & (
-            (t0 + idx * sub_ms) <= steps[:, None])
+        ts_tk = t0 + idx * sub_ms
+        in_win = (idx >= 0) & (idx < ti) & (ts_tk <= steps[:, None])
         idxc = jnp.asarray(np.clip(idx, 0, max(ti - 1, 0)))
         win = vals[:, idxc]  # [S, T, K]
         m = jnp.asarray(in_win)[None, :, :] & ~jnp.isnan(win)
+        return win, m, ts_tk, steps, res.labels
+
+    def _eval_subquery_counter(self, f: str, sq: SubqueryExpr) -> EvalResult:
+        """rate/increase/delta/irate/idelta over a subquery matrix: the
+        'samples' are the inner evaluations; counter-reset adjustment
+        scans the window axis (fori over K — K is small), then the SAME
+        _extrapolated as the selector path finishes rate/increase."""
+        mat = self._subquery_matrix(sq)
+        if mat is None:
+            return EvalResult(
+                jnp.zeros((0, self.num_steps), jnp.float32), [])
+        win, m, ts_tk, steps, labels = mat
+        S = win.shape[0]
+        K = win.shape[2]
+        ks = jnp.arange(K)
+        cnt = m.sum(axis=-1)
+        first_k = jnp.where(m, ks, K).min(-1)
+        last_k = jnp.where(m, ks, -1).max(-1)
+        fkc = jnp.clip(first_k, 0, K - 1)
+        lkc = jnp.clip(last_k, 0, K - 1)
+        fv = jnp.take_along_axis(win, fkc[..., None], -1)[..., 0]
+        lv = jnp.take_along_axis(win, lkc[..., None], -1)[..., 0]
+        ts_b = jnp.broadcast_to(
+            jnp.asarray(ts_tk)[None, :, :], win.shape)
+        ft = jnp.take_along_axis(ts_b, fkc[..., None], -1)[..., 0]
+        lt = jnp.take_along_axis(ts_b, lkc[..., None], -1)[..., 0]
+
+        if f in ("irate", "idelta"):
+            prev_k = jnp.where(m & (ks < last_k[..., None]), ks, -1).max(-1)
+            pkc = jnp.clip(prev_k, 0, K - 1)
+            pv = jnp.take_along_axis(win, pkc[..., None], -1)[..., 0]
+            pt = jnp.take_along_axis(ts_b, pkc[..., None], -1)[..., 0]
+            vals = _instant_pair(f, lt, pt, lv, pv, guard=cnt >= 2)
+            return EvalResult(vals.astype(jnp.float32), labels)
+
+        def body(k, carry):
+            prev, has_prev, dropsum = carry
+            v = jax.lax.dynamic_slice_in_dim(win, k, 1, axis=2)[..., 0]
+            valid = jax.lax.dynamic_slice_in_dim(m, k, 1, axis=2)[..., 0]
+            reset = valid & has_prev & (prev > v)
+            dropsum = dropsum + jnp.where(reset, prev, 0.0)
+            prev = jnp.where(valid, v, prev)
+            has_prev = has_prev | valid
+            return prev, has_prev, dropsum
+
+        zeros = jnp.zeros(win.shape[:2], win.dtype)
+        _p, _h, drops = jax.lax.fori_loop(
+            0, K, body, (zeros, jnp.zeros(win.shape[:2], bool), zeros))
+        out = {
+            "first_ts": ft, "last_ts": lt,
+            "first_val": fv, "count": cnt.astype(jnp.float32),
+            "delta_adj": lv - fv + drops,
+            "delta_raw": lv - fv,
+        }
+        vals = _extrapolated(
+            out, sq.range_s, steps.astype(np.float64),
+            counter=f != "delta", is_rate=f == "rate")
+        return EvalResult(vals, labels)
+
+    def _eval_subquery_window(self, f: str, sq: SubqueryExpr,
+                              q=None) -> EvalResult:
+        """fn_over_time(expr[range:step]) — PromQL subqueries: evaluate
+        the inner expression on the sub-step grid covering
+        (start − range, end], then reduce each outer step's window of
+        inner evaluations (reference src/promql/src/planner.rs subquery
+        lowering; Prometheus aligns inner steps to absolute multiples of
+        the sub-step)."""
+        mat = self._subquery_matrix(sq)
+        if mat is None:
+            return EvalResult(
+                jnp.zeros((0, self.num_steps), jnp.float32), [])
+        win, m, _ts_tk, _steps, labels = mat
+        K = win.shape[2]
         cnt = m.sum(axis=-1)
         has = cnt > 0
         nan = jnp.float32(jnp.nan)
@@ -909,7 +977,7 @@ class PromEvaluator:
             out = jnp.where(has, out, nan)
         else:  # pragma: no cover — guarded by _SUBQ_REDUCERS
             raise Unsupported(f"{f} over subquery")
-        return EvalResult(out.astype(jnp.float32), res.labels)
+        return EvalResult(out.astype(jnp.float32), labels)
 
     def _selector_arg(self, e: FunctionCall, i: int, want_range: bool = True) -> VectorSelector:
         a = e.args[i]
@@ -1189,6 +1257,21 @@ class PromEvaluator:
         if not out_vals:
             return EvalResult(jnp.zeros((0, self.num_steps), jnp.float32), [])
         return EvalResult(jnp.stack(out_vals), out_labels)
+
+
+def _instant_pair(f: str, last_ts, prev_ts, last_val, prev_val,
+                  guard=None) -> jnp.ndarray:
+    """irate/idelta from the last two samples — the ONE definition of
+    the instant-pair reset rule, shared by the selector kernel path and
+    the subquery matrix path (Prometheus instantValue semantics)."""
+    dt = (last_ts - prev_ts).astype(jnp.float32) / 1000.0
+    dv = last_val - prev_val
+    if f == "irate":
+        dv = jnp.where(dv < 0, last_val, dv)  # counter reset
+    ok = dt > 0
+    if guard is not None:
+        ok = ok & guard
+    return jnp.where(ok, dv / dt if f == "irate" else dv, jnp.nan)
 
 
 def _extrapolated(out: dict, range_s: float, range_end_ms: np.ndarray,

@@ -2,4 +2,4 @@ CREATE TABLE i1 (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h)
 SELECT table_name, table_type FROM information_schema.tables WHERE table_name = 'i1';
 SELECT column_name, semantic_type FROM information_schema.columns WHERE table_name = 'i1' ORDER BY column_name;
 SELECT table_name FROM information_schema.views;
-SELECT count(*) FROM information_schema.engines
+SELECT count(*) > 0 FROM information_schema.engines

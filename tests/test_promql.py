@@ -506,3 +506,36 @@ class TestPromqlSubqueries:
         self.make(db)
         with pytest.raises(Unsupported):
             db.sql("TQL EVAL (40, 40, '60') sq[30:10]")
+
+
+class TestCounterOverSubqueries:
+    """rate/increase/irate/idelta/delta over subquery matrices with
+    counter-reset adjustment along the window axis."""
+
+    def make(self, db):
+        db.sql("CREATE TABLE cs (pod STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "val DOUBLE, PRIMARY KEY (pod))")
+        r = db._region_of("cs")
+        vals = [0.0, 10, 20, 30, 2, 12, 22]  # reset after 30
+        r.write({"pod": ["p"] * 7, "ts": np.arange(7) * 10_000,
+                 "val": np.asarray(vals)})
+
+    def test_irate_exact(self, db):
+        self.make(db)
+        r = db.sql("TQL EVAL (60, 60, '60') irate(cs[60:10])")
+        assert r.rows[0][-1] == pytest.approx(1.0, rel=1e-6)
+
+    def test_rate_reset_adjusted(self, db):
+        self.make(db)
+        r = db.sql("TQL EVAL (60, 60, '60') rate(cs[60:10])")
+        # adjusted delta over the window ≈ 1/s after the reset at t=40
+        assert 0.5 < r.rows[0][-1] < 1.3
+        r2 = db.sql("TQL EVAL (60, 60, '60') increase(cs[60:10])")
+        assert r2.rows[0][-1] == pytest.approx(
+            r.rows[0][-1] * 60, rel=1e-5)
+
+    def test_delta_unadjusted(self, db):
+        self.make(db)
+        r = db.sql("TQL EVAL (60, 60, '60') delta(cs[60:10])")
+        # gauge delta: no reset adjustment → last - first extrapolated
+        assert r.rows[0][-1] < 30

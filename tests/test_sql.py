@@ -1748,3 +1748,21 @@ class TestPushdownMissSemantics:
         # loudly rather than silently matching host LIKE 'prod%'
         with pytest.raises(GreptimeError):
             jdb.sql("SELECT host FROM m3 WHERE 'prod%' LIKE host")
+
+
+class TestSystemTableFullSurface:
+    """System tables beyond the host mini-engine stage into the real
+    engine: GROUP BY, non-count aggregates, expressions of aggregates."""
+
+    def test_group_by_and_aggs(self, db):
+        db.sql("CREATE TABLE s1 (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (h))")
+        r = db.sql("SELECT table_schema, count(*) FROM "
+                   "information_schema.tables GROUP BY table_schema "
+                   "ORDER BY table_schema")
+        schemas = [row[0] for row in r.rows]
+        assert "public" in schemas and "information_schema" in schemas
+        assert db.sql("SELECT count(*) > 0 FROM "
+                      "information_schema.engines").rows == [[True]]
+        assert db.sql("SELECT max(ordinal_position) FROM "
+                      "information_schema.columns").rows == [[3]]
