@@ -552,8 +552,6 @@ def main() -> None:
     # the child prints its own JSON line to the shared stdout
     remaining = deadline - time.time()
     if remaining > 90 and not os.environ.get("GREPTIME_BENCH_NO_PROMQL"):
-        import subprocess
-
         env = dict(os.environ,
                    GREPTIME_BENCH_BUDGET_S=str(int(remaining)))
         if remaining < 360 and "GREPTIME_PROMQL_SERIES" not in env:
@@ -566,23 +564,22 @@ def main() -> None:
         if plat:
             env["JAX_PLATFORMS"] = plat
         log(f"promql north-star bench ({remaining:.0f}s budget left) ...")
+        # EXEC, don't fork: a subprocess would run alongside this
+        # process's multi-GB resident grid and jax buffers — observed
+        # OOM-killed silently in r5 (child died with no output, the r4
+        # 'tail ends at the first JAX warning' signature).  Replacing
+        # the process frees everything; stdout stays the same fd so the
+        # child's JSON line lands in the same capture.
         try:
-            child = subprocess.Popen(
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os.execve(
+                sys.executable,
                 [sys.executable,
                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "bench_promql.py")],
-                env=env,
+                env,
             )
-            try:
-                # the child's own hard cap is budget+300; give it that,
-                # then SIGTERM (its handler emits partial runs) + grace
-                child.wait(timeout=remaining + 330)
-            except subprocess.TimeoutExpired:
-                child.terminate()
-                try:
-                    child.wait(timeout=30)
-                except subprocess.TimeoutExpired:
-                    child.kill()
         except Exception as e:  # noqa: BLE001 — headline already emitted
             log(f"promql bench skipped: {e}")
 
