@@ -121,6 +121,27 @@ def shard_table(
     )
 
 
+def bucket_major_shardings(mesh, spad: int):
+    """NamedShardings for the derived bucket-major partial tensors
+    (storage/cache.py DerivedLayoutCache): per-(series, bucket) sums
+    ``[C, S, NB]`` and counts ``[S, NB]`` split on the series axis,
+    matching grid_shardings (storage/grid.py) so the mesh grid's resident
+    layout variant stays device-local — the per-query aligned-window
+    kernel then runs SPMD with one tiny XLA-inserted collective at the
+    [groups, buckets] merge, keeping parity with single-device results.
+    Returns None when the padded series count does not tile the mesh."""
+    if mesh is None:
+        return None
+    d = mesh.devices.size
+    if d <= 1 or spad % d != 0:
+        return None
+    axis = mesh.axis_names[0]
+    return {
+        "sums": NamedSharding(mesh, P(None, axis, None)),
+        "cnts": NamedSharding(mesh, P(axis, None)),
+    }
+
+
 # key spec: ("tag", column, card) | ("time", ts_column, step, start, nbuckets)
 # agg spec: (output_name, op, column) with op in sum/count/min/max/mean
 _MERGE = {
@@ -260,7 +281,18 @@ class DistAggExecutor:
                 v = env[col]
                 is_f = jnp.issubdtype(v.dtype, jnp.floating)
                 m = valid & (~jnp.isnan(v) if is_f else jnp.ones(mask.shape, bool))
-                if op in ("sum", "mean"):
+                if op == "sum" and not is_f:
+                    # int64 totals stay int64-exact (a NaN fill would
+                    # promote to float and lose precision above 2^53,
+                    # diverging from single-device segment_reduce);
+                    # empty groups are NULLed host-side via the count,
+                    # matching physical.py's __cnt_all__ convention
+                    part = jax.ops.segment_sum(
+                        jnp.where(m, v.astype(jnp.int64), 0), ids,
+                        num_segments=ns,
+                    )[:grid]
+                    out[out_name] = jax.lax.psum(part, SHARD_AXIS)
+                elif op in ("sum", "mean"):
                     part = jax.ops.segment_sum(
                         jnp.where(m, v, 0).astype(jnp.float32), ids, num_segments=ns
                     )[:grid]

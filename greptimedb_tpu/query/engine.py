@@ -318,7 +318,8 @@ class QueryEngine:
                 grid, ts_bounds = grid_fn(sel.table, plan)
                 if grid is not None:
                     t = mark("scan_cache_ms", t)
-                    res = self.executor.execute_grid(plan, grid, ts_bounds)
+                    res = self.executor.execute_grid(
+                        plan, grid, ts_bounds, metrics=metrics)
                     if res is not None:
                         env, n = res
                         scanned = grid.spad * grid.tpad

@@ -220,30 +220,38 @@ _HOST_FUNCS = {
         args, n, lambda *vs: "".join("" if v is None else str(v) for v in vs)
     ),
     "substr": lambda args, n: _per_row(args, n, _substr),
-    # string tail (reference src/common/function/src/scalars/string/)
+    # string tail (reference src/common/function/src/scalars/string/):
+    # NULL in ANY argument → NULL out, same convention as _geo_fn — a
+    # NULL pattern/length must never stringify to 'None' or raise
     "replace": lambda args, n: _per_row(
         args, n,
-        lambda s, a, b: None if s is None else str(s).replace(str(a), str(b)),
+        lambda s, a, b: None if _any_null(s, a, b)
+        else str(s).replace(str(a), str(b)),
     ),
     "reverse": lambda args, n: _per_row(
         args, n, lambda s: None if s is None else str(s)[::-1]
     ),
     "left": lambda args, n: _per_row(
-        args, n, lambda s, k: None if s is None else str(s)[: int(k)]
+        args, n,
+        lambda s, k: None if _any_null(s, k) else str(s)[: int(k)],
     ),
+    # right(s, -k) drops the FIRST k characters (PostgreSQL semantics);
+    # str(s)[-int(k):] covers both signs, k=0 is the empty string
     "right": lambda args, n: _per_row(
         args, n,
-        lambda s, k: None if s is None else (
-            str(s)[-int(k):] if int(k) > 0 else ""),
+        lambda s, k: None if _any_null(s, k) else (
+            str(s)[-int(k):] if int(k) != 0 else ""),
     ),
     "split_part": lambda args, n: _per_row(args, n, _split_part),
     "strpos": lambda args, n: _per_row(
         args, n,
-        lambda s, sub: None if s is None else str(s).find(str(sub)) + 1,
+        lambda s, sub: None if _any_null(s, sub)
+        else str(s).find(str(sub)) + 1,
     ),
     "position": lambda args, n: _per_row(
         args, n,
-        lambda sub, s: None if s is None else str(s).find(str(sub)) + 1,
+        lambda sub, s: None if _any_null(s, sub)
+        else str(s).find(str(sub)) + 1,
     ),
     "lpad": lambda args, n: _per_row(
         args, n, lambda s, k, p=" ": _pad(s, k, p, left=True)
@@ -252,7 +260,8 @@ _HOST_FUNCS = {
         args, n, lambda s, k, p=" ": _pad(s, k, p, left=False)
     ),
     "repeat": lambda args, n: _per_row(
-        args, n, lambda s, k: None if s is None else str(s) * int(k)
+        args, n,
+        lambda s, k: None if _any_null(s, k) else str(s) * int(k),
     ),
     "starts_with": lambda args, n: _per_row(
         args, n,
@@ -300,10 +309,17 @@ def _is_null_val(v) -> bool:
         return False
 
 
+def _any_null(*vs) -> bool:
+    """NULL-in/NULL-out guard for multi-argument string scalars: numeric
+    arguments may arrive as float NaN (device columns), string ones as
+    None — both are SQL NULL."""
+    return any(_is_null_val(v) for v in vs)
+
+
 def _pad(s, k, p, *, left: bool):
     """lpad/rpad with the full multi-character fill pattern cycled
     (PostgreSQL semantics), truncating to length k."""
-    if s is None:
+    if _any_null(s, k, p):
         return None
     s = str(s)
     k = int(k)

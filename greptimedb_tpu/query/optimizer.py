@@ -74,9 +74,22 @@ def _is_false(e: Expr) -> bool:
     return isinstance(e, Literal) and e.value is False
 
 
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
 def _cmp_literals(op: str, a, b):
     if a is None or b is None:
         return None  # NULL comparisons stay NULL — don't fold
+    if op in ("=", "!=", "<>") and not (
+        (_num(a) and _num(b))
+        or (isinstance(a, str) and isinstance(b, str))
+        or (isinstance(a, bool) and isinstance(b, bool))
+    ):
+        # mixed-type equality ('1' = 1): Python equality would fold it to
+        # FALSE, but type-coercing SQL runtimes may disagree — leave the
+        # comparison for the runtime to decide
+        return None
     try:
         if op == "=":
             return bool(a == b)
@@ -230,7 +243,10 @@ def coerce_time_literals(e: Expr, ctx) -> Expr:
                         b.value, getattr(ctx, "timezone", "UTC"))
                 except Exception:  # noqa: BLE001 — not a timestamp
                     return node
-                native = Literal(int(round(ms * unit_ms)))
+                # truncate exactly like TableContext.ts_literal (int(), not
+                # round()): sub-unit literals must coerce bit-identically
+                # between the plan-time and runtime paths
+                native = Literal(int(ms * unit_ms))
                 return (BinaryOp(node.op, native, a) if flip
                         else BinaryOp(node.op, a, native))
         return node

@@ -36,8 +36,10 @@ from greptimedb_tpu.storage.memtable import TSID
 DENSE_LIMIT = 1 << 22
 
 # diagnostics: counts every aggregate dispatch (including kernel-cache
-# hits) by which segment strategy it used; tests assert coverage
-DISPATCH_STATS = {"sorted": 0, "scatter": 0, "grid": 0}
+# hits) by which segment strategy it used; tests assert coverage.
+# "grid_bm" counts grid dispatches served from the resident bucket-major
+# derived layout (a subset of "grid").
+DISPATCH_STATS = {"sorted": 0, "scatter": 0, "grid": 0, "grid_bm": 0}
 
 _GRID_OPS = {"avg": "mean", "mean": "mean", "sum": "sum", "count": "count",
              "min": "min", "max": "max"}
@@ -129,6 +131,41 @@ def _pow2(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length() if n > 1 else 1
 
 
+def _series_group_ids(tag_codes, tag_cols, cards_tag, ngt, spad):
+    """Series → dense tag-group ids, poison codes (-1 pads, unknown)
+    routed to the overflow segment ``ngt``.  The ONE routing shared by
+    the dynamic-slice and bucket-major grid kernels so the two layouts
+    can never disagree on grouping."""
+    if tag_cols:
+        codes = [tag_codes[c] for c in tag_cols]
+        gid_s, _tot = combine_keys(codes, cards_tag)
+    else:
+        gid_s = jnp.zeros(spad, dtype=jnp.int64)
+    return jnp.where(
+        (gid_s >= 0) & (gid_s < ngt), gid_s, ngt
+    ).astype(jnp.int32)
+
+
+def _grid_key_outputs(tag_cols, cards_tag, ngt, nb, bts0, step_q, has_time):
+    """__comps__/__bts__ materialization: arithmetic decomposition over
+    the (tags…, bucket) grid — replicated, no gather.  Shared by both
+    grid kernels (one definition of the flatten order)."""
+    from greptimedb_tpu.ops.segment import decompose_keys
+
+    ng = ngt * nb
+    comps = decompose_keys(
+        jnp.arange(ng, dtype=jnp.int64), list(cards_tag) + [nb]
+    )
+    out = {
+        "__comps__": jnp.stack(comps[:-1]) if tag_cols else (
+            jnp.zeros((0, ng), dtype=jnp.int32)
+        ),
+    }
+    if has_time:
+        out["__bts__"] = bts0 + comps[-1].astype(jnp.int64) * step_q
+    return out
+
+
 class Executor:
     """Caches jitted kernels by (fingerprint, shape-class) keys."""
 
@@ -138,6 +175,12 @@ class Executor:
         # version): repeat queries must not re-decode/re-upload thousands
         # of stored states per execution
         self._sketch_cache: dict[tuple, object] = {}
+        # resident bucket-major partials per (region, step class): the
+        # aligned-window range path reuses them across warm queries
+        # instead of re-running the dynamic-slice window copy + gemv
+        from greptimedb_tpu.storage.cache import DerivedLayoutCache
+
+        self.layout_cache = DerivedLayoutCache()
 
     # ------------------------------------------------------------------
     def execute(
@@ -376,7 +419,8 @@ class Executor:
 
     # ---- dense time-grid path -----------------------------------------
     def execute_grid(
-        self, plan: SelectPlan, grid, ts_bounds: tuple[int, int]
+        self, plan: SelectPlan, grid, ts_bounds: tuple[int, int],
+        metrics: dict | None = None,
     ) -> tuple[dict[str, np.ndarray], int] | None:
         """Aggregate over a GridTable: reshape+reduce per time bucket, then
         a tiny series-axis segment merge — no row scatter at any scale.
@@ -397,7 +441,10 @@ class Executor:
             return None
         gridcols = set(grid.field_names)
 
-        # agg specs: (out_name, op, arg_fn|None, no_nan_plain)
+        # agg specs: (out_name, op, arg_fn|None, no_nan_plain, plain_ci)
+        # plain_ci is the grid field index when the argument is exactly
+        # one stored column — the bucket-major layout path addresses the
+        # resident partial sums by it
         specs: list[tuple] = []
         try:
             for agg in plan.aggs:
@@ -405,7 +452,7 @@ class Executor:
                 if op is None or agg.distinct:
                     return None
                 if not agg.args or isinstance(agg.args[0], Star):
-                    specs.append((str(agg), "count", None, True))
+                    specs.append((str(agg), "count", None, True, None))
                     continue
                 arg = agg.args[0]
                 refs: set = set()
@@ -413,15 +460,18 @@ class Executor:
                 if not refs <= gridcols | {ts_name}:
                     return None
                 no_nan_plain = False
+                plain_ci = None
                 if isinstance(arg, Column):
                     real = ctx.resolve(arg.name)
                     if real in gridcols:
                         ci = grid.field_names.index(real)
+                        plain_ci = ci
                         no_nan_plain = bool(
                             grid.no_nan[ci] if ci < len(grid.no_nan) else False
                         )
                 specs.append(
-                    (str(agg), op, compile_device(arg, ctx), no_nan_plain)
+                    (str(agg), op, compile_device(arg, ctx), no_nan_plain,
+                     plain_ci)
                 )
             where_fn = None
             where_series = False
@@ -517,29 +567,63 @@ class Executor:
             len(ctx.encoders[c.name]) for c in ctx.schema.tag_columns
         )
         tag_order = tuple(sorted(grid.tag_codes))
-        cache_key = (
-            "grid", plan.fingerprint(), grid.spad, grid.tpad,
-            grid.field_names, grid.ts0, g_step, r, nbw, w_raw, pad_l,
-            pad_r, tuple(cards_tag), dict_ver, grid.no_nan,
-            bool(time_keys), tag_order, where_series, aligned,
+
+        # resident bucket-major layout: ALIGNED windows whose aggregates
+        # all resolve to the per-(series, bucket) partials skip the
+        # dynamic-slice window copy + gemv entirely — per-query work is a
+        # bucket-axis slice of the cached [C, S, NB] sums plus the tiny
+        # series-axis merge (storage/cache.py DerivedLayoutCache)
+        out = None
+        layout = self._aligned_layout(
+            grid, r, pad_left, nb, specs, aligned, bool(time_keys),
+            where_fn, where_series, metrics,
         )
-        kernel = self._cache.get(cache_key)
-        if kernel is None:
-            kernel = self._build_grid_kernel(
-                grid.field_names, ts_name, tag_order,
-                [k.column for k in tag_keys], cards_tag,
-                bool(time_keys), r, nbw, w_raw, pad_l, pad_r, step_q,
-                where_fn, where_series, specs, grid.ts0, g_step, aligned,
+        if layout is not None:
+            DISPATCH_STATS["grid_bm"] += 1
+            bm_key = (
+                "grid_bm", plan.fingerprint(), grid.spad,
+                grid.field_names, r, nbw, nb, step_q, tuple(cards_tag),
+                dict_ver, tag_order, where_series,
             )
-            self._cache[cache_key] = kernel
-        ts_lo = np.int64(lo) if lo is not None else _I64_MIN
-        ts_hi = np.int64(hi) if hi is not None else _I64_MAX
-        out = kernel(
-            grid.values, grid.valid,
-            tuple(grid.tag_codes[t] for t in tag_order),
-            ts_lo, ts_hi, np.int64(int(bts0) + b_lo * step_q),
-            np.int32(s0),
-        )
+            kernel = self._cache.get(bm_key)
+            if kernel is None:
+                kernel = self._build_bm_kernel(
+                    tag_order, [k.column for k in tag_keys], cards_tag,
+                    nbw, step_q,
+                    where_fn if where_series else None,
+                    [(name, op, ci) for name, op, _fn, _nn, ci in specs],
+                )
+                self._cache[bm_key] = kernel
+            out = kernel(
+                layout[0], layout[1],
+                tuple(grid.tag_codes[t] for t in tag_order),
+                np.int32(b_lo), np.int64(int(bts0) + b_lo * step_q),
+            )
+        if out is None:
+            cache_key = (
+                "grid", plan.fingerprint(), grid.spad, grid.tpad,
+                grid.field_names, grid.ts0, g_step, r, nbw, w_raw, pad_l,
+                pad_r, tuple(cards_tag), dict_ver, grid.no_nan,
+                bool(time_keys), tag_order, where_series, aligned,
+            )
+            kernel = self._cache.get(cache_key)
+            if kernel is None:
+                kernel = self._build_grid_kernel(
+                    grid.field_names, ts_name, tag_order,
+                    [k.column for k in tag_keys], cards_tag,
+                    bool(time_keys), r, nbw, w_raw, pad_l, pad_r, step_q,
+                    where_fn, where_series, specs, grid.ts0, g_step,
+                    aligned,
+                )
+                self._cache[cache_key] = kernel
+            ts_lo = np.int64(lo) if lo is not None else _I64_MIN
+            ts_hi = np.int64(hi) if hi is not None else _I64_MAX
+            out = kernel(
+                grid.values, grid.valid,
+                tuple(grid.tag_codes[t] for t in tag_order),
+                ts_lo, ts_hi, np.int64(int(bts0) + b_lo * step_q),
+                np.int32(s0),
+            )
         out = {k: np.asarray(v) for k, v in out.items()}
 
         gmask = out.pop("__gmask__").astype(bool)
@@ -559,9 +643,192 @@ class Executor:
                 col = raw
             env[k.name] = col
             env[str(k.expr)] = col
-        for name, _op, _fn, _nn in specs:
+        for name, _op, _fn, _nn, _ci in specs:
             env[name] = out[name][gmask]
         return env, n
+
+    # ---- resident bucket-major layout (aligned windows) ---------------
+    def _aligned_layout(
+        self, grid, r, pad_left, nb, specs, aligned, has_time,
+        where_fn, where_series, metrics,
+    ):
+        """Per-(series, bucket) partial arrays for the aligned-window
+        path, from the DerivedLayoutCache (built on miss, admission
+        permitting).  Returns (sums [C, S, NB], cnts [S, NB]) or None —
+        None routes the query to the dynamic-slice kernel.
+
+        Eligibility mirrors exactly the subset whose per-query math is
+        window-independent: a bucket-aligned time window (every bucket
+        fully covered by the ts range), aggregates that reduce to plain
+        per-bucket sums/counts over finite stored columns, and a WHERE
+        that is absent or tag-only (applied AFTER the bucket reduce).
+        Everything else falls back, so the two layouts can never diverge
+        semantically."""
+        if metrics is not None:
+            metrics["layout"] = "dynamic_slice"
+        eligible = (
+            aligned
+            and has_time
+            and os.environ.get("GREPTIME_LAYOUT_CACHE", "auto") != "off"
+            and (where_fn is None or where_series)
+            and all(
+                (op == "count" and (fn is None or nn))
+                or (op in ("sum", "mean") and nn and ci is not None)
+                for _name, op, fn, nn, ci in specs
+            )
+        )
+        if not eligible:
+            return None
+        step_class = (r, pad_left, nb)
+        arrays = self.layout_cache.lookup(
+            grid.region_id, step_class, grid.dicts_version
+        )
+        state = "hit"
+        if arrays is None:
+            est = (len(grid.field_names) + 1) * grid.spad * nb * 4
+            if not self.layout_cache.admit(est):
+                # over budget even after LRU reclaim: dynamic-slice path
+                # (correct, just slower) rather than risking device OOM
+                if metrics is not None:
+                    metrics["layout_cache"] = "reject"
+                return None
+            arrays = self._bucket_major_partials(grid, r, pad_left, nb)
+            self.layout_cache.store(
+                grid.region_id, step_class, grid.dicts_version, arrays,
+                sum(int(a.nbytes) for a in arrays),
+            )
+            state = "miss"
+        if metrics is not None:
+            metrics["layout"] = "bucket_major"
+            metrics["layout_cache"] = state
+        return arrays
+
+    def _bucket_major_partials(self, grid, r, pad_left, nb):
+        """Materialize the [S, nb, r] bucket-major reshape of the grid
+        once on device and contract it to per-(series, bucket) partials:
+        sums [C, S, NB] and validity counts [S, NB] (f32 — exact below
+        2^24, guarded by the r-width check in execute_grid).  The
+        contraction is the same ``reshape @ ones[r]`` the dynamic-slice
+        kernel runs per window, over identical r-element blocks, so the
+        per-bucket f32 results are bit-identical.  Mesh grids keep the
+        partials sharded on the series axis (parallel/dist.py
+        bucket_major_shardings)."""
+        c = len(grid.field_names)
+        spad, tpad = grid.spad, grid.tpad
+        # resolve the partial shardings BEFORE the builder-cache lookup:
+        # the jitted closure bakes them in, so a dimensionally-identical
+        # grid under a DIFFERENT sharding (or none) must not reuse it —
+        # the key carries the mesh identity
+        shardings = None
+        sh_key = None
+        try:
+            from jax.sharding import NamedSharding
+
+            sh = grid.values.sharding
+            if isinstance(sh, NamedSharding):
+                from greptimedb_tpu.parallel.dist import (
+                    bucket_major_shardings,
+                )
+
+                shardings = bucket_major_shardings(sh.mesh, spad)
+                if shardings is not None:
+                    sh_key = (
+                        tuple(sh.mesh.axis_names),
+                        tuple(d.id for d in sh.mesh.devices.flat),
+                    )
+        except Exception:  # noqa: BLE001 — sharding is an optimization
+            shardings = None
+            sh_key = None
+        key = ("bm_build", c, spad, tpad, r, pad_left, nb, sh_key)
+        build = self._cache.get(key)
+        if build is None:
+            pad_rt = nb * r - pad_left - tpad
+
+            def build_fn(values, valid):
+                def padlast(x):
+                    if pad_left == 0 and pad_rt == 0:
+                        return x
+                    widths = [(0, 0)] * (x.ndim - 1) + [(pad_left, pad_rt)]
+                    return jnp.pad(x, widths)
+
+                ones_r = jnp.ones((r,), jnp.float32)
+                sums = padlast(values).reshape(c, spad, nb, r) @ ones_r
+                cnts = padlast(
+                    valid.astype(jnp.float32)
+                ).reshape(spad, nb, r) @ ones_r
+                if shardings is not None:
+                    sums = jax.lax.with_sharding_constraint(
+                        sums, shardings["sums"])
+                    cnts = jax.lax.with_sharding_constraint(
+                        cnts, shardings["cnts"])
+                return sums, cnts
+
+            build = jax.jit(build_fn)
+            self._cache[key] = build
+        sums, cnts = build(grid.values, grid.valid)
+        sums.block_until_ready()
+        return (sums, cnts)
+
+    def _build_bm_kernel(
+        self, tag_order, tag_cols, cards_tag, nbw, step_q, where_fn,
+        bm_specs,
+    ):
+        """Aligned-window kernel over the resident bucket-major partials:
+        slice the window's buckets (traced start, static width — rolling
+        windows reuse one compiled program), apply the tag-only WHERE as
+        a per-series multiplier, merge the series axis into tag groups.
+        Output contract matches _build_grid_kernel exactly (__gmask__/
+        __comps__/__bts__ + one array per aggregate) so the host-side
+        result shaping is shared."""
+        ngt = 1
+        for c in cards_tag:
+            ngt *= c
+        nb = nbw
+
+        @jax.jit
+        def kernel(sums, cnts, tag_arrays, b_lo, bts0):
+            spad = cnts.shape[0]
+            tag_codes = dict(zip(tag_order, tag_arrays))
+            s_w = jax.lax.dynamic_slice_in_dim(sums, b_lo, nbw, axis=2)
+            c_w = jax.lax.dynamic_slice_in_dim(cnts, b_lo, nbw, axis=1)
+            smf = None
+            if where_fn is not None:
+                env_s = {t: codes for t, codes in tag_codes.items()}
+                smf = jnp.broadcast_to(
+                    where_fn(env_s), (spad,)
+                ).astype(jnp.float32)
+                c_w = c_w * smf[:, None]
+            ids = _series_group_ids(tag_codes, tag_cols, cards_tag, ngt,
+                                    spad)
+
+            def gseg(x):
+                return jax.ops.segment_sum(x, ids, num_segments=ngt + 1)[:ngt]
+
+            cnt_all = gseg(c_w.astype(jnp.int64))  # [ngt, NB]
+            out = {}
+            for name, op, ci in bm_specs:
+                if op == "count":
+                    out[name] = cnt_all.reshape(-1)
+                    continue
+                sb = s_w[ci]
+                if smf is not None:
+                    sb = sb * smf[:, None]
+                sg = gseg(sb)
+                if op == "sum":
+                    out[name] = jnp.where(
+                        cnt_all > 0, sg, jnp.nan).reshape(-1)
+                else:  # mean
+                    out[name] = jnp.where(
+                        cnt_all > 0,
+                        sg / jnp.maximum(cnt_all, 1).astype(jnp.float32),
+                        jnp.nan,
+                    ).reshape(-1)
+            out["__gmask__"] = (cnt_all > 0).reshape(-1)
+            out.update(_grid_key_outputs(
+                tag_cols, cards_tag, ngt, nb, bts0, step_q, True))
+            return out
+
+        return kernel
 
     def _build_grid_kernel(
         self, field_names, ts_name, tag_order, tag_cols, cards_tag, has_time,
@@ -674,14 +941,8 @@ class Executor:
                 return v2
 
             # series → tag-group ids (poison -1 → routed to segment ngt)
-            if tag_cols:
-                codes = [tag_codes[c] for c in tag_cols]
-                gid_s, _tot = combine_keys(codes, cards_tag)
-            else:
-                gid_s = jnp.zeros(spad, dtype=jnp.int64)
-            ids = jnp.where(
-                (gid_s >= 0) & (gid_s < ngt), gid_s, ngt
-            ).astype(jnp.int32)
+            ids = _series_group_ids(tag_codes, tag_cols, cards_tag, ngt,
+                                    spad)
 
             def gseg(x, segf=jax.ops.segment_sum):
                 """[S, NB] → [ngt, NB]: series-axis merge (tiny)."""
@@ -701,7 +962,7 @@ class Executor:
             cnts: dict[str, jnp.ndarray] = {}
             sums: dict[str, jnp.ndarray] = {}
             min_items, max_items, cnt_items = [], [], []
-            for name, op, arg_fn, no_nan_plain in specs:
+            for name, op, arg_fn, no_nan_plain, _ci in specs:
                 if op == "count" and (arg_fn is None or no_nan_plain):
                     continue  # resolves to the shared cnt_all
                 x = jnp.broadcast_to(
@@ -751,7 +1012,7 @@ class Executor:
                     c = cnts.get(name, cnt_all)
                     out[name] = jnp.where(c > 0, merged, jnp.nan).reshape(-1)
 
-            for name, op, arg_fn, no_nan_plain in specs:
+            for name, op, arg_fn, no_nan_plain, _ci in specs:
                 if name in out:
                     continue  # min/max already materialized
                 if op == "count":
@@ -779,21 +1040,8 @@ class Executor:
                 out["__gmask__"] = jnp.ones(1, dtype=bool)
             else:
                 out["__gmask__"] = (cnt_all > 0).reshape(-1)
-            # group-key materialization: arithmetic decomposition over the
-            # (tags…, bucket) grid — replicated, no gather
-            from greptimedb_tpu.ops.segment import decompose_keys
-
-            ng = ngt * nb
-            comps = decompose_keys(
-                jnp.arange(ng, dtype=jnp.int64), list(cards_tag) + [nb]
-            )
-            out["__comps__"] = jnp.stack(comps[:-1]) if cards_tag else (
-                jnp.zeros((0, ng), dtype=jnp.int32)
-            )
-            if has_time:
-                out["__bts__"] = (
-                    bts0 + comps[-1].astype(jnp.int64) * step_q
-                )
+            out.update(_grid_key_outputs(
+                tag_cols, cards_tag, ngt, nb, bts0, step_q, has_time))
             return out
 
         return kernel

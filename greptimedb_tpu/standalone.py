@@ -287,6 +287,26 @@ class GreptimeDB(TableProvider):
         )
         self.regions.memory = self.memory
         self.engine = QueryEngine(self)
+        # derived bucket-major layout cache (aligned-window range path):
+        # the extra resident copy admits against its own workload quota
+        # with reject-to-fallback — an over-budget build degrades to the
+        # dynamic-slice kernel instead of OOMing HBM; admission pressure
+        # reclaims by LRU eviction
+        _layout = self.engine.executor.layout_cache
+        _layout_quota = os.environ.get("GREPTIME_LAYOUT_CACHE_QUOTA_BYTES")
+        self.memory.register(
+            "layout_cache",
+            int(_layout_quota) if _layout_quota else None,
+            usage_fn=lambda: self.engine.executor.layout_cache.bytes,
+            reclaim_fn=_layout.reclaim,
+            policy="reject",
+        )
+        _layout.memory_probe = (
+            lambda n: self.memory.try_admit("layout_cache", n)
+        )
+        # chain drop/truncate/repartition invalidation into the derived
+        # layouts so a dead region's partials free immediately
+        self.cache.derived_layouts = _layout
         # nested (sub)queries route through the full statement dispatch so
         # information_schema / pg_catalog subqueries resolve
         self.engine.dispatch = self.execute_statement

@@ -325,6 +325,12 @@ class RegionCacheManager:
         # device mesh for series-axis sharding of resident grids (set by
         # GreptimeDB when >1 device is visible); None = single device
         self.mesh = mesh
+        # optional DerivedLayoutCache chained into invalidate_region (set
+        # by GreptimeDB): every drop/truncate/repartition path that
+        # invalidates a region's resident tensors must also drop its
+        # derived bucket-major layouts, or they leak device bytes and
+        # inflate the layout_cache workload usage
+        self.derived_layouts = None
         self._lru: "collections.OrderedDict[tuple, _Entry]" = (
             collections.OrderedDict()
         )
@@ -523,7 +529,126 @@ class RegionCacheManager:
         e = self._lru.pop(key, None)
         if e is not None and e.table is not None:
             self._bytes -= e.table.nbytes()
+        if (self.derived_layouts is not None and key[1:2] == ("grid",)):
+            # a grid leaving residency (capacity pressure, stale-version
+            # sweep, failed extend) strands its derived layouts: the next
+            # grid build bumps dicts_version, so they could never hit
+            # again — drop them now instead of leaking device bytes
+            self.derived_layouts.invalidate_region(key[0])
 
     def invalidate_region(self, region_id: int) -> None:
         for k in [k for k in self._lru if k[0] == region_id]:
             self._evict(k)
+        if self.derived_layouts is not None:
+            self.derived_layouts.invalidate_region(region_id)
+
+
+@dataclass
+class _LayoutEntry:
+    version: int  # GridTable.dicts_version the layout was derived from
+    arrays: tuple
+    nbytes: int
+
+
+class DerivedLayoutCache:
+    """Resident derived layouts for the aligned-window range-aggregation
+    path: per (region, step class) the bucket-major reduction of the
+    resident grid — the ``[S, nb, r]`` reshape contracted once on device
+    into per-(series, bucket) partial sums ``[C, S, NB]`` and validity
+    counts ``[S, NB]`` — reused across warm queries so the per-query
+    aligned-window work drops to a bucket-axis slice plus the tiny
+    series-axis merge (the "pay the transpose once" pattern of tensor-
+    runtime query engines, arXiv:2203.01877).
+
+    Invalidation is by GridTable.dicts_version (bumped on every grid
+    build AND device-side append extension, which in turn follow the
+    region's ingest/flush/compaction generation bumps): a version
+    mismatch evicts the stale entry and rebuilds.  Capacity is LRU by
+    bytes; ``admit`` additionally consults an optional
+    WorkloadMemoryManager probe so the extra resident copy can never OOM
+    the device — rejected builds fall back to the dynamic-slice kernel.
+    """
+
+    def __init__(self, capacity_bytes: int | None = None):
+        import os
+
+        if capacity_bytes is None:
+            capacity_bytes = int(os.environ.get(
+                "GREPTIME_LAYOUT_CACHE_BYTES", str(1 << 30)))
+        self.capacity = capacity_bytes
+        # optional callable(nbytes) -> bool wired by the server to
+        # WorkloadMemoryManager.try_admit("layout_cache", ...)
+        self.memory_probe = None
+        self._lru: "collections.OrderedDict[tuple, _LayoutEntry]" = (
+            collections.OrderedDict()
+        )
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.rejects = 0
+        self.builds = 0
+        self.evictions = 0
+
+    @property
+    def bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def lookup(self, region_id: int, step_class: tuple, version: int):
+        """Arrays for (region, step class) at ``version``, or None.  A
+        stale entry (older grid generation) is evicted immediately — the
+        generation bump IS the invalidation."""
+        key = (region_id, step_class)
+        entry = self._lru.get(key)
+        if entry is not None and entry.version == version:
+            self.hits += 1
+            self._lru.move_to_end(key)
+            return entry.arrays
+        if entry is not None:
+            self._evict(key)
+        self.misses += 1
+        return None
+
+    def admit(self, nbytes: int) -> bool:
+        """Reject-to-fallback admission: evict LRU entries to make room,
+        then consult the workload memory probe.  False means the caller
+        must serve the query from the dynamic-slice path."""
+        if nbytes > self.capacity:
+            self.rejects += 1
+            return False
+        while self._bytes + nbytes > self.capacity and self._lru:
+            self._evict(next(iter(self._lru)))
+        if self.memory_probe is not None and not self.memory_probe(nbytes):
+            self.rejects += 1
+            return False
+        return True
+
+    def store(self, region_id: int, step_class: tuple, version: int,
+              arrays: tuple, nbytes: int) -> None:
+        key = (region_id, step_class)
+        if key in self._lru:
+            self._evict(key)
+        self._lru[key] = _LayoutEntry(version, arrays, nbytes)
+        self._bytes += nbytes
+        self.builds += 1
+
+    def reclaim(self, nbytes: int) -> None:
+        """WorkloadMemoryManager reclaim hook: free at least ``nbytes``
+        by LRU eviction (admission pressure from other workloads)."""
+        freed = 0
+        while freed < nbytes and self._lru:
+            k = next(iter(self._lru))
+            freed += self._lru[k].nbytes
+            self._evict(k)
+
+    def invalidate_region(self, region_id: int) -> None:
+        for k in [k for k in self._lru if k[0] == region_id]:
+            self._evict(k)
+
+    def _evict(self, key) -> None:
+        e = self._lru.pop(key, None)
+        if e is not None:
+            self._bytes -= e.nbytes
+            self.evictions += 1

@@ -101,6 +101,10 @@ class GridTable:
     # mask-free weighted reduce (inf would break its 0-weight products)
     no_nan: tuple = ()
     dicts_version: int = 0
+    # owning region: derived-layout cache entries key on it so a rebuilt
+    # grid (new dicts_version) REPLACES the region's stale layouts instead
+    # of leaking them until LRU pressure
+    region_id: int = -1
 
     @property
     def spad(self) -> int:
@@ -125,17 +129,18 @@ class GridTable:
             tuple(names), self.ts0, self.step, self.nt, self.num_series,
             self.field_names,
             tuple((k, tuple(v)) for k, v in sorted(self.dicts.items())),
-            self.no_nan, self.dicts_version,
+            self.no_nan, self.dicts_version, self.region_id,
         )
         return children, aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        (names, ts0, step, nt, ns, fields, dict_items, no_nan, dver) = aux
+        (names, ts0, step, nt, ns, fields, dict_items, no_nan, dver,
+         rid) = aux
         values, valid = children[0], children[1]
         tags = dict(zip(names, children[2:]))
         return cls(values, valid, tags, ts0, step, nt, ns, fields,
-                   {k: list(v) for k, v in dict_items}, no_nan, dver)
+                   {k: list(v) for k, v in dict_items}, no_nan, dver, rid)
 
 
 def grid_float_fields(schema) -> list[str]:
@@ -303,6 +308,7 @@ def build_grid_table(region, budget_bytes: int | None = None, mesh=None):
         dicts=dicts,
         no_nan=tuple(no_nan),
         dicts_version=next_dicts_version(),
+        region_id=int(getattr(region, "region_id", -1)),
     )
 
 
@@ -393,6 +399,7 @@ def load_grid_snapshot(path: str, region, mesh=None):
         dicts={k: list(v) for k, v in meta["dicts"].items()},
         no_nan=tuple(meta["no_nan"]),
         dicts_version=next_dicts_version(),
+        region_id=int(getattr(region, "region_id", -1)),
     )
 
 
@@ -460,4 +467,5 @@ def extend_grid_table(table: GridTable, region, chunks, mesh=None):
                for name in region.tag_names},
         no_nan=tuple(no_nan),
         dicts_version=next_dicts_version(),
+        region_id=table.region_id,
     )

@@ -75,6 +75,17 @@ class WorkloadMemoryManager:
         used = w.usage_fn()
         if used + nbytes <= w.quota_bytes:
             return
+        if nbytes > w.quota_bytes and w.policy == "reject":
+            # reclaim cannot help a reject-policy workload here: the
+            # allocation alone exceeds the quota, so draining the whole
+            # workload would still reject — don't destroy its resident
+            # state on a doomed admission (best_effort keeps the reclaim:
+            # it proceeds regardless, and freeing memory still helps)
+            _M_REJECTED.labels(name).inc()
+            raise ResourcesExhausted(
+                f"workload {name!r} allocation over quota: "
+                f"{nbytes} > {w.quota_bytes} bytes"
+            )
         if w.reclaim_fn is not None:
             _M_RECLAIMS.labels(name).inc()
             # ask for the actual deficit, not the batch size: usage may
@@ -90,6 +101,17 @@ class WorkloadMemoryManager:
             f"workload {name!r} over memory quota: "
             f"{w.usage_fn()} + {nbytes} > {w.quota_bytes} bytes"
         )
+
+    def try_admit(self, name: str, nbytes: int) -> bool:
+        """Non-raising admission probe for reject-to-fallback callers
+        (derived layout cache): the caller degrades to a slower path on
+        False instead of surfacing RUNTIME_RESOURCES_EXHAUSTED.  Runs the
+        same reclaim-then-policy sequence as ``admit``."""
+        try:
+            self.admit(name, nbytes)
+        except ResourcesExhausted:
+            return False
+        return True
 
     def usage(self) -> dict[str, dict]:
         with self._lock:

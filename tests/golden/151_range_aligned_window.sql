@@ -1,0 +1,7 @@
+-- bucket-aligned RANGE windows: the resident bucket-major layout path
+-- (query/physical.py _aligned_layout); repeated statement = warm hit
+CREATE TABLE ra (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO ra VALUES ('a',0,1.0),('b',0,10.0),('a',5000,2.0),('b',5000,20.0),('a',10000,3.0),('b',10000,30.0),('a',15000,4.0),('b',15000,40.0),('a',20000,5.0),('b',20000,50.0),('a',25000,6.0),('b',25000,60.0),('a',30000,7.0),('b',30000,70.0),('a',35000,8.0),('b',35000,80.0);
+SELECT h, ts, avg(v) RANGE '20s' FROM ra WHERE ts >= 0 AND ts < 40000 ALIGN '20s' BY (h) ORDER BY h, ts;
+SELECT h, ts, avg(v) RANGE '20s' FROM ra WHERE ts >= 0 AND ts < 40000 ALIGN '20s' BY (h) ORDER BY h, ts;
+SELECT h, ts, sum(v) RANGE '10s', count(v) RANGE '10s' FROM ra WHERE ts >= 10000 AND ts < 30000 ALIGN '10s' BY (h) ORDER BY h, ts

@@ -1,0 +1,7 @@
+-- window endpoints OFF the bucket boundaries: dynamic-slice kernel;
+-- results must agree with the aligned case on the shared interior buckets
+CREATE TABLE ru (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO ru VALUES ('a',0,1.0),('a',5000,2.0),('a',10000,3.0),('a',15000,4.0),('a',20000,5.0),('a',25000,6.0),('a',30000,7.0),('a',35000,8.0);
+SELECT ts, avg(v) RANGE '20s' FROM ru WHERE ts >= 7000 AND ts < 33000 ALIGN '20s' ORDER BY ts;
+SELECT ts, sum(v) RANGE '10s' FROM ru WHERE ts >= 5000 AND ts < 28000 ALIGN '10s' ORDER BY ts;
+SELECT ts, count(v) RANGE '10s' FROM ru WHERE ts > 4000 ALIGN '10s' ORDER BY ts

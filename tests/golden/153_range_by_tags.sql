@@ -1,0 +1,5 @@
+-- ALIGN ... BY (tag subset): aligned window grouped by one of two tags
+CREATE TABLE rb (h STRING, dc STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h, dc));
+INSERT INTO rb VALUES ('a','east',0,1.0),('a','west',0,2.0),('b','east',0,3.0),('b','west',0,4.0),('a','east',10000,5.0),('a','west',10000,6.0),('b','east',10000,7.0),('b','west',10000,8.0),('a','east',20000,9.0),('a','west',20000,10.0),('b','east',20000,11.0),('b','west',20000,12.0);
+SELECT dc, ts, sum(v) RANGE '20s' FROM rb WHERE ts >= 0 AND ts < 40000 ALIGN '20s' BY (dc) ORDER BY dc, ts;
+SELECT h, dc, ts, avg(v) RANGE '20s' FROM rb WHERE ts >= 0 AND ts < 40000 ALIGN '20s' BY (h, dc) ORDER BY h, dc, ts

@@ -1,0 +1,6 @@
+-- min/max RANGE stay on the dynamic-slice kernel even when aligned
+-- (the layout caches sum/count partials only) — results must not care
+CREATE TABLE rm (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rm VALUES ('a',0,5.0),('a',5000,1.0),('a',10000,9.0),('a',15000,3.0),('a',20000,7.0),('a',25000,2.0),('a',30000,8.0),('a',35000,4.0);
+SELECT ts, min(v) RANGE '20s', max(v) RANGE '20s', avg(v) RANGE '20s' FROM rm WHERE ts >= 0 AND ts < 40000 ALIGN '20s' ORDER BY ts;
+SELECT ts, max(v) RANGE '10s' FROM rm WHERE ts >= 10000 AND ts < 30000 ALIGN '10s' ORDER BY ts

@@ -1,0 +1,7 @@
+-- RANGE wider than ALIGN (sliding windows) over an aligned time range:
+-- tumbling partials may come from the layout cache; the host combine
+-- must be unaffected
+CREATE TABLE rs (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rs VALUES ('a',0,1.0),('a',10000,2.0),('a',20000,4.0),('a',30000,8.0),('a',40000,16.0),('a',50000,32.0);
+SELECT ts, sum(v) RANGE '20s' FROM rs WHERE ts >= 0 AND ts < 60000 ALIGN '10s' ORDER BY ts;
+SELECT ts, avg(v) RANGE '30s' FROM rs WHERE ts >= 0 AND ts < 60000 ALIGN '10s' ORDER BY ts

@@ -1,0 +1,10 @@
+-- ingest between two identical aligned RANGE queries: the second must
+-- see the new rows (stale derived layouts invalidate on the generation
+-- bump)
+CREATE TABLE rp (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rp VALUES ('a',0,1.0),('a',5000,2.0),('a',10000,3.0),('a',15000,4.0),('a',20000,5.0),('a',25000,6.0);
+SELECT ts, sum(v) RANGE '10s', count(v) RANGE '10s' FROM rp WHERE ts >= 0 AND ts < 40000 ALIGN '10s' ORDER BY ts;
+INSERT INTO rp VALUES ('a',30000,7.0),('a',35000,8.0);
+SELECT ts, sum(v) RANGE '10s', count(v) RANGE '10s' FROM rp WHERE ts >= 0 AND ts < 40000 ALIGN '10s' ORDER BY ts;
+INSERT INTO rp VALUES ('b',35000,100.0);
+SELECT h, ts, sum(v) RANGE '10s' FROM rp WHERE ts >= 20000 AND ts < 40000 ALIGN '10s' BY (h) ORDER BY h, ts

@@ -1,0 +1,7 @@
+-- tag-only WHERE over an aligned window: the per-series filter applies
+-- after the bucket reduce on both layouts
+CREATE TABLE rf (h STRING, dc STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h, dc));
+INSERT INTO rf VALUES ('a','east',0,1.0),('b','west',0,2.0),('c','east',0,3.0),('a','east',10000,4.0),('b','west',10000,5.0),('c','east',10000,6.0),('a','east',20000,7.0),('b','west',20000,8.0),('c','east',20000,9.0);
+SELECT h, ts, sum(v) RANGE '20s' FROM rf WHERE dc = 'east' AND ts >= 0 AND ts < 40000 ALIGN '20s' BY (h) ORDER BY h, ts;
+SELECT h, ts, avg(v) RANGE '20s' FROM rf WHERE dc != 'east' AND ts >= 0 AND ts < 40000 ALIGN '20s' BY (h) ORDER BY h, ts;
+SELECT h, ts, count(v) RANGE '20s' FROM rf WHERE v > 2 AND ts >= 0 AND ts < 40000 ALIGN '20s' BY (h) ORDER BY h, ts

@@ -1,0 +1,6 @@
+-- NULL-bearing field in RANGE windows: NaN cells route the column to the
+-- masked kernel path; empty buckets are absent (no FILL)
+CREATE TABLE rn (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, w DOUBLE, PRIMARY KEY (h));
+INSERT INTO rn VALUES ('a',0,1.0,1.0),('a',5000,NULL,2.0),('a',10000,3.0,3.0),('a',15000,NULL,4.0),('a',20000,5.0,5.0),('a',35000,7.0,7.0);
+SELECT ts, sum(v) RANGE '10s', count(v) RANGE '10s', avg(w) RANGE '10s' FROM rn WHERE ts >= 0 AND ts < 40000 ALIGN '10s' ORDER BY ts;
+SELECT ts, avg(v) RANGE '20s' FROM rn WHERE ts >= 0 AND ts < 40000 ALIGN '20s' ORDER BY ts

@@ -1,0 +1,7 @@
+-- the TSBS double-groupby shape in miniature: GROUP BY (tag, date_trunc)
+-- over an aligned window, multiple avg columns, repeated for a warm hit
+CREATE TABLE rg (host STRING, ts TIMESTAMP(3) TIME INDEX, u1 DOUBLE, u2 DOUBLE, PRIMARY KEY (host));
+INSERT INTO rg VALUES ('h0',0,10.0,1.0),('h1',0,20.0,2.0),('h0',30000,30.0,3.0),('h1',30000,40.0,4.0),('h0',60000,50.0,5.0),('h1',60000,60.0,6.0),('h0',90000,70.0,7.0),('h1',90000,80.0,8.0),('h0',120000,90.0,9.0),('h1',120000,100.0,10.0);
+SELECT host, date_trunc('minute', ts) AS m, avg(u1), avg(u2) FROM rg WHERE ts >= 0 AND ts < 120000 GROUP BY host, m ORDER BY host, m;
+SELECT host, date_trunc('minute', ts) AS m, avg(u1), avg(u2) FROM rg WHERE ts >= 0 AND ts < 120000 GROUP BY host, m ORDER BY host, m;
+SELECT host, date_bin(INTERVAL '1 minute', ts) AS m, sum(u1), count(*) FROM rg WHERE ts >= 60000 AND ts < 180000 GROUP BY host, m ORDER BY host, m

@@ -1,0 +1,9 @@
+-- the same table served at several step classes and alignment phases:
+-- each (RANGE, window phase) combination must agree with its row-path
+-- semantics independent of which resident layouts are warm
+CREATE TABLE rx (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rx VALUES ('a',3000,1.0),('a',8000,2.0),('a',13000,3.0),('a',18000,4.0),('a',23000,5.0),('a',28000,6.0),('a',33000,7.0),('a',38000,8.0);
+SELECT ts, sum(v) RANGE '10s' FROM rx WHERE ts >= 0 AND ts < 40000 ALIGN '10s' ORDER BY ts;
+SELECT ts, sum(v) RANGE '20s' FROM rx WHERE ts >= 0 AND ts < 40000 ALIGN '20s' ORDER BY ts;
+SELECT ts, sum(v) RANGE '10s' FROM rx WHERE ts >= 13000 AND ts < 33000 ALIGN '10s' ORDER BY ts;
+SELECT ts, avg(v) RANGE '20s' FROM rx WHERE ts >= 20000 AND ts < 40000 ALIGN '20s' ORDER BY ts

@@ -49,6 +49,18 @@ DIST_CASES = [
     "75_multi_field_wide",
     "77_like_escapes",
     "79_partitioned_agg",
+    # aligned/unaligned RANGE windows (the bucket-major layout-cache
+    # surface): location-transparent, so the whole block promotes
+    "151_range_aligned_window",
+    "152_range_unaligned_window",
+    "153_range_by_tags",
+    "154_range_minmax_aligned",
+    "155_range_sliding_aligned",
+    "156_range_post_ingest",
+    "157_range_tag_filter",
+    "158_range_nulls",
+    "159_range_groupby_trunc",
+    "160_range_mixed_alignments",
 ]
 
 
