@@ -550,6 +550,12 @@ def main() -> None:
         lc = db.engine.executor.layout_cache
         _extra_stats["layout_cache_hits"] = lc.hits
         _extra_stats["layout_cache_builds"] = lc.builds
+        # per-workload quota pressure (utils/memory.py): rejected/reclaim
+        # counts expose whether any resident cache ran against its quota
+        _extra_stats["memory_rejects"] = {
+            name: w["rejected"]
+            for name, w in db.memory.usage().items() if w["rejected"]
+        }
     except Exception as e:  # noqa: BLE001 — stats are best-effort
         log(f"layout-cache stats unavailable: {e}")
     emit(_times)

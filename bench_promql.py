@@ -53,6 +53,7 @@ _times: list[float] = []
 _warmup_times: list[float] = []  # SIGTERM fallback before any timed run
 _emitted = False
 _backend = "unknown"
+_cache_stats: dict = {}  # PromLayoutCache counters (resident PromQL state)
 
 
 def _line(times: list[float], warmup: bool = False) -> str:
@@ -69,6 +70,14 @@ def _line(times: list[float], warmup: bool = False) -> str:
         "eval_ms": round(sec * 1000, 1),
         "runs": len(times),
     }
+    # cold/warm attribution (round-5 gap: the one recorded run could not
+    # distinguish compile+build from steady state): cold = first eval
+    # (JIT compile + resident layout build), warm = this line's median
+    if _warmup_times:
+        line["eval_ms_cold"] = round(_warmup_times[0] * 1000, 1)
+    line["eval_ms_warm"] = round(sec * 1000, 1)
+    if _cache_stats:
+        line["promql_cache"] = _cache_stats
     notes = []
     if SERIES != 1_000_000:
         notes.append(f"reduced cardinality {SERIES}/1000000")
@@ -175,6 +184,14 @@ def main() -> None:
         np.asarray(res.values)  # materialize
         dt = time.time() - t0
         assert res.num_series == max(SERIES // 10, 1), res.num_series
+        # resident-cache counters (selection/sort/group hit-miss) for the
+        # line of record; per-eval events land in the stderr log
+        try:
+            _cache_stats.clear()
+            _cache_stats.update(db.promql_cache.stats())
+            _cache_stats["last_eval_events"] = dict(ev.cache_events)
+        except Exception as e:  # noqa: BLE001 — stats are best-effort
+            log(f"promql cache stats unavailable: {e}")
         return dt
 
     log("warmup (compile) ...")

@@ -142,6 +142,25 @@ def bucket_major_shardings(mesh, spad: int):
     }
 
 
+def promql_row_shardings(mesh, n: int):
+    """NamedShardings for the resident PromQL sort-layout arrays
+    (promql/engine.py _build_sort_layout) and padded selection vectors:
+    the leading axis — (tsid, ts)-sorted rows, or the pow2-padded selected
+    series — splits across the mesh so the per-eval window kernels
+    (searchsorted boundaries, reset-adjusted cumsums, segment folds) run
+    SPMD under GSPMD with XLA-inserted collectives, mirroring
+    bucket_major_shardings for the SQL aligned-window path.  Returns None
+    when the axis does not tile the mesh (caller keeps the replicated
+    placement)."""
+    if mesh is None:
+        return None
+    d = mesh.devices.size
+    if d <= 1 or n % d != 0:
+        return None
+    axis = mesh.axis_names[0]
+    return {"rows": NamedSharding(mesh, P(axis))}
+
+
 # key spec: ("tag", column, card) | ("time", ts_column, step, start, nbuckets)
 # agg spec: (output_name, op, column) with op in sum/count/min/max/mean
 _MERGE = {

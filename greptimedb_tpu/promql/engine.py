@@ -18,9 +18,13 @@ NaN encodes "absent" throughout (Prometheus staleness semantics).
 
 from __future__ import annotations
 
+import collections
+import collections.abc
 import math
+import os
 import re
 from dataclasses import dataclass, field
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -38,12 +42,112 @@ DEFAULT_LOOKBACK_S = 300.0
 _I64_MAX = np.int64(np.iinfo(np.int64).max)
 
 
+class LazySeriesLabels(collections.abc.Sequence):
+    """Label dicts for a matched series set, decoded ON DEMAND.
+
+    The round-5 profile showed per-eval O(series) host work dominating the
+    1M-series PromQL bench; the single largest term was select_series
+    materializing one Python dict per matched series.  This sequence keeps
+    only the tsid vector plus references into the region's dictionary
+    state (codes + vocabularies) and builds a dict only when someone
+    actually indexes it — aggregation never does (group ids come from the
+    code columns), so a `sum by(pod) (rate(m[5m]))` run decodes exactly
+    the output groups.
+
+    Also carries the selection's provenance (region id, generation,
+    matcher key) so eval_aggregation can key its resident group-id cache.
+    ``materializations`` counts dict constructions process-wide — the
+    tier-1 guard test pins it to O(output groups).
+    """
+
+    materializations = 0
+
+    def __init__(self, idx, tag_names, values, tsids, region_id: int,
+                 generation: int, matcher_key: tuple, cache):
+        self.idx = idx  # SeriesInvertedIndex (codes + vocabs)
+        self.tag_names = tag_names
+        self.values = values  # column -> raw encoder values (code-indexed)
+        self.tsids = tsids  # np.int32 [S]
+        self.region_id = region_id
+        self.generation = generation
+        self.matcher_key = matcher_key
+        self.cache = cache  # PromLayoutCache or None
+
+    def _label_at(self, i: int) -> dict:
+        LazySeriesLabels.materializations += 1
+        tsid = int(self.tsids[i])
+        codes = self.idx.codes
+        values = self.values
+        return {
+            name: values[name][int(codes[name][tsid])]
+            for name in self.tag_names
+            if 0 <= codes[name][tsid] < len(values[name])
+        }
+
+    def __len__(self) -> int:
+        return len(self.tsids)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._label_at(j) for j in range(*i.indices(len(self)))]
+        return self._label_at(i)
+
+    def __eq__(self, other):
+        if not isinstance(other, (list, tuple, collections.abc.Sequence)):
+            return NotImplemented
+        return len(self) == len(other) and all(
+            a == b for a, b in zip(self, other))
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
+
+    def __repr__(self) -> str:
+        return f"<LazySeriesLabels n={len(self)}>"
+
+
+class LazyGroupLabels(collections.abc.Sequence):
+    """Aggregation output labels, decoded per GROUP on demand: group g's
+    dict comes from its representative (first-appearance) input series via
+    the host group-key rule, so semantics are identical to the eager loop
+    while only ng dicts are ever built."""
+
+    def __init__(self, source, rep_rows, key_fn):
+        self.source = source  # input labels (usually LazySeriesLabels)
+        self.rep_rows = rep_rows  # np [ng] row index of each group's rep
+        self.key_fn = key_fn  # lab dict -> ((k, str v), ...) group key
+
+    def __len__(self) -> int:
+        return len(self.rep_rows)
+
+    def _label_at(self, g: int) -> dict:
+        return dict(self.key_fn(self.source[int(self.rep_rows[g])]))
+
+    def __getitem__(self, g):
+        if isinstance(g, slice):
+            return [self._label_at(j) for j in range(*g.indices(len(self)))]
+        return self._label_at(g)
+
+    def __eq__(self, other):
+        if not isinstance(other, (list, tuple, collections.abc.Sequence)):
+            return NotImplemented
+        return len(self) == len(other) and all(
+            a == b for a, b in zip(self, other))
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
+
+    def __repr__(self) -> str:
+        return f"<LazyGroupLabels n={len(self)}>"
+
+
 @dataclass
 class EvalResult:
     """A (possibly scalar) instant-vector time series matrix."""
 
     values: jnp.ndarray  # [S, T] f32; NaN = absent
-    labels: list[dict]  # len S
+    labels: "list[dict] | LazySeriesLabels | LazyGroupLabels"  # len S
     is_scalar: bool = False
 
     @property
@@ -67,6 +171,50 @@ def matcher_pred(matcher: LabelMatcher):
     raise PlanError(f"bad matcher {matcher.op}")
 
 
+def _series_group_ids(idx, tsids: np.ndarray, grouping, without: bool):
+    """Vectorized by/without group assignment from dictionary-encoded tag
+    codes — no per-series Python.  Per relevant column, codes remap to
+    canonical str-level term ids (missing merges with "" for ``by``,
+    stays distinct for ``without`` — exactly the information the host
+    group-key tuple carries); columns combine mixed-radix with dense
+    re-encoding before any possible int64 overflow; final ids renumber by
+    first appearance so group order matches the host enumeration.
+
+    Returns (gid_dev [S] i32, ng, rep_rows np [ng], row_order_dev [S],
+    seg_start np [ng])."""
+    if without:
+        use = sorted(n for n in idx.tag_names if n not in grouping)
+    else:
+        use = sorted(n for n in grouping if n in idx.codes)
+    S = len(tsids)
+    tsids64 = tsids.astype(np.int64)
+    combined = np.zeros(S, dtype=np.int64)
+    ncomb = 1
+    for name in use:
+        codes = idx.codes_for(name, tsids64)
+        V = len(idx.vocabs.get(name, []))
+        remap, ncanon = idx.canonical_codes(name, merge_missing_empty=not without)
+        pres = (codes >= 0) & (codes < V)
+        comp = remap[np.where(pres, codes, V)]
+        if ncanon > 1 and ncomb > (1 << 62) // ncanon:
+            _u, combined = np.unique(combined, return_inverse=True)
+            ncomb = len(_u)
+        combined = combined * ncanon + comp
+        ncomb *= max(ncanon, 1)
+    _uniq, first_idx, inv = np.unique(
+        combined, return_index=True, return_inverse=True)
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty(len(_uniq), dtype=np.int64)
+    rank[order] = np.arange(len(_uniq))
+    gids = rank[inv].astype(np.int32)
+    ng = len(_uniq)
+    rep_rows = first_idx[order]
+    row_order = np.argsort(gids, kind="stable")
+    seg_start = np.searchsorted(gids[row_order], np.arange(ng))
+    return (jnp.asarray(gids), ng, rep_rows, jnp.asarray(row_order),
+            seg_start)
+
+
 # ---------------------------------------------------------------------------
 # Window kernels
 # ---------------------------------------------------------------------------
@@ -83,53 +231,137 @@ class WindowParams:
     num_sel: int  # padded selected series count
     total_series: int
     kind: str  # which stats to compute
+    # padded max samples-per-series when the resident per-series bounds
+    # matrix serves window geometry (None = searchsorted over the full
+    # sorted key array); part of the key because the two geometries
+    # compile to different programs
+    bounds_l: int | None = None
 
 
 _KERNEL_CACHE: dict[WindowParams, object] = {}
 
 
-def _sorted_window_bounds(p: WindowParams, ts, val, tsid, mask, sel_tsids,
-                          start_ms):
-    """Shared window geometry for all window kernels: composite
-    (tsid, rel-ts) sort plus per-(series, step) half-open sample ranges
-    [lo, hi) with LEFT-EXCLUSIVE window semantics (t - range, t] — the
-    ONE definition the stats kernel and the matrix kernels build on.
+@jax.jit
+def _build_sort_layout(ts, val, tsid, mask):
+    """Composite-key sort of a resident table, QUERY-INDEPENDENT: the key
+    packs (tsid, ts − ts_min) with a stride covering the table's full time
+    span, so the permutation (and the gathered ts/val/tsid/valid arrays)
+    depends only on the data — it is built once per (region generation,
+    field column) and served resident by PromLayoutCache instead of being
+    re-derived inside every window kernel call.  Invalid rows (padding,
+    NULL values) sort to the end via a +inf key.
 
-    Returns (order, key_s, valid, lo, hi, cnt, has, sel_ok, n)."""
+    Returns (key_s, ts_s, val_s, tsid_s, valid_s, ts_min, kp); ts_min/kp
+    are 0-d device scalars, traced through the kernels so one compiled
+    program serves every region of the same shape class.
+    """
+    valid = mask & ~jnp.isnan(val)
+    any_valid = valid.any()
+    ts_min = jnp.where(
+        any_valid, jnp.min(jnp.where(valid, ts, _I64_MAX)), jnp.int64(0))
+    ts_max = jnp.where(
+        any_valid,
+        jnp.max(jnp.where(valid, ts, jnp.int64(-(1 << 62)))), jnp.int64(0))
+    # stride: rel = ts - ts_min ∈ [0, kp-2], so clip-to-(kp-1) bounds stay
+    # strictly above every data key (searchsorted side="right" correctness)
+    kp = ts_max - ts_min + 2
+    key = jnp.where(valid, tsid.astype(jnp.int64) * kp + (ts - ts_min),
+                    _I64_MAX)
+    order = jnp.argsort(key)
+    return (key[order], ts[order], val[order], tsid[order], valid[order],
+            ts_min, kp)
+
+
+def _sorted_window_bounds(p: WindowParams, key_s, ts_min, kp, sel_tsids,
+                          start_ms, bounds=None):
+    """Shared window geometry for all window kernels over a PRESORTED
+    resident layout (_build_sort_layout): per-(series, step) half-open
+    sample ranges [lo, hi) with LEFT-EXCLUSIVE window semantics
+    (t - range, t] — the ONE definition the stats kernel and the matrix
+    kernels build on.
+
+    Two interchangeable geometries (identical integer bounds, so results
+    are bit-exact either way):
+
+    - searchsorted (default): composite-key binary search over the full
+      sorted array — O(S·T·log N) RANDOM accesses, the right shape for
+      many steps;
+    - per-series bounds matrix (``bounds`` = (series_start [S], cnt_s [S],
+      ts_mat [S, L]), resident per selection): each window boundary is a
+      count of that series' timestamps ≤ threshold — O(S·T·L) SEQUENTIAL
+      compares, ~10× faster for instant-style queries where the
+      binary search is DRAM-latency-bound.
+
+    Returns (lo, hi, cnt, has, sel_ok, n)."""
     T = p.num_steps
     S = p.num_sel
-    n = ts.shape[0]
-    base = start_ms - p.range_ms - 1
-    span = p.step_ms * (T + 2) + p.range_ms + 2
-    K = np.int64(1) << int(span - 1).bit_length() if span > 0 else np.int64(2)
-    # composite sort key; padding/invalid rows to +inf so order holds
-    rel = jnp.clip(ts - base, 0, K - 1)
-    valid = mask & ~jnp.isnan(val) & (ts > base) & (ts - base < K)
-    key = jnp.where(valid, tsid.astype(jnp.int64) * K + rel, _I64_MAX)
-    # data is sorted by (tsid, ts) but NaN/out-of-range rows poke holes;
-    # re-sort keys (cheap vs correctness; XLA sorts well)
-    order = jnp.argsort(key)
-    key_s = key[order]
+    n = key_s.shape[0]
     steps = start_ms + p.step_ms * jnp.arange(T, dtype=jnp.int64)  # [T]
-    sel64 = sel_tsids.astype(jnp.int64)  # [S]
     sel_ok = sel_tsids >= 0
-    skey = jnp.where(sel_ok, sel64, 0) * K  # [S]
-    # window (t - range, t]: left-exclusive
-    lo_k = skey[:, None] + jnp.clip(
-        steps[None, :] - p.range_ms - base + 1, 1, K - 1)
-    hi_k = skey[:, None] + jnp.clip(steps[None, :] - base, 1, K - 1)
-    lo = jnp.searchsorted(key_s, lo_k.reshape(-1), side="left").reshape(S, T)
-    hi = jnp.searchsorted(key_s, hi_k.reshape(-1), side="right").reshape(S, T)
-    cnt = (hi - lo).astype(jnp.int32)
+    if bounds is not None:
+        series_start, cnt_s, ts_mat = bounds
+        # lo offset = #samples with ts ≤ t − range (left-exclusive window
+        # starts right after them); hi offset = #samples with ts ≤ t.
+        # Padding slots hold I64_MAX so they never count.
+        lo_off = jnp.sum(
+            ts_mat[:, None, :] <= (steps - p.range_ms)[None, :, None],
+            axis=-1, dtype=jnp.int32)
+        hi_off = jnp.sum(
+            ts_mat[:, None, :] <= steps[None, :, None],
+            axis=-1, dtype=jnp.int32)
+        lo = series_start[:, None] + lo_off
+        hi = series_start[:, None] + hi_off
+        cnt = hi_off - lo_off
+        has = (cnt > 0) & sel_ok[:, None]
+        return lo, hi, cnt, has, sel_ok, n
+    sel64 = sel_tsids.astype(jnp.int64)  # [S]
+    skey = jnp.where(sel_ok, sel64, 0) * kp  # [S]
+    # window (t - range, t]: left-exclusive.  rel_hi clips to -1 (a key
+    # strictly below this series' first sample) so windows entirely before
+    # the data come out empty; both clips cap at kp-1 > every data rel.
+    rel_lo = jnp.clip(steps[None, :] - p.range_ms + 1 - ts_min, 0, kp - 1)
+    rel_hi = jnp.clip(steps[None, :] - ts_min, -1, kp - 1)
+    lo = jnp.searchsorted(
+        key_s, (skey[:, None] + rel_lo).reshape(-1), side="left"
+    ).reshape(S, T)
+    hi = jnp.searchsorted(
+        key_s, (skey[:, None] + rel_hi).reshape(-1), side="right"
+    ).reshape(S, T)
+    cnt = jnp.maximum(hi - lo, 0).astype(jnp.int32)
     has = (cnt > 0) & sel_ok[:, None]
-    return order, key_s, valid, lo, hi, cnt, has, sel_ok, n
+    return lo, hi, cnt, has, sel_ok, n
+
+
+@jax.jit
+def _series_ranges(key_s, kp, sel_tsids):
+    """Query-independent row range of each selected series in the sorted
+    layout: [start, start+cnt).  skey+kp−1 exceeds every key of the series
+    (rel ≤ kp−2) and undercuts the next series' first key (skey+kp)."""
+    sel_ok = sel_tsids >= 0
+    skey = jnp.where(sel_ok, sel_tsids.astype(jnp.int64), 0) * kp
+    start = jnp.searchsorted(key_s, skey, side="left")
+    end = jnp.searchsorted(key_s, skey + (kp - 1), side="right")
+    return start, jnp.where(sel_ok, (end - start).astype(jnp.int32), 0)
+
+
+@partial(jax.jit, static_argnums=3)
+def _gather_ts_mat(ts_s, start, cnt_s, L: int):
+    """[S, L] per-series timestamp matrix (padding = I64_MAX so threshold
+    compares never count it); rows gathered from the sorted layout."""
+    n = ts_s.shape[0]
+    j = jnp.arange(L, dtype=jnp.int32)
+    idx = jnp.clip(start[:, None] + j[None, :], 0, n - 1)
+    mat = ts_s[idx]
+    return jnp.where(j[None, :] < cnt_s[:, None], mat, _I64_MAX)
 
 
 def _window_kernel(p: WindowParams):
     """Build the jitted kernel computing window stats for selected series.
 
-    Inputs: ts [N] i64, val [N] f32, tsid [N] i32, mask [N] bool,
-            sel_tsids [S] i32 (padded with -1), start_ms scalar i64.
+    Inputs: the presorted resident layout (key_s [N] i64, ts_s [N] i64,
+            val_s [N] f32, tsid_s [N] i32, valid_s [N] bool, ts_min, kp —
+            see _build_sort_layout), sel_tsids [S] i32 (padded with -1),
+            start_ms scalar i64.
     Output dict of [S, T] arrays depending on p.kind.
     """
 
@@ -137,15 +369,15 @@ def _window_kernel(p: WindowParams):
     S = p.num_sel
 
     @jax.jit
-    def kernel(ts, val, tsid, mask, sel_tsids, start_ms):
-        order, key_s, valid, lo, hi, cnt, has, sel_ok, n = (
-            _sorted_window_bounds(p, ts, val, tsid, mask, sel_tsids,
-                                  start_ms)
-        )
-        val_s = val[order]
-        ts_s = ts[order]
-        tsid_s = tsid[order]
-        valid_s = valid[order]
+    def kernel(key_s, ts_s, val_s, tsid_s, valid_s, ts_min, kp, *rest):
+        if p.bounds_l is not None:
+            series_start, cnt_s, ts_mat, sel_tsids, start_ms = rest
+            bounds = (series_start, cnt_s, ts_mat)
+        else:
+            sel_tsids, start_ms = rest
+            bounds = None
+        lo, hi, cnt, has, sel_ok, n = _sorted_window_bounds(
+            p, key_s, ts_min, kp, sel_tsids, start_ms, bounds)
 
         # per-series counter-reset adjustment (for counter kinds)
         prev_same = jnp.concatenate(
@@ -178,7 +410,8 @@ def _window_kernel(p: WindowParams):
         fcnt = cnt.astype(jnp.float32)
         nan = jnp.float32(jnp.nan)
 
-        if p.kind in ("counter", "gauge_window", "regression", "instant"):
+        if p.kind in ("counter", "counter_rc", "gauge_window", "regression",
+                      "instant"):
             out["count"] = jnp.where(has, fcnt, 0.0)
         if p.kind == "instant":
             lastv = val_s[last_i]
@@ -197,7 +430,10 @@ def _window_kernel(p: WindowParams):
             out["delta_raw"] = jnp.where(
                 has2, val_s[last_i] - val_s[first_i], nan
             )
-            # resets/changes counts via indicator cumsums
+        if p.kind == "counter_rc":
+            # resets/changes counts via indicator cumsums — a SEPARATE
+            # kind so the (much hotter) rate/increase/delta path doesn't
+            # pay two extra full-table cumsums it never reads
             ind_reset = jnp.where(prev_same & (prev_val > val_s), 1.0, 0.0)
             ind_change = jnp.where(prev_same & (prev_val != val_s), 1.0, 0.0)
             cs_r = cs(ind_reset)
@@ -285,9 +521,10 @@ def _count_max_kernel(p: WindowParams):
     kernels' static padded width (one cheap pass, cached per shape)."""
 
     @jax.jit
-    def kernel(ts, val, tsid, mask, sel_tsids, start_ms):
-        _o, _k, _v, _lo, _hi, cnt, _has, sel_ok, _n = _sorted_window_bounds(
-            p, ts, val, tsid, mask, sel_tsids, start_ms)
+    def kernel(key_s, ts_s, val_s, tsid_s, valid_s, ts_min, kp, sel_tsids,
+               start_ms):
+        _lo, _hi, cnt, _has, sel_ok, _n = _sorted_window_bounds(
+            p, key_s, ts_min, kp, sel_tsids, start_ms)
         return jnp.max(jnp.where(sel_ok[:, None], cnt, 0))
 
     return kernel
@@ -311,12 +548,10 @@ def _matrix_kernel(p: WindowParams, lmax: int, kind: str):
     T, S = p.num_steps, p.num_sel
 
     @jax.jit
-    def kernel(ts, val, tsid, mask, sel_tsids, start_ms, a1, a2):
-        order, _key_s, _valid, lo, hi, cnt, has, sel_ok, n = (
-            _sorted_window_bounds(p, ts, val, tsid, mask, sel_tsids,
-                                  start_ms)
-        )
-        val_s = val[order]
+    def kernel(key_s, ts_s, val_s, tsid_s, valid_s, ts_min, kp, sel_tsids,
+               start_ms, a1, a2):
+        lo, hi, cnt, has, sel_ok, n = _sorted_window_bounds(
+            p, key_s, ts_min, kp, sel_tsids, start_ms)
         lof = lo.reshape(-1)  # [W] with W = S*T
         cntf = cnt.reshape(-1)
         j = jnp.arange(lmax, dtype=jnp.int32)
@@ -377,19 +612,32 @@ def _matrix_kernel(p: WindowParams, lmax: int, kind: str):
 class SelectorData:
     """Host-side prepared state for one table used by selectors."""
 
-    def __init__(self, db, table: str):
+    def __init__(self, db, table: str, events=None):
         # partitioned tables come back as a CombinedRegionView duck-typing
         # the Region surface (encoders/_series/scan_host/num_series)
         region = (
             db._table_view(table) if hasattr(db, "_table_view")
             else db._region_of(table)
         )
+        self.db = db
         self.region = region
         self.table = db.cache.get(region)
         self.schema = region.schema
         self.ts_name = region.schema.time_index.name
         self.tag_names = region.tag_names
         self.encoders = region.encoders
+        # per-eval cache event counter shared with the evaluator (bench
+        # observability: selection/sort/group hit/miss/reject/uncached)
+        self.events = events if events is not None else collections.Counter()
+
+    def promql_cache(self):
+        """The db's resident PromLayoutCache, or None when caching is off
+        (GREPTIME_PROMQL_CACHE=off A/B knob) or the db has none.  Both
+        states serve evals from the identical transient-build code path,
+        so cached and uncached results are bit-exact by construction."""
+        if os.environ.get("GREPTIME_PROMQL_CACHE", "on") == "off":
+            return None
+        return getattr(self.db, "promql_cache", None)
 
     def field_column(self, matchers: list[LabelMatcher]) -> str:
         fields = [c.name for c in self.schema.field_columns]
@@ -407,34 +655,150 @@ class SelectorData:
             f"table has {len(fields)} fields; use __field__ matcher: {fields}"
         )
 
-    def select_series(self, matchers: list[LabelMatcher]) -> tuple[np.ndarray, list[dict]]:
-        """Returns (tsids, labels dicts) matching the label matchers.
+    def select_series(
+        self, matchers: list[LabelMatcher]
+    ) -> tuple[np.ndarray, jnp.ndarray, LazySeriesLabels]:
+        """Returns (tsids, padded device tsids, lazy labels) matching the
+        label matchers.
 
         Inverted-index evaluation (storage/inverted.py): each matcher runs
         once per DISTINCT term of its label and selects via posting lists —
         O(vocabulary) string work, not O(series).  The reference gets the
         same effect from its FST+bitmap inverted index
-        (src/index/src/inverted_index/)."""
+        (src/index/src/inverted_index/).  The matched tsid set (and its
+        pow2-padded device copy) is resident per (region generation,
+        matcher set); labels are NOT materialized here — LazySeriesLabels
+        decodes a dict only when indexed, so aggregations touch zero
+        per-series Python objects."""
         from greptimedb_tpu.storage.inverted import get_series_index
 
         tag_matchers = [m for m in matchers if m.name != "__field__"]
+        mkey = tuple(sorted((m.name, m.op, m.value) for m in tag_matchers))
+        # registry-only version: selections (and the group ids derived
+        # from them) survive data appends of existing series
+        gen = getattr(self.region, "series_generation",
+                      self.region.generation)
         idx = get_series_index(self.region)
-        sel_tsids = idx.all_tsids
-        for m in tag_matchers:
-            if sel_tsids.size == 0:
-                break
-            pred, neg = matcher_pred(m)
-            matched = idx.select(m.name, pred, negate=neg)
-            sel_tsids = np.intersect1d(sel_tsids, matched, assume_unique=True)
-        values = {name: self.encoders[name].values() for name in self.tag_names}
-        labels = []
-        for tsid in sel_tsids:
-            labels.append({
-                name: values[name][int(idx.codes[name][tsid])]
-                for name in self.tag_names
-                if 0 <= idx.codes[name][tsid] < len(values[name])
-            })
-        return sel_tsids.astype(np.int32), labels
+        cache = self.promql_cache()
+        rid = getattr(self.region, "region_id", None)
+        sel = None
+        if cache is not None and rid is not None:
+            sel = cache.lookup("selection", rid, mkey, gen)
+            self.events["selection_hit" if sel is not None
+                        else "selection_miss"] += 1
+        if sel is None:
+            sel_tsids = idx.all_tsids
+            for m in tag_matchers:
+                if sel_tsids.size == 0:
+                    break
+                pred, neg = matcher_pred(m)
+                matched = idx.select(m.name, pred, negate=neg)
+                sel_tsids = np.intersect1d(sel_tsids, matched,
+                                           assume_unique=True)
+            sel_tsids = sel_tsids.astype(np.int32)
+            S = max(1, 1 << (max(len(sel_tsids), 1) - 1).bit_length())
+            padded = np.full(S, -1, dtype=np.int32)
+            padded[: len(sel_tsids)] = sel_tsids
+            sel_dev = jnp.asarray(padded)
+            if cache is not None and cache.mesh is not None:
+                from greptimedb_tpu.parallel.dist import promql_row_shardings
+
+                sh = promql_row_shardings(cache.mesh, S)
+                if sh is not None:
+                    sel_dev = jax.device_put(sel_dev, sh["rows"])
+            sel = (sel_tsids, sel_dev)
+            if cache is not None and rid is not None:
+                nbytes = sel_tsids.nbytes + int(sel_dev.nbytes)
+                if cache.admit(nbytes):
+                    cache.store("selection", rid, mkey, gen, sel, nbytes)
+                else:
+                    self.events["selection_reject"] += 1
+        sel_tsids, sel_dev = sel
+        # label values decode from the index's shared per-region raw
+        # vocabularies — selections hold no per-matcher-set copies
+        labels = LazySeriesLabels(
+            idx, self.tag_names, idx.raw_values, sel_tsids,
+            rid if rid is not None else -1, gen, mkey, cache)
+        return sel_tsids, sel_dev, labels
+
+    def sort_layout(self, fieldcol: str) -> tuple:
+        """The resident composite-key sort of this table for ``fieldcol``
+        (see _build_sort_layout): served from PromLayoutCache per
+        (resident-table dicts_version, field column); a miss builds and —
+        if admission under the promql_cache workload quota succeeds —
+        stores it.  A rejected build serves this eval transiently from
+        the same arrays (reject-to-fallback, bit-exact either way)."""
+        cache = self.promql_cache()
+        rid = getattr(self.region, "region_id", None)
+        version = self.table.dicts_version
+        if cache is not None and rid is not None:
+            payload = cache.lookup("sort", rid, (fieldcol,), version)
+            if payload is not None:
+                self.events["sort_hit"] += 1
+                return payload
+            self.events["sort_miss"] += 1
+        cols = self.table.columns
+        arrays = _build_sort_layout(
+            cols[self.ts_name], cols[fieldcol], cols[TSID],
+            self.table.row_mask)
+        if cache is not None and rid is not None:
+            nbytes = sum(int(a.nbytes) for a in arrays)
+            if cache.admit(nbytes):
+                if cache.mesh is not None:
+                    from greptimedb_tpu.parallel.dist import (
+                        promql_row_shardings,
+                    )
+
+                    sh = promql_row_shardings(cache.mesh,
+                                              int(arrays[0].shape[0]))
+                    if sh is not None:
+                        arrays = tuple(
+                            jax.device_put(a, sh["rows"]) if a.ndim else a
+                            for a in arrays
+                        )
+                cache.store("sort", rid, (fieldcol,), version, arrays,
+                            nbytes)
+            else:
+                self.events["sort_reject"] += 1
+        return arrays
+
+    def window_bounds(self, fieldcol: str, layout: tuple, sel_dev,
+                      matcher_key: tuple):
+        """Resident per-(selection, field) window-geometry state: each
+        selected series' row range in the sorted layout plus its [S, L]
+        timestamp matrix (L = padded max samples/series).  Window
+        boundaries then cost O(T·L) sequential compares per series
+        instead of an O(T·log N) DRAM-latency-bound binary search —
+        ~10× on instant queries at 1M series.  Returns
+        (series_start, cnt_s, ts_mat, L) or None (cache off / reject):
+        callers fall back to the searchsorted geometry, which produces
+        the same integer bounds bit-exactly."""
+        cache = self.promql_cache()
+        rid = getattr(self.region, "region_id", None)
+        if cache is None or rid is None:
+            return None  # resident-only accelerator; transient builds
+            # would cost more than the searchsorted they replace
+        version = self.table.dicts_version
+        ckey = (matcher_key, fieldcol)
+        payload = cache.lookup("bounds", rid, ckey, version)
+        if payload is not None:
+            self.events["bounds_hit"] += 1
+            return payload
+        self.events["bounds_miss"] += 1
+        key_s, ts_s = layout[0], layout[1]
+        kp = layout[6]
+        start, cnt_s = _series_ranges(key_s, kp, sel_dev)
+        lmax = int(jnp.max(cnt_s)) if cnt_s.size else 0
+        L = max(1, 1 << (max(lmax, 1) - 1).bit_length())
+        nbytes = int(start.nbytes) + int(cnt_s.nbytes) + \
+            int(sel_dev.shape[0]) * L * 8
+        if not cache.admit(nbytes):
+            self.events["bounds_reject"] += 1
+            return None
+        ts_mat = _gather_ts_mat(ts_s, start, cnt_s, L)
+        payload = (start, cnt_s, ts_mat, L)
+        cache.store("bounds", rid, ckey, version, payload, nbytes)
+        return payload
 
 
 class PromEvaluator:
@@ -453,11 +817,15 @@ class PromEvaluator:
         self.lookback_ms = int(lookback_s * 1000)
         self._data: dict[str, SelectorData] = {}
         self._kernels: dict[tuple, object] = {}
+        # resident-cache event counter for this evaluation (selection /
+        # sort / group × hit / miss / reject) — surfaced to bench_promql
+        self.cache_events: collections.Counter = collections.Counter()
 
     # ---- plumbing -------------------------------------------------------
     def data_for(self, metric: str) -> SelectorData:
         if metric not in self._data:
-            self._data[metric] = SelectorData(self.db, metric)
+            self._data[metric] = SelectorData(self.db, metric,
+                                              self.cache_events)
         return self._data[metric]
 
     def steps_ms(self) -> np.ndarray:
@@ -466,7 +834,8 @@ class PromEvaluator:
     _KIND_KEYS = {
         "instant": ("count", "last", "last_ts"),
         "counter": ("count", "first_ts", "last_ts", "first_val", "last_val",
-                    "delta_adj", "delta_raw", "resets", "changes"),
+                    "delta_adj", "delta_raw"),
+        "counter_rc": ("count", "resets", "changes"),
         "gauge_window": ("count", "sum", "avg", "var", "last", "first",
                          "first_ts", "last_ts"),
         "regression": ("count", "slope", "intercept", "last_ts"),
@@ -475,7 +844,8 @@ class PromEvaluator:
     }
 
     def _prep_window(self, sel: VectorSelector, kind: str,
-                     range_ms: int | None = None):
+                     range_ms: int | None = None,
+                     allow_bounds: bool = True):
         """Shared selector→kernel-args prep for the stats and matrix
         kernels (ONE definition of pow2 series padding, range/offset/@
         resolution, and the kernel argument tuple).  Returns
@@ -484,10 +854,8 @@ class PromEvaluator:
         vector, Prometheus semantics)."""
         d = self.data_for(sel.metric)
         fieldcol = d.field_column(sel.matchers)
-        tsids, labels = d.select_series(sel.matchers)
-        S = max(1, 1 << (max(len(tsids), 1) - 1).bit_length())
-        sel_padded = np.full(S, -1, dtype=np.int32)
-        sel_padded[: len(tsids)] = tsids
+        tsids, sel_dev, labels = d.select_series(sel.matchers)
+        S = int(sel_dev.shape[0])
         rng = range_ms
         if rng is None:
             rng = int(sel.range_s * 1000) if sel.range_s else self.lookback_ms
@@ -501,6 +869,18 @@ class PromEvaluator:
         else:
             start = self.start_ms - offset_ms
             num_steps = self.num_steps
+        layout = d.sort_layout(fieldcol)
+        bounds_l = None
+        extra: tuple = ()
+        # per-series bounds matrix: resident-only accelerator for few-step
+        # windows (the S·T·L compare sweep must stay cheaper than the
+        # S·T·log N binary search it replaces)
+        if allow_bounds and num_steps <= 64:
+            b = d.window_bounds(fieldcol, layout, sel_dev,
+                                labels.matcher_key)
+            if b is not None and S * num_steps * b[3] <= (1 << 27):
+                bounds_l = b[3]
+                extra = b[:3]
         p = WindowParams(
             step_ms=self.step_ms,
             num_steps=num_steps,
@@ -508,12 +888,9 @@ class PromEvaluator:
             num_sel=S,
             total_series=max(d.region.num_series, 1),
             kind=kind,
+            bounds_l=bounds_l,
         )
-        cols = d.table.columns
-        args = (
-            cols[d.ts_name], cols[fieldcol], cols[TSID].astype(jnp.int32),
-            d.table.row_mask, jnp.asarray(sel_padded), np.int64(start),
-        )
+        args = layout + extra + (sel_dev, np.int64(start))
         return args, p, tsids, labels, pinned, start, int(rng)
 
     def _run_window(
@@ -522,7 +899,11 @@ class PromEvaluator:
         try:
             prep = self._prep_window(sel, kind, range_ms)
         except TableNotFound:
-            # unknown metric = empty vector (Prometheus semantics)
+            # unknown metric = empty vector (Prometheus semantics); the
+            # grid must still be recorded — rate/increase read it
+            # unconditionally right after (seed bug: AttributeError when
+            # the FIRST selector of an evaluator was an unknown metric)
+            self._last_window_grid = (self.start_ms, range_ms or 0, False)
             empty = jnp.zeros((0, self.num_steps), jnp.float32)
             return {k: empty for k in self._KIND_KEYS[kind]}, []
         args, p, tsids, labels, pinned, start, rng = prep
@@ -550,7 +931,7 @@ class PromEvaluator:
         import dataclasses
 
         try:
-            prep = self._prep_window(sel, kind)
+            prep = self._prep_window(sel, kind, allow_bounds=False)
         except TableNotFound:
             return jnp.zeros((0, self.num_steps), jnp.float32), []
         args, p, tsids, labels, pinned, _start, _rng = prep
@@ -709,7 +1090,7 @@ class PromEvaluator:
             return EvalResult(vals, labels)
         if f in ("resets", "changes"):
             sel = self._selector_arg(e, 0)
-            out, labels = self._run_window(sel, "counter")
+            out, labels = self._run_window(sel, "counter_rc")
             return EvalResult(out[f], labels)
         if f in ("avg_over_time", "sum_over_time", "count_over_time",
                  "last_over_time", "first_over_time", "stddev_over_time",
@@ -1005,11 +1386,25 @@ class PromEvaluator:
             raise PlanError(f"{who} parameter evaluates to NaN")
         return v
 
-    def eval_aggregation(self, e: Aggregation) -> EvalResult:
-        r = self.eval(e.expr)
-        if r.num_series == 0:
-            return r
-        # group series by label subset on host
+    def _group_series(self, e: Aggregation, r: EvalResult):
+        """Group-id assignment for an aggregation input — the ONE
+        definition of PromQL grouping semantics, with two providers:
+
+        - resident path (input labels still ARE the selector's
+          LazySeriesLabels): group ids are computed VECTORIZED from the
+          region's dictionary-encoded tag codes (canonical str-level term
+          ids per column, mixed-radix combine, first-appearance
+          renumbering) and held resident per (selection, grouping) in
+          PromLayoutCache — no per-series Python objects at all;
+        - host fallback (label-transforming functions ran in between):
+          the original dict loop.
+
+        Returns (gid_dev [S] i32, ng, out_labels, row_order_dev [S],
+        seg_start np [ng]) where row_order/seg_start give the
+        group-contiguous row permutation used by the segment-sorted
+        quantile/topk kernels.
+        """
+
         def group_key(lab: dict) -> tuple:
             if e.without:
                 keys = sorted(k for k in lab if k not in e.grouping)
@@ -1019,54 +1414,114 @@ class PromEvaluator:
                 keys = []
             return tuple((k, str(lab.get(k, ""))) for k in keys)
 
+        labels = r.labels
+        n = r.num_series
+        gspec = ("without" if e.without else "by",
+                 tuple(sorted(e.grouping or ())))
+        if isinstance(labels, LazySeriesLabels) and n == len(labels.tsids):
+            cache = labels.cache
+            ckey = (labels.matcher_key, gspec)
+            payload = None
+            if cache is not None:
+                payload = cache.lookup("group", labels.region_id, ckey,
+                                       labels.generation)
+                self.cache_events["group_hit" if payload is not None
+                                  else "group_miss"] += 1
+            if payload is None:
+                payload = _series_group_ids(labels.idx, labels.tsids,
+                                            e.grouping or [], e.without)
+                if cache is not None:
+                    nbytes = sum(
+                        int(a.nbytes) for a in payload
+                        if hasattr(a, "nbytes"))
+                    if cache.admit(nbytes):
+                        cache.store("group", labels.region_id, ckey,
+                                    labels.generation, payload, nbytes)
+                    else:
+                        self.cache_events["group_reject"] += 1
+            gid_dev, ng, rep_rows, row_order_dev, seg_start = payload
+            out_labels = LazyGroupLabels(labels, rep_rows, group_key)
+            return gid_dev, ng, out_labels, row_order_dev, seg_start
+
         groups: dict[tuple, int] = {}
-        gids = np.zeros(r.num_series, dtype=np.int32)
+        gids = np.zeros(n, dtype=np.int32)
         out_labels: list[dict] = []
-        for i, lab in enumerate(r.labels):
+        for i, lab in enumerate(labels):
             k = group_key(lab)
             if k not in groups:
                 groups[k] = len(groups)
                 out_labels.append(dict(k))
             gids[i] = groups[k]
         ng = len(groups)
+        row_order = np.argsort(gids, kind="stable")
+        seg_start = np.searchsorted(gids[row_order], np.arange(ng))
+        return (jnp.asarray(gids), ng, out_labels, jnp.asarray(row_order),
+                seg_start)
+
+    def eval_aggregation(self, e: Aggregation) -> EvalResult:
+        r = self.eval(e.expr)
+        if r.num_series == 0:
+            return r
+        gid_dev, ng, out_labels, row_order_dev, seg_start = (
+            self._group_series(e, r))
         v = r.values
+        S = v.shape[0]
         present = ~jnp.isnan(v)
-        gid_dev = jnp.asarray(gids)
-        cnt = jax.ops.segment_sum(present.astype(jnp.float32), gid_dev, num_segments=ng)
+        # int32 count accumulator: float32 segment sums lose exactness
+        # past 2^24 members per group (mirrors PR 1's mesh int-SUM fix)
+        cnt = jax.ops.segment_sum(present.astype(jnp.int32), gid_dev,
+                                  num_segments=ng)
+        fcnt = cnt.astype(jnp.float32)
+        has = cnt > 0
 
         if e.op in ("sum", "avg", "count", "group", "stddev", "stdvar"):
             s = jax.ops.segment_sum(jnp.where(present, v, 0), gid_dev, num_segments=ng)
             if e.op == "sum":
-                out = jnp.where(cnt > 0, s, jnp.nan)
+                out = jnp.where(has, s, jnp.nan)
             elif e.op == "avg":
-                out = jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), jnp.nan)
+                out = jnp.where(has, s / jnp.maximum(fcnt, 1), jnp.nan)
             elif e.op == "count":
-                out = jnp.where(cnt > 0, cnt, jnp.nan)
+                out = jnp.where(has, fcnt, jnp.nan)
             elif e.op == "group":
-                out = jnp.where(cnt > 0, 1.0, jnp.nan)
+                out = jnp.where(has, 1.0, jnp.nan)
             else:
                 s2 = jax.ops.segment_sum(
                     jnp.where(present, v * v, 0), gid_dev, num_segments=ng
                 )
-                mean = s / jnp.maximum(cnt, 1)
-                var = jnp.maximum(s2 / jnp.maximum(cnt, 1) - mean * mean, 0)
-                out = jnp.where(cnt > 0, var if e.op == "stdvar" else jnp.sqrt(var),
+                mean = s / jnp.maximum(fcnt, 1)
+                var = jnp.maximum(s2 / jnp.maximum(fcnt, 1) - mean * mean, 0)
+                out = jnp.where(has, var if e.op == "stdvar" else jnp.sqrt(var),
                                 jnp.nan)
             return EvalResult(out, out_labels)
         if e.op in ("min", "max"):
             fill = jnp.inf if e.op == "min" else -jnp.inf
             fn = jax.ops.segment_min if e.op == "min" else jax.ops.segment_max
             out = fn(jnp.where(present, v, fill), gid_dev, num_segments=ng)
-            return EvalResult(jnp.where(cnt > 0, out, jnp.nan), out_labels)
+            return EvalResult(jnp.where(has, out, jnp.nan), out_labels)
         if e.op == "quantile":
+            # segment-sorted ranks: ONE device dispatch for all groups —
+            # rows permuted group-contiguous, a two-key lexicographic sort
+            # orders values within each segment per step (NaNs sort last),
+            # then the two straddling order statistics interpolate
+            # (Prometheus linear quantile, same rule as quantile_over_time)
             q = self._scalar_param(e.param, "quantile")
-            # per group nanquantile via host loop over groups (group counts
-            # are small); device computes each
-            outs = []
-            for g in range(ng):
-                rows = np.nonzero(gids == g)[0]
-                outs.append(jnp.nanquantile(v[jnp.asarray(rows)], q, axis=0))
-            return EvalResult(jnp.stack(outs).astype(jnp.float32), out_labels)
+            gs = gid_dev[row_order_dev]
+            gb = jnp.broadcast_to(gs[:, None], v.shape)
+            _, sv = jax.lax.sort((gb, v[row_order_dev]), dimension=0,
+                                 num_keys=2)
+            base = jnp.asarray(seg_start, dtype=jnp.int32)[:, None]  # [ng,1]
+            rank = jnp.float32(q) * jnp.maximum(fcnt - 1, 0)  # [ng, T]
+            lo_r = jnp.floor(rank).astype(jnp.int32)
+            hi_r = jnp.ceil(rank).astype(jnp.int32)
+            vlo = jnp.take_along_axis(sv, jnp.clip(base + lo_r, 0, S - 1), 0)
+            vhi = jnp.take_along_axis(sv, jnp.clip(base + hi_r, 0, S - 1), 0)
+            out = vlo + (vhi - vlo) * (rank - lo_r.astype(jnp.float32))
+            if q < 0:
+                out = jnp.full_like(out, -jnp.inf)
+            elif q > 1:
+                out = jnp.full_like(out, jnp.inf)
+            out = jnp.where(has, out, jnp.nan)
+            return EvalResult(out.astype(jnp.float32), out_labels)
         if e.op in ("topk", "bottomk"):
             k = int(self._scalar_param(e.param, e.op))
             if k <= 0:
@@ -1077,14 +1532,21 @@ class PromEvaluator:
                 kth = -jnp.sort(-work, axis=0)[jnp.minimum(k - 1, v.shape[0] - 1)]
                 keep = work >= kth[None, :]
             else:
-                # per-group top-k: rank within group via sort of (gid, -val)
-                keep = jnp.zeros(v.shape, bool)
-                for g in range(ng):
-                    rows = np.nonzero(gids == g)[0]
-                    sub = work[jnp.asarray(rows)]
-                    kk = min(k, len(rows))
-                    kth = -jnp.sort(-sub, axis=0)[kk - 1]
-                    keep = keep.at[jnp.asarray(rows)].set(sub >= kth[None, :])
+                # per-group k-th value via ONE segment-sorted dispatch:
+                # sort (gid, -work) lexicographically per step, read each
+                # group's (min(k, size)-1)-th row, then keep every row at
+                # or above its group's threshold (ties kept, as before)
+                gs = gid_dev[row_order_dev]
+                gb = jnp.broadcast_to(gs[:, None], v.shape)
+                _, sw = jax.lax.sort((gb, -work[row_order_dev]), dimension=0,
+                                     num_keys=2)
+                sizes = np.diff(np.append(seg_start, S))
+                kth_row = jnp.asarray(
+                    seg_start + np.minimum(k, sizes) - 1, dtype=jnp.int32)
+                kth = -jnp.take_along_axis(
+                    sw, jnp.broadcast_to(kth_row[:, None], (ng, v.shape[1])),
+                    0)
+                keep = work >= kth[gid_dev]
             out = jnp.where(keep & present, v, jnp.nan)
             return EvalResult(out, r.labels)
         raise Unsupported(f"aggregation {e.op}")

@@ -378,7 +378,7 @@ class HttpServer(ThreadedAiohttpApp):
                     d = SelectorData(self.db, e.metric)
                 except GreptimeError:
                     continue
-                _tsids, labels = d.select_series(e.matchers)
+                _tsids, _sel_dev, labels = d.select_series(e.matchers)
                 for lab in labels:
                     item = {"__name__": e.metric}
                     item.update({k: str(v) for k, v in lab.items()})
@@ -515,7 +515,7 @@ class HttpServer(ThreadedAiohttpApp):
                 except TableNotFound:
                     results.append([])  # unknown metric: empty, not 5xx
                     continue
-                tsids, labels = data.select_series(matchers)
+                tsids, _sel_dev, labels = data.select_series(matchers)
                 field = data.field_column(matchers)
                 # equality matchers prune SSTs via the bloom sidecars
                 tag_filters = {

@@ -118,6 +118,14 @@ class CombinedRegionView:
         return sum(r.generation for r in self.regions) + len(self.regions)
 
     @property
+    def series_generation(self) -> tuple:
+        """Registry-only version (see Region.series_generation): the
+        combined dictionaries/series rebuild deterministically from the
+        member registries, so the tuple of member versions is
+        content-stable across data-only appends."""
+        return tuple(r.series_generation for r in self.regions)
+
+    @property
     def tag_names(self) -> list[str]:
         return [c.name for c in self.schema.tag_columns]
 
@@ -307,6 +315,25 @@ class GreptimeDB(TableProvider):
         # chain drop/truncate/repartition invalidation into the derived
         # layouts so a dead region's partials free immediately
         self.cache.derived_layouts = _layout
+        # resident PromQL evaluation cache (promql/engine.py): matched
+        # tsid selections, composite-key sort layouts and group-id
+        # vectors, generation-invalidated like the SQL layout cache and
+        # admitted under its own workload quota with reject-to-fallback
+        from greptimedb_tpu.storage.cache import PromLayoutCache
+
+        self.promql_cache = PromLayoutCache(mesh=self.mesh)
+        _pq_quota = os.environ.get("GREPTIME_PROMQL_CACHE_QUOTA_BYTES")
+        self.memory.register(
+            "promql_cache",
+            int(_pq_quota) if _pq_quota else None,
+            usage_fn=lambda: self.promql_cache.bytes,
+            reclaim_fn=self.promql_cache.reclaim,
+            policy="reject",
+        )
+        self.promql_cache.memory_probe = (
+            lambda n: self.memory.try_admit("promql_cache", n)
+        )
+        self.cache.promql_derived = self.promql_cache
         # nested (sub)queries route through the full statement dispatch so
         # information_schema / pg_catalog subqueries resolve
         self.engine.dispatch = self.execute_statement

@@ -331,6 +331,12 @@ class RegionCacheManager:
         # derived bucket-major layouts, or they leak device bytes and
         # inflate the layout_cache workload usage
         self.derived_layouts = None
+        # optional PromLayoutCache chained the same way: a dropped /
+        # truncated / repartitioned region's resident PromQL selections,
+        # sort layouts and group-id vectors must free with the region —
+        # version checks catch staleness, but only explicit invalidation
+        # catches deletion
+        self.promql_derived = None
         self._lru: "collections.OrderedDict[tuple, _Entry]" = (
             collections.OrderedDict()
         )
@@ -535,12 +541,21 @@ class RegionCacheManager:
             # grid build bumps dicts_version, so they could never hit
             # again — drop them now instead of leaking device bytes
             self.derived_layouts.invalidate_region(key[0])
+        if (self.promql_derived is not None
+                and key[2:] == ((None, None), None)):
+            # same stranding rule for the PromQL derived state: sort and
+            # bounds layouts key on the full-table DeviceTable's
+            # dicts_version, which the next build bumps — a full-table
+            # entry leaving residency makes them permanently unhittable
+            self.promql_derived.invalidate_region(key[0])
 
     def invalidate_region(self, region_id: int) -> None:
         for k in [k for k in self._lru if k[0] == region_id]:
             self._evict(k)
         if self.derived_layouts is not None:
             self.derived_layouts.invalidate_region(region_id)
+        if self.promql_derived is not None:
+            self.promql_derived.invalidate_region(region_id)
 
 
 @dataclass
@@ -550,41 +565,28 @@ class _LayoutEntry:
     nbytes: int
 
 
-class DerivedLayoutCache:
-    """Resident derived layouts for the aligned-window range-aggregation
-    path: per (region, step class) the bucket-major reduction of the
-    resident grid — the ``[S, nb, r]`` reshape contracted once on device
-    into per-(series, bucket) partial sums ``[C, S, NB]`` and validity
-    counts ``[S, NB]`` — reused across warm queries so the per-query
-    aligned-window work drops to a bucket-axis slice plus the tiny
-    series-axis merge (the "pay the transpose once" pattern of tensor-
-    runtime query engines, arXiv:2203.01877).
+class _ByteLRUCache:
+    """Shared machinery for the derived resident caches (SQL bucket-major
+    layouts, PromQL evaluation state): an LRU of version-tagged entries
+    bounded by bytes, with reject-to-fallback admission through an
+    optional WorkloadMemoryManager probe and region-scoped invalidation.
+    Subclasses define the key shape and hit/miss bookkeeping; the
+    eviction/admission/reclaim semantics exist exactly once here so the
+    two caches cannot drift."""
 
-    Invalidation is by GridTable.dicts_version (bumped on every grid
-    build AND device-side append extension, which in turn follow the
-    region's ingest/flush/compaction generation bumps): a version
-    mismatch evicts the stale entry and rebuilds.  Capacity is LRU by
-    bytes; ``admit`` additionally consults an optional
-    WorkloadMemoryManager probe so the extra resident copy can never OOM
-    the device — rejected builds fall back to the dynamic-slice kernel.
-    """
-
-    def __init__(self, capacity_bytes: int | None = None):
+    def __init__(self, capacity_bytes: int | None, env_var: str):
         import os
 
         if capacity_bytes is None:
-            capacity_bytes = int(os.environ.get(
-                "GREPTIME_LAYOUT_CACHE_BYTES", str(1 << 30)))
+            capacity_bytes = int(os.environ.get(env_var, str(1 << 30)))
         self.capacity = capacity_bytes
         # optional callable(nbytes) -> bool wired by the server to
-        # WorkloadMemoryManager.try_admit("layout_cache", ...)
+        # WorkloadMemoryManager.try_admit(<workload>, ...)
         self.memory_probe = None
         self._lru: "collections.OrderedDict[tuple, _LayoutEntry]" = (
             collections.OrderedDict()
         )
         self._bytes = 0
-        self.hits = 0
-        self.misses = 0
         self.rejects = 0
         self.builds = 0
         self.evictions = 0
@@ -596,25 +598,22 @@ class DerivedLayoutCache:
     def __len__(self) -> int:
         return len(self._lru)
 
-    def lookup(self, region_id: int, step_class: tuple, version: int):
-        """Arrays for (region, step class) at ``version``, or None.  A
-        stale entry (older grid generation) is evicted immediately — the
-        generation bump IS the invalidation."""
-        key = (region_id, step_class)
+    def _lookup_entry(self, key: tuple, version):
+        """Arrays for ``key`` at ``version``, or None.  A stale entry
+        (older derivation version) is evicted immediately — the version
+        bump IS the invalidation."""
         entry = self._lru.get(key)
         if entry is not None and entry.version == version:
-            self.hits += 1
             self._lru.move_to_end(key)
             return entry.arrays
         if entry is not None:
             self._evict(key)
-        self.misses += 1
         return None
 
     def admit(self, nbytes: int) -> bool:
         """Reject-to-fallback admission: evict LRU entries to make room,
         then consult the workload memory probe.  False means the caller
-        must serve the query from the dynamic-slice path."""
+        serves from its uncached fallback path."""
         if nbytes > self.capacity:
             self.rejects += 1
             return False
@@ -625,9 +624,7 @@ class DerivedLayoutCache:
             return False
         return True
 
-    def store(self, region_id: int, step_class: tuple, version: int,
-              arrays: tuple, nbytes: int) -> None:
-        key = (region_id, step_class)
+    def _store_entry(self, key: tuple, version, arrays, nbytes: int) -> None:
         if key in self._lru:
             self._evict(key)
         self._lru[key] = _LayoutEntry(version, arrays, nbytes)
@@ -652,3 +649,101 @@ class DerivedLayoutCache:
         if e is not None:
             self._bytes -= e.nbytes
             self.evictions += 1
+
+
+class PromLayoutCache(_ByteLRUCache):
+    """Resident derived state for the PromQL evaluation hot path — the
+    PromQL twin of DerivedLayoutCache, holding four kinds of entries:
+
+    - ``selection``: per (region, matcher set) the matched tsid vector and
+      its padded device copy, so repeated evaluations skip the inverted-
+      index walk AND the O(series) label-dict materialization (labels are
+      decoded lazily, only for output groups);
+    - ``sort``: per (region, field column) the composite (tsid, ts)-key
+      sort of the resident table — key/ts/val/tsid/valid arrays presorted
+      once on device, reused by every window kernel instead of re-sorting
+      the full table inside each eval;
+    - ``bounds``: per (selection, field column) the series row ranges and
+      [S, L] timestamp matrix that turn few-step window boundaries into
+      sequential compares instead of full-array binary searches;
+    - ``group``: per (selection, by/without grouping) the device group-id
+      vector + segment layout computed from the region's dictionary-
+      encoded tag codes, replacing the per-eval Python loop over label
+      dicts.
+
+    Invalidation follows PR 1's generation discipline: every entry stores
+    the version it was derived from (region ``generation`` for
+    selection/group, resident-table ``dicts_version`` for sort — both bump
+    on every ingest/flush/compaction) and a mismatch at lookup evicts and
+    rebuilds.  Capacity is LRU by bytes; ``admit`` consults the optional
+    WorkloadMemoryManager probe with reject-to-fallback — a rejected build
+    is served uncached from the identical code path, so results are
+    bit-exact either way.
+    """
+
+    KINDS = ("selection", "sort", "group", "bounds")
+
+    def __init__(self, capacity_bytes: int | None = None, mesh=None):
+        super().__init__(capacity_bytes, "GREPTIME_PROMQL_CACHE_BYTES")
+        # series-axis mesh (parallel/dist.py promql_row_shardings): resident
+        # sort layouts are placed sharded when a multi-device mesh exists
+        self.mesh = mesh
+        self.hits = dict.fromkeys(self.KINDS, 0)
+        self.misses = dict.fromkeys(self.KINDS, 0)
+
+    def lookup(self, kind: str, region_id: int, key: tuple, version):
+        """Payload for (kind, region, key) at ``version``, or None (same
+        contract as DerivedLayoutCache.lookup)."""
+        payload = self._lookup_entry((region_id, kind, key), version)
+        self.hits[kind] += payload is not None
+        self.misses[kind] += payload is None
+        return payload
+
+    def store(self, kind: str, region_id: int, key: tuple, version,
+              payload, nbytes: int) -> None:
+        self._store_entry((region_id, kind, key), version, payload, nbytes)
+
+    def stats(self) -> dict:
+        """Flat counters for the bench JSON line / status endpoints."""
+        out = {"bytes": self._bytes, "entries": len(self._lru),
+               "rejects": self.rejects, "builds": self.builds,
+               "evictions": self.evictions}
+        for kind in self.KINDS:
+            out[f"{kind}_hits"] = self.hits[kind]
+            out[f"{kind}_misses"] = self.misses[kind]
+        return out
+
+class DerivedLayoutCache(_ByteLRUCache):
+    """Resident derived layouts for the aligned-window range-aggregation
+    path: per (region, step class) the bucket-major reduction of the
+    resident grid — the ``[S, nb, r]`` reshape contracted once on device
+    into per-(series, bucket) partial sums ``[C, S, NB]`` and validity
+    counts ``[S, NB]`` — reused across warm queries so the per-query
+    aligned-window work drops to a bucket-axis slice plus the tiny
+    series-axis merge (the "pay the transpose once" pattern of tensor-
+    runtime query engines, arXiv:2203.01877).
+
+    Invalidation is by GridTable.dicts_version (bumped on every grid
+    build AND device-side append extension, which in turn follow the
+    region's ingest/flush/compaction generation bumps): a version
+    mismatch evicts the stale entry and rebuilds.  Capacity is LRU by
+    bytes; ``admit`` additionally consults an optional
+    WorkloadMemoryManager probe so the extra resident copy can never OOM
+    the device — rejected builds fall back to the dynamic-slice kernel.
+    """
+
+    def __init__(self, capacity_bytes: int | None = None):
+        super().__init__(capacity_bytes, "GREPTIME_LAYOUT_CACHE_BYTES")
+        self.hits = 0
+        self.misses = 0
+
+    def lookup(self, region_id: int, step_class: tuple, version: int):
+        """Arrays for (region, step class) at ``version``, or None."""
+        arrays = self._lookup_entry((region_id, step_class), version)
+        self.hits += arrays is not None
+        self.misses += arrays is None
+        return arrays
+
+    def store(self, region_id: int, step_class: tuple, version: int,
+              arrays: tuple, nbytes: int) -> None:
+        self._store_entry((region_id, step_class), version, arrays, nbytes)

@@ -30,9 +30,14 @@ class SeriesInvertedIndex:
     region object keyed by generation (see ``get_series_index``)."""
 
     def __init__(self, tag_names: list[str], series_codes: list[tuple],
-                 vocabs: dict[str, list[str]]):
+                 vocabs: dict[str, list[str]],
+                 raw_values: dict[str, list] | None = None):
         self.tag_names = list(tag_names)
         self.vocabs = vocabs  # column -> term list (code == list index)
+        # column -> RAW encoder values (labels decode to these, not the
+        # str-coerced matcher terms); ONE copy per region registry
+        # version, shared by every selection built against it
+        self.raw_values = raw_values if raw_values is not None else vocabs
         n = len(series_codes)
         self.num_series = n
         # tsid t has codes self.codes[c][t]
@@ -83,6 +88,45 @@ class SeriesInvertedIndex:
             return np.zeros(0, dtype=np.int64)
         return np.sort(np.concatenate(parts))
 
+    # ---- vectorized code access (PromQL grouping) ----------------------
+    def codes_for(self, column: str, tsids: np.ndarray) -> np.ndarray:
+        """Dictionary codes of ``column`` for a tsid vector — one fancy-
+        index gather, no per-series Python work.  Unknown columns yield
+        all -1 (the "missing label" sentinel the callers already treat as
+        out-of-vocabulary)."""
+        col = self.codes.get(column)
+        if col is None:
+            return np.full(len(tsids), -1, dtype=np.int64)
+        return col[tsids]
+
+    def canonical_codes(self, column: str,
+                        merge_missing_empty: bool) -> tuple[np.ndarray, int]:
+        """code → canonical-term id remap for grouping: terms with equal
+        ``str()`` collapse to one id (PromQL group keys are string-level),
+        and the MISSING sentinel (index = vocabulary size) either merges
+        with the empty-string term (``by`` semantics: absent label prints
+        as "") or stays distinct (``without`` semantics: an absent label
+        is omitted from the key, distinguishable from a present "").
+        Returns (remap array of length vocab+1, number of canonical ids).
+        """
+        vocab = self.vocabs.get(column, [])
+        terms = list(vocab)
+        if merge_missing_empty:
+            terms.append("")
+        uniq, inv = (np.unique(np.asarray(terms, dtype=object),
+                               return_inverse=True)
+                     if terms else (np.zeros(0, object),
+                                    np.zeros(0, np.int64)))
+        n = len(uniq)
+        remap = np.empty(len(vocab) + 1, dtype=np.int64)
+        remap[:len(vocab)] = inv[:len(vocab)]
+        if merge_missing_empty:
+            remap[len(vocab)] = inv[len(vocab)]
+        else:
+            remap[len(vocab)] = n
+            n += 1
+        return remap, n
+
     # ---- matcher-level -------------------------------------------------
     def select(self, column: str, pred: Callable[[str], bool],
                negate: bool = False) -> np.ndarray:
@@ -102,19 +146,31 @@ class SeriesInvertedIndex:
 
 
 def get_series_index(region) -> SeriesInvertedIndex:
-    """Generation-cached index for a Region / CombinedRegionView duck."""
-    gen = region.generation
+    """Series-registry-cached index for a Region / CombinedRegionView
+    duck: keyed on ``series_generation`` (registry version) when the
+    region exposes it, so pure data appends of existing series don't pay
+    an O(series) index rebuild per write — only registry growth or
+    structure changes do."""
+    _ = region.num_series  # CombinedRegionView: force a registry refresh
+    gen = getattr(region, "series_generation", None)
+    if gen is None:
+        gen = region.generation
     cached = getattr(region, "_series_inv_cache", None)
     if cached is not None and cached[0] == gen:
         return cached[1]
     series_codes = sorted(region._series.items(), key=lambda kv: kv[1])
     # str-coerce: non-string tag columns store raw values in the encoder,
-    # but matcher predicates (regex) are defined over strings
+    # but matcher predicates (regex) are defined over strings; the raw
+    # lists ride along for label decoding (one copy per registry version)
+    raw_values = {
+        name: region.encoders[name].values() for name in region.tag_names
+    }
     vocabs = {
-        name: [str(v) for v in region.encoders[name].values()]
+        name: [str(v) for v in raw_values[name]]
         for name in region.tag_names
     }
-    idx = SeriesInvertedIndex(region.tag_names, series_codes, vocabs)
+    idx = SeriesInvertedIndex(region.tag_names, series_codes, vocabs,
+                              raw_values)
     try:
         region._series_inv_cache = (gen, idx)
     except AttributeError:

@@ -126,6 +126,17 @@ class Region:
         return len(self._series)
 
     @property
+    def series_generation(self) -> tuple:
+        """Version of the SERIES REGISTRY (tsid ↔ tag-code mapping) only,
+        unlike ``generation`` which bumps on every data write.  The
+        registry is append-only between structure changes (every rebuild
+        site calls _mark_structure_change), so (base_version, len) is a
+        sound invalidation key — PromQL matcher selections, group-id
+        vectors and the inverted index depend only on this and survive
+        pure data appends of existing series (the steady-scrape case)."""
+        return (self.base_version, len(self._series))
+
+    @property
     def sst_files(self) -> list[SstMeta]:
         return list(self.manifest.state.files.values())
 

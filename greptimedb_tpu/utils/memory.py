@@ -36,6 +36,10 @@ class Workload:
     usage_fn: Callable[[], int]
     reclaim_fn: Callable[[int], None] | None = None
     policy: str = "reject"  # "reject" | "best_effort"
+    # local mirrors of the prometheus counters so /status and the bench
+    # drivers can read per-workload pressure without scraping the registry
+    rejected: int = 0
+    reclaims: int = 0
 
 
 class WorkloadMemoryManager:
@@ -81,12 +85,14 @@ class WorkloadMemoryManager:
             # workload would still reject — don't destroy its resident
             # state on a doomed admission (best_effort keeps the reclaim:
             # it proceeds regardless, and freeing memory still helps)
+            w.rejected += 1
             _M_REJECTED.labels(name).inc()
             raise ResourcesExhausted(
                 f"workload {name!r} allocation over quota: "
                 f"{nbytes} > {w.quota_bytes} bytes"
             )
         if w.reclaim_fn is not None:
+            w.reclaims += 1
             _M_RECLAIMS.labels(name).inc()
             # ask for the actual deficit, not the batch size: usage may
             # have drifted far past quota (estimates undershoot), and the
@@ -96,6 +102,7 @@ class WorkloadMemoryManager:
                 return
         if w.policy == "best_effort":
             return
+        w.rejected += 1
         _M_REJECTED.labels(name).inc()
         raise ResourcesExhausted(
             f"workload {name!r} over memory quota: "
@@ -121,6 +128,8 @@ class WorkloadMemoryManager:
                 "used_bytes": int(w.usage_fn()),
                 "quota_bytes": w.quota_bytes,
                 "policy": w.policy,
+                "rejected": w.rejected,
+                "reclaims": w.reclaims,
             }
             for w in workloads
         }
