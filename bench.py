@@ -547,14 +547,23 @@ def main() -> None:
     log(f"runs: {[f'{t:.0f}' for t in _times]} ms; groups={r.num_rows} "
         f"({time.time() - START:.0f}s elapsed)")
     try:
-        lc = db.engine.executor.layout_cache
-        _extra_stats["layout_cache_hits"] = lc.hits
-        _extra_stats["layout_cache_builds"] = lc.builds
-        # per-workload quota pressure (utils/memory.py): rejected/reclaim
-        # counts expose whether any resident cache ran against its quota
+        # counters come from the telemetry registry — the same numbers
+        # /metrics serves — so the bench JSON and a scrape can never
+        # disagree (the caches mirror every event into the registry)
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+
+        _extra_stats["layout_cache_hits"] = int(REGISTRY.value(
+            "greptime_cache_events_total", ("layout", "layout", "hit")))
+        _extra_stats["layout_cache_builds"] = int(REGISTRY.value(
+            "greptime_cache_events_total", ("layout", "layout", "build")))
+        # per-workload quota pressure: the registry mirror of
+        # utils/memory.py's rejected counters
         _extra_stats["memory_rejects"] = {
-            name: w["rejected"]
-            for name, w in db.memory.usage().items() if w["rejected"]
+            name: int(REGISTRY.value(
+                "greptime_memory_admissions_rejected_total", (name,)))
+            for name in db.memory.usage()
+            if REGISTRY.value(
+                "greptime_memory_admissions_rejected_total", (name,))
         }
     except Exception as e:  # noqa: BLE001 — stats are best-effort
         log(f"layout-cache stats unavailable: {e}")

@@ -185,10 +185,31 @@ def main() -> None:
         dt = time.time() - t0
         assert res.num_series == max(SERIES // 10, 1), res.num_series
         # resident-cache counters (selection/sort/group hit-miss) for the
-        # line of record; per-eval events land in the stderr log
+        # line of record, read from the telemetry registry (the numbers
+        # /metrics serves) so the bench JSON and a scrape can never
+        # disagree; per-eval events land in the stderr log
         try:
+            from greptimedb_tpu.utils.telemetry import REGISTRY
+
             _cache_stats.clear()
-            _cache_stats.update(db.promql_cache.stats())
+            _cache_stats["bytes"] = int(REGISTRY.value(
+                "greptime_cache_resident_bytes", ("promql",)))
+            _cache_stats["entries"] = int(REGISTRY.value(
+                "greptime_cache_entries", ("promql",)))
+            ev_total = "greptime_cache_events_total"
+            _cache_stats["rejects"] = int(REGISTRY.value(
+                ev_total, ("promql", "any", "quota_reject")))
+            _cache_stats["builds"] = sum(
+                int(REGISTRY.value(ev_total, ("promql", kind, "build")))
+                for kind in ("selection", "sort", "group", "bounds"))
+            _cache_stats["evictions"] = sum(
+                int(REGISTRY.value(ev_total, ("promql", kind, "eviction")))
+                for kind in ("selection", "sort", "group", "bounds"))
+            for kind in ("selection", "sort", "group", "bounds"):
+                for event in ("hit", "miss"):
+                    _cache_stats[f"{kind}_{event}es" if event == "miss"
+                                 else f"{kind}_{event}s"] = int(
+                        REGISTRY.value(ev_total, ("promql", kind, event)))
             _cache_stats["last_eval_events"] = dict(ev.cache_events)
         except Exception as e:  # noqa: BLE001 — stats are best-effort
             log(f"promql cache stats unavailable: {e}")

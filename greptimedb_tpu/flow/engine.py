@@ -31,6 +31,22 @@ from greptimedb_tpu.query.ast import (
     BinaryOp, Column, CreateFlow, DropFlow, FuncCall, IntervalLit, Literal,
     Select, ShowFlows, Star,
 )
+from greptimedb_tpu.utils.telemetry import REGISTRY
+
+# Flow observability (reference src/flow/src/metrics.rs
+# METRIC_FLOW_RUN_INTERVAL/ROWS): tick latency per (flow, engine mode)
+# and sink rows written per flow, scrapeable at /metrics and queryable
+# via information_schema.runtime_metrics.
+M_FLOW_TICK = REGISTRY.histogram(
+    "greptime_flow_tick_duration_seconds",
+    "One flow evaluation tick (streaming ingest fold or batching re-query)",
+    labels=("flow", "mode"),
+)
+M_FLOW_ROWS = REGISTRY.counter(
+    "greptime_flow_rows_total",
+    "Rows written to flow sink tables",
+    labels=("flow",),
+)
 
 
 @dataclass
@@ -262,6 +278,10 @@ class FlowEngine:
         return engine.execute_select(sel)
 
     def _stream_ingest(self, task: FlowTask, data: dict) -> None:
+        with M_FLOW_TICK.labels(task.name, "streaming").time():
+            self._stream_ingest_inner(task, data)
+
+    def _stream_ingest_inner(self, task: FlowTask, data: dict) -> None:
         from greptimedb_tpu.rpc.partial import merge_into
 
         plan = task.partial_plan
@@ -337,6 +357,7 @@ class FlowEngine:
         if "update_at" in [c.name for c in region.schema]:
             data["update_at"] = [int(time.time() * 1000)] * len(rows)
         region.write(data)
+        M_FLOW_ROWS.labels(task.name).inc(len(rows))
         self.db.cache.invalidate_region(region.region_id)
 
     def _backfill(self, task: FlowTask) -> None:
@@ -366,7 +387,9 @@ class FlowEngine:
             except TableNotFound:
                 pass
         try:
-            res = self.db.engine.execute_select(sel)
+            # metrics={}: a flow's internal query must not write its stage
+            # breakdown into the triggering statement's slow-query sink
+            res = self.db.engine.execute_select(sel, metrics={})
         except TableNotFound:
             # source not created yet (flow registered first): empty state
             # is correct; the first real ingest streams from zero
@@ -430,10 +453,17 @@ class FlowEngine:
         if task.mode == "streaming":
             if task.needs_backfill or task.dirty:
                 task.dirty.clear()
-                self._backfill(task)
+                with M_FLOW_TICK.labels(task.name, task.mode).time():
+                    self._backfill(task)
             return 0
         if not task.dirty:
             return 0
+        with M_FLOW_TICK.labels(task.name, task.mode).time():
+            written = self._run_batching(task, now_ms)
+        M_FLOW_ROWS.labels(task.name).inc(written)
+        return written
+
+    def _run_batching(self, task: FlowTask, now_ms: int | None) -> int:
         now_ms = now_ms or int(time.time() * 1000)
         windows = sorted(task.dirty)
         task.dirty.clear()
@@ -461,7 +491,9 @@ class FlowEngine:
                 BinaryOp("<", Column(ts_col), Literal(hi)),
             )
             sel.where = cond if sel.where is None else BinaryOp("AND", sel.where, cond)
-            res = self.db.engine.execute_select(sel)
+            # metrics={}: see _backfill — keep flow stages out of the
+            # triggering statement's slow-query sink
+            res = self.db.engine.execute_select(sel, metrics={})
             if not res.rows:
                 continue
             data = {

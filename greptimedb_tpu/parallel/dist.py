@@ -33,8 +33,19 @@ from greptimedb_tpu.errors import InvalidArguments, Unsupported
 from greptimedb_tpu.ops.segment import combine_keys
 from greptimedb_tpu.ops.time import bucket_index
 from greptimedb_tpu.storage.memtable import TSID
+from greptimedb_tpu.utils.telemetry import REGISTRY
+from greptimedb_tpu.utils.tracing import TRACER
 
 SHARD_AXIS = "shard"
+
+# Wall time of the collective exchange phase (shard_map partials + ICI
+# psum/pmin/pmax), labelled by mesh width and compile-vs-steady-state —
+# the mesh twin of query/physical.py's greptime_device_phase_seconds.
+M_MESH_COLLECTIVE = REGISTRY.histogram(
+    "greptime_mesh_collective_seconds",
+    "Mesh collective-exchange wall time (shard_map + ICI reductions)",
+    labels=("devices", "phase"),
+)
 
 
 def create_mesh(num_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
@@ -216,6 +227,7 @@ class DistAggExecutor:
         key = (tuple(key_specs), tuple(agg_specs), grid,
                table.rows_per_shard, ts_column, where_key, tr_flags)
         kern = self._cache.get(key)
+        jit_miss = kern is None
         if kern is None:
             kern = self._build(key_specs, agg_specs, cards, grid,
                                ts_column, where_fn, where_cols, tr_flags)
@@ -224,8 +236,22 @@ class DistAggExecutor:
         args = [table.columns[n] for n in names]
         lo = np.int64(time_range[0] if time_range[0] is not None else 0)
         hi = np.int64(time_range[1] if time_range[1] is not None else 0)
-        out = kern(table.row_mask, lo, hi, *args)
-        return {k: np.asarray(v) for k, v in out.items()}
+        # attribute device time to the collective exchange: the shard_map
+        # program IS the collective phase of the query (local partials +
+        # XLA-inserted psum/pmin/pmax over ICI), so its wall time — split
+        # compile vs steady-state like the single-device kernels — lands
+        # in the registry and, under a tracer, in a "collectives" span
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with TRACER.stage("collectives", devices=self.mesh.devices.size):
+            out = kern(table.row_mask, lo, hi, *args)
+            out = {k: np.asarray(v) for k, v in out.items()}
+        M_MESH_COLLECTIVE.labels(
+            str(self.mesh.devices.size),
+            "compile" if jit_miss else "execute",
+        ).observe(_time.perf_counter() - t0)
+        return out
 
     @staticmethod
     def _col_names(key_specs, agg_specs, ts_column=None, where_cols=()):

@@ -23,6 +23,7 @@ import collections.abc
 import math
 import os
 import re
+import time
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -36,8 +37,20 @@ from greptimedb_tpu.promql.parser import (
     StringLit, SubqueryExpr, UnaryExpr, VectorSelector, parse_promql,
 )
 from greptimedb_tpu.storage.memtable import TSID
+from greptimedb_tpu.utils.telemetry import REGISTRY
+from greptimedb_tpu.utils.tracing import TRACER
 
 DEFAULT_LOOKBACK_S = 300.0
+
+# Per-stage wall time of the PromQL hot loop (selection → sort_layout →
+# window_kernel → group_agg → label_decode), the PromQL twin of the SQL
+# engine's stage marks.  Observed per evaluation; the disabled-tracer
+# path costs one perf_counter pair per stage.
+M_PROMQL_STAGE = REGISTRY.histogram(
+    "greptime_promql_stage_seconds",
+    "PromQL evaluation stage wall time",
+    labels=("stage",),
+)
 
 _I64_MAX = np.int64(np.iinfo(np.int64).max)
 
@@ -820,6 +833,17 @@ class PromEvaluator:
         # resident-cache event counter for this evaluation (selection /
         # sort / group × hit / miss / reject) — surfaced to bench_promql
         self.cache_events: collections.Counter = collections.Counter()
+        # per-stage wall ms for this evaluation (selection → sort_layout →
+        # window_kernel → group_agg → label_decode): mirrored into the
+        # registry histogram and, through execute_tql, into the standalone
+        # stage sink so slow TQL queries self-report their breakdown
+        self.stage_ms: dict[str, float] = {}
+
+    def _stage_mark(self, name: str, t0: float) -> None:
+        dt = time.perf_counter() - t0
+        M_PROMQL_STAGE.labels(name).observe(dt)
+        self.stage_ms[name] = round(
+            self.stage_ms.get(name, 0.0) + dt * 1000, 3)
 
     # ---- plumbing -------------------------------------------------------
     def data_for(self, metric: str) -> SelectorData:
@@ -854,7 +878,10 @@ class PromEvaluator:
         vector, Prometheus semantics)."""
         d = self.data_for(sel.metric)
         fieldcol = d.field_column(sel.matchers)
-        tsids, sel_dev, labels = d.select_series(sel.matchers)
+        t0 = time.perf_counter()
+        with TRACER.stage("selection"):
+            tsids, sel_dev, labels = d.select_series(sel.matchers)
+        self._stage_mark("selection", t0)
         S = int(sel_dev.shape[0])
         rng = range_ms
         if rng is None:
@@ -869,18 +896,21 @@ class PromEvaluator:
         else:
             start = self.start_ms - offset_ms
             num_steps = self.num_steps
-        layout = d.sort_layout(fieldcol)
-        bounds_l = None
-        extra: tuple = ()
-        # per-series bounds matrix: resident-only accelerator for few-step
-        # windows (the S·T·L compare sweep must stay cheaper than the
-        # S·T·log N binary search it replaces)
-        if allow_bounds and num_steps <= 64:
-            b = d.window_bounds(fieldcol, layout, sel_dev,
-                                labels.matcher_key)
-            if b is not None and S * num_steps * b[3] <= (1 << 27):
-                bounds_l = b[3]
-                extra = b[:3]
+        t0 = time.perf_counter()
+        with TRACER.stage("sort_layout"):
+            layout = d.sort_layout(fieldcol)
+            bounds_l = None
+            extra: tuple = ()
+            # per-series bounds matrix: resident-only accelerator for
+            # few-step windows (the S·T·L compare sweep must stay cheaper
+            # than the S·T·log N binary search it replaces)
+            if allow_bounds and num_steps <= 64:
+                b = d.window_bounds(fieldcol, layout, sel_dev,
+                                    labels.matcher_key)
+                if b is not None and S * num_steps * b[3] <= (1 << 27):
+                    bounds_l = b[3]
+                    extra = b[:3]
+        self._stage_mark("sort_layout", t0)
         p = WindowParams(
             step_ms=self.step_ms,
             num_steps=num_steps,
@@ -908,10 +938,21 @@ class PromEvaluator:
             return {k: empty for k in self._KIND_KEYS[kind]}, []
         args, p, tsids, labels, pinned, start, rng = prep
         kern = _KERNEL_CACHE.get(p)
+        jit_miss = kern is None
         if kern is None:
             kern = _window_kernel(p)
             _KERNEL_CACHE[p] = kern
-        out = kern(*args)
+        t0 = time.perf_counter()
+        with TRACER.stage("window_kernel", kind=kind):
+            out = kern(*args)
+            if jit_miss or TRACER.enabled or (
+                getattr(self.db, "stage_sink", None) is not None
+            ):
+                # device sync only when someone reads the split: the first
+                # call (compile) is worth attributing always; steady-state
+                # evals keep the async dispatch pipeline
+                out = jax.block_until_ready(out)
+        self._stage_mark("xla_compile" if jit_miss else "window_kernel", t0)
         out = {k: v[: len(tsids)] for k, v in out.items()}
         if pinned:
             out = {
@@ -947,6 +988,7 @@ class PromEvaluator:
         lmax = max(2, 1 << (max(cnt_max, 1) - 1).bit_length())
         mk = (p, "matrix", lmax)
         kern = _KERNEL_CACHE.get(mk)
+        jit_miss = kern is None
         if kern is None:
             kern = _matrix_kernel(p, lmax, kind)
             _KERNEL_CACHE[mk] = kern
@@ -957,7 +999,10 @@ class PromEvaluator:
         a2 = (jnp.broadcast_to(jnp.asarray(extras[1], jnp.float32),
                                (self.num_steps,))[:num_steps]
               if len(extras) > 1 else ones)
-        vals = kern(*args, a1, a2)[: len(tsids)]
+        t0 = time.perf_counter()
+        with TRACER.stage("window_kernel", kind=kind):
+            vals = kern(*args, a1, a2)[: len(tsids)]
+        self._stage_mark("xla_compile" if jit_miss else "window_kernel", t0)
         if pinned:
             vals = jnp.broadcast_to(vals, (vals.shape[0], self.num_steps))
         return vals, labels
@@ -1462,8 +1507,11 @@ class PromEvaluator:
         r = self.eval(e.expr)
         if r.num_series == 0:
             return r
-        gid_dev, ng, out_labels, row_order_dev, seg_start = (
-            self._group_series(e, r))
+        t0 = time.perf_counter()
+        with TRACER.stage("group_agg", op=e.op):
+            gid_dev, ng, out_labels, row_order_dev, seg_start = (
+                self._group_series(e, r))
+        self._stage_mark("group_agg", t0)
         v = r.values
         S = v.shape[0]
         present = ~jnp.isnan(v)
@@ -1774,7 +1822,8 @@ def _extrapolated(out: dict, range_s: float, range_end_ms: np.ndarray,
 def execute_tql(db, stmt):
     from greptimedb_tpu.query.engine import QueryResult
 
-    expr = parse_promql(stmt.query)
+    with TRACER.stage("parse"):
+        expr = parse_promql(stmt.query)
     ev = PromEvaluator(
         db, stmt.start, stmt.end, stmt.step,
         stmt.lookback or DEFAULT_LOOKBACK_S,
@@ -1784,15 +1833,27 @@ def execute_tql(db, stmt):
     res = ev.eval(expr)
     vals = np.asarray(res.values)
     steps = ev.steps_ms()
-    label_keys = sorted({k for lab in res.labels for k in lab})
-    names = label_keys + ["ts", "val"]
-    rows = []
-    for s, lab in enumerate(res.labels):
-        col = vals[s]
-        for t in range(len(steps)):
-            v = float(col[t])
-            if np.isnan(v):
-                continue
-            rows.append([str(lab.get(k, "")) for k in label_keys]
-                        + [int(steps[t]), v])
+    t0 = time.perf_counter()
+    with TRACER.stage("label_decode"):
+        label_keys = sorted({k for lab in res.labels for k in lab})
+        names = label_keys + ["ts", "val"]
+        rows = []
+        for s, lab in enumerate(res.labels):
+            col = vals[s]
+            for t in range(len(steps)):
+                v = float(col[t])
+                if np.isnan(v):
+                    continue
+                rows.append([str(lab.get(k, "")) for k in label_keys]
+                            + [int(steps[t]), v])
+    ev._stage_mark("label_decode", t0)
+    sink = getattr(db, "stage_sink", None)
+    if sink is not None:
+        # slow-query self-reporting: the TQL stage breakdown rides the
+        # same sink the SQL engine's mark() writes into
+        sink.update(
+            {f"promql_{k}_ms": v for k, v in ev.stage_ms.items()})
+        sink["output_rows"] = len(rows)
+        if ev.cache_events:
+            sink["promql_cache_events"] = dict(ev.cache_events)
     return QueryResult(names, rows)

@@ -22,6 +22,7 @@ from greptimedb_tpu.query.exprs import TableContext, eval_host
 from greptimedb_tpu.query.physical import Executor
 from greptimedb_tpu.query.planner import SelectPlan, plan_select
 from greptimedb_tpu.query.window import collect_windows, compute_window
+from greptimedb_tpu.utils.tracing import TRACER
 
 
 @dataclass
@@ -266,6 +267,12 @@ class QueryEngine:
     def execute_select(self, sel: Select, metrics: dict | None = None) -> QueryResult:
         import time as _time
 
+        if metrics is None:
+            # slow-query self-reporting: the provider (GreptimeDB) exposes
+            # a per-statement stage sink; when one is active this query's
+            # stage breakdown lands there at zero extra cost (the mark()
+            # calls below run either way)
+            metrics = getattr(self.provider, "stage_sink", None)
         sel = self._resolve_subqueries(sel)
         if sel.table is None:
             return self._execute_tableless(sel)
@@ -286,8 +293,10 @@ class QueryEngine:
         ctx = self.provider.table_context(sel.table)
         from greptimedb_tpu.query.optimizer import optimize_select
 
-        sel, opt_rules = optimize_select(sel, ctx)
-        plan = plan_select(sel, ctx)
+        with TRACER.stage("optimize"):
+            sel, opt_rules = optimize_select(sel, ctx)
+        with TRACER.stage("plan"):
+            plan = plan_select(sel, ctx)
         if metrics is not None and opt_rules:
             metrics["optimizer_rules"] = ",".join(opt_rules)
         t = mark("plan_ms", t)
@@ -318,8 +327,9 @@ class QueryEngine:
                 grid, ts_bounds = grid_fn(sel.table, plan)
                 if grid is not None:
                     t = mark("scan_cache_ms", t)
-                    res = self.executor.execute_grid(
-                        plan, grid, ts_bounds, metrics=metrics)
+                    with TRACER.stage("execute"):
+                        res = self.executor.execute_grid(
+                            plan, grid, ts_bounds, metrics=metrics)
                     if res is not None:
                         env, n = res
                         scanned = grid.spad * grid.tpad
@@ -333,10 +343,12 @@ class QueryEngine:
             # non-commutative suffix — finish here)
             mesh_fn = getattr(self.provider, "mesh_select", None)
             if mesh_fn is not None and self._mesh_shapeable(sel):
-                mres = mesh_fn(sel)
+                with TRACER.stage("execute"):
+                    mres = mesh_fn(sel)
                 if mres is not None:
                     t = mark("device_exec_ms", t)
-                    result = self._finish_merged(sel, plan, *mres)
+                    with TRACER.stage("materialize"):
+                        result = self._finish_merged(sel, plan, *mres)
                     mark("shape_ms", t)
                     if metrics is not None:
                         metrics["mesh_rows"] = True
@@ -345,12 +357,15 @@ class QueryEngine:
         if env is None:
             table, ts_bounds = self.provider.device_table(sel.table, plan)
             t = mark("scan_cache_ms", t)
-            env, n = self.executor.execute(plan, table, ts_bounds)
+            with TRACER.stage("execute"):
+                env, n = self.executor.execute(plan, table, ts_bounds,
+                                               metrics=metrics)
             scanned = table.padded_rows
         t = mark("device_exec_ms", t)
-        if plan.sliding is not None:
-            env, n = _apply_sliding(plan, env, n)
-        result = self._shape(plan, env, n)
+        with TRACER.stage("materialize"):
+            if plan.sliding is not None:
+                env, n = _apply_sliding(plan, env, n)
+            result = self._shape(plan, env, n)
         mark("shape_ms", t)
         if metrics is not None:
             metrics["output_rows"] = len(result.rows)

@@ -45,6 +45,13 @@ M_LATENCY = telemetry.REGISTRY.histogram(
 M_INGEST_ROWS = telemetry.REGISTRY.counter(
     "greptime_ingest_rows_total", "Rows ingested", ("protocol",)
 )
+# Per-protocol query latency (reference METRIC_HTTP_SQL_ELAPSED et al):
+# one histogram shared by every wire surface — http SQL, the Prometheus
+# API emulation, MySQL and PostgreSQL register their own labels on it.
+M_PROTOCOL_QUERY = telemetry.REGISTRY.histogram(
+    "greptime_protocol_query_duration_seconds",
+    "Query latency by wire protocol", ("protocol",)
+)
 
 
 def _result_to_json(res: QueryResult, t0: float) -> dict:
@@ -270,7 +277,8 @@ class HttpServer(ThreadedAiohttpApp):
                 # they target on the single-worker db executor
                 res = self.db.try_fast_sql(sql)
                 if res is None:
-                    res = await self._call(self.db.sql, sql)
+                    with M_PROTOCOL_QUERY.labels("http").time():
+                        res = await self._call(self.db.sql, sql)
                 M_REQUESTS.labels("/v1/sql", "200").inc()
                 return web.json_response(_result_to_json(res, t0))
             except Exception as e:  # noqa: BLE001
@@ -286,9 +294,10 @@ class HttpServer(ThreadedAiohttpApp):
         expr = parse_promql(query)
 
         def run():
-            ev = PromEvaluator(self.db, start, end, step,
-                               lookback or DEFAULT_LOOKBACK_S)
-            res = ev.eval(expr)
+            with M_PROTOCOL_QUERY.labels("prometheus").time():
+                ev = PromEvaluator(self.db, start, end, step,
+                                   lookback or DEFAULT_LOOKBACK_S)
+                res = ev.eval(expr)
             return res, ev.steps_ms()
 
         return await self._call(run)

@@ -460,7 +460,8 @@ class _Conn:
             else:
                 result, self.session_db, self.session_tz = (
                     await loop.run_in_executor(
-                        self.server._db_executor, self.server.db.sql_in_db,
+                        self.server._db_executor,
+                        self.server.timed_sql_in_db,
                         stripped, self.session_db, self.session_tz,
                     )
                 )
@@ -487,6 +488,7 @@ class MysqlServer(ThreadedTcpServer):
     """TCP server on the MySQL port (reference default 4002)."""
 
     name = "greptime-mysql"
+    protocol = "mysql"
 
     def __init__(self, db, host: str = "127.0.0.1", port: int = 4002, *,
                  ssl_context=None, tls_require: bool = False):

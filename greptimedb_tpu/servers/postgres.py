@@ -384,7 +384,7 @@ class _PgConn:
                 return True
             portal.result, self.session_db, self.session_tz = (
                 await loop.run_in_executor(
-                    self.server._db_executor, self.server.db.sql_in_db,
+                    self.server._db_executor, self.server.timed_sql_in_db,
                     portal.bound_sql, self.session_db, self.session_tz))
             return True
         except GreptimeError as e:
@@ -556,7 +556,7 @@ class _PgConn:
                         result, self.session_db, self.session_tz = (
                             await loop.run_in_executor(
                                 self.server._db_executor,
-                                self.server.db.sql_in_db,
+                                self.server.timed_sql_in_db,
                                 sql, self.session_db, self.session_tz,
                             )
                         )
@@ -613,6 +613,7 @@ class PostgresServer(ThreadedTcpServer):
     """TCP server on the PostgreSQL port (reference default 4003)."""
 
     name = "greptime-pg"
+    protocol = "postgres"
 
     def __init__(self, db, host: str = "127.0.0.1", port: int = 4003, *,
                  ssl_context=None, auth_mode: str = "cleartext",

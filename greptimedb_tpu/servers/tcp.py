@@ -11,9 +11,19 @@ import asyncio
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
+from greptimedb_tpu.utils.telemetry import REGISTRY
+
+# same histogram object as servers/http.py's M_PROTOCOL_QUERY (the
+# registry dedupes by name): the wire servers label it mysql/postgres
+M_PROTOCOL_QUERY = REGISTRY.histogram(
+    "greptime_protocol_query_duration_seconds",
+    "Query latency by wire protocol", ("protocol",)
+)
+
 
 class ThreadedTcpServer:
     name = "greptime-tcp"
+    protocol = "tcp"  # per-protocol latency label (mysql/postgres)
 
     def __init__(self, db, host: str, port: int):
         self.db = db
@@ -34,6 +44,12 @@ class ThreadedTcpServer:
 
     async def _handle(self, reader, writer) -> None:  # pragma: no cover
         raise NotImplementedError
+
+    def timed_sql_in_db(self, query, dbname, timezone=None):
+        """db.sql_in_db with this protocol's latency observation — the
+        run_in_executor entry every wire statement goes through."""
+        with M_PROTOCOL_QUERY.labels(self.protocol).time():
+            return self.db.sql_in_db(query, dbname, timezone)
 
     def start(self) -> None:
         def run_loop():

@@ -33,6 +33,19 @@ from greptimedb_tpu.query.parser import parse_sql
 from greptimedb_tpu.query.planner import SelectPlan
 from greptimedb_tpu.storage.cache import RegionCacheManager
 from greptimedb_tpu.storage.region import RegionEngine, RegionOptions
+from greptimedb_tpu.utils.telemetry import REGISTRY
+
+# Per-engine query latency (reference METRIC_HANDLE_SQL_ELAPSED /
+# METRIC_HANDLE_PROMQL_ELAPSED in src/servers/src/metrics.rs): one
+# histogram labelled by which engine evaluated the statement batch —
+# "sql" (query/engine.py) or "promql" (TQL via promql/engine.py).  The
+# per-protocol twin lives in the protocol servers
+# (greptime_protocol_query_duration_seconds).
+M_QUERY_DURATION = REGISTRY.histogram(
+    "greptime_query_duration_seconds",
+    "SQL/TQL statement-batch latency by evaluating engine",
+    labels=("engine",),
+)
 
 
 def schema_from_create(stmt: "CreateTable") -> Schema:
@@ -590,7 +603,11 @@ class GreptimeDB(TableProvider):
             ticket = self.processes.register(query, self.current_db, client)
             self._proc_local.ticket = ticket
         try:
-            stmts = _stmts if _stmts is not None else parse_sql(query)
+            if _stmts is not None:
+                stmts = _stmts
+            else:
+                with TRACER.stage("parse"):
+                    stmts = parse_sql(query)
             fast = self._registry_only(stmts)
             if fast is not None:
                 return fast
@@ -660,27 +677,58 @@ class GreptimeDB(TableProvider):
     def _sql_locked(self, stmts, query: str, _time, TRACER) -> QueryResult:
         with self._lock:
             t0 = _time.perf_counter()
-            with TRACER.span("sql", statement=query[:256]):
-                if not stmts:
-                    return QueryResult([], [])
-                result = QueryResult([], [])
-                for stmt in stmts:
-                    self.check_cancelled()
-                    with TRACER.span("execute_statement",
-                                     kind=type(stmt).__name__):
-                        result = self.execute_statement(stmt)
-            elapsed_ms = (_time.perf_counter() - t0) * 1000
+            # per-statement stage sink: engines write their stage/device
+            # timings here (query/engine.py mark(), promql stage_ms) so a
+            # slow query self-reports where its time went.  Activated only
+            # when someone will read it — the recorder or the tracer —
+            # keeping the default path at two attribute checks.
+            sink: dict | None = None
+            outer_sink = getattr(self._proc_local, "stage_sink", None)
+            if outer_sink is None and (
+                self.slow_query_threshold_ms > 0 or TRACER.enabled
+            ):
+                sink = {}
+                self._proc_local.stage_sink = sink
+            engine = "promql" if any(
+                isinstance(s, Tql) for s in stmts) else "sql"
+            try:
+                with TRACER.stage("sql", statement=query[:256]):
+                    if not stmts:
+                        return QueryResult([], [])
+                    result = QueryResult([], [])
+                    for stmt in stmts:
+                        self.check_cancelled()
+                        with TRACER.stage("execute_statement",
+                                          kind=type(stmt).__name__):
+                            result = self.execute_statement(stmt)
+            finally:
+                if sink is not None:
+                    self._proc_local.stage_sink = None
+                elapsed_ms = (_time.perf_counter() - t0) * 1000
+                M_QUERY_DURATION.labels(engine).observe(elapsed_ms / 1000)
             if (
                 self.slow_query_threshold_ms > 0
                 and elapsed_ms >= self.slow_query_threshold_ms
                 and not self._recording_slow_query
                 and any(isinstance(s, (Select, Tql)) for s in stmts)
             ):
-                self._record_slow_query(query, elapsed_ms)
+                self._record_slow_query(query, elapsed_ms, stages=sink)
             return result
 
-    def _record_slow_query(self, query: str, elapsed_ms: float) -> None:
-        """Append to greptime_private.slow_queries (reference recorder.rs)."""
+    @property
+    def stage_sink(self) -> dict | None:
+        """The active per-statement stage-timing sink for this thread (see
+        _sql_locked), read by QueryEngine.execute_select and the PromQL
+        evaluator; None when nothing is collecting."""
+        return getattr(self._proc_local, "stage_sink", None)
+
+    def _record_slow_query(self, query: str, elapsed_ms: float,
+                           stages: dict | None = None) -> None:
+        """Append to greptime_private.slow_queries (reference recorder.rs).
+        ``stages`` is the statement's stage-timing sink (plan/device/shape
+        ms, jit-cache state, PromQL stage breakdown) serialized as JSON so
+        a slow query self-reports where its time went."""
+        import json as _json
         import time as _time
 
         self._recording_slow_query = True  # the recorder must never recurse
@@ -694,18 +742,37 @@ class GreptimeDB(TableProvider):
                     ColumnSchema("cost_ms", ConcreteDataType.FLOAT64),
                     ColumnSchema("threshold_ms", ConcreteDataType.FLOAT64),
                     ColumnSchema("query", ConcreteDataType.STRING),
+                    ColumnSchema("stages", ConcreteDataType.STRING),
                 ))
                 info = self.catalog.create_table(db, "slow_queries", schema,
                                                  if_not_exists=True)
                 if info is not None:
                     self.regions.create_region(info.region_ids[0], schema)
             region = self._region_of(f"{db}.slow_queries")
-            region.write({
+            row = {
                 "ts": [int(_time.time() * 1000)],
                 "cost_ms": [round(elapsed_ms, 3)],
                 "threshold_ms": [self.slow_query_threshold_ms],
                 "query": [query[:4096]],
-            })
+            }
+            if region.schema.has_column("stages"):
+                # pre-existing data dirs may carry the older 4-column
+                # schema; never fail the write over the extra column.
+                # The column must stay VALID JSON: an oversized breakdown
+                # drops its nested values (cache-event dicts etc.) rather
+                # than byte-truncating mid-token
+                text = ""
+                if stages:
+                    text = _json.dumps(stages, default=str)
+                    if len(text) > 4096:
+                        text = _json.dumps({
+                            k: v for k, v in stages.items()
+                            if isinstance(v, (int, float, str, bool))
+                        }, default=str)
+                    if len(text) > 4096:  # still huge: keep JSON valid
+                        text = "{}"
+                row["stages"] = [text]
+            region.write(row)
         except Exception:  # noqa: BLE001 (recording must never fail queries)
             pass
         finally:
@@ -1670,12 +1737,17 @@ class GreptimeDB(TableProvider):
             text = f"{type(stmt.inner).__name__}"
         rows = [["logical_plan (tpu)", text]]
         if stmt.analyze and isinstance(stmt.inner, Select):
+            from greptimedb_tpu.utils.tracing import TRACER, render_span_tree
+
             # EXPLAIN ANALYZE (reference DistAnalyzeExec): run the query and
             # report per-stage wall times + row counts
             metrics: dict = {}
             self.engine.execute_select(stmt.inner, metrics=metrics)
             # run once more for warm (compiled) numbers — the first run may
-            # include XLA compilation
+            # include XLA compilation.  With the tracer on, this warm run's
+            # span tree is surfaced as its own row (per-stage wall/device
+            # ms next to the layout=/jit_cache annotations above).
+            span_mark = TRACER.mark() if TRACER.enabled else 0
             warm: dict = {}
             self.engine.execute_select(stmt.inner, metrics=warm)
             lines = [
@@ -1683,6 +1755,10 @@ class GreptimeDB(TableProvider):
                 for k in metrics
             ]
             rows.append(["analyze (cold vs warm ms)", "\n".join(lines)])
+            if TRACER.enabled:
+                tree = render_span_tree(TRACER.since(span_mark))
+                if tree:
+                    rows.append(["analyze (span tree, warm run)", tree])
         return QueryResult(["plan_type", "plan"], rows)
 
     # ---- TQL / flows (wired in later milestones) -----------------------

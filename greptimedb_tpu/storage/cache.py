@@ -16,6 +16,7 @@ JAX free HBM.
 from __future__ import annotations
 
 import collections
+import weakref
 from dataclasses import dataclass, field
 
 import jax
@@ -26,6 +27,43 @@ from greptimedb_tpu.datatypes.batch import pad_rows
 from greptimedb_tpu.datatypes.schema import Schema
 from greptimedb_tpu.storage.memtable import SEQ, TSID
 from greptimedb_tpu.storage.region import Region
+from greptimedb_tpu.utils.telemetry import REGISTRY
+
+# Registry mirrors of the per-instance cache counters (reference: the
+# per-crate lazy_static CACHE_HIT/CACHE_MISS vectors in src/mito2/src/
+# metrics.rs).  The instance attributes (hits/misses/...) stay the
+# per-cache source of truth for tests and /status; these registry
+# counters make the same events SQL-queryable via runtime_metrics and
+# scrapeable at /metrics, which is what bench.py/bench_promql.py read.
+M_CACHE_EVENTS = REGISTRY.counter(
+    "greptime_cache_events_total",
+    "Resident-cache events (hit/miss/build/eviction/invalidation/"
+    "quota_reject/extend)",
+    labels=("cache", "kind", "event"),
+)
+M_CACHE_BYTES = REGISTRY.gauge(
+    "greptime_cache_resident_bytes",
+    "Bytes resident in each device cache (HBM for device tensors)",
+    labels=("cache",),
+)
+M_CACHE_ENTRIES = REGISTRY.gauge(
+    "greptime_cache_entries",
+    "Entries resident in each device cache",
+    labels=("cache",),
+)
+
+
+def _export_cache_gauges(name: str, cache) -> None:
+    """Point the per-cache bytes/entries gauges at this instance via a
+    weakref: scrape-time pulls read live state without keeping a dead
+    cache (tests build hundreds of short-lived dbs) alive forever.  The
+    newest instance wins the label — one standalone instance per process
+    is the served configuration."""
+    ref = weakref.ref(cache)
+    M_CACHE_BYTES.labels(name).set_function(
+        lambda: c._bytes if (c := ref()) is not None else 0.0)
+    M_CACHE_ENTRIES.labels(name).set_function(
+        lambda: len(c._lru) if (c := ref()) is not None else 0.0)
 
 
 _DICTS_VERSION = 0  # process-wide monotonic dict-content version
@@ -344,6 +382,7 @@ class RegionCacheManager:
         self.hits = 0
         self.misses = 0
         self.extends = 0
+        _export_cache_gauges("region_device", self)
 
     def get(
         self,
@@ -370,6 +409,7 @@ class RegionCacheManager:
         if entry is not None:
             if not incremental or entry.delta_pos == len(append_log):
                 self.hits += 1
+                M_CACHE_EVENTS.labels("region_device", "table", "hit").inc()
                 self._lru.move_to_end(key)
                 return entry.table
             # resident base is current; new append-log chunks extend it
@@ -380,6 +420,8 @@ class RegionCacheManager:
                 entry.live_rows * self.rebuild_fraction,
             ):
                 self.extends += 1
+                M_CACHE_EVENTS.labels(
+                    "region_device", "table", "extend").inc()
                 self._bytes -= entry.table.nbytes()
                 entry.table, entry.live_rows = extend_device_table(
                     entry.table, region, chunks, entry.live_rows
@@ -392,6 +434,7 @@ class RegionCacheManager:
             self._evict(key)  # too much drift: rebuild below
 
         self.misses += 1
+        M_CACHE_EVENTS.labels("region_device", "table", "miss").inc()
         table = build_device_table(region, ts_range, columns)
         entry = _Entry(
             table,
@@ -431,6 +474,7 @@ class RegionCacheManager:
         if entry is not None:
             if entry.delta_pos == len(append_log):
                 self.hits += 1
+                M_CACHE_EVENTS.labels("region_device", "grid", "hit").inc()
                 self._lru.move_to_end(key)
                 return entry.table
             if entry.table is None:
@@ -446,6 +490,7 @@ class RegionCacheManager:
             else:
                 chunks = append_log[entry.delta_pos:]
                 self.extends += 1
+                M_CACHE_EVENTS.labels("region_device", "grid", "extend").inc()
                 self._bytes -= entry.table.nbytes()
                 extended = extend_grid_table(entry.table, region, chunks,
                                              mesh=self.mesh)
@@ -460,6 +505,7 @@ class RegionCacheManager:
             self._evict(key)  # delta does not fit the resident shape
 
         self.misses += 1
+        M_CACHE_EVENTS.labels("region_device", "grid", "miss").inc()
         table = build_grid_table(region, mesh=self.mesh)
         rows_now = region.memtable.num_rows + sum(
             m.num_rows for m in region.sst_files
@@ -491,9 +537,11 @@ class RegionCacheManager:
         entry = self._lru.get(key)
         if entry is not None:
             self.hits += 1
+            M_CACHE_EVENTS.labels("region_device", "sharded", "hit").inc()
             self._lru.move_to_end(key)
             return entry.table
         self.misses += 1
+        M_CACHE_EVENTS.labels("region_device", "sharded", "miss").inc()
         table = shard_region(region, self.mesh)
         for k in [
             k for k in self._lru
@@ -574,6 +622,9 @@ class _ByteLRUCache:
     eviction/admission/reclaim semantics exist exactly once here so the
     two caches cannot drift."""
 
+    # registry label ("layout" / "promql"); subclasses override
+    metric_cache = "derived"
+
     def __init__(self, capacity_bytes: int | None, env_var: str):
         import os
 
@@ -590,6 +641,11 @@ class _ByteLRUCache:
         self.rejects = 0
         self.builds = 0
         self.evictions = 0
+        _export_cache_gauges(self.metric_cache, self)
+
+    def _kind_of(self, key: tuple) -> str:
+        """Entry kind for registry labels (PromLayoutCache keys carry it)."""
+        return "layout"
 
     @property
     def bytes(self) -> int:
@@ -616,11 +672,15 @@ class _ByteLRUCache:
         serves from its uncached fallback path."""
         if nbytes > self.capacity:
             self.rejects += 1
+            M_CACHE_EVENTS.labels(
+                self.metric_cache, "any", "quota_reject").inc()
             return False
         while self._bytes + nbytes > self.capacity and self._lru:
             self._evict(next(iter(self._lru)))
         if self.memory_probe is not None and not self.memory_probe(nbytes):
             self.rejects += 1
+            M_CACHE_EVENTS.labels(
+                self.metric_cache, "any", "quota_reject").inc()
             return False
         return True
 
@@ -630,6 +690,8 @@ class _ByteLRUCache:
         self._lru[key] = _LayoutEntry(version, arrays, nbytes)
         self._bytes += nbytes
         self.builds += 1
+        M_CACHE_EVENTS.labels(
+            self.metric_cache, self._kind_of(key), "build").inc()
 
     def reclaim(self, nbytes: int) -> None:
         """WorkloadMemoryManager reclaim hook: free at least ``nbytes``
@@ -642,6 +704,8 @@ class _ByteLRUCache:
 
     def invalidate_region(self, region_id: int) -> None:
         for k in [k for k in self._lru if k[0] == region_id]:
+            M_CACHE_EVENTS.labels(
+                self.metric_cache, self._kind_of(k), "invalidation").inc()
             self._evict(k)
 
     def _evict(self, key) -> None:
@@ -649,6 +713,8 @@ class _ByteLRUCache:
         if e is not None:
             self._bytes -= e.nbytes
             self.evictions += 1
+            M_CACHE_EVENTS.labels(
+                self.metric_cache, self._kind_of(key), "eviction").inc()
 
 
 class PromLayoutCache(_ByteLRUCache):
@@ -682,6 +748,10 @@ class PromLayoutCache(_ByteLRUCache):
     """
 
     KINDS = ("selection", "sort", "group", "bounds")
+    metric_cache = "promql"
+
+    def _kind_of(self, key: tuple) -> str:
+        return key[1]
 
     def __init__(self, capacity_bytes: int | None = None, mesh=None):
         super().__init__(capacity_bytes, "GREPTIME_PROMQL_CACHE_BYTES")
@@ -697,6 +767,8 @@ class PromLayoutCache(_ByteLRUCache):
         payload = self._lookup_entry((region_id, kind, key), version)
         self.hits[kind] += payload is not None
         self.misses[kind] += payload is None
+        M_CACHE_EVENTS.labels(
+            "promql", kind, "hit" if payload is not None else "miss").inc()
         return payload
 
     def store(self, kind: str, region_id: int, key: tuple, version,
@@ -732,6 +804,8 @@ class DerivedLayoutCache(_ByteLRUCache):
     the device — rejected builds fall back to the dynamic-slice kernel.
     """
 
+    metric_cache = "layout"
+
     def __init__(self, capacity_bytes: int | None = None):
         super().__init__(capacity_bytes, "GREPTIME_LAYOUT_CACHE_BYTES")
         self.hits = 0
@@ -742,6 +816,9 @@ class DerivedLayoutCache(_ByteLRUCache):
         arrays = self._lookup_entry((region_id, step_class), version)
         self.hits += arrays is not None
         self.misses += arrays is None
+        M_CACHE_EVENTS.labels(
+            "layout", "layout",
+            "hit" if arrays is not None else "miss").inc()
         return arrays
 
     def store(self, region_id: int, step_class: tuple, version: int,

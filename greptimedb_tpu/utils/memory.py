@@ -27,6 +27,19 @@ _M_REJECTED = REGISTRY.counter(
 _M_RECLAIMS = REGISTRY.counter(
     "greptime_memory_reclaims_total",
     "reclaim passes triggered by admission pressure", labels=("workload",))
+# Pull-mode usage/quota gauges per workload: accounting is PULL-based
+# (one source of truth — the owning component), so the gauges evaluate
+# the workload's usage_fn at scrape time via set_function instead of
+# push-updating a second copy.  Device-cache workloads make these the
+# per-workload HBM gauges (the resident tensors live in HBM).
+_M_USED = REGISTRY.gauge(
+    "greptime_memory_workload_used_bytes",
+    "Live bytes per workload (HBM for device-cache workloads)",
+    labels=("workload",))
+_M_QUOTA = REGISTRY.gauge(
+    "greptime_memory_workload_quota_bytes",
+    "Configured quota per workload (0 = unlimited)",
+    labels=("workload",))
 
 
 @dataclass
@@ -66,6 +79,32 @@ class WorkloadMemoryManager:
             self._workloads[name] = Workload(
                 name, quota_bytes, usage_fn, reclaim_fn, policy
             )
+        # weakref through the manager: the registry child must not keep a
+        # closed db (usage_fn closes over it) alive across test instances;
+        # the newest registration of a workload name wins the gauge
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _read(attr):
+            def fn(m=None):
+                m = ref()
+                if m is None:
+                    return 0.0
+                with m._lock:
+                    w = m._workloads.get(name)
+                if w is None:
+                    return 0.0
+                if attr == "quota":
+                    return float(w.quota_bytes or 0)
+                try:
+                    return float(w.usage_fn())
+                except Exception:  # noqa: BLE001 — scrape must not fail
+                    return 0.0
+            return fn
+
+        _M_USED.labels(name).set_function(_read("used"))
+        _M_QUOTA.labels(name).set_function(_read("quota"))
 
     def set_quota(self, name: str, quota_bytes: int | None) -> None:
         with self._lock:

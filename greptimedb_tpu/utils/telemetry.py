@@ -54,25 +54,45 @@ class Gauge(_Metric):
     kind = "gauge"
 
     class _Child:
-        __slots__ = ("value",)
+        __slots__ = ("_value", "fn")
 
         def __init__(self):
-            self.value = 0.0
+            self._value = 0.0
+            self.fn = None
+
+        @property
+        def value(self):
+            # pull-mode gauge (set_function): evaluated at scrape time so
+            # /metrics and runtime_metrics read live state with exactly one
+            # source of truth (the owning component); a dead or raising
+            # callback degrades to 0.0 rather than failing the scrape
+            if self.fn is not None:
+                try:
+                    return float(self.fn())
+                except Exception:  # noqa: BLE001
+                    return 0.0
+            return self._value
 
         def set(self, v: float):
-            self.value = v
+            self._value = v
 
         def inc(self, by: float = 1.0):
-            self.value += by
+            self._value += by
 
         def dec(self, by: float = 1.0):
-            self.value -= by
+            self._value -= by
+
+        def set_function(self, fn):
+            self.fn = fn
 
     def _new_child(self):
         return Gauge._Child()
 
     def set(self, v: float):
         self.labels().set(v)
+
+    def set_function(self, fn):
+        self.labels().set_function(fn)
 
 
 _DEFAULT_BUCKETS = (
@@ -132,6 +152,11 @@ class Registry:
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()
+        # conflicting re-registrations (same name, different kind or label
+        # set).  Registration never raises — a metric collision must not
+        # kill a server at import time — but the tier-1 registry check
+        # (tests/test_telemetry.py) fails the build on any entry here.
+        self.collisions: list[str] = []
 
     def counter(self, name, help_="", labels=()):
         return self._get(Counter, name, help_, tuple(labels))
@@ -145,6 +170,8 @@ class Registry:
             if m is None:
                 m = Histogram(name, help_, tuple(labels), buckets)
                 self._metrics[name] = m
+            else:
+                self._note_collision(m, Histogram, name, tuple(labels))
             return m
 
     def _get(self, cls, name, help_, labels):
@@ -153,7 +180,35 @@ class Registry:
             if m is None:
                 m = cls(name, help_, labels)
                 self._metrics[name] = m
+            else:
+                self._note_collision(m, cls, name, labels)
             return m
+
+    def _note_collision(self, existing, cls, name, labels):
+        if type(existing) is not cls:
+            self.collisions.append(
+                f"{name}: registered as {existing.kind}, "
+                f"re-registered as {cls.kind}")
+        elif existing.label_names != labels:
+            self.collisions.append(
+                f"{name}: labels {existing.label_names} vs {labels}")
+
+    def value(self, name: str, labels: tuple = ()) -> float:
+        """Read one child's current value (counter/gauge) or observation
+        count (histogram) without reaching into component objects — the
+        one bench/driver-facing read path, so bench JSON and /metrics can
+        never disagree.  Missing metric or label combination reads 0.0."""
+        with self._lock:
+            m = self._metrics.get(name)
+        if m is None:
+            return 0.0
+        key = tuple(str(v) for v in labels)
+        child = m._children.get(key)
+        if child is None:
+            return 0.0
+        if m.kind == "histogram":
+            return float(child.total)
+        return float(child.value)
 
     def snapshot(self):
         """Consistent point-in-time view: (metric_name, kind, label_names,
@@ -170,21 +225,29 @@ class Registry:
         return rows
 
     def render(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format.  Children are copied under
+        each metric's lock (same discipline as snapshot()): a scrape on
+        the server thread races label() inserts from executor threads —
+        every query/flow/protocol can mint a new label child."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
         out = []
-        for name in sorted(self._metrics):
-            m = self._metrics[name]
-            out.append(f"# HELP {name} {m.help}")
+        for m in metrics:
+            name = m.name
+            out.append(f"# HELP {name} {_escape_help(m.help)}")
             out.append(f"# TYPE {name} {m.kind}")
-            for key, child in sorted(m._children.items()):
+            with m._lock:
+                children = sorted(m._children.items())
+            for key, child in children:
                 lab = ",".join(
-                    f'{n}="{v}"' for n, v in zip(m.label_names, key)
+                    f'{n}="{_escape_label(v)}"'
+                    for n, v in zip(m.label_names, key)
                 )
                 lab = "{" + lab + "}" if lab else ""
                 if m.kind == "histogram":
-                    cum = 0
+                    # child.counts is already cumulative (observe()
+                    # increments every bucket >= v)
                     for b, c in zip(m.buckets, child.counts):
-                        cum = c
                         blab = (lab[:-1] + "," if lab else "{") + f'le="{b}"' + "}"
                         out.append(f"{name}_bucket{blab} {c}")
                     inf_lab = (lab[:-1] + "," if lab else "{") + 'le="+Inf"' + "}"
@@ -196,4 +259,52 @@ class Registry:
         return "\n".join(out) + "\n"
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline (exposition format spec §label values)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    """HELP-line escaping: backslash and newline only (quotes are legal)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 REGISTRY = Registry()
+
+# ---------------------------------------------------------------------------
+# Instance-identity metrics (reference src/common/telemetry build info +
+# process collector): registered once at import so every surface that walks
+# the registry (/metrics, information_schema.runtime_metrics) carries them.
+# ---------------------------------------------------------------------------
+
+_PROCESS_START_S = time.time()
+
+
+def _register_process_metrics() -> None:
+    try:
+        from greptimedb_tpu import __version__ as _version
+    except Exception:  # noqa: BLE001 — partial import during bootstrap
+        _version = "unknown"
+    build = REGISTRY.gauge(
+        "greptime_build_info",
+        "Instance identity; value is constant 1",
+        labels=("version", "backend"),
+    )
+    import os as _os
+
+    build.labels(_version, _os.environ.get("JAX_PLATFORMS") or "auto").set(1)
+    start = REGISTRY.gauge(
+        "greptime_process_start_time_seconds",
+        "Unix time the process started",
+    )
+    start.set(_PROCESS_START_S)
+    uptime = REGISTRY.gauge(
+        "greptime_process_uptime_seconds",
+        "Seconds since process start",
+    )
+    uptime.set_function(lambda: time.time() - _PROCESS_START_S)
+
+
+_register_process_metrics()

@@ -26,6 +26,9 @@ from greptimedb_tpu.utils.proto import (  # the ONE wire encoder
 )
 
 
+_NULL_CTX = contextlib.nullcontext()
+
+
 def _kv(key: str, value: str) -> bytes:
     any_value = _field(1, value.encode())  # AnyValue.string_value
     return _field(1, key.encode()) + _field(2, any_value)
@@ -63,6 +66,7 @@ class Tracer:
         self.service_name = "greptimedb-tpu"
         self.max_buffer = 2048
         self._spans: list[dict] = []
+        self._dropped = 0  # spans trimmed off the buffer head (mark/since)
         self._lock = threading.Lock()
         self._tls = threading.local()  # current span id (parenting)
         self._trace_id_base = os.urandom(12).hex()
@@ -80,6 +84,7 @@ class Tracer:
         self.enabled = False
         self.endpoint = None
         with self._lock:
+            self._dropped += len(self._spans)
             self._spans.clear()
 
     def _next_ids(self) -> tuple[str, str]:
@@ -88,6 +93,15 @@ class Tracer:
             c = self._counter
         return (self._trace_id_base + struct.pack(">I", c & 0xFFFFFFFF).hex(),
                 os.urandom(8).hex())
+
+    def stage(self, name: str, **attributes):
+        """Hot-path span entry: ``span()`` when enabled, a SHARED null
+        context when disabled — one attribute check, no generator or
+        span-record allocation, so per-stage instrumentation inside the
+        query engines is free when tracing is off."""
+        if not self.enabled:
+            return _NULL_CTX
+        return self.span(name, **attributes)
 
     @contextlib.contextmanager
     def span(self, name: str, **attributes):
@@ -125,13 +139,31 @@ class Tracer:
             with self._lock:
                 self._spans.append(rec)
                 if len(self._spans) > self.max_buffer:
-                    del self._spans[: len(self._spans) - self.max_buffer]
+                    trim = len(self._spans) - self.max_buffer
+                    del self._spans[:trim]
+                    self._dropped += trim
 
     def drain(self) -> list[dict]:
         with self._lock:
             out = self._spans
             self._spans = []
+            self._dropped += len(out)
         return out
+
+    # ---- in-process span-tree readback --------------------------------
+    # EXPLAIN ANALYZE (and tests) read the spans of ONE query back out of
+    # the buffer without draining it away from the OTLP exporter: mark()
+    # before, since() after.  Buffer trimming between the two calls can
+    # only drop spans older than the mark, so ``mark - dropped`` stays a
+    # valid offset.
+    def mark(self) -> int:
+        with self._lock:
+            return self._dropped + len(self._spans)
+
+    def since(self, mark: int) -> list[dict]:
+        with self._lock:
+            off = max(0, mark - self._dropped)
+            return list(self._spans[off:])
 
     def flush(self, timeout: float = 10.0) -> int:
         """Export buffered spans to the OTLP endpoint; returns count."""
@@ -144,6 +176,37 @@ class Tracer:
             headers={"Content-Type": "application/x-protobuf"})
         urllib.request.urlopen(req, timeout=timeout).read()
         return len(spans)
+
+
+def render_span_tree(spans: list[dict]) -> str:
+    """Indented per-stage text tree from a span list (parent links), with
+    wall-ms per span and its recorded attributes — the EXPLAIN ANALYZE
+    surface of the query span tree.  Spans arrive in completion order
+    (children before parents); siblings render in start order."""
+    by_parent: dict[str, list[dict]] = {}
+    ids = {s["span_id"] for s in spans}
+    for s in spans:
+        parent = s.get("parent_span_id") or ""
+        if parent not in ids:
+            parent = ""  # orphan (parent outside the capture): root it
+        by_parent.setdefault(parent, []).append(s)
+
+    lines: list[str] = []
+
+    def emit(parent: str, depth: int) -> None:
+        for s in sorted(by_parent.get(parent, ()),
+                        key=lambda x: x["start_ns"]):
+            ms = (s["end_ns"] - s["start_ns"]) / 1e6
+            attrs = s.get("attributes") or {}
+            suffix = "".join(
+                f" {k}={v}" for k, v in attrs.items()
+                if k not in ("statement",)
+            )
+            lines.append(f"{'  ' * depth}{s['name']}: {ms:.3f} ms{suffix}")
+            emit(s["span_id"], depth + 1)
+
+    emit("", 0)
+    return "\n".join(lines)
 
 
 TRACER = Tracer()
