@@ -32,6 +32,7 @@ from greptimedb_tpu.query.ast import (
     Select, ShowFlows, Star,
 )
 from greptimedb_tpu.utils.telemetry import REGISTRY
+from greptimedb_tpu.utils.tracing import TRACER
 
 # Flow observability (reference src/flow/src/metrics.rs
 # METRIC_FLOW_RUN_INTERVAL/ROWS): tick latency per (flow, engine mode)
@@ -278,8 +279,12 @@ class FlowEngine:
         return engine.execute_select(sel)
 
     def _stream_ingest(self, task: FlowTask, data: dict) -> None:
-        with M_FLOW_TICK.labels(task.name, "streaming").time():
-            self._stream_ingest_inner(task, data)
+        # span named for the entry point, flow_name attribute so the
+        # ingest fold shows up in self-traces next to the triggering
+        # statement's tree (same trace id: the hook runs inside it)
+        with TRACER.stage("stream_ingest", flow_name=task.name):
+            with M_FLOW_TICK.labels(task.name, "streaming").time():
+                self._stream_ingest_inner(task, data)
 
     def _stream_ingest_inner(self, task: FlowTask, data: dict) -> None:
         from greptimedb_tpu.rpc.partial import merge_into
@@ -453,13 +458,16 @@ class FlowEngine:
         if task.mode == "streaming":
             if task.needs_backfill or task.dirty:
                 task.dirty.clear()
-                with M_FLOW_TICK.labels(task.name, task.mode).time():
-                    self._backfill(task)
+                with TRACER.stage("run_flow", flow_name=task.name,
+                                  mode="backfill"):
+                    with M_FLOW_TICK.labels(task.name, task.mode).time():
+                        self._backfill(task)
             return 0
         if not task.dirty:
             return 0
-        with M_FLOW_TICK.labels(task.name, task.mode).time():
-            written = self._run_batching(task, now_ms)
+        with TRACER.stage("run_flow", flow_name=task.name, mode=task.mode):
+            with M_FLOW_TICK.labels(task.name, task.mode).time():
+                written = self._run_batching(task, now_ms)
         M_FLOW_ROWS.labels(task.name).inc(written)
         return written
 

@@ -386,6 +386,32 @@ def _runtime_metrics(db):
     return _columns_of(rows, names), types
 
 
+def _self_monitor(db):
+    """Self-monitoring loop state (utils/selfmonitor.py): whether the
+    loopback span/metric exporter is running (GREPTIME_SELF_MONITOR) and
+    what it has written — the introspection surface of the reference's
+    ``export_metrics`` self_import timer."""
+    from greptimedb_tpu.utils.tracing import TRACER
+
+    mon = getattr(db, "self_monitor", None)
+    rows = [{
+        "enabled": "Yes" if mon is not None else "No",
+        "tracer_enabled": "Yes" if TRACER.enabled else "No",
+        "interval_s": float(mon.interval_s) if mon else None,
+        "ticks": mon.ticks if mon else 0,
+        "spans_exported": mon.spans_exported if mon else 0,
+        "metric_rows_exported": mon.metric_rows_exported if mon else 0,
+        "last_tick": (mon.last_tick_ms or None) if mon else None,
+    }]
+    names = ["enabled", "tracer_enabled", "interval_s", "ticks",
+             "spans_exported", "metric_rows_exported", "last_tick"]
+    types = {n: "String" for n in names}
+    types.update({"interval_s": "Float64", "ticks": "Int64",
+                  "spans_exported": "Int64", "metric_rows_exported": "Int64",
+                  "last_tick": "TimestampMillisecond"})
+    return _columns_of(rows, names), types
+
+
 def _views(db):
     """Reference src/catalog/src/system_schema/information_schema/views.rs."""
     rows = []
@@ -510,6 +536,7 @@ _TABLES = {
     "ssts": _ssts,
     "procedure_info": _procedure_info,
     "runtime_metrics": _runtime_metrics,
+    "self_monitor": _self_monitor,
     "views": _views,
     "triggers": _triggers,
     "table_constraints": _table_constraints,

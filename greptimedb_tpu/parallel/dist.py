@@ -244,7 +244,8 @@ class DistAggExecutor:
         import time as _time
 
         t0 = _time.perf_counter()
-        with TRACER.stage("collectives", devices=self.mesh.devices.size):
+        with TRACER.stage("collectives", devices=self.mesh.devices.size,
+                          phase="compile" if jit_miss else "execute"):
             out = kern(table.row_mask, lo, hi, *args)
             out = {k: np.asarray(v) for k, v in out.items()}
         M_MESH_COLLECTIVE.labels(

@@ -35,6 +35,9 @@ from greptimedb_tpu.errors import (
 from greptimedb_tpu.query.engine import QueryResult
 from greptimedb_tpu.utils import telemetry
 from greptimedb_tpu.utils.snappy import decompress as snappy_decompress
+from greptimedb_tpu.utils.tracing import (
+    TRACER, parse_trace_id, parse_traceparent,
+)
 
 M_REQUESTS = telemetry.REGISTRY.counter(
     "greptime_http_requests_total", "HTTP requests", ("path", "code")
@@ -52,6 +55,24 @@ M_PROTOCOL_QUERY = telemetry.REGISTRY.histogram(
     "greptime_protocol_query_duration_seconds",
     "Query latency by wire protocol", ("protocol",)
 )
+
+
+def _request_trace_context(request) -> tuple[str, str] | None:
+    """Trace context for one query request: W3C ``traceparent`` first,
+    then the reference's ``x-greptime-trace-id`` header; malformed values
+    are ignored (fresh trace), never errors.  With the tracer on and no
+    client context, a fresh trace id is minted so the response header
+    always names the trace the query's spans landed in."""
+    ctx = parse_traceparent(request.headers.get("traceparent"))
+    if ctx is None:
+        ctx = parse_trace_id(request.headers.get("x-greptime-trace-id"))
+    if ctx is None and TRACER.enabled:
+        ctx = (TRACER.new_trace_id(), "")
+    return ctx
+
+
+def _trace_headers(ctx: tuple[str, str] | None) -> dict:
+    return {"x-greptime-trace-id": ctx[0]} if ctx else {}
 
 
 def _result_to_json(res: QueryResult, t0: float) -> dict:
@@ -262,9 +283,18 @@ class HttpServer(ThreadedAiohttpApp):
         return default
 
     # ---- handlers ------------------------------------------------------
+    def _traced_sql(self, sql: str, ctx: tuple[str, str] | None):
+        """Executor-thread entry for /v1/sql: installs the request's
+        trace context on the DB thread (thread-locals do not cross the
+        run_in_executor boundary) so the statement's span tree is rooted
+        under the client's traceparent."""
+        with TRACER.trace_context(ctx):
+            return self.db.sql(sql)
+
     async def h_sql(self, request: web.Request) -> web.Response:
         t0 = time.perf_counter()
         sql = await self._param(request, "sql")
+        ctx = _request_trace_context(request)
         with M_LATENCY.labels("/v1/sql").time():
             if not sql:
                 M_REQUESTS.labels("/v1/sql", "400").inc()
@@ -278,16 +308,19 @@ class HttpServer(ThreadedAiohttpApp):
                 res = self.db.try_fast_sql(sql)
                 if res is None:
                     with M_PROTOCOL_QUERY.labels("http").time():
-                        res = await self._call(self.db.sql, sql)
+                        res = await self._call(self._traced_sql, sql, ctx)
                 M_REQUESTS.labels("/v1/sql", "200").inc()
-                return web.json_response(_result_to_json(res, t0))
+                return web.json_response(_result_to_json(res, t0),
+                                         headers=_trace_headers(ctx))
             except Exception as e:  # noqa: BLE001
                 body, status = _error_json(e)
                 M_REQUESTS.labels("/v1/sql", str(status)).inc()
-                return web.json_response(body, status=status)
+                return web.json_response(body, status=status,
+                                         headers=_trace_headers(ctx))
 
     async def _eval_promql(self, query: str, start: float, end: float,
-                           step: float, lookback: float | None = None):
+                           step: float, lookback: float | None = None,
+                           trace_ctx: tuple[str, str] | None = None):
         from greptimedb_tpu.promql.engine import DEFAULT_LOOKBACK_S, PromEvaluator
         from greptimedb_tpu.promql.parser import parse_promql
 
@@ -295,25 +328,29 @@ class HttpServer(ThreadedAiohttpApp):
 
         def run():
             with M_PROTOCOL_QUERY.labels("prometheus").time():
-                ev = PromEvaluator(self.db, start, end, step,
-                                   lookback or DEFAULT_LOOKBACK_S)
-                res = ev.eval(expr)
+                with TRACER.trace_context(trace_ctx):
+                    ev = PromEvaluator(self.db, start, end, step,
+                                       lookback or DEFAULT_LOOKBACK_S)
+                    res = ev.eval(expr)
             return res, ev.steps_ms()
 
         return await self._call(run)
 
     async def h_prom_range(self, request: web.Request) -> web.Response:
+        ctx = _request_trace_context(request)
         try:
             query = await self._param(request, "query")
             start = _parse_prom_time(await self._param(request, "start"))
             end = _parse_prom_time(await self._param(request, "end"))
             step = _parse_prom_duration(await self._param(request, "step", "60"))
             with M_LATENCY.labels("/v1/prometheus/api/v1/query_range").time():
-                res, steps = await self._eval_promql(query, start, end, step)
+                res, steps = await self._eval_promql(query, start, end, step,
+                                                     trace_ctx=ctx)
             from greptimedb_tpu.promql.format import range_payload
 
             M_REQUESTS.labels("/v1/prometheus/api/v1/query_range", "200").inc()
-            return web.json_response(range_payload(res, steps))
+            return web.json_response(range_payload(res, steps),
+                                     headers=_trace_headers(ctx))
         except Exception as e:  # noqa: BLE001
             M_REQUESTS.labels("/v1/prometheus/api/v1/query_range", "400").inc()
             return web.json_response(
@@ -321,15 +358,18 @@ class HttpServer(ThreadedAiohttpApp):
                 status=400)
 
     async def h_prom_query(self, request: web.Request) -> web.Response:
+        ctx = _request_trace_context(request)
         try:
             query = await self._param(request, "query")
             t = _parse_prom_time(await self._param(request, "time", str(time.time())))
             with M_LATENCY.labels("/v1/prometheus/api/v1/query").time():
-                res, steps = await self._eval_promql(query, t, t, 1)
+                res, steps = await self._eval_promql(query, t, t, 1,
+                                                     trace_ctx=ctx)
             from greptimedb_tpu.promql.format import instant_payload
 
             M_REQUESTS.labels("/v1/prometheus/api/v1/query", "200").inc()
-            return web.json_response(instant_payload(res, steps))
+            return web.json_response(instant_payload(res, steps),
+                                     headers=_trace_headers(ctx))
         except Exception as e:  # noqa: BLE001
             M_REQUESTS.labels("/v1/prometheus/api/v1/query", "400").inc()
             return web.json_response(
@@ -1204,12 +1244,14 @@ class HttpServer(ThreadedAiohttpApp):
         """Greptime-native PromQL endpoint: query/start/end/step params,
         greptime JSON envelope output (reference /v1/promql)."""
         t0 = time.perf_counter()
+        ctx = _request_trace_context(request)
         try:
             query = await self._param(request, "query")
             start = _parse_prom_time(await self._param(request, "start", "0"))
             end = _parse_prom_time(await self._param(request, "end", "0"))
             step = _parse_prom_duration(await self._param(request, "step", "60"))
-            res, steps = await self._eval_promql(query, start, end, step)
+            res, steps = await self._eval_promql(query, start, end, step,
+                                                 trace_ctx=ctx)
             vals = np.asarray(res.values, dtype=np.float64)
             label_keys = sorted({k for lab in res.labels for k in lab})
             rows = []
@@ -1222,7 +1264,8 @@ class HttpServer(ThreadedAiohttpApp):
                             + [int(steps[t]), float(v)]
                         )
             qr = QueryResult(label_keys + ["ts", "val"], rows)
-            return web.json_response(_result_to_json(qr, t0))
+            return web.json_response(_result_to_json(qr, t0),
+                                     headers=_trace_headers(ctx))
         except Exception as e:  # noqa: BLE001
             body, status = _error_json(e)
             return web.json_response(body, status=status)

@@ -79,6 +79,11 @@ class _Conn:
         self.caps = 0
         self.session_db = "public"  # per-connection database
         self.session_tz = "UTC"
+        # trace id of the last statement that carried a traceparent
+        # comment (no headers on this wire — clients read it back via
+        # SELECT @@greptime_trace_id, the MySQL analog of the HTTP
+        # x-greptime-trace-id response header)
+        self.last_trace_id = ""
         # prepared statements: stmt_id -> (sql, param_positions, types)
         self._stmt_map: dict[int, list] = {}
         self._stmt_next = 1
@@ -451,6 +456,26 @@ class _Conn:
                 ["@@version_comment"], [["greptimedb-tpu"]],
                 column_types=["String"]))
             return
+        from greptimedb_tpu.utils.tracing import extract_sql_trace_context
+
+        ctx = extract_sql_trace_context(stripped)
+        if ctx is not None:
+            self.last_trace_id = ctx[0]
+        # comment-stripped compare (head only — a multi-MB INSERT must
+        # not pay a regex pass): sqlcommenter middleware prefixes EVERY
+        # statement, including the readback itself
+        if "@@greptime_trace_id" in low[:512]:
+            import re as _re
+
+            low_nc = _re.sub(r"\s+", " ", _re.sub(
+                r"/\*.*?\*/", " ", low[:512], flags=_re.S)).strip()
+            if low_nc == "select @@greptime_trace_id":
+                from greptimedb_tpu.query.engine import QueryResult
+
+                self.send_resultset(QueryResult(
+                    ["@@greptime_trace_id"], [[self.last_trace_id]],
+                    column_types=["String"]))
+                return
         try:
             # registry-only statements (KILL, SHOW PROCESSLIST) run inline
             # so they never queue behind the query they target

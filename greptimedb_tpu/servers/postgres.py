@@ -83,6 +83,15 @@ class _PgConn:
                   + b"M" + msg.encode("utf-8") + b"\x00" + b"\x00")
         self._msg(b"E", fields)
 
+    def _notice(self, msg: str) -> None:
+        """NoticeResponse — used to echo the trace id back when a
+        statement carries a traceparent comment (the PostgreSQL analog
+        of the HTTP x-greptime-trace-id response header; notices are
+        wire-legal at any point and harmless to drivers)."""
+        fields = (b"SNOTICE\x00" + b"C00000\x00"
+                  + b"M" + msg.encode("utf-8") + b"\x00" + b"\x00")
+        self._msg(b"N", fields)
+
     async def _scram_auth(self, provider, user: str) -> bool:
         """SCRAM-SHA-256 SASL exchange (reference pgwire's SCRAM path;
         algorithm in utils/auth.ScramSha256Server)."""
@@ -541,6 +550,13 @@ class _PgConn:
                     await self.writer.drain()
                     continue
                 sql = body.rstrip(b"\x00").decode("utf-8", "replace").strip()
+                from greptimedb_tpu.utils.tracing import (
+                    extract_sql_trace_context,
+                )
+
+                tctx = extract_sql_trace_context(sql)
+                if tctx is not None:
+                    self._notice(f"x-greptime-trace-id: {tctx[0]}")
                 low = sql.lower().rstrip(";")
                 if not low or low.startswith(("begin", "commit",
                                               "rollback", "discard")):

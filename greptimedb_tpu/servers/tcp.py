@@ -12,6 +12,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 
 from greptimedb_tpu.utils.telemetry import REGISTRY
+from greptimedb_tpu.utils.tracing import TRACER, extract_sql_trace_context
 
 # same histogram object as servers/http.py's M_PROTOCOL_QUERY (the
 # registry dedupes by name): the wire servers label it mysql/postgres
@@ -47,9 +48,16 @@ class ThreadedTcpServer:
 
     def timed_sql_in_db(self, query, dbname, timezone=None):
         """db.sql_in_db with this protocol's latency observation — the
-        run_in_executor entry every wire statement goes through."""
+        run_in_executor entry every wire statement goes through.  MySQL/
+        PostgreSQL have no request headers, so trace context rides in a
+        leading SQL comment (sqlcommenter convention,
+        ``/* traceparent='00-…-…-01' */ SELECT …``) and seeds the span
+        tree exactly like the HTTP ``traceparent`` header; this runs ON
+        the db-executor thread, where the Tracer's thread-local lives."""
+        ctx = extract_sql_trace_context(query)
         with M_PROTOCOL_QUERY.labels(self.protocol).time():
-            return self.db.sql_in_db(query, dbname, timezone)
+            with TRACER.trace_context(ctx):
+                return self.db.sql_in_db(query, dbname, timezone)
 
     def start(self) -> None:
         def run_loop():

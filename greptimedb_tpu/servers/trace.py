@@ -117,6 +117,46 @@ def parse_otlp_traces(body: bytes) -> dict[str, list]:
     return cols
 
 
+def spans_to_columns(service_name: str, spans: list[dict]) -> dict[str, list]:
+    """In-process span records (utils/tracing.py buffer shape) → the SAME
+    columnar rows ``parse_otlp_traces`` emits — the loopback self-export
+    path (utils/selfmonitor.py) writes spans indistinguishable from OTLP
+    ingest, so the Jaeger query API serves the instance's own traces with
+    zero extra code (and no HTTP hop through the OTLP endpoint)."""
+    if not spans:
+        return {}
+    rows = []
+    for s in spans:
+        start_ns = int(s["start_ns"])
+        end_ns = int(s["end_ns"])
+        rows.append({
+            "service_name": service_name or "unknown",
+            "ts": start_ns // 1_000_000,
+            "trace_id": s["trace_id"],
+            "span_id": s["span_id"],
+            "parent_span_id": s.get("parent_span_id") or "",
+            "span_name": s["name"],
+            "span_kind": _KIND.get(s.get("kind", 1), "SPAN_KIND_INTERNAL"),
+            "duration_nano": max(end_ns - start_ns, 0),
+            "status_code": _STATUS.get(s.get("status_code", 0),
+                                       str(s.get("status_code", 0))),
+            "attributes": json.dumps(
+                {str(k): str(v) for k, v in (s.get("attributes") or {}).items()}
+            ),
+        })
+    cols: dict[str, list] = {
+        "__tags__": ["service_name"],
+        "__fields__": ["trace_id", "span_id", "parent_span_id", "span_name",
+                       "span_kind", "duration_nano", "status_code",
+                       "attributes"],
+    }
+    for key in ["service_name", "ts", "trace_id", "span_id", "parent_span_id",
+                "span_name", "span_kind", "duration_nano", "status_code",
+                "attributes"]:
+        cols[key] = [r[key] for r in rows]
+    return cols
+
+
 # ---------------------------------------------------------------------------
 # Jaeger API formatting
 # ---------------------------------------------------------------------------

@@ -413,6 +413,22 @@ class GreptimeDB(TableProvider):
             import sys as _sys
 
             print(f"procedure recovery failed: {e}", file=_sys.stderr)
+        # self-monitoring loop (reference export_metrics self_import +
+        # self trace export): a timer writes the Tracer span buffer into
+        # opentelemetry_traces and snapshots the metrics registry into
+        # internal tables, both through the normal ingest path.  OFF by
+        # default — the knob also gates the import, so a disabled
+        # instance never loads the exporter module and the query hot
+        # path carries zero extra allocations.
+        self.self_monitor = None
+        if os.environ.get("GREPTIME_SELF_MONITOR", "").lower() in (
+                "1", "true", "on"):
+            from greptimedb_tpu.utils.selfmonitor import SelfMonitor
+
+            self.self_monitor = SelfMonitor(
+                self, interval_s=float(os.environ.get(
+                    "GREPTIME_SELF_MONITOR_INTERVAL_S", "30")))
+            self.self_monitor.start()
 
     def _flush_largest_memtable(self, needed_bytes: int) -> None:
         """Ingest-quota reclaimer: flush memtables largest-first until the
@@ -432,6 +448,8 @@ class GreptimeDB(TableProvider):
             freed += b
 
     def close(self) -> None:
+        if self.self_monitor is not None:
+            self.self_monitor.stop()
         self.regions.close()
         if hasattr(self.kv, "close"):
             self.kv.close()
@@ -743,6 +761,7 @@ class GreptimeDB(TableProvider):
                     ColumnSchema("threshold_ms", ConcreteDataType.FLOAT64),
                     ColumnSchema("query", ConcreteDataType.STRING),
                     ColumnSchema("stages", ConcreteDataType.STRING),
+                    ColumnSchema("trace_id", ConcreteDataType.STRING),
                 ))
                 info = self.catalog.create_table(db, "slow_queries", schema,
                                                  if_not_exists=True)
@@ -772,6 +791,14 @@ class GreptimeDB(TableProvider):
                     if len(text) > 4096:  # still huge: keep JSON valid
                         text = "{}"
                 row["stages"] = [text]
+            if region.schema.has_column("trace_id"):
+                # the trace id the protocol layer returned to the client
+                # (W3C traceparent / x-greptime-trace-id) — lets an
+                # operator join a client-reported trace to its slow-query
+                # record; "" when the statement carried no context
+                from greptimedb_tpu.utils.tracing import TRACER
+
+                row["trace_id"] = [TRACER.current_trace_id()]
             region.write(row)
         except Exception:  # noqa: BLE001 (recording must never fail queries)
             pass
@@ -1759,6 +1786,12 @@ class GreptimeDB(TableProvider):
                 tree = render_span_tree(TRACER.since(span_mark))
                 if tree:
                     rows.append(["analyze (span tree, warm run)", tree])
+                tid = TRACER.current_trace_id()
+                if tid:
+                    # the id the whole statement's spans carry (external
+                    # traceparent or the fresh id minted at the protocol
+                    # layer) — feed it to the Jaeger API after a flush
+                    rows.append(["analyze (trace_id)", tid])
         return QueryResult(["plan_type", "plan"], rows)
 
     # ---- TQL / flows (wired in later milestones) -----------------------

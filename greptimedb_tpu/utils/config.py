@@ -9,7 +9,11 @@ from __future__ import annotations
 
 import os
 import re
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is API-compatible
+    import tomli as tomllib
 from dataclasses import dataclass, field, fields, is_dataclass
 
 

@@ -1,0 +1,146 @@
+"""Self-monitoring loop: loopback span/metric export into the instance's
+own tables.
+
+Reference: the standalone's ``export_metrics`` config with
+``self_import`` scrapes its own Prometheus registry into its own tables
+on a timer (SURVEY.md §5.5), and the Jaeger HTTP API serves whatever
+landed in ``opentelemetry_traces`` — the database observes itself with
+itself.  Here the loop is fully in-process (no HTTP hop):
+
+- ``flush_spans`` drains the Tracer's bounded span buffer and writes it
+  straight into ``opentelemetry_traces`` via the normal auto-creating
+  ingest path, in the exact row shape OTLP trace ingest produces
+  (servers/trace.py spans_to_columns) — so a query's
+  parse→optimize→plan→execute→materialize tree becomes retrievable
+  through the existing Jaeger query API.
+- ``export_metrics`` snapshots the registry (counters, pull gauges,
+  histograms exploded prometheus-style — telemetry.py export_samples)
+  into per-metric tables, so PromQL can compute e.g. cache hit-rate
+  from ``greptime_cache_events_total`` over time.
+
+Recursion guard: both writers run under ``TRACER.suppressed()`` and
+never route through ``db.sql`` — an export tick emits no spans, no
+slow-query records and no protocol-latency observations, so an idle
+instance's telemetry stays flat across ticks (pinned by
+tests/test_selfmonitor.py).
+
+The loop is OFF unless ``GREPTIME_SELF_MONITOR`` is set; standalone.py
+gates the import on the knob so a disabled instance never loads this
+module.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from greptimedb_tpu.utils.telemetry import REGISTRY
+from greptimedb_tpu.utils.tracing import TRACER
+
+
+class SelfMonitor:
+    """Timer-driven loopback exporter bound to one GreptimeDB instance."""
+
+    def __init__(self, db, interval_s: float = 30.0,
+                 service_name: str | None = None):
+        self.db = db
+        self.interval_s = float(interval_s)
+        self.service_name = service_name or TRACER.service_name
+        self.ticks = 0
+        self.spans_exported = 0
+        self.metric_rows_exported = 0
+        self.last_tick_ms = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---- the two export halves ----------------------------------------
+    def flush_spans(self) -> int:
+        """Drain the span buffer into ``opentelemetry_traces``; returns
+        the number of spans written.
+
+        The buffer has ONE consumer per span: a span this loop drains
+        never reaches Tracer.flush()'s OTLP exporter (and vice versa) —
+        run one or the other against a given instance.  The drain happens
+        UNDER db._lock so it can never race a statement's mark()/since()
+        window (EXPLAIN ANALYZE reads its warm-run span tree while
+        holding that lock); a failed write requeues the drained spans for
+        the next tick instead of losing them."""
+        from greptimedb_tpu.servers.http import _ingest_columns
+        from greptimedb_tpu.servers.trace import TRACE_TABLE, spans_to_columns
+
+        # db._lock: region writes are single-writer; the timer thread must
+        # serialize against SQL statements like any protocol server does
+        with self.db._lock:
+            spans = TRACER.drain()
+            if not spans:
+                return 0
+            cols = spans_to_columns(self.service_name, spans)
+            try:
+                with TRACER.suppressed():
+                    _ingest_columns(self.db, TRACE_TABLE, cols,
+                                    append_mode=True)
+            except Exception:
+                TRACER.requeue(spans)
+                raise
+        self.spans_exported += len(spans)
+        return len(spans)
+
+    def export_metrics(self) -> int:
+        """Snapshot the registry into internal metric tables (one table
+        per metric, labels as tags, ``val`` field — the remote-write /
+        OTLP column model); returns rows written."""
+        from greptimedb_tpu.servers.http import _ingest_columns
+        from greptimedb_tpu.servers.otlp import _norm
+
+        now_ms = int(time.time() * 1000)
+        tables: dict[str, list[tuple[dict, float]]] = {}
+        for name, labels, value in REGISTRY.export_samples():
+            tables.setdefault(_norm(name), []).append((labels, value))
+        total = 0
+        with self.db._lock, TRACER.suppressed():
+            for table, samples in tables.items():
+                tag_names = sorted({k for lab, _v in samples for k in lab})
+                cols: dict[str, list] = {k: [] for k in tag_names}
+                cols["ts"] = []
+                cols["val"] = []
+                for lab, val in samples:
+                    for k in tag_names:
+                        cols[k].append(str(lab.get(k, "")))
+                    cols["ts"].append(now_ms)
+                    cols["val"].append(float(val))
+                cols["__tags__"] = tag_names
+                cols["__fields__"] = ["val"]
+                total += _ingest_columns(self.db, table, cols)
+        self.metric_rows_exported += total
+        return total
+
+    def tick(self) -> dict:
+        """One export cycle (spans then metrics); returns what it wrote."""
+        spans = self.flush_spans()
+        rows = self.export_metrics()
+        self.ticks += 1
+        self.last_tick_ms = int(time.time() * 1000)
+        return {"spans": spans, "metric_rows": rows}
+
+    # ---- timer lifecycle ----------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — self-monitoring must
+                    pass  # never take the database down with it
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="greptime-self-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -224,6 +224,31 @@ class Registry:
                 rows.append((m.name, m.kind, m.label_names, key, child))
         return rows
 
+    def export_samples(self) -> list[tuple[str, dict, float]]:
+        """Flat (table_name, labels, value) samples for the metrics
+        self-import loop (reference ``export_metrics`` self_import,
+        src/common/telemetry): counters and gauges sample as themselves;
+        histograms explode prometheus-style into ``<name>_bucket``
+        (cumulative counts with an ``le`` label), ``<name>_sum`` and
+        ``<name>_count`` — the SAME shape servers/otlp.py produces for
+        OTLP histogram ingest, so ``histogram_quantile`` works over
+        self-imported tables unchanged.  Pull gauges (set_function)
+        evaluate at sample time, like a scrape."""
+        out: list[tuple[str, dict, float]] = []
+        for name, kind, label_names, key, child in self.snapshot():
+            labels = dict(zip(label_names, key))
+            if kind == "histogram":
+                for b, c in zip(child.buckets, child.counts):
+                    out.append((name + "_bucket",
+                                {**labels, "le": str(b)}, float(c)))
+                out.append((name + "_bucket",
+                            {**labels, "le": "+Inf"}, float(child.total)))
+                out.append((name + "_sum", labels, float(child.sum)))
+                out.append((name + "_count", labels, float(child.total)))
+            else:
+                out.append((name, labels, float(child.value)))
+        return out
+
     def render(self) -> str:
         """Prometheus text exposition format.  Children are copied under
         each metric's lock (same discipline as snapshot()): a scrape on

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import re
 import struct
 import threading
 import time
@@ -27,6 +28,93 @@ from greptimedb_tpu.utils.proto import (  # the ONE wire encoder
 
 
 _NULL_CTX = contextlib.nullcontext()
+
+# ---------------------------------------------------------------------------
+# Trace-context propagation (W3C Trace Context + the reference's
+# x-greptime-trace-id header, src/servers/src/http/header.rs).  Malformed
+# values are IGNORED — a bad header falls back to a fresh trace, never an
+# error (per the W3C spec's "restart the trace" rule).
+# ---------------------------------------------------------------------------
+
+_HEX = frozenset("0123456789abcdef")
+
+
+def _is_hex(s: str) -> bool:
+    return bool(s) and all(c in _HEX for c in s.lower())
+
+
+def parse_traceparent(value: str | None) -> tuple[str, str] | None:
+    """W3C ``traceparent`` (``version-traceid-parentid-flags``) →
+    (trace_id, parent_span_id), lowercased, or None when absent or
+    malformed (wrong field length, non-hex, all-zero ids, version
+    ``ff``, or a version-00 header with trailing members)."""
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if len(version) != 2 or not _is_hex(version) or version.lower() == "ff":
+        return None
+    if version == "00" and len(parts) != 4:
+        return None
+    if len(trace_id) != 32 or not _is_hex(trace_id):
+        return None
+    if len(span_id) != 16 or not _is_hex(span_id):
+        return None
+    if len(flags) != 2 or not _is_hex(flags):
+        return None
+    trace_id = trace_id.lower()
+    span_id = span_id.lower()
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def parse_trace_id(value: str | None) -> tuple[str, str] | None:
+    """``x-greptime-trace-id``: a bare 32-hex trace id (no parent span).
+    Returns (trace_id, "") or None when absent/malformed."""
+    if not value:
+        return None
+    tid = value.strip().lower()
+    if len(tid) != 32 or not _is_hex(tid) or tid == "0" * 32:
+        return None
+    return tid, ""
+
+
+# sqlcommenter-style propagation for header-less wire protocols
+# (MySQL/PostgreSQL): a SQL comment near the statement head carrying
+# traceparent='00-…-…-01'.
+_SQL_TRACEPARENT = re.compile(r"traceparent\s*=\s*'([0-9a-fA-F-]{10,80})'")
+
+
+def extract_sql_trace_context(sql: str) -> tuple[str, str] | None:
+    """Trace context from a LEADING SQL comment (sqlcommenter
+    convention) — the MySQL/PostgreSQL twin of the HTTP ``traceparent``
+    header.  Only comments before the first real token are scanned: a
+    traceparent-looking substring inside a string literal must never
+    seed the trace context.  A cheap substring gate keeps the
+    per-statement cost at one ``in`` check when no context rides along."""
+    head = sql[:512]
+    if "traceparent" not in head:
+        return None
+    pos, n = 0, len(head)
+    while pos < n:
+        while pos < n and head[pos].isspace():
+            pos += 1
+        if head.startswith("--", pos):
+            nl = head.find("\n", pos)
+            seg, pos = (head[pos:], n) if nl < 0 else (head[pos:nl], nl + 1)
+        elif head.startswith("/*", pos):
+            end = head.find("*/", pos)
+            seg, pos = (head[pos:], n) if end < 0 else (head[pos:end],
+                                                        end + 2)
+        else:
+            return None  # first real token: stop before any literal
+        m = _SQL_TRACEPARENT.search(seg)
+        if m is not None:
+            return parse_traceparent(m.group(1))
+    return None
 
 
 def _kv(key: str, value: str) -> bytes:
@@ -98,14 +186,16 @@ class Tracer:
         """Hot-path span entry: ``span()`` when enabled, a SHARED null
         context when disabled — one attribute check, no generator or
         span-record allocation, so per-stage instrumentation inside the
-        query engines is free when tracing is off."""
-        if not self.enabled:
+        query engines is free when tracing is off.  The suppress check
+        sits AFTER the enabled short-circuit: the disabled path still
+        costs exactly one attribute read."""
+        if not self.enabled or getattr(self._tls, "suppress", False):
             return _NULL_CTX
         return self.span(name, **attributes)
 
     @contextlib.contextmanager
     def span(self, name: str, **attributes):
-        if not self.enabled:
+        if not self.enabled or getattr(self._tls, "suppress", False):
             yield None
             return
         parent = getattr(self._tls, "current", None)
@@ -143,12 +233,72 @@ class Tracer:
                     del self._spans[:trim]
                     self._dropped += trim
 
+    # ---- trace-context propagation ------------------------------------
+    def new_trace_id(self) -> str:
+        """A fresh 32-hex trace id (random base + counter suffix)."""
+        trace_id, _ = self._next_ids()
+        return trace_id
+
+    def current_trace_id(self) -> str:
+        """The trace id active on THIS thread ("" when none) — read by
+        the slow-query recorder and EXPLAIN ANALYZE so both surfaces
+        report the same id the protocol layer returned to the client."""
+        cur = getattr(self._tls, "current", None)
+        return cur[0] if cur else ""
+
+    @contextlib.contextmanager
+    def trace_context(self, ctx: tuple[str, str] | None):
+        """Seed this thread's span tree with an external (trace_id,
+        parent_span_id) — the protocol servers wrap each statement's
+        executor closure in this so a client's W3C ``traceparent``
+        parents the whole parse→…→materialize tree.  Installs the
+        context even when the tracer is disabled so slow_queries still
+        carries the client's trace id.  ``ctx=None`` is a no-op."""
+        if ctx is None:
+            yield
+            return
+        prev = getattr(self._tls, "current", None)
+        self._tls.current = (ctx[0], ctx[1] or "")
+        try:
+            yield
+        finally:
+            self._tls.current = prev
+
+    @contextlib.contextmanager
+    def suppressed(self):
+        """Recursion guard for the self-monitoring loop: while active on
+        this thread, stage()/span() record nothing — loopback span/metric
+        exports must not observe themselves into the very buffers they
+        export (reference export_metrics self_import filters its own
+        write path the same way)."""
+        prev = getattr(self._tls, "suppress", False)
+        self._tls.suppress = True
+        try:
+            yield
+        finally:
+            self._tls.suppress = prev
+
     def drain(self) -> list[dict]:
         with self._lock:
             out = self._spans
             self._spans = []
             self._dropped += len(out)
         return out
+
+    def requeue(self, spans: list[dict]) -> None:
+        """Put drained-but-unexported spans back at the buffer head (a
+        self-export write failed; they retry next tick).  Reverses
+        drain()'s dropped-count bump so mark()/since() offsets stay
+        valid; the normal head-trim reclaims any overflow."""
+        if not spans:
+            return
+        with self._lock:
+            self._spans[:0] = spans
+            self._dropped -= len(spans)
+            if len(self._spans) > self.max_buffer:
+                trim = len(self._spans) - self.max_buffer
+                del self._spans[:trim]
+                self._dropped += trim
 
     # ---- in-process span-tree readback --------------------------------
     # EXPLAIN ANALYZE (and tests) read the spans of ONE query back out of

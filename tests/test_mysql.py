@@ -163,6 +163,24 @@ class TestMysqlProtocol:
         assert rows == [["greptimedb-tpu"]]
         c.quit()
 
+    def test_trace_id_comment_and_readback(self, mysql):
+        # sqlcommenter-style propagation: a traceparent comment rides the
+        # statement; the trace id reads back via @@greptime_trace_id (the
+        # MySQL analog of the HTTP x-greptime-trace-id response header),
+        # including when the readback itself carries a comment prefix
+        tid = "0123456789abcdef0123456789abcdef"
+        tp = f"00-{tid}-00f067aa0ba902b7-01"
+        c = MiniMysqlClient(mysql.port)
+        c.connect()
+        assert c.query("select @@greptime_trace_id")[2] == [[""]]
+        kind, _n, _r = c.query(f"/* traceparent='{tp}' */ SELECT 1")
+        assert kind == "rows"
+        assert c.query("select @@greptime_trace_id")[2] == [[tid]]
+        assert c.query(
+            f"/* traceparent='{tp}' */ select @@greptime_trace_id"
+        )[2] == [[tid]]
+        c.quit()
+
     def test_connect_with_db_and_init_db(self, mysql):
         mysql.db.sql("CREATE DATABASE IF NOT EXISTS mdb")
         c = MiniMysqlClient(mysql.port)

@@ -88,6 +88,22 @@ class TestExposition:
         state["v"] = 9.0
         assert "pull_bytes 9.0" in r.render()
 
+    def test_export_samples_histogram_explosion(self):
+        # the self-import sample shape: cumulative _bucket rows with an
+        # le label ending in +Inf, plus _sum/_count — same layout the
+        # OTLP ingest path produces, so histogram_quantile just works
+        r = Registry()
+        h = r.histogram("lat_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        samples = {(n, tuple(sorted(lab.items()))): v
+                   for n, lab, v in r.export_samples()}
+        assert samples[("lat_seconds_bucket", (("le", "0.1"),))] == 1.0
+        assert samples[("lat_seconds_bucket", (("le", "1.0"),))] == 1.0
+        assert samples[("lat_seconds_bucket", (("le", "+Inf"),))] == 2.0
+        assert samples[("lat_seconds_count", ())] == 2.0
+        assert samples[("lat_seconds_sum", ())] == pytest.approx(5.05)
+
     def test_registry_value_reader(self):
         r = Registry()
         c = r.counter("v_total", labels=("k",))
@@ -133,6 +149,34 @@ class TestRegistryStaticCheck:
             for ln in m.label_names:
                 assert _NAME_RE.match(ln), f"bad label {ln!r} on {name}"
             assert isinstance(m, (Counter, Gauge, Histogram))
+
+    def test_self_export_table_naming(self):
+        # the self-import loop (utils/selfmonitor.py) names tables after
+        # registry metrics: every name must round-trip through the OTLP
+        # normalizer unchanged, and the prometheus-style histogram
+        # explosion (_bucket/_sum/_count) must not collide with any
+        # other registered metric's table
+        import greptimedb_tpu.flow.engine  # noqa: F401
+        import greptimedb_tpu.parallel.dist  # noqa: F401
+        import greptimedb_tpu.promql.engine  # noqa: F401
+        import greptimedb_tpu.query.physical  # noqa: F401
+        import greptimedb_tpu.servers.http  # noqa: F401
+        import greptimedb_tpu.servers.tcp  # noqa: F401
+        import greptimedb_tpu.standalone  # noqa: F401
+        import greptimedb_tpu.storage.cache  # noqa: F401
+        import greptimedb_tpu.utils.memory  # noqa: F401
+        from greptimedb_tpu.servers.otlp import _norm
+
+        tables: set[str] = set()
+        for name, m in REGISTRY._metrics.items():
+            assert _norm(name) == name, f"{name!r} mutates through _norm"
+            exploded = (
+                [name + s for s in ("_bucket", "_sum", "_count")]
+                if m.kind == "histogram" else [name]
+            )
+            for t in exploded:
+                assert t not in tables, f"self-export table collision: {t}"
+                tables.add(t)
 
 
 # ---------------------------------------------------------------------------
