@@ -25,6 +25,29 @@ from greptimedb_tpu.query.window import collect_windows, compute_window
 from greptimedb_tpu.utils.tracing import TRACER
 
 
+def _scan_stats_seq() -> int:
+    from greptimedb_tpu.storage.scan import LAST_SCAN_STATS
+
+    return LAST_SCAN_STATS.get("seq", 0)
+
+
+def _attach_scan_stats(metrics, seq0: int) -> None:
+    """Fold the cold-scan pipeline's phase summary (storage/scan.py) into
+    the per-query metrics sink when a scan actually ran under this query
+    (cache miss/rebuild) — EXPLAIN ANALYZE's cold row and slow_queries
+    then show where cold time went (decode vs merge, files, strategy).
+    Warm queries (seq unchanged) add nothing."""
+    if metrics is None:
+        return
+    from greptimedb_tpu.storage.scan import LAST_SCAN_STATS as s
+
+    if s.get("seq", 0) == seq0:
+        return
+    for key in ("files", "threads", "decode_ms", "path", "merge_ms"):
+        if key in s:
+            metrics[f"scan_{key}"] = s[key]
+
+
 @dataclass
 class QueryResult:
     column_names: list[str]
@@ -324,9 +347,11 @@ class QueryEngine:
             from greptimedb_tpu.query.physical import grid_plan_candidate
 
             if grid_plan_candidate(plan):
+                scan_seq0 = _scan_stats_seq()
                 grid, ts_bounds = grid_fn(sel.table, plan)
                 if grid is not None:
                     t = mark("scan_cache_ms", t)
+                    _attach_scan_stats(metrics, scan_seq0)
                     with TRACER.stage("execute"):
                         res = self.executor.execute_grid(
                             plan, grid, ts_bounds, metrics=metrics)
@@ -355,8 +380,10 @@ class QueryEngine:
                         metrics["output_rows"] = len(result.rows)
                     return result
         if env is None:
+            scan_seq0 = _scan_stats_seq()
             table, ts_bounds = self.provider.device_table(sel.table, plan)
             t = mark("scan_cache_ms", t)
+            _attach_scan_stats(metrics, scan_seq0)
             with TRACER.stage("execute"):
                 env, n = self.executor.execute(plan, table, ts_bounds,
                                                metrics=metrics)

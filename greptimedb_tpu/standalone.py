@@ -347,6 +347,19 @@ class GreptimeDB(TableProvider):
             lambda n: self.memory.try_admit("promql_cache", n)
         )
         self.cache.promql_derived = self.promql_cache
+        # cold-scan staging buffers (storage/scan.py): the parallel SST
+        # decode pool admits its estimated in-flight decode bytes with
+        # reject-to-SEQUENTIAL fallback — over quota, a scan degrades to
+        # the one-file-at-a-time loop instead of failing the query
+        from greptimedb_tpu.storage import scan as _scanmod
+
+        _scan_quota = os.environ.get("GREPTIME_SCAN_QUOTA_BYTES")
+        self.memory.register(
+            "scan",
+            int(_scan_quota) if _scan_quota else None,
+            usage_fn=_scanmod.staging_bytes,
+            policy="reject",
+        )
         # nested (sub)queries route through the full statement dispatch so
         # information_schema / pg_catalog subqueries resolve
         self.engine.dispatch = self.execute_statement

@@ -25,7 +25,9 @@ import numpy as np
 
 from greptimedb_tpu.datatypes.batch import pad_rows
 from greptimedb_tpu.datatypes.schema import Schema
-from greptimedb_tpu.storage.memtable import SEQ, TSID
+from greptimedb_tpu.storage.memtable import (
+    SEQ, TAGCODE_PREFIX, TSID, tagcode_col,
+)
 from greptimedb_tpu.storage.region import Region
 from greptimedb_tpu.utils.telemetry import REGISTRY
 
@@ -77,24 +79,17 @@ def next_dicts_version() -> int:
     return _DICTS_VERSION
 
 # One multi-hundred-MB device_put RPC can break the TPU relay tunnel
-# (observed: UNAVAILABLE mid-upload of a 34M-row table). Stream large
-# columns in bounded pieces instead; each piece completes before the
-# next is sent, then a device-side concatenate assembles the column.
-_UPLOAD_CHUNK_BYTES = 64 << 20
+# (observed: UNAVAILABLE mid-upload of a 34M-row table). Large columns
+# stream in bounded pieces (storage/scan.py stream_to_device).
 
 
 def _to_device(arr: np.ndarray) -> jnp.ndarray:
-    if arr.nbytes <= _UPLOAD_CHUNK_BYTES:
-        return jnp.asarray(arr)
-    rows = max(1, _UPLOAD_CHUNK_BYTES // max(1, arr.dtype.itemsize))
-    parts = []
-    for i in range(0, len(arr), rows):
-        part = jax.device_put(arr[i:i + rows])
-        part.block_until_ready()
-        parts.append(part)
-    out = jnp.concatenate(parts)
-    out.block_until_ready()
-    return out
+    """Delegates to the scan pipeline's double-buffered streamer: bounded
+    chunks with two dispatches in flight, so host staging overlaps the
+    previous chunk's transfer instead of serializing on it."""
+    from greptimedb_tpu.storage.scan import stream_to_device
+
+    return stream_to_device(arr)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -207,8 +202,21 @@ def build_device_table(
     ts_range: tuple[int | None, int | None] = (None, None),
     columns: list[str] | None = None,
 ) -> DeviceTable:
-    """Scan, canonicalize and upload one region's data."""
-    host = region.scan_host(ts_range, columns)
+    """Scan, canonicalize and upload one region's data.
+
+    Real regions scan on the CODE path: string tags arrive as
+    ``__tagcode_<name>__`` int32 companions already in region code space
+    (storage/sst.py maps each file's dictionary once), so canonicalization
+    is a rename — no per-row object array, no re-hash.  Duck-typed views
+    (combined/metric/file engines) keep the raw scan + re-encode;
+    ``GREPTIME_SCAN_TAG_CODES=off`` forces the raw path for A/B."""
+    import os
+
+    if (getattr(region, "scan_supports_codes", False)
+            and os.environ.get("GREPTIME_SCAN_TAG_CODES", "on") != "off"):
+        host = region.scan_host(ts_range, columns, with_tag_codes=True)
+    else:
+        host = region.scan_host(ts_range, columns)
     schema = region.schema
     n = len(host[TSID])
     padded = pad_rows(n)
@@ -219,7 +227,14 @@ def build_device_table(
     for name, arr in host.items():
         if name == SEQ:
             continue  # sequences are a storage concern; queries never see them
-        vals = _canonical_column(schema, region.encoders, name, arr, dicts)
+        if name.startswith(TAGCODE_PREFIX):
+            # code-path tag column: already region codes
+            name = name[len(TAGCODE_PREFIX):-2]
+            vals = arr.astype(np.int32, copy=False)
+            dicts[name] = region.encoders[name].values()
+        else:
+            vals = _canonical_column(schema, region.encoders, name, arr,
+                                     dicts)
         out = np.full(padded, _pad_value(schema, name, vals.dtype),
                       dtype=vals.dtype)
         out[:n] = vals
@@ -263,7 +278,15 @@ def _canonical_delta(
     dn = len(host[TSID])
     out: dict[str, np.ndarray] = {}
     for name, arr in host.items():
-        if name == SEQ:
+        if name == SEQ or name.startswith(TAGCODE_PREFIX):
+            continue  # codes fold into their tag column below
+        tc = tagcode_col(name)
+        if (tc in host and schema.has_column(name)
+                and schema.column(name).is_tag):
+            # memtable chunks carry write-time region codes: reuse them
+            # instead of re-hashing the raw strings per delta
+            out[name] = host[tc].astype(np.int32, copy=False)
+            dicts[name] = region.encoders[name].values()
             continue
         out[name] = _canonical_column(schema, region.encoders, name, arr,
                                       dicts)
@@ -340,6 +363,10 @@ class _Entry:
     table: object
     delta_pos: int | None = None  # consumed append-log position
     live_rows: int = 0
+    # grid catch-up validity keys (see get_grid): the SST set the table
+    # was built from and the region's content-mutation epoch at build time
+    sst_ids: frozenset | None = None
+    mutation_epoch: int = -1
 
 
 class RegionCacheManager:
@@ -465,6 +492,8 @@ class RegionCacheManager:
             build_grid_table, extend_grid_table,
         )
 
+        from greptimedb_tpu.storage.grid import catch_up_grid_table
+
         base_ver = getattr(region, "base_version", None)
         append_log = getattr(region, "_append_log", None)
         if base_ver is None or append_log is None:
@@ -506,11 +535,55 @@ class RegionCacheManager:
 
         self.misses += 1
         M_CACHE_EVENTS.labels("region_device", "grid", "miss").inc()
-        table = build_grid_table(region, mesh=self.mesh)
         rows_now = region.memtable.num_rows + sum(
             m.num_rows for m in region.sst_files
         )
-        entry = _Entry(table, delta_pos=len(append_log), live_rows=rows_now)
+        cur_ids = frozenset(m.file_id for m in region.sst_files)
+        epoch = getattr(region, "mutation_epoch", None)
+
+        # incremental catch-up: a previous base_version's resident grid is
+        # still valid row-for-row when only content-PRESERVING structure
+        # changes happened (flush: mutation_epoch unchanged, old SST set
+        # intact, memtable/append-log empty) — extend it from the new
+        # files (reads prune to the not-yet-resident ts range) instead of
+        # re-reading the whole region
+        prev_key = next(
+            (k for k in self._lru
+             if k[0] == region.region_id and k[1:2] == ("grid",)), None)
+        if prev_key is not None and epoch is not None:
+            prev = self._lru[prev_key]
+            if (prev.table is not None and prev.sst_ids is not None
+                    and prev.mutation_epoch == epoch
+                    and region.memtable.is_empty and not append_log
+                    and prev.sst_ids <= cur_ids):
+                new_metas = [m for m in region.sst_files
+                             if m.file_id not in prev.sst_ids]
+                caught = catch_up_grid_table(
+                    prev.table, region, new_metas, mesh=self.mesh)
+                if caught is not None:
+                    self.extends += 1
+                    M_CACHE_EVENTS.labels(
+                        "region_device", "grid", "catch_up").inc()
+                    prev = self._lru.pop(prev_key)
+                    self._bytes -= prev.table.nbytes()
+                    if (caught is not prev.table
+                            and self.derived_layouts is not None):
+                        # dicts_version moved on: the old grid's derived
+                        # layouts can never hit again
+                        self.derived_layouts.invalidate_region(key[0])
+                    self._lru[key] = _Entry(
+                        caught, delta_pos=len(append_log),
+                        live_rows=rows_now, sst_ids=cur_ids,
+                        mutation_epoch=epoch,
+                    )
+                    self._bytes += caught.nbytes()
+                    self._shrink()
+                    return caught
+
+        table = build_grid_table(region, mesh=self.mesh)
+        entry = _Entry(table, delta_pos=len(append_log), live_rows=rows_now,
+                       sst_ids=cur_ids,
+                       mutation_epoch=epoch if epoch is not None else -1)
         stale = [
             k for k in self._lru
             if k[0] == key[0] and k[1:2] == ("grid",) and k[2] != base_ver
@@ -570,7 +643,9 @@ class RegionCacheManager:
         ]:
             self._evict(k)
         self._lru[key] = _Entry(
-            table, delta_pos=len(region._append_log), live_rows=rows_now
+            table, delta_pos=len(region._append_log), live_rows=rows_now,
+            sst_ids=frozenset(m.file_id for m in region.sst_files),
+            mutation_epoch=getattr(region, "mutation_epoch", -1),
         )
         self._bytes += table.nbytes()
         self._shrink()

@@ -41,9 +41,6 @@ _T_ALIGN = 2048
 _S_ALIGN = 256
 _MIN_DENSITY = float(os.environ.get("GREPTIME_GRID_MIN_DENSITY", "0.1"))
 _BUDGET = int(os.environ.get("GREPTIME_GRID_BUDGET_BYTES", str(6 << 30)))
-# stream uploads in bounded pieces (same rationale as cache._to_device:
-# one huge device_put RPC can wedge the TPU relay tunnel)
-_UPLOAD_CHUNK_BYTES = 64 << 20
 
 
 def _pad_to(n: int, align: int) -> int:
@@ -56,24 +53,15 @@ def _pad_to(n: int, align: int) -> int:
 
 
 def _to_device_rows(arr: np.ndarray, sharding=None) -> jnp.ndarray:
-    """Chunked host→device upload (relay-safe): flatten, stream bounded
-    pieces, reshape on device (free — same layout).  With a sharding the
-    array lands distributed across the mesh in one placement (multi-chip
-    meshes have per-chip links, not the single-relay bottleneck)."""
-    if sharding is not None:
-        return jax.device_put(arr, sharding)
-    if arr.nbytes <= _UPLOAD_CHUNK_BYTES:
-        return jnp.asarray(arr)
-    flat = arr.reshape(-1)
-    per = max(1, _UPLOAD_CHUNK_BYTES // max(1, arr.dtype.itemsize))
-    parts = []
-    for i in range(0, flat.shape[0], per):
-        p = jax.device_put(flat[i:i + per])
-        p.block_until_ready()
-        parts.append(p)
-    out = jnp.concatenate(parts).reshape(arr.shape)
-    out.block_until_ready()
-    return out
+    """Chunked host→device upload (relay-safe) with double buffering —
+    the scan pipeline's shared streamer (storage/scan.py): bounded pieces
+    with two dispatches in flight, reshaped on device (free — same
+    layout).  With a sharding the array lands distributed across the mesh
+    in one placement (multi-chip meshes have per-chip links, not the
+    single-relay bottleneck)."""
+    from greptimedb_tpu.storage.scan import stream_to_device
+
+    return stream_to_device(arr, sharding)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -165,14 +153,29 @@ def _gather_parts(region, fields: list[str]):
     ranges, and TWCS-compacted files never share (series, ts) keys with
     files of other time windows, so per-key ordering reduces to per-file
     ordering.  Memtable chunks follow in append order.
+
+    Decodes run concurrently on the scan pipeline's bounded pool with
+    scan-driven readahead.  (Catch-up builds read their own ts-restricted
+    slice in catch_up_grid_table — this is the full-region gather.)
     """
+    from greptimedb_tpu.storage.scan import (
+        estimate_staging_bytes, prefetch_store, read_parts,
+    )
     from greptimedb_tpu.storage.sst import read_sst
 
     ts_name = region.ts_name
     want = [ts_name, TSID, SEQ, OP] + fields
-    parts = []
-    for m in sorted(region.sst_files, key=lambda m: m.seq_max):
-        parts.append(read_sst(region.store, m, region.schema, columns=want))
+    metas = sorted(region.sst_files, key=lambda m: m.seq_max)
+    prefetch_store(region.store, metas)
+    est = estimate_staging_bytes(metas, len(want))
+    parts = read_parts(
+        [
+            (lambda m=m: read_sst(region.store, m, region.schema,
+                                  columns=want))
+            for m in metas
+        ],
+        memory=getattr(region, "memory", None), est_bytes=est,
+    )
     for chunk in region.memtable.snapshot_chunks():
         # within-chunk duplicates resolve by scatter order (later row wins),
         # matching keep-max-seq: rows in a chunk share one sequence and
@@ -462,6 +465,115 @@ def extend_grid_table(table: GridTable, region, chunks, mesh=None):
         step=step,
         nt=max(table.nt, new_nt),
         num_series=new_series,
+        field_names=fields,
+        dicts={name: region.encoders[name].values()
+               for name in region.tag_names},
+        no_nan=tuple(no_nan),
+        dicts_version=next_dicts_version(),
+        region_id=table.region_id,
+    )
+
+
+def catch_up_grid_table(table: GridTable, region, new_metas, mesh=None):
+    """Incremental grid build: extend a resident grid with freshly FLUSHED
+    SSTs instead of re-reading the whole region.
+
+    Only rows strictly after the resident coverage are read — the
+    resident max timestamp bounds a ``ts_range`` that read_sst turns into
+    Parquet row-group pruning, so a flushed file whose rows are already
+    resident (they arrived via the append-log extend path) costs a footer
+    read, not a full decode.  New cells scatter into the resident tensors
+    device-side, per part in sequence order (keep-max-seq).
+
+    Returns the extended GridTable, the SAME table when the new files
+    carry nothing beyond the resident coverage, or None when the delta
+    does not fit the resident shape/step (caller rebuilds).  Safety
+    preconditions — no content-mutating structure change since the build
+    (``Region.mutation_epoch`` unchanged), old SST set intact, memtable
+    and append log empty — are enforced by the cache manager
+    (storage/cache.py get_grid).
+    """
+    from greptimedb_tpu.storage.scan import (
+        estimate_staging_bytes, prefetch_store, read_parts,
+    )
+    from greptimedb_tpu.storage.sst import read_sst
+
+    fields = table.field_names
+    if tuple(grid_float_fields(region.schema)) != tuple(fields):
+        return None
+    if region.num_series > table.spad:
+        return None
+    step = table.step
+    if step <= 0:
+        return None
+    ts_name = region.ts_name
+    lo = table.ts0 + (table.nt - 1) * step + 1  # strictly after resident
+    want = [ts_name, TSID, SEQ, OP] + list(fields)
+    metas = [
+        m for m in sorted(new_metas, key=lambda m: m.seq_max)
+        if m.ts_max >= lo
+    ]
+    prefetch_store(region.store, metas)
+    est = estimate_staging_bytes(metas, len(want), (lo, None))
+    parts = read_parts(
+        [
+            (lambda m=m: read_sst(region.store, m, region.schema,
+                                  (lo, None), columns=want))
+            for m in metas
+        ],
+        memory=getattr(region, "memory", None), est_bytes=est,
+    )
+    parts = [p for p in parts if len(p[TSID])]
+    if not parts:
+        return table  # fully resident already (flush of consumed appends)
+    all_ts = np.concatenate(
+        [p[ts_name].astype(np.int64) for p in parts])
+    rel = all_ts - table.ts0
+    if bool((rel % step != 0).any()):
+        return None  # off-grid timestamps: sampling changed
+    new_nt = int(rel.max()) // step + 1
+    if new_nt > table.tpad:
+        return None
+    values, valid = table.values, table.valid
+    no_nan = list(table.no_nan)
+    for p in parts:
+        tsid = p[TSID].astype(np.int64)
+        tidx = (p[ts_name].astype(np.int64) - table.ts0) // step
+        op = p[OP]
+        dels = op == OP_DELETE
+        any_dels = bool(dels.any())
+        cols = []
+        for ci, name in enumerate(fields):
+            col = p[name]
+            if col.dtype != np.float32:
+                col = col.astype(np.float32)
+            if any_dels:
+                col = np.where(dels, np.float32(0.0), col)
+            if no_nan[ci] and not bool(np.isfinite(col).all()):
+                no_nan[ci] = False
+            cols.append(col)
+        delta = np.stack(cols, axis=0)  # [C, n]
+        ji, jj = jnp.asarray(tsid), jnp.asarray(tidx)
+        values = values.at[:, ji, jj].set(jnp.asarray(delta))
+        valid = valid.at[ji, jj].set(jnp.asarray(~dels))
+    tag_codes = table.tag_codes
+    if region.num_series > table.num_series:
+        host_tags = _series_tag_matrix(region, table.spad)
+        sh = grid_shardings(mesh, table.spad)
+        tag_codes = {
+            k: _to_device_rows(v, sh and sh["tags"])
+            for k, v in host_tags.items()
+        }
+    from greptimedb_tpu.storage.cache import next_dicts_version
+
+    return GridTable(
+        values=values,
+        valid=valid,
+        tag_codes=tag_codes,
+        ts0=table.ts0,
+        step=step,
+        nt=max(table.nt, new_nt),
+        num_series=region.num_series,
         field_names=fields,
         dicts={name: region.encoders[name].values()
                for name in region.tag_names},

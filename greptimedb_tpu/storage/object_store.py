@@ -39,6 +39,14 @@ class ObjectStore:
         cache-file mtimes."""
         return None
 
+    def prefetch(self, paths: list[str]) -> int:
+        """Scan-driven readahead hint: start pulling these objects toward
+        local storage in the background so the decode pool finds them
+        warm (storage/scan.py prefetch_store).  Returns the number of
+        fetches actually queued; disk/memory backends have nothing to
+        warm and return 0."""
+        return 0
+
 
 class FsObjectStore(ObjectStore):
     def __init__(self, root: str):

@@ -65,6 +65,10 @@ class RegionOptions:
 
 
 class Region:
+    # capability flag for build_device_table: scan_host accepts
+    # ``with_tag_codes`` (duck-typed views that wrap scan_host don't)
+    scan_supports_codes = True
+
     def __init__(
         self,
         region_id: int,
@@ -110,6 +114,12 @@ class Region:
             tuple(codes): i for i, codes in enumerate(manifest.state.series)
         }
         self.generation = 0  # bumped on any data mutation; cache key
+        # bumped only on structure changes that can MUTATE row content
+        # (upserts/deletes/compaction/ttl/truncate/alter/replay) — flush is
+        # content-preserving (rows just move memtable → SST), so a resident
+        # grid whose epoch still matches can CATCH UP from the flushed
+        # files instead of rebuilding (storage/grid.py catch_up_grid_table)
+        self.mutation_epoch = 0
         self._index_cache: dict[str, dict] = {}  # file_id -> column blooms
 
     # ------------------------------------------------------------------
@@ -349,10 +359,17 @@ class Region:
             self.flush()
         return seq
 
-    def _mark_structure_change(self) -> None:
+    def _mark_structure_change(self, content_preserving: bool = False) -> None:
         """Resident device tables for this region can no longer be extended
-        in place — bump the base version so the cache rebuilds."""
+        in place — bump the base version so the cache rebuilds.
+
+        ``content_preserving=True`` (flush only: rows move memtable → SST
+        byte-identically — dedup/tombstone interactions would have bumped
+        the epoch at write time already) keeps ``mutation_epoch`` intact so
+        the grid cache may catch up incrementally from the new files."""
         self.base_version += 1
+        if not content_preserving:
+            self.mutation_epoch += 1
         self._append_log.clear()
         self._max_ts_seen = None
 
@@ -423,7 +440,7 @@ class Region:
         self.memtable = Memtable(self.schema)
         self.wal.truncate(flushed_seq + 1)
         self.generation += 1
-        self._mark_structure_change()
+        self._mark_structure_change(content_preserving=True)
         self._maybe_compact()
         return meta
 
@@ -526,12 +543,28 @@ class Region:
         history for that key range — conservatively, when the input includes
         every SST file (full compaction); otherwise they are carried over.
         """
-        parts = [read_sst(self.store, m, self.schema) for m in files]
-        names = list(parts[0].keys())
-        merged = {k: np.concatenate([p[k] for p in parts]) for k in names}
-        # re-encode tags: raw values -> codes -> tsid already in file (TSID col)
-        order = np.lexsort((merged[SEQ], merged[self.ts_name], merged[TSID]))
-        merged = {k: v[order] for k, v in merged.items()}
+        from greptimedb_tpu.storage.scan import (
+            estimate_staging_bytes, merge_parts, prefetch_store, read_parts,
+        )
+
+        # parallel decode through the scan pipeline, on the CODE path:
+        # tags travel as region-code companions (read_sst maps each
+        # file's dictionary once), so the rewrite never re-hashes a raw
+        # string, and write_sst below rebuilds dictionary pages straight
+        # from the codes.  Inputs are sorted SSTs — the sorted-run merge
+        # replaces the global lexsort.
+        prefetch_store(self.store, files)
+        est = estimate_staging_bytes(files, len(self.schema) + 3)
+        parts = read_parts(
+            [
+                (lambda m=m: read_sst(self.store, m, self.schema,
+                                      tag_encoders=self.encoders,
+                                      decode_tags=False))
+                for m in files
+            ],
+            memory=self.memory, est_bytes=est,
+        )
+        merged, _path = merge_parts(parts, self.ts_name, TSID, SEQ)
         if not self.options.append_mode:
             tsid, ts = merged[TSID], merged[self.ts_name]
             keep = np.ones(len(tsid), dtype=bool)
@@ -546,6 +579,7 @@ class Region:
         new_meta = write_sst(
             self.store, f"{self._dir}/sst", self.schema, merged,
             level=max(m.level for m in files) + 1,
+            tag_dicts={k: enc.values() for k, enc in self.encoders.items()},
         )
         self._write_sst_index(new_meta, merged)
         self.manifest.commit(
@@ -703,13 +737,18 @@ class Region:
         tag_filters: dict[str, set] | None = None,
         tag_preds: dict[str, object] | None = None,
         ft_tokens: dict[str, list] | None = None,
+        with_tag_codes: bool = False,
     ) -> dict[str, np.ndarray]:
         """Merged, deduped host columns for the requested time range.
 
         Sources: SSTs overlapping the range (file-level time pruning, bloom
         skipping-index pruning on ``tag_filters`` equality/IN sets, then
-        Parquet row-group pruning) and the live memtable. Dedup
-        keep-max-seq across sources; tombstones applied then dropped.
+        Parquet row-group pruning) and the live memtable.  Selected SSTs
+        decode CONCURRENTLY on the scan pipeline's bounded pool
+        (storage/scan.py; ``GREPTIME_SCAN_THREADS``), with scan-driven
+        readahead on prefetching object stores, and sources merge by
+        sorted-run merge instead of a global lexsort.  Dedup keep-max-seq
+        across sources; tombstones applied then dropped.
 
         ``tag_preds`` maps tag columns to term predicates (e.g. compiled
         regex matchers) used for FILE-LEVEL pruning only, via the sidecar's
@@ -717,17 +756,30 @@ class Region:
         applies the predicate row-wise to the returned columns.
         ``ft_tokens`` maps string-FIELD columns to full-text query tokens
         (AND semantics) pruned against the sidecar token sets.
+
+        ``with_tag_codes=True`` is the code-path scan for device-cache
+        builds: string tag columns come back as ``__tagcode_<name>__``
+        int32 companions in region code space INSTEAD of raw object
+        arrays — no per-row python object is ever materialized for a
+        dictionary-encoded column on this path.
         """
         from greptimedb_tpu.storage.index import (
             sst_may_match, sst_pred_may_match, sst_tokens_may_match,
         )
+        from greptimedb_tpu.storage.scan import (
+            M_SCAN_FILES, estimate_staging_bytes, merge_parts,
+            prefetch_store, read_parts,
+        )
+        from greptimedb_tpu.utils.tracing import TRACER
 
         want = None
         if columns is not None:
             internal = [TSID, SEQ, OP, self.ts_name]
             want = list(dict.fromkeys(columns + internal))
-        parts: list[dict[str, np.ndarray]] = []
+        selected: list[SstMeta] = []
+        total = 0
         for m in self.sst_files:
+            total += 1
             if not m.overlaps(*ts_range):
                 continue
             if tag_filters or tag_preds or ft_tokens:
@@ -745,55 +797,95 @@ class Region:
                         for col, toks in ft_tokens.items()
                     ):
                         continue
-            parts.append(read_sst(self.store, m, self.schema, ts_range, want,
-                                  tag_filters))
+            selected.append(m)
+        if total:
+            M_SCAN_FILES.labels("pruned").inc(total - len(selected))
         internal = (TSID, SEQ, OP)
         schema_cols = {c.name for c in self.schema}
-        eff_want = want if want is not None else list(schema_cols) + list(internal)
-        if not self.memtable.is_empty:
-            lo, hi = ts_range
-            for chunk in self.memtable.snapshot_chunks():
-                ts = chunk[self.ts_name]
-                sel = np.ones(len(ts), dtype=bool)
-                if lo is not None:
-                    sel &= ts >= lo
-                if hi is not None:
-                    sel &= ts < hi
-                if sel.any():
+        eff_want = (want if want is not None
+                    else list(schema_cols) + list(internal))
+        # code-path tags: string tags only (integer tags are not
+        # dictionary-encoded in SSTs and stay raw on either path)
+        code_tags = {
+            c.name for c in self.schema.tag_columns
+            if c.dtype.is_string_like and c.name in eff_want
+        } if with_tag_codes else set()
+        code_cols = {tagcode_col(t) for t in code_tags}
+        tag_enc = self.encoders if with_tag_codes else None
+        with TRACER.stage("scan", region=self.region_id,
+                          files=len(selected)):
+            prefetch_store(self.store, selected)
+            est = estimate_staging_bytes(selected, len(eff_want), ts_range)
+            with TRACER.stage("scan_decode", files=len(selected)):
+                parts = read_parts(
+                    [
+                        (lambda m=m: read_sst(
+                            self.store, m, self.schema, ts_range, want,
+                            tag_filters, tag_encoders=tag_enc,
+                            decode_tags=not with_tag_codes))
+                        for m in selected
+                    ],
+                    memory=self.memory, est_bytes=est,
+                )
+            if not self.memtable.is_empty:
+                lo, hi = ts_range
+                for chunk in self.memtable.snapshot_chunks():
+                    ts = chunk[self.ts_name]
+                    sel = np.ones(len(ts), dtype=bool)
+                    if lo is not None:
+                        sel &= ts >= lo
+                    if hi is not None:
+                        sel &= ts < hi
+                    if not sel.any():
+                        continue
                     part = {
                         k: v[sel]
                         for k, v in chunk.items()
-                        if k in eff_want and (k in schema_cols or k in internal)
+                        if (k in code_cols) or (
+                            k in eff_want and k not in code_tags
+                            and (k in schema_cols or k in internal))
                     }
                     n = int(sel.sum())
                     for c in self.schema:  # chunks predating ALTER ADD
-                        if c.name in eff_want and c.name not in part:
+                        if c.name not in eff_want or c.name in part:
+                            continue
+                        if c.name in code_tags:
+                            if tagcode_col(c.name) not in part:
+                                fill = default_fill_array(c, 1)[0]
+                                code = self.encoders[c.name].get_or_insert(
+                                    fill)
+                                part[tagcode_col(c.name)] = np.full(
+                                    n, code, dtype=np.int32)
+                        else:
                             part[c.name] = default_fill_array(c, n)
                     parts.append(part)
-        if not parts:
-            empty = {}
-            for c in self.schema:
-                if want is None or c.name in want:
-                    empty[c.name] = np.empty(
-                        0, dtype=object if c.dtype.is_string_like else np.int64
-                        if c.dtype.is_timestamp else c.dtype.to_numpy()
-                    )
-            empty[TSID] = np.empty(0, dtype=np.int64)
-            empty[SEQ] = np.empty(0, dtype=np.int64)
-            empty[OP] = np.empty(0, dtype=np.int8)
-            return empty
-        names = list(parts[0].keys())
-        merged = {k: np.concatenate([p[k] for p in parts]) for k in names}
-        order = np.lexsort((merged[SEQ], merged[self.ts_name], merged[TSID]))
-        merged = {k: v[order] for k, v in merged.items()}
-        keep = np.ones(len(merged[TSID]), dtype=bool)
-        if not self.options.append_mode:
-            tsid, ts = merged[TSID], merged[self.ts_name]
-            if len(tsid) > 1:
-                same = (tsid[1:] == tsid[:-1]) & (ts[1:] == ts[:-1])
-                keep[:-1] = ~same
-        alive = keep & (merged[OP] != OP_DELETE)
-        return {k: v[alive] for k, v in merged.items()}
+            if not parts:
+                empty: dict[str, np.ndarray] = {}
+                for c in self.schema:
+                    if want is None or c.name in want:
+                        if c.name in code_tags:
+                            empty[tagcode_col(c.name)] = np.empty(
+                                0, dtype=np.int32)
+                        else:
+                            empty[c.name] = np.empty(
+                                0, dtype=object if c.dtype.is_string_like
+                                else np.int64 if c.dtype.is_timestamp
+                                else c.dtype.to_numpy()
+                            )
+                empty[TSID] = np.empty(0, dtype=np.int64)
+                empty[SEQ] = np.empty(0, dtype=np.int64)
+                empty[OP] = np.empty(0, dtype=np.int8)
+                return empty
+            with TRACER.stage("scan_merge", parts=len(parts)):
+                merged, _path = merge_parts(parts, self.ts_name, TSID, SEQ)
+            keep = np.ones(len(merged[TSID]), dtype=bool)
+            if not self.options.append_mode:
+                tsid, ts = merged[TSID], merged[self.ts_name]
+                if len(tsid) > 1:
+                    same = (tsid[1:] == tsid[:-1]) & (ts[1:] == ts[:-1])
+                    keep[:-1] = ~same
+            alive = keep & (merged[OP] != OP_DELETE)
+            return {k: v[alive] for k, v in merged.items()}
 
 
 class RegionEngine:

@@ -110,6 +110,18 @@ class S3ObjectStore(ObjectStore):
             os.makedirs(cache_dir, exist_ok=True)
         parsed = urllib.parse.urlparse(self.endpoint)
         self.host = parsed.netloc
+        # scan-driven readahead state (see prefetch): daemon workers are
+        # started lazily on the first prefetch; the in-flight map lets the
+        # read path WAIT for a fetch already on the wire instead of
+        # downloading the same object twice
+        self._prefetch_lock = threading.Lock()
+        self._inflight: dict[str, threading.Event] = {}
+        self._prefetch_q = None
+        # default matches the widest decode pool (storage/scan.py): the
+        # read path JOINS in-flight prefetches, so fewer fetchers than
+        # decode threads would serialize a fetch-bound cold scan
+        self._prefetch_threads = max(
+            1, int(os.environ.get("GREPTIME_PREFETCH_THREADS", "8")))
 
     # ---- plumbing ------------------------------------------------------
     def _key(self, path: str) -> str:
@@ -170,6 +182,69 @@ class S3ObjectStore(ObjectStore):
                 os.unlink(tmp)
             raise
 
+    # ---- scan-driven readahead ----------------------------------------
+    def _ensure_prefetch_workers(self) -> None:
+        with self._prefetch_lock:
+            if self._prefetch_q is not None:
+                return
+            import queue
+
+            self._prefetch_q = queue.Queue()
+            for i in range(self._prefetch_threads):
+                t = threading.Thread(
+                    target=self._prefetch_worker, daemon=True,
+                    name=f"s3-prefetch-{i}",
+                )
+                t.start()
+
+    def _prefetch_worker(self) -> None:
+        while True:
+            path = self._prefetch_q.get()
+            try:
+                status, body = self._request("GET", self._key(path))
+                if status != 404:
+                    cp = self._cache_path(path)
+                    if cp:
+                        self._cache_fill(cp, body)
+            except Exception:  # noqa: BLE001 — readahead is best-effort;
+                pass  # the read path re-fetches on demand
+            finally:
+                with self._prefetch_lock:
+                    ev = self._inflight.pop(path, None)
+                if ev is not None:
+                    ev.set()
+
+    def prefetch(self, paths: list[str]) -> int:
+        """Queue background read-through fills for not-yet-local objects
+        (the scan pipeline calls this with the selected SSTs before the
+        decode pool reaches them).  Returns the number queued; objects
+        already cached or already in flight are skipped."""
+        if not self.cache_dir:
+            return 0
+        queued = 0
+        for path in paths:
+            cp = self._cache_path(path)
+            if cp and os.path.exists(cp):
+                continue
+            with self._prefetch_lock:
+                if path in self._inflight:
+                    continue
+                self._inflight[path] = threading.Event()
+            self._ensure_prefetch_workers()
+            self._prefetch_q.put(path)
+            queued += 1
+        return queued
+
+    def _wait_inflight(self, path: str) -> None:
+        """Block (bounded) on an in-flight prefetch of ``path`` so the
+        read path joins the existing download instead of duplicating it;
+        a wedged fetch degrades to the caller's own fetch after the
+        timeout."""
+        with self._prefetch_lock:
+            ev = self._inflight.get(path)
+        if ev is not None:
+            ev.wait(timeout=60.0)
+
     # ---- ObjectStore ---------------------------------------------------
     def write(self, path: str, data: bytes) -> None:
         status, _body = self._request("PUT", self._key(path), payload=data)
@@ -180,6 +255,7 @@ class S3ObjectStore(ObjectStore):
             self._cache_fill(cp, data)
 
     def read(self, path: str) -> bytes:
+        self._wait_inflight(path)
         cp = self._cache_path(path)
         if cp and os.path.exists(cp):
             with open(cp, "rb") as f:
@@ -247,6 +323,8 @@ class S3ObjectStore(ObjectStore):
         cp = self._cache_path(path)
         if cp is None:
             return None
+        if not os.path.exists(cp):
+            self._wait_inflight(path)  # join a prefetch already in flight
         if not os.path.exists(cp):
             try:
                 self.read(path)  # read-through populates the cache

@@ -12,6 +12,7 @@ footer give a second pruning level (reference reader.rs row-group pruning).
 from __future__ import annotations
 
 import io
+import threading
 import uuid
 from dataclasses import dataclass, field
 
@@ -20,8 +21,23 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from greptimedb_tpu.datatypes.schema import Schema, default_fill_array
-from greptimedb_tpu.storage.memtable import OP, SEQ, TSID
+from greptimedb_tpu.storage.memtable import OP, SEQ, TSID, tagcode_col
 from greptimedb_tpu.storage.object_store import ObjectStore
+from greptimedb_tpu.utils.telemetry import REGISTRY
+
+# per-row python-object materializations for dictionary-encoded string
+# columns.  The code-path scan (``tag_encoders`` + ``decode_tags=False``)
+# keeps dictionary indices as region codes instead; a tier-1 guard pins
+# that the hot scan path (device-cache builds) never grows this counter.
+M_OBJECT_DECODE_ROWS = REGISTRY.counter(
+    "greptime_scan_object_decode_rows_total",
+    "Rows decoded into per-row python objects from dictionary-encoded "
+    "columns (0 on the code-path scan)",
+)
+
+# the rare legacy fallback in _dict_to_codes mutates a region encoder from
+# a decode thread; serialize those inserts (scans may decode in parallel)
+_ENCODER_FALLBACK_LOCK = threading.Lock()
 
 
 @dataclass(frozen=True)
@@ -84,10 +100,11 @@ def write_sst(
 ) -> SstMeta:
     """Write one sorted SST; caller guarantees (tsid, ts, seq) order.
 
-    ``tag_dicts`` + ``__tagcode_<name>__`` companion columns (write path)
-    build the Parquet dictionary pages directly from region codes — no
-    per-row string hashing; compaction inputs lack codes and take the
-    hash-encode fallback."""
+    ``tag_dicts`` + ``__tagcode_<name>__`` companion columns build the
+    Parquet dictionary pages directly from region codes — no per-row
+    string hashing.  Both flush and compaction supply codes (compaction
+    reads its inputs on the code path); the hash-encode fallback only
+    covers callers with raw values and no companions."""
     from greptimedb_tpu.storage.memtable import tagcode_col
 
     ts_col = schema.time_index.name
@@ -98,8 +115,9 @@ def write_sst(
     target = _arrow_schema(schema)
     arrays = []
     for f in target:
-        col = columns[f.name]
         if pa.types.is_dictionary(f.type):
+            # codes-first: a code-path scan (compaction over coded parts)
+            # may carry ONLY ``__tagcode_*__`` companions, no raw values
             codes = columns.get(tagcode_col(f.name))
             vocab = (tag_dicts or {}).get(f.name)
             if codes is not None and vocab is not None:
@@ -115,11 +133,11 @@ def write_sst(
                 ))
             else:
                 arrays.append(
-                    pa.array(col.astype(object), type=pa.utf8())
+                    pa.array(columns[f.name].astype(object), type=pa.utf8())
                     .dictionary_encode()
                 )
         else:
-            arrays.append(pa.array(col, type=f.type))
+            arrays.append(pa.array(columns[f.name], type=f.type))
     table = pa.Table.from_arrays(arrays, schema=target)
 
     sink = io.BytesIO()
@@ -150,6 +168,30 @@ def write_sst(
     )
 
 
+def _dict_to_codes(arr, enc) -> np.ndarray:
+    """Dictionary array → region tag codes: map the file's (small)
+    dictionary through the region encoder ONCE, vectorized over the
+    int32 indices — the per-row cost is a single numpy gather, never a
+    python-object materialization.  A null dictionary entry maps like
+    the write path's NULL convention (empty string)."""
+    dict_vals = ["" if v is None else v for v in arr.dictionary.to_pylist()]
+    mapping = np.fromiter(
+        (enc.get(v) for v in dict_vals), dtype=np.int32,
+        count=len(dict_vals),
+    )
+    if bool((mapping < 0).any()):
+        # legacy file carrying a value the region dicts never saw (e.g.
+        # pre-manifest data): register it, serialized against concurrent
+        # decode threads — codes are append-only so readers stay valid
+        with _ENCODER_FALLBACK_LOCK:
+            mapping = np.fromiter(
+                (enc.get_or_insert(v) for v in dict_vals), dtype=np.int32,
+                count=len(dict_vals),
+            )
+    indices = arr.indices.to_numpy(zero_copy_only=False)
+    return mapping[indices.astype(np.int64, copy=False)]
+
+
 def read_sst(
     store: ObjectStore,
     meta: SstMeta,
@@ -157,15 +199,23 @@ def read_sst(
     ts_range: tuple[int | None, int | None] = (None, None),
     columns: list[str] | None = None,
     tag_filters: dict[str, set] | None = None,
+    tag_encoders: dict | None = None,
+    decode_tags: bool = True,
 ) -> dict[str, np.ndarray]:
     """Read an SST back into numpy columns, pruning row groups by time and
     (when ``tag_filters`` equality/IN sets are given) by tag values via
     Parquet dictionary/statistics filtering — the row-group-level
     counterpart of the file-level bloom skipping index.
 
-    Tag dictionary columns come back as raw values (object arrays);
-    re-encoding to region codes happens in the cache layer against the
-    region dictionaries.
+    Tag transfer is two-mode.  Default (``tag_encoders=None``): dictionary
+    columns come back as raw values (object arrays) and re-encoding
+    happens downstream.  Code path (``tag_encoders`` = the region's
+    DictionaryEncoders): each dictionary column additionally yields a
+    ``__tagcode_<name>__`` int32 companion in REGION code space — the
+    file's dictionary is mapped once, vectorized — and with
+    ``decode_tags=False`` the per-row object array is never materialized
+    at all, so the cache layer consumes codes directly without re-hashing
+    a single string.
     """
     ts_idx = schema.time_index
     ts_col = ts_idx.name
@@ -182,6 +232,17 @@ def read_sst(
             conj.append((col, "in", [str(v) for v in values]))
     filters = conj or None
 
+    from greptimedb_tpu.storage.scan import M_SCAN_BYTES, M_SCAN_FILES
+
+    M_SCAN_FILES.labels("read").inc()
+    # bytes DECODED, not file size: scale by the ts overlap fraction so
+    # row-group-pruned reads (grid catch-up tails) don't overstate the
+    # metric by the whole file
+    span = max(1, meta.ts_max - meta.ts_min + 1)
+    eff_lo = meta.ts_min if lo is None else max(meta.ts_min, int(lo))
+    eff_hi = meta.ts_max + 1 if hi is None else min(meta.ts_max + 1, int(hi))
+    M_SCAN_BYTES.inc(
+        meta.size_bytes * min(1.0, max(0.0, (eff_hi - eff_lo) / span)))
     local = store.local_path(meta.path)
     src = local if local else io.BytesIO(store.read(meta.path))
     internal = (TSID, SEQ, OP)
@@ -203,9 +264,33 @@ def read_sst(
             continue  # dropped by ALTER; dead weight in old SSTs
         arr = table.column(name).combine_chunks()
         if pa.types.is_dictionary(arr.type):
-            # decode via the (small) dictionary, not per-row python objects
+            enc = (tag_encoders or {}).get(name)
+            if enc is not None:
+                if arr.null_count == 0:
+                    out[tagcode_col(name)] = _dict_to_codes(arr, enc)
+                    if not decode_tags:
+                        continue  # codes ARE the column; no object array
+                else:
+                    # anomalous row-level nulls (never written by this
+                    # engine): decode and re-encode so the code companion
+                    # invariant still holds for every part of a scan
+                    vals = np.asarray(arr.to_pylist(), dtype=object)
+                    M_OBJECT_DECODE_ROWS.inc(len(vals))
+                    with _ENCODER_FALLBACK_LOCK:
+                        out[tagcode_col(name)] = np.fromiter(
+                            (enc.get_or_insert("" if v is None else v)
+                             for v in vals),
+                            dtype=np.int32, count=len(vals),
+                        )
+                    if decode_tags:
+                        out[name] = vals
+                    continue
+            # decode via the (small) dictionary, not per-row to_pylist —
+            # still a per-row object-pointer array, which the hot scan
+            # path avoids entirely (tier-1 pins the counter at 0 there)
             dict_vals = np.asarray(arr.dictionary.to_pylist(), dtype=object)
             indices = arr.indices.to_numpy(zero_copy_only=False)
+            M_OBJECT_DECODE_ROWS.inc(len(indices))
             out[name] = dict_vals[indices]
         elif pa.types.is_string(arr.type) or pa.types.is_binary(arr.type):
             out[name] = np.asarray(arr.to_pylist(), dtype=object)
@@ -217,5 +302,18 @@ def read_sst(
     n = len(out[SEQ]) if SEQ in out else (table.num_rows)
     for c in schema:
         if c.name in want and c.name not in out:
+            enc = ((tag_encoders or {}).get(c.name)
+                   if c.is_tag and c.dtype.is_string_like else None)
+            if enc is not None:
+                if tagcode_col(c.name) not in out:
+                    fill = default_fill_array(c, 1)[0]
+                    code = enc.get(fill)
+                    if code < 0:
+                        with _ENCODER_FALLBACK_LOCK:
+                            code = enc.get_or_insert(fill)
+                    out[tagcode_col(c.name)] = np.full(n, code,
+                                                       dtype=np.int32)
+                if not decode_tags:
+                    continue  # the code companion IS the column
             out[c.name] = default_fill_array(c, n)
     return out

@@ -3,13 +3,19 @@
 Equivalent of the reference's common-memory-manager crate (SURVEY.md §2.9:
 workload memory quotas with policies/guards, src/common/memory-manager/
 {policy.rs,guard.rs}): named workloads (ingest write-buffer, query device
-cache, query build working set) each get a byte quota and a policy for
-what happens at the ceiling — reclaim (flush/evict) first, then reject
-with RUNTIME_RESOURCES_EXHAUSTED or proceed best-effort.
+cache, derived layout caches, scan staging buffers) each get a byte quota
+and a policy for what happens at the ceiling — reclaim (flush/evict)
+first, then reject with RUNTIME_RESOURCES_EXHAUSTED or proceed
+best-effort.  Reject-to-fallback callers (``try_admit``) degrade to a
+slower path instead: the layout caches serve uncached, the scan pipeline
+drops to sequential single-file decode.
 
 Accounting is PULL-based: each workload's live usage is read from the
-owning component (memtable bytes, cache LRU bytes) at admission time, so
-there is exactly one source of truth and no double bookkeeping.
+owning component (memtable bytes, cache LRU bytes, scan staging counter)
+at admission time, so there is exactly one source of truth and no double
+bookkeeping.  ``peak_bytes`` records the high-water mark seen at
+admissions — transient workloads (scan staging) spike between scrapes,
+so the live gauges alone under-report their real footprint.
 """
 
 from __future__ import annotations
@@ -53,6 +59,9 @@ class Workload:
     # drivers can read per-workload pressure without scraping the registry
     rejected: int = 0
     reclaims: int = 0
+    # high-water mark of (usage + requested) observed at admission time —
+    # the honest footprint of spiky workloads between scrapes
+    peak_bytes: int = 0
 
 
 class WorkloadMemoryManager:
@@ -113,9 +122,16 @@ class WorkloadMemoryManager:
     def admit(self, name: str, nbytes: int) -> None:
         with self._lock:
             w = self._workloads.get(name)
-        if w is None or w.quota_bytes is None:
+        if w is None:
+            return
+        if w.quota_bytes is None:
+            # unlimited: skip the usage pull (hot ingest path) — the
+            # request size alone still records a useful high-water mark
+            if nbytes > w.peak_bytes:
+                w.peak_bytes = nbytes
             return
         used = w.usage_fn()
+        w.peak_bytes = max(w.peak_bytes, used + nbytes)
         if used + nbytes <= w.quota_bytes:
             return
         if nbytes > w.quota_bytes and w.policy == "reject":
@@ -169,6 +185,7 @@ class WorkloadMemoryManager:
                 "policy": w.policy,
                 "rejected": w.rejected,
                 "reclaims": w.reclaims,
+                "peak_bytes": int(w.peak_bytes),
             }
             for w in workloads
         }

@@ -352,9 +352,10 @@ class TestSkippingIndex:
         orig = regmod.read_sst
 
         def counting(store, meta, schema, ts_range=(None, None), columns=None,
-                     tag_filters=None):
+                     tag_filters=None, **kwargs):
             reads.append(meta.file_id)
-            return orig(store, meta, schema, ts_range, columns, tag_filters)
+            return orig(store, meta, schema, ts_range, columns, tag_filters,
+                        **kwargs)
 
         regmod.read_sst = counting
         try:
