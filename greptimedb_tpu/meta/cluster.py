@@ -21,12 +21,25 @@ from greptimedb_tpu.datatypes.schema import Schema
 from greptimedb_tpu.errors import GreptimeError, InvalidArguments, RegionNotFound
 from greptimedb_tpu.meta.failure_detector import PhiAccrualFailureDetector
 from greptimedb_tpu.meta.kv import KvBackend
-from greptimedb_tpu.meta.procedure import (
-    Procedure, ProcedureContext, ProcedureManager, Status,
-)
+from greptimedb_tpu.meta.procedure import ProcedureManager
 from greptimedb_tpu.storage.region import RegionEngine
+from greptimedb_tpu.utils.telemetry import REGISTRY
 
 REGION_LEASE_MS = 20_000.0
+
+# Replication lag of follower replicas, published from heartbeats (ISSUE 6:
+# the bounded-staleness read contract reads these through the kv follower
+# routes; /metrics shows the same numbers so the two can never disagree).
+M_REPL_LAG_S = REGISTRY.gauge(
+    "greptime_replication_lag_seconds",
+    "Seconds since a follower replica last synced from shared storage",
+    labels=("region", "node"),
+)
+M_REPL_LAG_E = REGISTRY.gauge(
+    "greptime_replication_lag_entries",
+    "WAL entries a follower replica trails its leader by",
+    labels=("region", "node"),
+)
 
 
 class Datanode:
@@ -49,6 +62,7 @@ class Datanode:
         self.lease_until_ms: dict[int, float] = {}
         self.alive = True
         self._sync_fingerprints: dict[int, tuple] = {}
+        self.replica_sync_ms: dict[int, float] = {}  # follower last sync
 
     # ---- data plane ----------------------------------------------------
     def read(self, region_id: int, ts_range=(None, None), columns=None):
@@ -62,7 +76,7 @@ class Datanode:
             raise RegionNotFound(f"region {region_id} not on node {self.node_id}")
         return region.scan_host(ts_range, columns)
 
-    def sync_region(self, region_id: int) -> None:
+    def sync_region(self, region_id: int, now_ms: float = 0.0) -> None:
         """Follower catch-up from shared storage (reference
         SyncRegionFromRequest); no-op when storage hasn't changed since the
         last sync (a full manifest+WAL re-read per heartbeat would be pure
@@ -72,9 +86,38 @@ class Datanode:
             raise RegionNotFound(f"region {region_id} not on node {self.node_id}")
         fp = region.storage_fingerprint()
         if self._sync_fingerprints.get(region_id) == fp:
+            self.replica_sync_ms[region_id] = now_ms  # up to date IS a sync
             return
         region.catch_up()
         self._sync_fingerprints[region_id] = region.storage_fingerprint()
+        self.replica_sync_ms[region_id] = now_ms
+
+    # ---- object plane (region snapshot shipping) -----------------------
+    # The migration procedure's bulk-copy surface: region objects (SSTs,
+    # skipping indexes, manifest files — and WAL segments when the WAL
+    # lives under the data home) move between data homes through these.
+    # RemoteDatanode mirrors the same four methods over Flight, so the
+    # procedure drives in-process and OS-process nodes identically.
+    def _check_object_path(self, path: str) -> str:
+        if not self.alive:
+            raise GreptimeError(f"datanode {self.node_id} is down")
+        if not path.startswith("region_") or ".." in path:
+            raise InvalidArguments(f"not a region object path: {path}")
+        return path
+
+    def list_region_objects(self, region_id: int) -> list[str]:
+        if not self.alive:
+            raise GreptimeError(f"datanode {self.node_id} is down")
+        return list(self.engine.store.list(f"region_{region_id}/"))
+
+    def fetch_object(self, path: str) -> bytes:
+        return self.engine.store.read(self._check_object_path(path))
+
+    def put_object(self, path: str, data: bytes) -> None:
+        self.engine.store.write(self._check_object_path(path), data)
+
+    def delete_object(self, path: str) -> None:
+        self.engine.store.delete(self._check_object_path(path))
 
     def write(self, region_id: int, data: dict, now_ms: float) -> int:
         if not self.alive:
@@ -98,12 +141,19 @@ class Datanode:
             raise GreptimeError(f"datanode {self.node_id} is down")
         regions = []
         for rid, region in self.engine.regions.items():
-            regions.append({
+            info = {
                 "region_id": rid,
                 "role": self.roles.get(rid, "follower"),
                 "num_rows": region.memtable.num_rows
                 + sum(m.num_rows for m in region.sst_files),
-            })
+                "last_seq": region.next_seq - 1,
+            }
+            if info["role"] == "follower":
+                synced = self.replica_sync_ms.get(rid)
+                info["sync_lag_ms"] = (
+                    None if synced is None else max(now_ms - synced, 0.0)
+                )
+            regions.append(info)
         return {"node_id": self.node_id, "regions": regions, "ts": now_ms}
 
     def handle_instruction(self, instr: dict, now_ms: float) -> dict:
@@ -147,9 +197,12 @@ class Datanode:
             return {"ok": True}
         if kind == "downgrade_region":
             region = self.engine.regions.get(rid)
-            if region is not None:
-                region.flush()
+            # fence FIRST, then flush: a write racing the downgrade must
+            # either be rejected or land before the flush — never in the
+            # gap where only the WAL tail would carry it off a local disk
             self.roles[rid] = "downgrading"
+            if region is not None and instr.get("flush", True):
+                region.flush()
             return {"ok": True, "last_seq": region.next_seq - 1 if region else 0}
         if kind == "upgrade_region":
             region = self.engine.regions.get(rid)
@@ -170,7 +223,7 @@ class Datanode:
                 self.lease_until_ms[rid] = now_ms + REGION_LEASE_MS
             return {"ok": True}
         if kind == "sync_region":
-            self.sync_region(rid)
+            self.sync_region(rid, now_ms)
             return {"ok": True}
         raise GreptimeError(f"unknown instruction {kind}")
 
@@ -185,66 +238,15 @@ class Datanode:
         return expired
 
 
-class RegionMigrationProcedure(Procedure):
-    """OpenCandidate → Downgrade → Upgrade → UpdateMetadata → CloseOld
-    (reference migration_start.rs ... migration_end.rs)."""
-
-    type_name = "region_migration"
-
-    def execute(self, ctx: ProcedureContext) -> Status:
-        s = self.state
-        datanodes: dict[int, Datanode] = ctx.services["datanodes"]
-        metasrv: Metasrv = ctx.services["metasrv"]
-        rid = s["region_id"]
-        src = s["from_node"]
-        dst = s["to_node"]
-        now = s.get("now_ms", 0.0)
-        phase = s.setdefault("phase", "open_candidate")
-
-        if phase == "open_candidate":
-            dn = datanodes[dst]
-            dn.handle_instruction(
-                {"kind": "open_region", "region_id": rid, "role": "follower",
-                 "schema": s.get("schema")}, now,
-            )
-            s["phase"] = "downgrade_leader"
-            return Status.executing()
-        if phase == "downgrade_leader":
-            src_dn = datanodes.get(src)
-            if src_dn is not None and src_dn.alive:
-                src_dn.handle_instruction(
-                    {"kind": "downgrade_region", "region_id": rid}, now
-                )
-            s["phase"] = "upgrade_candidate"
-            return Status.executing()
-        if phase == "upgrade_candidate":
-            datanodes[dst].handle_instruction(
-                {"kind": "upgrade_region", "region_id": rid}, now
-            )
-            s["phase"] = "update_metadata"
-            return Status.executing()
-        if phase == "update_metadata":
-            metasrv.set_region_route(rid, dst)
-            s["phase"] = "close_old"
-            return Status.executing()
-        if phase == "close_old":
-            src_dn = datanodes.get(src)
-            if src_dn is not None and src_dn.alive:
-                src_dn.handle_instruction(
-                    {"kind": "close_region", "region_id": rid}, now
-                )
-            return Status.done({"region_id": rid, "to_node": dst})
-        raise GreptimeError(f"unknown migration phase {phase}")
-
-    def lock_keys(self) -> list[str]:
-        return [f"region/{self.state['region_id']}"]
-
-
 class Metasrv:
     """Cluster brain (reference src/meta-srv/src/metasrv.rs:556): heartbeat
     handler chain, failure detection, region routes, migration driving."""
 
     def __init__(self, kv: KvBackend):
+        from greptimedb_tpu.meta.migration import (
+            RegionFailoverProcedure, RegionMigrationProcedure,
+        )
+
         self.kv = kv
         self.datanodes: dict[int, Datanode] = {}
         self.detectors: dict[int, PhiAccrualFailureDetector] = {}
@@ -252,6 +254,7 @@ class Metasrv:
             kv, services={"datanodes": self.datanodes, "metasrv": self}
         )
         self.procedures.register(RegionMigrationProcedure)
+        self.procedures.register(RegionFailoverProcedure)
         from greptimedb_tpu.meta.reconciliation import (
             ReconcileCatalogProcedure, ReconcileDatabaseProcedure,
             ReconcileTableProcedure,
@@ -261,6 +264,7 @@ class Metasrv:
         self.procedures.register(ReconcileDatabaseProcedure)
         self.procedures.register(ReconcileCatalogProcedure)
         self.maintenance_mode = False
+        self._leader_seq: dict[int, int] = {}  # from leader heartbeats
 
     # ---- membership ----------------------------------------------------
     def register_datanode(self, dn: Datanode) -> None:
@@ -281,6 +285,33 @@ class Metasrv:
             out[int(k.rsplit("/", 1)[-1])] = json.loads(v)["node"]
         return out
 
+    # ---- follower routes (read replicas) -------------------------------
+    # Follower placement + freshness live in the kv store next to the
+    # leader routes, so stateless frontends can route bounded-staleness
+    # reads without talking to the metasrv (reference: RegionRoute
+    # follower_peers in the table route value, src/common/meta/src/rpc/
+    # router.rs + the read-preference RFC).
+    def _followers_key(self, region_id: int) -> str:
+        return f"__meta/route/followers/{region_id}"
+
+    def follower_routes(self, region_id: int) -> dict[int, dict]:
+        rec = self.kv.get_json(self._followers_key(region_id)) or {}
+        return {int(n): meta for n, meta in rec.get("nodes", {}).items()}
+
+    def _put_follower_routes(self, region_id: int,
+                             nodes: dict[int, dict]) -> None:
+        if nodes:
+            self.kv.put_json(self._followers_key(region_id),
+                             {"nodes": {str(n): m for n, m in nodes.items()}})
+        else:
+            self.kv.delete(self._followers_key(region_id))
+
+    def remove_follower_route(self, region_id: int, node_id: int) -> None:
+        nodes = self.follower_routes(region_id)
+        if node_id in nodes:
+            del nodes[node_id]
+            self._put_follower_routes(region_id, nodes)
+
     # ---- heartbeat chain (reference handler.rs:322) --------------------
     def handle_heartbeat(self, hb: dict, now_ms: float) -> list[dict]:
         node_id = hb["node_id"]
@@ -290,17 +321,40 @@ class Metasrv:
         det.heartbeat(now_ms)
         instructions = []
         for r in hb.get("regions", []):
-            if r["role"] == "leader" and self.region_route(r["region_id"]) == node_id:
+            rid = r["region_id"]
+            if r["role"] == "leader" and self.region_route(rid) == node_id:
                 # lease renewal for leader regions this node legitimately routes
+                self._leader_seq[rid] = int(r.get("last_seq", 0))
                 instructions.append(
-                    {"kind": "renew_lease", "region_id": r["region_id"]}
+                    {"kind": "renew_lease", "region_id": rid}
                 )
             elif r["role"] == "follower":
+                self._note_follower_lag(rid, node_id, r, now_ms)
                 # read replicas catch up from shared storage each beat
                 instructions.append(
-                    {"kind": "sync_region", "region_id": r["region_id"]}
+                    {"kind": "sync_region", "region_id": rid}
                 )
         return instructions
+
+    def _note_follower_lag(self, region_id: int, node_id: int, r: dict,
+                           now_ms: float) -> None:
+        """Publish follower freshness to the registry and the kv follower
+        route (the frontend's bounded-staleness input)."""
+        lag_ms = r.get("sync_lag_ms")
+        entries = max(
+            self._leader_seq.get(region_id, 0) - int(r.get("last_seq", 0)), 0
+        )
+        if lag_ms is not None:
+            # a replica that has NEVER synced makes no freshness claim:
+            # exporting 0 here would show a stuck replica as perfect
+            M_REPL_LAG_S.labels(str(region_id), str(node_id)).set(
+                lag_ms / 1000.0)
+        M_REPL_LAG_E.labels(str(region_id), str(node_id)).set(entries)
+        nodes = self.follower_routes(region_id)
+        if node_id in nodes or self.region_route(region_id) is not None:
+            nodes[node_id] = {"lag_ms": lag_ms, "entries_behind": entries,
+                              "ts": now_ms}
+            self._put_follower_routes(region_id, nodes)
 
     def add_follower(self, region_id: int, node_id: int, now_ms: float) -> None:
         """Open a read replica of a region on another node."""
@@ -326,6 +380,10 @@ class Metasrv:
         # without a schema the follower can still open a region that exists
         # on shared storage; a truly unknown region raises RegionNotFound
         self.datanodes[node_id].handle_instruction(instr, now_ms)
+        nodes = self.follower_routes(region_id)
+        nodes[node_id] = {"lag_ms": None, "entries_behind": None,
+                          "ts": now_ms}
+        self._put_follower_routes(region_id, nodes)
 
     # ---- supervision (reference region/supervisor.rs:280) --------------
     def select_target(self, exclude: set[int]) -> int | None:
@@ -340,8 +398,23 @@ class Metasrv:
                 best, best_load = nid, load
         return best
 
+    def select_failover_target(self, region_id: int,
+                               exclude: set[int]) -> int | None:
+        """Prefer an alive node already hosting the region as a follower
+        replica (its data is warm and nearly caught up — reference
+        region_failover candidate selection); else least-loaded alive."""
+        for nid, dn in self.datanodes.items():
+            if nid in exclude:
+                continue
+            try:
+                if dn.alive and dn.roles.get(region_id) == "follower":
+                    return nid
+            except GreptimeError:
+                continue
+        return self.select_target(exclude)
+
     def tick(self, now_ms: float) -> list[dict]:
-        """Failure detection sweep; returns completed migrations."""
+        """Failure detection sweep; returns completed failovers."""
         if self.maintenance_mode:
             return []
         migrated = []
@@ -353,22 +426,28 @@ class Metasrv:
             for rid, node in self.routes().items():
                 if node != nid:
                     continue
-                target = self.select_target(exclude={nid})
+                target = self.select_failover_target(rid, exclude={nid})
                 if target is None:
                     continue
                 migrated.append(
-                    self._submit_migration(rid, nid, target, now_ms)
+                    self._submit_migration(rid, nid, target, now_ms,
+                                           failover=True)
                 )
         return migrated
 
     def _submit_migration(self, region_id: int, from_node: int, to_node: int,
-                          now_ms: float) -> dict:
+                          now_ms: float, failover: bool = False) -> dict:
+        from greptimedb_tpu.meta.migration import (
+            RegionFailoverProcedure, RegionMigrationProcedure,
+        )
+
         # schema peek is best-effort: a dead from-node's proxy reports no
         # regions (rpc client swallows transport errors) and the candidate
         # then opens from shared storage via the manifest
         region = self.datanodes[from_node].engine.regions.get(region_id)
         schema = region.schema.to_dict() if region is not None else None
-        proc = RegionMigrationProcedure(state={
+        cls = RegionFailoverProcedure if failover else RegionMigrationProcedure
+        proc = cls(state={
             "region_id": region_id, "from_node": from_node, "to_node": to_node,
             "schema": schema, "now_ms": now_ms,
         })
@@ -378,6 +457,18 @@ class Metasrv:
                        now_ms: float) -> dict:
         """Manual migration (reference admin migrate_region function)."""
         return self._submit_migration(region_id, from_node, to_node, now_ms)
+
+    def failover_region(self, region_id: int, now_ms: float) -> dict:
+        """Force-promote the best replica of a region whose leader is
+        gone (admin analog of the supervisor's automatic path)."""
+        from_node = self.region_route(region_id)
+        if from_node is None:
+            raise GreptimeError(f"no route for region {region_id}")
+        target = self.select_failover_target(region_id, exclude={from_node})
+        if target is None:
+            raise GreptimeError("no failover target available")
+        return self._submit_migration(region_id, from_node, target, now_ms,
+                                      failover=True)
 
     # ---- reconciliation (reference reconciliation/manager.rs) ----------
     def reconcile_table(self, db: str, table: str,

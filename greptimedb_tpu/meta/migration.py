@@ -1,0 +1,220 @@
+"""Live region migration + failover: journaled, resumable procedures.
+
+Reference: src/meta-srv/src/procedure/region_migration/ (the
+OpenCandidate → Downgrade → Upgrade → UpdateMetadata → CloseOld state
+machine, migration_start.rs … migration_end.rs) and the fault-tolerance
+RFC (docs/rfcs/2023-03-08-region-fault-tolerance.md).  Two additions
+over the reference's open-from-shared-storage flow:
+
+- **Snapshot shipping.**  When source and target datanodes do NOT share
+  an object store, the region's objects (SSTs, skipping indexes,
+  manifest files — and WAL segments when the WAL lives under the data
+  home) are bulk-copied source→target over the Flight object plane on a
+  bounded thread pool (the PR 5 streaming-pipeline discipline: fetch and
+  install overlap across files).  Shared storage is detected with a
+  probe object and the copy collapses to a no-op.
+- **Two-round copy.**  The bulk ship runs while the source still serves
+  writes; the source is only then fenced (downgrade: reject writes,
+  flush) and a small delta sync mirrors whatever landed during the ship.
+  The target's open/catch-up replays the remaining WAL tail from the
+  shared broker (remote WAL) or the shipped segments (local WAL), so a
+  migration under live writes is bit-exact vs a quiesced copy.
+
+Every phase journals its state through the procedure framework before
+executing, so a metasrv crash at ANY phase resumes to a consistent
+route: re-running a phase is idempotent by construction (mirror copies
+skip already-installed immutable files, fencing and opening are
+re-appliable, the route swap is last).
+
+``RegionFailoverProcedure`` drives the same machinery with the source
+presumed dead (phi-accrual detector tripped): ship/fence/delta collapse
+and the target — preferably a node already holding a follower replica —
+opens from shared storage and replays the remote-WAL tail.  This is the
+"datanodes are (nearly) stateless" payoff the remote WAL promises
+(storage/remote_wal.py): nothing on the dead machine is needed.
+"""
+
+from __future__ import annotations
+
+import re
+from concurrent.futures import ThreadPoolExecutor
+
+from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.meta.procedure import Procedure, ProcedureContext, Status
+from greptimedb_tpu.utils.telemetry import REGISTRY
+
+# SSTs and their skipping indexes are immutable and uniquely named
+# (uuid file ids): one already on the target is done forever.  Manifest
+# json / WAL segments / watermark markers re-copy every round (append-
+# or version-mutated).
+_IMMUTABLE = re.compile(r"\.(parquet|idx)$")
+
+_COPY_WORKERS = 4
+
+M_MIGRATION_PHASE = REGISTRY.counter(
+    "greptime_region_migration_phase_total",
+    "Region migration/failover phases executed",
+    labels=("procedure", "phase"),
+)
+M_MIGRATION_OBJECTS = REGISTRY.counter(
+    "greptime_region_migration_objects_total",
+    "Objects shipped (or pruned) by region migration bulk copy",
+    labels=("kind",),
+)
+
+
+def _alive(dn) -> bool:
+    if dn is None:
+        return False
+    try:
+        return bool(dn.alive)
+    except Exception:  # noqa: BLE001 — an unreachable proxy is dead
+        return False
+
+
+class RegionMigrationProcedure(Procedure):
+    """state: {region_id, from_node, to_node, schema, now_ms, phase,
+    source_dead, shared_store, shipped, delta_shipped, fenced_seq}."""
+
+    type_name = "region_migration"
+
+    def lock_keys(self) -> list[str]:
+        return [f"region/{self.state['region_id']}"]
+
+    # ---- bulk copy -----------------------------------------------------
+    @staticmethod
+    def _same_store(src, dst, rid: int, pid: str) -> bool:
+        """Probe whether the two nodes see one object store: write a
+        marker through the source, look for it through the target."""
+        probe = f"region_{rid}/.migprobe-{pid}"
+        src.put_object(probe, b"1")
+        try:
+            return probe in set(dst.list_region_objects(rid))
+        finally:
+            src.delete_object(probe)
+
+    @staticmethod
+    def _mirror_copy(src, dst, rid: int) -> int:
+        """Make the target's ``region_<rid>/`` tree a mirror of the
+        source's: ship missing/mutable objects (overlapped on a bounded
+        pool), prune target objects the source no longer has (stale
+        manifest deltas from an earlier tenure would otherwise be applied
+        on open).  Idempotent — a resumed phase re-ships only deltas."""
+        src_objs = src.list_region_objects(rid)
+        dst_objs = set(dst.list_region_objects(rid))
+        to_copy = [p for p in src_objs
+                   if not (_IMMUTABLE.search(p) and p in dst_objs)]
+        if to_copy:
+            with ThreadPoolExecutor(
+                min(_COPY_WORKERS, len(to_copy))
+            ) as pool:
+                list(pool.map(
+                    lambda p: dst.put_object(p, src.fetch_object(p)),
+                    to_copy,
+                ))
+            M_MIGRATION_OBJECTS.labels("shipped").inc(len(to_copy))
+        src_set = set(src_objs)
+        stale = [p for p in dst_objs if p not in src_set]
+        for p in stale:
+            dst.delete_object(p)
+        if stale:
+            M_MIGRATION_OBJECTS.labels("pruned").inc(len(stale))
+        return len(to_copy)
+
+    # ---- state machine -------------------------------------------------
+    def execute(self, ctx: ProcedureContext) -> Status:
+        s = self.state
+        datanodes = ctx.services["datanodes"]
+        metasrv = ctx.services["metasrv"]
+        rid = s["region_id"]
+        dst = datanodes.get(s["to_node"])
+        src = datanodes.get(s["from_node"])
+        if dst is None:
+            raise GreptimeError(f"unknown target datanode {s['to_node']}")
+        now = s.get("now_ms", 0.0)
+        phase = s.setdefault("phase", "prepare")
+        M_MIGRATION_PHASE.labels(self.type_name, phase).inc()
+
+        if phase == "prepare":
+            # ALWAYS probe the source, even on the detector-driven
+            # failover path: a phi false-positive (GC pause, partition to
+            # the metasrv only) leaves a leader that still answers
+            # clients — it must be fenced through the full
+            # ship→downgrade→delta pipeline, or writes it acks during
+            # the takeover are lost (split brain).  Only a source that
+            # really does not answer skips the copy/fence story: its
+            # regions must live on shared storage + shared WAL.
+            s["source_dead"] = not _alive(src)
+            if not s["source_dead"] and s.get("schema") is None:
+                region = src.engine.regions.get(rid)
+                if region is not None:
+                    s["schema"] = region.schema.to_dict()
+            s["phase"] = ("upgrade_target" if s["source_dead"]
+                          else "snapshot_ship")
+            return Status.executing()
+
+        if phase == "snapshot_ship":
+            # bulk copy under live writes (the big transfer happens while
+            # the source still serves; the fence window stays small)
+            if s.get("shared_store") is None:
+                s["shared_store"] = self._same_store(
+                    src, dst, rid, ctx.procedure_id)
+            if not s["shared_store"]:
+                s["shipped"] = self._mirror_copy(src, dst, rid)
+            s["phase"] = "fence_source"
+            return Status.executing()
+
+        if phase == "fence_source":
+            # downgrade: reject writes first, then flush, so everything
+            # acked by the source is in SSTs or the shared WAL tail
+            if _alive(src):
+                out = src.handle_instruction(
+                    {"kind": "downgrade_region", "region_id": rid}, now)
+                s["fenced_seq"] = int(out.get("last_seq", 0))
+            s["phase"] = "delta_sync"
+            return Status.executing()
+
+        if phase == "delta_sync":
+            # second, small mirror round: SSTs flushed and manifest deltas
+            # committed since the snapshot ship
+            if not s.get("shared_store") and _alive(src):
+                s["delta_shipped"] = self._mirror_copy(src, dst, rid)
+            s["phase"] = "upgrade_target"
+            return Status.executing()
+
+        if phase == "upgrade_target":
+            # open-or-promote: a fresh target opens from the shipped (or
+            # shared) manifest and replays the WAL tail; an already-open
+            # follower runs a full ownership catch-up before leadership
+            # (cluster.py open_region handler); an already-leader target
+            # (resume after crash) is a no-op
+            dst.handle_instruction(
+                {"kind": "open_region", "region_id": rid, "role": "leader",
+                 "schema": s.get("schema")}, now)
+            s["phase"] = "update_metadata"
+            return Status.executing()
+
+        if phase == "update_metadata":
+            metasrv.set_region_route(rid, s["to_node"])
+            # a promoted replica is no longer a follower of anything
+            metasrv.remove_follower_route(rid, s["to_node"])
+            s["phase"] = "close_old"
+            return Status.executing()
+
+        if phase == "close_old":
+            if not s.get("source_dead") and _alive(src):
+                src.handle_instruction(
+                    {"kind": "close_region", "region_id": rid}, now)
+            return Status.done({"region_id": rid, "to_node": s["to_node"]})
+
+        raise GreptimeError(f"unknown migration phase {phase}")
+
+
+class RegionFailoverProcedure(RegionMigrationProcedure):
+    """The detector-driven variant: same journaled machinery and the
+    same liveness probe in prepare — the detector's suspicion picks the
+    moment and the target, but only an actually-unreachable source is
+    treated as dead (reference region_failover → region_migration
+    unification; the supervisor submits these from Metasrv.tick)."""
+
+    type_name = "region_failover"

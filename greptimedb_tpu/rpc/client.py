@@ -7,11 +7,23 @@ in-process Datanode surface so Metasrv procedures (migration, failover,
 follower management) drive remote OS processes without modification —
 the cross-process analog of the reference's mock-cluster-vs-real-cluster
 duality (tests-integration/src/cluster.rs:84).
+
+Every RPC goes through a retry/deadline envelope (the reference client's
+retry layer, src/client/src/lib.rs is_retriable + object-store retries):
+transient transport failures and injected chaos faults back off with
+jitter and reconnect, bounded by a per-call deadline, so a blip on the
+wire is survived instead of surfacing as a query failure.  Retries are
+counted in ``greptime_remote_retry_total{service="flight"}`` — the same
+counter storage/s3.py uses — so /metrics shows cluster fault pressure
+in one place.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import random
+import time
 
 import numpy as np
 import pyarrow as pa
@@ -20,23 +32,84 @@ import pyarrow.flight as fl
 from greptimedb_tpu.datatypes.schema import Schema
 from greptimedb_tpu.errors import GreptimeError
 from greptimedb_tpu.storage.memtable import SEQ, TSID
+from greptimedb_tpu.utils.chaos import CHAOS, ChaosError, M_REMOTE_RETRY
+
+# transient transport failures worth a retry: server restarting/not yet
+# listening (unavailable), deadline blips, half-open sockets.  Typed
+# server-side errors (FlightServerError: bad region, bad plan...) are
+# NOT here — retrying a deterministic rejection is pure waste.
+_RETRYABLE = (fl.FlightUnavailableError, fl.FlightTimedOutError,
+              ChaosError, ConnectionError)
+
+_DEADLINE_S = float(os.environ.get("GREPTIME_RPC_DEADLINE_S", "30"))
+_MAX_RETRIES = int(os.environ.get("GREPTIME_RPC_RETRIES", "3"))
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 1.0
 
 
 class DatanodeClient:
-    def __init__(self, address: str):
+    def __init__(self, address: str, deadline_s: float | None = None,
+                 max_retries: int | None = None):
         self.address = address
+        self.deadline_s = _DEADLINE_S if deadline_s is None else deadline_s
+        self.max_retries = (_MAX_RETRIES if max_retries is None
+                            else max_retries)
         self._conn = fl.connect(f"grpc://{address}")
 
     def close(self) -> None:
         self._conn.close()
 
+    def _reconnect(self) -> None:
+        try:
+            self._conn.close()
+        except Exception:  # noqa: BLE001 — channel may already be dead
+            pass
+        self._conn = fl.connect(f"grpc://{self.address}")
+
+    def _call(self, op: str, fn):
+        """Retry envelope: chaos injection point, bounded retries with
+        exponential backoff + jitter, per-call deadline, reconnect on
+        retry (a restarted node needs a fresh channel).  The deadline
+        bounds the IN-FLIGHT attempt too — each attempt carries the
+        remaining budget as a gRPC deadline (FlightCallOptions), so a
+        hung server cannot block the caller past deadline_s.  do_put is
+        at-least-once under real mid-flight failures — region upsert
+        semantics (dedup on (series, ts)) make replays idempotent."""
+        t0 = time.monotonic()
+        attempt = 0
+        while True:
+            remaining = self.deadline_s - (time.monotonic() - t0)
+            options = fl.FlightCallOptions(timeout=max(remaining, 0.05))
+            try:
+                CHAOS.inject("flight.call")
+                return fn(options)
+            except _RETRYABLE as e:
+                attempt += 1
+                elapsed = time.monotonic() - t0
+                if attempt > self.max_retries or elapsed >= self.deadline_s:
+                    raise
+                M_REMOTE_RETRY.labels("flight", type(e).__name__).inc()
+                backoff = min(_BACKOFF_BASE_S * (2 ** (attempt - 1)),
+                              _BACKOFF_CAP_S)
+                # full jitter; never sleep past the deadline
+                delay = min(backoff * (0.5 + random.random() / 2),
+                            max(self.deadline_s - elapsed, 0.0))
+                time.sleep(delay)
+                if not isinstance(e, ChaosError):
+                    self._reconnect()
+
     # ---- control plane -------------------------------------------------
     def action(self, kind: str, body: dict | None = None) -> dict:
         payload = json.dumps(body or {}).encode()
-        results = list(self._conn.do_action(fl.Action(kind, payload)))
-        if not results:
-            return {}
-        return json.loads(results[0].body.to_pybytes().decode())
+
+        def go(options):
+            results = list(self._conn.do_action(fl.Action(kind, payload),
+                                                options))
+            if not results:
+                return {}
+            return json.loads(results[0].body.to_pybytes().decode())
+
+        return self._call(f"action:{kind}", go)
 
     def instruction(self, instr: dict) -> dict:
         return self.action("instruction", instr)
@@ -48,12 +121,29 @@ class DatanodeClient:
         return self.action("status")
 
     def health(self) -> bool:
+        # no retry envelope: liveness probes must answer fast and a dead
+        # node answering False IS the signal, not an error to survive
         try:
-            return bool(self.action("health").get("ok"))
-        except fl.FlightError:
+            results = list(self._conn.do_action(
+                fl.Action("health", b"{}"),
+                fl.FlightCallOptions(timeout=2.0)))
+            out = json.loads(results[0].body.to_pybytes().decode()) if (
+                results) else {}
+            return bool(out.get("ok"))
+        except (fl.FlightError, ConnectionError):
             return False
 
     # ---- write plane ---------------------------------------------------
+    def _do_put(self, op: str, descriptor, table: pa.Table) -> None:
+        def go(options):
+            writer, _reader = self._conn.do_put(descriptor, table.schema,
+                                                options)
+            writer.write_table(table)
+            writer.done_writing()
+            writer.close()
+
+        self._call(op, go)
+
     def write(self, region_id: int, data: dict) -> None:
         cols = {}
         for k, v in data.items():
@@ -63,19 +153,20 @@ class DatanodeClient:
         descriptor = fl.FlightDescriptor.for_command(
             json.dumps({"region_id": region_id}).encode()
         )
-        writer, reader = self._conn.do_put(descriptor, table.schema)
-        writer.write_table(table)
-        writer.done_writing()
-        writer.close()
+        self._do_put("do_put", descriptor, table)
 
     # ---- query plane ---------------------------------------------------
+    def _do_get(self, op: str, ticket_doc: dict) -> pa.Table:
+        ticket = fl.Ticket(json.dumps(ticket_doc).encode())
+        return self._call(
+            op, lambda options: self._conn.do_get(ticket, options).read_all())
+
     def query(self, sql: str, table: str, region_ids: list[int],
               mode: str = "sql", timezone: str = "UTC") -> pa.Table:
-        ticket = fl.Ticket(json.dumps({
+        return self._do_get("do_get:sql", {
             "sql": sql, "table": table, "region_ids": region_ids,
             "mode": mode, "timezone": timezone,
-        }).encode())
-        return self._conn.do_get(ticket).read_all()
+        })
 
     def query_plan(self, plan_doc: dict, table: str,
                    region_ids: list[int],
@@ -84,19 +175,46 @@ class DatanodeClient:
         substrait analog): the datanode executes exactly this Select, no
         re-parse, no re-derivation.  Takes the encoded doc so fan-out
         callers encode once, not once per node."""
-        ticket = fl.Ticket(json.dumps({
+        return self._do_get("do_get:plan", {
             "mode": "plan", "plan": plan_doc, "table": table,
             "region_ids": region_ids, "timezone": timezone,
-        }).encode())
-        return self._conn.do_get(ticket).read_all()
+        })
 
     def scan(self, table: str, region_ids: list[int],
              ts_range=(None, None)) -> pa.Table:
-        ticket = fl.Ticket(json.dumps({
+        return self._do_get("do_get:scan", {
             "mode": "scan", "table": table, "region_ids": region_ids,
             "ts_range": list(ts_range),
-        }).encode())
-        return self._conn.do_get(ticket).read_all()
+        })
+
+    # ---- object plane (region snapshot shipping) -----------------------
+    # The bulk-copy half of live region migration: SST/manifest objects
+    # stream between data homes as Arrow binary batches (reference analog:
+    # the enterprise snapshot copy in region_migration; here Flight carries
+    # it on the same socket as everything else).
+    def list_region_objects(self, region_id: int) -> list[str]:
+        out = self.action("list_region_objects", {"region_id": region_id})
+        return list(out.get("objects", []))
+
+    def fetch_object(self, path: str) -> bytes:
+        table = self._do_get("do_get:object", {"mode": "object",
+                                               "path": path})
+        return b"".join(
+            c.as_py() for c in table.column("data")
+        )
+
+    def delete_object(self, path: str) -> None:
+        self.action("delete_object", {"path": path})
+
+    def put_object(self, path: str, data: bytes,
+                   chunk_bytes: int = 8 * 1024 * 1024) -> None:
+        chunks = [data[i:i + chunk_bytes]
+                  for i in range(0, len(data), chunk_bytes)] or [b""]
+        table = pa.table({"data": pa.array(chunks, pa.large_binary())})
+        descriptor = fl.FlightDescriptor.for_command(
+            json.dumps({"kind": "object", "path": path}).encode()
+        )
+        self._do_put("do_put:object", descriptor, table)
 
 
 class _RemoteRegionStub:
@@ -170,6 +288,21 @@ class RemoteDatanode:
         hb = self.client.heartbeat()
         hb["ts"] = now_ms
         return hb
+
+    # object plane: Metasrv migration procedures copy region snapshots
+    # between data homes through these (same surface as the in-process
+    # Datanode, so the procedure never knows which it is driving)
+    def list_region_objects(self, region_id: int) -> list[str]:
+        return self.client.list_region_objects(region_id)
+
+    def fetch_object(self, path: str) -> bytes:
+        return self.client.fetch_object(path)
+
+    def put_object(self, path: str, data: bytes) -> None:
+        self.client.put_object(path, data)
+
+    def delete_object(self, path: str) -> None:
+        self.client.delete_object(path)
 
     def write(self, region_id: int, data: dict, now_ms: float) -> int:
         self.client.write(region_id, data)

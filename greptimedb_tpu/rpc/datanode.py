@@ -143,8 +143,17 @@ class DatanodeFlightServer(fl.FlightServerBase):
     # ---- write plane ---------------------------------------------------
     def do_put(self, context, descriptor, reader, writer):
         from greptimedb_tpu.meta.cluster import REGION_LEASE_MS
+        from greptimedb_tpu.utils.chaos import CHAOS
 
+        CHAOS.inject("datanode.call")
         cmd = json.loads(descriptor.command.decode())
+        if cmd.get("kind") == "object":
+            # object plane: install a region snapshot object (migration
+            # bulk copy) — binary chunks reassemble into one store write
+            table = reader.read_all()
+            data = b"".join(c.as_py() for c in table.column("data"))
+            self.datanode.put_object(cmd["path"], data)
+            return
         rid = cmd["region_id"]
         if not self.managed and self.datanode.roles.get(rid) == "leader":
             self.datanode.lease_until_ms[rid] = _now_ms() + REGION_LEASE_MS
@@ -160,8 +169,19 @@ class DatanodeFlightServer(fl.FlightServerBase):
 
     # ---- query plane ---------------------------------------------------
     def do_get(self, context, ticket):
+        from greptimedb_tpu.utils.chaos import CHAOS
+
+        CHAOS.inject("datanode.call")
         req = json.loads(ticket.ticket.decode())
         mode = req.get("mode", "sql")
+        if mode == "object":
+            # object plane: stream one snapshot object out as binary chunks
+            data = self.datanode.fetch_object(req["path"])
+            chunk = 8 * 1024 * 1024
+            chunks = [data[i:i + chunk]
+                      for i in range(0, len(data), chunk)] or [b""]
+            table = pa.table({"data": pa.array(chunks, pa.large_binary())})
+            return fl.RecordBatchStream(table)
         view = self._view(req["table"], req["region_ids"])
         if mode == "scan":
             ts_range = tuple(req.get("ts_range", (None, None)))
@@ -196,7 +216,11 @@ class DatanodeFlightServer(fl.FlightServerBase):
 
     # ---- control plane -------------------------------------------------
     def do_action(self, context, action):
+        from greptimedb_tpu.utils.chaos import CHAOS
+
         kind = action.type
+        if kind != "health":  # liveness probes must see the truth
+            CHAOS.inject("datanode.call")
         body = json.loads(action.body.to_pybytes().decode()) if (
             action.body is not None and len(action.body)
         ) else {}
@@ -212,7 +236,15 @@ class DatanodeFlightServer(fl.FlightServerBase):
                     str(rid): r.schema.to_dict()
                     for rid, r in self.datanode.engine.regions.items()
                 },
+                "remote_wal": self.datanode.engine.log_store_factory
+                is not None,
             }
+        elif kind == "list_region_objects":
+            out = {"objects": self.datanode.list_region_objects(
+                body["region_id"])}
+        elif kind == "delete_object":
+            self.datanode.delete_object(body["path"])
+            out = {"ok": True}
         elif kind == "health":
             out = {"ok": True, "node_id": self.node_id}
         elif kind == "shutdown":

@@ -14,10 +14,15 @@ on the frontend" path).
 
 from __future__ import annotations
 
+import re
+import time
+
 import numpy as np
+import pyarrow.flight as fl
 
 from greptimedb_tpu.errors import GreptimeError, Unsupported
 from greptimedb_tpu.meta.catalog import CatalogManager
+from greptimedb_tpu.meta.failure_detector import PhiAccrualFailureDetector
 from greptimedb_tpu.meta.kv import KvBackend, MemoryKv
 from greptimedb_tpu.query.ast import CreateTable, Insert, Select
 from greptimedb_tpu.query.engine import QueryResult, SortVal
@@ -25,6 +30,36 @@ from greptimedb_tpu.query.exprs import TableContext
 from greptimedb_tpu.query.parser import parse_sql
 from greptimedb_tpu.rpc.client import RemoteDatanode
 from greptimedb_tpu.rpc.partial import merge_partials, split_partial
+from greptimedb_tpu.utils.chaos import ChaosError
+from greptimedb_tpu.utils.telemetry import REGISTRY
+
+M_ROUTE_RETRY = REGISTRY.counter(
+    "greptime_frontend_route_retry_total",
+    "Requests retried after a route refresh (stale route / dead node)",
+    labels=("op",),
+)
+M_READ_ROUTE = REGISTRY.counter(
+    "greptime_frontend_read_route_total",
+    "Read routing decisions under the read preference",
+    labels=("target",),
+)
+
+# errors that plausibly mean "my route is stale or the node just died":
+# worth ONE route refresh + retry (the transport-level retry inside
+# DatanodeClient already handled transient blips on a live route)
+_STALE_ROUTE_MSG = re.compile(
+    r"no route|not open on node|is down|not leader|lease expired|chaos"
+)
+
+
+def _route_retryable(e: Exception) -> bool:
+    if isinstance(e, (ChaosError, ConnectionError)):
+        return True
+    if isinstance(e, (fl.FlightUnavailableError, fl.FlightTimedOutError)):
+        return True
+    if isinstance(e, (fl.FlightError, GreptimeError)):
+        return bool(_STALE_ROUTE_MSG.search(str(e)))
+    return False
 
 
 class DistFrontend:
@@ -37,12 +72,37 @@ class DistFrontend:
         self.datanodes: dict[int, RemoteDatanode] = {}
         self._rr = 0  # round-robin cursor for region placement
         self.timezone = "UTC"
+        # failure detectors over frontend-observed traffic: fed by
+        # note_heartbeat (tests/metasrv embedding drive it explicitly;
+        # serve_frontend ticks it from node health).  A node with NO
+        # observations is presumed alive — detectors only ever REMOVE
+        # candidates from placement, never queries from routing.
+        self.detectors: dict[int, PhiAccrualFailureDetector] = {}
+        # bounded-staleness read contract (reference read-preference):
+        # "follower" routes SELECTs to a replica whose published
+        # replication lag is within max_staleness_ms, else the leader
+        self.read_preference = "leader"
+        self.max_staleness_ms = 5_000.0
+        self.clock_ms = lambda: time.time() * 1000.0
 
     # ---- membership ----------------------------------------------------
     def add_datanode(self, node_id: int, address: str) -> RemoteDatanode:
         dn = RemoteDatanode(node_id, address)
         self.datanodes[node_id] = dn
+        self.detectors.setdefault(node_id, PhiAccrualFailureDetector())
         return dn
+
+    def note_heartbeat(self, node_id: int, now_ms: float | None = None) -> None:
+        """Feed the node's failure detector (any observed sign of life)."""
+        det = self.detectors.get(node_id)
+        if det is not None:
+            det.heartbeat(self.clock_ms() if now_ms is None else now_ms)
+
+    def _node_dead(self, node_id: int) -> bool:
+        det = self.detectors.get(node_id)
+        if det is None or det._last_heartbeat_ms is None:
+            return False  # no evidence either way: usable
+        return not det.is_available(self.clock_ms())
 
     def close(self) -> None:
         for dn in self.datanodes.values():
@@ -56,6 +116,31 @@ class DistFrontend:
     def region_route(self, region_id: int) -> int | None:
         rec = self.kv.get_json(f"__meta/route/region/{region_id}")
         return None if rec is None else rec["node"]
+
+    def _follower_node(self, region_id: int, leader: int) -> int:
+        """Bounded-staleness read routing: a live follower whose published
+        lag is inside the contract serves the read; anything else falls
+        back to the leader (metasrv heartbeats publish lag into the kv
+        follower routes — meta/cluster.py _note_follower_lag)."""
+        rec = self.kv.get_json(f"__meta/route/followers/{region_id}")
+        now = self.clock_ms()
+        for n_str, meta in (rec or {}).get("nodes", {}).items():
+            node = int(n_str)
+            if node not in self.datanodes or self._node_dead(node):
+                continue
+            lag = meta.get("lag_ms")
+            if lag is None:
+                continue  # never synced: no freshness claim at all
+            # the record itself ages: a metasrv that stopped publishing
+            # (died, partitioned) must not leave a frozen "lag 10ms"
+            # snapshot routing reads forever — the replica's worst-case
+            # staleness is its published lag PLUS the record's age
+            age = max(now - meta.get("ts", now), 0.0)
+            if lag + age <= self.max_staleness_ms:
+                M_READ_ROUTE.labels("follower").inc()
+                return node
+        M_READ_ROUTE.labels("leader").inc()
+        return leader
 
     # ---- SQL entry -----------------------------------------------------
     def sql(self, query: str) -> QueryResult:
@@ -95,9 +180,14 @@ class DistFrontend:
         )
         if info is None:  # IF NOT EXISTS on an existing table
             return QueryResult([], [])
-        node_ids = sorted(self.datanodes)
-        if not node_ids:
+        if not self.datanodes:
             raise GreptimeError("no datanodes registered")
+        # placement skips nodes the failure detector considers dead — a
+        # region placed on a dying node would fail over immediately
+        node_ids = [n for n in sorted(self.datanodes)
+                    if not self._node_dead(n)]
+        if not node_ids:
+            raise GreptimeError("no alive datanodes for region placement")
         for rid in info.region_ids:
             node = node_ids[self._rr % len(node_ids)]
             self._rr += 1
@@ -137,28 +227,59 @@ class DistFrontend:
         for pidx, row_idx in routed.items():
             rid = info.region_ids[pidx]
             chunk = {c: [data[c][i] for i in row_idx] for c in columns}
+            self._write_region(rid, chunk)
+        return QueryResult([], [], affected_rows=n)
+
+    def _write_region(self, rid: int, chunk: dict) -> None:
+        """Route-aware write: a failure that smells like a stale route
+        (node died, region moved, lease fenced) re-reads the route from
+        kv — failover may have swapped it — and retries ONCE.  Region
+        upsert semantics keep an ambiguous first attempt idempotent."""
+
+        def ship():
             node = self.region_route(rid)
             if node is None or node not in self.datanodes:
                 raise GreptimeError(f"no route for region {rid}")
             self.datanodes[node].client.write(rid, chunk)
-        return QueryResult([], [], affected_rows=n)
+            self.note_heartbeat(node)
+
+        try:
+            ship()
+        except Exception as e:  # noqa: BLE001 — filtered just below
+            if not _route_retryable(e):
+                raise
+            M_ROUTE_RETRY.labels("write").inc()
+            ship()
 
     # ---- reads ---------------------------------------------------------
-    def _node_regions(self, info) -> dict[int, list[int]]:
+    def _node_regions(self, info, for_read: bool = False) -> dict[int, list[int]]:
         """region ids of this table grouped by hosting datanode."""
         out: dict[int, list[int]] = {}
         for rid in info.region_ids:
             node = self.region_route(rid)
             if node is None:
                 raise GreptimeError(f"no route for region {rid}")
+            if for_read and self.read_preference == "follower":
+                node = self._follower_node(rid, node)
             out.setdefault(node, []).append(rid)
         return out
 
     def _select(self, sel: Select, raw_sql: str) -> QueryResult:
+        # one route-refresh retry: routes re-read from kv inside the
+        # attempt, so a failover that swapped them mid-flight is picked up
+        try:
+            return self._select_attempt(sel, raw_sql)
+        except Exception as e:  # noqa: BLE001 — filtered just below
+            if not _route_retryable(e):
+                raise
+            M_ROUTE_RETRY.labels("select").inc()
+            return self._select_attempt(sel, raw_sql)
+
+    def _select_attempt(self, sel: Select, raw_sql: str) -> QueryResult:
         if sel.table is None:
             raise Unsupported("tableless SELECT on the distributed frontend")
         info = self.catalog.get_table(self.db, sel.table)
-        by_node = self._node_regions(info)
+        by_node = self._node_regions(info, for_read=True)
         ts_col = (info.schema.time_index.name
                   if info.schema.time_index is not None else None)
         plan = split_partial(sel, ts_column=ts_col)
@@ -174,6 +295,7 @@ class DistFrontend:
                 table = self.datanodes[node].client.query_plan(
                     doc, sel.table, rids, timezone=self.timezone,
                 )
+                self.note_heartbeat(node)
                 parts.append({
                     name: table.column(name).to_pylist()
                     for name in table.column_names
@@ -206,6 +328,7 @@ class DistFrontend:
                 table = self.datanodes[node].client.scan(
                     sel.table, rids, ts_range=ts_range
                 )
+                self.note_heartbeat(node)
                 if table.num_rows == 0:
                     continue
                 data = {}

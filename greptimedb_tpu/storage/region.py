@@ -645,6 +645,12 @@ class Region:
         }
         self.memtable = Memtable(self.schema)
         self.next_seq = max(self.next_seq, state.flushed_seq + 1)
+        if take_ownership:
+            # shared-log stores must re-read the topic tail before this
+            # promoted region appends (stale cached end-offsets collide)
+            acquire = getattr(self.wal, "acquire_ownership", None)
+            if acquire is not None:
+                acquire()
         self.replay_wal(repair=take_ownership)
         self.generation += 1
         self._mark_structure_change()

@@ -85,6 +85,9 @@ class SharedLogBroker:
     # ---- data plane ----------------------------------------------------
     def append(self, topic: str, region_id: int, sequence: int,
                payload: bytes) -> int:
+        from greptimedb_tpu.utils.chaos import CHAOS
+
+        CHAOS.inject("wal.append")  # broker stall/failure (chaos tier)
         with self._lock:
             log = self._log(topic)
             offset = self._offsets[topic] + 1
@@ -176,6 +179,14 @@ class RemoteLogStore(LogStore):
         # change-detection hook for Region.storage_fingerprint (follower
         # no-op sync skipping): the topic's segment files
         self.dir = os.path.join(broker.root, self.topic)
+
+    def acquire_ownership(self) -> None:
+        """Re-take append ownership at leader promotion (Region.catch_up
+        with take_ownership): a follower's broker handle cached the topic
+        end-offset at OPEN time, and the old leader has appended since —
+        appending through the stale handle would mint colliding offsets
+        and corrupt the pruning floor."""
+        self.broker.acquire(self.topic)
 
     def append(self, sequence: int, payload: bytes) -> None:
         self.broker.append(self.topic, self.region_id, sequence, payload)

@@ -29,6 +29,7 @@ import xml.etree.ElementTree as ET
 
 from greptimedb_tpu.errors import StorageError
 from greptimedb_tpu.storage.object_store import ObjectStore
+from greptimedb_tpu.utils.chaos import CHAOS, ChaosError, M_REMOTE_RETRY
 
 
 def _sign(key: bytes, msg: str) -> bytes:
@@ -139,6 +140,8 @@ class S3ObjectStore(ObjectStore):
             req = urllib.request.Request(url, data=payload or None,
                                          method=method, headers=headers)
             try:
+                if method == "GET":
+                    CHAOS.inject("s3.read")  # injected object-store fault
                 with urllib.request.urlopen(req) as resp:
                     return resp.status, resp.read()
             except urllib.error.HTTPError as e:
@@ -151,6 +154,11 @@ class S3ObjectStore(ObjectStore):
                 last_err = e  # 5xx: retry (reference retry layer)
             except urllib.error.URLError as e:
                 last_err = e
+            except ChaosError as e:
+                last_err = e  # survived like any transient network fault
+            if attempt < self.max_retries:  # a retry will actually follow
+                # shared fault-pressure counter (same as the flight path)
+                M_REMOTE_RETRY.labels("s3", type(last_err).__name__).inc()
             time.sleep(min(0.05 * (2 ** attempt), 1.0))
         raise StorageError(f"s3 {method} {key}: {last_err}")
 

@@ -1,0 +1,198 @@
+"""Deterministic fault injection for the cluster serving stack.
+
+Reference analog: tests-fuzz/ (failover/unstable targets) plus the
+error-injection layers greptimedb gets for free from its object-store
+stack — here a single in-process controller every remote boundary
+consults before doing real work.  The point is that faults are
+*survived, not just observed*: the same PR wires retry/timeout/backoff
+into rpc/client.py and storage/s3.py, and the chaos test tier asserts
+end-to-end invariants (zero acked-write loss, correct query results,
+bounded staleness) while this layer fires.
+
+Design constraints:
+
+- **Zero overhead disabled.**  ``CHAOS.inject(point)`` is one attribute
+  check when no rules are configured (the production default) — the
+  same discipline as utils/tracing.py.  The warm query path must not
+  pay for the failure machinery it never uses.
+- **Deterministic.**  Every injection point owns a seeded RNG stream
+  (seed ⊕ stable hash of the point name), so a seeded run fires the
+  same faults at the same call indices every time — tests assert exact
+  recovery behavior, not probabilistic soup.
+- **Env-propagated.**  ``GREPTIME_CHAOS`` configures the controller at
+  import (``seed=7;flight.call=0.2:error;wal.append=0.1:stall:50``), so
+  datanode OS subprocesses inherit the faults of the test that spawned
+  them.
+
+Injection points wired in this PR:
+
+===================  ======================================== ==========
+point                site                                     actions
+===================  ======================================== ==========
+``flight.call``      every DatanodeClient RPC (rpc/client)    error/delay/drop
+``datanode.call``    Flight server do_put/do_get/do_action    error/hang/kill
+``s3.read``          S3ObjectStore GET (storage/s3)           error/delay
+``wal.append``       SharedLogBroker.append (remote_wal)      stall/error
+===================  ======================================== ==========
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+
+from greptimedb_tpu.errors import GreptimeError
+from greptimedb_tpu.utils.telemetry import REGISTRY
+
+# Shared fault-pressure counter: every survived retry at a remote
+# boundary (Flight RPC, S3 request) increments it, so /metrics shows
+# injected-or-real fault pressure in one place (ISSUE 6 satellite).
+M_REMOTE_RETRY = REGISTRY.counter(
+    "greptime_remote_retry_total",
+    "Retries against remote services (flight RPC, object store)",
+    labels=("service", "kind"),
+)
+
+M_CHAOS_INJECTED = REGISTRY.counter(
+    "greptime_chaos_injected_total",
+    "Faults fired by the chaos controller",
+    labels=("point", "action"),
+)
+
+
+class ChaosError(GreptimeError):
+    """An injected fault.  Retry layers treat it as transient (it models
+    a dropped/failed remote call), so a survivable fault is survived."""
+
+
+@dataclass
+class ChaosRule:
+    point: str
+    prob: float
+    action: str = "error"  # error | delay | stall | drop | hang | kill
+    delay_ms: float = 20.0
+    limit: int | None = None  # max fires; None = unbounded
+    fired: int = 0
+
+
+def _parse_rules(spec: str) -> tuple[int, dict[str, ChaosRule]]:
+    """``seed=7;flight.call=0.2:error;wal.append=0.1:stall:50;s3.read=1:error:limit=2``
+
+    Each rule is ``point=prob[:action[:delay_ms_or_limit]...]``; a bare
+    ``limit=N`` arg caps total fires for the rule.
+    """
+    seed = 0
+    rules: dict[str, ChaosRule] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        if key == "seed":
+            seed = int(val)
+            continue
+        args = val.split(":")
+        rule = ChaosRule(point=key, prob=float(args[0]))
+        for a in args[1:]:
+            if a.startswith("limit="):
+                rule.limit = int(a[len("limit="):])
+            elif a.replace(".", "", 1).isdigit():
+                rule.delay_ms = float(a)
+            elif a:
+                rule.action = a
+        rules[key] = rule
+    return seed, rules
+
+
+class ChaosController:
+    """Seed-driven fault firing at named injection points."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.seed = 0
+        self._rules: dict[str, ChaosRule] = {}
+        self._rngs: dict[str, random.Random] = {}
+        self._lock = threading.Lock()
+
+    # ---- configuration -------------------------------------------------
+    @classmethod
+    def from_env(cls) -> "ChaosController":
+        c = cls()
+        spec = os.environ.get("GREPTIME_CHAOS", "")
+        if spec:
+            seed, rules = _parse_rules(spec)
+            c.configure(seed, rules)
+        return c
+
+    def configure(self, seed: int,
+                  rules: dict[str, ChaosRule] | None) -> None:
+        with self._lock:
+            self.seed = seed
+            self._rules = dict(rules or {})
+            self._rngs = {}
+            self.enabled = bool(self._rules)
+
+    def rule(self, point: str, prob: float, action: str = "error",
+             delay_ms: float = 20.0, limit: int | None = None) -> None:
+        """Programmatic single-rule setup (tests)."""
+        with self._lock:
+            self._rules[point] = ChaosRule(point, prob, action, delay_ms,
+                                           limit)
+            self._rngs.pop(point, None)
+            self.enabled = True
+
+    def reset(self) -> None:
+        self.configure(0, None)
+
+    def fired(self, point: str) -> int:
+        r = self._rules.get(point)
+        return r.fired if r is not None else 0
+
+    # ---- firing --------------------------------------------------------
+    def _rng(self, point: str) -> random.Random:
+        rng = self._rngs.get(point)
+        if rng is None:
+            # stable per-point stream: the same seeded run fires the same
+            # faults at the same call indices regardless of rule order
+            rng = random.Random(self.seed ^ zlib.crc32(point.encode()))
+            self._rngs[point] = rng
+        return rng
+
+    def inject(self, point: str) -> None:
+        """Fire the configured fault for ``point`` (or return untouched).
+
+        error/drop → raise ChaosError; delay/stall → sleep ``delay_ms``;
+        hang → sleep 1000×``delay_ms`` (the caller's deadline must save
+        it); kill → hard process exit (SIGKILL analog for chaos tests).
+        """
+        if not self.enabled:  # production fast path: one attribute check
+            return
+        with self._lock:
+            rule = self._rules.get(point)
+            if rule is None:
+                return
+            if rule.limit is not None and rule.fired >= rule.limit:
+                return
+            if self._rng(point).random() >= rule.prob:
+                return
+            rule.fired += 1
+            action = rule.action
+            delay_s = rule.delay_ms / 1000.0
+        M_CHAOS_INJECTED.labels(point, action).inc()
+        if action in ("delay", "stall"):
+            time.sleep(delay_s)
+            return
+        if action == "hang":
+            time.sleep(delay_s * 1000.0)
+            return
+        if action == "kill":
+            os._exit(137)
+        raise ChaosError(f"chaos[{point}]: injected {action}")
+
+
+CHAOS = ChaosController.from_env()

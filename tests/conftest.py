@@ -45,3 +45,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "golden_dist: distributed re-run of the golden corpus")
     config.addinivalue_line("markers", "fuzz: randomized DDL/insert/query fuzzing")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tier — node kills under live load with "
+        "recovery invariants (fast deterministic cases run in tier-1)")
+    config.addinivalue_line(
+        "markers", "slow: long soak cases excluded from tier-1")

@@ -435,3 +435,481 @@ class TestMigrationChaos:
                 if p.poll() is None:
                     p.terminate()
                     p.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Chaos tier (ISSUE 6): deterministic seed-driven fault injection at every
+# remote boundary, survived by the retry/failover machinery.  Fast cases run
+# in tier-1; long soak cases are marked slow.
+# ---------------------------------------------------------------------------
+
+from greptimedb_tpu.utils.chaos import (  # noqa: E402
+    CHAOS, ChaosController, ChaosError, ChaosRule, _parse_rules,
+)
+
+
+@pytest.fixture
+def chaos():
+    CHAOS.reset()
+    yield CHAOS
+    CHAOS.reset()
+
+
+class TestChaosController:
+    pytestmark = pytest.mark.chaos
+
+    def test_disabled_is_default_and_noop(self):
+        c = ChaosController()
+        assert not c.enabled
+        for _ in range(1000):
+            c.inject("flight.call")  # must never raise or sleep
+
+    def test_env_spec_parses(self):
+        seed, rules = _parse_rules(
+            "seed=7;flight.call=0.2:error;wal.append=0.1:stall:50;"
+            "s3.read=1:error:limit=2")
+        assert seed == 7
+        assert rules["flight.call"].prob == 0.2
+        assert rules["wal.append"].action == "stall"
+        assert rules["wal.append"].delay_ms == 50.0
+        assert rules["s3.read"].limit == 2
+
+    def test_deterministic_fire_pattern(self):
+        def pattern(seed):
+            c = ChaosController()
+            c.configure(seed, {"p": ChaosRule("p", 0.3)})
+            out = []
+            for i in range(50):
+                try:
+                    c.inject("p")
+                    out.append(False)
+                except ChaosError:
+                    out.append(True)
+            return out
+
+        a, b = pattern(42), pattern(42)
+        assert a == b  # same seed, same faults at the same call indices
+        assert any(a) and not all(a)
+        assert pattern(43) != a  # a different seed differs somewhere
+
+    def test_limit_caps_fires(self):
+        c = ChaosController()
+        c.configure(1, {"p": ChaosRule("p", 1.0, limit=3)})
+        fired = 0
+        for _ in range(10):
+            try:
+                c.inject("p")
+            except ChaosError:
+                fired += 1
+        assert fired == 3 and c.fired("p") == 3
+
+    def test_points_have_independent_streams(self):
+        c = ChaosController()
+        c.configure(5, {"a": ChaosRule("a", 1.0, limit=1),
+                        "b": ChaosRule("b", 1.0, limit=1)})
+        with pytest.raises(ChaosError):
+            c.inject("a")
+        with pytest.raises(ChaosError):
+            c.inject("b")
+
+
+class TestRetryEnvelope:
+    pytestmark = pytest.mark.chaos
+
+    def test_client_survives_injected_flight_faults(self, tmp_path, chaos):
+        """Client-side chaos on the wire: the retry envelope absorbs the
+        first N faults and the call still succeeds; /metrics counts the
+        fault pressure."""
+        from greptimedb_tpu.rpc.client import DatanodeClient
+        from greptimedb_tpu.rpc.datanode import DatanodeFlightServer
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+        from tests.test_meta import schema
+
+        server = DatanodeFlightServer(0, str(tmp_path / "dn"))
+        try:
+            client = DatanodeClient(server.address)
+            client.instruction({"kind": "open_region", "region_id": 5,
+                                "role": "leader",
+                                "schema": schema().to_dict()})
+            before = REGISTRY.value("greptime_remote_retry_total",
+                                    ("flight", "ChaosError"))
+            chaos.configure(3, {"flight.call": ChaosRule(
+                "flight.call", 1.0, "error", limit=2)})
+            client.write(5, {"h": ["a"], "ts": [1000], "v": [1.0]})
+            out = client.query("SELECT count(*) FROM t", "t", [5])
+            assert out.column("count(*)").to_pylist() == [1]
+            assert chaos.fired("flight.call") == 2  # faults DID fire
+            after = REGISTRY.value("greptime_remote_retry_total",
+                                   ("flight", "ChaosError"))
+            assert after - before >= 2  # ...and were counted as retries
+            client.close()
+        finally:
+            chaos.reset()
+            server.shutdown()
+
+    def test_exhausted_retries_surface(self, tmp_path, chaos):
+        from greptimedb_tpu.rpc.client import DatanodeClient
+        from greptimedb_tpu.rpc.datanode import DatanodeFlightServer
+
+        server = DatanodeFlightServer(0, str(tmp_path / "dn"))
+        try:
+            client = DatanodeClient(server.address, max_retries=2)
+            chaos.configure(3, {"flight.call": ChaosRule(
+                "flight.call", 1.0, "error")})  # unbounded
+            with pytest.raises(ChaosError):
+                client.action("status")
+        finally:
+            chaos.reset()
+            server.shutdown()
+
+    def test_frontend_route_retry_survives_server_fault(self, tmp_path,
+                                                        chaos):
+        """Server-side chaos (fault inside the datanode handler, NOT
+        retryable at the transport layer): the frontend's route-refresh
+        retry absorbs exactly one, per the satellite contract."""
+        from greptimedb_tpu.rpc.datanode import DatanodeFlightServer
+        from greptimedb_tpu.rpc.frontend import DistFrontend
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+
+        server = DatanodeFlightServer(0, str(tmp_path / "dn"))
+        fe = DistFrontend()
+        try:
+            fe.add_datanode(0, server.address)
+            fe.sql("CREATE TABLE rt (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+                   " v DOUBLE, PRIMARY KEY (h))")
+            fe.sql("INSERT INTO rt VALUES ('a', 1000, 1.0)")
+            before = REGISTRY.value("greptime_frontend_route_retry_total",
+                                    ("select",))
+            chaos.configure(9, {"datanode.call": ChaosRule(
+                "datanode.call", 1.0, "error", limit=1)})
+            res = fe.sql("SELECT count(*) FROM rt")
+            assert res.rows == [[1]]
+            assert chaos.fired("datanode.call") == 1
+            after = REGISTRY.value("greptime_frontend_route_retry_total",
+                                   ("select",))
+            assert after - before == 1
+            # write path has the same one-retry contract
+            chaos.configure(9, {"datanode.call": ChaosRule(
+                "datanode.call", 1.0, "error", limit=1)})
+            fe.sql("INSERT INTO rt VALUES ('b', 2000, 2.0)")
+            assert fe.sql("SELECT count(*) FROM rt").rows == [[2]]
+        finally:
+            chaos.reset()
+            fe.close()
+            server.shutdown()
+
+    def test_s3_retry_counter_shares_registry(self, chaos):
+        """Injected S3 read faults are survived by the store's retry loop
+        and counted in the SAME greptime_remote_retry_total counter as
+        flight retries (satellite: /metrics shows fault pressure)."""
+        from greptimedb_tpu.storage.s3 import MockS3Server, S3ObjectStore
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+
+        mock = MockS3Server()
+        try:
+            store = S3ObjectStore(mock.endpoint, "bkt", access_key="k",
+                                  secret_key="s")
+            store.write("region_1/sst/x.parquet", b"DATA")
+            before = REGISTRY.value("greptime_remote_retry_total",
+                                    ("s3", "ChaosError"))
+            chaos.configure(4, {"s3.read": ChaosRule(
+                "s3.read", 1.0, "error", limit=2)})
+            assert store.read("region_1/sst/x.parquet") == b"DATA"
+            after = REGISTRY.value("greptime_remote_retry_total",
+                                   ("s3", "ChaosError"))
+            assert after - before == 2
+        finally:
+            chaos.reset()
+            mock.stop()
+
+    def test_wal_append_stall_only_delays(self, tmp_path, chaos):
+        from greptimedb_tpu.storage.remote_wal import (
+            RemoteLogStore, SharedLogBroker,
+        )
+
+        broker = SharedLogBroker(str(tmp_path / "b"))
+        store = RemoteLogStore(broker, region_id=1)
+        chaos.configure(2, {"wal.append": ChaosRule(
+            "wal.append", 1.0, "stall", delay_ms=5.0, limit=3)})
+        for seq in range(1, 5):
+            store.append(seq, b"x")  # stalls, never fails
+        assert chaos.fired("wal.append") == 3
+        assert [s for s, _p in store.replay(0)] == [1, 2, 3, 4]
+
+
+class TestChaosUnderLoad:
+    """The flagship acceptance scenario: kill the leader datanode during
+    a closed-loop query workload with fault injection seeded.  Zero
+    acked-write loss (remote-WAL replay), every query correct (retry +
+    failover routing), bounded-staleness follower reads, and the region
+    re-served by the survivor without manual intervention."""
+
+    pytestmark = pytest.mark.chaos
+
+    def _cluster(self, tmp_path):
+        from greptimedb_tpu.meta.cluster import Metasrv
+        from greptimedb_tpu.meta.kv import MemoryKv
+        from greptimedb_tpu.rpc.datanode import DatanodeFlightServer
+        from greptimedb_tpu.rpc.frontend import DistFrontend
+
+        shared = str(tmp_path / "store")
+        wal = str(tmp_path / "broker")
+        servers = [
+            DatanodeFlightServer(i, shared, managed=True,
+                                 remote_wal_dir=wal)
+            for i in range(2)
+        ]
+        kv = MemoryKv()
+        ms = Metasrv(kv)
+        fe = DistFrontend(kv=kv)
+        for s in servers:
+            ms.register_datanode(fe.add_datanode(s.node_id, s.address))
+        return servers, ms, fe
+
+    def test_kill_leader_mid_bench(self, tmp_path, chaos):
+        servers, ms, fe = self._cluster(tmp_path)
+        proxies = ms.datanodes
+        try:
+            # the first flight calls get injected faults (fully
+            # deterministic: prob 1 with a fire limit), survived by the
+            # client retry envelope
+            chaos.configure(11, {"flight.call": ChaosRule(
+                "flight.call", 1.0, "error", limit=3)})
+            fe.sql("CREATE TABLE ct (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+                   " v DOUBLE, PRIMARY KEY (h))")
+            info = fe.catalog.get_table("public", "ct")
+            rid = info.region_ids[0]
+            assert fe.region_route(rid) == 0  # round-robin landed on 0
+            ms.add_follower(rid, 1, now_ms=0.0)
+
+            def beat(t, alive=(0, 1)):
+                for i in alive:
+                    hb = proxies[i].heartbeat(t)
+                    for instr in ms.handle_heartbeat(hb, t):
+                        proxies[i].handle_instruction(instr, t)
+
+            acked = 0
+            t = 0.0
+            killed = False
+            for k in range(20):
+                beat(t, alive=(0, 1) if not killed else (1,))
+                try:
+                    fe.sql(f"INSERT INTO ct VALUES ('h{k % 3}', "
+                           f"{1000 + k}, {float(k)})")
+                    acked += 1
+                except Exception:  # noqa: BLE001 — leader just died
+                    assert killed, "only the kill may fail a write"
+                    # the supervision loop (NOT a human) recovers: the
+                    # detector has seen 2 minutes of silence
+                    migrated = ms.tick(t)
+                    assert migrated and migrated[0]["to_node"] == 1
+                    fe.sql(f"INSERT INTO ct VALUES ('h{k % 3}', "
+                           f"{1000 + k}, {float(k)})")
+                    acked += 1
+                # closed-loop correctness probe: leader reads are exact
+                res = fe.sql("SELECT count(*) FROM ct")
+                assert res.rows == [[acked]], f"iteration {k}"
+                if k == 9 and not killed:
+                    servers[0].shutdown()  # node death mid-bench
+                    killed = True
+                    t += 120_000.0  # silence the detector observes
+                t += 1000.0
+            assert killed and acked == 20
+            assert chaos.fired("flight.call") == 3  # faults really fired
+            # region re-served by the survivor; route swapped in kv
+            assert ms.region_route(rid) == 1
+            assert proxies[1].roles[rid] == "leader"
+            # zero acked loss, bit-level: every acked v value present once
+            res = fe.sql("SELECT count(*), min(v), max(v) FROM ct")
+            assert res.rows == [[20, 0.0, 19.0]]
+        finally:
+            chaos.reset()
+            fe.close()
+            for s in servers:
+                try:
+                    s.shutdown()
+                except Exception:  # noqa: BLE001 — already dead
+                    pass
+
+    def test_bounded_staleness_follower_reads(self, tmp_path, chaos):
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+
+        servers, ms, fe = self._cluster(tmp_path)
+        proxies = ms.datanodes
+        try:
+            fe.sql("CREATE TABLE ft (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+                   " v DOUBLE, PRIMARY KEY (h))")
+            rid = fe.catalog.get_table("public", "ft").region_ids[0]
+            ms.add_follower(rid, 1, now_ms=0.0)
+            for k in range(5):
+                fe.sql(f"INSERT INTO ft VALUES ('a', {1000 + k}, "
+                       f"{float(k)})")
+            # quiesced sync rounds: follower catches up, lag publishes
+            t = 0.0
+            for _ in range(3):
+                for i in (0, 1):
+                    hb = proxies[i].heartbeat(t)
+                    for instr in ms.handle_heartbeat(hb, t):
+                        proxies[i].handle_instruction(instr, t)
+                t += 1000.0
+            rec = fe.kv.get_json(f"__meta/route/followers/{rid}")
+            assert rec["nodes"]["1"]["entries_behind"] == 0
+            # follower preference: the read routes to the replica and is
+            # correct within the staleness contract (fully synced here).
+            # The frontend clock joins the metasrv's deterministic time
+            # base — staleness accounting ages the published record
+            # against the SAME clock that stamped it.
+            fe.clock_ms = lambda: t
+            fe.read_preference = "follower"
+            fe.max_staleness_ms = 60_000.0
+            before = REGISTRY.value("greptime_frontend_read_route_total",
+                                    ("follower",))
+            assert fe.sql("SELECT count(*) FROM ft").rows == [[5]]
+            after = REGISTRY.value("greptime_frontend_read_route_total",
+                                   ("follower",))
+            assert after - before == 1
+            # an unmeetable staleness bound falls back to the leader
+            fe.max_staleness_ms = -1.0
+            lb = REGISTRY.value("greptime_frontend_read_route_total",
+                                ("leader",))
+            assert fe.sql("SELECT count(*) FROM ft").rows == [[5]]
+            la = REGISTRY.value("greptime_frontend_read_route_total",
+                                ("leader",))
+            assert la - lb == 1
+            # a FROZEN lag record (metasrv stopped publishing) ages out
+            # of the contract even though its lag field still reads
+            # fresh — bounded staleness, not bounded-at-publication-time
+            fe.max_staleness_ms = 60_000.0
+            fe.clock_ms = lambda: t + 300_000.0
+            lb = REGISTRY.value("greptime_frontend_read_route_total",
+                                ("leader",))
+            assert fe.sql("SELECT count(*) FROM ft").rows == [[5]]
+            la = REGISTRY.value("greptime_frontend_read_route_total",
+                                ("leader",))
+            assert la - lb == 1
+        finally:
+            chaos.reset()
+            fe.close()
+            for s in servers:
+                s.shutdown()
+
+
+class TestChaosSoak:
+    """Long soak: repeated kill/recover rounds with broader fault rules.
+    Excluded from tier-1 (slow)."""
+
+    pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+    def test_repeated_leader_kills_no_acked_loss(self, tmp_path, chaos):
+        from greptimedb_tpu.meta.cluster import Metasrv
+        from greptimedb_tpu.meta.kv import MemoryKv
+        from greptimedb_tpu.rpc.datanode import DatanodeFlightServer
+        from greptimedb_tpu.rpc.frontend import DistFrontend
+
+        shared = str(tmp_path / "store")
+        wal = str(tmp_path / "broker")
+        kv = MemoryKv()
+        ms = Metasrv(kv)
+        fe = DistFrontend(kv=kv)
+        servers = {}
+
+        def start(i):
+            s = DatanodeFlightServer(i, shared, managed=True,
+                                     remote_wal_dir=wal)
+            servers[i] = s
+            ms.register_datanode(fe.add_datanode(i, s.address))
+            return s
+
+        start(0)
+        start(1)
+        try:
+            chaos.configure(SEED, {
+                "flight.call": ChaosRule("flight.call", 0.02, "error"),
+                "wal.append": ChaosRule("wal.append", 0.05, "stall",
+                                        delay_ms=2.0),
+            })
+            fe.sql("CREATE TABLE sk (h STRING, ts TIMESTAMP(3) TIME INDEX,"
+                   " v DOUBLE, PRIMARY KEY (h))")
+            rid = fe.catalog.get_table("public", "sk").region_ids[0]
+            acked, t = 0, 0.0
+            for rnd in range(ROUNDS):
+                leader = ms.region_route(rid)
+                for k in range(8):
+                    try:
+                        fe.sql(f"INSERT INTO sk VALUES ('h{k % 4}', "
+                               f"{rnd * 100_000 + k}, {float(acked)})")
+                        acked += 1
+                    except Exception:  # noqa: BLE001
+                        ms.tick(t)
+                        fe.sql(f"INSERT INTO sk VALUES ('h{k % 4}', "
+                               f"{rnd * 100_000 + k}, {float(acked)})")
+                        acked += 1
+                    for i, s in servers.items():
+                        if s is not None:
+                            hb = ms.datanodes[i].heartbeat(t)
+                            ms.handle_heartbeat(hb, t)
+                    t += 1000.0
+                assert fe.sql("SELECT count(*) FROM sk").rows == [[acked]]
+                # kill the current leader, restart it as a fresh process
+                # next round (same shared storage + broker)
+                servers[leader].shutdown()
+                servers[leader] = None
+                # survivors keep a steady cadence while the dead node
+                # falls silent (a single time LEAP would pollute the
+                # survivors' interval history and mask their next death)
+                for _ in range(120):
+                    for i, s in servers.items():
+                        if s is not None:
+                            hb = ms.datanodes[i].heartbeat(t)
+                            ms.handle_heartbeat(hb, t)
+                    t += 1000.0
+                ms.tick(t)
+                assert fe.sql("SELECT count(*) FROM sk").rows == [[acked]]
+                old = fe.datanodes.pop(leader)
+                old.client.close()
+                ms.datanodes.pop(leader)
+                ms.detectors.pop(leader)
+                start(leader)
+        finally:
+            chaos.reset()
+            fe.close()
+            for s in servers.values():
+                if s is not None:
+                    try:
+                        s.shutdown()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+
+class TestChaosEnvPropagation:
+    pytestmark = pytest.mark.chaos
+
+    def test_kill_action_fells_subprocess_datanode(self, tmp_path):
+        """GREPTIME_CHAOS in the environment configures the controller at
+        import, so OS-process datanodes inherit the test's faults; the
+        'kill' action is a SIGKILL analog fired from inside the victim."""
+        from greptimedb_tpu.rpc.client import DatanodeClient
+
+        env = dict(os.environ)
+        env["GREPTIME_CHAOS"] = "seed=1;datanode.call=1:kill:limit=1"
+        p = subprocess.Popen(
+            [sys.executable, "-m", "greptimedb_tpu.cli", "datanode",
+             "start", "--node-id", "9", "--data-home",
+             str(tmp_path / "dn9"), "--platform", "cpu"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd="/root/repo", env=env)
+        try:
+            addr = json.loads(p.stdout.readline())["address"]
+            client = DatanodeClient(addr, max_retries=1, deadline_s=5.0)
+            # health is exempt from injection: the probe sees the truth
+            assert client.health()
+            # the first non-health call triggers the injected kill
+            with pytest.raises(Exception):
+                client.action("status")
+            p.wait(timeout=20)
+            assert p.returncode == 137
+            assert not DatanodeClient(addr, max_retries=0).health()
+        finally:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)

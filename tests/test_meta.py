@@ -734,3 +734,261 @@ def test_information_schema_breadth(tmp_path):
                "collations"):
         db.sql(f"SELECT * FROM information_schema.{vt}")
     db.close()
+
+
+class TestFailureDetectorEdgeCases:
+    """ISSUE 6 satellite: the detector's numeric guards, exercised
+    explicitly (clock skew, cold start, the ±700 exponent clamps)."""
+
+    def test_phi_before_any_heartbeat_is_zero(self):
+        det = PhiAccrualFailureDetector()
+        assert det.phi(0.0) == 0.0
+        assert det.phi(1e12) == 0.0
+        assert det.is_available(1e15)
+
+    def test_clock_going_backwards_does_not_poison_history(self):
+        det = PhiAccrualFailureDetector()
+        for i in range(10):
+            det.heartbeat(i * 1000.0)
+        before = list(det._intervals)
+        det.heartbeat(2_000.0)  # NTP step: 7 seconds into the past
+        # the negative interval was dropped, not recorded
+        assert list(det._intervals) == before
+        assert all(x >= 0 for x in det._intervals)
+        # detector still sane: recent-beat phi low, long-silence phi high
+        assert det.phi(2_500.0) < det.threshold
+        assert det.phi(2_000.0 + 300_000.0) > det.threshold
+        # and recovers its rhythm from subsequent regular beats
+        for i in range(3, 13):
+            det.heartbeat(i * 1000.0)
+        assert det.phi(13_200.0) < 1.0
+
+    def test_exponent_clamp_alive_side(self):
+        det = PhiAccrualFailureDetector()
+        for i in range(20):
+            det.heartbeat(i * 1000.0)
+        # querying far BEFORE the last heartbeat (big negative elapsed):
+        # exponent > 700 must clamp to certainly-alive, not overflow
+        assert det.phi(19_000.0 - 1e9) == 0.0
+
+    def test_exponent_clamp_dead_side(self):
+        det = PhiAccrualFailureDetector()
+        for i in range(20):
+            det.heartbeat(i * 1000.0)
+        # querying absurdly far past the last heartbeat: exponent < -700
+        # must clamp to certainly-dead (300), not raise/overflow
+        assert det.phi(19_000.0 + 1e12) == 300.0
+        # and the tiny-probability guard (p <= 1e-300) saturates too
+        assert det.phi(19_000.0 + 1e9) == pytest.approx(300.0)
+
+    def test_first_heartbeat_seeds_bootstrap_estimate(self):
+        det = PhiAccrualFailureDetector()
+        det.heartbeat(0.0)
+        assert len(det._intervals) == 2  # mean ± std bootstrap pair
+        assert det.phi(500.0) < det.threshold
+
+
+def _migration_cluster(tmp_path, kv=None, shared_home=False):
+    """2 in-process datanodes with SEPARATE data homes over a shared
+    remote-WAL broker directory (the snapshot-ship topology), or a
+    shared home (the shared-storage topology)."""
+    from greptimedb_tpu.storage.remote_wal import SharedLogBroker
+
+    kv = kv if kv is not None else MemoryKv()
+    ms = Metasrv(kv)
+    nodes = []
+    for i in range(2):
+        broker = SharedLogBroker(str(tmp_path / "broker"))
+        home = str(tmp_path) if shared_home else str(tmp_path / f"dn{i}")
+        dn = Datanode(i, home, wal_broker=broker)
+        ms.register_datanode(dn)
+        nodes.append(dn)
+    return ms, nodes, kv
+
+
+def _seed_migration_region(ms, nodes, rid=900):
+    nodes[0].handle_instruction(
+        {"kind": "open_region", "region_id": rid, "role": "leader",
+         "schema": schema().to_dict()}, 0.0)
+    ms.set_region_route(rid, 0)
+    nodes[0].write(rid, {"h": ["a", "b"], "ts": [1000, 2000],
+                         "v": [1.0, 2.0]}, 1.0)
+    nodes[0].engine.regions[rid].flush()
+    nodes[0].write(rid, {"h": ["c"], "ts": [3000], "v": [3.0]}, 2.0)  # WAL tail
+    return rid
+
+
+_MIGRATION_PHASES = ("prepare", "snapshot_ship", "fence_source",
+                     "delta_sync", "upgrade_target", "update_metadata",
+                     "close_old")
+
+
+class TestMigrationSnapshotShip:
+    def test_migration_across_separate_homes(self, tmp_path):
+        """The tentpole path: no shared object store — SSTs snapshot-ship
+        over the object plane, the WAL tail replays from the shared
+        broker, and the move is exact."""
+        ms, nodes, _kv = _migration_cluster(tmp_path)
+        rid = _seed_migration_region(ms, nodes)
+        out = ms.migrate_region(rid, 0, 1, now_ms=10.0)
+        assert out == {"region_id": rid, "to_node": 1}
+        assert ms.region_route(rid) == 1
+        assert rid not in nodes[0].engine.regions
+        host = nodes[1].engine.regions[rid].scan_host()
+        assert sorted(zip(host["h"], host["v"])) == [
+            ("a", 1.0), ("b", 2.0), ("c", 3.0)]
+        # the target physically owns the SSTs now (separate home)
+        assert any(p.endswith(".parquet")
+                   for p in nodes[1].list_region_objects(rid))
+        nodes[1].write(rid, {"h": ["d"], "ts": [4000], "v": [4.0]}, 20.0)
+        assert len(nodes[1].engine.regions[rid].scan_host()["ts"]) == 4
+
+    def test_resume_at_every_journaled_phase(self, tmp_path):
+        """Kill the procedure runner after each journaled phase; a fresh
+        metasrv over the same kv + storage recovers to a consistent
+        route with zero acked-write loss (acceptance criterion)."""
+        from greptimedb_tpu.meta.migration import RegionMigrationProcedure
+        from greptimedb_tpu.meta.procedure import ProcedureContext
+
+        for crash_after in range(len(_MIGRATION_PHASES)):
+            base = tmp_path / f"case{crash_after}"
+            base.mkdir()
+            ms, nodes, kv = _migration_cluster(base)
+            rid = _seed_migration_region(ms, nodes)
+            proc = RegionMigrationProcedure(state={
+                "region_id": rid, "from_node": 0, "to_node": 1,
+                "schema": None, "now_ms": 5.0})
+            ctx = ProcedureContext(
+                kv, ms.procedures, "crashpid",
+                {"datanodes": ms.datanodes, "metasrv": ms})
+            for _ in range(crash_after):
+                st = proc.execute(ctx)
+                assert st.kind == "executing"
+            # journal exactly what the manager would have, then "crash"
+            kv.put_json("__procedure/resume-test", {
+                "type": "region_migration", "state": proc.state,
+                "status": "running", "ts": 0})
+            # restart: fresh metasrv + fresh datanode objects, same disks
+            ms2, nodes2, _ = _migration_cluster(base, kv=kv)
+            out = ms2.procedures.recover()
+            assert out and out[-1] == {"region_id": rid, "to_node": 1}, (
+                crash_after, out)
+            assert ms2.region_route(rid) == 1, crash_after
+            # no stuck journal
+            assert not [
+                r for r in ms2.procedures.history()
+                if r["status"] == "running"], crash_after
+            # the re-homed region serves every ACKED (WAL-appended) write
+            nodes2[1].handle_instruction(
+                {"kind": "open_region", "region_id": rid,
+                 "role": "leader"}, 50.0)
+            host = nodes2[1].engine.regions[rid].scan_host()
+            assert sorted(zip(host["h"], host["v"])) == [
+                ("a", 1.0), ("b", 2.0), ("c", 3.0)], crash_after
+
+    def test_live_migration_bit_exact_vs_quiesced(self, tmp_path):
+        """Writes land on the source WHILE phases run; the migrated
+        region must match a quiesced reference copy bit-for-bit
+        (acceptance criterion)."""
+        from greptimedb_tpu.meta.migration import RegionMigrationProcedure
+        from greptimedb_tpu.meta.procedure import ProcedureContext
+
+        ms, nodes, kv = _migration_cluster(tmp_path)
+        rid = _seed_migration_region(ms, nodes)
+        applied = [("a", 1000, 1.0), ("b", 2000, 2.0), ("c", 3000, 3.0)]
+        proc = RegionMigrationProcedure(state={
+            "region_id": rid, "from_node": 0, "to_node": 1,
+            "schema": None, "now_ms": 5.0})
+        ctx = ProcedureContext(kv, ms.procedures, "livepid",
+                               {"datanodes": ms.datanodes, "metasrv": ms})
+        k = 0
+        while True:
+            st = proc.execute(ctx)
+            if st.kind == "done":
+                break
+            # a live writer between every pair of phases; once the fence
+            # lands, the source rejects and the writer would fail over
+            row = (f"w{k}", 10_000 + k * 7, float(k))
+            try:
+                nodes[0].write(rid, {"h": [row[0]], "ts": [row[1]],
+                                     "v": [row[2]]}, 6.0 + k)
+                applied.append(row)
+            except GreptimeError:
+                pass  # fenced: not acked, so not part of the contract
+            k += 1
+        host = nodes[1].engine.regions[rid].scan_host()
+        got = sorted(zip(host["h"], host["ts"], host["v"]))
+        # quiesced reference: the same acked writes on an idle region
+        from greptimedb_tpu.storage.region import RegionEngine
+
+        ref = RegionEngine(str(tmp_path / "ref")).create_region(
+            1, schema())
+        for h, ts, v in applied:
+            ref.write({"h": [h], "ts": [ts], "v": [v]})
+        rhost = ref.scan_host()
+        want = sorted(zip(rhost["h"], rhost["ts"], rhost["v"]))
+        assert got == want
+
+
+class TestFollowerReplicas:
+    def test_follower_lag_published_and_failover_prefers_follower(
+            self, tmp_path):
+        ms, nodes, kv = _migration_cluster(tmp_path, shared_home=True)
+        rid = _seed_migration_region(ms, nodes)
+        ms.add_follower(rid, 1, now_ms=0.0)
+        assert nodes[1].roles[rid] == "follower"
+        # heartbeat loop: leader renews, follower syncs; lag publishes
+        t = 0.0
+        for _ in range(30):
+            for dn in nodes:
+                for instr in ms.handle_heartbeat(dn.heartbeat(t), t):
+                    dn.handle_instruction(instr, t)
+            t += 1000.0
+        rec = kv.get_json(f"__meta/route/followers/{rid}")
+        meta = rec["nodes"]["1"]
+        # lag is bounded by one heartbeat interval (the beat reports the
+        # sync applied on the PREVIOUS beat)
+        assert meta["lag_ms"] is not None and meta["lag_ms"] <= 1000.0
+        assert meta["entries_behind"] == 0
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+
+        assert REGISTRY.value("greptime_replication_lag_entries",
+                              (str(rid), "1")) == 0.0
+        # follower actually replays leader data (shared storage + broker)
+        host = nodes[1].engine.regions[rid].scan_host()
+        assert sorted(host["h"].tolist()) == ["a", "b", "c"]
+        # new leader writes show up as entries_behind until the next sync
+        nodes[0].write(rid, {"h": ["d"], "ts": [4000], "v": [4.0]}, t)
+        hb_leader = nodes[0].heartbeat(t)
+        ms.handle_heartbeat(hb_leader, t)
+        hb_f = nodes[1].heartbeat(t)
+        ms.handle_heartbeat(hb_f, t)
+        rec = kv.get_json(f"__meta/route/followers/{rid}")
+        assert rec["nodes"]["1"]["entries_behind"] >= 1
+        # leader dies: the detector trips and failover PROMOTES the
+        # follower (warm data) instead of cold-opening elsewhere
+        nodes[0].alive = False
+        for _ in range(60):
+            for instr in ms.handle_heartbeat(nodes[1].heartbeat(t), t):
+                nodes[1].handle_instruction(instr, t)
+            t += 1000.0
+        migrated = ms.tick(t)
+        assert migrated and migrated[0] == {"region_id": rid, "to_node": 1}
+        assert ms.region_route(rid) == 1
+        assert nodes[1].roles[rid] == "leader"
+        # promoted replica serves EVERY acked write, incl. the WAL tail
+        host = nodes[1].engine.regions[rid].scan_host()
+        assert sorted(host["h"].tolist()) == ["a", "b", "c", "d"]
+        # and is no longer listed as a follower
+        rec = kv.get_json(f"__meta/route/followers/{rid}")
+        assert rec is None or "1" not in rec.get("nodes", {})
+        # survivor keeps taking writes
+        nodes[1].write(rid, {"h": ["e"], "ts": [5000], "v": [5.0]}, t)
+
+    def test_add_follower_on_leader_node_rejected(self, tmp_path):
+        from greptimedb_tpu.errors import InvalidArguments
+
+        ms, nodes, _kv = _migration_cluster(tmp_path, shared_home=True)
+        rid = _seed_migration_region(ms, nodes)
+        with pytest.raises(InvalidArguments):
+            ms.add_follower(rid, 0, now_ms=0.0)

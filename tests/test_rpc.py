@@ -650,3 +650,134 @@ class TestPromGateway:
         finally:
             srv.shutdown()
             db.close()
+
+
+class TestObjectPlane:
+    def test_object_roundtrip_over_flight(self, two_nodes):
+        """Region snapshot objects ship as binary Arrow batches (the
+        migration bulk-copy substrate)."""
+        client = DatanodeClient(two_nodes[0].address)
+        payload = bytes(range(256)) * 40_000  # ~10MB: exercises chunking
+        client.put_object("region_9/sst/blob.parquet", payload)
+        assert "region_9/sst/blob.parquet" in client.list_region_objects(9)
+        assert client.fetch_object("region_9/sst/blob.parquet") == payload
+        client.delete_object("region_9/sst/blob.parquet")
+        assert client.list_region_objects(9) == []
+        # path traversal is rejected at the server
+        with pytest.raises(Exception, match="region object path"):
+            client.put_object("../../etc/passwd", b"nope")
+        client.close()
+
+
+class TestSnapshotShipMigration:
+    def test_migration_between_separate_data_homes(self, tmp_path):
+        """The tentpole over real sockets: datanodes with SEPARATE data
+        homes (no shared object store) — migration snapshot-ships the
+        SSTs over Flight and catches up from the shared remote-WAL tail."""
+        from greptimedb_tpu.meta.cluster import Metasrv
+        from greptimedb_tpu.meta.kv import MemoryKv
+        from tests.test_meta import schema
+
+        wal = str(tmp_path / "walbroker")
+        servers = [
+            DatanodeFlightServer(i, str(tmp_path / f"dn{i}"), managed=True,
+                                 remote_wal_dir=wal)
+            for i in range(2)
+        ]
+        try:
+            ms = Metasrv(MemoryKv())
+            proxies = [RemoteDatanode(s.node_id, s.address) for s in servers]
+            for p in proxies:
+                ms.register_datanode(p)
+            rid = 77
+            proxies[0].handle_instruction(
+                {"kind": "open_region", "region_id": rid, "role": "leader",
+                 "schema": schema().to_dict()}, 0.0)
+            ms.set_region_route(rid, 0)
+            proxies[0].write(rid, {"h": ["a", "b"], "ts": [1000, 2000],
+                                   "v": [1.0, 2.0]}, 1.0)
+            proxies[0].client.instruction(
+                {"kind": "flush_region", "region_id": rid})
+            proxies[0].write(rid, {"h": ["c"], "ts": [3000], "v": [3.0]},
+                             2.0)  # WAL-tail only
+            out = ms.migrate_region(rid, 0, 1, now_ms=10.0)
+            assert out == {"region_id": rid, "to_node": 1}
+            assert ms.region_route(rid) == 1
+            host = proxies[1].read(rid)
+            assert sorted(zip(host["h"], host["v"])) == [
+                ("a", 1.0), ("b", 2.0), ("c", 3.0)]
+            # the SSTs physically moved into the target's own home
+            shipped = proxies[1].list_region_objects(rid)
+            assert any(p.endswith(".parquet") for p in shipped)
+            # source no longer hosts the region
+            assert rid not in servers[0].datanode.engine.regions
+            proxies[1].write(rid, {"h": ["d"], "ts": [4000], "v": [4.0]},
+                             20.0)
+            assert len(proxies[1].read(rid)["ts"]) == 4
+        finally:
+            for s in servers:
+                s.shutdown()
+
+
+class TestFrontendPlacementAndRouting:
+    def test_placement_skips_detector_dead_nodes(self, frontend, two_nodes):
+        fe = frontend
+        # both nodes beat steadily, then node 0 falls silent
+        t = 0.0
+        for _ in range(30):
+            fe.note_heartbeat(0, t)
+            fe.note_heartbeat(1, t)
+            t += 1000.0
+        for _ in range(90):
+            fe.note_heartbeat(1, t)
+            t += 1000.0
+        fe.clock_ms = lambda: t
+        assert fe._node_dead(0) and not fe._node_dead(1)
+        fe.sql(
+            "CREATE TABLE pl (host STRING, ts TIMESTAMP(3) TIME INDEX, "
+            "v DOUBLE, PRIMARY KEY (host)) "
+            "PARTITION ON COLUMNS (host) (host < 'm', host >= 'm')"
+        )
+        # every region landed on the live node
+        info = fe.catalog.get_table("public", "pl")
+        assert all(fe.region_route(r) == 1 for r in info.region_ids)
+        assert len(two_nodes[1].datanode.engine.regions) == 2
+        assert len(two_nodes[0].datanode.engine.regions) == 0
+
+    def test_placement_with_all_nodes_dead_raises(self, frontend):
+        from greptimedb_tpu.errors import GreptimeError
+
+        fe = frontend
+        t = 0.0
+        for _ in range(30):
+            fe.note_heartbeat(0, t)
+            fe.note_heartbeat(1, t)
+            t += 1000.0
+        fe.clock_ms = lambda: t + 600_000.0  # everyone long silent
+        with pytest.raises(GreptimeError, match="no alive datanodes"):
+            fe.sql("CREATE TABLE dead (h STRING, ts TIMESTAMP(3) "
+                   "TIME INDEX, v DOUBLE, PRIMARY KEY (h))")
+
+    def test_queries_follow_migrated_route(self, frontend, two_nodes):
+        """A metasrv-driven migration (snapshot ship: the fixture's nodes
+        have SEPARATE data homes) swaps the route in the kv the frontend
+        reads — subsequent writes and queries follow it with no frontend
+        restart or cache flush."""
+        from greptimedb_tpu.meta.cluster import Metasrv
+
+        fe = frontend
+        fe.sql("CREATE TABLE rw (h STRING, ts TIMESTAMP(3) TIME INDEX, "
+               "v DOUBLE, PRIMARY KEY (h))")
+        rid = fe.catalog.get_table("public", "rw").region_ids[0]
+        assert fe.region_route(rid) == 0
+        fe.sql("INSERT INTO rw VALUES ('a', 1000, 1.0)")
+        ms = Metasrv(fe.kv)  # shares the frontend's route store
+        for s in two_nodes:
+            ms.register_datanode(RemoteDatanode(s.node_id, s.address))
+        out = ms.migrate_region(rid, 0, 1, now_ms=10.0)
+        assert out == {"region_id": rid, "to_node": 1}
+        # the frontend picks up the new route on the next statement
+        fe.sql("INSERT INTO rw VALUES ('b', 2000, 2.0)")
+        assert fe.sql("SELECT count(*) FROM rw").rows == [[2]]
+        assert len(two_nodes[1].datanode.engine.regions) == 1
+        assert rid not in two_nodes[0].datanode.engine.regions

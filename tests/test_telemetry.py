@@ -134,13 +134,17 @@ class TestRegistryStaticCheck:
         # no conflicting re-registrations, and every metric/label name
         # follows the Prometheus [a-z_][a-z0-9_]* convention
         import greptimedb_tpu.flow.engine  # noqa: F401
+        import greptimedb_tpu.meta.cluster  # noqa: F401
+        import greptimedb_tpu.meta.migration  # noqa: F401
         import greptimedb_tpu.parallel.dist  # noqa: F401
         import greptimedb_tpu.promql.engine  # noqa: F401
         import greptimedb_tpu.query.physical  # noqa: F401
+        import greptimedb_tpu.rpc.frontend  # noqa: F401
         import greptimedb_tpu.servers.http  # noqa: F401
         import greptimedb_tpu.servers.tcp  # noqa: F401
         import greptimedb_tpu.standalone  # noqa: F401
         import greptimedb_tpu.storage.cache  # noqa: F401
+        import greptimedb_tpu.utils.chaos  # noqa: F401
         import greptimedb_tpu.utils.memory  # noqa: F401
 
         assert REGISTRY.collisions == [], REGISTRY.collisions
