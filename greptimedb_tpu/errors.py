@@ -140,6 +140,20 @@ class ResourcesExhausted(GreptimeError):
     status_code = StatusCode.RUNTIME_RESOURCES_EXHAUSTED
 
 
+class RateLimited(GreptimeError):
+    """Per-tenant rate quota exceeded (serving/admission.py) — the
+    deliberate flow-control rejection, distinct from memory pressure."""
+
+    status_code = StatusCode.RATE_LIMITED
+
+
+class DeadlineExceeded(GreptimeError):
+    """Query shed by the scheduler before/while running because its
+    deadline passed (serving/scheduler.py deadline-based shedding)."""
+
+    status_code = StatusCode.DEADLINE_EXCEEDED
+
+
 class Cancelled(GreptimeError):
     status_code = StatusCode.CANCELLED
 

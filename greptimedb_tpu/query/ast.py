@@ -379,14 +379,20 @@ class InSubquery(Expr):
 
 @dataclass
 class Union(Statement):
-    """UNION [ALL] chain; trailing ORDER BY/LIMIT apply to the union
-    (reference: DataFusion set operations via src/query/src/datafusion.rs)."""
+    """Set operation chain; trailing ORDER BY/LIMIT apply to the whole
+    statement (reference: DataFusion set operations via
+    src/query/src/datafusion.rs).  ``op`` is "union" | "intersect" |
+    "except"; UNION chains stay flat (selects may hold >2 members),
+    INTERSECT/EXCEPT and mixed chains nest left-associatively with
+    INTERSECT binding tighter, so ``selects`` members may themselves be
+    Union statements."""
 
-    selects: list[Select]
+    selects: list  # list[Select | Union]
     all: bool = False
     order_by: list[OrderByItem] = field(default_factory=list)
     limit: int | None = None
     offset: int | None = None
+    op: str = "union"  # "union" | "intersect" | "except"
 
 
 @dataclass

@@ -399,6 +399,72 @@ class QueryEngine:
             metrics["scanned_rows_padded"] = scanned
         return result
 
+    # ---- cross-query stacked execution --------------------------------
+    def execute_select_batch(
+        self, sels: list[Select], metrics: dict | None = None,
+    ) -> list[QueryResult] | None:
+        """Execute N concurrent Selects over the same (table, shape
+        class) through ONE stacked device dispatch
+        (Executor.execute_grid_batch), shaping each member's result with
+        the normal per-query host tail (_shape) so batched output is
+        bit-exact vs solo execution.  Returns None whenever ANY member
+        falls outside the tight warm-grid eligibility — the scheduler
+        then executes the group solo, so this path can only ever be a
+        fast path, never a semantic fork."""
+        import os as _os
+
+        if len(sels) < 2 or _os.environ.get("GREPTIME_GRID", "auto") == "off":
+            return None
+        grid_fn = getattr(self.provider, "grid_table", None)
+        if grid_fn is None:
+            return None
+        table = sels[0].table
+        if table is None or any(
+            s.table != table or s.joins or s.from_subquery is not None
+            for s in sels
+        ):
+            return None
+        from greptimedb_tpu.query.ast import expr_contains
+
+        for s in sels:
+            touched = [s.where, s.having] + [it.expr for it in s.items]
+            if any(
+                e is not None and expr_contains(
+                    e, (ScalarSubquery, InSubquery, Exists))
+                for e in touched
+            ):
+                return None
+        check = getattr(self.provider, "check_cancelled", None)
+        if check is not None:
+            check()
+        from greptimedb_tpu.query.optimizer import optimize_select
+        from greptimedb_tpu.query.physical import grid_plan_candidate
+
+        try:
+            ctx = self.provider.table_context(table)
+            plans = []
+            for s in sels:
+                s_opt, _rules = optimize_select(s, ctx)
+                plan = plan_select(s_opt, ctx)
+                if not grid_plan_candidate(plan) or plan.sliding is not None:
+                    return None
+                plans.append(plan)
+        except (PlanError, Unsupported, TableNotFound):
+            return None
+        grid, ts_bounds = grid_fn(table, plans[0])
+        if grid is None:
+            return None
+        with TRACER.stage("execute", batch=len(plans)):
+            outs = self.executor.execute_grid_batch(
+                plans, grid, ts_bounds, metrics=metrics)
+        if outs is None:
+            return None
+        results = []
+        with TRACER.stage("materialize", batch=len(plans)):
+            for plan, (env, n) in zip(plans, outs):
+                results.append(self._shape(plan, env, n))
+        return results
+
     def _execute_expr_key_agg(self, sel: Select, ctx,
                               plan: SelectPlan) -> QueryResult | None:
         """GROUP BY over computed tag expressions (upper(h), length(h),
@@ -582,27 +648,36 @@ class QueryEngine:
 
     # ------------------------------------------------------------------
     def execute_union(self, union, run_select) -> QueryResult:
-        """UNION [ALL]: run each member via ``run_select`` (the caller's
-        full dispatch, so information_schema members work), concatenate,
-        dedup unless ALL, then apply the union-level ORDER BY/LIMIT."""
+        """Set operations: run each member via ``run_select`` (the
+        caller's full dispatch, so information_schema members and nested
+        set operations work), combine per ``union.op`` —
+        UNION concatenates (dedup unless ALL); INTERSECT keeps left rows
+        present on the right (ALL: min multiplicity); EXCEPT keeps left
+        rows absent from the right (ALL: left-minus-right multiplicity,
+        left order preserved) — then apply the statement-level ORDER
+        BY/LIMIT."""
         results = [run_select(s) for s in union.selects]
         ncols = len(results[0].column_names)
         for r in results[1:]:
             if len(r.column_names) != ncols:
                 raise PlanError(
-                    f"UNION members have {ncols} vs "
+                    f"{union.op.upper()} members have {ncols} vs "
                     f"{len(r.column_names)} columns"
                 )
-        rows = [row for r in results for row in r.rows]
-        if not union.all:
-            seen: set = set()
-            deduped = []
-            for row in rows:
-                key = tuple(row)
-                if key not in seen:
-                    seen.add(key)
-                    deduped.append(row)
-            rows = deduped
+        op = getattr(union, "op", "union")
+        if op == "union":
+            rows = [row for r in results for row in r.rows]
+            if not union.all:
+                seen: set = set()
+                deduped = []
+                for row in rows:
+                    key = tuple(row)
+                    if key not in seen:
+                        seen.add(key)
+                        deduped.append(row)
+                rows = deduped
+        else:
+            rows = self._set_op_rows(op, union.all, results)
         res = QueryResult(results[0].column_names, rows,
                           column_types=results[0].column_types)
         if union.order_by:
@@ -625,6 +700,50 @@ class QueryEngine:
         if union.limit is not None:
             res.rows[:] = res.rows[: union.limit]
         return res
+
+    @staticmethod
+    def _set_op_rows(op: str, all_: bool, results: list) -> list[list]:
+        """INTERSECT/EXCEPT over exactly two member results (the parser
+        nests longer chains left-associatively).  DISTINCT semantics
+        dedup the output; ALL keeps multiplicities (min for INTERSECT,
+        left-minus-right for EXCEPT).  Left member order is preserved."""
+        import collections
+
+        left, right = results[0].rows, results[1].rows
+        rcount = collections.Counter(tuple(r) for r in right)
+        out: list[list] = []
+        if op == "intersect":
+            if all_:
+                budget = dict(rcount)
+                for row in left:
+                    k = tuple(row)
+                    if budget.get(k, 0) > 0:
+                        budget[k] -= 1
+                        out.append(row)
+            else:
+                seen: set = set()
+                for row in left:
+                    k = tuple(row)
+                    if k in rcount and k not in seen:
+                        seen.add(k)
+                        out.append(row)
+        else:  # except
+            if all_:
+                budget = dict(rcount)
+                for row in left:
+                    k = tuple(row)
+                    if budget.get(k, 0) > 0:
+                        budget[k] -= 1
+                    else:
+                        out.append(row)
+            else:
+                seen = set()
+                for row in left:
+                    k = tuple(row)
+                    if k not in rcount and k not in seen:
+                        seen.add(k)
+                        out.append(row)
+        return out
 
     def _execute_tableless(self, sel: Select) -> QueryResult:
         env: dict[str, np.ndarray] = {}

@@ -185,6 +185,8 @@ class Parser:
         kw = t.upper
         if kw == "SELECT":
             return self.select_or_union()
+        if kw == "WITH":
+            return self.with_statement()
         if kw == "TQL":
             return self.tql()
         if kw == "CREATE":
@@ -253,30 +255,99 @@ class Parser:
 
     # ---- SELECT ---------------------------------------------------------
     def select_or_union(self) -> Statement:
-        """SELECT ... [UNION [ALL] SELECT ...]*; a trailing ORDER BY/LIMIT
-        (parsed into the last member) applies to the whole union."""
+        """SELECT ... [UNION|INTERSECT|EXCEPT [ALL] SELECT ...]*; a
+        trailing ORDER BY/LIMIT (parsed into the last member) applies to
+        the whole statement.  INTERSECT binds tighter than UNION/EXCEPT
+        (standard SQL precedence); same-level operators associate left.
+        INTERSECT/EXCEPT must be real set operations here — before they
+        were parsed, ``SELECT 1 INTERSECT SELECT 1`` silently split into
+        TWO statements (INTERSECT swallowed as a column alias) and
+        returned only the second SELECT's result."""
         first = self.select()
-        if not self.at_kw("UNION"):
+        if not self.at_kw("UNION", "INTERSECT", "EXCEPT"):
             return first
-        members = [first]
-        all_flags: list[bool] = []
-        while self.eat_kw("UNION"):
-            all_flags.append(bool(self.eat_kw("ALL")))
+        members: list = [first]
+        ops: list[tuple[str, bool]] = []  # (op, all) joining i and i+1
+        while self.at_kw("UNION", "INTERSECT", "EXCEPT"):
+            op = self.next().upper.lower()
+            all_ = bool(self.eat_kw("ALL"))
+            self.eat_kw("DISTINCT")  # explicit DISTINCT = the default
+            ops.append((op, all_))
             members.append(self.select())
-        if len(set(all_flags)) > 1:
-            raise SyntaxError_("mixed UNION and UNION ALL is not supported")
         for m in members[:-1]:
             if m.order_by or m.limit is not None or m.offset is not None:
                 raise SyntaxError_(
-                    "ORDER BY/LIMIT inside a UNION member needs parentheses"
+                    "ORDER BY/LIMIT inside a set-operation member needs "
+                    "parentheses"
                 )
         last = members[-1]
-        union = Union(
-            selects=members, all=all_flags[0],
-            order_by=last.order_by, limit=last.limit, offset=last.offset,
-        )
+        order_by, limit, offset = last.order_by, last.limit, last.offset
         last.order_by, last.limit, last.offset = [], None, None
-        return union
+
+        if all(op == "union" for op, _ in ops):
+            # flat UNION chain (the historical shape execute_union
+            # optimizes for); mixed ALL-ness stays refused
+            all_flags = {a for _, a in ops}
+            if len(all_flags) > 1:
+                raise SyntaxError_(
+                    "mixed UNION and UNION ALL is not supported")
+            return Union(
+                selects=members, all=ops[0][1],
+                order_by=order_by, limit=limit, offset=offset,
+            )
+
+        # precedence pass 1: fold INTERSECT runs into nested Unions
+        folded: list = [members[0]]
+        level_ops: list[tuple[str, bool]] = []
+        for (op, all_), m in zip(ops, members[1:]):
+            if op == "intersect":
+                folded[-1] = Union(selects=[folded[-1], m], all=all_,
+                                   op="intersect")
+            else:
+                level_ops.append((op, all_))
+                folded.append(m)
+        # pass 2: UNION/EXCEPT left-associative
+        result = folded[0]
+        for (op, all_), m in zip(level_ops, folded[1:]):
+            result = Union(selects=[result, m], all=all_, op=op)
+        result.order_by, result.limit, result.offset = (
+            order_by, limit, offset)
+        return result
+
+    # ---- WITH ... AS (non-recursive CTEs) -------------------------------
+    def with_statement(self) -> Statement:
+        """``WITH name AS (SELECT ...) [, name2 AS (...)] SELECT ...``:
+        non-recursive common table expressions, desugared at parse time —
+        every FROM reference to a CTE name becomes a derived table
+        (``from_subquery``), so planning/execution reuse the staged
+        subquery machinery unchanged (the reference plans CTEs through
+        DataFusion, tests/cases/.../common/cte/).  Each CTE body sees the
+        CTEs defined before it; forward and self references stay plain
+        table names (and surface TableNotFound), which is exactly
+        non-recursive scoping."""
+        self.expect_kw("WITH")
+        if self.at_kw("RECURSIVE"):
+            raise Unsupported("WITH RECURSIVE (recursive CTEs)")
+        ctes: dict[str, Statement] = {}
+        while True:
+            name = self.ident()
+            if self.at(Tok.PUNCT, "("):
+                raise Unsupported("CTE column alias lists")
+            self.expect_kw("AS")
+            self.expect(Tok.PUNCT, "(")
+            body = self.select_or_union()
+            self.expect(Tok.PUNCT, ")")
+            if name in ctes:
+                raise SyntaxError_(f"duplicate CTE name {name!r}")
+            ctes[name] = _substitute_ctes(body, ctes)
+            if not self.eat(Tok.PUNCT, ","):
+                break
+        if not self.at_kw("SELECT"):
+            t = self.peek()
+            raise SyntaxError_(
+                f"WITH must be followed by SELECT at {t.pos}, "
+                f"got {t.text!r}")
+        return _substitute_ctes(self.select_or_union(), ctes)
 
     def select(self) -> Select:
         self.expect_kw("SELECT")
@@ -290,16 +361,18 @@ class Parser:
         if self.eat_kw("FROM"):
             if self.at(Tok.PUNCT, "("):
                 # derived table: FROM (SELECT …) [AS] alias — the alias
-                # becomes the staged table name (qualified refs resolve)
+                # becomes the staged table name (qualified refs resolve);
+                # set operations stage like any other inner statement
                 self.next()
-                from_subquery = self.select()
+                from_subquery = self.select_or_union()
                 self.expect(Tok.PUNCT, ")")
                 table = "__subquery__"
             else:
                 table = self.qualified_name()
             if self.peek().kind is Tok.IDENT and not self.at_kw(
                 "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "ALIGN",
-                "UNION", "JOIN", "LEFT", "RIGHT", "FULL", "INNER", "ON", "AS",
+                "UNION", "INTERSECT", "EXCEPT",
+                "JOIN", "LEFT", "RIGHT", "FULL", "INNER", "ON", "AS",
             ):
                 alias = self.ident()
             elif self.eat_kw("AS"):
@@ -389,7 +462,7 @@ class Parser:
         elif self.peek().kind in (Tok.IDENT, Tok.QUOTED_IDENT) and not self.at_kw(
             "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET",
             "ALIGN", "RANGE", "FILL", "BY", "AND", "OR", "NOT", "BETWEEN",
-            "IN", "IS", "LIKE", "UNION",
+            "IN", "IS", "LIKE", "UNION", "INTERSECT", "EXCEPT",
         ):
             alias = self.ident()
         if rng is None and self.at_kw("RANGE"):
@@ -1122,3 +1195,71 @@ class Parser:
 
 def parse_sql(sql: str) -> list[Statement]:
     return Parser.parse_sql(sql)
+
+
+def _substitute_ctes(stmt: Statement, ctes: dict) -> Statement:
+    """Rewrite FROM references to CTE names into derived tables, and
+    recurse into set-operation members, derived tables and expression
+    subqueries (IN/EXISTS/scalar) so a CTE is visible anywhere a SELECT
+    can appear.  JOIN operands cannot stage a subquery yet — a CTE name
+    there is refused rather than silently bound to a real table."""
+    import dataclasses
+
+    from greptimedb_tpu.query.ast import map_expr
+
+    if not ctes:
+        return stmt
+    if isinstance(stmt, Union):
+        return dataclasses.replace(stmt, selects=[
+            _substitute_ctes(s, ctes) for s in stmt.selects
+        ])
+    if not isinstance(stmt, Select):
+        return stmt
+
+    def sub_expr(e):
+        if e is None:
+            return None
+
+        def resolve(node):
+            if isinstance(node, (ScalarSubquery, InSubquery, Exists)):
+                inner = _substitute_ctes(node.select, ctes)
+                if inner is not node.select:
+                    return dataclasses.replace(node, select=inner)
+            return node
+
+        return map_expr(e, resolve)
+
+    changes: dict = {}
+    for j in stmt.joins:
+        if j.table in ctes:
+            raise Unsupported(f"CTE {j.table!r} in JOIN")
+    if stmt.from_subquery is not None:
+        inner = _substitute_ctes(stmt.from_subquery, ctes)
+        if inner is not stmt.from_subquery:
+            changes["from_subquery"] = inner
+    elif stmt.table in ctes:
+        # the CTE name doubles as the staged table alias, exactly like
+        # FROM (SELECT ...) name
+        changes["from_subquery"] = ctes[stmt.table]
+    new_items = [
+        dataclasses.replace(it, expr=sub_expr(it.expr))
+        if not isinstance(it.expr, Star) else it
+        for it in stmt.items
+    ]
+    if any(a.expr is not b.expr for a, b in zip(new_items, stmt.items)):
+        changes["items"] = new_items
+    for f in ("where", "having"):
+        v = getattr(stmt, f)
+        nv = sub_expr(v)
+        if nv is not v:
+            changes[f] = nv
+    if stmt.group_by:
+        ng = [sub_expr(g) for g in stmt.group_by]
+        if any(a is not b for a, b in zip(ng, stmt.group_by)):
+            changes["group_by"] = ng
+    if stmt.order_by:
+        no = [dataclasses.replace(o, expr=sub_expr(o.expr))
+              for o in stmt.order_by]
+        if any(a.expr is not b.expr for a, b in zip(no, stmt.order_by)):
+            changes["order_by"] = no
+    return dataclasses.replace(stmt, **changes) if changes else stmt

@@ -13,6 +13,7 @@ collision-free, still static-shape.
 
 from __future__ import annotations
 
+import dataclasses as _dataclasses
 import functools
 import os
 
@@ -54,7 +55,41 @@ M_DEVICE_PHASE = REGISTRY.histogram(
 # hits) by which segment strategy it used; tests assert coverage.
 # "grid_bm" counts grid dispatches served from the resident bucket-major
 # derived layout (a subset of "grid").
-DISPATCH_STATS = {"sorted": 0, "scatter": 0, "grid": 0, "grid_bm": 0}
+DISPATCH_STATS = {"sorted": 0, "scatter": 0, "grid": 0, "grid_bm": 0,
+                  "grid_batch": 0}
+
+
+@_dataclasses.dataclass
+class _GridGeom:
+    """Plan→grid geometry produced by Executor._grid_prologue: everything
+    the grid kernels need beyond the plan itself.  Shared by the solo
+    path and the cross-query stacked dispatch so window math has exactly
+    one definition."""
+
+    specs: list
+    where_fn: object
+    where_series: bool
+    ts_name: str
+    tag_keys: list
+    has_time: bool
+    r: int
+    pad_left: int
+    nb: int
+    nbw: int
+    w_raw: int
+    pad_l: int
+    pad_r: int
+    step_q: int
+    bts0: int
+    b_lo: int
+    s0: int
+    aligned: bool
+    lo: int | None
+    hi: int | None
+    cards_tag: list
+    ngt: int
+    dict_ver: tuple
+    tag_order: tuple
 
 _GRID_OPS = {"avg": "mean", "mean": "mean", "sum": "sum", "count": "count",
              "min": "min", "max": "max"}
@@ -490,6 +525,18 @@ class Executor:
         (src/query/src/range_select/plan.rs:273) — here the time bucketing
         is a tensor reshape because the data layout already IS the range
         grid (SURVEY.md §5.7, §7.1)."""
+        g = self._grid_prologue(plan, grid, ts_bounds)
+        if g is None:
+            return None
+        return self._execute_grid_geom(plan, grid, g, metrics)
+
+    def _grid_prologue(self, plan: SelectPlan, grid,
+                       ts_bounds: tuple[int, int]):
+        """Plan→grid geometry shared by the solo path and the cross-query
+        stacked dispatch (execute_grid_batch): agg specs, WHERE shape,
+        time-bucket geometry and window slicing.  Returns None when the
+        plan/grid combination is ineligible for the grid path; otherwise
+        a _GridGeom whose fields feed either kernel family."""
         ctx = plan.ctx
         ts_name = ctx.schema.time_index.name
         tag_keys = [k for k in plan.group_keys if k.kind == "tag"]
@@ -618,12 +665,37 @@ class Executor:
             # per-(series, bucket) counts ride an f32 einsum, exact only
             # below 2^24; absurdly wide buckets take the row path
             return None
-        DISPATCH_STATS["grid"] += 1
 
         dict_ver = tuple(
             len(ctx.encoders[c.name]) for c in ctx.schema.tag_columns
         )
         tag_order = tuple(sorted(grid.tag_codes))
+        return _GridGeom(
+            specs=specs, where_fn=where_fn, where_series=where_series,
+            ts_name=ts_name, tag_keys=tag_keys, has_time=bool(time_keys),
+            r=r, pad_left=pad_left, nb=nb, nbw=nbw, w_raw=w_raw,
+            pad_l=pad_l, pad_r=pad_r, step_q=step_q, bts0=int(bts0),
+            b_lo=b_lo, s0=s0, aligned=aligned, lo=lo, hi=hi,
+            cards_tag=cards_tag, ngt=ngt, dict_ver=dict_ver,
+            tag_order=tag_order,
+        )
+
+    def _execute_grid_geom(
+        self, plan: SelectPlan, grid, g: "_GridGeom",
+        metrics: dict | None,
+    ) -> tuple[dict[str, np.ndarray], int]:
+        ctx = plan.ctx
+        specs = g.specs
+        where_fn, where_series = g.where_fn, g.where_series
+        ts_name = g.ts_name
+        tag_keys, cards_tag = g.tag_keys, g.cards_tag
+        r, pad_left, nb, nbw = g.r, g.pad_left, g.nb, g.nbw
+        w_raw, pad_l, pad_r = g.w_raw, g.pad_l, g.pad_r
+        step_q, bts0, b_lo, s0 = g.step_q, g.bts0, g.b_lo, g.s0
+        aligned, lo, hi = g.aligned, g.lo, g.hi
+        dict_ver, tag_order = g.dict_ver, g.tag_order
+        g_step = grid.step
+        DISPATCH_STATS["grid"] += 1
 
         # resident bucket-major layout: ALIGNED windows whose aggregates
         # all resolve to the per-(series, bucket) partials skip the
@@ -632,7 +704,7 @@ class Executor:
         # series-axis merge (storage/cache.py DerivedLayoutCache)
         out = None
         layout = self._aligned_layout(
-            grid, r, pad_left, nb, specs, aligned, bool(time_keys),
+            grid, r, pad_left, nb, specs, aligned, g.has_time,
             where_fn, where_series, metrics,
         )
         if layout is not None:
@@ -663,7 +735,7 @@ class Executor:
                 "grid", plan.fingerprint(), grid.spad, grid.tpad,
                 grid.field_names, grid.ts0, g_step, r, nbw, w_raw, pad_l,
                 pad_r, tuple(cards_tag), dict_ver, grid.no_nan,
-                bool(time_keys), tag_order, where_series, aligned,
+                g.has_time, tag_order, where_series, aligned,
             )
             kernel = self._cache.get(cache_key)
             jit_miss = kernel is None
@@ -671,7 +743,7 @@ class Executor:
                 kernel = self._build_grid_kernel(
                     grid.field_names, ts_name, tag_order,
                     [k.column for k in tag_keys], cards_tag,
-                    bool(time_keys), r, nbw, w_raw, pad_l, pad_r, step_q,
+                    g.has_time, r, nbw, w_raw, pad_l, pad_r, step_q,
                     where_fn, where_series, specs, grid.ts0, g_step,
                     aligned,
                 )
@@ -686,7 +758,14 @@ class Executor:
                     np.int32(s0),
                 ), jit_miss, metrics)
         out = {k: np.asarray(v) for k, v in out.items()}
+        return self._grid_env(plan, specs, out)
 
+    @staticmethod
+    def _grid_env(plan: SelectPlan, specs, out: dict) -> tuple[dict, int]:
+        """Kernel outputs → host result env: one definition shared by the
+        solo grid path and the stacked batch dispatch, so a batched
+        member's result shaping can never diverge from solo."""
+        ctx = plan.ctx
         gmask = out.pop("__gmask__").astype(bool)
         n = int(gmask.sum())
         env: dict[str, np.ndarray] = {}
@@ -707,6 +786,104 @@ class Executor:
         for name, _op, _fn, _nn, _ci in specs:
             env[name] = out[name][gmask]
         return env, n
+
+    # ---- cross-query stacked dispatch ---------------------------------
+    def execute_grid_batch(
+        self, plans: list[SelectPlan], grid, ts_bounds: tuple[int, int],
+        metrics: dict | None = None,
+    ) -> list[tuple[dict[str, np.ndarray], int]] | None:
+        """Stack N concurrent warm queries over the SAME (region, shape
+        class) into one device dispatch: the bucket-major kernel vmapped
+        over its per-window traced arguments (b_lo, bts0).  Eligibility
+        is deliberately the tightest warm shape — bucket-aligned windows
+        with no residual WHERE, identical plan fingerprint and window
+        geometry, resident bucket-major layout available — everything
+        else returns None and the scheduler falls back to solo execution.
+        Data Path Fusion's observation (arXiv 2605.10511): once per-query
+        kernels are cached, stacking shape-compatible work into one
+        dispatch is the remaining multiplier.
+
+        Bit-exactness contract: the stacked kernel is jit(vmap(fn)) of
+        the SAME fn the solo path jits; vmap maps the batch axis over
+        slice+segment ops whose reduction dims are unbatched, so each
+        member's floats are identical to its solo run."""
+        if len(plans) < 2:
+            return None
+        geoms: list[_GridGeom] = []
+        for p in plans:
+            if p.sliding is not None:
+                return None
+            g = self._grid_prologue(p, grid, ts_bounds)
+            if g is None:
+                return None
+            geoms.append(g)
+        g0 = geoms[0]
+        fp0 = plans[0].fingerprint()
+
+        def sig(g: _GridGeom):
+            return (
+                g.aligned, g.has_time, g.where_fn is None, g.r, g.pad_left,
+                g.nb, g.nbw, g.step_q, tuple(g.cards_tag), g.tag_order,
+                g.dict_ver,
+                tuple((name, op, ci, nn)
+                      for name, op, _fn, nn, ci in g.specs),
+            )
+
+        sig0 = sig(g0)
+        if not (g0.aligned and g0.has_time and g0.where_fn is None):
+            return None
+        for p, g in zip(plans[1:], geoms[1:]):
+            if p.fingerprint() != fp0 or sig(g) != sig0:
+                return None
+        layout = self._aligned_layout(
+            grid, g0.r, g0.pad_left, g0.nb, g0.specs, True, True,
+            None, False, metrics,
+        )
+        if layout is None:
+            return None
+
+        n = len(plans)
+        # pow2-pad the stack (duplicating the leader's window) so the
+        # compiled-program population stays logarithmic in batch size
+        npad = _pow2(n)
+        b_los = np.array(
+            [g.b_lo for g in geoms] + [g0.b_lo] * (npad - n), np.int32)
+        bts0s = np.array(
+            [g.bts0 + g.b_lo * g.step_q for g in geoms]
+            + [g0.bts0 + g0.b_lo * g0.step_q] * (npad - n), np.int64)
+        vkey = (
+            "grid_bm_vmap", fp0, grid.spad, grid.field_names, g0.r,
+            g0.nbw, g0.nb, g0.step_q, tuple(g0.cards_tag), g0.dict_ver,
+            g0.tag_order, npad,
+        )
+        kernel = self._cache.get(vkey)
+        jit_miss = kernel is None
+        if kernel is None:
+            fn = self._bm_kernel_fn(
+                g0.tag_order, [k.column for k in g0.tag_keys],
+                g0.cards_tag, g0.nbw, g0.step_q, None,
+                [(name, op, ci) for name, op, _fn, _nn, ci in g0.specs],
+            )
+            kernel = jax.jit(jax.vmap(fn, in_axes=(None, None, None, 0, 0)))
+            self._cache[vkey] = kernel
+        DISPATCH_STATS["grid"] += n
+        DISPATCH_STATS["grid_bm"] += n
+        DISPATCH_STATS["grid_batch"] += 1
+        out = timed_kernel_call(
+            lambda: kernel(
+                layout[0], layout[1],
+                tuple(grid.tag_codes[t] for t in g0.tag_order),
+                b_los, bts0s,
+            ), jit_miss, metrics)
+        out_np = {k: np.asarray(v) for k, v in out.items()}
+        if metrics is not None:
+            metrics["batched"] = n
+            metrics["layout"] = "bucket_major_stacked"
+        results = []
+        for i, (p, g) in enumerate(zip(plans, geoms)):
+            out_i = {k: v[i] for k, v in out_np.items()}
+            results.append(self._grid_env(p, g.specs, out_i))
+        return results
 
     # ---- resident bucket-major layout (aligned windows) ---------------
     def _aligned_layout(
@@ -830,7 +1007,7 @@ class Executor:
         sums.block_until_ready()
         return (sums, cnts)
 
-    def _build_bm_kernel(
+    def _bm_kernel_fn(
         self, tag_order, tag_cols, cards_tag, nbw, step_q, where_fn,
         bm_specs,
     ):
@@ -840,13 +1017,17 @@ class Executor:
         a per-series multiplier, merge the series axis into tag groups.
         Output contract matches _build_grid_kernel exactly (__gmask__/
         __comps__/__bts__ + one array per aggregate) so the host-side
-        result shaping is shared."""
+        result shaping is shared.  Returned UNJITTED: the solo path jits
+        it directly; the cross-query stacked dispatch jits vmap of the
+        SAME function over (b_lo, bts0) — one program source, so batched
+        and solo math can only differ by XLA's batching rule, which maps
+        the window axis without touching any reduction order (the
+        bit-exactness contract tests/test_scheduler.py pins)."""
         ngt = 1
         for c in cards_tag:
             ngt *= c
         nb = nbw
 
-        @jax.jit
         def kernel(sums, cnts, tag_arrays, b_lo, bts0):
             spad = cnts.shape[0]
             tag_codes = dict(zip(tag_order, tag_arrays))
@@ -890,6 +1071,9 @@ class Executor:
             return out
 
         return kernel
+
+    def _build_bm_kernel(self, *args):
+        return jax.jit(self._bm_kernel_fn(*args))
 
     def _build_grid_kernel(
         self, field_names, ts_name, tag_order, tag_cols, cards_tag, has_time,

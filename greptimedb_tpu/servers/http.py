@@ -113,6 +113,11 @@ def _error_json(e: Exception) -> tuple[dict, int]:
             StatusCode.DATABASE_ALREADY_EXISTS: 409,
             # deliberate backpressure (memory quota), not a server fault
             StatusCode.RUNTIME_RESOURCES_EXHAUSTED: 503,
+            # per-tenant flow control (serving/admission.py): the client
+            # should back off, not fail over
+            StatusCode.RATE_LIMITED: 429,
+            # scheduler deadline shed under overload
+            StatusCode.DEADLINE_EXCEEDED: 503,
         }.get(code, 500)
         return {"code": int(code), "error": e.msg, "execution_time_ms": 0}, http
     return {"code": int(StatusCode.INTERNAL), "error": str(e)}, 500
@@ -196,6 +201,14 @@ class HttpServer(ThreadedAiohttpApp):
         self._db_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="greptime-db"
         )
+        # with the serving scheduler enabled, query requests block in
+        # scheduler.submit instead of executing here — a wider pool lets
+        # concurrent clients queue into the scheduler (where priorities,
+        # quotas and batching decide order) rather than serialize in
+        # front of it.  Ingest protocol handlers stay on the single
+        # db-executor worker.  Created lazily: scheduler-off servers
+        # never allocate it.
+        self._submit_pool: ThreadPoolExecutor | None = None
 
     # ------------------------------------------------------------------
     def build_app(self) -> web.Application:
@@ -271,6 +284,44 @@ class HttpServer(ThreadedAiohttpApp):
             self._db_executor, fn, *args
         )
 
+    async def _call_query(self, fn, *args):
+        """Query-path executor hop: the scheduler-submit pool when the
+        serving scheduler is on (submit blocks until the worker finishes
+        the entry), the single db worker otherwise."""
+        ex = self._db_executor
+        if self.db.scheduler is not None:
+            if self._submit_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._submit_pool = ThreadPoolExecutor(
+                    max_workers=32, thread_name_prefix="greptime-submit")
+            ex = self._submit_pool
+        return await asyncio.get_running_loop().run_in_executor(
+            ex, fn, *args)
+
+    def _tenant(self, request: web.Request) -> str:
+        """Tenant identity for admission: the authenticated basic-auth
+        username wins (a client must not be able to shed its quotas by
+        sending a different x-greptime-tenant header); the header is the
+        fallback for unauthenticated deployments, else "default"."""
+        auth = request.headers.get("Authorization", "")
+        if auth.startswith("Basic "):
+            import base64
+
+            try:
+                creds = base64.b64decode(auth[6:]).decode("utf-8")
+                user = creds.split(":", 1)[0]
+                if user:
+                    return user
+            except Exception:  # noqa: BLE001 — auth middleware rejects
+                pass
+        return request.headers.get("x-greptime-tenant") or "default"
+
+    @staticmethod
+    def _priority(request: web.Request) -> str | None:
+        p = request.headers.get("x-greptime-priority")
+        return p if p in ("interactive", "normal", "background") else None
+
     async def _param(self, request: web.Request, name: str, default=None):
         if name in request.query:
             return request.query[name]
@@ -307,8 +358,19 @@ class HttpServer(ThreadedAiohttpApp):
                 # they target on the single-worker db executor
                 res = self.db.try_fast_sql(sql)
                 if res is None:
+                    sched = self.db.scheduler
                     with M_PROTOCOL_QUERY.labels("http").time():
-                        res = await self._call(self._traced_sql, sql, ctx)
+                        if sched is not None:
+                            tenant = self._tenant(request)
+                            prio = self._priority(request)
+                            client = request.remote or ""
+                            res = await self._call_query(
+                                lambda: sched.submit(
+                                    sql, tenant=tenant, priority=prio,
+                                    client=client, trace_ctx=ctx))
+                        else:
+                            res = await self._call(
+                                self._traced_sql, sql, ctx)
                 M_REQUESTS.labels("/v1/sql", "200").inc()
                 return web.json_response(_result_to_json(res, t0),
                                          headers=_trace_headers(ctx))
@@ -320,7 +382,8 @@ class HttpServer(ThreadedAiohttpApp):
 
     async def _eval_promql(self, query: str, start: float, end: float,
                            step: float, lookback: float | None = None,
-                           trace_ctx: tuple[str, str] | None = None):
+                           trace_ctx: tuple[str, str] | None = None,
+                           tenant: str = "default"):
         from greptimedb_tpu.promql.engine import DEFAULT_LOOKBACK_S, PromEvaluator
         from greptimedb_tpu.promql.parser import parse_promql
 
@@ -334,6 +397,15 @@ class HttpServer(ThreadedAiohttpApp):
                     res = ev.eval(expr)
             return res, ev.steps_ms()
 
+        sched = self.db.scheduler
+        if sched is not None:
+            # PromQL evaluations submit like SQL queries: per-tenant
+            # admission, interactive priority, deadline shedding (no
+            # cross-query batching — the PromQL layout caches already
+            # dedupe the heavy state)
+            return await self._call_query(
+                lambda: sched.submit_fn(run, tenant=tenant,
+                                        label=query[:256]))
         return await self._call(run)
 
     async def h_prom_range(self, request: web.Request) -> web.Response:
@@ -344,8 +416,9 @@ class HttpServer(ThreadedAiohttpApp):
             end = _parse_prom_time(await self._param(request, "end"))
             step = _parse_prom_duration(await self._param(request, "step", "60"))
             with M_LATENCY.labels("/v1/prometheus/api/v1/query_range").time():
-                res, steps = await self._eval_promql(query, start, end, step,
-                                                     trace_ctx=ctx)
+                res, steps = await self._eval_promql(
+                    query, start, end, step, trace_ctx=ctx,
+                    tenant=self._tenant(request))
             from greptimedb_tpu.promql.format import range_payload
 
             M_REQUESTS.labels("/v1/prometheus/api/v1/query_range", "200").inc()
@@ -363,8 +436,9 @@ class HttpServer(ThreadedAiohttpApp):
             query = await self._param(request, "query")
             t = _parse_prom_time(await self._param(request, "time", str(time.time())))
             with M_LATENCY.labels("/v1/prometheus/api/v1/query").time():
-                res, steps = await self._eval_promql(query, t, t, 1,
-                                                     trace_ctx=ctx)
+                res, steps = await self._eval_promql(
+                    query, t, t, 1, trace_ctx=ctx,
+                    tenant=self._tenant(request))
             from greptimedb_tpu.promql.format import instant_payload
 
             M_REQUESTS.labels("/v1/prometheus/api/v1/query", "200").inc()
@@ -1251,7 +1325,8 @@ class HttpServer(ThreadedAiohttpApp):
             end = _parse_prom_time(await self._param(request, "end", "0"))
             step = _parse_prom_duration(await self._param(request, "step", "60"))
             res, steps = await self._eval_promql(query, start, end, step,
-                                                 trace_ctx=ctx)
+                                                 trace_ctx=ctx,
+                                                 tenant=self._tenant(request))
             vals = np.asarray(res.values, dtype=np.float64)
             label_keys = sorted({k for lab in res.labels for k in lab})
             rows = []

@@ -79,6 +79,7 @@ class _Conn:
         self.caps = 0
         self.session_db = "public"  # per-connection database
         self.session_tz = "UTC"
+        self.user = ""  # handshake username = scheduler tenant identity
         # trace id of the last statement that carried a traceparent
         # comment (no headers on this wire — clients read it back via
         # SELECT @@greptime_trace_id, the MySQL analog of the HTTP
@@ -171,6 +172,7 @@ class _Conn:
         rest = resp[32:]
         nul = rest.find(b"\x00")
         username = rest[:nul].decode("utf-8", "replace") if nul >= 0 else ""
+        self.user = username
         after = rest[nul + 1:]
         auth_response = b""
         if after:
@@ -488,6 +490,7 @@ class _Conn:
                         self.server._db_executor,
                         self.server.timed_sql_in_db,
                         stripped, self.session_db, self.session_tz,
+                        self.user,
                     )
                 )
         except GreptimeError as e:

@@ -68,6 +68,7 @@ class _PgConn:
         self.writer = writer
         self.session_db = "public"  # per-connection database
         self.session_tz = "UTC"
+        self.user = ""  # startup-packet user = scheduler tenant identity
         self.stmts: dict[str, _Prepared] = {}
         self.portals: dict[str, _Portal] = {}
         self._skip_until_sync = False
@@ -183,6 +184,7 @@ class _PgConn:
                 db = params.get("database")
                 if db:
                     self.session_db = db
+                self.user = params.get("user", "")
                 provider = getattr(self.server.db, "user_provider", None)
                 if provider is not None and provider.enabled and (
                         self.server.auth_mode == "scram"):
@@ -394,7 +396,8 @@ class _PgConn:
             portal.result, self.session_db, self.session_tz = (
                 await loop.run_in_executor(
                     self.server._db_executor, self.server.timed_sql_in_db,
-                    portal.bound_sql, self.session_db, self.session_tz))
+                    portal.bound_sql, self.session_db, self.session_tz,
+                    self.user))
             return True
         except GreptimeError as e:
             self._ext_error(e.msg, "42000")
@@ -574,6 +577,7 @@ class _PgConn:
                                 self.server._db_executor,
                                 self.server.timed_sql_in_db,
                                 sql, self.session_db, self.session_tz,
+                                self.user,
                             )
                         )
                     if result.column_names:

@@ -38,24 +38,44 @@ class ThreadedTcpServer:
         # that are unsynchronized by design (mito2-style single worker per
         # region) and rely on this pool for serialization. Registry-only
         # statements (KILL, SHOW PROCESSLIST) bypass the pool entirely —
-        # see db.try_fast_sql at the protocol call sites.
+        # see db.try_fast_sql at the protocol call sites.  With the
+        # serving scheduler enabled the pool carries only BLOCKING submit
+        # calls (the scheduler owns execution order and the db lock owns
+        # correctness), so it widens to let concurrent connections queue
+        # into the scheduler instead of serializing in front of it.
         self._db_executor = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix=f"{self.name}-db"
+            max_workers=(16 if getattr(db, "scheduler", None) is not None
+                         else 1),
+            thread_name_prefix=f"{self.name}-db"
         )
+
+    @property
+    def scheduler(self):
+        return getattr(self.db, "scheduler", None)
 
     async def _handle(self, reader, writer) -> None:  # pragma: no cover
         raise NotImplementedError
 
-    def timed_sql_in_db(self, query, dbname, timezone=None):
+    def timed_sql_in_db(self, query, dbname, timezone=None, user=""):
         """db.sql_in_db with this protocol's latency observation — the
         run_in_executor entry every wire statement goes through.  MySQL/
         PostgreSQL have no request headers, so trace context rides in a
         leading SQL comment (sqlcommenter convention,
         ``/* traceparent='00-…-…-01' */ SELECT …``) and seeds the span
         tree exactly like the HTTP ``traceparent`` header; this runs ON
-        the db-executor thread, where the Tracer's thread-local lives."""
+        the db-executor thread, where the Tracer's thread-local lives.
+        With the serving scheduler enabled, the statement submits there
+        instead — the connection's authenticated ``user`` is its tenant
+        identity for admission, and the scheduler's worker installs the
+        trace context."""
         ctx = extract_sql_trace_context(query)
         with M_PROTOCOL_QUERY.labels(self.protocol).time():
+            sched = self.scheduler
+            if sched is not None:
+                return sched.submit_session(
+                    query, dbname, timezone,
+                    tenant=user or "default", client=self.protocol,
+                    trace_ctx=ctx)
             with TRACER.trace_context(ctx):
                 return self.db.sql_in_db(query, dbname, timezone)
 

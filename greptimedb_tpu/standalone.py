@@ -398,6 +398,20 @@ class GreptimeDB(TableProvider):
 
         self.processes = ProcessManager()
         self._proc_local = _threading.local()
+        # concurrent serving layer (serving/): protocol servers submit
+        # queries through the scheduler — per-tenant admission, priority
+        # classes, deadline shedding, cross-query stacked dispatch.
+        # GREPTIME_SCHEDULER=off restores the inline path byte-for-byte:
+        # the package is never imported, servers call db.sql directly,
+        # and the warm path carries zero new allocations (pinned in
+        # tests/test_scheduler.py).  Worker threads start lazily on the
+        # first submit, so non-serving embedders pay only this attribute.
+        self.scheduler = None
+        if os.environ.get("GREPTIME_SCHEDULER", "on").lower() not in (
+                "off", "0", "false"):
+            from greptimedb_tpu.serving import QueryScheduler
+
+            self.scheduler = QueryScheduler(self)
         # persistent procedure manager (repartition etc.): one instance so
         # table locks are process-wide; RUNNING journals from a crashed
         # process resume here at startup
@@ -461,6 +475,8 @@ class GreptimeDB(TableProvider):
             freed += b
 
     def close(self) -> None:
+        if self.scheduler is not None:
+            self.scheduler.stop()
         if self.self_monitor is not None:
             self.self_monitor.stop()
         self.regions.close()
@@ -719,6 +735,13 @@ class GreptimeDB(TableProvider):
                 self.slow_query_threshold_ms > 0 or TRACER.enabled
             ):
                 sink = {}
+                # scheduler columns: a worker thread stamps its queue
+                # wait/batch info before calling in, so slow_queries and
+                # the trace both carry where the statement QUEUED, not
+                # just where it ran
+                sched = getattr(self._proc_local, "sched_info", None)
+                if sched:
+                    sink.update(sched)
                 self._proc_local.stage_sink = sink
             engine = "promql" if any(
                 isinstance(s, Tql) for s in stmts) else "sql"
@@ -775,6 +798,10 @@ class GreptimeDB(TableProvider):
                     ColumnSchema("query", ConcreteDataType.STRING),
                     ColumnSchema("stages", ConcreteDataType.STRING),
                     ColumnSchema("trace_id", ConcreteDataType.STRING),
+                    # scheduler columns: queue wait and coalesced batch
+                    # size when the statement came through serving/
+                    ColumnSchema("sched_wait_ms", ConcreteDataType.FLOAT64),
+                    ColumnSchema("sched_batch", ConcreteDataType.FLOAT64),
                 ))
                 info = self.catalog.create_table(db, "slow_queries", schema,
                                                  if_not_exists=True)
@@ -804,6 +831,14 @@ class GreptimeDB(TableProvider):
                     if len(text) > 4096:  # still huge: keep JSON valid
                         text = "{}"
                 row["stages"] = [text]
+            sched = getattr(self._proc_local, "sched_info", None) or {}
+            if not sched and stages:
+                sched = stages  # batch path: sink already carries them
+            if region.schema.has_column("sched_wait_ms"):
+                row["sched_wait_ms"] = [
+                    float(sched.get("sched_wait_ms", 0.0))]
+            if region.schema.has_column("sched_batch"):
+                row["sched_batch"] = [float(sched.get("sched_batch", 0.0))]
             if region.schema.has_column("trace_id"):
                 # the trace id the protocol layer returned to the client
                 # (W3C traceparent / x-greptime-trace-id) — lets an
@@ -830,20 +865,25 @@ class GreptimeDB(TableProvider):
         self.timezone = tz
 
     def sql_in_db(
-        self, query: str, dbname: str, timezone: str | None = None
+        self, query: str, dbname: str, timezone: str | None = None,
+        _stmts: list | None = None,
     ) -> tuple[QueryResult, str, str]:
         """Session-scoped execution for wire-protocol connections: run with
         the connection's database and timezone without leaking either to
         other connections. Returns (result, session db, session tz) —
-        USE / SET time_zone move them."""
+        USE / SET time_zone move them.  ``_stmts`` hands over an already
+        parsed statement list (the scheduler parses at submit for
+        classification/batching) so the wire hot path parses once."""
         # register the ticket BEFORE blocking on the executor lock so a
         # wire statement queued behind a long query is visible in (and
         # killable from) SHOW PROCESSLIST; KILL / SHOW PROCESSLIST
         # short-circuit without the lock entirely
-        try:
-            stmts = parse_sql(query)
-        except Exception:  # noqa: BLE001 — normal path reports the error
-            stmts = None
+        stmts = _stmts
+        if stmts is None:
+            try:
+                stmts = parse_sql(query)
+            except Exception:  # noqa: BLE001 — normal path reports error
+                stmts = None
         ticket = None
         if getattr(self._proc_local, "ticket", None) is None:
             ticket = self.processes.register(query, dbname)
@@ -869,6 +909,65 @@ class GreptimeDB(TableProvider):
             if ticket is not None:
                 self._proc_local.ticket = None
                 self.processes.deregister(ticket)
+
+    def sql_batch(self, entries) -> list[QueryResult] | None:
+        """Scheduler entry for one stacked dispatch over N coalesced
+        Selects: ``entries`` is [(query_text, Select, dbname|None,
+        timezone|None)].  Returns per-entry results (order preserved,
+        bit-exact vs solo) or None when any member falls outside the
+        batchable surface — the scheduler then executes each solo.
+        Statement-level dispatch guards mirror execute_statement's Select
+        branch exactly: system tables, views and derived tables never
+        batch."""
+        import time as _time
+
+        from greptimedb_tpu.meta import information_schema as info
+        from greptimedb_tpu.utils.tracing import TRACER  # noqa: F401
+
+        sels = [s for _q, s, _d, _tz in entries]
+        for s in sels:
+            if (s.table is None or s.from_subquery is not None or s.joins
+                    or info.is_information_schema(s.table)
+                    or info.is_pg_catalog(s.table)
+                    or s.table.lower() == "greptime_private.recycle_bin"):
+                return None
+            try:
+                vdb, vname = self._split_name(s.table)
+                if self.catalog.get_engine(vdb, vname) == "view":
+                    return None
+            except Exception:  # noqa: BLE001 — solo path owns the error
+                return None
+        with self._lock:
+            # session entries were classified against current_db and the
+            # instance timezone OUTSIDE the lock; a concurrent USE / SET
+            # TIME ZONE could have moved either — re-verify under the
+            # lock or fall back to solo session execution (which swaps
+            # the session db/tz per statement)
+            for _q, _s, dbname, tz in entries:
+                if dbname is not None and dbname != self.current_db:
+                    return None
+                if tz is not None and tz != self.timezone:
+                    return None
+            t0 = _time.perf_counter()
+            sink: dict = {}
+            sched = getattr(self._proc_local, "sched_info", None)
+            if sched:
+                sink.update(sched)
+            results = self.engine.execute_select_batch(sels, metrics=sink)
+            elapsed_ms = (_time.perf_counter() - t0) * 1000
+        if results is None:
+            return None
+        for (query, _s, _d, _tz), _res in zip(entries, results):
+            # each member waited for the whole dispatch: observe the
+            # batch wall per member, exactly what its client experienced
+            M_QUERY_DURATION.labels("sql").observe(elapsed_ms / 1000)
+            if (
+                self.slow_query_threshold_ms > 0
+                and elapsed_ms >= self.slow_query_threshold_ms
+                and not self._recording_slow_query
+            ):
+                self._record_slow_query(query, elapsed_ms, stages=sink)
+        return results
 
     def execute_statement(self, stmt: Statement) -> QueryResult:
         from greptimedb_tpu.query.ast import Union as UnionStmt
@@ -1780,8 +1879,15 @@ class GreptimeDB(TableProvider):
             from greptimedb_tpu.utils.tracing import TRACER, render_span_tree
 
             # EXPLAIN ANALYZE (reference DistAnalyzeExec): run the query and
-            # report per-stage wall times + row counts
+            # report per-stage wall times + row counts.  Statements that
+            # arrived through the scheduler carry their queue wait/batch
+            # columns into the analyze lines (sched_wait_ms/sched_batch)
+            # plus a dedicated scheduler row below; direct db.sql keeps
+            # the seed format byte-for-byte.
             metrics: dict = {}
+            sched = getattr(self._proc_local, "sched_info", None)
+            if sched:
+                metrics.update(sched)
             self.engine.execute_select(stmt.inner, metrics=metrics)
             # run once more for warm (compiled) numbers — the first run may
             # include XLA compilation.  With the tracer on, this warm run's
@@ -1795,6 +1901,18 @@ class GreptimeDB(TableProvider):
                 for k in metrics
             ]
             rows.append(["analyze (cold vs warm ms)", "\n".join(lines)])
+            if sched and self.scheduler is not None:
+                st = self.scheduler.stats()
+                rows.append([
+                    "analyze (scheduler)",
+                    f"wait_ms: {sched.get('sched_wait_ms', 0)}\n"
+                    f"batch: {sched.get('sched_batch', 1)}\n"
+                    f"queue_depth: {st['queue_depth']}\n"
+                    f"batches: {st['batches']} "
+                    f"(queries {st['batched_queries']}, "
+                    f"largest {st['largest_batch']})\n"
+                    f"shed: {st['shed']}",
+                ])
             if TRACER.enabled:
                 tree = render_span_tree(TRACER.since(span_mark))
                 if tree:

@@ -99,17 +99,34 @@ _UPLOAD_CHUNK_BYTES = 64 << 20
 _UPLOAD_DEPTH = 2
 
 
+# Scan-pool preemption hook (serving/scheduler.py installs it when the
+# scheduler is enabled): returns True when the CALLING thread is running
+# background-priority work while interactive queries wait — the decode
+# pool then narrows to one thread so a cold scan/compaction pass stops
+# monopolizing cores under interactive load.  None (scheduler off) costs
+# the warm path nothing.
+background_yield_hook = None
+
+
 def scan_threads(num_files: int) -> int:
     """Decode-pool width: ``GREPTIME_SCAN_THREADS`` wins, else
-    ``min(8, files, cores)`` — more threads than files is pure overhead,
-    more than the core count just contends the GIL-held decode segments,
-    and more than 8 saturates memory bandwidth before it saturates
-    cores."""
+    ``min(8, files, cores)`` narrowed to 1 while the serving scheduler
+    reports this thread should yield — more threads than files is pure
+    overhead, more than the core count just contends the GIL-held decode
+    segments, and more than 8 saturates memory bandwidth before it
+    saturates cores."""
     env = os.environ.get("GREPTIME_SCAN_THREADS")
     if env:
         try:
             return max(1, int(env))
         except ValueError:
+            pass
+    hook = background_yield_hook
+    if hook is not None:
+        try:
+            if hook():
+                return 1
+        except Exception:  # noqa: BLE001 — preemption is best-effort
             pass
     return max(1, min(8, num_files, os.cpu_count() or 1))
 

@@ -50,4 +50,9 @@ def pytest_configure(config):
         "chaos: fault-injection tier — node kills under live load with "
         "recovery invariants (fast deterministic cases run in tier-1)")
     config.addinivalue_line(
+        "markers",
+        "concurrency: serving-scheduler tier — multi-client admission/"
+        "batching/priority invariants (fast deterministic cases run in "
+        "tier-1, like the chaos tier)")
+    config.addinivalue_line(
         "markers", "slow: long soak cases excluded from tier-1")

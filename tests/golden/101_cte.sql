@@ -1,0 +1,25 @@
+-- Non-recursive WITH ... AS (CTEs), desugared to derived tables
+-- (reference tests/cases/standalone/common/cte/cte.result).
+CREATE TABLE m (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+
+INSERT INTO m VALUES ('a', 1000, 1.0), ('b', 2000, 2.0), ('c', 3000, 3.0), ('a', 4000, 4.0);
+
+WITH big AS (SELECT h, v FROM m WHERE v > 1.5) SELECT h FROM big ORDER BY h;
+
+-- a CTE referencing an earlier CTE
+WITH big AS (SELECT h, v FROM m WHERE v > 1.5), mid AS (SELECT h FROM big WHERE v < 3.5) SELECT * FROM mid ORDER BY h;
+
+-- aggregation over a CTE
+WITH sums AS (SELECT h, sum(v) AS s FROM m GROUP BY h) SELECT h, s FROM sums ORDER BY h;
+
+-- CTE body may be a set operation
+WITH u AS (SELECT 1 AS a UNION SELECT 2) SELECT a FROM u ORDER BY a;
+
+-- CTE visible inside an IN subquery
+WITH picked AS (SELECT 'a' AS q) SELECT DISTINCT h FROM m WHERE h IN (SELECT q FROM picked);
+
+-- shadowing scoping: forward/self references are NOT in scope
+WITH x AS (SELECT 1) SELECT * FROM not_defined_yet;
+
+-- recursive CTEs are refused, never misparsed
+WITH RECURSIVE r AS (SELECT 1) SELECT * FROM r;

@@ -142,6 +142,7 @@ class TestRegistryStaticCheck:
         import greptimedb_tpu.rpc.frontend  # noqa: F401
         import greptimedb_tpu.servers.http  # noqa: F401
         import greptimedb_tpu.servers.tcp  # noqa: F401
+        import greptimedb_tpu.serving.scheduler  # noqa: F401
         import greptimedb_tpu.standalone  # noqa: F401
         import greptimedb_tpu.storage.cache  # noqa: F401
         import greptimedb_tpu.utils.chaos  # noqa: F401
@@ -153,6 +154,22 @@ class TestRegistryStaticCheck:
             for ln in m.label_names:
                 assert _NAME_RE.match(ln), f"bad label {ln!r} on {name}"
             assert isinstance(m, (Counter, Gauge, Histogram))
+        # the serving scheduler's first-class metric surface must exist
+        # by import (not lazily on first query): /metrics scrapes on an
+        # idle instance still show the queue/batch/admission families
+        for required in (
+            "greptime_scheduler_queue_depth",
+            "greptime_scheduler_wait_seconds",
+            "greptime_scheduler_batch_size",
+            "greptime_scheduler_batches_total",
+            "greptime_scheduler_batched_queries_total",
+            "greptime_scheduler_shed_total",
+            "greptime_scheduler_executed_total",
+            "greptime_scheduler_admitted_total",
+            "greptime_scheduler_rejected_total",
+            "greptime_scheduler_tenant_inflight",
+        ):
+            assert required in REGISTRY._metrics, required
 
     def test_self_export_table_naming(self):
         # the self-import loop (utils/selfmonitor.py) names tables after
@@ -166,6 +183,7 @@ class TestRegistryStaticCheck:
         import greptimedb_tpu.query.physical  # noqa: F401
         import greptimedb_tpu.servers.http  # noqa: F401
         import greptimedb_tpu.servers.tcp  # noqa: F401
+        import greptimedb_tpu.serving.scheduler  # noqa: F401
         import greptimedb_tpu.standalone  # noqa: F401
         import greptimedb_tpu.storage.cache  # noqa: F401
         import greptimedb_tpu.utils.memory  # noqa: F401
