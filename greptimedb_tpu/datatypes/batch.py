@@ -333,6 +333,90 @@ class DeviceBatch:
         return RecordBatch(schema, cols, nulls)
 
 
+class DictColumn:
+    """Dictionary-compressed string column on the ingest wire→device path:
+    a tiny unique-value vocabulary plus per-row int32 codes — the PR 5
+    ``__tagcode_*__`` trick in reverse, applied at wire-parse time.
+
+    The vectorized protocol parsers (servers/protocols.py) emit tag columns
+    in this form so no per-row Python string object is materialized between
+    the wire bytes and the region write; ``Region._encode_tags`` consumes
+    the (codes, values) pair directly as a pre-factorized column.  Supports
+    just enough of the ndarray surface (len/getitem/take) for routing and
+    schema probing; ``materialize()`` produces the object array (a C-level
+    fancy-index over the shared vocabulary objects) for consumers that
+    need raw values."""
+
+    __slots__ = ("values", "codes")
+
+    def __init__(self, values: np.ndarray, codes: np.ndarray):
+        # values: object array of unique strings; codes: int32 per row
+        self.values = np.asarray(values, dtype=object)
+        self.codes = np.asarray(codes, dtype=np.int32)
+
+    def __len__(self) -> int:
+        return len(self.codes)
+
+    def __getitem__(self, i):
+        if isinstance(i, (int, np.integer)):
+            return self.values[self.codes[i]]
+        return DictColumn(self.values, self.codes[i])
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def __eq__(self, other):
+        if isinstance(other, str):
+            # vectorized filter: one vocabulary probe, codes compare at C
+            # speed (no per-row string comparison)
+            hit = np.nonzero(self.values == other)[0]
+            if len(hit) == 0:
+                return np.zeros(len(self.codes), dtype=bool)
+            return self.codes == hit[0]
+        if isinstance(other, (list, tuple)):
+            return self.materialize().tolist() == list(other)
+        if isinstance(other, DictColumn):
+            other = other.materialize()
+        if isinstance(other, np.ndarray):
+            return self.materialize() == other
+        return NotImplemented
+
+    __hash__ = None  # mutable-ish container semantics, like ndarray
+
+    @staticmethod
+    def from_arrow(col) -> "DictColumn | None":
+        """Arrow string/dictionary Array → DictColumn, or None when the
+        column needs the object path instead: nulls among the rows, OR a
+        null vocabulary entry (which hides from ``col.null_count`` but
+        would smuggle None through the coded path).  The one conversion
+        every columnar ingest surface (arrow bulk, Flight do_put) shares.
+        """
+        if col.null_count:
+            return None
+        if pa.types.is_dictionary(col.type):
+            if col.dictionary.null_count:
+                return None
+            return DictColumn(
+                np.asarray(col.dictionary.to_pylist(), dtype=object),
+                col.indices.to_numpy(zero_copy_only=False),
+            )
+        # C-level dictionary encode: the vocabulary is the only object
+        # array (tag columns repeat heavily)
+        d = col.dictionary_encode()
+        return DictColumn(
+            np.asarray(d.dictionary.to_pylist(), dtype=object),
+            d.indices.to_numpy(zero_copy_only=False),
+        )
+
+    def take(self, indices: np.ndarray) -> "DictColumn":
+        return DictColumn(self.values, self.codes[indices])
+
+    def materialize(self) -> np.ndarray:
+        """Per-row object array; rows share the vocabulary's string
+        objects (refcount bumps at C speed, no new PyObjects)."""
+        return self.values[self.codes]
+
+
 class DictionaryEncoder:
     """Stable string→int32 dictionary (the metric-engine ``__tsid`` idea,
     reference src/metric-engine/src/row_modifier.rs: label values become

@@ -135,10 +135,18 @@ class FlowEngine:
     _KV_PREFIX = "__flow/"
 
     def __init__(self, db, restore: bool = True):
+        import threading
+
         # restore=False: sharded flownodes (flow/cluster.py) register
         # only the flows their routes assign, not the whole key-space
         self.db = db
         self.flows: dict[str, FlowTask] = {}
+        # serializes incremental-state mutation: HTTP ingest-pool workers
+        # (servers/http.py) and the SQL path on the db-executor both call
+        # on_write/run_all — two threads folding the same flow's deltas
+        # concurrently would lose or double-apply them.  Reentrant so
+        # run_all → run_flow nests.
+        self._fold_lock = threading.RLock()
         if restore:
             self._restore()
 
@@ -217,17 +225,18 @@ class FlowEngine:
         batch was a pure append; upserts (``appendable=False``) would
         double-count in incremental state, so they force a state reseed.
         Batching flows (or ts-only callers) mark dirty windows."""
-        for task in self.flows.values():
-            if task.source_table.split(".")[-1] != table.split(".")[-1]:
-                continue
-            if task.mode == "streaming" and not appendable:
-                task.needs_backfill = True
-            if task.mode == "streaming" and data is not None and not (
-                task.needs_backfill
-            ):
-                self._stream_ingest(task, data)
-            else:
-                task.mark_dirty(ts_values)
+        with self._fold_lock:
+            for task in list(self.flows.values()):
+                if task.source_table.split(".")[-1] != table.split(".")[-1]:
+                    continue
+                if task.mode == "streaming" and not appendable:
+                    task.needs_backfill = True
+                if task.mode == "streaming" and data is not None and not (
+                    task.needs_backfill
+                ):
+                    self._stream_ingest(task, data)
+                else:
+                    task.mark_dirty(ts_values)
 
     # ---- streaming engine ---------------------------------------------
     def _time_key_pos(self, task: FlowTask) -> int | None:
@@ -455,6 +464,11 @@ class FlowEngine:
         Streaming tasks only reach here for (re)seeding: registration,
         restart, or a ts-only ingest notification (no columns to consume)
         — all handled by a full state backfill."""
+        with self._fold_lock:
+            return self._run_flow_locked(task, now_ms)
+
+    def _run_flow_locked(self, task: FlowTask,
+                         now_ms: int | None = None) -> int:
         if task.mode == "streaming":
             if task.needs_backfill or task.dirty:
                 task.dirty.clear()
@@ -521,7 +535,8 @@ class FlowEngine:
         return written
 
     def run_all(self) -> int:
-        return sum(self.run_flow(t) for t in self.flows.values())
+        with self._fold_lock:
+            return sum(self.run_flow(t) for t in list(self.flows.values()))
 
 
 def handle_flow_statement(db, stmt):

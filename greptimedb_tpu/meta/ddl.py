@@ -223,12 +223,16 @@ class AlterTableProcedure(Procedure):
                         region = db.regions.open_region(rid)
                     except RegionNotFound:
                         continue  # file-engine/virtual: no LSM region
-                region.flush()
-                region.schema = new_schema
-                region.manifest.commit(
-                    {"kind": "schema", "schema": new_schema.to_dict()}
-                )
-                region.memtable.schema = new_schema
+                # under the region's (reentrant) write lock: concurrent
+                # ingest-pool writers must not observe a half-applied
+                # flush/schema swap
+                with region._write_lock:
+                    region.flush()
+                    region.schema = new_schema
+                    region.manifest.commit(
+                        {"kind": "schema", "schema": new_schema.to_dict()}
+                    )
+                    region.memtable.schema = new_schema
                 db.cache.invalidate_region(region.region_id)
             view = db._views.pop(f"{st['db']}.{st['name']}", None)
             if view is not None:

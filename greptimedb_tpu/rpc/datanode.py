@@ -157,12 +157,23 @@ class DatanodeFlightServer(fl.FlightServerBase):
         rid = cmd["region_id"]
         if not self.managed and self.datanode.roles.get(rid) == "leader":
             self.datanode.lease_until_ms[rid] = _now_ms() + REGION_LEASE_MS
+        from greptimedb_tpu.datatypes.batch import DictColumn
+
         table = reader.read_all()
         data: dict[str, np.ndarray] = {}
         for name in table.column_names:
-            col = table.column(name)
-            if pa.types.is_string(col.type) or pa.types.is_large_string(col.type):
-                data[name] = np.asarray(col.to_pylist(), dtype=object)
+            col = table.column(name).combine_chunks()
+            if (pa.types.is_dictionary(col.type)
+                    or pa.types.is_string(col.type)
+                    or pa.types.is_large_string(col.type)):
+                # dictionary-coded on the wire (vectorized bulk insert)
+                # passes straight through as codes + vocabulary; plain
+                # strings dictionary-encode at C level.  None = nulls
+                # anywhere (rows OR vocabulary): the object path keeps
+                # None alive as NULL
+                dc = DictColumn.from_arrow(col)
+                data[name] = (dc if dc is not None
+                              else np.asarray(col.to_pylist(), dtype=object))
             else:
                 data[name] = col.to_numpy(zero_copy_only=False)
         self.datanode.write(rid, data, _now_ms())

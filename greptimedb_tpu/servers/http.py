@@ -48,6 +48,10 @@ M_LATENCY = telemetry.REGISTRY.histogram(
 M_INGEST_ROWS = telemetry.REGISTRY.counter(
     "greptime_ingest_rows_total", "Rows ingested", ("protocol",)
 )
+M_INGEST_BYTES = telemetry.REGISTRY.counter(
+    "greptime_ingest_bytes_total", "Wire bytes ingested (pre-decode)",
+    ("protocol",)
+)
 # Per-protocol query latency (reference METRIC_HTTP_SQL_ELAPSED et al):
 # one histogram shared by every wire surface — http SQL, the Prometheus
 # API emulation, MySQL and PostgreSQL register their own labels on it.
@@ -205,10 +209,21 @@ class HttpServer(ThreadedAiohttpApp):
         # scheduler.submit instead of executing here — a wider pool lets
         # concurrent clients queue into the scheduler (where priorities,
         # quotas and batching decide order) rather than serialize in
-        # front of it.  Ingest protocol handlers stay on the single
-        # db-executor worker.  Created lazily: scheduler-off servers
-        # never allocate it.
+        # front of it.  Created lazily: scheduler-off servers never
+        # allocate it.
         self._submit_pool: ThreadPoolExecutor | None = None
+        # metric-ingest handlers get their own small pool: region writes
+        # serialize per REGION (Region._write_lock), so concurrent
+        # batches for different tables/regions decode+append in parallel
+        # instead of queueing behind one db-executor thread.  Width 1
+        # (GREPTIME_INGEST_WORKERS=1) restores the strictly serialized
+        # seed behavior.
+        import os as _os
+
+        self._ingest_pool = ThreadPoolExecutor(
+            max_workers=max(1, int(_os.environ.get(
+                "GREPTIME_INGEST_WORKERS", "4"))),
+            thread_name_prefix="greptime-ingest")
 
     # ------------------------------------------------------------------
     def build_app(self) -> web.Application:
@@ -245,6 +260,7 @@ class HttpServer(ThreadedAiohttpApp):
         r.add_post("/v1/prometheus/read", self.h_remote_read)
         r.add_post("/v1/influxdb/api/v2/write", self.h_influx_write)
         r.add_post("/v1/influxdb/write", self.h_influx_write)
+        r.add_post("/v1/arrow/write", self.h_arrow_write)
         r.add_post("/v1/otlp/v1/metrics", self.h_otlp_metrics)
         r.add_post("/v1/otlp/v1/logs", self.h_otlp_logs)
         r.add_post("/v1/otel-arrow/v1/metrics", self.h_otel_arrow_metrics)
@@ -283,6 +299,30 @@ class HttpServer(ThreadedAiohttpApp):
         return await asyncio.get_running_loop().run_in_executor(
             self._db_executor, fn, *args
         )
+
+    async def _call_ingest(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._ingest_pool, fn, *args
+        )
+
+    def _admit_ingest(self, request: web.Request, wire_bytes: int):
+        """Per-tenant write admission (PR 7 discipline, applied to the
+        write path): reserve the batch's estimated decoded footprint
+        against the tenant's memory budget and count it in flight, so
+        sustained ingest cannot starve interactive queries of their
+        memory/concurrency quotas.  Returns a release callable (pair it
+        in a finally); raises RateLimited (429) / ResourcesExhausted
+        (503) — the same error surface queries get."""
+        sched = self.db.scheduler
+        if sched is None:
+            return lambda: None
+        adm = sched.admission
+        tenant = self._tenant(request)
+        # decoded columnar batches run ~4x the wire bytes (numbers widen
+        # to float64/int64, tag codes add int32 per row)
+        est = wire_bytes * 4
+        adm.admit(tenant, est)
+        return lambda: adm.release(tenant, est)
 
     async def _call_query(self, fn, *args):
         """Query-path executor hop: the scheduler-submit pool when the
@@ -530,24 +570,45 @@ class HttpServer(ThreadedAiohttpApp):
                 # Prometheus metrics multiplex onto the metric engine's
                 # physical region (reference default for remote write);
                 # names already taken by plain tables fall back to them so
-                # one conflicting metric can't wedge the whole batch
+                # one conflicting metric can't wedge the whole batch.
+                # The DDL lock serializes ONLY logical-table/label-set
+                # growth across the ingest pool — the append itself runs
+                # outside it (the shared physical region's own write lock
+                # serializes appends), so one batch's WAL flush never
+                # stalls unrelated tables' ingest on the DDL lock.
                 name = _safe_table(table)
                 try:
-                    total += self.db.metric_engine.write(name, cols)
+                    with _INGEST_DDL_LOCK:
+                        self.db.metric_engine.ensure_logical(
+                            name, list(cols.get("__tags__") or []))
+                    total += self.db.metric_engine.write(name, cols,
+                                                         ensure=False)
                 except InvalidArguments:
                     total += _ingest_columns(self.db, name, cols)
+            cache = getattr(self.db, "cache", None)
+            if tables and cache is not None:
+                # hot-tail: freshly acked samples scatter into the
+                # physical region's resident grid tail (if any)
+                cache.extend_hot_tail(self.db.metric_engine.physical_region())
             if self.db.flow_engine.flows:
-                for table, cols in tables.items():
-                    # metric-engine writes multiplex regions; conservative
-                    # appendable=False is handled upstream via dirtying,
-                    # so pass the chunk and let pure appends stream
-                    self.db.flow_engine.on_write(_safe_table(table),
-                                                 cols["ts"], data=cols)
-                self.db.flow_engine.run_all()
+                with _INGEST_DDL_LOCK:
+                    for table, cols in tables.items():
+                        # metric-engine writes multiplex regions;
+                        # conservative appendable=False is handled upstream
+                        # via dirtying, so pass the chunk and let pure
+                        # appends stream
+                        self.db.flow_engine.on_write(_safe_table(table),
+                                                     cols["ts"], data=cols)
+                    self.db.flow_engine.run_all()
             return total
 
+        M_INGEST_BYTES.labels("prom_remote_write").inc(len(body))
         try:
-            n = await self._call(run)
+            release = self._admit_ingest(request, len(body))
+            try:
+                n = await self._call_ingest(run)
+            finally:
+                release()
             M_INGEST_ROWS.labels("prom_remote_write").inc(n)
             return web.Response(status=204)
         except Exception as e:  # noqa: BLE001
@@ -557,8 +618,11 @@ class HttpServer(ThreadedAiohttpApp):
     async def h_influx_write(self, request: web.Request) -> web.Response:
         from greptimedb_tpu.servers.protocols import parse_line_protocol
 
-        body = (await request.read()).decode("utf-8")
+        # raw bytes: the vectorized parser consumes them directly (one
+        # C-level transform + pyarrow CSV); the legacy path decodes
+        body = await request.read()
         precision = request.query.get("precision", "ns")
+        M_INGEST_BYTES.labels("influxdb").inc(len(body))
 
         def run():
             tables = parse_line_protocol(body, precision)
@@ -568,9 +632,44 @@ class HttpServer(ThreadedAiohttpApp):
             return total
 
         try:
-            n = await self._call(run)
+            release = self._admit_ingest(request, len(body))
+            try:
+                n = await self._call_ingest(run)
+            finally:
+                release()
             M_INGEST_ROWS.labels("influxdb").inc(n)
             return web.Response(status=204)
+        except Exception as e:  # noqa: BLE001
+            body_json, status = _error_json(e)
+            return web.json_response(body_json, status=status)
+
+    async def h_arrow_write(self, request: web.Request) -> web.Response:
+        """Arrow IPC bulk insert — the standalone HTTP surface of the
+        in-cluster Flight do_put plane (reference gRPC bulk inserts).
+        Body: one Arrow IPC stream; ``?table=`` names the target.  The
+        highest-rate wire format: columns land as NumPy arrays /
+        dictionary codes with zero per-row decode (protocols.py
+        ``parse_arrow_bulk``)."""
+        from greptimedb_tpu.servers.protocols import parse_arrow_bulk
+
+        table = request.query.get("table", "")
+        body = await request.read()
+        M_INGEST_BYTES.labels("arrow").inc(len(body))
+
+        def run():
+            if not table:
+                raise InvalidArguments("arrow write needs ?table=")
+            cols = parse_arrow_bulk(body)
+            return _ingest_columns(self.db, table, cols)
+
+        try:
+            release = self._admit_ingest(request, len(body))
+            try:
+                n = await self._call_ingest(run)
+            finally:
+                release()
+            M_INGEST_ROWS.labels("arrow").inc(n)
+            return web.json_response({"rows": n})
         except Exception as e:  # noqa: BLE001
             body_json, status = _error_json(e)
             return web.json_response(body_json, status=status)
@@ -591,8 +690,13 @@ class HttpServer(ThreadedAiohttpApp):
                 total += _ingest_columns(self.db, table, cols)
             return total
 
+        M_INGEST_BYTES.labels("otlp_metrics").inc(len(body))
         try:
-            n = await self._call(run)
+            release = self._admit_ingest(request, len(body))
+            try:
+                n = await self._call_ingest(run)
+            finally:
+                release()
             M_INGEST_ROWS.labels("otlp_metrics").inc(n)
             return web.json_response({"partialSuccess": {}})
         except Exception as e:  # noqa: BLE001
@@ -1450,95 +1554,159 @@ def _safe_table(name: str) -> str:
     return out or "es_logs"
 
 
+# serializes catalog/schema mutation (table auto-create, alter-on-demand,
+# flow notification) across the ingest pool's workers — region WRITES run
+# outside it under their own per-region locks, so the common steady-state
+# path (schema already in place) takes this only for two dict probes
+_INGEST_DDL_LOCK = threading.RLock()
+
+
+def _ingest_field_type(values):
+    """Field column → ConcreteDataType; dtype-dispatch for the vectorized
+    (ndarray/DictColumn) columns, first-non-null scan for legacy lists."""
+    from greptimedb_tpu.datatypes.batch import DictColumn
+    from greptimedb_tpu.datatypes.types import ConcreteDataType
+
+    if isinstance(values, DictColumn):
+        return ConcreteDataType.STRING
+    if isinstance(values, np.ndarray) and values.dtype != object:
+        if values.dtype == np.bool_:
+            return ConcreteDataType.BOOL
+        if np.issubdtype(values.dtype, np.integer):
+            return ConcreteDataType.INT64
+        if np.issubdtype(values.dtype, np.floating):
+            return ConcreteDataType.FLOAT64
+    for v in values:
+        if isinstance(v, (bool, np.bool_)):
+            return ConcreteDataType.BOOL
+        if isinstance(v, str):
+            return ConcreteDataType.STRING
+        if isinstance(v, (float, np.floating)):
+            return ConcreteDataType.FLOAT64
+        if isinstance(v, (int, np.integer)):
+            return ConcreteDataType.INT64
+    return ConcreteDataType.FLOAT64
+
+
 def _ingest_columns(db, table: str, cols: dict,
                     append_mode: bool = False) -> int:
     """Auto-creating ingest (reference Inserter auto table creation,
     src/operator/src/insert.rs:178-304): create the table from the first
     batch's shape, add columns on demand, then write.  ``append_mode``
     creates log/trace-style tables that keep EVERY row (no (series, ts)
-    dedup — reference CREATE TABLE WITH (append_mode='true'))."""
+    dedup — reference CREATE TABLE WITH (append_mode='true')).
+
+    Columns may be legacy Python lists or vectorized ndarray/DictColumn
+    batches; the write path never materializes per-row objects for the
+    latter (partition routing slices by index at C level).  Safe for
+    concurrent callers: schema setup serializes on ``_INGEST_DDL_LOCK``,
+    row appends on each region's own write lock."""
+    from greptimedb_tpu.datatypes.batch import DictColumn
     from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
     from greptimedb_tpu.datatypes.types import ConcreteDataType, SemanticType
     from greptimedb_tpu.query.ast import AlterTable, ColumnDef
 
     tag_names = cols.pop("__tags__", [])
     field_names = cols.pop("__fields__", [])
+    # raw wire bytes usable as the WAL payload verbatim (arrow bulk);
+    # only valid when the whole batch lands in ONE region intact
+    wire_ipc = cols.pop("__wire_ipc__", None)
     n = len(cols["ts"])
-
-    def field_type(values) -> ConcreteDataType:
-        for v in values:
-            if isinstance(v, bool):
-                return ConcreteDataType.BOOL
-            if isinstance(v, str):
-                return ConcreteDataType.STRING
-            if isinstance(v, float):
-                return ConcreteDataType.FLOAT64
-            if isinstance(v, int):
-                return ConcreteDataType.INT64
-        return ConcreteDataType.FLOAT64
+    field_type = _ingest_field_type
 
     dbname, name = db._split_name(table)
-    if not db.catalog.table_exists(dbname, name):
-        defs = [ColumnSchema(t, ConcreteDataType.STRING, SemanticType.TAG)
-                for t in tag_names]
-        defs.append(ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND,
-                                 SemanticType.TIMESTAMP, nullable=False))
-        defs += [ColumnSchema(f, field_type(cols[f]), SemanticType.FIELD)
-                 for f in field_names]
-        info = db.catalog.create_table(
-            dbname, name, Schema(tuple(defs)),
-            options={"append_mode": "true"} if append_mode else None,
-            if_not_exists=True)
-        if info is not None:
-            opts = None
-            if append_mode:
-                import dataclasses as _dc
+    with _INGEST_DDL_LOCK:
+        if not db.catalog.table_exists(dbname, name):
+            defs = [ColumnSchema(t, ConcreteDataType.STRING, SemanticType.TAG)
+                    for t in tag_names]
+            defs.append(ColumnSchema(
+                "ts", ConcreteDataType.TIMESTAMP_MILLISECOND,
+                SemanticType.TIMESTAMP, nullable=False))
+            defs += [ColumnSchema(f, field_type(cols[f]), SemanticType.FIELD)
+                     for f in field_names]
+            info = db.catalog.create_table(
+                dbname, name, Schema(tuple(defs)),
+                options={"append_mode": "true"} if append_mode else None,
+                if_not_exists=True)
+            if info is not None:
+                opts = None
+                if append_mode:
+                    import dataclasses as _dc
 
-                opts = _dc.replace(db.regions.default_options,
-                                   append_mode=True)
-            db.regions.create_region(info.region_ids[0], info.schema,
-                                     options=opts)
-    else:
-        info = db.catalog.get_table(dbname, name)
-        missing_tags = [t for t in tag_names if not info.schema.has_column(t)]
-        if missing_tags:
-            # online tag addition (reference alter-on-demand,
-            # src/operator/src/insert.rs): existing series extend their
-            # key with the empty-string label — same machinery as the
-            # metric engine's label growth
-            tag_regions = db._regions_of(f"{dbname}.{name}")
-            for region in tag_regions:
-                for t in missing_tags:
-                    region.add_tag_column(t)
-            info.schema = tag_regions[0].schema
-            db.catalog.update_table(info)
-        for f in field_names:
-            if not info.schema.has_column(f):
-                db.execute_statement(AlterTable(
-                    f"{dbname}.{name}", "add_column",
-                    column=ColumnDef(f, field_type(cols[f]).value),
-                ))
-                info = db.catalog.get_table(dbname, name)
-    regions = db._regions_of(f"{dbname}.{name}")
+                    opts = _dc.replace(db.regions.default_options,
+                                       append_mode=True)
+                db.regions.create_region(info.region_ids[0], info.schema,
+                                         options=opts)
+        else:
+            info = db.catalog.get_table(dbname, name)
+            missing_tags = [t for t in tag_names
+                            if not info.schema.has_column(t)]
+            if missing_tags:
+                # online tag addition (reference alter-on-demand,
+                # src/operator/src/insert.rs): existing series extend their
+                # key with the empty-string label — same machinery as the
+                # metric engine's label growth
+                tag_regions = db._regions_of(f"{dbname}.{name}")
+                for region in tag_regions:
+                    for t in missing_tags:
+                        region.add_tag_column(t)
+                info.schema = tag_regions[0].schema
+                db.catalog.update_table(info)
+            for f in field_names:
+                if not info.schema.has_column(f):
+                    db.execute_statement(AlterTable(
+                        f"{dbname}.{name}", "add_column",
+                        column=ColumnDef(f, field_type(cols[f]).value),
+                    ))
+                    info = db.catalog.get_table(dbname, name)
+        regions = db._regions_of(f"{dbname}.{name}")
     if len(regions) == 1:
-        regions[0].write(cols)
+        regions[0].write(cols, wire_payload=wire_ipc)
     else:
-        # partition routing (same as SQL INSERT; skipping it would dump all
-        # rows into region 0 and break cross-region dedup/DELETE)
-        import numpy as np
-
+        # partition routing, ONCE per batch (same as SQL INSERT; skipping
+        # it would dump all rows into region 0 and break cross-region
+        # dedup/DELETE): evaluate the rule over materialized key columns,
+        # then slice every column per target region by index — fancy
+        # indexing / DictColumn.take, no per-row Python loop
         from greptimedb_tpu.parallel.partition import split_rows
 
-        cols_np = {c: np.asarray(v, dtype=object) for c, v in cols.items()}
-        parts = split_rows(db._partition_rule(f"{dbname}.{name}"), cols_np, n)
+        rule = db._partition_rule(f"{dbname}.{name}")
+        # the rule only reads its key columns — materializing every
+        # column to per-row objects here would undo the vectorized
+        # parse's zero-object discipline on exactly the sharded path
+        # (split_rows boxes the key columns itself)
+        cols_np = {
+            c: (cols[c].materialize() if isinstance(cols[c], DictColumn)
+                else cols[c])
+            for c in (rule.columns or list(cols))
+            if c in cols
+        }
+        parts = split_rows(rule, cols_np, n)
         for pidx, row_idx in parts.items():
-            sub = {c: [cols[c][i] for i in row_idx] for c in cols}
+            idx = np.asarray(row_idx, dtype=np.int64)
+            sub = {}
+            for c, v in cols.items():
+                if isinstance(v, DictColumn):
+                    sub[c] = v.take(idx)
+                elif isinstance(v, np.ndarray):
+                    sub[c] = v[idx]
+                else:
+                    sub[c] = [v[i] for i in row_idx]
             regions[pidx].write(sub)
+    # hot-tail grid catch-up: freshly acked rows scatter into the
+    # resident grid's not-yet-covered tail right now (when one is
+    # resident and the delta is worth a dispatch) — the next query sees
+    # them without any flush/rebuild
+    cache = getattr(db, "cache", None)
+    if cache is not None:
+        for region in regions:
+            cache.extend_hot_tail(region)
     if db.flow_engine.flows:
-        appendable = all(
-            getattr(r, "last_write_appendable", True) for r in regions
-        )
-        db.flow_engine.on_write(name, cols["ts"], data=cols,
-                                appendable=appendable)
-        db.flow_engine.run_all()
+        with _INGEST_DDL_LOCK:
+            appendable = all(
+                getattr(r, "last_write_appendable", True) for r in regions
+            )
+            db.flow_engine.on_write(name, cols["ts"], data=cols,
+                                    appendable=appendable)
+            db.flow_engine.run_all()
     return n

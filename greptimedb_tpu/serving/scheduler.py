@@ -185,9 +185,11 @@ class QueryScheduler:
         if batching is None:
             batching = env("GREPTIME_SCHEDULER_BATCH", "on") != "off"
         self.batching = batching
-        # group-commit linger: under saturation (more clients in flight
-        # than claimed) a worker waits up to this long for coalescible
-        # arrivals before dispatching.  A lone client never lingers.
+        # group-commit linger CEILING: under saturation (more clients in
+        # flight than claimed) a worker waits for coalescible arrivals
+        # before dispatching.  The effective wait is adaptive — scaled by
+        # observed same-class pressure (_effective_linger_s), so stacking
+        # engages as saturation deepens and a lone client never lingers.
         self.linger_ms = float(env("GREPTIME_SCHEDULER_LINGER_MS", "5"))
         self.admission = TenantAdmission(
             memory=getattr(db, "memory", None),
@@ -426,6 +428,22 @@ class QueryScheduler:
             q[:] = keep
         return group
 
+    def _effective_linger_s(self, priority: str, group_len: int) -> float:
+        """Adaptive linger (called under self._cond): scale the
+        configured ceiling by observed same-class pressure.  ``pending``
+        counts submitted-but-unclaimed sql/session queries beyond this
+        group — zero pending (the idle path) lingers 0 ms, full linger
+        only engages once a max_batch's worth of joinable work is in
+        flight.  Depth, not a constant, decides the wait: light contention
+        pays a fraction of the ceiling, saturation the whole of it."""
+        if self.linger_ms <= 0:
+            return 0.0
+        pending = self._sqlish_inflight[priority] - group_len
+        if pending <= 0:
+            return 0.0
+        return (self.linger_ms / 1000.0) * min(
+            1.0, pending / max(1, self.max_batch))
+
     def _worker_loop(self) -> None:
         while True:
             with self._cond:
@@ -439,10 +457,12 @@ class QueryScheduler:
                 group = [e]
                 if self.batching and e.kind in ("sql", "session"):
                     group = self._claim_batch(e)
+                    linger_s = self._effective_linger_s(
+                        e.priority, len(group))
                     if (e.compute_batch_key(
                             self.db.current_db, self.db.timezone) is not None
-                            and self.linger_ms > 0):
-                        stop_at = time.monotonic() + self.linger_ms / 1000
+                            and linger_s > 0):
+                        stop_at = time.monotonic() + linger_s
                         # linger only while MORE same-priority sql/session
                         # entries are in flight than this group holds — a
                         # lone client, fn-kind work (PromQL) or another

@@ -16,6 +16,7 @@ JAX free HBM.
 from __future__ import annotations
 
 import collections
+import threading
 import weakref
 from dataclasses import dataclass, field
 
@@ -357,11 +358,32 @@ def extend_device_table(
     )
 
 
+def _append_pos(region) -> "int | None":
+    """The region's absolute append-log position (Region.append_pos);
+    falls back to the raw list length for duck-typed region-likes that
+    predate position trimming."""
+    pos = getattr(region, "append_pos", None)
+    if pos is not None:
+        return pos
+    log = getattr(region, "_append_log", None)
+    return len(log) if log is not None else None
+
+
+def _chunks_since(region, pos: int) -> "list | None":
+    """Append-log chunks after absolute position ``pos``; None when the
+    position predates the region's trimmed window (consumer must rebuild)."""
+    f = getattr(region, "append_chunks_since", None)
+    if f is not None:
+        return f(pos)
+    log = getattr(region, "_append_log", None)
+    return log[pos:] if log is not None else None
+
+
 @dataclass
 class _Entry:
     # DeviceTable, GridTable, or None (negative grid-eligibility cache)
     table: object
-    delta_pos: int | None = None  # consumed append-log position
+    delta_pos: int | None = None  # consumed append-log position (absolute)
     live_rows: int = 0
     # grid catch-up validity keys (see get_grid): the SST set the table
     # was built from and the region's content-mutation epoch at build time
@@ -406,6 +428,19 @@ class RegionCacheManager:
             collections.OrderedDict()
         )
         self._bytes = 0
+        # guards _lru/_bytes: scheduler workers (get/get_grid) and
+        # ingest-pool workers (extend_hot_tail, auto-create paths) mutate
+        # them concurrently — an unguarded OrderedDict iteration would
+        # raise "mutated during iteration" mid-query and unguarded
+        # read-modify-writes of _bytes drift the accounting _shrink
+        # evicts by.  Reentrant: _evict/_shrink run nested under it.
+        # Device builds/extends run OUTSIDE it — only dict/counter ops
+        # are held.
+        self._struct_lock = threading.RLock()
+        # serializes ingest-side hot-tail extenders (the ingest pool runs
+        # several writers); acquired non-blocking — a contended extend is
+        # skipped, the query-time path stays responsible
+        self._hot_tail_lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.extends = 0
@@ -432,55 +467,92 @@ class RegionCacheManager:
             ts_range,
             tuple(columns) if columns else None,
         )
+        pos = _append_pos(region) if incremental else None
         entry = self._lru.get(key)
         if entry is not None:
-            if not incremental or entry.delta_pos == len(append_log):
+            if not incremental or entry.delta_pos == pos:
                 self.hits += 1
                 M_CACHE_EVENTS.labels("region_device", "table", "hit").inc()
-                self._lru.move_to_end(key)
+                with self._struct_lock:
+                    if key in self._lru:
+                        self._lru.move_to_end(key)
                 return entry.table
             # resident base is current; new append-log chunks extend it
-            chunks = append_log[entry.delta_pos:]
-            delta_rows = sum(len(c[TSID]) for c in chunks)
-            if delta_rows <= max(
+            chunks = _chunks_since(region, entry.delta_pos)
+            delta_rows = (sum(len(c[TSID]) for c in chunks)
+                          if chunks is not None else None)
+            if delta_rows is not None and delta_rows <= max(
                 self.min_extend_rows,
                 entry.live_rows * self.rebuild_fraction,
             ):
                 self.extends += 1
                 M_CACHE_EVENTS.labels(
                     "region_device", "table", "extend").inc()
-                self._bytes -= entry.table.nbytes()
-                entry.table, entry.live_rows = extend_device_table(
+                # whole-entry swap (not field mutation): a concurrent
+                # reader holds a self-consistent entry either way
+                new_table, new_rows = extend_device_table(
                     entry.table, region, chunks, entry.live_rows
                 )
-                entry.delta_pos = len(append_log)
-                self._bytes += entry.table.nbytes()
-                self._lru.move_to_end(key)
+                with self._struct_lock:
+                    if self._lru.get(key) is entry:
+                        # bytes delta only when the swap applies — an
+                        # entry replaced/evicted meanwhile keeps its own
+                        # accounting (an unconditional += would drift
+                        # _bytes upward and make _shrink evict live
+                        # entries forever after).  delta_pos derives from
+                        # the chunks actually applied, NOT a pos read
+                        # earlier: a chunk landing between the pos read
+                        # and the fetch would be in the table yet
+                        # recorded unconsumed, and the next extend would
+                        # append its rows a second time.
+                        self._bytes += (new_table.nbytes()
+                                        - entry.table.nbytes())
+                        self._lru[key] = _Entry(
+                            new_table,
+                            delta_pos=entry.delta_pos + len(chunks),
+                            live_rows=new_rows,
+                            sst_ids=entry.sst_ids,
+                            mutation_epoch=entry.mutation_epoch,
+                        )
+                        self._lru.move_to_end(key)
                 self._shrink()
-                return entry.table
-            self._evict(key)  # too much drift: rebuild below
+                return new_table
+            self._evict(key)  # too much drift (or trimmed past): rebuild
 
         self.misses += 1
         M_CACHE_EVENTS.labels("region_device", "table", "miss").inc()
         table = build_device_table(region, ts_range, columns)
+        if incremental and _append_pos(region) != pos:
+            # a chunk landed while building: the table may contain rows
+            # past ``pos`` (the build reads the live memtable), so caching
+            # it at pos would double-apply them on the next extend, and
+            # recording the newer pos could silently drop rows the build
+            # raced past.  Serve the (self-consistent) table uncached —
+            # the next quiet query populates the entry; under sustained
+            # ingest extend_hot_tail keeps the grid entries fresh instead.
+            return table
         entry = _Entry(
             table,
-            delta_pos=len(append_log) if incremental else None,
+            delta_pos=pos,
             live_rows=int(np.asarray(table.row_mask).sum()),
         )
-        # drop stale versions of the same region+range; versions live in
-        # two namespaces (base_version for incremental full-table entries,
-        # generation for restricted scans), so only compare within the
-        # same (range, columns) class
-        stale = [
-            k for k in self._lru
-            if k[0] == key[0] and k[2:] == key[2:] and k[1] != key[1]
-        ]
-        for k in stale:
-            self._evict(k)
-        self._lru[key] = entry
-        self._bytes += table.nbytes()
-        self._shrink()
+        with self._struct_lock:
+            # drop stale versions of the same region+range; versions live
+            # in two namespaces (base_version for incremental full-table
+            # entries, generation for restricted scans), so only compare
+            # within the same (range, columns) class
+            stale = [
+                k for k in self._lru
+                if k[0] == key[0] and k[2:] == key[2:] and k[1] != key[1]
+            ]
+            for k in stale:
+                self._evict(k)
+            old = self._lru.get(key)
+            if old is not None and old.table is not None:
+                self._bytes -= old.table.nbytes()  # concurrent double-build
+            self._lru[key] = entry
+            self._bytes += table.nbytes()
+            self._shrink()
         return table
 
     def get_grid(self, region):
@@ -499,39 +571,53 @@ class RegionCacheManager:
         if base_ver is None or append_log is None:
             return None  # duck-typed views (joins, staged scans): row path
         key = (region.region_id, "grid", base_ver)
+        pos = _append_pos(region)
         entry = self._lru.get(key)
         if entry is not None:
-            if entry.delta_pos == len(append_log):
+            if entry.delta_pos == pos:
                 self.hits += 1
                 M_CACHE_EVENTS.labels("region_device", "grid", "hit").inc()
-                self._lru.move_to_end(key)
+                with self._struct_lock:
+                    if key in self._lru:
+                        self._lru.move_to_end(key)
                 return entry.table
+            chunks = _chunks_since(region, entry.delta_pos)
             if entry.table is None:
                 # negative entry: re-probe only after substantial growth —
                 # an ineligible (irregular/sparse) region must not pay a
                 # full eligibility scan per query
-                appended = sum(
-                    len(c[TSID]) for c in append_log[entry.delta_pos:]
-                )
-                if appended <= max(self.min_extend_rows,
-                                   entry.live_rows * self.rebuild_fraction):
-                    return None
-            else:
-                chunks = append_log[entry.delta_pos:]
+                if chunks is not None:
+                    appended = sum(len(c[TSID]) for c in chunks)
+                    if appended <= max(
+                            self.min_extend_rows,
+                            entry.live_rows * self.rebuild_fraction):
+                        return None
+            elif chunks is not None:
                 self.extends += 1
                 M_CACHE_EVENTS.labels("region_device", "grid", "extend").inc()
-                self._bytes -= entry.table.nbytes()
                 extended = extend_grid_table(entry.table, region, chunks,
                                              mesh=self.mesh)
                 if extended is not None:
-                    entry.table = extended
-                    entry.delta_pos = len(append_log)
-                    self._bytes += entry.table.nbytes()
-                    self._lru.move_to_end(key)
+                    # whole-entry swap (not field mutation): a concurrent
+                    # reader holds a self-consistent entry either way;
+                    # bytes delta only when the swap applies, and
+                    # delta_pos derives from the chunks actually applied
+                    # (see get)
+                    with self._struct_lock:
+                        if self._lru.get(key) is entry:
+                            self._bytes += (extended.nbytes()
+                                            - entry.table.nbytes())
+                            self._lru[key] = _Entry(
+                                extended,
+                                delta_pos=entry.delta_pos + len(chunks),
+                                live_rows=entry.live_rows,
+                                sst_ids=entry.sst_ids,
+                                mutation_epoch=entry.mutation_epoch,
+                            )
+                            self._lru.move_to_end(key)
                     self._shrink()
-                    return entry.table
-                self._bytes += entry.table.nbytes()  # undo; evict next
-            self._evict(key)  # delta does not fit the resident shape
+                    return extended
+            self._evict(key)  # delta does not fit (or trimmed past)
 
         self.misses += 1
         M_CACHE_EVENTS.labels("region_device", "grid", "miss").inc()
@@ -547,11 +633,12 @@ class RegionCacheManager:
         # intact, memtable/append-log empty) — extend it from the new
         # files (reads prune to the not-yet-resident ts range) instead of
         # re-reading the whole region
-        prev_key = next(
-            (k for k in self._lru
-             if k[0] == region.region_id and k[1:2] == ("grid",)), None)
-        if prev_key is not None and epoch is not None:
-            prev = self._lru[prev_key]
+        with self._struct_lock:
+            prev_key = next(
+                (k for k in self._lru
+                 if k[0] == region.region_id and k[1:2] == ("grid",)), None)
+            prev = self._lru.get(prev_key) if prev_key is not None else None
+        if prev is not None and epoch is not None:
             if (prev.table is not None and prev.sst_ids is not None
                     and prev.mutation_epoch == epoch
                     and region.memtable.is_empty and not append_log
@@ -564,36 +651,52 @@ class RegionCacheManager:
                     self.extends += 1
                     M_CACHE_EVENTS.labels(
                         "region_device", "grid", "catch_up").inc()
-                    prev = self._lru.pop(prev_key)
-                    self._bytes -= prev.table.nbytes()
-                    if (caught is not prev.table
-                            and self.derived_layouts is not None):
-                        # dicts_version moved on: the old grid's derived
-                        # layouts can never hit again
-                        self.derived_layouts.invalidate_region(key[0])
-                    self._lru[key] = _Entry(
-                        caught, delta_pos=len(append_log),
-                        live_rows=rows_now, sst_ids=cur_ids,
-                        mutation_epoch=epoch,
-                    )
-                    self._bytes += caught.nbytes()
-                    self._shrink()
+                    with self._struct_lock:
+                        got = self._lru.pop(prev_key, None)
+                        if got is not None and got.table is not None:
+                            self._bytes -= got.table.nbytes()
+                        if (caught is not prev.table
+                                and self.derived_layouts is not None):
+                            # dicts_version moved on: the old grid's
+                            # derived layouts can never hit again
+                            self.derived_layouts.invalidate_region(key[0])
+                        old = self._lru.get(key)
+                        if old is not None and old.table is not None:
+                            self._bytes -= old.table.nbytes()
+                        self._lru[key] = _Entry(
+                            caught, delta_pos=pos,
+                            live_rows=rows_now, sst_ids=cur_ids,
+                            mutation_epoch=epoch,
+                        )
+                        self._bytes += caught.nbytes()
+                        self._shrink()
                     return caught
 
         table = build_grid_table(region, mesh=self.mesh)
-        entry = _Entry(table, delta_pos=len(append_log), live_rows=rows_now,
+        if table is not None and _append_pos(region) != pos:
+            # raced an ingest append mid-build (see get's miss path):
+            # serve uncached rather than cache a table whose delta_pos
+            # cannot be trusted.  Negative (None) entries cache anyway —
+            # delta_pos staleness only delays the next eligibility probe.
+            return table
+        entry = _Entry(table, delta_pos=pos, live_rows=rows_now,
                        sst_ids=cur_ids,
                        mutation_epoch=epoch if epoch is not None else -1)
-        stale = [
-            k for k in self._lru
-            if k[0] == key[0] and k[1:2] == ("grid",) and k[2] != base_ver
-        ]
-        for k in stale:
-            self._evict(k)
-        self._lru[key] = entry
-        if table is not None:
-            self._bytes += table.nbytes()
-        self._shrink()
+        with self._struct_lock:
+            stale = [
+                k for k in self._lru
+                if (k[0] == key[0] and k[1:2] == ("grid",)
+                    and k[2] != base_ver)
+            ]
+            for k in stale:
+                self._evict(k)
+            old = self._lru.get(key)
+            if old is not None and old.table is not None:
+                self._bytes -= old.table.nbytes()  # concurrent double-build
+            self._lru[key] = entry
+            if table is not None:
+                self._bytes += table.nbytes()
+            self._shrink()
         return table
 
     def get_sharded(self, region):
@@ -611,19 +714,25 @@ class RegionCacheManager:
         if entry is not None:
             self.hits += 1
             M_CACHE_EVENTS.labels("region_device", "sharded", "hit").inc()
-            self._lru.move_to_end(key)
+            with self._struct_lock:
+                if key in self._lru:
+                    self._lru.move_to_end(key)
             return entry.table
         self.misses += 1
         M_CACHE_EVENTS.labels("region_device", "sharded", "miss").inc()
         table = shard_region(region, self.mesh)
-        for k in [
-            k for k in self._lru
-            if k[0] == key[0] and k[1:2] == ("sharded",) and k != key
-        ]:
-            self._evict(k)
-        self._lru[key] = _Entry(table)
-        self._bytes += table.nbytes()
-        self._shrink()
+        with self._struct_lock:
+            for k in [
+                k for k in self._lru
+                if k[0] == key[0] and k[1:2] == ("sharded",) and k != key
+            ]:
+                self._evict(k)
+            old = self._lru.get(key)
+            if old is not None and old.table is not None:
+                self._bytes -= old.table.nbytes()
+            self._lru[key] = _Entry(table)
+            self._bytes += table.nbytes()
+            self._shrink()
         return table
 
     def install_grid(self, region, table) -> None:
@@ -637,27 +746,95 @@ class RegionCacheManager:
         # same stale-version sweep as get_grid's miss path: entries for
         # other base_versions are dead weight that would count against
         # capacity and could shrink-evict the fresh grid
-        for k in [
-            k for k in self._lru
-            if k[0] == key[0] and k[1:2] == ("grid",)
-        ]:
-            self._evict(k)
-        self._lru[key] = _Entry(
-            table, delta_pos=len(region._append_log), live_rows=rows_now,
-            sst_ids=frozenset(m.file_id for m in region.sst_files),
-            mutation_epoch=getattr(region, "mutation_epoch", -1),
-        )
-        self._bytes += table.nbytes()
-        self._shrink()
+        with self._struct_lock:
+            for k in [
+                k for k in self._lru
+                if k[0] == key[0] and k[1:2] == ("grid",)
+            ]:
+                self._evict(k)
+            self._lru[key] = _Entry(
+                table, delta_pos=_append_pos(region), live_rows=rows_now,
+                sst_ids=frozenset(m.file_id for m in region.sst_files),
+                mutation_epoch=getattr(region, "mutation_epoch", -1),
+            )
+            self._bytes += table.nbytes()
+            self._shrink()
+
+    def extend_hot_tail(self, region) -> bool:
+        """Eager hot-tail append for freshly ACKED ingest rows: when this
+        region already has a resident grid at the current base_version,
+        scatter the pending append-log delta into its not-yet-covered
+        tail right now (ingest-side), so the next query finds the grid
+        current instead of paying the extend itself.  Opportunistic —
+        the extender lock is taken non-blocking, so contending ingest
+        workers skip instead of queueing; a False return means the
+        query-time extend/rebuild path (get_grid) remains responsible.
+        Small deltas are left to accumulate (one scatter dispatch per
+        tiny batch would throttle ingest).
+
+        Publication is a whole-entry swap, never field-wise mutation:
+        concurrent readers (scheduler workers in get_grid) hold either
+        the old entry or the new one, and both are internally consistent
+        (table matches delta_pos) — a torn pair would silently serve a
+        grid missing acked rows."""
+        from greptimedb_tpu.storage.grid import extend_grid_table
+        from greptimedb_tpu.utils.tracing import TRACER
+
+        base_ver = getattr(region, "base_version", None)
+        if base_ver is None:
+            return False
+        key = (region.region_id, "grid", base_ver)
+        if not self._hot_tail_lock.acquire(blocking=False):
+            return False
+        try:
+            entry = self._lru.get(key)
+            if entry is None or entry.table is None:
+                return False
+            pos = _append_pos(region)
+            if entry.delta_pos == pos:
+                return False
+            chunks = _chunks_since(region, entry.delta_pos)
+            if chunks is None:
+                return False  # trimmed past: query path rebuilds
+            delta_rows = sum(len(c[TSID]) for c in chunks)
+            if delta_rows < self.min_extend_rows:
+                return False  # let small batches accumulate
+            with TRACER.stage("ingest_grid_tail", region=region.region_id,
+                              rows=delta_rows):
+                extended = extend_grid_table(entry.table, region, chunks,
+                                             mesh=self.mesh)
+            if extended is None:
+                return False  # off-grid delta: get_grid will evict/rebuild
+            self.extends += 1
+            M_CACHE_EVENTS.labels("region_device", "grid", "hot_tail").inc()
+            with self._struct_lock:
+                # not evicted/replaced meanwhile; delta_pos derives from
+                # the chunks actually scattered, not the earlier pos read
+                # (see get)
+                if self._lru.get(key) is entry:
+                    self._bytes += extended.nbytes() - entry.table.nbytes()
+                    self._lru[key] = _Entry(
+                        extended,
+                        delta_pos=entry.delta_pos + len(chunks),
+                        live_rows=entry.live_rows,
+                        sst_ids=entry.sst_ids,
+                        mutation_epoch=entry.mutation_epoch,
+                    )
+            self._shrink()
+            return True
+        finally:
+            self._hot_tail_lock.release()
 
     def _shrink(self) -> None:
-        while self._bytes > self.capacity and len(self._lru) > 1:
-            self._evict(next(iter(self._lru)))
+        with self._struct_lock:
+            while self._bytes > self.capacity and len(self._lru) > 1:
+                self._evict(next(iter(self._lru)))
 
     def _evict(self, key) -> None:
-        e = self._lru.pop(key, None)
-        if e is not None and e.table is not None:
-            self._bytes -= e.table.nbytes()
+        with self._struct_lock:
+            e = self._lru.pop(key, None)
+            if e is not None and e.table is not None:
+                self._bytes -= e.table.nbytes()
         if (self.derived_layouts is not None and key[1:2] == ("grid",)):
             # a grid leaving residency (capacity pressure, stale-version
             # sweep, failed extend) strands its derived layouts: the next
@@ -673,8 +850,9 @@ class RegionCacheManager:
             self.promql_derived.invalidate_region(key[0])
 
     def invalidate_region(self, region_id: int) -> None:
-        for k in [k for k in self._lru if k[0] == region_id]:
-            self._evict(k)
+        with self._struct_lock:
+            for k in [k for k in self._lru if k[0] == region_id]:
+                self._evict(k)
         if self.derived_layouts is not None:
             self.derived_layouts.invalidate_region(region_id)
         if self.promql_derived is not None:

@@ -406,6 +406,26 @@ def load_grid_snapshot(path: str, region, mesh=None):
     )
 
 
+def _grow_time_axis(values, valid, tpad: int, new_nt: int, spad: int,
+                    c: int):
+    """Grow the padded time axis (new cells zero-valued / invalid) so
+    sustained time-forward ingest extends the resident grid in amortized
+    O(1) per appended step instead of falling off a fixed-``tpad`` cliff
+    into a full rebuild every linger window.  Doubles by default; falls
+    back to an exact fit near the budget.  Returns ``(values, valid)``
+    or None when even the exact fit exceeds the grid budget."""
+    tpad2 = _pad_to(max(new_nt, 2 * tpad), _T_ALIGN)
+    if spad * tpad2 * (4 * c + 1) > _BUDGET:
+        tpad2 = _pad_to(new_nt, _T_ALIGN)
+        if spad * tpad2 * (4 * c + 1) > _BUDGET:
+            return None
+    grow = tpad2 - tpad
+    return (
+        jnp.pad(values, ((0, 0), (0, 0), (0, grow))),
+        jnp.pad(valid, ((0, 0), (0, grow))),
+    )
+
+
 def extend_grid_table(table: GridTable, region, chunks, mesh=None):
     """Scatter pure-append chunks into the resident grid device-side.
 
@@ -431,8 +451,13 @@ def extend_grid_table(table: GridTable, region, chunks, mesh=None):
         return None  # off-grid timestamps: sampling changed
     tidx = rel // step
     new_nt = int(tidx.max()) + 1
+    values, valid = table.values, table.valid
     if new_nt > table.tpad:
-        return None
+        grown = _grow_time_axis(values, valid, table.tpad, new_nt,
+                                table.spad, len(fields))
+        if grown is None:
+            return None
+        values, valid = grown
     cols = []
     no_nan = list(table.no_nan)
     for ci, name in enumerate(fields):
@@ -443,10 +468,10 @@ def extend_grid_table(table: GridTable, region, chunks, mesh=None):
             no_nan[ci] = False
         cols.append(col)
     delta = np.stack(cols, axis=0)  # [C, n]
-    values = table.values.at[
+    values = values.at[
         :, jnp.asarray(tsid), jnp.asarray(tidx)
     ].set(jnp.asarray(delta))
-    valid = table.valid.at[jnp.asarray(tsid), jnp.asarray(tidx)].set(True)
+    valid = valid.at[jnp.asarray(tsid), jnp.asarray(tidx)].set(True)
     tag_codes = table.tag_codes
     if new_series > table.num_series:
         host_tags = _series_tag_matrix(region, table.spad)
@@ -532,9 +557,13 @@ def catch_up_grid_table(table: GridTable, region, new_metas, mesh=None):
     if bool((rel % step != 0).any()):
         return None  # off-grid timestamps: sampling changed
     new_nt = int(rel.max()) // step + 1
-    if new_nt > table.tpad:
-        return None
     values, valid = table.values, table.valid
+    if new_nt > table.tpad:
+        grown = _grow_time_axis(values, valid, table.tpad, new_nt,
+                                table.spad, len(fields))
+        if grown is None:
+            return None
+        values, valid = grown
     no_nan = list(table.no_nan)
     for p in parts:
         tsid = p[TSID].astype(np.int64)

@@ -41,9 +41,18 @@ class Memtable:
         self.min_seq: int | None = None
         self.max_seq: int | None = None
 
-    def append(self, chunk: dict[str, np.ndarray]) -> None:
-        """Append a pre-encoded chunk: schema columns (tags already as raw
-        values, ts as int64, fields numeric) + __tsid__/__seq__/__op__."""
+    def append(self, chunk: dict[str, np.ndarray],
+               ts_bounds: tuple[int, int] | None = None,
+               seq: int | None = None) -> None:
+        """Append a pre-encoded columnar slab: schema columns (tags
+        already as raw values, ts as int64, fields numeric) +
+        __tsid__/__seq__/__op__.  The slab is stored as-is — zero
+        reorganization at ingest; sorting/dedup happen once at freeze.
+
+        ``ts_bounds`` and ``seq``, when the caller already knows them
+        (Region.write computes the ts extremes for its append
+        classification and stamps one sequence per batch), skip the
+        per-column min/max reductions on the hot path."""
         n = len(chunk[SEQ])
         if n == 0:
             return
@@ -52,13 +61,18 @@ class Memtable:
         self.bytes += sum(
             a.nbytes if isinstance(a, np.ndarray) else 64 * n for a in chunk.values()
         )
-        ts_col = self.schema.time_index.name
-        ts = chunk[ts_col]
-        lo, hi = int(ts.min()), int(ts.max())
+        if ts_bounds is not None:
+            lo, hi = int(ts_bounds[0]), int(ts_bounds[1])
+        else:
+            ts = chunk[self.schema.time_index.name]
+            lo, hi = int(ts.min()), int(ts.max())
         self.ts_min = lo if self.ts_min is None else min(self.ts_min, lo)
         self.ts_max = hi if self.ts_max is None else max(self.ts_max, hi)
-        seq = chunk[SEQ]
-        slo, shi = int(seq.min()), int(seq.max())
+        if seq is not None:
+            slo = shi = int(seq)
+        else:
+            sc = chunk[SEQ]
+            slo, shi = int(sc.min()), int(sc.max())
         self.min_seq = slo if self.min_seq is None else min(self.min_seq, slo)
         self.max_seq = shi if self.max_seq is None else max(self.max_seq, shi)
 

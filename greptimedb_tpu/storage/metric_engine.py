@@ -110,14 +110,29 @@ class MetricEngine:
                 self.db.catalog.update_table(info)
 
     def write(self, metric: str, cols: dict,
-              dbname: str | None = None) -> int:
-        """Route one metric's batch into the physical region."""
+              dbname: str | None = None, ensure: bool = True) -> int:
+        """Route one metric's batch into the physical region.  The
+        injected ``__metric__`` column is a single-entry dictionary
+        column (codes are one memset), not ``[metric] * n`` — per-row
+        object lists would undo the vectorized wire parse.
+
+        ``ensure=False`` skips the logical-table/label probe: callers
+        that already ran ``ensure_logical`` under their DDL lock (the
+        remote-write ingest pool) append without re-entering it, so the
+        lock never spans the physical region's WAL flush."""
+        from greptimedb_tpu.datatypes.batch import DictColumn
+
         tag_names = list(cols.get("__tags__") or [])
-        self.ensure_logical(metric, tag_names, dbname)
+        if ensure:
+            self.ensure_logical(metric, tag_names, dbname)
         region = self.physical_region(dbname)
         n = len(cols["ts"])
-        data = {METRIC_COLUMN: [metric] * n, "ts": cols["ts"],
-                "val": cols["val"]}
+        data = {
+            METRIC_COLUMN: DictColumn(
+                np.asarray([metric], dtype=object),
+                np.zeros(n, dtype=np.int32)),
+            "ts": cols["ts"], "val": cols["val"],
+        }
         for t in tag_names:
             data[t] = cols[t]
         region.write(data)

@@ -15,11 +15,12 @@ idea, reference src/metric-engine/src/row_modifier.rs).
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
 
-from greptimedb_tpu.datatypes.batch import DictionaryEncoder
+from greptimedb_tpu.datatypes.batch import DictColumn, DictionaryEncoder
 from greptimedb_tpu.datatypes.schema import Schema, default_fill_array
 from greptimedb_tpu.errors import InvalidArguments, RegionNotFound, StorageError
 from greptimedb_tpu.storage.manifest import Manifest
@@ -31,7 +32,7 @@ from greptimedb_tpu.storage.sst import SstMeta, read_sst, write_sst
 from greptimedb_tpu.storage.wal import (
     FileLogStore,
     NoopLogStore,
-    decode_write,
+    decode_write_full,
     encode_write,
 )
 
@@ -104,7 +105,22 @@ class Region:
         # can extend resident tensors instead of rebuilding (cache.py)
         self.base_version = 0
         self._append_log: list[dict] = []
+        # count of chunks trimmed off the log's front: consumer positions
+        # are ABSOLUTE (base + list index), so sustained ingest can trim
+        # consumed chunks without invalidating up-to-date consumers
+        self._append_base = 0
         self._max_ts_seen: int | None = None  # lazy; -2**63 = empty
+        # serializes writers of THIS region only: concurrent ingest to
+        # different regions proceeds in parallel (the parallel axis of
+        # the sharded ingest pipeline) while each region keeps the
+        # single-writer discipline its sequence/memtable code assumes
+        self._write_lock = threading.RLock()
+        # guards (_append_base, _append_log) as a pair: cache consumers
+        # read them lock-free of _write_lock, so trim (del + base bump)
+        # must be atomic w.r.t. append_chunks_since/append_pos — a torn
+        # read would silently skip or duplicate chunks in the resident
+        # device tail.  Never held across I/O: list ops only.
+        self._append_log_lock = threading.Lock()
         # tag encoders hydrated from the manifest
         self.encoders: dict[str, DictionaryEncoder] = {
             c.name: DictionaryEncoder(manifest.state.dicts.get(c.name, []))
@@ -150,6 +166,24 @@ class Region:
     def sst_files(self) -> list[SstMeta]:
         return list(self.manifest.state.files.values())
 
+    # ---- append-log positions (device-cache incremental protocol) -----
+    @property
+    def append_pos(self) -> int:
+        """Absolute position past the newest append-log chunk.  Consumers
+        (storage/cache.py) remember the position they consumed to; pure
+        appends between two positions EXTEND resident tensors in place."""
+        with self._append_log_lock:
+            return self._append_base + len(self._append_log)
+
+    def append_chunks_since(self, pos: int) -> "list[dict] | None":
+        """Chunks appended after absolute position ``pos``, or None when
+        ``pos`` predates the trimmed window (consumer too stale: rebuild)."""
+        with self._append_log_lock:
+            i = pos - self._append_base
+            if i < 0:
+                return None
+            return self._append_log[i:]
+
     # ---- write path ---------------------------------------------------
     def _encode_tags(
         self, columns: dict[str, np.ndarray], n: int,
@@ -167,11 +201,33 @@ class Region:
 
         code_arrays = []
         for name in tag_cols:
-            vals = np.asarray(columns[name], dtype=object)
             enc = self.encoders[name]
-            # hash-factorize (O(n), no object-array sort): tag columns
-            # repeat heavily, so python cost is paid per UNIQUE value only
-            inv, uniq = pd.factorize(vals, use_na_sentinel=False)
+            col = columns[name]
+            if isinstance(col, DictColumn):
+                # pre-factorized by the vectorized wire parser: the
+                # (codes, vocabulary) pair IS the factorization — skip
+                # the per-row hash entirely.  Compact to REFERENCED
+                # vocabulary entries first: a sliced column (DictColumn
+                # .take from partition routing / per-measurement splits)
+                # keeps the whole-batch vocabulary, and registering
+                # unreferenced values would grow this region's dictionary
+                # with values that were routed elsewhere, forever
+                inv, uniq = col.codes, col.values
+                # referenced-code set via bincount (O(n + vocab)) instead
+                # of a sort — codes are small non-negative ints
+                used = (np.flatnonzero(np.bincount(inv, minlength=len(uniq)))
+                        if inv.size > len(uniq) else np.unique(inv))
+                if len(used) < len(uniq):
+                    remap = np.full(len(uniq), -1, dtype=inv.dtype)
+                    remap[used] = np.arange(len(used), dtype=inv.dtype)
+                    inv = remap[inv]
+                    uniq = uniq[used]
+            else:
+                vals = np.asarray(col, dtype=object)
+                # hash-factorize (O(n), no object-array sort): tag columns
+                # repeat heavily, so python cost is paid per UNIQUE value
+                # only
+                inv, uniq = pd.factorize(vals, use_na_sentinel=False)
             if any(
                 v is None or (isinstance(v, float) and v != v) for v in uniq
             ):
@@ -212,7 +268,30 @@ class Region:
             else:  # astronomically wide key space: exact structured unique
                 packed = None
         if packed is not None:
-            inv2, uniq_packed = pd.factorize(packed)
+            pmax = int(packed.max()) + 1 if n else 0
+            if 0 < pmax <= max(1024, 4 * n):
+                # dense key space (the common case: few live series):
+                # bincount-factorize is O(n + keyspace) with no hash
+                # table.  Uniques are then reordered to FIRST-OCCURRENCE
+                # order — exactly pd.factorize's — because the order NEW
+                # series ids are assigned in is observable downstream
+                # (first/last picks on equal timestamps follow the
+                # device layout's tsid order)
+                uniq_sorted = np.flatnonzero(
+                    np.bincount(packed, minlength=pmax))
+                remap = np.zeros(pmax, dtype=np.int64)
+                remap[uniq_sorted] = np.arange(len(uniq_sorted))
+                inv_s = remap[packed]
+                first = np.empty(len(uniq_sorted), dtype=np.int64)
+                first[inv_s[::-1]] = np.arange(n - 1, -1, -1,
+                                               dtype=np.int64)
+                order = np.argsort(first, kind="stable")
+                rank = np.empty(len(order), dtype=np.int64)
+                rank[order] = np.arange(len(order), dtype=np.int64)
+                uniq_packed = uniq_sorted[order]
+                inv2 = rank[inv_s]
+            else:
+                inv2, uniq_packed = pd.factorize(packed)
             # first-occurrence row per unique packed key (reversed write:
             # the earliest row wins), to recover the exact code tuple
             first_row = np.empty(len(uniq_packed), dtype=np.int64)
@@ -240,30 +319,77 @@ class Region:
             tsids[j] = tsid
         return tsids[inv2.reshape(-1)]
 
-    def write(self, data: dict[str, list | np.ndarray], op: int = OP_PUT) -> int:
-        """Synchronous write of one row group; returns the sequence."""
+    def write(self, data: dict[str, list | np.ndarray], op: int = OP_PUT,
+              wire_payload: bytes | None = None) -> int:
+        """Synchronous write of one row group; returns the sequence.
+
+        Serialized per region by ``_write_lock`` — concurrent ingest to
+        DIFFERENT regions runs in parallel (the sharded half of the
+        vectorized ingest pipeline), while sequence assignment, tag
+        encoding and memtable mutation for one region stay single-writer.
+        Tag columns may arrive as ``DictColumn`` (vectorized wire parse):
+        codes flow straight into the series registry and the WAL encodes
+        them as Arrow dictionary arrays — no per-row string objects until
+        the memtable materialization (a C-level vocabulary gather).
+
+        ``wire_payload``: the batch's original wire bytes when they are
+        already a valid slim WAL payload (an Arrow IPC stream of exactly
+        the columns in ``data``, ts as int64 epoch ms, no nulls — the
+        arrow bulk surface).  Logged verbatim instead of re-serializing
+        the batch, PROVIDED every schema column arrived structurally
+        (checked below); otherwise ignored."""
+        with self._write_lock:
+            return self._write_locked(data, op, wire_payload)
+
+    def _write_locked(self, data, op: int,
+                      wire_payload: bytes | None = None) -> int:
+        from greptimedb_tpu.utils.tracing import TRACER
+
         ts_name = self.ts_name
         n = len(data[ts_name])
         if self.memory is not None:
             # rough batch footprint: ~16B/cell covers the typical mix of
             # f64/int64 values plus object-array overhead for tags
             self.memory.admit("ingest", n * len(data) * 16)
+        # wire_payload stays usable only while every schema column turns
+        # out to have arrived structurally (typed ndarray / string-typed
+        # DictColumn) — exactly the inputs replay_wal re-derives
+        # identically from the raw wire stream
+        wire_ok = wire_payload is not None and op == OP_PUT
         cols: dict[str, np.ndarray] = {}
         for c in self.schema:
             if c.name not in data:
                 if not c.nullable and c.default is None:
                     raise InvalidArguments(f"missing column {c.name}")
+                # default-filled here ≠ present in the wire bytes: replay
+                # of the raw stream would KeyError on this column
+                wire_ok = False
                 cols[c.name] = default_fill_array(c, n)
             else:
                 v = data[c.name]
-                if c.dtype.is_string_like:
+                if wire_ok and not (
+                    (isinstance(v, DictColumn) and c.dtype.is_string_like)
+                    or (isinstance(v, np.ndarray) and v.dtype != object)
+                ):
+                    wire_ok = False
+                if isinstance(v, DictColumn) and c.dtype.is_string_like:
+                    cols[c.name] = v  # stays dictionary-coded end to end
+                elif isinstance(v, DictColumn):
+                    v = v.materialize()
+                    cols[c.name] = v.astype(c.dtype.to_numpy())
+                elif c.dtype.is_string_like:
                     cols[c.name] = np.asarray(v, dtype=object)
                 elif c.dtype.is_timestamp:
-                    cols[c.name] = np.asarray(v).astype(np.int64)
+                    # copy=False: parser output is never aliased by the
+                    # caller afterwards, so an already-int64 ts passes
+                    # through untouched
+                    cols[c.name] = np.asarray(v).astype(np.int64,
+                                                        copy=False)
                 elif isinstance(v, np.ndarray) and v.dtype != object:
                     # typed arrays (arrow ingest, staging scans) can't hold
-                    # None — keep the single-pass hot path
-                    cols[c.name] = v.astype(c.dtype.to_numpy())
+                    # None — keep the single-pass hot path; copy=False
+                    # skips the memcpy when the wire dtype already matches
+                    cols[c.name] = v.astype(c.dtype.to_numpy(), copy=False)
                 else:
                     arr = np.asarray(v, dtype=object)
                     if any(x is None for x in arr):
@@ -300,18 +426,46 @@ class Region:
         # non-durable stores (Noop) skip serialization entirely — encoding
         # 10 columns of a million-row batch for /dev/null is pure overhead
         if getattr(self.wal, "durable", True):
-            wal_cols = {}
-            for k, v in chunk.items():
-                if k.startswith(TAGCODE_PREFIX):
-                    continue  # codes are derivable; replay recomputes them
-                # object-dtype (string) columns: pa.array over the python
-                # list preserves None as arrow nulls (astype(str) would
-                # corrupt NULL into the literal 'None' across recovery)
-                wal_cols[k] = pa.array(v.tolist() if v.dtype == object else v)
-            self.wal.append(seq, encode_write(wal_cols))
-        # memtable stores ts as int64 under the schema's ts column name
-        mt_chunk = dict(chunk)
-        mt_chunk[self.ts_name] = chunk[self.ts_name].astype(np.int64)
+            with TRACER.stage("ingest_wal", region=self.region_id, rows=n):
+                if wire_ok:
+                    # the wire bytes already ARE the slim payload (arrow
+                    # bulk: same columns, int64 ms ts, no nulls, op PUT
+                    # implied by absent metadata) — log them verbatim,
+                    # skipping a full re-serialization of the batch
+                    self.wal.append(seq, wire_payload)
+                else:
+                    wal_cols = {}
+                    for k, v in chunk.items():
+                        if k.startswith(TAGCODE_PREFIX) or k in (
+                                TSID, SEQ, OP):
+                            # derivable at replay: codes/tsids recompute,
+                            # the sequence rides the record header, op is
+                            # one value per batch (schema metadata)
+                            continue
+                        if isinstance(v, DictColumn):
+                            # dictionary-coded tags log as Arrow
+                            # dictionary arrays: vocabulary once + int32
+                            # codes per row
+                            wal_cols[k] = pa.DictionaryArray.from_arrays(
+                                pa.array(v.codes),
+                                pa.array(v.values.tolist()))
+                            continue
+                        # object-dtype (string) columns: pa.array over the
+                        # python list preserves None as arrow nulls
+                        # (astype(str) would corrupt NULL into the literal
+                        # 'None' across recovery)
+                        wal_cols[k] = pa.array(
+                            v.tolist() if v.dtype == object else v)
+                    self.wal.append(seq, encode_write(wal_cols, op=op))
+        # memtable stores ts as int64 under the schema's ts column name;
+        # dictionary-coded tags materialize here — one vocabulary gather
+        # per column (rows share the vocabulary's string objects)
+        mt_chunk = {
+            k: (v.materialize() if isinstance(v, DictColumn) else v)
+            for k, v in chunk.items()
+        }
+        mt_chunk[self.ts_name] = np.asarray(
+            mt_chunk[self.ts_name]).astype(np.int64, copy=False)
 
         # incremental-cache classification: a batch whose timestamps all lie
         # strictly AFTER everything seen is a pure append (no upsert/delete
@@ -320,10 +474,9 @@ class Region:
             b = self.ts_bounds()
             self._max_ts_seen = b[1] if b is not None else -(1 << 63)
         ts_i64 = mt_chunk[self.ts_name]
-        appendable = (
-            op == OP_PUT and n > 0 and int(ts_i64.min()) > self._max_ts_seen
-            and len(self._append_log) < MAX_APPEND_CHUNKS
-        )
+        ts_lo = int(ts_i64.min()) if n else 0
+        ts_hi = int(ts_i64.max()) if n else 0
+        appendable = op == OP_PUT and n > 0 and ts_lo > self._max_ts_seen
         if appendable and n > 1:
             # within-batch duplicate (series, ts) keys dedup keep-last in
             # the memtable but would append verbatim on the device — not
@@ -332,26 +485,39 @@ class Region:
             # structured row sort (~6x slower on 1M-row ingest batches);
             # falls back to the row-wise check if the key space overflows.
             tsid_i64 = chunk[TSID].astype(np.int64)
-            rel = ts_i64 - int(ts_i64.min())
+            rel = ts_i64 - ts_lo
             if int(tsid_i64.max()) < (1 << 30) and int(rel.max()) < (1 << 34):
                 packed = (tsid_i64 << 34) | rel
-                if len(np.unique(packed)) != n:
+                packed.sort()  # fresh array — safe to sort in place
+                if bool((packed[1:] == packed[:-1]).any()):
                     appendable = False
             else:
                 pairs = np.stack([tsid_i64, ts_i64], axis=1)
                 if len(np.unique(pairs, axis=0)) != n:
                     appendable = False
         if n > 0:
-            self._max_ts_seen = max(self._max_ts_seen, int(ts_i64.max()))
+            self._max_ts_seen = max(self._max_ts_seen, ts_hi)
 
-        self.memtable.append(mt_chunk)
+        with TRACER.stage("ingest_memtable", region=self.region_id, rows=n):
+            self.memtable.append(
+                mt_chunk, ts_bounds=(ts_lo, ts_hi) if n else None, seq=seq)
         self.generation += 1
         # consumers like the streaming flow engine need to know whether
         # this batch could have OVERWRITTEN existing rows (upsert) — an
         # incremental aggregate may only fold in pure appends
         self.last_write_appendable = appendable or n == 0
         if appendable:
-            self._append_log.append(mt_chunk)
+            with self._append_log_lock:
+                self._append_log.append(mt_chunk)
+                if len(self._append_log) > MAX_APPEND_CHUNKS:
+                    # sustained ingest: trim the consumed front instead
+                    # of forcing a structure change — up-to-date
+                    # consumers (absolute positions) keep extending
+                    # forever; a consumer behind the trimmed window
+                    # rebuilds (it was stale anyway)
+                    drop = len(self._append_log) - MAX_APPEND_CHUNKS
+                    del self._append_log[:drop]
+                    self._append_base += drop
         elif n > 0:
             self._mark_structure_change()
         # n == 0: nothing changed; keep resident tables valid
@@ -370,7 +536,9 @@ class Region:
         self.base_version += 1
         if not content_preserving:
             self.mutation_epoch += 1
-        self._append_log.clear()
+        with self._append_log_lock:
+            self._append_base += len(self._append_log)
+            self._append_log.clear()
         self._max_ts_seen = None
 
     def delete(self, data: dict[str, list | np.ndarray]) -> int:
@@ -384,7 +552,18 @@ class Region:
         Existing series extend their key with the empty-string code; tsids
         are preserved, so resident caches/devices stay consistent. Flushes
         first so every SST is backfillable by schema evolution.
+
+        Takes the region write lock (reentrant — flush re-acquires) for
+        the whole swap: concurrent ingest-pool writers must never observe
+        a half-rebuilt series registry or a schema/encoder mismatch.
         """
+        from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
+        from greptimedb_tpu.datatypes.types import ConcreteDataType, SemanticType
+
+        with self._write_lock:
+            self._add_tag_column_locked(name)
+
+    def _add_tag_column_locked(self, name: str) -> None:
         from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema
         from greptimedb_tpu.datatypes.types import ConcreteDataType, SemanticType
 
@@ -417,6 +596,10 @@ class Region:
 
     # ---- flush / replay ------------------------------------------------
     def flush(self) -> SstMeta | None:
+        with self._write_lock:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> SstMeta | None:
         if self.memtable.is_empty:
             return None
         frozen = self.memtable.freeze(dedup=not self.options.append_mode)
@@ -459,7 +642,7 @@ class Region:
         for seq, payload in self.wal.replay(
             self.manifest.state.flushed_seq + 1, repair=repair
         ):
-            cols = decode_write(payload)
+            cols, op = decode_write_full(payload)
             chunk: dict[str, np.ndarray] = {}
             for c in self.schema:
                 arr = cols[c.name]
@@ -474,8 +657,14 @@ class Region:
             chunk[TSID] = self._encode_tags(chunk, n, out_codes=tag_codes)
             for tname, tcodes in tag_codes.items():
                 chunk[tagcode_col(tname)] = tcodes
-            chunk[SEQ] = cols[SEQ].to_numpy(zero_copy_only=False)
-            chunk[OP] = cols[OP].to_numpy(zero_copy_only=False).astype(np.int8)
+            # slim payloads derive __seq__/__op__ (header sequence +
+            # metadata op); pre-slimming records still carry the columns
+            # and replay them verbatim
+            chunk[SEQ] = (cols[SEQ].to_numpy(zero_copy_only=False)
+                          if SEQ in cols else np.full(n, seq, dtype=np.int64))
+            chunk[OP] = (cols[OP].to_numpy(zero_copy_only=False)
+                         .astype(np.int8)
+                         if OP in cols else np.full(n, op, dtype=np.int8))
             self.memtable.append(chunk)
             self.next_seq = max(self.next_seq, seq + 1)
             count += 1

@@ -85,6 +85,12 @@ class SharedLogBroker:
     # ---- data plane ----------------------------------------------------
     def append(self, topic: str, region_id: int, sequence: int,
                payload: bytes) -> int:
+        """Durable append; returns the topic offset.  Offset assignment
+        and record enqueue happen atomically under the broker lock, but
+        the durability wait runs OUTSIDE it — concurrent appenders (many
+        regions, many topics) enqueue back-to-back and the log's group
+        committer flushes the whole batch with one write + fsync, acking
+        every waiter at once (the Kafka produce-batching analog)."""
         from greptimedb_tpu.utils.chaos import CHAOS
 
         CHAOS.inject("wal.append")  # broker stall/failure (chaos tier)
@@ -92,8 +98,10 @@ class SharedLogBroker:
             log = self._log(topic)
             offset = self._offsets[topic] + 1
             self._offsets[topic] = offset
-            log.append(offset, _ENV.pack(region_id, sequence) + payload)
-            return offset
+            wait = log.append_async(
+                offset, _ENV.pack(region_id, sequence) + payload)
+        wait()
+        return offset
 
     def read(self, topic: str, from_offset: int | None = None):
         """Yield (offset, region_id, sequence, payload); read-only (never

@@ -141,10 +141,12 @@ class TestRegistryStaticCheck:
         import greptimedb_tpu.query.physical  # noqa: F401
         import greptimedb_tpu.rpc.frontend  # noqa: F401
         import greptimedb_tpu.servers.http  # noqa: F401
+        import greptimedb_tpu.servers.protocols  # noqa: F401
         import greptimedb_tpu.servers.tcp  # noqa: F401
         import greptimedb_tpu.serving.scheduler  # noqa: F401
         import greptimedb_tpu.standalone  # noqa: F401
         import greptimedb_tpu.storage.cache  # noqa: F401
+        import greptimedb_tpu.storage.wal  # noqa: F401
         import greptimedb_tpu.utils.chaos  # noqa: F401
         import greptimedb_tpu.utils.memory  # noqa: F401
 
@@ -168,6 +170,20 @@ class TestRegistryStaticCheck:
             "greptime_scheduler_admitted_total",
             "greptime_scheduler_rejected_total",
             "greptime_scheduler_tenant_inflight",
+        ):
+            assert required in REGISTRY._metrics, required
+        # the vectorized ingest pipeline's metric surface likewise exists
+        # by import: wire decode (rows/bytes/batches/parse-phase seconds,
+        # the object-decode pin the hot path holds at 0) and the WAL
+        # group-commit batch/fsync accounting
+        for required in (
+            "greptime_ingest_rows_total",
+            "greptime_ingest_bytes_total",
+            "greptime_ingest_batches_total",
+            "greptime_ingest_parse_seconds",
+            "greptime_ingest_object_decode_rows_total",
+            "greptime_ingest_wal_batch_size",
+            "greptime_ingest_wal_fsyncs_total",
         ):
             assert required in REGISTRY._metrics, required
 
