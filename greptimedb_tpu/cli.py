@@ -108,7 +108,9 @@ def cmd_standalone(args) -> int:
         # protocol servers drain before the database closes under them
         for s in reversed(servers):
             s.stop()
-        db.close()
+        # graceful shutdown: flush dirty regions so the clean restart
+        # replays O(hot-tail) instead of the full log (ISSUE 9)
+        db.close(flush=True)
     return 0
 
 

@@ -312,6 +312,41 @@ class Metasrv:
             del nodes[node_id]
             self._put_follower_routes(region_id, nodes)
 
+    def wire_repair_sources(self, region_id: int) -> int:
+        """Durability repair plumbing (ISSUE 9, Taurus repair-from-replica):
+        point each open LEADER region's corruption-repair hooks at an
+        alive follower replica — ``repair_source`` fetches the replica's
+        copy of an SST over the object plane, ``wal_resync`` scans the
+        replica's replayable WAL objects for a lost sequence range.  With
+        no alive follower the hooks clear, so an uncovered loss stays a
+        loud failure instead of hanging on a dead peer.  Returns the
+        number of leader regions wired."""
+        from greptimedb_tpu.storage.durability import (
+            repair_sst_from_peer, resync_from_peer_wal,
+        )
+
+        routes = self.follower_routes(region_id)
+        wired = 0
+        for nid, dn in self.datanodes.items():
+            if (dn.roles.get(region_id) != "leader"
+                    or region_id not in dn.engine.regions):
+                continue
+            region = dn.engine.regions[region_id]
+            peer = None
+            for fnid in routes:
+                f = self.datanodes.get(int(fnid))
+                if f is not None and f.alive and f.node_id != nid:
+                    peer = f
+                    break
+            if peer is None:
+                region.repair_source = None
+                region.wal_resync = None
+                continue
+            region.repair_source = repair_sst_from_peer(peer)
+            region.wal_resync = resync_from_peer_wal(peer, region_id)
+            wired += 1
+        return wired
+
     # ---- heartbeat chain (reference handler.rs:322) --------------------
     def handle_heartbeat(self, hb: dict, now_ms: float) -> list[dict]:
         node_id = hb["node_id"]

@@ -198,6 +198,14 @@ class RegionMigrationProcedure(Procedure):
             metasrv.set_region_route(rid, s["to_node"])
             # a promoted replica is no longer a follower of anything
             metasrv.remove_follower_route(rid, s["to_node"])
+            # durability repair plumbing (ISSUE 9): re-point the new
+            # leader's corruption-repair hooks at its surviving follower
+            # replicas (best-effort — repair is an extra safety net, and
+            # its wiring must never fail a migration)
+            try:
+                metasrv.wire_repair_sources(rid)
+            except Exception:  # noqa: BLE001
+                pass
             s["phase"] = "close_old"
             return Status.executing()
 

@@ -60,12 +60,22 @@ def lib():
             ctypes.c_char_p, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_size_t),
         ]
-        l.gt_wal_scan.restype = ctypes.c_int64
-        l.gt_wal_scan.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
-            ctypes.POINTER(GtWalSpan), ctypes.c_size_t,
-            ctypes.POINTER(ctypes.c_size_t),
-        ]
+        # v2 WAL frame scan (header-checksummed records); an older .so
+        # without these symbols still serves crc32/snappy — the WAL
+        # wrappers just return None and pure-python scanning takes over
+        try:
+            l.gt_wal_scan2.restype = ctypes.c_int64
+            l.gt_wal_scan2.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
+                ctypes.POINTER(GtWalSpan), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_size_t),
+            ]
+            l.gt_wal_find_boundary2.restype = ctypes.c_int64
+            l.gt_wal_find_boundary2.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+            ]
+        except AttributeError:
+            l._gt_no_wal = True
         _LIB = l
     except OSError:
         _LIB = None
@@ -103,14 +113,14 @@ def snappy_decompress(data: bytes) -> bytes | None:
 def wal_scan(buf: bytes, min_seq: int) -> tuple[list[tuple[int, int, int]], int] | None:
     """Returns ([(seq, payload_off, payload_len)], good_end) or None."""
     l = lib()
-    if l is None:
+    if l is None or getattr(l, "_gt_no_wal", False):
         return None
-    cap = max(len(buf) // 16, 16)
+    cap = max(len(buf) // 20, 16)
     while True:
         spans = (GtWalSpan * cap)()
         good_end = ctypes.c_size_t(0)
-        n = l.gt_wal_scan(buf, len(buf), min_seq, spans, cap,
-                          ctypes.byref(good_end))
+        n = l.gt_wal_scan2(buf, len(buf), min_seq, spans, cap,
+                           ctypes.byref(good_end))
         if n < 0:
             cap *= 2
             continue
@@ -119,3 +129,15 @@ def wal_scan(buf: bytes, min_seq: int) -> tuple[list[tuple[int, int, int]], int]
              for i in range(n)],
             good_end.value,
         )
+
+
+def wal_find_boundary(buf: bytes, start: int) -> int | None:
+    """Next fully-valid record offset at/after ``start``; None when the
+    damage reaches EOF, or when the native library is unavailable (the
+    caller must fall back to the pure-python byte scan, NOT treat the
+    miss as torn tail)."""
+    l = lib()
+    if l is None or getattr(l, "_gt_no_wal", False):
+        return None
+    off = l.gt_wal_find_boundary2(buf, len(buf), start)
+    return None if off < 0 else int(off)

@@ -145,7 +145,9 @@ int gt_snappy_decompress(const uint8_t* in, size_t in_len, uint8_t* out,
 }
 
 // ---------------------------------------------------------------------------
-// WAL segment scan: [u32 len][u32 crc][u64 seq][payload] frames
+// WAL segment scan: [u32 len][u32 crc(payload)][u64 seq][u32 crc(hdr)]
+// [payload] frames.  The header CRC covers the 16-byte prefix so a bit
+// flip anywhere in a record (including the sequence field) is detected.
 // ---------------------------------------------------------------------------
 
 struct GtWalSpan {
@@ -154,29 +156,33 @@ struct GtWalSpan {
   uint64_t payload_len;
 };
 
-// Scans frames, validating CRCs. Returns the number of valid frames with
-// seq >= min_seq written to spans (up to max_spans), and sets *good_end to
-// the byte offset after the last valid frame (torn-tail truncation point).
-// A negative return means spans overflowed (call again with more room).
-int64_t gt_wal_scan(const uint8_t* buf, size_t len, uint64_t min_seq,
-                    GtWalSpan* spans, size_t max_spans, size_t* good_end) {
+// Scans v2 frames, validating header + payload CRCs. Returns the number of
+// valid frames with seq >= min_seq written to spans (up to max_spans), and
+// sets *good_end to the byte offset after the last valid frame (corruption
+// triage resumes from there). A negative return means spans overflowed
+// (call again with more room).
+int64_t gt_wal_scan2(const uint8_t* buf, size_t len, uint64_t min_seq,
+                     GtWalSpan* spans, size_t max_spans, size_t* good_end) {
   size_t off = 0;
   size_t n = 0;
   *good_end = 0;
-  while (off + 16 <= len) {
+  while (off + 20 <= len) {
     uint32_t rec_len;
     uint32_t crc;
     uint64_t seq;
+    uint32_t hcrc;
     memcpy(&rec_len, buf + off, 4);
     memcpy(&crc, buf + off + 4, 4);
     memcpy(&seq, buf + off + 8, 8);
-    size_t end = off + 16 + rec_len;
+    memcpy(&hcrc, buf + off + 16, 4);
+    if (gt_crc32(buf + off, 16) != hcrc) break;
+    size_t end = off + 20 + rec_len;
     if (end > len) break;
-    if (gt_crc32(buf + off + 16, rec_len) != crc) break;
+    if (gt_crc32(buf + off + 20, rec_len) != crc) break;
     if (seq >= min_seq) {
       if (n >= max_spans) return -static_cast<int64_t>(n);
       spans[n].seq = seq;
-      spans[n].payload_off = off + 16;
+      spans[n].payload_off = off + 20;
       spans[n].payload_len = rec_len;
       n++;
     }
@@ -184,6 +190,27 @@ int64_t gt_wal_scan(const uint8_t* buf, size_t len, uint64_t min_seq,
     *good_end = end;
   }
   return static_cast<int64_t>(n);
+}
+
+// Byte-scan forward from `start` for the next offset holding a fully valid
+// v2 frame — the interior-corruption resync point. Returns the offset, or
+// -1 when no valid frame follows (damage reaches EOF).
+int64_t gt_wal_find_boundary2(const uint8_t* buf, size_t len, size_t start) {
+  if (len < 20) return -1;
+  for (size_t off = start; off + 20 <= len; off++) {
+    uint32_t hcrc;
+    memcpy(&hcrc, buf + off + 16, 4);
+    if (gt_crc32(buf + off, 16) != hcrc) continue;
+    uint32_t rec_len;
+    uint32_t crc;
+    memcpy(&rec_len, buf + off, 4);
+    memcpy(&crc, buf + off + 4, 4);
+    size_t end = off + 20 + rec_len;
+    if (end > len) continue;
+    if (gt_crc32(buf + off + 20, rec_len) != crc) continue;
+    return static_cast<int64_t>(off);
+  }
+  return -1;
 }
 
 }  // extern "C"

@@ -280,4 +280,36 @@ def serve(node_id: int, data_home: str, host: str = "127.0.0.1",
                                   remote_wal_dir=remote_wal_dir)
     print(json.dumps({"node_id": node_id, "address": server.address}),
           flush=True)
+
+    # graceful SIGTERM/SIGINT: stop serving, flush dirty regions and close
+    # WAL handles (RegionEngine.close) so a clean restart replays only the
+    # hot tail instead of the full log.  SIGKILL still exercises the crash
+    # path — replay + corruption triage cover it.
+    import signal
+    import threading
+
+    # single-flight close: the signal thread and the post-serve() main
+    # thread can both reach it — flushing/clearing regions concurrently
+    # would race (dict mutated during iteration, flush after wal.close)
+    close_once = threading.Lock()
+    closed = []
+
+    def _close_engine():
+        with close_once:
+            if closed:
+                return
+            closed.append(True)
+            server.datanode.engine.close(flush=True)
+
+    def _graceful(_signum, _frame):
+        def _stop():
+            try:
+                server.shutdown()
+            finally:
+                _close_engine()
+        threading.Thread(target=_stop, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
     server.serve()
+    _close_engine()

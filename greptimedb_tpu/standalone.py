@@ -474,12 +474,16 @@ class GreptimeDB(TableProvider):
             r.flush()
             freed += b
 
-    def close(self) -> None:
+    def close(self, flush: bool = False) -> None:
+        """Shut the instance down: drain the scheduler, stop the
+        self-monitor, close region WAL handles, close the kv store.
+        ``flush=True`` (the graceful SIGTERM server path) also flushes
+        dirty regions so a clean restart replays O(hot-tail)."""
         if self.scheduler is not None:
             self.scheduler.stop()
         if self.self_monitor is not None:
             self.self_monitor.stop()
-        self.regions.close()
+        self.regions.close(flush=flush)
         if hasattr(self.kv, "close"):
             self.kv.close()
 

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from greptimedb_tpu.storage.durability import SstCorruption
 from greptimedb_tpu.storage.memtable import OP, OP_DELETE, SEQ, TSID
 
 # padding granularity: each distinct (Spad, Tpad) is a compile shape class.
@@ -165,17 +166,29 @@ def _gather_parts(region, fields: list[str]):
 
     ts_name = region.ts_name
     want = [ts_name, TSID, SEQ, OP] + fields
-    metas = sorted(region.sst_files, key=lambda m: m.seq_max)
-    prefetch_store(region.store, metas)
-    est = estimate_staging_bytes(metas, len(want))
-    parts = read_parts(
-        [
-            (lambda m=m: read_sst(region.store, m, region.schema,
-                                  columns=want))
-            for m in metas
-        ],
-        memory=getattr(region, "memory", None), est_bytes=est,
-    )
+    attempts = 0
+    while True:
+        metas = sorted(region.sst_files, key=lambda m: m.seq_max)
+        prefetch_store(region.store, metas)
+        est = estimate_staging_bytes(metas, len(want))
+        try:
+            parts = read_parts(
+                [
+                    (lambda m=m: read_sst(region.store, m, region.schema,
+                                          columns=want))
+                    for m in metas
+                ],
+                memory=getattr(region, "memory", None), est_bytes=est,
+            )
+            break
+        except SstCorruption as e:
+            # verified read failed: quarantine/repair, retry over the
+            # refreshed live set (the grid build must never ingest
+            # corrupt pages, and must keep building around a lost file)
+            attempts += 1
+            if attempts > 16:
+                raise
+            region._handle_sst_corruption(e)
     for chunk in region.memtable.snapshot_chunks():
         # within-chunk duplicates resolve by scatter order (later row wins),
         # matching keep-max-seq: rows in a chunk share one sequence and
@@ -351,8 +364,10 @@ def save_grid_snapshot(table: GridTable, region, path: str) -> None:
         "fingerprint": _region_fingerprint(region),
     }
     tmp = os.path.join(path, "meta.json.tmp")
-    with open(tmp, "w") as f:
+    with open(tmp, "w", encoding="utf-8") as f:
         json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, os.path.join(path, "meta.json"))
 
 
@@ -540,14 +555,21 @@ def catch_up_grid_table(table: GridTable, region, new_metas, mesh=None):
     ]
     prefetch_store(region.store, metas)
     est = estimate_staging_bytes(metas, len(want), (lo, None))
-    parts = read_parts(
-        [
-            (lambda m=m: read_sst(region.store, m, region.schema,
-                                  (lo, None), columns=want))
-            for m in metas
-        ],
-        memory=getattr(region, "memory", None), est_bytes=est,
-    )
+    try:
+        parts = read_parts(
+            [
+                (lambda m=m: read_sst(region.store, m, region.schema,
+                                      (lo, None), columns=want))
+                for m in metas
+            ],
+            memory=getattr(region, "memory", None), est_bytes=est,
+        )
+    except SstCorruption as e:
+        # quarantine/repair changes the SST set out from under this
+        # incremental pass — hand back None so the cache does a full
+        # (verified, corruption-retrying) rebuild instead
+        region._handle_sst_corruption(e)
+        return None
     parts = [p for p in parts if len(p[TSID])]
     if not parts:
         return table  # fully resident already (flush of consumed appends)

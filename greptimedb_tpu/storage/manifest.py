@@ -1,4 +1,4 @@
-"""Region manifest: versioned action log + checkpoints.
+"""Region manifest: versioned action log + checkpoints, CRC-verified.
 
 Equivalent of the reference's manifest (src/mito2/src/manifest/{action.rs,
 checkpointer.rs,manager.rs}, SURVEY.md §5.4 mechanism 2): every metadata
@@ -9,18 +9,76 @@ so region open replays O(recent) actions, not history.
 Layout under <region>/manifest/:
     checkpoint-<version>.json   full state at version
     delta-<version>.json        one action, applied in version order
+    quarantine/<name>           corrupt files moved aside (never deleted)
+    QUARANTINED                 marker: open refuses until cleared
+
+Durability hardening (ISSUE 9, mirroring the reference's checksummed
+manifest storage):
+
+- every file is wrapped in a ``GTM1 <crc32>`` envelope and verified on
+  open — a bit flip is detected, not parsed into wrong metadata;
+- ``commit`` persists the delta BEFORE mutating in-memory state, so a
+  failed write can never leave memory a version ahead of disk (the next
+  commit would write version+1 over a hole);
+- open REFUSES version gaps: deltas must be consecutive from the
+  checkpoint base.  A corrupt/missing delta raises ManifestCorruption
+  carrying the last good prefix — the region open path recovers through
+  WAL replay when the log covers the lost actions, and quarantines the
+  region (files moved aside + marker, open fails loudly) when it does
+  not;
+- ``checkpoint`` read-back-verifies the new checkpoint file before GC
+  deletes the deltas it supersedes — GC can never destroy the only
+  readable history behind an unreadable checkpoint.
 """
 
 from __future__ import annotations
 
 import json
+import zlib
 from dataclasses import dataclass, field
 
 from greptimedb_tpu.datatypes.schema import Schema
+from greptimedb_tpu.errors import StorageError
+from greptimedb_tpu.storage.durability import (
+    M_CORRUPTION,
+    M_QUARANTINED,
+    ManifestCorruption,
+    RegionQuarantined,
+)
 from greptimedb_tpu.storage.object_store import ObjectStore
 from greptimedb_tpu.storage.sst import SstMeta
+from greptimedb_tpu.utils.chaos import CHAOS
 
 CHECKPOINT_EVERY = 16
+
+_MAGIC = b"GTM1 "
+_QUARANTINE_MARKER = "QUARANTINED"
+
+_KNOWN_KINDS = frozenset(
+    {"edit", "schema", "dicts", "reset_dicts", "truncate", "options",
+     "quarantine"})
+
+
+def _encode_file(obj: dict) -> bytes:
+    body = json.dumps(obj).encode()
+    return _MAGIC + b"%08x\n" % (zlib.crc32(body) & 0xFFFFFFFF) + body
+
+
+def _decode_file(data: bytes) -> dict | None:
+    """Parse a manifest file; None = corrupt (CRC mismatch / unparsable).
+    Files written before the envelope (legacy plain JSON) still load —
+    their integrity is best-effort, exactly as before."""
+    try:
+        if data.startswith(_MAGIC):
+            nl = data.index(b"\n", len(_MAGIC))
+            want = int(data[len(_MAGIC):nl], 16)
+            body = data[nl + 1:]
+            if (zlib.crc32(body) & 0xFFFFFFFF) != want:
+                return None
+            return json.loads(body)
+        return json.loads(data)
+    except (ValueError, IndexError):
+        return None
 
 
 @dataclass
@@ -34,6 +92,10 @@ class ManifestState:
     dicts: dict[str, list] = field(default_factory=dict)
     series: list[list[int]] = field(default_factory=list)
     options: dict = field(default_factory=dict)
+    # SSTs pulled from the live set after failing read verification:
+    # file_id -> meta dict.  Kept in state (not just moved aside on disk)
+    # so every node agrees the file is out of service until repaired.
+    quarantined: dict[str, dict] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -44,6 +106,7 @@ class ManifestState:
             "dicts": self.dicts,
             "series": self.series,
             "options": self.options,
+            "quarantined": self.quarantined,
         }
 
     @staticmethod
@@ -56,6 +119,7 @@ class ManifestState:
             dicts=d.get("dicts", {}),
             series=d.get("series", []),
             options=d.get("options", {}),
+            quarantined=d.get("quarantined", {}),
         )
 
     def apply(self, action: dict) -> None:
@@ -87,6 +151,19 @@ class ManifestState:
             self.flushed_seq = max(self.flushed_seq, action["truncated_seq"])
         elif kind == "options":
             self.options.update(action["options"])
+        elif kind == "quarantine":
+            # pull a corrupt SST from the live set (detection) or restore
+            # a repaired one (repair) — the scan layer keeps serving the
+            # remaining files either way
+            fid = action["file_id"]
+            if action.get("restore"):
+                meta = self.quarantined.pop(fid, None)
+                if meta is not None:
+                    self.files[fid] = SstMeta.from_dict(meta)
+            else:
+                meta = self.files.pop(fid, None)
+                if meta is not None:
+                    self.quarantined[fid] = meta.to_dict()
         else:
             raise ValueError(f"unknown manifest action kind: {kind}")
 
@@ -102,27 +179,102 @@ class Manifest:
     # ---- open/replay ----------------------------------------------------
     @staticmethod
     def open(store: ObjectStore, manifest_dir: str) -> "Manifest":
+        """Open and verify.  Raises RegionQuarantined when a prior
+        uncovered corruption marked the region, and ManifestCorruption
+        (carrying the recoverable prefix) when verification fails past a
+        good prefix — callers with a WAL decide recovery vs quarantine."""
         m = Manifest(store, manifest_dir)
         entries = store.list(manifest_dir)
         ckpt_versions = []
         delta_versions = []
         for p in entries:
+            if f"/{_QUARANTINE_MARKER}" in p or p.endswith(
+                    _QUARANTINE_MARKER):
+                raise RegionQuarantined(
+                    f"manifest {manifest_dir} is quarantined "
+                    f"({p}): clear the marker after repair to reopen")
+            if "/quarantine/" in p:
+                continue  # moved-aside corpses: never re-read as live
             fn = p.rsplit("/", 1)[-1]
             if fn.startswith("checkpoint-"):
                 ckpt_versions.append(int(fn[len("checkpoint-"):-len(".json")]))
             elif fn.startswith("delta-"):
                 delta_versions.append(int(fn[len("delta-"):-len(".json")]))
+        bad_files: list[str] = []
+        bad_ckpt_max = None
         base = 0
-        if ckpt_versions:
-            base = max(ckpt_versions)
-            raw = json.loads(store.read(f"{manifest_dir}/checkpoint-{base:020d}.json"))
+        # newest checkpoint that verifies wins; corrupt ones are suspects
+        for v in sorted(ckpt_versions, reverse=True):
+            path = f"{manifest_dir}/checkpoint-{v:020d}.json"
+            raw = _decode_file(store.read(path))
+            if raw is None:
+                M_CORRUPTION.labels("manifest", "checkpoint").inc()
+                bad_files.append(path)
+                bad_ckpt_max = max(bad_ckpt_max or 0, v)
+                continue
             m.state = ManifestState.from_dict(raw)
-            m.version = base
+            m.version = base = v
+            break
+        detail = None
+        tail_only = False
+        expected = base + 1
         for v in sorted(x for x in delta_versions if x > base):
-            action = json.loads(store.read(f"{manifest_dir}/delta-{v:020d}.json"))
+            if v != expected:
+                # version gap: a delta is MISSING — refuse to silently
+                # apply later deltas over the hole.  Deltas exist beyond
+                # the hole, so this is mid-chain loss, never tail debris.
+                M_CORRUPTION.labels("manifest", "gap").inc()
+                detail = f"delta version gap: expected {expected}, found {v}"
+                bad_files.extend(
+                    f"{manifest_dir}/delta-{w:020d}.json"
+                    for w in sorted(x for x in delta_versions if x >= v))
+                break
+            path = f"{manifest_dir}/delta-{v:020d}.json"
+            action = _decode_file(store.read(path))
+            if action is None:
+                M_CORRUPTION.labels("manifest", "delta").inc()
+                detail = f"corrupt delta at version {v}"
+                bad_files.extend(
+                    f"{manifest_dir}/delta-{w:020d}.json"
+                    for w in sorted(x for x in delta_versions if x >= v))
+                # crash-debris shape only if NOTHING follows the corpse:
+                # the lost action was the last (unacked) commit
+                tail_only = max(delta_versions) == v
+                break
             m.state.apply(action)
             m.version = v
+            expected = v + 1
+        if detail is None and bad_files:
+            if bad_ckpt_max is not None and m.version >= bad_ckpt_max:
+                # a corrupt checkpoint fully superseded by an intact
+                # delta chain: nothing is lost — move the corpse aside
+                # and open normally (detected + quarantined, not fatal)
+                m.quarantine_files(bad_files)
+                return m
+            detail = "corrupt checkpoint(s) newer than the loaded state"
+        if detail is not None:
+            raise ManifestCorruption(m, bad_files, detail,
+                                     tail_only=tail_only)
         return m
+
+    # ---- corruption handling (driven by the region open path) ----------
+    def quarantine_files(self, paths: list[str]) -> None:
+        """Move suspect files aside (``quarantine/`` subdir, preserved,
+        never deleted) so the recovered prefix can move forward without
+        colliding with their version numbers."""
+        for p in paths:
+            if not self.store.exists(p):
+                continue
+            fn = p.rsplit("/", 1)[-1]
+            self.store.rename(p, f"{self.dir}/quarantine/{fn}")
+            M_QUARANTINED.labels("manifest").inc()
+
+    def quarantine_region(self, reason: str) -> None:
+        """Uncovered loss: move suspects aside AND mark the region so
+        every future open fails loudly until an operator intervenes."""
+        self.store.write(
+            f"{self.dir}/{_QUARANTINE_MARKER}",
+            _encode_file({"reason": reason, "version": self.version}))
 
     @property
     def exists(self) -> bool:
@@ -130,25 +282,51 @@ class Manifest:
 
     # ---- mutation -------------------------------------------------------
     def commit(self, action: dict) -> int:
+        if action.get("kind") not in _KNOWN_KINDS:
+            raise ValueError(
+                f"unknown manifest action kind: {action.get('kind')}")
+        data = _encode_file(action)
+        after = None
+        if CHAOS.enabled:  # durability-boundary crash point + data faults
+            data, after = CHAOS.filter_io("manifest.delta", data)
+        # persist FIRST, apply on success: a failed write must leave the
+        # in-memory state at the on-disk version, or the next commit
+        # would write version+2 over a hole (the open-time gap check
+        # above would then refuse the whole manifest)
+        self.store.write(f"{self.dir}/delta-{self.version + 1:020d}.json",
+                         data)
+        if after is not None:
+            raise after
         self.state.apply(action)
         self.version += 1
-        self.store.write(
-            f"{self.dir}/delta-{self.version:020d}.json",
-            json.dumps(action).encode(),
-        )
         self._actions_since_checkpoint += 1
         if self._actions_since_checkpoint >= CHECKPOINT_EVERY:
             self.checkpoint()
         return self.version
 
     def checkpoint(self) -> None:
-        self.store.write(
-            f"{self.dir}/checkpoint-{self.version:020d}.json",
-            json.dumps(self.state.to_dict()).encode(),
-        )
+        path = f"{self.dir}/checkpoint-{self.version:020d}.json"
+        data = _encode_file(self.state.to_dict())
+        after = None
+        if CHAOS.enabled:  # durability-boundary crash point + data faults
+            data, after = CHAOS.filter_io("manifest.checkpoint", data)
+        self.store.write(path, data)
+        if after is not None:
+            raise after
+        # read-back verify BEFORE GC: the deltas this checkpoint
+        # supersedes are the only other copy of region metadata — they
+        # may only die once the replacement provably reads back clean
+        if _decode_file(self.store.read(path)) is None:
+            M_CORRUPTION.labels("manifest", "checkpoint").inc()
+            raise StorageError(
+                f"checkpoint {path} failed read-back verification; "
+                "superseded deltas retained")
         self._actions_since_checkpoint = 0
-        # GC superseded deltas/checkpoints
+        # GC superseded deltas/checkpoints (never the quarantine corner)
+        CHAOS.inject("manifest.gc")
         for p in self.store.list(self.dir):
+            if "/quarantine/" in p or p.endswith(_QUARANTINE_MARKER):
+                continue
             fn = p.rsplit("/", 1)[-1]
             if fn.startswith("delta-") and int(fn[6:-5]) <= self.version:
                 self.store.delete(p)

@@ -1,15 +1,23 @@
 """Object store abstraction (reference: src/object-store over OpenDAL).
 
-Only the operations the engine needs: atomic write, read, list, delete.
-``FsObjectStore`` is the local-disk backend; the interface is narrow enough
-that an S3/GCS backend is a drop-in (multipart + rename-free atomic write
-via temp object + copy).
+Only the operations the engine needs: atomic write, read, list, delete,
+rename.  ``FsObjectStore`` is the local-disk backend; the interface is
+narrow enough that an S3/GCS backend is a drop-in (multipart +
+rename-free atomic write via temp object + copy).
+
+Durability discipline (ISSUE 9): every FsObjectStore write is temp file
+→ write → fsync → rename → parent-directory fsync, so a power loss
+after ``write`` returns can lose neither the bytes nor the rename.  The
+local-disk chaos points (``fs.write`` torn/bitflip, ``fs.fsync``) hook
+this path with the zero-overhead-disabled ``CHAOS.enabled`` guard.
 """
 
 from __future__ import annotations
 
 import os
 import tempfile
+
+from greptimedb_tpu.utils.chaos import CHAOS
 
 
 class ObjectStore:
@@ -27,6 +35,14 @@ class ObjectStore:
 
     def delete(self, path: str) -> None:
         raise NotImplementedError
+
+    def rename(self, src: str, dst: str) -> None:
+        """Move an object (quarantine uses this: bytes must be PRESERVED
+        under the new name, never deleted).  Default is copy+delete —
+        fine for remote backends; disk backends override with a real
+        rename."""
+        self.write(dst, self.read(src))
+        self.delete(src)
 
     def local_path(self, path: str) -> str | None:
         """Filesystem path if this store is disk-backed (lets pyarrow mmap),
@@ -48,6 +64,19 @@ class ObjectStore:
         return 0
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a rename inside it survives power loss (the
+    half of atomic-replace durability os.replace alone does not give)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platforms without directory fds: best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 class FsObjectStore(ObjectStore):
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
@@ -64,14 +93,27 @@ class FsObjectStore(ObjectStore):
     def write(self, path: str, data: bytes) -> None:
         p = self._abs(path)
         os.makedirs(os.path.dirname(p), exist_ok=True)
-        # atomic: temp file + rename
+        after = None
+        if CHAOS.enabled:  # disk fault injection (zero-overhead disabled)
+            data, after = CHAOS.filter_io("fs.write", data)
+        # atomic: temp file + fsync + rename + parent dir fsync
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(p))
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
                 f.flush()
+                if after is not None:
+                    raise after  # torn write: prefix persisted, then die
+                if CHAOS.enabled:
+                    CHAOS.inject("fs.fsync")
                 os.fsync(f.fileno())
             os.replace(tmp, p)
+            # the rename itself must be durable: fsync the parent dir,
+            # or a power loss can roll the directory entry back to the
+            # old (or no) file even though write() returned success
+            if CHAOS.enabled:
+                CHAOS.inject("fs.fsync")
+            _fsync_dir(os.path.dirname(p))
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)
@@ -100,6 +142,14 @@ class FsObjectStore(ObjectStore):
         if os.path.exists(p):
             os.unlink(p)
 
+    def rename(self, src: str, dst: str) -> None:
+        s, d = self._abs(src), self._abs(dst)
+        os.makedirs(os.path.dirname(d), exist_ok=True)
+        os.replace(s, d)
+        _fsync_dir(os.path.dirname(d))
+        if os.path.dirname(s) != os.path.dirname(d):
+            _fsync_dir(os.path.dirname(s))
+
     def last_modified(self, path: str) -> float | None:
         try:
             return os.path.getmtime(self._abs(path))
@@ -127,8 +177,18 @@ class MemoryObjectStore(ObjectStore):
         return path.lstrip("/") in self._data
 
     def list(self, prefix: str) -> list[str]:
+        # directory semantics, matching FsObjectStore: prefix "r1" must
+        # not match "r10/..." — a bare prefix only matches itself or
+        # paths under "r1/" (manifest/GC listings must not bleed across
+        # regions whose ids share a decimal prefix)
         p = prefix.lstrip("/")
-        return sorted(k for k in self._data if k.startswith(p))
+        if not p or p.endswith("/"):
+            return sorted(k for k in self._data if k.startswith(p))
+        return sorted(k for k in self._data
+                      if k == p or k.startswith(p + "/"))
 
     def delete(self, path: str) -> None:
         self._data.pop(path.lstrip("/"), None)
+
+    def rename(self, src: str, dst: str) -> None:
+        self._data[dst.lstrip("/")] = self._data.pop(src.lstrip("/"))

@@ -23,6 +23,15 @@ import numpy as np
 from greptimedb_tpu.datatypes.batch import DictColumn, DictionaryEncoder
 from greptimedb_tpu.datatypes.schema import Schema, default_fill_array
 from greptimedb_tpu.errors import InvalidArguments, RegionNotFound, StorageError
+from greptimedb_tpu.storage.durability import (
+    M_QUARANTINED,
+    M_REPAIRED,
+    ManifestCorruption,
+    RegionQuarantined,
+    SstCorruption,
+    WalHole,
+    quarantine_object,
+)
 from greptimedb_tpu.storage.manifest import Manifest
 from greptimedb_tpu.storage.memtable import (
     Memtable, OP, OP_DELETE, OP_PUT, SEQ, TAGCODE_PREFIX, TSID, tagcode_col,
@@ -137,6 +146,14 @@ class Region:
         # files instead of rebuilding (storage/grid.py catch_up_grid_table)
         self.mutation_epoch = 0
         self._index_cache: dict[str, dict] = {}  # file_id -> column blooms
+        # durability repair hooks (ISSUE 9).  ``repair_source``: fetch a
+        # replica's copy of an object (path -> bytes | None), e.g.
+        # durability.repair_sst_from_peer over the Flight object plane.
+        # ``wal_resync``: fetch missing WAL records for a lost sequence
+        # range ((lo, hi) -> [(seq, payload)]), e.g.
+        # durability.resync_from_log_store / resync_from_peer_wal.
+        self.repair_source = None
+        self.wal_resync = None
 
     # ------------------------------------------------------------------
     @property
@@ -637,40 +654,101 @@ class Region:
 
         ``repair=False`` = read-only replay (followers sharing the leader's
         WAL dir must never truncate its active segment).
+
+        Corruption triage (ISSUE 9): a torn tail is truncated by the log
+        store (crash debris, correct); INTERIOR corruption — a lost acked
+        sequence range in the middle of the log — is resynced from
+        ``self.wal_resync`` (remote WAL / follower replica) and the
+        damaged segment healed; without a covering resync source the open
+        raises WalHole instead of silently dropping acked writes (the
+        damaged bytes stay quarantined in sidecars either way).
         """
+        from_seq = self.manifest.state.flushed_seq + 1
         count = 0
-        for seq, payload in self.wal.replay(
-            self.manifest.state.flushed_seq + 1, repair=repair
-        ):
-            cols, op = decode_write_full(payload)
-            chunk: dict[str, np.ndarray] = {}
-            for c in self.schema:
-                arr = cols[c.name]
-                if c.dtype.is_string_like:
-                    chunk[c.name] = np.asarray(arr.to_pylist(), dtype=object)
-                else:
-                    chunk[c.name] = arr.to_numpy(zero_copy_only=False).astype(
-                        np.int64 if c.dtype.is_timestamp else c.dtype.to_numpy()
-                    )
-            n = len(chunk[self.ts_name])
-            tag_codes: dict[str, np.ndarray] = {}
-            chunk[TSID] = self._encode_tags(chunk, n, out_codes=tag_codes)
-            for tname, tcodes in tag_codes.items():
-                chunk[tagcode_col(tname)] = tcodes
-            # slim payloads derive __seq__/__op__ (header sequence +
-            # metadata op); pre-slimming records still carry the columns
-            # and replay them verbatim
-            chunk[SEQ] = (cols[SEQ].to_numpy(zero_copy_only=False)
-                          if SEQ in cols else np.full(n, seq, dtype=np.int64))
-            chunk[OP] = (cols[OP].to_numpy(zero_copy_only=False)
-                         .astype(np.int8)
-                         if OP in cols else np.full(n, op, dtype=np.int8))
-            self.memtable.append(chunk)
-            self.next_seq = max(self.next_seq, seq + 1)
+        for seq, payload in self.wal.replay(from_seq, repair=repair):
+            self._apply_wal_record(seq, payload)
             count += 1
+        if repair:
+            count += self._resync_wal_holes(from_seq)
         if count:
             self.generation += 1
             self._mark_structure_change()
+        return count
+
+    def _decode_wal_chunk(self, seq: int, payload: bytes) -> dict:
+        """One WAL record → memtable chunk (codes/tsids recomputed)."""
+        cols, op = decode_write_full(payload)
+        chunk: dict[str, np.ndarray] = {}
+        for c in self.schema:
+            arr = cols[c.name]
+            if c.dtype.is_string_like:
+                chunk[c.name] = np.asarray(arr.to_pylist(), dtype=object)
+            else:
+                chunk[c.name] = arr.to_numpy(zero_copy_only=False).astype(
+                    np.int64 if c.dtype.is_timestamp else c.dtype.to_numpy()
+                )
+        n = len(chunk[self.ts_name])
+        tag_codes: dict[str, np.ndarray] = {}
+        chunk[TSID] = self._encode_tags(chunk, n, out_codes=tag_codes)
+        for tname, tcodes in tag_codes.items():
+            chunk[tagcode_col(tname)] = tcodes
+        # slim payloads derive __seq__/__op__ (header sequence +
+        # metadata op); pre-slimming records still carry the columns
+        # and replay them verbatim
+        chunk[SEQ] = (cols[SEQ].to_numpy(zero_copy_only=False)
+                      if SEQ in cols else np.full(n, seq, dtype=np.int64))
+        chunk[OP] = (cols[OP].to_numpy(zero_copy_only=False)
+                     .astype(np.int8)
+                     if OP in cols else np.full(n, op, dtype=np.int8))
+        return chunk
+
+    def _apply_wal_record(self, seq: int, payload: bytes) -> None:
+        self.memtable.append(self._decode_wal_chunk(seq, payload))
+        self.next_seq = max(self.next_seq, seq + 1)
+
+    def _resync_wal_holes(self, from_seq: int) -> int:
+        """Repair interior WAL corruption found by the last replay pass:
+        fetch the lost sequence range from ``wal_resync``, re-log it
+        durably, apply it, and heal the damaged segments.  Raises WalHole
+        when acked sequences are lost and no source covers them."""
+        triage = getattr(self.wal, "last_triage", None)
+        if not triage:
+            return 0
+        holes: list[tuple[int, int | None]] = []
+        for d in triage:
+            if d.kind != "interior":
+                continue
+            r = d.lost_range()
+            if r is None:
+                continue  # pure garbage between consecutive sequences
+            lo, hi = r
+            lo = max(lo, from_seq)
+            if hi is not None and hi < lo:
+                continue  # entirely below flushed_seq: already in SSTs
+            holes.append((lo, hi))
+        if not holes:
+            # nothing recoverable was lost; drop the damaged spans (the
+            # sidecars keep the original bytes)
+            self.wal.heal()
+            return 0
+        if self.wal_resync is None:
+            raise WalHole(self.region_id, holes)
+        count = 0
+        for lo, hi in holes:
+            fetched = sorted(self.wal_resync(
+                lo, hi if hi is not None else (1 << 62)))
+            # the source is the authority on what existed: sequences it
+            # lacks may simply never have been written (failed appends
+            # burn sequences) — but a source with NOTHING for the hole
+            # is indistinguishable from loss, so declare it loudly
+            if not fetched:
+                raise WalHole(self.region_id, [(lo, hi)])
+            for seq, payload in fetched:
+                self.wal.append(seq, payload)  # re-log durably FIRST
+                self._apply_wal_record(seq, payload)
+                count += 1
+            M_REPAIRED.labels("wal", "resync").inc(len(fetched))
+        self.wal.heal()
         return count
 
     # ---- compaction (TWCS-lite) ---------------------------------------
@@ -685,7 +763,13 @@ class Region:
         self.apply_ttl()
         for _win, files in self._windows().items():
             if len(files) >= self.options.compaction_trigger_files:
-                self.compact_files(files)
+                try:
+                    self.compact_files(files)
+                except SstCorruption as e:
+                    # corrupt input quarantined (or repaired): skip this
+                    # window now; the next flush re-triggers it over the
+                    # surviving/repaired file set
+                    self._handle_sst_corruption(e)
 
     @staticmethod
     def _now_ms() -> int:
@@ -792,9 +876,17 @@ class Region:
         if self.memtable.num_rows:
             self.flush()
         self.apply_ttl()
-        files = self.sst_files
-        if files:
-            self.compact_files(files)
+        for _attempt in range(8):
+            files = self.sst_files
+            if not files:
+                return
+            try:
+                self.compact_files(files)
+                return
+            except SstCorruption as e:
+                # quarantine/repair the bad input, retry over the
+                # refreshed live set
+                self._handle_sst_corruption(e)
 
     def truncate(self) -> None:
         for m in self.sst_files:
@@ -818,7 +910,27 @@ class Region:
         on the shared segment."""
         from greptimedb_tpu.storage.manifest import Manifest
 
-        self.manifest = Manifest.open(self.store, f"{self._dir}/manifest")
+        try:
+            self.manifest = Manifest.open(self.store, f"{self._dir}/manifest")
+        except ManifestCorruption as mc:
+            # same recovery gate as engine open: proceed on the good
+            # prefix only when OUR replayable WAL covers the lost
+            # actions.  Only an ownership-taking catch-up (leader
+            # upgrade) may move the suspect files aside — followers stay
+            # read-only on shared storage.
+            floor = None
+            for seq, _p in self.wal.replay(0, repair=False):
+                floor = seq
+                break
+            covered = (mc.tail_only and mc.manifest.exists
+                       and floor is not None
+                       and floor <= mc.manifest.state.flushed_seq + 1)
+            if not covered:
+                raise
+            if take_ownership:
+                mc.manifest.quarantine_files(mc.bad_files)
+            M_REPAIRED.labels("manifest", "wal_replay").inc()
+            self.manifest = mc.manifest
         state = self.manifest.state
         # adopt the manifest schema FIRST: the leader may have added tag
         # columns online (add_tag_column), and encoders built from the stale
@@ -924,8 +1036,103 @@ class Region:
         self._index_cache[meta.file_id] = idx
         return idx
 
+    # ---- SST corruption: quarantine + repair ---------------------------
+    def _handle_sst_corruption(self, exc: SstCorruption) -> str:
+        """A verified read failed: move the damaged file aside (bytes
+        preserved), then repair from a replica (``repair_source``) or
+        re-flush from the WAL when the file's sequence range survived
+        truncation; otherwise pull it from the live set via a manifest
+        quarantine action so the region keeps serving its remaining
+        files.  Returns "repaired" or "quarantined" (both mean: retry the
+        read)."""
+        meta = exc.meta
+        with self._write_lock:
+            if meta.file_id not in self.manifest.state.files:
+                return "quarantined"  # another thread already handled it
+            try:
+                quarantine_object(self.store, meta.path)
+            except (KeyError, OSError):
+                pass  # file vanished entirely: nothing left to preserve
+            M_QUARANTINED.labels("sst").inc()
+            self._index_cache.pop(meta.file_id, None)
+            # 1) replica repair over the Flight object plane
+            if self.repair_source is not None:
+                from greptimedb_tpu.storage.sst import verify_sst_bytes
+
+                data = self.repair_source(meta.path)
+                if data is not None and verify_sst_bytes(data):
+                    self.store.write(meta.path, data)
+                    M_REPAIRED.labels("sst", "replica").inc()
+                    return "repaired"
+            # 2) WAL re-flush: a flush-produced file whose sequence range
+            # is still fully in the log (truncation crashed or lagged)
+            if self._reflush_sst_from_wal(meta):
+                M_REPAIRED.labels("sst", "wal").inc()
+                self.generation += 1
+                self._mark_structure_change()
+                return "repaired"
+            # 3) serve around it, loudly: the quarantine action pulls the
+            # file from the live set and records it in manifest state
+            self.manifest.commit(
+                {"kind": "quarantine", "file_id": meta.file_id})
+            self.generation += 1
+            self._mark_structure_change()
+            return "quarantined"
+
+    def _reflush_sst_from_wal(self, meta) -> bool:
+        """Rebuild a corrupt SST from WAL records covering exactly its
+        sequence range (valid for flush-produced files: one freeze, one
+        contiguous range).  Commits a replace edit on success."""
+        recs = []
+        for s, p in self.wal.replay(meta.seq_min, repair=False):
+            if meta.seq_min <= s <= meta.seq_max:
+                recs.append((s, p))
+        got = {s for s, _ in recs}
+        if got != set(range(meta.seq_min, meta.seq_max + 1)):
+            return False  # not fully covered: never rebuild a partial file
+        mt = Memtable(self.schema)
+        for s, p in sorted(recs):
+            mt.append(self._decode_wal_chunk(s, p))
+        frozen = mt.freeze(dedup=not self.options.append_mode)
+        new_meta = write_sst(
+            self.store, f"{self._dir}/sst", self.schema, frozen,
+            level=meta.level,
+            tag_dicts={k: enc.values() for k, enc in self.encoders.items()},
+        )
+        self._write_sst_index(new_meta, frozen)
+        self.manifest.commit({
+            "kind": "edit",
+            "add": [new_meta.to_dict()],
+            "remove": [meta.file_id],
+        })
+        return True
+
     # ---- read path -----------------------------------------------------
     def scan_host(
+        self,
+        ts_range: tuple[int | None, int | None] = (None, None),
+        columns: list[str] | None = None,
+        tag_filters: dict[str, set] | None = None,
+        tag_preds: dict[str, object] | None = None,
+        ft_tokens: dict[str, list] | None = None,
+        with_tag_codes: bool = False,
+    ) -> dict[str, np.ndarray]:
+        """Verified scan: on SST corruption the file is quarantined (and
+        repaired from a replica / WAL re-flush when covered) and the scan
+        retries — the region keeps serving from its remaining files; the
+        corrupt bytes are never merged into results.  See
+        ``_scan_host_impl`` for the scan machinery itself."""
+        for _attempt in range(8):
+            try:
+                return self._scan_host_impl(ts_range, columns, tag_filters,
+                                            tag_preds, ft_tokens,
+                                            with_tag_codes)
+            except SstCorruption as e:
+                self._handle_sst_corruption(e)
+        return self._scan_host_impl(ts_range, columns, tag_filters,
+                                    tag_preds, ft_tokens, with_tag_codes)
+
+    def _scan_host_impl(
         self,
         ts_range: tuple[int | None, int | None] = (None, None),
         columns: list[str] | None = None,
@@ -1105,6 +1312,11 @@ class RegionEngine:
         # optional WorkloadMemoryManager shared by all regions (ingest
         # write-buffer quota); settable post-init by the embedding app
         self.memory = memory
+        # region_id -> {"repair_source": ..., "wal_resync": ...}: repair
+        # hooks installed on a region BEFORE its open-time WAL replay, so
+        # interior corruption found at open can resync instead of raising
+        # (meta/cluster.py wire_repair_sources sets the live equivalents)
+        self.repair_hooks: dict[int, dict] = {}
 
     def _log_store(self, region_id: int):
         if self.log_store_factory is None:
@@ -1113,6 +1325,59 @@ class RegionEngine:
 
     def _wal_dir(self, region_id: int) -> str:
         return os.path.join(self.data_home, f"region_{region_id}", "wal")
+
+    # ---- manifest corruption recovery (ISSUE 9) ------------------------
+    def _wal_floor(self, region_id: int) -> int | None:
+        """Smallest sequence still replayable from the region's WAL, or
+        None when the log is empty/absent — the cover probe for manifest
+        recovery."""
+        log = self._log_store(region_id)
+        close = False
+        if log is None:
+            wal_dir = self._wal_dir(region_id)
+            if not os.path.isdir(wal_dir):
+                return None
+            log = FileLogStore(wal_dir)
+            close = True
+        try:
+            for seq, _payload in log.replay(0, repair=False):
+                return seq
+            return None
+        finally:
+            if close:
+                log.close()
+
+    def _open_manifest_verified(self, region_id: int) -> Manifest:
+        """Manifest.open with corrupt-delta recovery: when verification
+        fails past a good prefix, recover through WAL replay if the log
+        covers everything since the prefix's flushed_seq (suspect files
+        move to ``quarantine/``, open proceeds, replay restores the data
+        actions); otherwise quarantine the REGION — files moved aside,
+        marker written, open fails loudly until an operator intervenes.
+        Never silently applies metadata over a hole."""
+        try:
+            return Manifest.open(self.store, f"region_{region_id}/manifest")
+        except ManifestCorruption as mc:
+            m = mc.manifest
+            floor = self._wal_floor(region_id)
+            # recoverable ONLY when (a) the damage is tail-shaped (the
+            # lost action was the unacked commit a crash tore — an acked
+            # mid-chain action could be a schema/dicts change WAL replay
+            # cannot re-derive) and (b) the WAL actually replays from
+            # the prefix's flushed_seq
+            covered = (mc.tail_only and m.exists and floor is not None
+                       and floor <= m.state.flushed_seq + 1)
+            m.quarantine_files(mc.bad_files)
+            if not covered:
+                m.quarantine_region(mc.detail)
+                raise RegionQuarantined(
+                    f"region {region_id}: {mc.detail}; not recoverable "
+                    f"(tail_only={mc.tail_only}, WAL floor={floor}, "
+                    f"prefix flushed_seq={m.state.flushed_seq}) — region "
+                    "quarantined, files preserved under manifest/"
+                    "quarantine/") from mc
+            M_REPAIRED.labels("manifest", "wal_replay").inc()
+            return m
 
     def create_region(
         self, region_id: int, schema: Schema,
@@ -1145,7 +1410,7 @@ class RegionEngine:
         manifest open is checkpoint+delta reads, costly on object stores."""
         if region_id in self.regions:
             return self.regions[region_id]
-        manifest = Manifest.open(self.store, f"region_{region_id}/manifest")
+        manifest = self._open_manifest_verified(region_id)
         if manifest.exists:
             return self.open_region(region_id, _manifest=manifest)
         # create path re-opens fresh: the immediately-pre-commit existence
@@ -1160,8 +1425,8 @@ class RegionEngine:
         torn tails the live leader may still be appending."""
         if region_id in self.regions:
             return self.regions[region_id]
-        manifest = _manifest if _manifest is not None else Manifest.open(
-            self.store, f"region_{region_id}/manifest")
+        manifest = (_manifest if _manifest is not None
+                    else self._open_manifest_verified(region_id))
         if not manifest.exists:
             raise RegionNotFound(f"region {region_id} not found in {self.data_home}")
         opts = RegionOptions(**manifest.state.options) if manifest.state.options else self.default_options
@@ -1169,6 +1434,9 @@ class RegionEngine:
                         self._wal_dir(region_id), opts,
                         log_store=self._log_store(region_id),
                         memory=self.memory)
+        hooks = self.repair_hooks.get(region_id) or {}
+        region.repair_source = hooks.get("repair_source")
+        region.wal_resync = hooks.get("wal_resync")
         region.replay_wal(repair=take_ownership)
         self.regions[region_id] = region
         return region
@@ -1196,13 +1464,22 @@ class RegionEngine:
             region = self.regions.get(rid)
             if region is not None:
                 files = region.sst_files
+                quarantined = region.manifest.state.quarantined
             else:
-                manifest = Manifest.open(self.store, f"region_{rid}/manifest")
+                try:
+                    manifest = Manifest.open(
+                        self.store, f"region_{rid}/manifest")
+                except (ManifestCorruption, RegionQuarantined):
+                    continue  # unverifiable live set: GC must not guess
                 if not manifest.exists:
                     continue  # not a region we can reason about: skip
                 files = list(manifest.state.files.values())
+                quarantined = manifest.state.quarantined
             live = {m.path for m in files}
             live |= {f"region_{rid}/sst/{m.file_id}.idx" for m in files}
+            # quarantined SSTs stay repairable: never GC their objects
+            live |= {d["path"] for d in quarantined.values()}
+            live |= {f"region_{rid}/sst/{fid}.idx" for fid in quarantined}
             prefix = f"region_{rid}/sst"
             for path in self.store.list(prefix):
                 if path in live:
@@ -1233,7 +1510,23 @@ class RegionEngine:
         if region is not None:
             region.wal.close()
 
-    def close(self) -> None:
+    def close(self, flush: bool = False) -> None:
+        """Close WAL/segment handles; with ``flush=True`` (the graceful
+        SIGTERM shutdown path — standalone CLI, datanode serve) dirty
+        regions flush first, their WALs truncate to the hot tail, and a
+        clean restart replays O(recent) instead of the full log.  The
+        default stays cheap for embedders/tests — a dirty region simply
+        replays on the next open (the crash path, which is exercised
+        constantly).  Flush failures are surfaced on stderr but never
+        block the close."""
         for r in self.regions.values():
+            if flush:
+                try:
+                    r.flush()
+                except Exception as e:  # noqa: BLE001 — shutdown must
+                    import sys as _sys   # finish; replay covers the rest
+
+                    print(f"flush-on-close failed for region "
+                          f"{r.region_id}: {e}", file=_sys.stderr)
             r.wal.close()
         self.regions.clear()

@@ -139,12 +139,15 @@ class SharedLogBroker:
             wm = self._load_wm(topic)
             wm[str(region_id)] = max(int(wm.get(str(region_id), 0)), sequence)
             self._prune(topic, wm)
-            # atomic replace: a crash mid-write must never corrupt the
-            # marker (a broken marker would wedge flush/prune forever)
+            # atomic replace + fsync: a crash mid-write must never corrupt
+            # the marker (a broken marker would wedge flush/prune forever),
+            # and the rename must be durable before pruning relies on it
             path = self._wm_path(topic)
             tmp = path + ".tmp"
-            with open(tmp, "w") as f:
+            with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(wm, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
 
     def _prune(self, topic: str, wm: dict) -> None:

@@ -143,7 +143,14 @@ class S3ObjectStore(ObjectStore):
                 if method == "GET":
                     CHAOS.inject("s3.read")  # injected object-store fault
                 with urllib.request.urlopen(req) as resp:
-                    return resp.status, resp.read()
+                    body = resp.read()
+                    if method == "GET" and CHAOS.enabled:
+                        # silent-bit-rot shape: the read "succeeds" with
+                        # one corrupt byte — downstream verification
+                        # (parquet page checksums, manifest CRCs) must
+                        # catch it, not this layer
+                        body, _ = CHAOS.filter_io("s3.read.payload", body)
+                    return resp.status, body
             except urllib.error.HTTPError as e:
                 if e.code == 404:
                     return 404, b""

@@ -21,8 +21,10 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from greptimedb_tpu.datatypes.schema import Schema, default_fill_array
+from greptimedb_tpu.storage.durability import M_CORRUPTION, SstCorruption
 from greptimedb_tpu.storage.memtable import OP, SEQ, TSID, tagcode_col
 from greptimedb_tpu.storage.object_store import ObjectStore
+from greptimedb_tpu.utils.chaos import CHAOS
 from greptimedb_tpu.utils.telemetry import REGISTRY
 
 # per-row python-object materializations for dictionary-encoded string
@@ -149,9 +151,17 @@ def write_sst(
         compression_level=1,
         use_dictionary=True,
         write_statistics=True,
+        # page-level CRCs (ISSUE 9): every scan/compaction read verifies
+        # them, so silent bit rot is detected instead of served
+        write_page_checksum=True,
     )
     data = sink.getvalue()
+    after = None
+    if CHAOS.enabled:  # durability-boundary crash point + data faults
+        data, after = CHAOS.filter_io("sst.write", data)
     store.write(path, data)
+    if after is not None:
+        raise after
     ts = columns[ts_col]
     seq = columns[SEQ]
     return SstMeta(
@@ -244,19 +254,34 @@ def read_sst(
     M_SCAN_BYTES.inc(
         meta.size_bytes * min(1.0, max(0.0, (eff_hi - eff_lo) / span)))
     local = store.local_path(meta.path)
-    src = local if local else io.BytesIO(store.read(meta.path))
+    if CHAOS.enabled and local is not None:
+        # disk fault injection on the SST read path: route the mmap-able
+        # local file through a byte read so bitflip faults apply
+        data, _ = CHAOS.filter_io("sst.read", store.read(meta.path))
+        local, src = None, io.BytesIO(data)
+    else:
+        src = local if local else io.BytesIO(store.read(meta.path))
     internal = (TSID, SEQ, OP)
     schema_cols = {c.name for c in schema}
-    if meta.columns is not None:
-        present = set(meta.columns)
-    else:  # legacy meta: one footer read to learn the file's columns
-        present = set(pq.read_schema(src).names)
-        if isinstance(src, io.BytesIO):
-            src.seek(0)
-    want = columns if columns is not None else (list(schema_cols) + list(internal))
-    want = list(dict.fromkeys(want))
-    read_cols = [c for c in want if c in present]
-    table = pq.read_table(src, columns=read_cols, filters=filters)
+    try:
+        if meta.columns is not None:
+            present = set(meta.columns)
+        else:  # legacy meta: one footer read to learn the file's columns
+            present = set(pq.read_schema(src).names)
+            if isinstance(src, io.BytesIO):
+                src.seek(0)
+        want = (columns if columns is not None
+                else (list(schema_cols) + list(internal)))
+        want = list(dict.fromkeys(want))
+        read_cols = [c for c in want if c in present]
+        # page_checksum_verification: decode fails loudly on bit rot —
+        # the scan layer quarantines the file and repairs/serves around
+        # it instead of returning corrupt rows
+        table = pq.read_table(src, columns=read_cols, filters=filters,
+                              page_checksum_verification=True)
+    except (OSError, ValueError, KeyError, pa.ArrowException) as e:
+        M_CORRUPTION.labels("sst", "read").inc()
+        raise SstCorruption(meta, e) from e
 
     out: dict[str, np.ndarray] = {}
     for name in table.column_names:
@@ -299,7 +324,7 @@ def read_sst(
         else:
             out[name] = arr.to_numpy(zero_copy_only=False)
     # schema evolution: backfill columns added after this SST was written
-    n = len(out[SEQ]) if SEQ in out else (table.num_rows)
+    n = len(out[SEQ]) if SEQ in out else table.num_rows
     for c in schema:
         if c.name in want and c.name not in out:
             enc = ((tag_encoders or {}).get(c.name)
@@ -317,3 +342,14 @@ def read_sst(
                     continue  # the code companion IS the column
             out[c.name] = default_fill_array(c, n)
     return out
+
+
+def verify_sst_bytes(data: bytes) -> bool:
+    """Full checksummed decode of candidate SST bytes — repair validation:
+    a replica's copy must prove readable (page checksums included) before
+    it replaces a quarantined file."""
+    try:
+        pq.read_table(io.BytesIO(data), page_checksum_verification=True)
+        return True
+    except (OSError, ValueError, KeyError, pa.ArrowException):
+        return False

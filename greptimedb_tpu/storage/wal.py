@@ -8,9 +8,24 @@ the flushed sequence (SURVEY.md §5.4 mechanism 1). A Kafka-style remote WAL
 can implement the same LogStore interface later.
 
 Record format (little-endian): [u32 len][u32 crc32(payload)][u64 sequence]
-[payload]. Torn tails (crash mid-append) are detected by length/CRC and
-truncated on replay. Payloads are columnar row groups serialized with
-Arrow IPC — portable and fast, no pickle.
+[u32 crc32(header)][payload]. The header CRC covers the 16-byte
+(len, payload-crc, sequence) prefix so a bit flip ANYWHERE in a record —
+including the sequence field — is detected; a payload-only checksum
+would let a flipped sequence replay as a wrong-but-valid record.
+Payloads are columnar row groups serialized with Arrow IPC — portable
+and fast, no pickle.
+
+Corruption triage (ISSUE 9, the raft-engine recovery-modes analog):
+replay distinguishes a **torn tail** (crash debris at the end of the
+active segment — truncated, today's behavior, correct) from **interior
+corruption** (bit rot inside acked records): on a bad record it scans
+forward for the next valid record boundary, counts the event in
+``greptime_durability_corruption_total{store="wal",kind=...}``, copies
+the damaged bytes to a ``.quarantine`` sidecar (originals preserved,
+never deleted), keeps replaying past the hole, and reports the lost
+sequence range in ``last_triage`` so the region can resync it from the
+remote WAL or a follower replica before declaring data loss
+(``heal()`` then compacts the damaged span out of the segment).
 
 Group commit (``GREPTIME_WAL_GROUP_COMMIT``, default on): concurrent
 appenders hand their encoded records to a per-log committer; one of them
@@ -34,13 +49,19 @@ import struct
 import threading
 import time
 import zlib
+from dataclasses import dataclass
 
 import pyarrow as pa
 import pyarrow.ipc
 
+from greptimedb_tpu.storage.durability import M_CORRUPTION, M_QUARANTINED
 from greptimedb_tpu.utils import telemetry
+from greptimedb_tpu.utils.chaos import CHAOS
 
+# record header: [u32 len][u32 crc32(payload)][u64 seq] + [u32 crc32(hdr)]
 _HDR = struct.Struct("<IIQ")
+_HCRC = struct.Struct("<I")
+_REC_HDR = _HDR.size + _HCRC.size  # 20 bytes
 _SEGMENT_TARGET = 64 * 1024 * 1024
 
 # CRC of record payloads: the C++ helper (same polynomial, sliced table)
@@ -60,6 +81,146 @@ def _payload_crc(payload: bytes) -> int:
     if _crc32 is not None and len(payload) >= 1 << 16:
         return _crc32(payload)
     return zlib.crc32(payload)
+
+
+def _pack_record(sequence: int, payload: bytes) -> bytes:
+    hdr = _HDR.pack(len(payload), _payload_crc(payload), sequence)
+    return hdr + _HCRC.pack(zlib.crc32(hdr)) + payload
+
+
+def _native():
+    try:
+        from greptimedb_tpu import native
+    except ImportError:
+        return None
+    return native
+
+
+def _scan(data: bytes, off: int, native_mod):
+    """Scan valid records from ``off``; returns ``(spans, end)`` where
+    spans are (seq, payload_off, payload_len) and ``end`` is the offset
+    after the last valid record (== len(data) on a clean scan)."""
+    if native_mod is not None:
+        view = data if off == 0 else data[off:]
+        scanned = native_mod.wal_scan(view, 0)
+        if scanned is not None:
+            spans, end = scanned
+            if off:
+                spans = [(s, o + off, ln) for s, o, ln in spans]
+                end += off
+            return spans, end
+    spans = []
+    n = len(data)
+    while off + _REC_HDR <= n:
+        ln, crc, seq = _HDR.unpack_from(data, off)
+        (hcrc,) = _HCRC.unpack_from(data, off + _HDR.size)
+        if zlib.crc32(data[off:off + _HDR.size]) != hcrc:
+            break
+        end = off + _REC_HDR + ln
+        if end > n:
+            break
+        if zlib.crc32(data[off + _REC_HDR:end]) != crc:
+            break
+        spans.append((seq, off + _REC_HDR, ln))
+        off = end
+    return spans, off
+
+
+def _parse_v1(data: bytes, off: int):
+    """Legacy 16-byte-header record ([len][crc(payload)][seq], no header
+    CRC) at ``off`` — read compatibility for data homes written before
+    the v2 format (tests/compat fixtures).  Returns (seq, payload_off,
+    payload_len) or None.  Only consulted where a v2 parse failed, so a
+    v2 record never misreads as v1."""
+    if off + _HDR.size > len(data):
+        return None
+    ln, crc, seq = _HDR.unpack_from(data, off)
+    end = off + _HDR.size + ln
+    if end > len(data):
+        return None
+    if zlib.crc32(data[off + _HDR.size:end]) != crc:
+        return None
+    return seq, off + _HDR.size, ln
+
+
+def _walk(data: bytes, native_mod):
+    """Classify a segment byte-exactly into record and damage spans:
+    yields ``("rec", seq, payload_off, payload_len, rec_start, rec_end)``
+    for every valid record (v2, or legacy v1 where v2 fails) and
+    ``("gap", start, end)`` for invalid spans (``end == len(data)``:
+    damage reaches EOF).  Shared by replay, heal and truncate so all
+    three agree on what a segment contains."""
+    off = 0
+    n = len(data)
+    while off < n:
+        spans, end = _scan(data, off, native_mod)
+        for seq, poff, ln in spans:
+            yield ("rec", seq, poff, ln, poff - _REC_HDR, poff + ln)
+        if end >= n:
+            return
+        v1 = _parse_v1(data, end)
+        if v1 is not None:
+            # consume the whole legacy run inline: re-entering the v2
+            # scanner (which slices data[off:] for the native library)
+            # per record would make a long v1 segment O(n^2) in copies
+            off = end
+            while v1 is not None:
+                seq, poff, ln = v1
+                yield ("rec", seq, poff, ln, off, poff + ln)
+                off = poff + ln
+                v1 = _parse_v1(data, off)
+            continue
+        nxt = _next_boundary(data, end + 1, native_mod)
+        yield ("gap", end, nxt if nxt is not None else n)
+        if nxt is None:
+            return
+        off = nxt
+
+
+def _next_boundary(data: bytes, start: int,
+                   native_mod=None) -> int | None:
+    """Byte-scan forward for the next offset holding a fully valid record
+    (header CRC + bounds + payload CRC) — the interior-corruption resync
+    point.  None = no valid record follows (damage reaches EOF)."""
+    if native_mod is not None:
+        l = native_mod.lib()
+        if l is not None and not getattr(l, "_gt_no_wal", False):
+            return native_mod.wal_find_boundary(data, start)
+    n = len(data)
+    for off in range(max(0, start), n - _REC_HDR + 1):
+        ln, crc, _seq = _HDR.unpack_from(data, off)
+        (hcrc,) = _HCRC.unpack_from(data, off + _HDR.size)
+        if zlib.crc32(data[off:off + _HDR.size]) != hcrc:
+            continue
+        end = off + _REC_HDR + ln
+        if end > n:
+            continue
+        if zlib.crc32(data[off + _REC_HDR:end]) != crc:
+            continue
+        return off
+    return None
+
+
+@dataclass
+class WalDamage:
+    """One triaged corruption event from a replay pass."""
+
+    path: str          # segment file
+    kind: str          # "torn_tail" | "interior"
+    start: int         # damaged byte span [start, end) within the segment
+    end: int
+    prev_seq: int | None  # last valid sequence before the damage
+    next_seq: int | None  # first valid sequence after (None: none found)
+
+    def lost_range(self) -> tuple[int, int | None] | None:
+        """Inclusive sequence range the damage may have destroyed, or
+        None when nothing can be missing (pure garbage between two
+        consecutive sequences).  ``(lo, None)`` = open-ended."""
+        lo = (self.prev_seq + 1) if self.prev_seq is not None else 1
+        if self.next_seq is None:
+            return None if self.kind == "torn_tail" else (lo, None)
+        hi = self.next_seq - 1
+        return None if hi < lo else (lo, hi)
 
 M_WAL_BATCH = telemetry.REGISTRY.histogram(
     "greptime_ingest_wal_batch_size",
@@ -185,6 +346,8 @@ class FileLogStore(LogStore):
         if group_commit is None:
             group_commit = group_commit_enabled()
         self._gc = _GroupCommitter(self) if group_commit else None
+        # corruption triage report of the most recent replay() pass
+        self.last_triage: list[WalDamage] = []
 
     def _seg_path(self, seg_id: int) -> str:
         return os.path.join(self.dir, f"{seg_id:020d}.wal")
@@ -198,18 +361,37 @@ class FileLogStore(LogStore):
 
     def _flush_records(self, data: bytes, count: int) -> None:
         """One buffered write + flush (+ fsync) for ``count`` records —
-        the single IO round-trip a whole commit group shares."""
-        self._fh.write(data)
-        self._fh.flush()
-        if self.sync:
-            os.fsync(self._fh.fileno())
-            M_WAL_FSYNCS.inc()
+        the single IO round-trip a whole commit group shares.
+
+        A failed/torn flush rolls the file back to the pre-flush offset:
+        a survivable write error is surfaced to the appenders (nothing
+        acked) and must not leave half-records that later appends would
+        bury as interior corruption — only a real crash leaves a torn
+        tail, and replay truncates that."""
+        after = None
+        if CHAOS.enabled:  # disk fault injection: torn/bitflip/error/kill
+            data, after = CHAOS.filter_io("wal.flush", data)
+        pos = self._fh.tell()
+        try:
+            self._fh.write(data)
+            self._fh.flush()
+            if after is not None:
+                raise after  # torn write: prefix persisted, then fail
+            if self.sync:
+                os.fsync(self._fh.fileno())
+                M_WAL_FSYNCS.inc()
+        except BaseException:
+            try:
+                self._fh.truncate(pos)
+            except OSError:
+                pass  # rollback is best-effort; replay triage covers it
+            raise
         M_WAL_BATCH.observe(count)
         if self._fh.tell() >= _SEGMENT_TARGET:
             self._roll()
 
     def append(self, sequence: int, payload: bytes) -> None:
-        rec = _HDR.pack(len(payload), _payload_crc(payload), sequence) + payload
+        rec = _pack_record(sequence, payload)
         if self._gc is not None:
             self._gc.wait(self._gc.enqueue(rec))
             return
@@ -225,7 +407,7 @@ class FileLogStore(LogStore):
         shared-log broker) enqueue inside it and wait OUTSIDE it — the
         group commit then merges appends from many topics/regions into
         one fsync."""
-        rec = _HDR.pack(len(payload), _payload_crc(payload), sequence) + payload
+        rec = _pack_record(sequence, payload)
         if self._gc is None:
             # synchronous path: write now, nothing to wait for
             self._flush_records(rec, 1)
@@ -240,63 +422,147 @@ class FileLogStore(LogStore):
 
     def replay(self, from_sequence: int = 0, repair: bool = True):
         """Yield (sequence, payload) for entries with sequence >= from_sequence.
-        Stops at the first torn/corrupt record; with ``repair`` (write
-        ownership — leader open/recovery) the torn tail is truncated so
-        future appends start clean.  Followers replaying a WAL directory
+
+        Corruption triage instead of stop-at-first-error: a **torn tail**
+        (damage reaching EOF of the final segment) is truncated under
+        ``repair`` — crash debris, today's behavior, correct; **interior**
+        damage (a valid record boundary exists beyond it) is counted,
+        copied to a ``.quarantine`` sidecar (repair mode), and replay
+        CONTINUES from the next boundary — acked records after bit rot
+        are never silently discarded.  Every event lands in
+        ``self.last_triage`` with the lost sequence range, so the region
+        can resync the hole (remote WAL / follower replica) and then
+        ``heal()`` the segment.  Followers replaying a WAL directory
         shared with a live leader MUST pass repair=False: a partially
         flushed leader append would otherwise be destroyed mid-write."""
-        try:
-            from greptimedb_tpu import native
-        except ImportError:
-            native = None
-        for seg in self._segments():
+        native = _native()
+        self.last_triage = []
+        pending: WalDamage | None = None
+        # carried ACROSS segments: damage at the head of segment k+1 must
+        # bound its lost range from segment k's last record, not from 1
+        last_seq: int | None = None
+        segs = self._segments()
+        for idx, seg in enumerate(segs):
             path = self._seg_path(seg)
             with open(path, "rb") as f:
                 data = f.read()
-            good_end = 0
-            scanned = native.wal_scan(data, from_sequence) if native else None
-            if scanned is not None:
-                spans, good_end = scanned
-                for seq, off, ln in spans:
-                    yield seq, data[off:off + ln]
-            else:
-                off = 0
-                while off + _HDR.size <= len(data):
-                    ln, crc, seq = _HDR.unpack_from(data, off)
-                    end = off + _HDR.size + ln
-                    if end > len(data):
-                        break
-                    payload = data[off + _HDR.size : end]
-                    if zlib.crc32(payload) != crc:
-                        break
-                    good_end = end
-                    off = end
+            for ev in _walk(data, native):
+                if ev[0] == "rec":
+                    _, seq, poff, ln, _rs, _re = ev
+                    if pending is not None:
+                        # first valid record after a hole
+                        pending.next_seq = seq
+                        pending = None
+                    last_seq = seq
                     if seq >= from_sequence:
-                        yield seq, payload
-            if good_end < len(data):
+                        yield seq, data[poff:poff + ln]
+                    continue
+                _, start, dmg_end = ev
+                if dmg_end >= len(data) and idx == len(segs) - 1:
+                    # torn tail of the active segment: expected crash
+                    # debris — truncate (write ownership only)
+                    M_CORRUPTION.labels("wal", "torn_tail").inc()
+                    self.last_triage.append(WalDamage(
+                        path, "torn_tail", start, len(data), last_seq,
+                        None))
+                    if repair:
+                        with open(path, "r+b") as f:
+                            f.truncate(start)
+                        if seg == self._current_id:
+                            self._fh.close()
+                            self._fh = open(path, "ab")
+                    break
+                # interior damage: valid records follow (in this segment
+                # or a later one) — the next "rec" event patches next_seq
+                dmg = WalDamage(path, "interior", start, dmg_end,
+                                last_seq, None)
+                M_CORRUPTION.labels("wal", "interior").inc()
+                self.last_triage.append(dmg)
+                pending = dmg
                 if repair:
-                    # torn tail: truncate so future appends start clean
-                    with open(path, "r+b") as f:
-                        f.truncate(good_end)
-                    if seg == self._current_id:
-                        self._fh.close()
-                        self._fh = open(path, "ab")
-                break
+                    self._write_sidecar(path, start, data[start:dmg_end])
+
+    def _write_sidecar(self, path: str, start: int, blob: bytes) -> None:
+        """Preserve damaged bytes beside the segment (never deleted);
+        idempotent per (segment, offset) so repeated failed opens don't
+        stack duplicates."""
+        side = f"{path}.{start}.quarantine"
+        if os.path.exists(side):
+            return
+        tmp = side + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, side)
+        M_QUARANTINED.labels("wal").inc()
+
+    def heal(self, damages: "list[WalDamage] | None" = None) -> int:
+        """Compact interior-damaged segments down to their valid records
+        (call AFTER the lost range was resynced and re-appended durably —
+        healing first would turn a repairable hole into silent loss).
+        Damaged bytes already live in the ``.quarantine`` sidecars.
+        Returns the number of bytes dropped."""
+        damages = self.last_triage if damages is None else damages
+        native = _native()
+        dropped = 0
+        for path in sorted({d.path for d in damages
+                            if d.kind == "interior"}):
+            with open(path, "rb") as f:
+                data = f.read()
+            keep = bytearray()
+            for ev in _walk(data, native):
+                if ev[0] == "rec":
+                    keep += data[ev[4]:ev[5]]
+            if len(keep) == len(data):
+                continue
+            dropped += len(data) - len(keep)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(bytes(keep))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            if path == self._seg_path(self._current_id):
+                self._fh.close()
+                self._fh = open(path, "ab")
+        return dropped
 
     def truncate(self, up_to_sequence: int) -> None:
-        """Drop whole segments whose every entry is below up_to_sequence."""
+        """Drop whole segments whose every entry is below up_to_sequence.
+        Unverifiable bytes (damage) conservatively KEEP the segment — a
+        quarantine/resync may still need them.
+
+        Walks headers only (v2 header CRC validates len/seq without
+        touching the payload): truncation runs on every flush, and
+        payload checksums belong to replay/heal, not this hot path."""
         for seg in self._segments()[:-1]:  # never drop the active segment
             path = self._seg_path(seg)
             keep = False
             with open(path, "rb") as f:
                 data = f.read()
-            off = 0
-            while off + _HDR.size <= len(data):
-                ln, _crc, seq = _HDR.unpack_from(data, off)
-                if seq >= up_to_sequence:
-                    keep = True
-                    break
-                off += _HDR.size + ln
+            off, n = 0, len(data)
+            while off < n:
+                if off + _REC_HDR <= n:
+                    ln, _crc, seq = _HDR.unpack_from(data, off)
+                    (hcrc,) = _HCRC.unpack_from(data, off + _HDR.size)
+                    if (zlib.crc32(data[off:off + _HDR.size]) == hcrc
+                            and off + _REC_HDR + ln <= n):
+                        if seq >= up_to_sequence:
+                            keep = True
+                            break
+                        off += _REC_HDR + ln
+                        continue
+                v1 = _parse_v1(data, off)  # legacy record (payload CRC)
+                if v1 is not None:
+                    seq, poff, ln = v1
+                    if seq >= up_to_sequence:
+                        keep = True
+                        break
+                    off = poff + ln
+                    continue
+                keep = True  # damage: never drop unverified bytes
+                break
             if not keep:
                 os.unlink(path)
 

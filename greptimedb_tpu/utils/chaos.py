@@ -24,16 +24,36 @@ Design constraints:
   datanode OS subprocesses inherit the faults of the test that spawned
   them.
 
-Injection points wired in this PR:
+Injection points:
 
-===================  ======================================== ==========
-point                site                                     actions
-===================  ======================================== ==========
-``flight.call``      every DatanodeClient RPC (rpc/client)    error/delay/drop
-``datanode.call``    Flight server do_put/do_get/do_action    error/hang/kill
-``s3.read``          S3ObjectStore GET (storage/s3)           error/delay
-``wal.append``       SharedLogBroker.append (remote_wal)      stall/error
-===================  ======================================== ==========
+=======================  ===================================== ==========
+point                    site                                  actions
+=======================  ===================================== ==========
+``flight.call``          every DatanodeClient RPC (rpc/client) error/delay/drop
+``datanode.call``        Flight server do_put/do_get/do_action error/hang/kill
+``s3.read``              S3ObjectStore GET (storage/s3)        error/delay
+``s3.read.payload``      S3ObjectStore GET response bytes      bitflip
+``wal.append``           SharedLogBroker.append (remote_wal)   stall/error
+``fs.write``             FsObjectStore.write payload           torn/bitflip/error/kill
+``fs.fsync``             FsObjectStore.write fsync/dir-fsync   error/kill/delay
+``wal.flush``            FileLogStore._flush_records           torn/bitflip/error/kill
+``sst.read``             read_sst file bytes (storage/sst)     bitflip/error/delay
+``sst.write``            write_sst store write (storage/sst)   torn/bitflip/error/kill
+``manifest.delta``       Manifest.commit delta write           bitflip/error/kill
+``manifest.checkpoint``  Manifest.checkpoint write             bitflip/error/kill
+``manifest.gc``          Manifest checkpoint GC delete loop    error/kill
+=======================  ===================================== ==========
+
+Local-disk fault shapes (ISSUE 9): ``torn`` persists a PREFIX of the
+payload then fails (crash mid-write); ``bitflip`` corrupts one byte of
+the payload and lets the IO "succeed" (silent bit-rot — the read path
+must detect it); ``at=N`` makes a rule fire deterministically at the
+Nth call of its point regardless of probability (the crash-at-Nth-
+boundary matrix), e.g. ``GREPTIME_CHAOS=manifest.delta=1:kill:at=3``.
+
+Data-carrying points go through ``filter_io(point, data)``; call sites
+guard it with the same ``CHAOS.enabled`` one-attribute check, so the
+disabled production path never pays for the mutation machinery.
 """
 
 from __future__ import annotations
@@ -73,17 +93,23 @@ class ChaosError(GreptimeError):
 class ChaosRule:
     point: str
     prob: float
-    action: str = "error"  # error | delay | stall | drop | hang | kill
+    action: str = "error"  # error|delay|stall|drop|hang|kill|torn|bitflip
     delay_ms: float = 20.0
     limit: int | None = None  # max fires; None = unbounded
     fired: int = 0
+    # deterministic crash-at-Nth-boundary: fire exactly at the Nth call
+    # of this point (1-based), ignoring prob — the recovery matrix seeds
+    # a kill at every durability boundary index this way
+    at: int | None = None
+    calls: int = 0
 
 
 def _parse_rules(spec: str) -> tuple[int, dict[str, ChaosRule]]:
     """``seed=7;flight.call=0.2:error;wal.append=0.1:stall:50;s3.read=1:error:limit=2``
 
     Each rule is ``point=prob[:action[:delay_ms_or_limit]...]``; a bare
-    ``limit=N`` arg caps total fires for the rule.
+    ``limit=N`` arg caps total fires for the rule and ``at=N`` pins the
+    rule to fire exactly at the point's Nth call.
     """
     seed = 0
     rules: dict[str, ChaosRule] = {}
@@ -101,6 +127,8 @@ def _parse_rules(spec: str) -> tuple[int, dict[str, ChaosRule]]:
         for a in args[1:]:
             if a.startswith("limit="):
                 rule.limit = int(a[len("limit="):])
+            elif a.startswith("at="):
+                rule.at = int(a[len("at="):])
             elif a.replace(".", "", 1).isdigit():
                 rule.delay_ms = float(a)
             elif a:
@@ -138,11 +166,12 @@ class ChaosController:
             self.enabled = bool(self._rules)
 
     def rule(self, point: str, prob: float, action: str = "error",
-             delay_ms: float = 20.0, limit: int | None = None) -> None:
+             delay_ms: float = 20.0, limit: int | None = None,
+             at: int | None = None) -> None:
         """Programmatic single-rule setup (tests)."""
         with self._lock:
             self._rules[point] = ChaosRule(point, prob, action, delay_ms,
-                                           limit)
+                                           limit, at=at)
             self._rngs.pop(point, None)
             self.enabled = True
 
@@ -163,27 +192,42 @@ class ChaosController:
             self._rngs[point] = rng
         return rng
 
+    def _fire(self, point: str) -> tuple[str, float] | None:
+        """Decide under the lock whether ``point``'s rule fires at this
+        call; returns (action, delay_s) or None."""
+        with self._lock:
+            rule = self._rules.get(point)
+            if rule is None:
+                return None
+            rule.calls += 1
+            if rule.limit is not None and rule.fired >= rule.limit:
+                return None
+            if rule.at is not None:
+                if rule.calls != rule.at:
+                    return None
+            elif self._rng(point).random() >= rule.prob:
+                return None
+            rule.fired += 1
+            action = rule.action
+            delay_s = rule.delay_ms / 1000.0
+        M_CHAOS_INJECTED.labels(point, action).inc()
+        return action, delay_s
+
     def inject(self, point: str) -> None:
         """Fire the configured fault for ``point`` (or return untouched).
 
         error/drop → raise ChaosError; delay/stall → sleep ``delay_ms``;
         hang → sleep 1000×``delay_ms`` (the caller's deadline must save
         it); kill → hard process exit (SIGKILL analog for chaos tests).
+        torn/bitflip are data faults: at a non-data point they degrade to
+        error (a rule misconfiguration must still be loud, not silent).
         """
         if not self.enabled:  # production fast path: one attribute check
             return
-        with self._lock:
-            rule = self._rules.get(point)
-            if rule is None:
-                return
-            if rule.limit is not None and rule.fired >= rule.limit:
-                return
-            if self._rng(point).random() >= rule.prob:
-                return
-            rule.fired += 1
-            action = rule.action
-            delay_s = rule.delay_ms / 1000.0
-        M_CHAOS_INJECTED.labels(point, action).inc()
+        fired = self._fire(point)
+        if fired is None:
+            return
+        action, delay_s = fired
         if action in ("delay", "stall"):
             time.sleep(delay_s)
             return
@@ -192,6 +236,46 @@ class ChaosController:
             return
         if action == "kill":
             os._exit(137)
+        raise ChaosError(f"chaos[{point}]: injected {action}")
+
+    def filter_io(self, point: str,
+                  data: bytes) -> tuple[bytes, Exception | None]:
+        """Data-carrying injection for local-disk IO (ISSUE 9): returns
+        ``(data_to_use, error_to_raise_after_io)``.
+
+        - ``torn``: a strict PREFIX of the payload plus a ChaosError the
+          caller must raise AFTER persisting the prefix — a torn write;
+        - ``bitflip``: the payload with one byte corrupted and no error —
+          silent bit-rot the verifying read path must catch;
+        - ``error``/``drop``: raises immediately (IO never happens);
+        - ``delay``/``stall``: sleeps, data untouched;
+        - ``kill``: hard process exit at the IO boundary.
+
+        Call sites guard with ``if CHAOS.enabled:`` so the disabled path
+        stays one attribute check (the zero-overhead pin).
+        """
+        if not self.enabled:
+            return data, None
+        fired = self._fire(point)
+        if fired is None:
+            return data, None
+        action, delay_s = fired
+        if action in ("delay", "stall"):
+            time.sleep(delay_s)
+            return data, None
+        if action == "kill":
+            os._exit(137)
+        if action == "bitflip":
+            if not data:
+                return data, None
+            pos = self._rng(point).randrange(len(data))
+            mutated = bytearray(data)
+            mutated[pos] ^= 1 << self._rng(point).randrange(8)
+            return bytes(mutated), None
+        if action == "torn":
+            cut = self._rng(point).randrange(len(data)) if data else 0
+            return data[:cut], ChaosError(
+                f"chaos[{point}]: torn write after {cut}/{len(data)} bytes")
         raise ChaosError(f"chaos[{point}]: injected {action}")
 
 

@@ -186,6 +186,17 @@ class TestRegistryStaticCheck:
             "greptime_ingest_wal_fsyncs_total",
         ):
             assert required in REGISTRY._metrics, required
+        # the durability surface (corruption triage, quarantine, repair)
+        # likewise exists by import: an idle /metrics scrape must already
+        # expose the counters operators alert on
+        import greptimedb_tpu.storage.durability  # noqa: F401
+
+        for required in (
+            "greptime_durability_corruption_total",
+            "greptime_durability_quarantined_total",
+            "greptime_durability_repaired_total",
+        ):
+            assert required in REGISTRY._metrics, required
 
     def test_self_export_table_naming(self):
         # the self-import loop (utils/selfmonitor.py) names tables after
