@@ -1,0 +1,19 @@
+"""greptime-lint: AST-based static analysis over greptimedb_tpu.
+
+Five pass families (see passes/), a checked-in justified-suppression
+baseline (baseline.json), a runtime lock-order witness (witness.py),
+and a CLI::
+
+    python -m greptimedb_tpu.analysis            # run, report, exit 1
+    python -m greptimedb_tpu.analysis --baseline # re-snapshot baseline
+    python -m greptimedb_tpu.analysis --write-config  # regenerate CONFIG.md
+
+The tier-1 gate (tests/test_analysis.py) runs every pass over the whole
+package and fails on any non-baselined finding.
+"""
+
+from greptimedb_tpu.analysis.core import (  # noqa: F401
+    AnalysisContext, Finding, Pass, all_passes, analyze_source,
+    apply_baseline, check_package, load_baseline, load_package, run_passes,
+    write_baseline,
+)

@@ -1,0 +1,89 @@
+"""CLI for greptime-lint: ``python -m greptimedb_tpu.analysis``."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    from greptimedb_tpu.analysis import core
+
+    ap = argparse.ArgumentParser(
+        prog="python -m greptimedb_tpu.analysis",
+        description="greptime-lint: concurrency/hot-path/durability/"
+                    "telemetry static analysis over greptimedb_tpu/")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    help="run only this pass (repeatable); default all")
+    ap.add_argument("--list-passes", action="store_true",
+                    help="list registered passes and finding codes")
+    ap.add_argument("--baseline", action="store_true",
+                    help="write the current findings to baseline.json "
+                         "(preserving existing justifications; new "
+                         "entries get a TODO reason the gate rejects)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring baseline.json")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also list inline-allowed and baselined findings")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--write-config", action="store_true",
+                    help="regenerate CONFIG.md from the knob inventory")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for p in core.all_passes():
+            print(f"{p.name}: {p.title}")
+            for code, desc in sorted(p.codes.items()):
+                print(f"  {code}  {desc}")
+        return 0
+
+    if args.write_config:
+        from greptimedb_tpu.analysis.passes.hygiene import render_config_md
+        import os
+
+        path = os.path.join(os.path.dirname(core.package_root()),
+                            "CONFIG.md")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(render_config_md())
+        print(f"wrote {path}")
+        return 0
+
+    active, inline = core.run_passes(names=args.passes)
+    if args.baseline:
+        path = core.write_baseline(active)
+        print(f"wrote {len(active)} entries to {path}")
+        return 0
+
+    if args.no_baseline:
+        new, matched, stale = active, [], []
+    else:
+        new, matched, stale = core.apply_baseline(
+            active, core.load_baseline())
+
+    if args.json:
+        print(json.dumps({
+            "new": [vars(f) for f in new],
+            "baselined": [vars(f) for f in matched],
+            "inline_suppressed": [vars(f) for f in inline],
+            "stale_baseline": stale,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if args.show_suppressed:
+            for f in matched:
+                print(f"[baselined] {f.render()}  -- {f.reason}")
+            for f in inline:
+                print(f"[allowed]   {f.render()}  -- {f.reason}")
+        for e in stale:
+            print(f"[stale baseline entry] {e['code']} {e['file']} "
+                  f"[{e['scope']}] {e['key']}")
+        print(f"{len(new)} finding(s), {len(matched)} baselined, "
+              f"{len(inline)} inline-allowed, {len(stale)} stale "
+              "baseline entr(ies)")
+    return 1 if new or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,362 @@
+"""greptime-lint core: pass registry, findings, baseline, suppressions.
+
+The reference enforces its concurrency/hot-path/durability invariants
+mechanically — ``[workspace.lints]`` + clippy deny-lists run over every
+crate on every build (Cargo.toml workspace.lints, plus custom disallowed-
+methods entries for blocking calls in async context).  This package is
+that discipline for the Python reproduction: AST-based passes over
+``greptimedb_tpu/`` with per-finding codes, a checked-in baseline of
+*justified* suppressions, and a tier-1 gate (tests/test_analysis.py)
+that fails on any non-baselined finding.
+
+Mechanics shared by every pass live here:
+
+- **SourceModule / AnalysisContext** — each ``.py`` file parsed once
+  (source, AST, per-line suppression / marker comments), shared across
+  passes.
+- **Inline suppressions** — ``# gl: allow[CODE] -- reason`` on the
+  offending line (or the line above) suppresses that code there; a
+  reason is REQUIRED or the allow is ignored.  These are the in-code
+  twin of clippy's ``#[allow(...)]`` with the justification attached.
+- **Markers** — ``# gl: holds[lockattr]`` declares that a function runs
+  with a lock already held (callers acquire it — e.g. ``_write_locked``
+  helpers); ``# gl: warm-path`` / ``# gl: warm-path(host)`` mark a
+  function as a warm path for the device-sync pass.
+- **Baseline** — ``analysis/baseline.json``: a list of findings matched
+  by (code, file, scope, key) — never by line number, so unrelated
+  edits don't churn it — each carrying a mandatory justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    code: str  # e.g. "GL-L002"
+    file: str  # path relative to the package root, posix separators
+    line: int
+    scope: str  # enclosing qualname ("RegionCacheManager.get") or "<module>"
+    key: str  # stable identity detail for baseline matching (not the line)
+    message: str
+    reason: str = ""  # justification, populated when suppressed
+
+    @property
+    def identity(self) -> tuple:
+        return (self.code, self.file, self.scope, self.key)
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}: {self.code} [{self.scope}] "
+                f"{self.message}")
+
+
+# ---------------------------------------------------------------------------
+# Source loading
+# ---------------------------------------------------------------------------
+
+_ALLOW_RE = re.compile(
+    r"#\s*gl:\s*allow\[([A-Za-z0-9_,\- ]+)\]\s*(?:--\s*)?(.*)")
+_HOLDS_RE = re.compile(r"#\s*gl:\s*holds\[([A-Za-z0-9_,\. ]+)\]")
+_WARM_RE = re.compile(r"#\s*gl:\s*warm-path(\((host)\))?")
+
+
+@dataclass
+class SourceModule:
+    relpath: str  # posix, relative to package root (e.g. "storage/cache.py")
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+    # line -> (set of codes, reason); allows without a reason are dropped
+    allows: dict[int, tuple[set[str], str]] = field(default_factory=dict)
+    holds: dict[int, set[str]] = field(default_factory=dict)
+    warm: dict[int, str] = field(default_factory=dict)  # line -> "full"|"host"
+
+    @classmethod
+    def from_source(cls, source: str, relpath: str) -> "SourceModule":
+        tree = ast.parse(source)
+        mod = cls(relpath=relpath, source=source, tree=tree,
+                  lines=source.splitlines())
+        for i, line in enumerate(mod.lines, 1):
+            if "# gl:" not in line and "#gl:" not in line:
+                continue
+            m = _ALLOW_RE.search(line)
+            if m and m.group(2).strip():
+                codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                mod.allows[i] = (codes, m.group(2).strip())
+            m = _HOLDS_RE.search(line)
+            if m:
+                mod.holds.setdefault(i, set()).update(
+                    a.strip() for a in m.group(1).split(",") if a.strip())
+            m = _WARM_RE.search(line)
+            if m:
+                mod.warm[i] = "host" if m.group(2) else "full"
+        return mod
+
+    def allow_reason(self, finding: Finding) -> str | None:
+        """Reason string when an inline allow covers ``finding`` (on its
+        line or the line directly above), else None."""
+        for ln in (finding.line, finding.line - 1):
+            entry = self.allows.get(ln)
+            if entry is not None and finding.code in entry[0]:
+                return entry[1]
+        return None
+
+    def marker_lines(self, node: ast.AST) -> range:
+        """Lines on which a def-scoped marker (holds/warm-path) counts for
+        ``node``: the def line through the first body statement's start —
+        covers decorators-free defs with the marker on the signature or a
+        leading comment line inside the body."""
+        first = getattr(node, "body", [None])[0]
+        end = first.lineno if first is not None else node.lineno + 1
+        return range(node.lineno, end + 1)
+
+    def holds_for(self, func: ast.AST) -> set[str]:
+        out: set[str] = set()
+        for ln in self.marker_lines(func):
+            out |= self.holds.get(ln, set())
+        return out
+
+    def warm_for(self, func: ast.AST) -> str | None:
+        for ln in self.marker_lines(func):
+            if ln in self.warm:
+                return self.warm[ln]
+        return None
+
+
+class AnalysisContext:
+    def __init__(self, modules: list[SourceModule]):
+        self.modules = modules
+        self._by_path = {m.relpath: m for m in modules}
+
+    def module(self, relpath: str) -> SourceModule | None:
+        return self._by_path.get(relpath)
+
+
+def package_root() -> str:
+    """Directory of the greptimedb_tpu package itself."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_package(root: str | None = None) -> AnalysisContext:
+    root = root or package_root()
+    modules = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in ("__pycache__",))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            modules.append(SourceModule.from_source(src, rel))
+    return AnalysisContext(modules)
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by passes
+# ---------------------------------------------------------------------------
+
+
+def qualname_map(tree: ast.Module) -> dict[ast.AST, str]:
+    """Map every function/class node to its dotted qualname."""
+    out: dict[ast.AST, str] = {}
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out[child] = q
+                walk(child, q)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def attr_chain(node: ast.AST) -> str | None:
+    """Dotted name for Name/Attribute chains: ``self._lru`` ->
+    "self._lru", ``os.path.join`` -> "os.path.join"; None for anything
+    with a non-name base (calls, subscripts)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Pass registry
+# ---------------------------------------------------------------------------
+
+
+class Pass:
+    name: str = ""
+    title: str = ""
+    codes: dict[str, str] = {}
+
+    def run(self, ctx: AnalysisContext) -> list[Finding]:
+        raise NotImplementedError
+
+
+PASS_REGISTRY: dict[str, Pass] = {}
+
+
+def register(cls):
+    PASS_REGISTRY[cls.name] = cls()
+    return cls
+
+
+def all_passes() -> list[Pass]:
+    # importing the passes package populates the registry
+    from greptimedb_tpu.analysis import passes  # noqa: F401
+
+    return [PASS_REGISTRY[k] for k in sorted(PASS_REGISTRY)]
+
+
+def run_passes(
+    ctx: AnalysisContext | None = None,
+    names: list[str] | None = None,
+) -> tuple[list[Finding], list[Finding]]:
+    """Run passes over ``ctx`` (default: the whole package).  Returns
+    (active, inline_suppressed); baseline filtering is separate
+    (apply_baseline) so the CLI can show either view."""
+    ctx = ctx or load_package()
+    passes = all_passes()
+    if names is not None:
+        passes = [p for p in passes if p.name in names]
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    for p in passes:
+        for f in p.run(ctx):
+            mod = ctx.module(f.file)
+            reason = mod.allow_reason(f) if mod is not None else None
+            if reason is not None:
+                f.reason = reason
+                suppressed.append(f)
+            else:
+                active.append(f)
+    active.sort(key=lambda f: (f.file, f.line, f.code))
+    suppressed.sort(key=lambda f: (f.file, f.line, f.code))
+    return active, suppressed
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> list[dict]:
+    path = path or BASELINE_PATH
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: list[dict],
+) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """Split ``findings`` against the baseline.  Returns (new, matched,
+    stale_entries) where matched findings carry the entry's justification
+    and stale entries matched nothing (they must be pruned — a baseline
+    can only shrink honestly)."""
+    from collections import Counter
+
+    pool = Counter(
+        (e["code"], e["file"], e["scope"], e["key"]) for e in baseline)
+    reasons = {(e["code"], e["file"], e["scope"], e["key"]): e.get(
+        "reason", "") for e in baseline}
+    new: list[Finding] = []
+    matched: list[Finding] = []
+    for f in findings:
+        if pool.get(f.identity, 0) > 0:
+            pool[f.identity] -= 1
+            f.reason = reasons.get(f.identity, "")
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = []
+    for e in baseline:
+        ident = (e["code"], e["file"], e["scope"], e["key"])
+        if pool.get(ident, 0) > 0:
+            pool[ident] -= 1
+            stale.append(e)
+    return new, matched, stale
+
+
+def baseline_entries(findings: list[Finding],
+                     old: list[dict] | None = None) -> list[dict]:
+    """Serialize findings as baseline entries, preserving justifications
+    from ``old`` for identities that persist; new entries get a TODO
+    reason the tier-1 gate rejects until a human justifies them."""
+    old_reasons: dict[tuple, list[str]] = {}
+    for e in old or []:
+        ident = (e["code"], e["file"], e["scope"], e["key"])
+        old_reasons.setdefault(ident, []).append(e.get("reason", ""))
+    out = []
+    for f in findings:
+        reasons = old_reasons.get(f.identity)
+        reason = reasons.pop(0) if reasons else "TODO: justify or fix"
+        out.append({
+            "code": f.code, "file": f.file, "scope": f.scope, "key": f.key,
+            "line": f.line,  # informational only — matching ignores it
+            "message": f.message, "reason": reason,
+        })
+    return out
+
+
+def write_baseline(findings: list[Finding], path: str | None = None) -> str:
+    path = path or BASELINE_PATH
+    entries = baseline_entries(findings, load_baseline(path))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(entries, f, indent=1)
+        f.write("\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Test / CLI convenience
+# ---------------------------------------------------------------------------
+
+
+def analyze_source(source: str, relpath: str,
+                   names: list[str] | None = None) -> list[Finding]:
+    """Run passes over one in-memory module (fixture snippets in
+    tests/test_analysis.py).  Inline allows apply; no baseline."""
+    ctx = AnalysisContext([SourceModule.from_source(source, relpath)])
+    active, _ = run_passes(ctx, names)
+    return active
+
+
+def check_package(names: list[str] | None = None):
+    """The tier-1 entry: (new, matched, stale, inline_suppressed) over
+    the live package against the checked-in baseline.  A subset run
+    (``names``) only consults baseline entries owned by those passes —
+    other passes' entries are not "stale" just because they didn't run."""
+    active, inline = run_passes(load_package(), names)
+    baseline = load_baseline()
+    if names is not None:
+        codes = {c for p in all_passes() if p.name in names
+                 for c in p.codes}
+        baseline = [e for e in baseline if e["code"] in codes]
+    new, matched, stale = apply_baseline(active, baseline)
+    return new, matched, stale, inline

@@ -1,0 +1,10 @@
+"""greptime-lint passes.  Importing this package registers every pass
+with the core registry (core.all_passes)."""
+
+from greptimedb_tpu.analysis.passes import (  # noqa: F401
+    durability,
+    hotpath,
+    hygiene,
+    lock_discipline,
+    lock_order,
+)

@@ -1,0 +1,121 @@
+"""Durability pass: every persisted write routes through the fsync
+discipline.
+
+Generalizes the ad-hoc bare-open lint that lived in
+tests/test_durability.py (which now delegates here — single source of
+truth): storage code must not write bytes to disk except through the
+modules that OWN the temp+fsync+rename discipline, and an atomic
+``os.replace`` is only durable when the parent directory is fsynced
+afterwards (the half of atomic-replace durability the rename alone does
+not give — a power loss can forget the directory entry even though the
+file's blocks hit disk).
+
+Scope: ``storage/``.  Codes:
+
+- **GL-D001** — a bare binary-mode ``open(..., "wb"/"ab"/"xb")`` in
+  storage code outside the owner modules (wal.py, object_store.py,
+  s3.py).  Everything else must write through ObjectStore /
+  FileLogStore so chaos injection, checksums and fsync policy apply.
+- **GL-D002** — ``os.replace``/``os.rename`` in storage code in a
+  function that never fsyncs the parent directory (no ``_fsync_dir``
+  call).  Owner modules are exempt only where they ARE the helper.
+
+Reference analog: the object-store stack's write-path invariants that
+greptimedb gets from opendal plus its own atomic-write helpers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from greptimedb_tpu.analysis.core import (
+    AnalysisContext, Finding, Pass, attr_chain, qualname_map, register,
+)
+
+SCOPE_PREFIX = "storage/"
+# modules that OWN the fsync discipline; bare opens are their job
+OPEN_OWNERS = {"storage/wal.py", "storage/object_store.py", "storage/s3.py"}
+WRITE_MODES = set("wax")
+
+
+def _binary_write_mode(call: ast.Call) -> bool:
+    """True for open(..., "wb"/"ab"/"xb"/"r+b"-style writable binary)."""
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if not isinstance(mode, str) or "b" not in mode:
+        return False
+    return bool(WRITE_MODES & set(mode)) or "+" in mode
+
+
+@register
+class DurabilityPass(Pass):
+    name = "durability"
+    title = "persisted writes route through the fsync discipline"
+    codes = {
+        "GL-D001": "bare binary write open() outside the owner modules",
+        "GL-D002": "os.replace/rename without a parent-directory fsync",
+    }
+
+    def run(self, ctx: AnalysisContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in ctx.modules:
+            if not mod.relpath.startswith(SCOPE_PREFIX):
+                continue
+            qnames = qualname_map(mod.tree)
+            funcs = [n for n in qnames
+                     if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+            def scope_of(node: ast.AST) -> str:
+                best = "<module>"
+                best_span = None
+                for f in funcs:
+                    end = getattr(f, "end_lineno", f.lineno)
+                    if f.lineno <= node.lineno <= end:
+                        span = end - f.lineno
+                        if best_span is None or span < best_span:
+                            best, best_span = qnames[f], span
+                return best
+
+            # which functions call _fsync_dir (directly, any receiver)
+            fsyncs_dir: set[str] = set()
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    chain = attr_chain(node.func) or ""
+                    if chain.rsplit(".", 1)[-1] == "_fsync_dir":
+                        fsyncs_dir.add(scope_of(node))
+
+            ordinals: dict[tuple, int] = {}
+
+            def emit(code: str, node: ast.AST, key_base: tuple, msg: str):
+                scope = scope_of(node)
+                n = ordinals.get((code, scope) + key_base, 0)
+                ordinals[(code, scope) + key_base] = n + 1
+                key = ":".join(key_base) + (f":{n}" if n else "")
+                findings.append(Finding(
+                    code=code, file=mod.relpath, line=node.lineno,
+                    scope=scope, key=key, message=msg))
+
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func) or ""
+                if (chain == "open" and mod.relpath not in OPEN_OWNERS
+                        and _binary_write_mode(node)):
+                    emit("GL-D001", node, ("bare-open",),
+                         "bare binary write open() — storage code must "
+                         "write through ObjectStore/FileLogStore "
+                         "(temp+fsync+rename discipline)")
+                if chain in ("os.replace", "os.rename"):
+                    scope = scope_of(node)
+                    if scope == "_fsync_dir" or scope in fsyncs_dir:
+                        continue
+                    emit("GL-D002", node, (chain,),
+                         f"{chain} without a parent-directory fsync in "
+                         f"{scope!r} — the rename is not durable until "
+                         "the directory entry is (use object_store."
+                         "_fsync_dir)")
+        return findings

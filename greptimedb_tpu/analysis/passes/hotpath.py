@@ -1,0 +1,169 @@
+"""Hot-path device-sync pass: no host syncs or per-row loops in warm code.
+
+The TCR paper (arXiv 2203.01877) finding this pass mechanizes: host-side
+work sneaking into tensor-runtime hot paths is the dominant silent
+regression.  Our warm-path zero-sync guarantees were previously
+protected only by point tests (interleaved A/B medians, tracemalloc
+pins); this pass protects the CODE.
+
+Functions opt in with a marker on the ``def`` line (or the line below):
+
+- ``# gl: warm-path`` — device-warm code (kernels, resident-layout
+  extension): both checks apply.
+- ``# gl: warm-path(host)`` — host-side vectorized code (wire parsers):
+  only the per-row loop check applies (``np.asarray`` on host arrays is
+  free there).
+
+Codes:
+
+- **GL-H001** — implicit host sync in a device-warm function:
+  ``np.asarray``/``np.array``/``jax.device_get`` on a value,
+  ``.item()``/``.tolist()``/``.block_until_ready()``, or
+  ``float()/int()/bool()`` of a non-literal.  Each one is a device
+  round-trip serialized into the warm path.
+- **GL-H002** — a per-row Python loop in any warm function: ``for``
+  over ``range(len(...))``/``range(n)``, ``zip(...)`` of arrays, or
+  ``enumerate(...)``.  O(rows) python-object work is the exact failure
+  mode the vectorized ingest/scan pipelines exist to avoid (their
+  ``*_object_decode_rows_total`` metrics pin it at 0 dynamically; this
+  pins it statically).  Loops over columns/specs (``for k, v in
+  d.items()``, ``for spec in specs``) do not match.
+
+Markers also flow into nested functions: a closure defined inside a
+warm function is warm (jitted kernel bodies are closures).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from greptimedb_tpu.analysis.core import (
+    AnalysisContext, Finding, Pass, attr_chain, qualname_map, register,
+)
+
+SYNC_CALL_CHAINS = {"np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array", "jax.device_get"}
+SYNC_METHOD_TAILS = {"item", "tolist", "block_until_ready"}
+CAST_BUILTINS = {"float", "int", "bool"}
+
+ROWY_NAMES = {"n", "nrows", "num_rows", "rows", "n_rows"}
+
+
+def _is_rowy_loop(node: ast.For) -> bool:
+    """Per-ROW loop shapes only: ``range(len(x))`` / ``range(n)`` and
+    ``zip(cols[a], cols[b], ...)`` over subscripted columns.  O(columns)
+    iteration (``for k, v in d.items()``, ``enumerate(fields)``, ``for
+    spec in specs``) is the vectorized code's legitimate shape and does
+    not match."""
+    it = node.iter
+    if isinstance(it, ast.Call):
+        chain = attr_chain(it.func)
+        if chain == "range":
+            if it.args and isinstance(it.args[-1], ast.Call) and attr_chain(
+                    it.args[-1].func) == "len":
+                return True
+            if it.args and isinstance(it.args[-1], ast.Name) and (
+                    it.args[-1].id in ROWY_NAMES):
+                return True
+            return False
+        if chain == "zip" and len(it.args) >= 2 and any(
+                isinstance(a, ast.Subscript) for a in it.args):
+            return True
+    return False
+
+
+class _WarmWalker:
+    def __init__(self, pass_, mod, scope: str, mode: str,
+                 in_closure: bool = False):
+        self.p = pass_
+        self.mod = mod
+        self.scope = scope
+        self.mode = mode  # "full" | "host"
+        # inside a nested def (a traced kernel closure): host CASTS of
+        # runtime values (float/int/bool) are also flagged there — in the
+        # outer function's epilogue they are ordinary host math
+        self.in_closure = in_closure
+        self.ordinals: dict[tuple, int] = {}
+
+    def _emit(self, code: str, node: ast.AST, key_base: tuple, msg: str):
+        n = self.ordinals.get(key_base, 0)
+        self.ordinals[key_base] = n + 1
+        key = ":".join(str(x) for x in key_base) + (f":{n}" if n else "")
+        self.p.findings.append(Finding(
+            code=code, file=self.mod.relpath, line=node.lineno,
+            scope=self.scope, key=key, message=msg))
+
+    def walk(self, node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sub_mode = self.mod.warm_for(child) or self.mode
+                sub = _WarmWalker(self.p, self.mod,
+                                  f"{self.scope}.{child.name}", sub_mode,
+                                  in_closure=True)
+                sub.walk(child)
+                continue
+            if isinstance(child, ast.For) and _is_rowy_loop(child):
+                self._emit("GL-H002", child, ("rowloop",),
+                           "per-row Python loop in warm path "
+                           f"(iterating {ast.unparse(child.iter)[:60]!r})")
+            if isinstance(child, ast.Call) and self.mode == "full":
+                self._check_call(child)
+            self.walk(child)
+
+    def _check_call(self, node: ast.Call):
+        chain = attr_chain(node.func)
+        if chain in SYNC_CALL_CHAINS:
+            self._emit("GL-H001", node, ("sync", chain),
+                       f"host sync {chain!r} in warm path")
+            return
+        tail = chain.rsplit(".", 1)[-1] if chain else None
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_METHOD_TAILS):
+            self._emit("GL-H001", node, ("sync", node.func.attr),
+                       f"host sync .{node.func.attr}() in warm path")
+            return
+        if (self.in_closure and tail in CAST_BUILTINS and chain == tail
+                and len(node.args) == 1
+                and not isinstance(node.args[0], ast.Constant)):
+            self._emit("GL-H001", node, ("cast", tail),
+                       f"{tail}() of a runtime value inside a kernel "
+                       "closure (device scalar pull)")
+
+
+@register
+class HotPathPass(Pass):
+    name = "hotpath"
+    title = "no host syncs / per-row loops in warm paths"
+    codes = {
+        "GL-H001": "implicit host sync in a device-warm function",
+        "GL-H002": "per-row Python loop in a warm function",
+    }
+
+    def run(self, ctx: AnalysisContext) -> list[Finding]:
+        self.findings: list[Finding] = []
+        for mod in ctx.modules:
+            if not mod.warm:
+                continue
+            qnames = qualname_map(mod.tree)
+            marked = []
+            for node, qual in qnames.items():
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                mode = mod.warm_for(node)
+                if mode is not None:
+                    marked.append((node, qual, mode))
+            # drop marked functions nested inside other marked functions
+            # (the outer walk covers them)
+            outer = []
+            spans = [(n.lineno, max(getattr(n, "end_lineno", n.lineno),
+                                    n.lineno)) for n, _, _ in marked]
+            for i, (node, qual, mode) in enumerate(marked):
+                if any(j != i and spans[j][0] < node.lineno
+                       and spans[j][1] >= spans[i][1]
+                       for j in range(len(marked))):
+                    continue
+                outer.append((node, qual, mode))
+            for node, qual, mode in outer:
+                _WarmWalker(self, mod, qual, mode).walk(node)
+        return self.findings

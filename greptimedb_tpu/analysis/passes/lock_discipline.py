@@ -1,0 +1,370 @@
+"""Lock-discipline pass: guarded attributes + blocking calls under locks.
+
+Codes:
+
+- **GL-L001** — a read/write of a declared guarded attribute outside its
+  lock.  The guard map below is the single declarative source of truth
+  for which shared attributes are protected by which lock (the docstring
+  promises next to each ``threading.Lock()`` today, made checkable).
+  Mode ``"mutate"`` guards writes/mutating calls only (lock-free read
+  fast paths stay legal — the cache's ``_lru.get`` discipline); ``"rw"``
+  guards reads too (torn-pair state like the region append log).
+- **GL-L002** — a blocking call (fsync, flush, sleep, socket/Flight IO,
+  ``block_until_ready``) made while ANY lock is held.  Every such site
+  either loses the lock's latency budget (writers pile up behind one
+  fsync) or is a deliberate serialization point — in which case it
+  carries an inline ``# gl: allow[GL-L002] -- why`` justification.
+
+Clippy analog: ``disallowed_methods`` under ``[workspace.lints]`` plus
+the await-holding-lock lint family.
+
+Construction (``__init__``/``__new__``) is exempt: objects are published
+after construction, happens-before included.  Helper methods that run
+with a caller-held lock declare it with ``# gl: holds[lockattr]``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from greptimedb_tpu.analysis.core import (
+    AnalysisContext, Finding, Pass, SourceModule, attr_chain, qualname_map,
+    register,
+)
+
+# ---------------------------------------------------------------------------
+# Declarative guard map: relpath -> class -> attr -> (lock attr, mode).
+# Class "" = module scope.  Attribute sites match by NAME within methods
+# of the class (any receiver — helpers like ``w.rejected`` in
+# WorkloadMemoryManager mutate Workload fields under the manager lock).
+# ---------------------------------------------------------------------------
+
+GUARDED: dict[str, dict[str, dict[str, tuple[str, str]]]] = {
+    "storage/cache.py": {
+        "RegionCacheManager": {
+            "_lru": ("_struct_lock", "mutate"),
+            "_bytes": ("_struct_lock", "mutate"),
+            "hits": ("_struct_lock", "mutate"),
+            "misses": ("_struct_lock", "mutate"),
+            "extends": ("_struct_lock", "mutate"),
+        },
+    },
+    "storage/region.py": {
+        "Region": {
+            "_append_log": ("_append_log_lock", "rw"),
+            "_append_base": ("_append_log_lock", "rw"),
+        },
+    },
+    "serving/scheduler.py": {
+        "QueryScheduler": {
+            "_queues": ("_cond", "mutate"),
+            "_sqlish_inflight": ("_cond", "rw"),
+        },
+        "": {
+            "_interactive_waiting": ("_wait_lock", "mutate"),
+        },
+    },
+    "utils/memory.py": {
+        "WorkloadMemoryManager": {
+            "_workloads": ("_lock", "mutate"),
+            "peak_bytes": ("_lock", "mutate"),
+            "rejected": ("_lock", "mutate"),
+            "reclaims": ("_lock", "mutate"),
+        },
+    },
+    "utils/telemetry.py": {
+        "_Child": {
+            "value": ("_mu", "mutate"),
+            "_value": ("_mu", "mutate"),
+            "counts": ("_mu", "mutate"),
+            "total": ("_mu", "mutate"),
+            "sum": ("_mu", "mutate"),
+        },
+        "Registry": {
+            "_metrics": ("_lock", "mutate"),
+            "collisions": ("_lock", "mutate"),
+        },
+    },
+    "storage/scan.py": {
+        "_Staging": {
+            "_bytes": ("_lock", "mutate"),
+        },
+    },
+}
+
+# dict/list/set/OrderedDict methods that mutate their receiver
+MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "move_to_end", "sort", "reverse", "add",
+    "discard", "appendleft", "popleft",
+}
+
+# call targets considered blocking for GL-L002: matched against the last
+# component of the dotted callee chain
+BLOCKING_TAIL = {
+    "fsync", "_fsync_dir", "sleep", "urlopen", "block_until_ready",
+    "do_get", "do_put", "do_action", "sendall", "recv", "connect", "flush",
+}
+# full dotted chains additionally treated as blocking
+BLOCKING_CHAIN = {"os.fsync", "time.sleep"}
+
+_LOCKISH = ("lock", "_cond", "_mu", "mutex")
+
+
+def is_lockish(name: str | None) -> bool:
+    if not name:
+        return False
+    tail = name.rsplit(".", 1)[-1].lower()
+    return any(t in tail for t in _LOCKISH)
+
+
+def lock_tail(node: ast.AST) -> str | None:
+    """Last component of a lock-ish with/acquire target, else None."""
+    chain = attr_chain(node)
+    if chain is None or not is_lockish(chain):
+        return None
+    return chain.rsplit(".", 1)[-1]
+
+
+class _FunctionWalker:
+    """Walks one function's statements tracking the set of held locks
+    (with-blocks plus explicit acquire/release), reporting guarded-attr
+    and blocking-call violations to ``pass_``."""
+
+    def __init__(self, pass_, mod: SourceModule, scope: str,
+                 class_chain: tuple[str, ...], held: set[str]):
+        self.p = pass_
+        self.mod = mod
+        self.scope = scope
+        self.class_chain = class_chain
+        self.held = set(held)
+        self.ordinals: dict[tuple, int] = {}
+
+    # ---- guard map lookup ----------------------------------------------
+    def _guard_for(self, attr: str) -> tuple[str, str] | None:
+        per_mod = GUARDED.get(self.mod.relpath)
+        if not per_mod:
+            return None
+        for cls, attrs in per_mod.items():
+            if attr not in attrs:
+                continue
+            if cls == "" and not self.class_chain:
+                return attrs[attr]
+            if cls in self.class_chain:
+                return attrs[attr]
+        return None
+
+    def _emit(self, code: str, node: ast.AST, key_base: tuple, message: str):
+        n = self.ordinals.get(key_base, 0)
+        self.ordinals[key_base] = n + 1
+        key = ":".join(str(x) for x in key_base) + (f":{n}" if n else "")
+        self.p.findings.append(Finding(
+            code=code, file=self.mod.relpath, line=node.lineno,
+            scope=self.scope, key=key, message=message))
+
+    # ---- attribute site checks -----------------------------------------
+    def _check_attr_site(self, attr: str, node: ast.AST, kind: str):
+        guard = self._guard_for(attr)
+        if guard is None:
+            return
+        lock, mode = guard
+        if kind == "read" and mode != "rw":
+            return
+        if lock in self.held:
+            return
+        self._emit(
+            "GL-L001", node, (attr, kind),
+            f"{kind} of {attr!r} without holding {lock!r} "
+            f"(declared guard, mode={mode})")
+
+    def _mutation_targets(self, target: ast.AST, node: ast.AST):
+        """Attr names mutated by an assignment target (``x.attr = .``,
+        ``x.attr[k] = .``)."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._mutation_targets(elt, node)
+            return
+        t = target
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        if isinstance(t, ast.Attribute):
+            self._check_attr_site(t.attr, node, "write")
+        elif isinstance(t, ast.Name) and not self.class_chain:
+            # module-global state (``_interactive_waiting += delta``)
+            self._check_attr_site(t.id, node, "write")
+
+    # ---- statement walk -------------------------------------------------
+    def walk(self, stmts: list[ast.stmt]):
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs run later — lock state does not transfer; a
+            # holds marker re-establishes it explicitly
+            sub = _FunctionWalker(
+                self.p, self.mod, f"{self.scope}.{stmt.name}",
+                self.class_chain, self.mod.holds_for(stmt))
+            sub.walk(stmt.body)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return  # handled at the top level of the pass
+        if isinstance(stmt, ast.With):
+            acquired = []
+            for item in stmt.items:
+                tail = lock_tail(item.context_expr)
+                if tail is not None and tail not in self.held:
+                    acquired.append(tail)
+                self._expr(item.context_expr)
+            self.held.update(acquired)
+            self.walk(stmt.body)
+            self.held.difference_update(acquired)
+            return
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._mutation_targets(t, stmt)
+            self._expr(stmt.value)
+            for t in stmt.targets:
+                self._expr_reads_only(t)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._mutation_targets(stmt.target, stmt)
+            self._expr(stmt.value)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._mutation_targets(stmt.target, stmt)
+                self._expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._mutation_targets(t, stmt)
+                # del x.attr[k] also reads x.attr
+            return
+        if isinstance(stmt, ast.Expr):
+            self._expr(stmt.value, stmt_level=True)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body)
+            for h in stmt.handlers:
+                self.walk(h.body)
+            self.walk(stmt.orelse)
+            self.walk(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            for v in ast.iter_child_nodes(stmt):
+                if isinstance(v, ast.expr):
+                    self._expr(v)
+            return
+        # anything else: scan contained expressions generically
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    # ---- expressions -----------------------------------------------------
+    def _expr_reads_only(self, node: ast.AST):
+        """Visit the VALUE part of an assignment target chain (e.g. the
+        ``self`` in ``self._lru[k] = v``) without re-flagging the write."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _expr(self, node: ast.AST, stmt_level: bool = False):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            tail = chain.rsplit(".", 1)[-1] if chain else None
+            # explicit acquire()/release() tracking (hot-tail pattern,
+            # group-commit leader's release-around-IO)
+            if tail == "acquire" and chain and is_lockish(
+                    chain.rsplit(".", 1)[0]):
+                self.held.add(chain.split(".")[-2])
+            elif tail == "release" and chain and is_lockish(
+                    chain.rsplit(".", 1)[0]):
+                self.held.discard(chain.split(".")[-2])
+            elif tail is not None:
+                # mutating method on a guarded attribute?
+                if tail in MUTATORS and isinstance(node.func, ast.Attribute):
+                    recv = node.func.value
+                    if isinstance(recv, ast.Subscript):
+                        recv = recv.value  # self._queues[p].append(...)
+                    if isinstance(recv, ast.Attribute):
+                        self._check_attr_site(recv.attr, node, "write")
+                # blocking call under a held lock?
+                if self.held and (
+                    tail in BLOCKING_TAIL
+                    or (chain in BLOCKING_CHAIN)
+                ):
+                    held = ",".join(sorted(self.held))
+                    self._emit(
+                        "GL-L002", node, ("blocking", tail),
+                        f"blocking call {(chain or tail)!r} while holding "
+                        f"lock(s) {held}")
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr) and child is not node.func:
+                    self._expr(child)
+            if isinstance(node.func, (ast.Attribute,)):
+                # receiver expression may itself read guarded attrs
+                self._expr(node.func.value)
+            elif not isinstance(node.func, ast.Name):
+                self._expr(node.func)
+            return
+        if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+            self._check_attr_site(node.attr, node, "read")
+            self._expr(node.value)
+            return
+        if isinstance(node, (ast.Lambda,)):
+            return  # deferred execution: lock state does not transfer
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+
+@register
+class LockDisciplinePass(Pass):
+    name = "lock_discipline"
+    title = "guarded attributes + blocking calls under locks"
+    codes = {
+        "GL-L001": "guarded attribute accessed without its lock",
+        "GL-L002": "blocking call while holding a lock",
+    }
+
+    def run(self, ctx: AnalysisContext) -> list[Finding]:
+        self.findings: list[Finding] = []
+        for mod in ctx.modules:
+            qnames = qualname_map(mod.tree)
+            # hoisted per module (not per node): the class-name set and
+            # the function-qualname set each walk all qnames once
+            class_names = {n.name for n in qnames
+                           if isinstance(n, ast.ClassDef)}
+            func_quals = {
+                q for n, q in qnames.items()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            for node, qual in qnames.items():
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                # only walk OUTERMOST functions; nested defs are walked
+                # inline (lock state resets at the boundary)
+                parts = qual.split(".")
+                if any(".".join(parts[:i]) in func_quals
+                       for i in range(1, len(parts))):
+                    continue  # nested def: parent walks it inline
+                if parts[-1] in ("__init__", "__new__"):
+                    continue  # construction: unpublished object
+                chain = tuple(p for p in parts[:-1] if p in class_names)
+                w = _FunctionWalker(self, mod, qual, chain,
+                                    mod.holds_for(node))
+                w.walk(node.body)
+        return self.findings

@@ -1,0 +1,273 @@
+"""Lock-order pass: static acquisition graph + cycle detection.
+
+Codes:
+
+- **GL-O001** — a cycle in the static lock-acquisition graph: lock B is
+  acquired while A is held on one path and A while B is held on another
+  (the ABBA deadlock shape).  Edges come from syntactic nesting
+  (``with A: ... with B:``), explicit ``acquire()`` while another lock
+  is held, intra-module calls to functions whose bodies acquire locks,
+  and a small declarative table of cross-module acquirers (methods whose
+  lock lives in another module — the region append-log API the cache
+  layer calls under its own lock).
+- **GL-O002** — re-acquisition of a NON-reentrant ``threading.Lock``
+  while it is already held on the same path (self-deadlock; an RLock
+  self-edge is legal and ignored).
+
+Lock nodes are named ``relpath:Class.attr`` (or ``relpath:name`` for
+module globals); lock KIND (Lock/RLock/Condition) is read from the
+``threading.X()`` constructor at the assignment site.
+
+The static graph is necessarily partial (dynamic dispatch, cross-module
+calls).  Its runtime twin — greptimedb_tpu.analysis.witness — records
+REAL acquisition chains in the concurrency/chaos test tiers and fails
+on inversions the static pass cannot see; the two share this pass's
+"edge + first-seen site" vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from greptimedb_tpu.analysis.core import (
+    AnalysisContext, Finding, Pass, attr_chain, qualname_map, register,
+)
+from greptimedb_tpu.analysis.passes.lock_discipline import lock_tail
+
+# Cross-module acquirers the intra-module call resolution cannot see:
+# method/function name -> lock node it acquires.  Kept small and
+# verified; the runtime witness is the net under this declarative table.
+CROSS_MODULE_ACQUIRES: dict[str, list[str]] = {
+    # region append-log API (storage/region.py) — called by the cache
+    # layer, sometimes under RegionCacheManager._struct_lock
+    "append_pos": ["storage/region.py:Region._append_log_lock"],
+    "append_chunks_since": ["storage/region.py:Region._append_log_lock"],
+    "_append_pos": ["storage/region.py:Region._append_log_lock"],
+    "_chunks_since": ["storage/region.py:Region._append_log_lock"],
+    # memory admission (utils/memory.py) — called from ingest and cache
+    "admit": ["utils/memory.py:WorkloadMemoryManager._lock"],
+    "try_admit": ["utils/memory.py:WorkloadMemoryManager._lock"],
+}
+
+
+def _lock_defs(mod) -> dict[str, str]:
+    """attr/global name -> kind ("Lock"|"RLock"|"Condition") for locks
+    created in this module via ``threading.X()``."""
+    out: dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            continue
+        chain = attr_chain(node.value.func)
+        if chain not in ("threading.Lock", "threading.RLock",
+                         "threading.Condition"):
+            continue
+        kind = chain.rsplit(".", 1)[-1]
+        for t in node.targets:
+            name = attr_chain(t)
+            if name is None:
+                continue
+            out[name.rsplit(".", 1)[-1]] = kind
+    return out
+
+
+class _Graph:
+    def __init__(self):
+        # (a, b) -> (file, line, scope) first observed
+        self.edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        self.kinds: dict[str, str] = {}  # node -> Lock/RLock/Condition
+        self.self_acquire: list[tuple[str, str, int, str]] = []
+
+    def add_edge(self, a: str, b: str, site: tuple[str, int, str]):
+        if a == b:
+            return
+        self.edges.setdefault((a, b), site)
+
+    def cycles(self) -> list[list[str]]:
+        """Elementary cycles via DFS over the edge set (the graph is tiny
+        — a handful of locks), deduped by rotation."""
+        adj: dict[str, list[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+        seen_cycles: set[tuple[str, ...]] = set()
+        out: list[list[str]] = []
+
+        def dfs(start: str, node: str, path: list[str], visited: set[str]):
+            for nxt in adj.get(node, ()):
+                if nxt == start and len(path) > 1:
+                    # canonical rotation for dedup
+                    i = path.index(min(path))
+                    canon = tuple(path[i:] + path[:i])
+                    if canon not in seen_cycles:
+                        seen_cycles.add(canon)
+                        out.append(list(canon))
+                elif nxt not in visited and nxt >= start:
+                    visited.add(nxt)
+                    dfs(start, nxt, path + [nxt], visited)
+                    visited.discard(nxt)
+
+        for n in sorted(adj):
+            dfs(n, n, [n], {n})
+        return out
+
+
+class _ModuleScan:
+    """Collect, per function, the locks it acquires directly, and the
+    nesting edges within it."""
+
+    def __init__(self, mod, graph: _Graph):
+        self.mod = mod
+        self.graph = graph
+        self.lock_kinds = _lock_defs(mod)
+        self.qnames = qualname_map(mod.tree)
+        self.class_of: dict[str, str] = {}
+        # function qualname -> set of lock nodes acquired directly
+        self.direct: dict[str, set[str]] = {}
+        # deferred: (held_node, callee_name, site) — resolved after every
+        # function's direct set is known
+        self.calls_under_lock: list[tuple[str, str, tuple]] = []
+
+    def node_for(self, tail: str, class_chain: tuple[str, ...]) -> str:
+        cls = class_chain[-1] if class_chain else ""
+        base = f"{cls}.{tail}" if cls else tail
+        kind = self.lock_kinds.get(tail, "Lock")
+        node = f"{self.mod.relpath}:{base}"
+        self.graph.kinds.setdefault(node, kind)
+        return node
+
+    def scan(self):
+        class_names = {n.name for n in self.qnames
+                       if isinstance(n, ast.ClassDef)}
+        for node, qual in self.qnames.items():
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            parts = qual.split(".")
+            chain = tuple(p for p in parts[:-1] if p in class_names)
+            held0 = {
+                self.node_for(t, chain) for t in self.mod.holds_for(node)
+            }
+            self._walk(node.body, qual, chain, set(held0))
+
+    def _walk(self, stmts, scope: str, chain, held: set[str]):
+        for stmt in stmts:
+            self._stmt(stmt, scope, chain, held)
+
+    def _acquire(self, tail: str, scope: str, chain, held: set[str],
+                 lineno: int) -> str:
+        node = self.node_for(tail, chain)
+        site = (self.mod.relpath, lineno, scope)
+        if node in held and self.graph.kinds.get(node) == "Lock":
+            self.graph.self_acquire.append(
+                (node, self.mod.relpath, lineno, scope))
+        for h in held:
+            self.graph.add_edge(h, node, site)
+        self.direct.setdefault(scope, set()).add(node)
+        return node
+
+    def _stmt(self, stmt, scope: str, chain, held: set[str]):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub_held = {self.node_for(t, chain)
+                        for t in self.mod.holds_for(stmt)}
+            self._walk(stmt.body, f"{scope}.{stmt.name}", chain, sub_held)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, ast.With):
+            acquired = []
+            for item in stmt.items:
+                tail = lock_tail(item.context_expr)
+                if tail is not None:
+                    n = self._acquire(tail, scope, chain, held,
+                                      item.context_expr.lineno)
+                    if n not in held:
+                        acquired.append(n)
+            held.update(acquired)
+            self._walk(stmt.body, scope, chain, held)
+            held.difference_update(acquired)
+            return
+        for call in self._calls_in(stmt):
+            cchain = attr_chain(call.func)
+            if cchain is None:
+                continue
+            parts = cchain.split(".")
+            tail = parts[-1]
+            if tail == "acquire" and len(parts) >= 2 and lock_tail(
+                    call.func.value) is not None:
+                held.add(self._acquire(parts[-2], scope, chain, held,
+                                       call.lineno))
+            elif tail == "release" and len(parts) >= 2 and lock_tail(
+                    call.func.value) is not None:
+                held.discard(self.node_for(parts[-2], chain))
+            elif held:
+                site = (self.mod.relpath, call.lineno, scope)
+                for h in sorted(held):
+                    self.calls_under_lock.append((h, tail, site))
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, scope, chain, held)
+
+    @staticmethod
+    def _calls_in(stmt):
+        """Calls in this statement's own expressions (not nested stmts)."""
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.Call):
+                        yield sub
+            elif isinstance(child, ast.withitem):
+                for sub in ast.walk(child.context_expr):
+                    if isinstance(sub, ast.Call):
+                        yield sub
+
+
+@register
+class LockOrderPass(Pass):
+    name = "lock_order"
+    title = "lock acquisition graph cycles"
+    codes = {
+        "GL-O001": "cycle in the static lock-acquisition graph",
+        "GL-O002": "re-acquiring a non-reentrant Lock already held",
+    }
+
+    def build_graph(self, ctx: AnalysisContext) -> _Graph:
+        graph = _Graph()
+        scans = [_ModuleScan(m, graph) for m in ctx.modules]
+        for s in scans:
+            s.scan()
+        # resolve calls-under-lock: intra-module by function/method name,
+        # plus the declarative cross-module table
+        by_name: dict[tuple[str, str], set[str]] = {}
+        for s in scans:
+            for qual, locks in s.direct.items():
+                by_name.setdefault(
+                    (s.mod.relpath, qual.rsplit(".", 1)[-1]), set()
+                ).update(locks)
+        for s in scans:
+            for held, callee, site in s.calls_under_lock:
+                targets = set(by_name.get((s.mod.relpath, callee), ()))
+                targets.update(CROSS_MODULE_ACQUIRES.get(callee, ()))
+                for t in targets:
+                    graph.kinds.setdefault(t, "Lock")
+                    graph.add_edge(held, t, site)
+        return graph
+
+    def run(self, ctx: AnalysisContext) -> list[Finding]:
+        graph = self.build_graph(ctx)
+        findings: list[Finding] = []
+        for cyc in graph.cycles():
+            edges = [(cyc[i], cyc[(i + 1) % len(cyc)])
+                     for i in range(len(cyc))]
+            sites = [graph.edges.get(e) for e in edges]
+            first = min((s for s in sites if s), default=("<unknown>", 0, ""))
+            findings.append(Finding(
+                code="GL-O001", file=first[0], line=first[1],
+                scope=first[2], key="|".join(cyc),
+                message=("lock-order cycle: " + " -> ".join(
+                    cyc + [cyc[0]]))))
+        for node, relpath, lineno, scope in graph.self_acquire:
+            findings.append(Finding(
+                code="GL-O002", file=relpath, line=lineno, scope=scope,
+                key=node,
+                message=(f"non-reentrant Lock {node} acquired while "
+                         "already held on this path")))
+        return findings

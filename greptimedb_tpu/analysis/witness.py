@@ -1,0 +1,246 @@
+"""Runtime lock-order witness: records real acquisition chains, fails
+on inversions.
+
+The static lock-order pass (passes/lock_order.py) sees syntactic
+nesting; this witness sees what actually happens — including chains
+through dynamic dispatch and cross-module calls the static graph cannot
+resolve.  Chaos-style discipline (utils/chaos.py):
+
+- **Zero overhead disabled.**  Production code NEVER imports this
+  module (the tier-1 pin asserts it is absent from ``sys.modules`` after
+  driving the write path); nothing is patched, ``threading.Lock`` is the
+  stock factory.  There is no "cheap disabled check" on any hot path —
+  the disabled cost is exactly zero.
+- **Scoped.**  ``capture()`` patches the ``threading`` lock factories
+  for its dynamic extent; only locks CREATED inside the scope are
+  witnessed (tests build their scheduler/cache/region fixtures inside
+  it).  ``uninstall`` restores the stock factories; witnessed locks
+  created meanwhile keep working (they hold a real lock underneath).
+- **Deterministic verdicts.**  An inversion is an EDGE conflict — lock B
+  acquired under A somewhere, A under B elsewhere — so a seeded ABBA
+  interleaving is caught even when the timing never actually deadlocks.
+
+Env: ``GREPTIME_LOCK_WITNESS=on`` lets the concurrency/chaos test tiers
+install the witness for the whole session (tests/conftest.py); unset,
+this module is never imported.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from contextlib import contextmanager
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+
+def _creation_site() -> str:
+    f = sys._getframe(2)
+    code = f.f_code
+    return f"{code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}"
+
+
+class Inversion(Exception):
+    pass
+
+
+class _WitnessedLock:
+    """Wraps a real lock; reports acquisition ordering to the witness.
+    Quacks like threading.Lock/RLock (with-statement, acquire/release,
+    Condition(lock=...) compatible)."""
+
+    def __init__(self, witness: "LockWitness", inner, name: str,
+                 reentrant: bool):
+        self._w = witness
+        self._inner = inner
+        self._name = name
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._w._note_acquire(self)
+        return got
+
+    def release(self):
+        self._w._note_release(self)
+        self._inner.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    # Condition() interop: Condition probes these on its lock argument.
+    # RLock has real implementations; a PLAIN Lock does not (CPython's
+    # Condition falls back to acquire/release there) — we must emulate
+    # those fallbacks, not blindly delegate, or Event()/Queue()/
+    # Condition(Lock()) built on a witnessed Lock crash at wait() time.
+    def _is_owned(self):
+        if self._reentrant:
+            return self._inner._is_owned()
+        # CPython's plain-lock fallback semantics
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        self._w._note_release(self)
+        if self._reentrant:
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        if self._reentrant:
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        self._w._note_acquire(self)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        # stdlib interop (_at_fork_reinit via os.register_at_fork, ...):
+        # anything not intercepted delegates to the real lock
+        return getattr(self._inner, name)
+
+    def __repr__(self):
+        return f"<witnessed {self._name} {self._inner!r}>"
+
+
+class LockWitness:
+    """Acquisition-order recorder.  ``edges`` maps (held_name, acquired_
+    name) -> first-seen (thread, chain); an inversion is recorded when
+    both (a, b) and (b, a) exist."""
+
+    MAX_CHAINS = 10_000  # soak-run bound; edges stay (they're the verdict)
+
+    def __init__(self):
+        self._mu = _ORIG_LOCK()  # stock lock: the witness never
+        # witnesses itself
+        self._tls = threading.local()
+        self._site_seq: dict[str, int] = {}
+        self.edges: dict[tuple[str, str], str] = {}
+        self.inversions: list[str] = []
+        self.chains: list[tuple[str, ...]] = []  # real acquisition chains
+        self.installed = False
+
+    # ---- recording -----------------------------------------------------
+    def _held(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _note_acquire(self, lock: _WitnessedLock):
+        stack = self._held()
+        if lock._reentrant and any(l is lock for l in stack):
+            stack.append(lock)  # reentrant re-entry: no new edges
+            return
+        new_edges = []
+        for held in stack:
+            if held is lock:
+                continue
+            a, b = held._name, lock._name
+            if a == b:
+                continue
+            new_edges.append((a, b))
+        stack.append(lock)
+        if not new_edges:
+            return
+        chain = tuple(l._name for l in stack)
+        with self._mu:
+            if len(self.chains) < self.MAX_CHAINS:
+                self.chains.append(chain)
+            for a, b in new_edges:
+                if (a, b) not in self.edges:
+                    self.edges[(a, b)] = (
+                        f"{threading.current_thread().name}: "
+                        + " -> ".join(chain))
+                if (b, a) in self.edges:
+                    msg = (f"lock-order inversion: {a} -> {b} "
+                           f"({self.edges[(a, b)]}) but {b} -> {a} "
+                           f"({self.edges[(b, a)]})")
+                    if not any(m.startswith(
+                            f"lock-order inversion: {a} -> {b} ")
+                            or m.startswith(
+                            f"lock-order inversion: {b} -> {a} ")
+                            for m in self.inversions):
+                        self.inversions.append(msg)
+
+    def _note_release(self, lock: _WitnessedLock):
+        stack = self._held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                return
+
+    # ---- factories -----------------------------------------------------
+    def _name(self, site: str) -> str:
+        """Per-INSTANCE name: creation site + sequence.  Instance-level
+        (not lockdep class-level) identity on purpose — two locks minted
+        by the same constructor line (every Region's `_append_log_lock`,
+        two locks on one source line) must not alias, or their mutual
+        ordering (the classic "always lock regions in id order" deadlock
+        family) self-cancels as a skipped self-edge."""
+        with self._mu:
+            n = self._site_seq.get(site, 0)
+            self._site_seq[site] = n + 1
+        return f"{site}#{n}" if n else site
+
+    def _make_lock(self):
+        return _WitnessedLock(self, _ORIG_LOCK(),
+                              self._name(_creation_site()), False)
+
+    def _make_rlock(self):
+        return _WitnessedLock(self, _ORIG_RLOCK(),
+                              self._name(_creation_site()), True)
+
+    # ---- install -------------------------------------------------------
+    def install(self):
+        if self.installed:
+            return
+        threading.Lock = self._make_lock
+        threading.RLock = self._make_rlock
+        self.installed = True
+
+    def uninstall(self):
+        if not self.installed:
+            return
+        threading.Lock = _ORIG_LOCK
+        threading.RLock = _ORIG_RLOCK
+        self.installed = False
+
+    @contextmanager
+    def capture(self):
+        """Install for a dynamic extent; locks created inside are
+        witnessed for their whole lifetime."""
+        self.install()
+        try:
+            yield self
+        finally:
+            self.uninstall()
+
+    def check(self):
+        """Raise Inversion when any inversion was recorded."""
+        if self.inversions:
+            raise Inversion("; ".join(self.inversions))
+
+
+WITNESS = LockWitness()
+
+
+def install_from_env() -> bool:
+    """Session-wide install when GREPTIME_LOCK_WITNESS=on (called by the
+    concurrency/chaos test tiers' conftest — never by production code)."""
+    import os
+
+    if os.environ.get("GREPTIME_LOCK_WITNESS", "").lower() in (
+            "on", "1", "true"):
+        WITNESS.install()
+        return True
+    return False

@@ -368,7 +368,7 @@ def _gather_ts_mat(ts_s, start, cnt_s, L: int):
     return jnp.where(j[None, :] < cnt_s[:, None], mat, _I64_MAX)
 
 
-def _window_kernel(p: WindowParams):
+def _window_kernel(p: WindowParams):  # gl: warm-path
     """Build the jitted kernel computing window stats for selected series.
 
     Inputs: the presorted resident layout (key_s [N] i64, ts_s [N] i64,
@@ -491,7 +491,7 @@ def _window_kernel(p: WindowParams):
         if p.kind == "minmax":
             # multi-bucket scatter: sample contributes to ceil(r/step)+1
             # windows; fori_loop keeps compile size O(1) in range/step ratio
-            kmax = int(p.range_ms // p.step_ms + 1)
+            kmax = int(p.range_ms // p.step_ms + 1)  # gl: allow[GL-H001] -- static WindowParams config, folded at trace time
             row_of = jnp.full((p.total_series + 1,), -1, dtype=jnp.int32)
             row_of = row_of.at[jnp.where(sel_ok, sel_tsids, p.total_series)].set(
                 jnp.arange(S, dtype=jnp.int32)
@@ -529,7 +529,7 @@ def _window_kernel(p: WindowParams):
     return kernel
 
 
-def _count_max_kernel(p: WindowParams):
+def _count_max_kernel(p: WindowParams):  # gl: warm-path
     """Max samples in any (series, step) window — sizes the matrix
     kernels' static padded width (one cheap pass, cached per shape)."""
 
@@ -543,7 +543,7 @@ def _count_max_kernel(p: WindowParams):
     return kernel
 
 
-def _matrix_kernel(p: WindowParams, lmax: int, kind: str):
+def _matrix_kernel(p: WindowParams, lmax: int, kind: str):  # gl: warm-path
     """Window-matrix kernels: gather each (series, step) window's samples
     (time-ordered, padded to the static width ``lmax``) into a
     [S*T, lmax] matrix, then

@@ -26,9 +26,9 @@ from greptimedb_tpu.utils.tracing import TRACER
 
 
 def _scan_stats_seq() -> int:
-    from greptimedb_tpu.storage.scan import LAST_SCAN_STATS
+    from greptimedb_tpu.storage.scan import scan_stats
 
-    return LAST_SCAN_STATS.get("seq", 0)
+    return scan_stats().get("seq", 0)
 
 
 def _attach_scan_stats(metrics, seq0: int) -> None:
@@ -36,11 +36,14 @@ def _attach_scan_stats(metrics, seq0: int) -> None:
     the per-query metrics sink when a scan actually ran under this query
     (cache miss/rebuild) — EXPLAIN ANALYZE's cold row and slow_queries
     then show where cold time went (decode vs merge, files, strategy).
-    Warm queries (seq unchanged) add nothing."""
+    Warm queries (seq unchanged) add nothing.  The summary is THREAD-
+    local (scan_stats), so a compaction or another worker's scan landing
+    mid-query can no longer masquerade as this query's cold phases."""
     if metrics is None:
         return
-    from greptimedb_tpu.storage.scan import LAST_SCAN_STATS as s
+    from greptimedb_tpu.storage.scan import scan_stats
 
+    s = scan_stats()
     if s.get("seq", 0) == seq0:
         return
     for key in ("files", "threads", "decode_ms", "path", "merge_ms"):

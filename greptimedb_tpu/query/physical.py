@@ -299,7 +299,7 @@ class Executor:
         nb = max(1, -(-(hi - start) // step))
         return step, start, _pow2(nb)
 
-    def _execute_agg(
+    def _execute_agg(  # gl: warm-path
         self, plan: SelectPlan, table: DeviceTable,
         ts_bounds: tuple[int, int], metrics: dict | None = None,
     ) -> tuple[dict[str, np.ndarray], int]:
@@ -439,6 +439,7 @@ class Executor:
                        for spec in key_specs if spec[0] == "time")
         out = timed_kernel_call(
             lambda: kernel(table, ts_lo, ts_hi, starts), jit_miss, metrics)
+        # gl: allow[GL-H001] -- THE one host materialization per dispatch; everything below operates on these numpy arrays
         out = {k: np.asarray(v) for k, v in out.items()}
 
         gmask = out.pop("__gmask__").astype(bool)
@@ -473,8 +474,10 @@ class Executor:
                 from greptimedb_tpu.ops import sketch as sk
 
                 if codec[0] == "hll":
+                    # gl: allow[GL-H001] -- sketch wire-encode epilogue over already-host group rows (O(groups), post-materialization)
                     v = np.array([sk.encode_hll(r) for r in v], dtype=object)
                 elif codec[0] == "udd":
+                    # gl: allow[GL-H001] -- same sketch epilogue, host side
                     v = np.array(
                         [sk.encode_udd(r, codec[1], codec[2]) for r in v],
                         dtype=object)
@@ -494,11 +497,11 @@ class Executor:
                                   for i, c in enumerate(r[:width]) if c}
                         rows.append(sk.encode_udd_doc(
                             sparse, configs[cmin], c_star, width))
-                    v = np.array(rows, dtype=object)
+                    v = np.array(rows, dtype=object)  # gl: allow[GL-H001] -- sketch epilogue, host side
             env[name] = v
         for name, _op, _col in batched:
             env[name] = out[name][gmask]
-        if cnt_all_g is not None and int(np.asarray(cnt_all_g)[0]) == 0:
+        if cnt_all_g is not None and int(cnt_all_g[0]) == 0:
             # zero-row global aggregate: every non-count aggregate is
             # NULL; float paths already carry NaN, but int aggregates
             # (sum/min/max/first/last over int columns) came back as
@@ -506,7 +509,7 @@ class Executor:
             for agg in plan.aggs:
                 if agg.name not in ("count", "count_distinct",
                                     "approx_distinct"):
-                    env[str(agg)] = np.array([None], dtype=object)
+                    env[str(agg)] = np.array([None], dtype=object)  # gl: allow[GL-H001] -- host NULL fill, O(aggregates)
         return env, n
 
     # ---- dense time-grid path -----------------------------------------
@@ -680,7 +683,7 @@ class Executor:
             tag_order=tag_order,
         )
 
-    def _execute_grid_geom(
+    def _execute_grid_geom(  # gl: warm-path
         self, plan: SelectPlan, grid, g: "_GridGeom",
         metrics: dict | None,
     ) -> tuple[dict[str, np.ndarray], int]:
@@ -757,6 +760,7 @@ class Executor:
                     ts_lo, ts_hi, np.int64(int(bts0) + b_lo * step_q),
                     np.int32(s0),
                 ), jit_miss, metrics)
+        # gl: allow[GL-H001] -- THE one host materialization per grid dispatch
         out = {k: np.asarray(v) for k, v in out.items()}
         return self._grid_env(plan, specs, out)
 
@@ -788,7 +792,7 @@ class Executor:
         return env, n
 
     # ---- cross-query stacked dispatch ---------------------------------
-    def execute_grid_batch(
+    def execute_grid_batch(  # gl: warm-path
         self, plans: list[SelectPlan], grid, ts_bounds: tuple[int, int],
         metrics: dict | None = None,
     ) -> list[tuple[dict[str, np.ndarray], int]] | None:
@@ -832,6 +836,7 @@ class Executor:
         sig0 = sig(g0)
         if not (g0.aligned and g0.has_time and g0.where_fn is None):
             return None
+        # gl: allow[GL-H002] -- O(batch members) compatibility probe, bounded by max_batch
         for p, g in zip(plans[1:], geoms[1:]):
             if p.fingerprint() != fp0 or sig(g) != sig0:
                 return None
@@ -846,9 +851,10 @@ class Executor:
         # pow2-pad the stack (duplicating the leader's window) so the
         # compiled-program population stays logarithmic in batch size
         npad = _pow2(n)
+        # gl: allow[GL-H001] -- O(batch members) window-argument stack, host ints
         b_los = np.array(
             [g.b_lo for g in geoms] + [g0.b_lo] * (npad - n), np.int32)
-        bts0s = np.array(
+        bts0s = np.array(  # gl: allow[GL-H001] -- same O(batch) stack
             [g.bts0 + g.b_lo * g.step_q for g in geoms]
             + [g0.bts0 + g0.b_lo * g0.step_q] * (npad - n), np.int64)
         vkey = (
@@ -875,6 +881,7 @@ class Executor:
                 tuple(grid.tag_codes[t] for t in g0.tag_order),
                 b_los, bts0s,
             ), jit_miss, metrics)
+        # gl: allow[GL-H001] -- THE one host materialization for the whole stacked batch
         out_np = {k: np.asarray(v) for k, v in out.items()}
         if metrics is not None:
             metrics["batched"] = n
@@ -1007,7 +1014,7 @@ class Executor:
         sums.block_until_ready()
         return (sums, cnts)
 
-    def _bm_kernel_fn(
+    def _bm_kernel_fn(  # gl: warm-path
         self, tag_order, tag_cols, cards_tag, nbw, step_q, where_fn,
         bm_specs,
     ):
@@ -1075,7 +1082,7 @@ class Executor:
     def _build_bm_kernel(self, *args):
         return jax.jit(self._bm_kernel_fn(*args))
 
-    def _build_grid_kernel(
+    def _build_grid_kernel(  # gl: warm-path
         self, field_names, ts_name, tag_order, tag_cols, cards_tag, has_time,
         r, nbw, w_raw, pad_l, pad_r, step_q, where_fn, where_series, specs,
         ts0, g_step, aligned=False,
@@ -1506,7 +1513,7 @@ class Executor:
         self._sketch_cache[ckey] = (ver, fn)
         return fn
 
-    def _build_agg_kernel(
+    def _build_agg_kernel(  # gl: warm-path
         self, key_specs, dense_ok, num_groups, cards, where_fn, agg_specs,
         ts_name, use_sorted=False, batched=(),
     ):

@@ -234,7 +234,7 @@ def _lp_dict_column(col):
     )
 
 
-def _parse_line_protocol_vec(raw: bytes, div) -> dict:
+def _parse_line_protocol_vec(raw: bytes, div) -> dict:  # gl: warm-path(host)
     """Vectorized line-protocol decode for uniform-schema batches.
 
     The trick: with no escapes and no quoted strings, ``=``, ``,`` and the
@@ -605,7 +605,7 @@ def _walk_write_request(body: bytes):
         yield labels, vals, tss
 
 
-def _parse_remote_write_vec(body: bytes) -> dict:
+def _parse_remote_write_vec(body: bytes) -> dict:  # gl: warm-path(host)
     """Columnar WriteRequest assembly: per-series label sets factorize to
     a vocabulary + counts, tag columns come out as ``DictColumn`` via one
     ``np.repeat`` per tag (C-level), values/timestamps as single
@@ -680,7 +680,7 @@ def parse_remote_write_legacy(body: bytes) -> dict[str, dict[str, list]]:
 # Flight do_put plane — reference gRPC bulk inserts / BulkInsertService)
 # ---------------------------------------------------------------------------
 
-def parse_arrow_bulk(body: bytes) -> dict:
+def parse_arrow_bulk(body: bytes) -> dict:  # gl: warm-path(host)
     """Arrow IPC stream → one columnar write batch for ``_ingest_columns``.
 
     The highest-rate wire format: the client ships columns, so decode is

@@ -428,7 +428,7 @@ class QueryScheduler:
             q[:] = keep
         return group
 
-    def _effective_linger_s(self, priority: str, group_len: int) -> float:
+    def _effective_linger_s(self, priority: str, group_len: int) -> float:  # gl: holds[_cond]
         """Adaptive linger (called under self._cond): scale the
         configured ceiling by observed same-class pressure.  ``pending``
         counts submitted-but-unclaimed sql/session queries beyond this
@@ -444,7 +444,7 @@ class QueryScheduler:
         return (self.linger_ms / 1000.0) * min(
             1.0, pending / max(1, self.max_batch))
 
-    def _worker_loop(self) -> None:
+    def _worker_loop(self) -> None:  # gl: warm-path(host)
         while True:
             with self._cond:
                 while not self._stopping:
@@ -541,7 +541,7 @@ class QueryScheduler:
             db._proc_local.sched_info = None
             e.done.set()
 
-    def _execute_batch(self, group: list[_Entry]) -> None:
+    def _execute_batch(self, group: list[_Entry]) -> None:  # gl: warm-path(host)
         """One stacked device dispatch for the whole group when the
         executor confirms shape-class compatibility; per-entry solo
         fallback otherwise.  Results are bit-exact vs solo execution —

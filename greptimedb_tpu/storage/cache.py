@@ -471,9 +471,9 @@ class RegionCacheManager:
         entry = self._lru.get(key)
         if entry is not None:
             if not incremental or entry.delta_pos == pos:
-                self.hits += 1
                 M_CACHE_EVENTS.labels("region_device", "table", "hit").inc()
                 with self._struct_lock:
+                    self.hits += 1
                     if key in self._lru:
                         self._lru.move_to_end(key)
                 return entry.table
@@ -485,7 +485,8 @@ class RegionCacheManager:
                 self.min_extend_rows,
                 entry.live_rows * self.rebuild_fraction,
             ):
-                self.extends += 1
+                with self._struct_lock:
+                    self.extends += 1
                 M_CACHE_EVENTS.labels(
                     "region_device", "table", "extend").inc()
                 # whole-entry swap (not field mutation): a concurrent
@@ -519,7 +520,8 @@ class RegionCacheManager:
                 return new_table
             self._evict(key)  # too much drift (or trimmed past): rebuild
 
-        self.misses += 1
+        with self._struct_lock:
+            self.misses += 1
         M_CACHE_EVENTS.labels("region_device", "table", "miss").inc()
         table = build_device_table(region, ts_range, columns)
         if incremental and _append_pos(region) != pos:
@@ -575,9 +577,9 @@ class RegionCacheManager:
         entry = self._lru.get(key)
         if entry is not None:
             if entry.delta_pos == pos:
-                self.hits += 1
                 M_CACHE_EVENTS.labels("region_device", "grid", "hit").inc()
                 with self._struct_lock:
+                    self.hits += 1
                     if key in self._lru:
                         self._lru.move_to_end(key)
                 return entry.table
@@ -593,7 +595,8 @@ class RegionCacheManager:
                             entry.live_rows * self.rebuild_fraction):
                         return None
             elif chunks is not None:
-                self.extends += 1
+                with self._struct_lock:
+                    self.extends += 1
                 M_CACHE_EVENTS.labels("region_device", "grid", "extend").inc()
                 extended = extend_grid_table(entry.table, region, chunks,
                                              mesh=self.mesh)
@@ -619,7 +622,8 @@ class RegionCacheManager:
                     return extended
             self._evict(key)  # delta does not fit (or trimmed past)
 
-        self.misses += 1
+        with self._struct_lock:
+            self.misses += 1
         M_CACHE_EVENTS.labels("region_device", "grid", "miss").inc()
         rows_now = region.memtable.num_rows + sum(
             m.num_rows for m in region.sst_files
@@ -648,10 +652,10 @@ class RegionCacheManager:
                 caught = catch_up_grid_table(
                     prev.table, region, new_metas, mesh=self.mesh)
                 if caught is not None:
-                    self.extends += 1
                     M_CACHE_EVENTS.labels(
                         "region_device", "grid", "catch_up").inc()
                     with self._struct_lock:
+                        self.extends += 1
                         got = self._lru.pop(prev_key, None)
                         if got is not None and got.table is not None:
                             self._bytes -= got.table.nbytes()
@@ -712,13 +716,14 @@ class RegionCacheManager:
         key = (region.region_id, "sharded", region.generation)
         entry = self._lru.get(key)
         if entry is not None:
-            self.hits += 1
             M_CACHE_EVENTS.labels("region_device", "sharded", "hit").inc()
             with self._struct_lock:
+                self.hits += 1
                 if key in self._lru:
                     self._lru.move_to_end(key)
             return entry.table
-        self.misses += 1
+        with self._struct_lock:
+            self.misses += 1
         M_CACHE_EVENTS.labels("region_device", "sharded", "miss").inc()
         table = shard_region(region, self.mesh)
         with self._struct_lock:
@@ -805,9 +810,9 @@ class RegionCacheManager:
                                              mesh=self.mesh)
             if extended is None:
                 return False  # off-grid delta: get_grid will evict/rebuild
-            self.extends += 1
             M_CACHE_EVENTS.labels("region_device", "grid", "hot_tail").inc()
             with self._struct_lock:
+                self.extends += 1
                 # not evicted/replaced meanwhile; delta_pos derives from
                 # the chunks actually scattered, not the earlier pos read
                 # (see get)

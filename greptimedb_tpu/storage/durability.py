@@ -149,6 +149,7 @@ def resync_from_peer_wal(client, region_id: int):
                 return []
             for p in names:
                 data = client.fetch_object(p)
+                # gl: allow[GL-D001] -- scratch copy of a PEER's WAL in a TemporaryDirectory, read-only-scanned then deleted; no durability surface
                 with open(os.path.join(tmp, p.rsplit("/", 1)[-1]),
                           "wb") as f:
                     f.write(data)

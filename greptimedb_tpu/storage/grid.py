@@ -35,6 +35,7 @@ import numpy as np
 
 from greptimedb_tpu.storage.durability import SstCorruption
 from greptimedb_tpu.storage.memtable import OP, OP_DELETE, SEQ, TSID
+from greptimedb_tpu.storage.object_store import _fsync_dir
 
 # padding granularity: each distinct (Spad, Tpad) is a compile shape class.
 # T gets coarse alignment (appends grow it constantly); S changes rarely.
@@ -369,6 +370,10 @@ def save_grid_snapshot(table: GridTable, region, path: str) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, os.path.join(path, "meta.json"))
+    # rename durability: the directory entry must hit disk too, or a
+    # power loss can resurrect the old meta.json against new .npy tensors
+    # (fingerprint mismatch is caught, but the snapshot is silently lost)
+    _fsync_dir(path)
 
 
 def load_grid_snapshot(path: str, region, mesh=None):
@@ -441,7 +446,7 @@ def _grow_time_axis(values, valid, tpad: int, new_nt: int, spad: int,
     )
 
 
-def extend_grid_table(table: GridTable, region, chunks, mesh=None):
+def extend_grid_table(table: GridTable, region, chunks, mesh=None):  # gl: warm-path(host)
     """Scatter pure-append chunks into the resident grid device-side.
 
     Returns the extended GridTable, or None when the delta does not fit

@@ -33,6 +33,7 @@ import os
 import struct
 import threading
 
+from greptimedb_tpu.storage.object_store import _fsync_dir
 from greptimedb_tpu.storage.wal import FileLogStore, LogStore
 
 _ENV = struct.Struct("<QQ")  # region_id, region sequence
@@ -146,9 +147,13 @@ class SharedLogBroker:
             tmp = path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(wm, f)
-                f.flush()
-                os.fsync(f.fileno())
+                f.flush()  # gl: allow[GL-L002] -- _lock IS the watermark-write serialization: a torn interleaving of two markers would wedge flush/prune
+                os.fsync(f.fileno())  # gl: allow[GL-L002] -- same: durability before the prune below relies on it
             os.replace(tmp, path)
+            # rename durability: prune (above) already dropped segments
+            # this marker accounts for — losing the directory entry at
+            # power loss would replay from a floor below the pruned data
+            _fsync_dir(self.root)  # gl: allow[GL-L002] -- same serialization as the marker write above
 
     def _prune(self, topic: str, wm: dict) -> None:
         """Drop whole segments whose every entry is below its region's

@@ -191,6 +191,7 @@ class S3ObjectStore(ObjectStore):
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
+            # gl: allow[GL-D002] -- read cache only: a lost directory entry re-fetches from S3; fsync here would tax every cold GET
             os.replace(tmp, cp)
         except BaseException:
             if os.path.exists(tmp):

@@ -85,10 +85,22 @@ M_SCAN_FALLBACK = REGISTRY.counter(
 LAST_MERGE_PATH: str = ""
 # last completed scan's phase summary, for the query engines' metrics
 # sink (EXPLAIN ANALYZE cold row, slow_queries stages): "seq" bumps once
-# per merge so a consumer can tell a FRESH cold scan from stale state.
-# Queries are serialized by the engine's single-writer lock, so a plain
-# dict is race-free in the served configuration.
-LAST_SCAN_STATS: dict = {"seq": 0}
+# per read_parts so a consumer can tell a FRESH cold scan from stale
+# state.  THREAD-LOCAL: scans run concurrently from scheduler workers,
+# the ingest pool (compaction) and flush paths — a process-global dict
+# cross-attributed one thread's decode/merge phases to another thread's
+# EXPLAIN ANALYZE/slow_queries row (and a compaction landing mid-query
+# overwrote the query's numbers entirely).
+_SCAN_STATS_TLS = threading.local()
+
+
+def scan_stats() -> dict:
+    """This thread's last scan phase summary (mutable — read_parts and
+    merge_parts write into it)."""
+    d = getattr(_SCAN_STATS_TLS, "stats", None)
+    if d is None:
+        d = _SCAN_STATS_TLS.stats = {"seq": 0}
+    return d
 
 # mirrors cache.py's relay-safety bound: one multi-hundred-MB device_put
 # RPC can break the TPU relay tunnel, so uploads stream in bounded pieces
@@ -183,9 +195,10 @@ def read_parts(tasks, memory=None, est_bytes: int = 0):
     estimate is rejected by the ``"scan"`` memory workload — in which
     case it falls back to the sequential loop (identical output)."""
     n = len(tasks)
-    seq = LAST_SCAN_STATS.get("seq", 0) + 1
-    LAST_SCAN_STATS.clear()
-    LAST_SCAN_STATS["seq"] = seq
+    stats = scan_stats()
+    seq = stats.get("seq", 0) + 1
+    stats.clear()
+    stats["seq"] = seq
     if n == 0:
         return []
     threads = min(scan_threads(n), n)
@@ -212,9 +225,9 @@ def read_parts(tasks, memory=None, est_bytes: int = 0):
     finally:
         dt = time.perf_counter() - t0
         M_SCAN_PHASE.labels("decode").observe(dt)
-        LAST_SCAN_STATS["files"] = n
-        LAST_SCAN_STATS["threads"] = threads
-        LAST_SCAN_STATS["decode_ms"] = round(dt * 1000, 3)
+        stats["files"] = n
+        stats["threads"] = threads
+        stats["decode_ms"] = round(dt * 1000, 3)
     return out
 
 
@@ -283,9 +296,10 @@ def merge_parts(parts, ts_name: str, tsid_name: str, seq_name: str):
     M_SCAN_MERGE.labels(path).inc()
     M_SCAN_ROWS.inc(len(merged[ts_name]))
     LAST_MERGE_PATH = path
-    LAST_SCAN_STATS["path"] = path
-    LAST_SCAN_STATS["rows"] = len(merged[ts_name])
-    LAST_SCAN_STATS["merge_ms"] = round(dt * 1000, 3)
+    stats = scan_stats()
+    stats["path"] = path
+    stats["rows"] = len(merged[ts_name])
+    stats["merge_ms"] = round(dt * 1000, 3)
     return merged, path
 
 

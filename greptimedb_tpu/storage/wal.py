@@ -55,6 +55,7 @@ import pyarrow as pa
 import pyarrow.ipc
 
 from greptimedb_tpu.storage.durability import M_CORRUPTION, M_QUARANTINED
+from greptimedb_tpu.storage.object_store import _fsync_dir
 from greptimedb_tpu.utils import telemetry
 from greptimedb_tpu.utils.chaos import CHAOS
 
@@ -495,6 +496,10 @@ class FileLogStore(LogStore):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, side)
+        # rename durability: the sidecar is the only surviving copy of
+        # the damaged bytes once heal() compacts the segment — a power
+        # loss must not be able to forget its directory entry
+        _fsync_dir(os.path.dirname(side))
         M_QUARANTINED.labels("wal").inc()
 
     def heal(self, damages: "list[WalDamage] | None" = None) -> int:
@@ -523,6 +528,10 @@ class FileLogStore(LogStore):
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
+            # rename durability: a healed segment that reverts to its
+            # damaged pre-compaction bytes at power loss would re-open
+            # with interior corruption the triage believes is repaired
+            _fsync_dir(os.path.dirname(path))
             if path == self._seg_path(self._current_id):
                 self._fh.close()
                 self._fh = open(path, "ab")

@@ -120,48 +120,60 @@ class WorkloadMemoryManager:
             self._workloads[name].quota_bytes = quota_bytes
 
     def admit(self, name: str, nbytes: int) -> None:
+        # Workload counters (peak_bytes/rejected/reclaims) are plain
+        # read-modify-writes reached concurrently from the scheduler
+        # worker pool AND the ingest pool — they mutate under self._lock
+        # only.  usage_fn/reclaim_fn stay OUTSIDE the lock: they call
+        # into component code (cache _struct_lock, memtable state) and
+        # holding the manager lock across them would add lock-order
+        # edges for no benefit.
         with self._lock:
             w = self._workloads.get(name)
-        if w is None:
-            return
-        if w.quota_bytes is None:
-            # unlimited: skip the usage pull (hot ingest path) — the
-            # request size alone still records a useful high-water mark
-            if nbytes > w.peak_bytes:
-                w.peak_bytes = nbytes
-            return
+            if w is None:
+                return
+            quota = w.quota_bytes
+            if quota is None:
+                # unlimited: skip the usage pull (hot ingest path) — the
+                # request size alone still records a useful high-water mark
+                if nbytes > w.peak_bytes:
+                    w.peak_bytes = nbytes
+                return
         used = w.usage_fn()
-        w.peak_bytes = max(w.peak_bytes, used + nbytes)
-        if used + nbytes <= w.quota_bytes:
+        with self._lock:
+            w.peak_bytes = max(w.peak_bytes, used + nbytes)
+        if used + nbytes <= quota:
             return
-        if nbytes > w.quota_bytes and w.policy == "reject":
+        if nbytes > quota and w.policy == "reject":
             # reclaim cannot help a reject-policy workload here: the
             # allocation alone exceeds the quota, so draining the whole
             # workload would still reject — don't destroy its resident
             # state on a doomed admission (best_effort keeps the reclaim:
             # it proceeds regardless, and freeing memory still helps)
-            w.rejected += 1
+            with self._lock:
+                w.rejected += 1
             _M_REJECTED.labels(name).inc()
             raise ResourcesExhausted(
                 f"workload {name!r} allocation over quota: "
-                f"{nbytes} > {w.quota_bytes} bytes"
+                f"{nbytes} > {quota} bytes"
             )
         if w.reclaim_fn is not None:
-            w.reclaims += 1
+            with self._lock:
+                w.reclaims += 1
             _M_RECLAIMS.labels(name).inc()
             # ask for the actual deficit, not the batch size: usage may
             # have drifted far past quota (estimates undershoot), and the
             # reclaimer stops as soon as it frees what was requested
-            w.reclaim_fn(used + nbytes - w.quota_bytes)
-            if w.usage_fn() + nbytes <= w.quota_bytes:
+            w.reclaim_fn(used + nbytes - quota)
+            if w.usage_fn() + nbytes <= quota:
                 return
         if w.policy == "best_effort":
             return
-        w.rejected += 1
+        with self._lock:
+            w.rejected += 1
         _M_REJECTED.labels(name).inc()
         raise ResourcesExhausted(
             f"workload {name!r} over memory quota: "
-            f"{w.usage_fn()} + {nbytes} > {w.quota_bytes} bytes"
+            f"{w.usage_fn()} + {nbytes} > {quota} bytes"
         )
 
     def try_admit(self, name: str, nbytes: int) -> bool:

@@ -35,13 +35,20 @@ class Counter(_Metric):
     kind = "counter"
 
     class _Child:
-        __slots__ = ("value",)
+        __slots__ = ("value", "_mu")
 
         def __init__(self):
             self.value = 0.0
+            # float += is a read-modify-write (LOAD/ADD/STORE bytecodes):
+            # concurrent inc() from the scheduler workers and the ingest
+            # pool interleaves and LOSES updates without this — counter
+            # drift that survives until restart.  Scrape-time reads stay
+            # lock-free (a torn read of one float is impossible).
+            self._mu = threading.Lock()
 
         def inc(self, by: float = 1.0):
-            self.value += by
+            with self._mu:
+                self.value += by
 
     def _new_child(self):
         return Counter._Child()
@@ -54,11 +61,12 @@ class Gauge(_Metric):
     kind = "gauge"
 
     class _Child:
-        __slots__ = ("_value", "fn")
+        __slots__ = ("_value", "fn", "_mu")
 
         def __init__(self):
             self._value = 0.0
             self.fn = None
+            self._mu = threading.Lock()  # see Counter._Child
 
         @property
         def value(self):
@@ -74,13 +82,16 @@ class Gauge(_Metric):
             return self._value
 
         def set(self, v: float):
-            self._value = v
+            with self._mu:
+                self._value = v
 
         def inc(self, by: float = 1.0):
-            self._value += by
+            with self._mu:
+                self._value += by
 
         def dec(self, by: float = 1.0):
-            self._value -= by
+            with self._mu:
+                self._value -= by
 
         def set_function(self, fn):
             self.fn = fn
@@ -108,20 +119,22 @@ class Histogram(_Metric):
         self.buckets = tuple(sorted(buckets))
 
     class _Child:
-        __slots__ = ("counts", "total", "sum", "buckets")
+        __slots__ = ("counts", "total", "sum", "buckets", "_mu")
 
         def __init__(self, buckets):
             self.buckets = buckets
             self.counts = [0] * len(buckets)
             self.total = 0
             self.sum = 0.0
+            self._mu = threading.Lock()  # see Counter._Child
 
         def observe(self, v: float):
-            self.total += 1
-            self.sum += v
-            for i, b in enumerate(self.buckets):
-                if v <= b:
-                    self.counts[i] += 1
+            with self._mu:
+                self.total += 1
+                self.sum += v
+                for i, b in enumerate(self.buckets):
+                    if v <= b:
+                        self.counts[i] += 1
 
         def time(self):
             return _Timer(self)
@@ -184,7 +197,7 @@ class Registry:
                 self._note_collision(m, cls, name, labels)
             return m
 
-    def _note_collision(self, existing, cls, name, labels):
+    def _note_collision(self, existing, cls, name, labels):  # gl: holds[_lock]
         if type(existing) is not cls:
             self.collisions.append(
                 f"{name}: registered as {existing.kind}, "

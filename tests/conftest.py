@@ -41,6 +41,19 @@ def tmp_data_dir(tmp_path):
 
 
 def pytest_configure(config):
+    # GREPTIME_LOCK_WITNESS=on: the concurrency/chaos tiers run with the
+    # runtime lock-order witness installed for the whole session — every
+    # lock created by a fixture is witnessed and real acquisition chains
+    # are checked for ABBA inversions.  Off (default): the module is
+    # never imported, threading.Lock stays the stock factory (the
+    # zero-overhead pin in tests/test_analysis.py).
+    import os as _os
+
+    if _os.environ.get("GREPTIME_LOCK_WITNESS", "").lower() in (
+            "on", "1", "true"):
+        from greptimedb_tpu.analysis.witness import install_from_env
+
+        install_from_env()
     config.addinivalue_line("markers", "golden: golden-file SQL/TQL corpus")
     config.addinivalue_line(
         "markers", "golden_dist: distributed re-run of the golden corpus")

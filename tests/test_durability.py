@@ -16,7 +16,6 @@ results vs an uninterrupted twin — for group commit on AND off.
 
 import glob
 import os
-import re
 import shutil
 import signal
 import subprocess
@@ -719,25 +718,18 @@ class TestGracefulShutdown:
 
 
 class TestDurabilityLint:
-    # modules that OWN the fsync discipline; everything else in storage/
-    # must write through ObjectStore / FileLogStore
-    _ALLOWED = {"wal.py", "object_store.py", "s3.py"}
-
     def test_no_bare_binary_writes_in_storage(self):
-        import greptimedb_tpu.storage as storage_pkg
+        # the ad-hoc regex lint that used to live here is now the
+        # analyzer's durability pass (GL-D001 bare opens + GL-D002
+        # unfsynced renames, greptimedb_tpu/analysis/passes/durability.py)
+        # — this test delegates so there is ONE source of truth
+        from greptimedb_tpu.analysis import check_package
 
-        pat = re.compile(r"""open\([^)\n]*["'][wax]b\+?["']""")
-        root = os.path.dirname(storage_pkg.__file__)
-        offenders = []
-        for path in sorted(glob.glob(os.path.join(root, "*.py"))):
-            if os.path.basename(path) in self._ALLOWED:
-                continue
-            for i, line in enumerate(open(path), 1):
-                if pat.search(line):
-                    offenders.append(f"{os.path.basename(path)}:{i}")
-        assert not offenders, (
-            "storage code must write through ObjectStore/FileLogStore "
-            f"(temp+fsync+rename discipline), found bare opens: {offenders}")
+        new, _matched, stale, _inline = check_package(names=["durability"])
+        assert not new, (
+            "storage durability discipline violated:\n"
+            + "\n".join(f.render() for f in new))
+        assert not stale
 
     def test_durability_metrics_registered_at_import(self):
         import greptimedb_tpu.storage.durability  # noqa: F401
@@ -905,3 +897,68 @@ class TestCrashPointMatrix:
             assert len(_rows_before(db, acked)) == acked * 8
         finally:
             db.close()
+
+
+# ---------------------------------------------------------------------------
+# Rename durability (GL-D002 fix-forward): os.replace is only durable
+# once the parent DIRECTORY entry is fsynced — the analyzer's durability
+# pass found three sites that fsynced the file but not the dir (grid
+# snapshot meta, shared-log watermarks, WAL quarantine sidecars/heal).
+# The static pass pins the fix mechanically; these prove the calls fire.
+# ---------------------------------------------------------------------------
+
+
+class TestRenameDurability:
+    def test_grid_snapshot_meta_fsyncs_parent_dir(self, tmp_path,
+                                                  monkeypatch):
+        from types import SimpleNamespace
+
+        import jax.numpy as jnp
+
+        import greptimedb_tpu.storage.grid as gridmod
+        from greptimedb_tpu.storage.grid import GridTable, save_grid_snapshot
+
+        table = GridTable(
+            values=jnp.zeros((1, 2, 4), jnp.float32),
+            valid=jnp.zeros((2, 4), bool), tag_codes={}, ts0=0, step=1000,
+            nt=4, num_series=2, field_names=("v",),
+        )
+        region = SimpleNamespace(
+            sst_files=[], memtable=SimpleNamespace(num_rows=0),
+            num_series=2, schema=cpu_schema())
+        calls = []
+        monkeypatch.setattr(gridmod, "_fsync_dir",
+                            lambda p: calls.append(p))
+        snap = str(tmp_path / "snap")
+        save_grid_snapshot(table, region, snap)
+        assert calls == [snap]
+        assert os.path.exists(os.path.join(snap, "meta.json"))
+
+    def test_watermark_marker_fsyncs_broker_root(self, tmp_path,
+                                                 monkeypatch):
+        import greptimedb_tpu.storage.remote_wal as rwmod
+        from greptimedb_tpu.storage.remote_wal import SharedLogBroker
+
+        broker = SharedLogBroker(str(tmp_path / "broker"))
+        calls = []
+        monkeypatch.setattr(rwmod, "_fsync_dir", lambda p: calls.append(p))
+        broker.set_low_watermark("region_1", region_id=1, sequence=5)
+        assert calls == [broker.root]
+        assert os.path.exists(broker._wm_path("region_1"))
+
+    def test_wal_quarantine_sidecar_fsyncs_dir(self, tmp_path,
+                                               monkeypatch):
+        import greptimedb_tpu.storage.wal as walmod
+
+        wal = FileLogStore(str(tmp_path / "wal"))
+        wal.append(1, b"payload")
+        seg = wal_segment(str(tmp_path / "wal"))
+        calls = []
+        monkeypatch.setattr(walmod, "_fsync_dir", lambda p: calls.append(p))
+        wal._write_sidecar(seg, 0, b"damaged-bytes")
+        assert calls == [os.path.dirname(seg)]
+        assert os.path.exists(f"{seg}.0.quarantine")
+        # idempotent per (segment, offset): no duplicate fsync either
+        wal._write_sidecar(seg, 0, b"damaged-bytes")
+        assert calls == [os.path.dirname(seg)]
+        wal.close()

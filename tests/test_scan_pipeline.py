@@ -262,7 +262,9 @@ class TestKnobsAndQuota:
         r.memory = None  # region.write admission must not interfere
         f0 = REGISTRY.value(
             "greptime_scan_sequential_fallbacks_total", ("quota",))
-        monkeypatch.delenv("GREPTIME_SCAN_THREADS", raising=False)
+        # pin a parallel-eligible pool width: on a 1-core container the
+        # auto width is 1 and the quota path (parallel-only) never runs
+        monkeypatch.setenv("GREPTIME_SCAN_THREADS", "2")
         seq_expected = r.scan_host()  # no manager: parallel reference
         r.memory = mem
         out = r.scan_host()

@@ -117,9 +117,6 @@ class TestExposition:
 # Tier-1 registry static check (duplicate registrations + name convention)
 # ---------------------------------------------------------------------------
 
-_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
-
-
 class TestRegistryStaticCheck:
     def test_collision_detection(self):
         r = Registry()
@@ -150,11 +147,14 @@ class TestRegistryStaticCheck:
         import greptimedb_tpu.utils.chaos  # noqa: F401
         import greptimedb_tpu.utils.memory  # noqa: F401
 
-        assert REGISTRY.collisions == [], REGISTRY.collisions
-        for name, m in REGISTRY._metrics.items():
-            assert _NAME_RE.match(name), f"bad metric name {name!r}"
-            for ln in m.label_names:
-                assert _NAME_RE.match(ln), f"bad label {ln!r} on {name}"
+        # the convention/collision logic lives in the analyzer's hygiene
+        # pass now (single source of truth): check_registry is the
+        # RUNTIME twin of the static GL-T001/T002/T003 checks, applied
+        # to whatever actually registered (dynamic names included)
+        from greptimedb_tpu.analysis.passes.hygiene import check_registry
+
+        assert check_registry(REGISTRY) == []
+        for m in REGISTRY._metrics.values():
             assert isinstance(m, (Counter, Gauge, Histogram))
         # the serving scheduler's first-class metric surface must exist
         # by import (not lazily on first query): /metrics scrapes on an
@@ -214,18 +214,12 @@ class TestRegistryStaticCheck:
         import greptimedb_tpu.standalone  # noqa: F401
         import greptimedb_tpu.storage.cache  # noqa: F401
         import greptimedb_tpu.utils.memory  # noqa: F401
+        from greptimedb_tpu.analysis.passes.hygiene import check_registry
         from greptimedb_tpu.servers.otlp import _norm
 
-        tables: set[str] = set()
-        for name, m in REGISTRY._metrics.items():
-            assert _norm(name) == name, f"{name!r} mutates through _norm"
-            exploded = (
-                [name + s for s in ("_bucket", "_sum", "_count")]
-                if m.kind == "histogram" else [name]
-            )
-            for t in exploded:
-                assert t not in tables, f"self-export table collision: {t}"
-                tables.add(t)
+        # delegated to the hygiene pass's runtime twin: histogram
+        # explosion collisions + the OTLP normalizer round-trip
+        assert check_registry(REGISTRY, norm=_norm) == []
 
 
 # ---------------------------------------------------------------------------
