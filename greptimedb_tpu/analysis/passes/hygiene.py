@@ -66,6 +66,22 @@ KNOB_DOCS: dict[str, str] = {
         "`on` installs the runtime lock-order witness (records real "
         "acquisition chains, fails on ABBA inversions) for the "
         "concurrency/chaos test tiers; unset = witness never imported."),
+    "GREPTIME_FULLTEXT": (
+        "`off` disables the fingerprint text index everywhere: "
+        "LIKE/MATCHES/regex/LogQL predicates walk their dictionaries "
+        "host-side byte-for-byte as before (A/B twin)."),
+    "GREPTIME_FULLTEXT_CACHE_BYTES": (
+        "Capacity of the resident fulltext cache (fingerprint matrices, "
+        "verified-vocabulary memos, combined line-filter vectors)."),
+    "GREPTIME_FULLTEXT_MIN_GRAM": (
+        "Shortest indexed n-gram (2 or 3): 2 doubles index build work "
+        "but lets two-character literals prune."),
+    "GREPTIME_FULLTEXT_QUOTA_BYTES": (
+        "Memory-manager quota for the `fulltext` workload "
+        "(reject-to-host-fallback admission)."),
+    "GREPTIME_FULLTEXT_WORDS": (
+        "uint32 words per fingerprint row (32 bloom bits each): more "
+        "words = fewer prefilter false positives, more HBM."),
     "GREPTIME_GRID": (
         "`off` disables the dense resident time-grid path; queries fall "
         "back to row-major device tables."),

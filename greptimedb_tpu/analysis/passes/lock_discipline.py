@@ -90,6 +90,17 @@ GUARDED: dict[str, dict[str, dict[str, tuple[str, str]]]] = {
             "_bytes": ("_lock", "mutate"),
         },
     },
+    "fulltext/resident.py": {
+        "FulltextIndexCache": {
+            "_lru": ("_struct_lock", "mutate"),
+            "_bytes": ("_struct_lock", "mutate"),
+            "hits": ("_struct_lock", "mutate"),
+            "misses": ("_struct_lock", "mutate"),
+            "builds": ("_struct_lock", "mutate"),
+            "rejects": ("_struct_lock", "mutate"),
+            "evictions": ("_struct_lock", "mutate"),
+        },
+    },
 }
 
 # dict/list/set/OrderedDict methods that mutate their receiver

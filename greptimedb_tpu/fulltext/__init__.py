@@ -1,0 +1,35 @@
+"""Device-resident full-text search.
+
+Reference: src/index/src/fulltext_index/ (tantivy + the bloom-filter
+backend) and src/log-query/ + src/servers/src/http/loki.rs (the LogQL
+read surface).  The TPU build replaces the disk inverted index with a
+**fingerprint matrix**: per (region, string column) every DISTINCT value
+gets a W-word packed n-gram bloom fingerprint (uint32 ``[n, W]``), built
+vectorized (one chunked-bincount pass over the concatenated bytes) and
+held resident in HBM under quota admission.  A text predicate compiles to
+a small set of required-gram query masks; ``(row_fp & qmask) == qmask``
+runs as one jitted bitwise kernel, and the exact host predicate runs only
+on the surviving candidates — results are bit-exact vs the host path by
+construction (the prefilter can have false positives, never false
+negatives).
+
+Modules:
+
+- ``fingerprint`` — the pure math: canonical text form, vectorized gram
+  hashing, fingerprint build/extend, required-literal extraction
+  (LIKE/regex/matches), query-mask compilation;
+- ``resident``    — the quota-admitted device cache (fingerprint
+  matrices, verified-vocabulary memos, combined line-filter vectors) and
+  the per-query provider the SQL compiler and the LogQL evaluator share;
+- ``logql``       — the LogQL subset parser (stream selector, line
+  filters, ``| json`` / ``| logfmt``, label filters, range/vector
+  aggregations);
+- ``loki``        — the Loki read-API evaluator (query/query_range/
+  labels/label values/series) lowering metric queries onto the PromQL
+  window kernels.
+
+``GREPTIME_FULLTEXT=off`` restores the host-side predicate paths
+byte-for-byte (this package's caches are never consulted).
+"""
+
+from greptimedb_tpu.fulltext.fingerprint import enabled  # noqa: F401

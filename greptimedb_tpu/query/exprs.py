@@ -499,6 +499,27 @@ def _code_set(values, pred) -> np.ndarray:
     )
 
 
+def _code_set_ft(ctx, real: str, values, pred, kind: str,
+                 text: str) -> np.ndarray:
+    """Fingerprint-prefiltered twin of ``_code_set`` for text predicates:
+    when the executor attached a fulltext provider (ctx.fulltext, set
+    from the resident FulltextIndexCache) the predicate evaluates only
+    on prefilter candidates — and repeats hit the verified-vocabulary
+    memo — instead of walking the whole dictionary.  Candidate sets have
+    no false negatives and verification runs the SAME ``pred``, so the
+    result is the identical int32 code array; any fallback (knob off,
+    quota reject, unfilterable pattern on a provider-less path) IS
+    ``_code_set``."""
+    if isinstance(values, DictionaryEncoder):
+        values = values.values()
+    ft = getattr(ctx, "fulltext", None)
+    if ft is not None:
+        codes = ft.codes_matching(real, values, pred, kind, text)
+        if codes is not None:
+            return codes
+    return _code_set(values, pred)
+
+
 def _codes_isin_fn(codes: np.ndarray, real: str, negate: bool):
     """The ONE code-set membership closure shared by tag and string-FIELD
     comparisons (negation excludes padding/poison codes < 0)."""
@@ -675,10 +696,16 @@ def compile_device(e: Expr, ctx: TableContext):
                         _like_to_regex(other.value),
                         re.IGNORECASE if op == "ILIKE" else 0,
                     )
-                    codes = _code_set(enc, lambda v: rx.match(str(v)) is not None)
+                    codes = _code_set_ft(
+                        ctx, real, enc,
+                        lambda v: rx.match(str(v)) is not None,
+                        "ilike" if op == "ILIKE" else "like", other.value)
                 else:  # ~ / !~ regex
                     rx = re.compile(other.value)
-                    codes = _code_set(enc, lambda v: rx.search(str(v)) is not None)
+                    codes = _code_set_ft(
+                        ctx, real, enc,
+                        lambda v: rx.search(str(v)) is not None,
+                        "regex", other.value)
                 return _codes_isin_fn(codes, real, op == "!~")
             if isinstance(other, Column) and ctx.is_tag(other.name):
                 # tag = tag comparison only sound if same dictionary; compare
@@ -735,16 +762,21 @@ def compile_device(e: Expr, ctx: TableContext):
                         "resident dictionary (row path only)")
                 if op in ("=", "!="):
                     pred = lambda v, w=other_f.value: str(v) == w  # noqa: E731
+                    kind = "eq"
                 elif op in ("LIKE", "ILIKE"):
                     rx = re.compile(
                         _like_to_regex(other_f.value),
                         re.IGNORECASE if op == "ILIKE" else 0)
                     pred = lambda v, rx=rx: rx.match(str(v)) is not None  # noqa: E731
+                    kind = "ilike" if op == "ILIKE" else "like"
                 else:
                     rx = re.compile(other_f.value)
                     pred = lambda v, rx=rx: rx.search(str(v)) is not None  # noqa: E731
+                    kind = "regex"
                 return _codes_isin_fn(
-                    _code_set(vocab, pred), real, op in ("!=", "!~"))
+                    _code_set_ft(ctx, real, vocab, pred, kind,
+                                 other_f.value),
+                    real, op in ("!=", "!~"))
         # --- time-index comparisons with string timestamps ---
         ts_side = None
         if isinstance(e.left, Column) and ctx.is_ts(e.left.name):
@@ -934,9 +966,22 @@ def _compile_ft_match(e: FuncCall, ctx: TableContext):
         return score_fn
 
     pred = _ft_pred(e.name, lit.value)
-    hits = jnp.asarray(
-        np.asarray([bool(pred(str(t))) for t in vocab], dtype=bool)
-    )
+    if isinstance(vocab, DictionaryEncoder):
+        vocab = vocab.values()
+    vocab = list(vocab)
+    ft = getattr(ctx, "fulltext", None)
+    bools = None
+    if ft is not None:
+        # fingerprint prefilter: the token predicate runs only on
+        # candidate terms (memoized per lineage) instead of every
+        # distinct value — the high-cardinality log-line case where the
+        # host loop below is O(rows)
+        bools = ft.cache.verified_bools(
+            ft.tkey, ft.table, real, vocab,
+            lambda t, p=pred: bool(p(str(t))), e.name, lit.value)
+    if bools is None:
+        bools = np.asarray([bool(pred(str(t))) for t in vocab], dtype=bool)
+    hits = jnp.asarray(bools)
 
     def fn(env, col_name=real, h=hits):
         codes = env[col_name]

@@ -269,6 +269,24 @@ class Executor:
         from greptimedb_tpu.storage.cache import DerivedLayoutCache
 
         self.layout_cache = DerivedLayoutCache()
+        # resident fulltext fingerprint matrices + verified-vocabulary
+        # memos (fulltext/resident.py): text predicates over dictionary-
+        # encoded columns prefilter on device and verify only candidates
+        from greptimedb_tpu.fulltext.resident import FulltextIndexCache
+
+        self.fulltext_cache = FulltextIndexCache()
+
+    def _fulltext_provider(self, plan, table):
+        """ctx.fulltext for one execution, or None (knob off / table
+        without dictionary lineage) — the compiler then walks
+        dictionaries host-side exactly as before."""
+        from greptimedb_tpu.fulltext import enabled
+        from greptimedb_tpu.fulltext.resident import FulltextProvider
+
+        if not enabled() or getattr(table, "dicts_root", 0) == 0:
+            return None
+        return FulltextProvider(self.fulltext_cache,
+                                getattr(plan, "table", None) or "?", table)
 
     # ------------------------------------------------------------------
     def execute(
@@ -306,6 +324,7 @@ class Executor:
         ctx = plan.ctx
         ctx.table_dicts = table.dicts  # vector search / string-dict exprs
         ctx.table_dicts_version = getattr(table, "dicts_version", 0)
+        ctx.fulltext = self._fulltext_provider(plan, table)
         ctx.sketch_table = plan.table
         ts_name = ctx.schema.time_index.name if ctx.schema.time_index else None
 
@@ -1766,6 +1785,7 @@ class Executor:
     ) -> tuple[dict[str, np.ndarray], int]:
         ctx = plan.ctx
         ctx.table_dicts = table.dicts  # vector search / string-dict exprs
+        ctx.fulltext = self._fulltext_provider(plan, table)
         ts_name = ctx.schema.time_index.name if ctx.schema.time_index else None
         where_fn = compile_device(plan.where, ctx) if plan.where is not None else None
         lo, hi = plan.time_range

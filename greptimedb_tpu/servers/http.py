@@ -265,6 +265,13 @@ class HttpServer(ThreadedAiohttpApp):
         r.add_post("/v1/otlp/v1/logs", self.h_otlp_logs)
         r.add_post("/v1/otel-arrow/v1/metrics", self.h_otel_arrow_metrics)
         r.add_post("/v1/loki/api/v1/push", self.h_loki_push)
+        r.add_route("*", "/v1/loki/api/v1/query", self.h_loki_query)
+        r.add_route("*", "/v1/loki/api/v1/query_range",
+                    self.h_loki_query_range)
+        r.add_route("*", "/v1/loki/api/v1/labels", self.h_loki_labels)
+        r.add_get("/v1/loki/api/v1/label/{name}/values",
+                  self.h_loki_label_values)
+        r.add_route("*", "/v1/loki/api/v1/series", self.h_loki_series)
         r.add_post("/v1/logs", self.h_log_query)
         r.add_post("/v1/otlp/v1/traces", self.h_otlp_traces)
         r.add_get("/v1/jaeger/api/services", self.h_jaeger_services)
@@ -305,7 +312,8 @@ class HttpServer(ThreadedAiohttpApp):
             self._ingest_pool, fn, *args
         )
 
-    def _admit_ingest(self, request: web.Request, wire_bytes: int):
+    def _admit_ingest(self, request: web.Request, wire_bytes: int,
+                      tenant: str | None = None):
         """Per-tenant write admission (PR 7 discipline, applied to the
         write path): reserve the batch's estimated decoded footprint
         against the tenant's memory budget and count it in flight, so
@@ -317,7 +325,8 @@ class HttpServer(ThreadedAiohttpApp):
         if sched is None:
             return lambda: None
         adm = sched.admission
-        tenant = self._tenant(request)
+        if tenant is None:
+            tenant = self._tenant(request)
         # decoded columnar batches run ~4x the wire bytes (numbers widen
         # to float64/int64, tag codes add int32 per row)
         est = wire_bytes * 4
@@ -356,6 +365,20 @@ class HttpServer(ThreadedAiohttpApp):
             except Exception:  # noqa: BLE001 — auth middleware rejects
                 pass
         return request.headers.get("x-greptime-tenant") or "default"
+
+    def _loki_tenant(self, request: web.Request) -> str:
+        """Loki surfaces speak multi-tenancy via ``X-Scope-OrgID``
+        (Loki's org header): it maps onto the SAME per-tenant admission
+        budgets as every other surface.  Authenticated identity still
+        wins — a client must not shed its quotas by sending a different
+        org id."""
+        auth = request.headers.get("Authorization", "")
+        if auth.startswith("Basic "):
+            return self._tenant(request)
+        org = request.headers.get("X-Scope-OrgID")
+        if org:
+            return str(org)
+        return self._tenant(request)
 
     @staticmethod
     def _priority(request: web.Request) -> str | None:
@@ -921,13 +944,15 @@ class HttpServer(ThreadedAiohttpApp):
         """Loki push (reference src/servers/src/http/loki.rs), BOTH wire
         forms: JSON and snappy-compressed protobuf (logproto.PushRequest
         — what promtail/the Grafana agent actually send).  Streams land
-        in ``loki_logs`` with stream labels as tags and the line in
-        ``line`` (string field)."""
+        in ``loki_logs`` with stream labels as tags, the line in ``line``
+        (string field), and the admitted tenant (``X-Scope-OrgID``) as a
+        ``tenant`` tag — queryable and joinable like any other label."""
         try:
             body = await request.read()
         except Exception as e:  # noqa: BLE001 (bad content encoding etc.)
             return web.json_response({"error": f"body: {e}"}, status=400)
         ctype = request.content_type or ""
+        tenant = self._loki_tenant(request)
 
         def run():
             # decompress/decode on the executor thread, never the event
@@ -963,33 +988,130 @@ class HttpServer(ThreadedAiohttpApp):
 
             # labels named like reserved columns are renamed
             rows = [
-                ({(k + "_label" if k in ("ts", "line") else k): v
+                ({(k + "_label" if k in ("ts", "line", "tenant") else k): v
                   for k, v in labels.items()}, line, ts)
                 for labels, line, ts in rows
             ]
             if not rows:
                 return 0
-            tag_names = sorted({k for lab, _l, _t in rows for k in lab})
+            tag_names = sorted({k for lab, _l, _t in rows for k in lab}
+                               | {"tenant"})
             cols: dict[str, list] = {k: [] for k in tag_names}
             cols["ts"] = []
             cols["line"] = []
             for lab, line, ts in rows:
                 for k in tag_names:
-                    cols[k].append(lab.get(k, ""))
+                    cols[k].append(tenant if k == "tenant"
+                                   else lab.get(k, ""))
                 cols["ts"].append(ts)
                 cols["line"].append(line)
             cols["__tags__"] = tag_names
             cols["__fields__"] = ["line"]
-            return _ingest_columns(self.db, "loki_logs", cols,
-                                    append_mode=True)
+            n = _ingest_columns(self.db, "loki_logs", cols,
+                                append_mode=True)
+            # ingest-side fingerprint hot tail: if the fulltext matrix is
+            # already resident, extend it with this batch's new distinct
+            # lines now (best-effort, non-blocking)
+            from greptimedb_tpu.fulltext.loki import prewarm_ingest
 
+            prewarm_ingest(self.db, "loki_logs")
+            return n
+
+        M_INGEST_BYTES.labels("loki").inc(len(body))
         try:
-            n = await self._call(run)
+            release = self._admit_ingest(request, len(body), tenant=tenant)
+            try:
+                n = await self._call_ingest(run)
+            finally:
+                release()
             M_INGEST_ROWS.labels("loki").inc(n)
             return web.Response(status=204)
         except Exception as e:  # noqa: BLE001
             body_json, status = _error_json(e)
             return web.json_response(body_json, status=status)
+
+    async def _loki_params(self, request: web.Request) -> dict:
+        params = dict(request.query)
+        if request.method == "POST" and request.content_type in (
+            "application/x-www-form-urlencoded", "multipart/form-data",
+        ):
+            form = await request.post()
+            for k in form:
+                params.setdefault(k, form[k])
+        return params
+
+    async def _loki_eval(self, request: web.Request, path: str, fn):
+        """Shared Loki read-endpoint plumbing: params, the query
+        scheduler (tenant admission from ``X-Scope-OrgID``, interactive
+        priority, deadline shedding), Loki-style error envelopes."""
+        ctx = _request_trace_context(request)
+        try:
+            params = await self._loki_params(request)
+
+            def run():
+                with M_PROTOCOL_QUERY.labels("loki").time():
+                    with TRACER.trace_context(ctx):
+                        return fn(params)
+
+            with M_LATENCY.labels(path).time():
+                sched = self.db.scheduler
+                if sched is not None:
+                    tenant = self._loki_tenant(request)
+                    payload = await self._call_query(
+                        lambda: sched.submit_fn(
+                            run, tenant=tenant,
+                            label=f"logql: {params.get('query', path)}"
+                            [:256]))
+                else:
+                    payload = await self._call(run)
+            M_REQUESTS.labels(path, "200").inc()
+            return web.json_response(payload, headers=_trace_headers(ctx))
+        except Exception as e:  # noqa: BLE001
+            _body, status = _error_json(e)
+            M_REQUESTS.labels(path, str(status)).inc()
+            return web.json_response(
+                {"status": "error", "errorType": "bad_data", "error": str(e)},
+                status=status, headers=_trace_headers(ctx))
+
+    async def h_loki_query(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.fulltext.loki import loki_query_instant
+
+        return await self._loki_eval(
+            request, "/v1/loki/api/v1/query",
+            lambda params: loki_query_instant(self.db, params))
+
+    async def h_loki_query_range(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.fulltext.loki import loki_query_range
+
+        return await self._loki_eval(
+            request, "/v1/loki/api/v1/query_range",
+            lambda params: loki_query_range(self.db, params))
+
+    async def h_loki_labels(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.fulltext.loki import loki_labels
+
+        return await self._loki_eval(
+            request, "/v1/loki/api/v1/labels",
+            lambda params: loki_labels(self.db, params))
+
+    async def h_loki_label_values(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.fulltext.loki import loki_label_values
+
+        name = request.match_info["name"]
+        return await self._loki_eval(
+            request, "/v1/loki/api/v1/label_values",
+            lambda params: loki_label_values(self.db, name, params))
+
+    async def h_loki_series(self, request: web.Request) -> web.Response:
+        from greptimedb_tpu.fulltext.loki import loki_series
+
+        matches = request.query.getall("match[]", [])
+        if not matches and request.method == "POST":
+            form = await request.post()
+            matches = form.getall("match[]", [])
+        return await self._loki_eval(
+            request, "/v1/loki/api/v1/series",
+            lambda params: loki_series(self.db, matches, params))
 
     async def h_log_query(self, request: web.Request) -> web.Response:
         from greptimedb_tpu.servers.logquery import execute_log_query
@@ -1411,12 +1533,17 @@ class HttpServer(ThreadedAiohttpApp):
     async def h_status(self, request: web.Request) -> web.Response:
         import jax
 
-        return web.json_response({
+        payload = {
             "version": "greptimedb-tpu-0.1.0",
             "devices": [str(d) for d in jax.devices()],
             "tables": len(self.db.catalog.list_tables(self.db.current_db)),
             "memory": self.db.memory.usage(),
-        })
+        }
+        ft = getattr(getattr(self.db, "engine", None), "executor", None)
+        ft = getattr(ft, "fulltext_cache", None)
+        if ft is not None and len(ft):
+            payload["fulltext"] = ft.stats()
+        return web.json_response(payload)
 
     async def h_promql(self, request: web.Request) -> web.Response:
         """Greptime-native PromQL endpoint: query/start/end/step params,

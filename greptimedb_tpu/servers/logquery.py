@@ -58,35 +58,106 @@ def _term_pred(cond: dict):
     return None
 
 
-def _match(cond: dict, values: np.ndarray) -> np.ndarray:
-    strs = np.asarray([("" if v is None else str(v)) for v in values],
-                      dtype=object)
-    n = len(strs)
+def _cond_pred(cond: dict):
+    """cond → (kind, text, predicate-over-coerced-strings) for the
+    fingerprint-prefilterable filter kinds, None for the rest
+    (exists: not value-local).  The predicate is THE definition of the
+    filter's truth — the host row loop and the fingerprint-verified map
+    both evaluate exactly it, so the two routes cannot diverge."""
     if "contains" in cond:
         needle = str(cond["contains"])
-        return np.array([needle in s for s in strs], dtype=bool)
+        return ("contains", needle, lambda s, t=needle: t in s)
     if "prefix" in cond:
         p = str(cond["prefix"])
-        return np.array([s.startswith(p) for s in strs], dtype=bool)
+        return ("prefix", p, lambda s, p=p: s.startswith(p))
     if "regex" in cond:
         try:
             rx = re.compile(str(cond["regex"]))
         except re.error as e:
             raise InvalidArguments(f"bad regex {cond['regex']!r}: {e}") from None
-        return np.array([rx.search(s) is not None for s in strs], dtype=bool)
-    if "match" in cond:
+        return ("regex", str(cond["regex"]),
+                lambda s, rx=rx: rx.search(s) is not None)
+    if "match" in cond or "matches" in cond:
         # full-text match (shared semantics with SQL matches(); empty-token
-        # queries match nothing)
+        # queries match nothing); "matches" is the documented spelling,
+        # "match" the original one — same filter
         from greptimedb_tpu.storage.index import ft_predicate
 
-        pred = ft_predicate("matches", str(cond["match"]))
-        return np.array([pred(s) for s in strs], dtype=bool)
+        q = str(cond.get("matches", cond.get("match")))
+        return ("matches", q, ft_predicate("matches", q))
     if "eq" in cond:
-        return np.asarray(strs == str(cond["eq"]), dtype=bool).reshape(n)
+        v = str(cond["eq"])
+        return ("eq", v, lambda s, v=v: s == v)
+    return None
+
+
+def _match(cond: dict, values: np.ndarray, vmap: dict | None = None
+           ) -> np.ndarray:
+    strs = np.asarray([("" if v is None else str(v)) for v in values],
+                      dtype=object)
+    n = len(strs)
+    got = _cond_pred(cond)
+    if got is not None:
+        _kind, _text, pred = got
+        if vmap is not None:
+            # fingerprint route: per-DISTINCT-value truth precomputed
+            # (fulltext/resident.py verified_bools over the resident
+            # dictionary); rows reduce to a dict probe.  Values the
+            # resident vocabulary has not seen yet (hot appends) fall
+            # back to the same predicate — bit-exact either way.
+            return np.array(
+                [vmap[s] if s in vmap else pred(s) for s in strs],
+                dtype=bool)
+        return np.array([pred(s) for s in strs], dtype=bool)
     if "exists" in cond:
         has = np.array([s != "" for s in strs], dtype=bool)
         return has if cond["exists"] else ~has
     raise InvalidArguments(f"unknown log filter {cond!r}")
+
+
+def _fingerprint_maps(db, table_name: str, view, query: dict) -> dict:
+    """Per-(filter, cond) value→bool maps from the resident fingerprint
+    index, for the DSL filter kinds it can serve (contains/prefix/regex/
+    eq/matches).  Only consults state that is ALREADY resident
+    (RegionCacheManager.peek_table — a cold table stays fully on the
+    host path); with `GREPTIME_FULLTEXT=off` or on any miss the caller's
+    per-row predicate loop runs unchanged, and rows whose value the
+    resident vocabulary has not seen fall back per value — the host path
+    is the fallback twin at every granularity."""
+    from greptimedb_tpu.fulltext import enabled
+
+    if not enabled():
+        return {}
+    cache_mgr = getattr(db, "cache", None)
+    ex = getattr(getattr(db, "engine", None), "executor", None)
+    ft = getattr(ex, "fulltext_cache", None)
+    if cache_mgr is None or ft is None:
+        return {}
+    dt = cache_mgr.peek_table(view)
+    if dt is None or getattr(dt, "dicts_root", 0) == 0:
+        return {}
+    out: dict = {}
+    for fi, f in enumerate(query.get("filters") or []):
+        col = f.get("column")
+        vocab = dt.dicts.get(col)
+        if not vocab:
+            continue
+        for ci, cond in enumerate(f.get("filters") or []):
+            got = _cond_pred(cond)
+            if got is None:
+                continue
+            kind, text, pred = got
+            # the verified memo sees raw vocabulary items; truth is
+            # defined over the DSL's coerced strings — one wrapper, and
+            # variant="dsl" namespaces the memo so the SQL path (whose
+            # subject for NULL is str(None)) can never serve this
+            # coercion's truth or vice versa
+            coerced = lambda v, p=pred: p("" if v is None else str(v))
+            vmap = ft.verified_map(table_name, dt, col, vocab, coerced,
+                                   kind, text, variant="dsl")
+            if vmap is not None:
+                out[(fi, ci)] = vmap
+    return out
 
 
 def execute_log_query(db, query: dict) -> QueryResult:
@@ -151,13 +222,14 @@ def execute_log_query(db, query: dict) -> QueryResult:
                           tag_preds=tag_preds or None,
                           ft_tokens=ft_tokens or None)
     n = len(host[ts_name])
+    vmaps = _fingerprint_maps(db, full, view, query)
     keep = np.ones(n, dtype=bool)
-    for f in query.get("filters") or []:
+    for fi, f in enumerate(query.get("filters") or []):
         col = f.get("column")
         if col not in host:
             raise InvalidArguments(f"unknown filter column {col!r}")
-        for cond in f.get("filters") or []:
-            keep &= _match(cond, host[col])
+        for ci, cond in enumerate(f.get("filters") or []):
+            keep &= _match(cond, host[col], vmaps.get((fi, ci)))
     idx = np.nonzero(keep)[0]
     # newest first, like the reference's default ordering for log search
     order = np.argsort(host[ts_name][idx].astype(np.int64))[::-1]

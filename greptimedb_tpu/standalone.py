@@ -347,6 +347,22 @@ class GreptimeDB(TableProvider):
             lambda n: self.memory.try_admit("promql_cache", n)
         )
         self.cache.promql_derived = self.promql_cache
+        # resident fulltext fingerprint index (fulltext/resident.py):
+        # matrices + verified-vocabulary memos admit under their own
+        # workload quota with reject-to-fallback — an over-budget build
+        # degrades to the host predicate loop instead of OOMing HBM
+        _ft = self.engine.executor.fulltext_cache
+        _ft_quota = os.environ.get("GREPTIME_FULLTEXT_QUOTA_BYTES")
+        self.memory.register(
+            "fulltext",
+            int(_ft_quota) if _ft_quota else None,
+            usage_fn=lambda: _ft.bytes,
+            reclaim_fn=_ft.reclaim,
+            policy="reject",
+        )
+        _ft.memory_probe = (
+            lambda n: self.memory.try_admit("fulltext", n)
+        )
         # cold-scan staging buffers (storage/scan.py): the parallel SST
         # decode pool admits its estimated in-flight decode bytes with
         # reject-to-SEQUENTIAL fallback — over quota, a scan degrades to
@@ -1131,6 +1147,10 @@ class GreptimeDB(TableProvider):
                 )
             for region in self._regions_of(stmt.table):
                 region.truncate()
+            # lineage checks would catch the staleness lazily; eager
+            # invalidation frees the fingerprint bytes now
+            self.engine.executor.fulltext_cache.invalidate_table(name)
+            self.engine.executor.fulltext_cache.invalidate_table(stmt.table)
             return QueryResult([], [], affected_rows=0)
         if isinstance(stmt, (CreateFlow, DropFlow, ShowFlows)):
             return self._flow_statement(stmt)
@@ -1405,6 +1425,8 @@ class GreptimeDB(TableProvider):
             self.procedures.submit(DropTableProcedure(state={
                 "db": db, "name": name, "if_exists": stmt.if_exists,
             }))
+            self.engine.executor.fulltext_cache.invalidate_table(name)
+            self.engine.executor.fulltext_cache.invalidate_table(full)
         return QueryResult([], [], affected_rows=1)
 
     def _admin(self, stmt) -> QueryResult:

@@ -113,6 +113,13 @@ class DeviceTable:
     # monotonic per-build version of ``dicts``: kernels that bake dict-
     # derived constants (vector/fulltext) key their cache on it
     dicts_version: int = 0
+    # lineage root: the dicts_version of the FULL build this table
+    # descends from.  Device-side extends bump dicts_version but keep the
+    # root (dictionaries only ever APPEND within a lineage), so
+    # incrementally extendable derived state — the fulltext fingerprint
+    # matrix — keys on the root and extends by vocabulary tail instead of
+    # rebuilding per append
+    dicts_root: int = 0
 
     @property
     def padded_rows(self) -> int:
@@ -133,15 +140,17 @@ class DeviceTable:
             tuple((k, tuple(v)) for k, v in sorted(self.dicts.items())),
             tuple(self.sorted_tags),
             self.dicts_version,
+            self.dicts_root,
         )
         return children, aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        names, num_series, dict_items, sorted_tags, dver = aux
+        names, num_series, dict_items, sorted_tags, dver, droot = aux
         cols = dict(zip(names, children[:-1]))
         return cls(cols, children[-1], num_series,
-                   {k: list(v) for k, v in dict_items}, sorted_tags, dver)
+                   {k: list(v) for k, v in dict_items}, sorted_tags, dver,
+                   droot)
 
 
 def _canonical_column(
@@ -261,7 +270,7 @@ def build_device_table(
     global _DICTS_VERSION
     _DICTS_VERSION += 1
     return DeviceTable(dev_cols, jnp.asarray(mask), region.num_series, dicts,
-                       tuple(sorted_tags), _DICTS_VERSION)
+                       tuple(sorted_tags), _DICTS_VERSION, _DICTS_VERSION)
 
 
 def _canonical_delta(
@@ -353,7 +362,7 @@ def extend_device_table(
     _DICTS_VERSION += 1
     return (
         DeviceTable(cols, mask, region.num_series, dicts, sorted_tags,
-                    _DICTS_VERSION),
+                    _DICTS_VERSION, table.dicts_root),
         n_new,
     )
 
@@ -556,6 +565,21 @@ class RegionCacheManager:
             self._bytes += table.nbytes()
             self._shrink()
         return table
+
+    def peek_table(self, region):
+        """The region's resident full-table DeviceTable if one is ALREADY
+        resident at the current base version, else None — never builds.
+        Consumers that only accelerate when warm (the log-query DSL's
+        fingerprint route) use this so a cold table stays on its host
+        path instead of paying a device build it didn't ask for.  The
+        entry may lag the append log; callers must treat the resident
+        dictionaries as a (valid) prefix, not the complete vocabulary."""
+        base_ver = getattr(region, "base_version", None)
+        if base_ver is None:
+            return None
+        entry = self._lru.get((region.region_id, base_ver, (None, None),
+                               None))
+        return entry.table if entry is not None else None
 
     def get_grid(self, region):
         """Dense-grid resident table for a region (storage/grid.py), or

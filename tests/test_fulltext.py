@@ -1,0 +1,762 @@
+"""Device-resident full-text search: fingerprint parity, LogQL parser
+goldens, Loki read API, and the push→query→PromQL round trip.
+
+The load-bearing property is BIT-EXACTNESS: the fingerprint prefilter
+may only ever produce false positives (exact host verification runs on
+candidates), so every result — SQL LIKE/ILIKE/regex/matches, LogQL line
+filters, the log-query DSL — must equal the host path exactly, including
+NULL, unicode case edges (İ/ı/ß/ſ), CJK and empty lines.  The fuzz
+classes pin that; ``GREPTIME_FULLTEXT=off`` must restore the host paths
+byte-for-byte.
+"""
+
+import json
+import random
+import re
+import types
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.fulltext import fingerprint as fpm
+from greptimedb_tpu.fulltext.logql import (
+    LabelFilter, LineFilter, LogQuery, Matcher, ParserStage, RangeAgg,
+    VectorAgg, parse_duration_ms, parse_logql,
+)
+from greptimedb_tpu.fulltext.resident import (
+    FulltextIndexCache, _host_verified,
+)
+from greptimedb_tpu.standalone import GreptimeDB
+from greptimedb_tpu.errors import InvalidArguments
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+# alphabet deliberately includes case-fold edges, CJK, emoji, separators
+_ALPHABET = (
+    list("abcdefgXYZ0123456789 _-./=:%[]()?*+|")
+    + ["İ", "ı", "ß", "ſ", "K", "é", "Σ", "σ", "ς", "日", "誌", "テ", "🎉"]
+)
+
+
+def _rand_text(rng: random.Random, maxlen: int = 40) -> str:
+    return "".join(rng.choice(_ALPHABET) for _ in range(rng.randrange(maxlen)))
+
+
+def _http(base, path, body=None, headers=None, method=None):
+    req = urllib.request.Request(base + path, data=body,
+                                 headers=headers or {}, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _loki_push(base, streams, headers=None):
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    return _http(base, "/v1/loki/api/v1/push",
+                 json.dumps({"streams": streams}).encode(), h)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint math
+# ---------------------------------------------------------------------------
+
+class TestFingerprintMath:
+    def test_canonical_text_fold_edges(self):
+        # exact containment must survive canonicalization...
+        for s in ("İstanbul", "dotless ı", "straße", "ſoft", "K elvin"):
+            for sub in (s[1:4], s[:3]):
+                assert fpm.canonical_text(sub) in fpm.canonical_text(s)
+        # ...and the sre i/ı equivalence collapses onto one form
+        assert fpm.canonical_text("ı") == "i"
+        assert fpm.canonical_text("İ") == "i"
+
+    def test_build_matches_query_side_hashing(self):
+        vals = ["error: conn reset", "GET /api", "日誌 テスト", ""]
+        fp = fpm.build_fingerprints(vals, 8, 2)
+        assert fp.shape == (4, 8) and fp.dtype == np.uint32
+        assert not fp[3].any()  # empty string has no grams
+        # every gram mask of a value is a subset of its fingerprint
+        for i, v in enumerate(vals):
+            qm = fpm.literal_mask(v, 8, 2)
+            assert np.array_equal(fp[i] & qm, qm)
+
+    def test_spec_extraction(self):
+        assert fpm.spec_for("eq", "abc") == [("abc",)]
+        assert fpm.spec_for("like", "%err%or_") == [("err", "or")]
+        assert fpm.spec_for("like", "%%") is None
+        assert fpm.spec_for("contains", "x") == [("x",)]
+        assert fpm.spec_for("matches", "hello v1.0") == [("hello", "v1",
+                                                          "0")]
+        assert fpm.spec_for("matches", "...") == fpm.MATCH_NOTHING
+        # regex: literal runs, groups, alternation, min>=1 repeats
+        assert fpm.spec_for("regex", "conn reset") == [("conn reset",)]
+        assert fpm.spec_for("regex", "a(bc)d") == [("a", "bc", "d")]
+        alts = fpm.spec_for("regex", "err(or|ed) hard")
+        assert alts is not None and len(alts) == 2
+        assert ("err", "or", " hard") in alts and ("err", "ed", " hard") in alts
+        assert fpm.spec_for("regex", "(abc)+x") == [("abc", "x")]
+        # star/optional/classes contribute nothing — but must stay sound
+        assert fpm.spec_for("regex", "a*b?c[de]f") in ([("c", "f")],
+                                                       [("c", "f",)])
+        assert fpm.spec_for("regex", "^anchored$") == [("anchored",)]
+
+    def test_compile_masks_drops_unprunable_alternative(self):
+        w, g = 8, 2
+        assert fpm.compile_masks([("err",), ("x",)], w, g) is None
+        m = fpm.compile_masks([("err",), ("warn",)], w, g)
+        assert m is not None and m.shape == (2, 8)
+        assert fpm.compile_masks(None, w, g) is None
+        assert fpm.compile_masks(fpm.MATCH_NOTHING, w, g) is None
+
+
+# ---------------------------------------------------------------------------
+# fingerprint parity fuzz (unit level: cache vs host full scan)
+# ---------------------------------------------------------------------------
+
+class TestFingerprintParityFuzz:
+    def _preds(self, rng: random.Random, corpus):
+        """Random predicates of every routed kind, with their host truth
+        exactly as query/exprs.py / logquery.py / loki.py define it."""
+        out = []
+        for _ in range(4):
+            src = rng.choice(corpus) if corpus and rng.random() < 0.7 \
+                else _rand_text(rng, 12)
+            i = rng.randrange(max(len(src), 1))
+            frag = src[i:i + rng.randrange(1, 8)]
+            out.append(("contains", frag,
+                        lambda v, t=frag: t in str(v)))
+            pat = f"%{frag}%" if rng.random() < 0.6 else \
+                f"{frag}%" if rng.random() < 0.5 else f"%{frag}"
+            rx = re.compile(
+                "^" + "".join(".*" if c == "%" else re.escape(c)
+                              for c in pat) + "$")
+            out.append(("like", pat,
+                        lambda v, rx=rx: rx.match(str(v)) is not None))
+            rxi = re.compile(
+                "^" + "".join(".*" if c == "%" else re.escape(c)
+                              for c in pat) + "$", re.IGNORECASE)
+            out.append(("ilike", pat,
+                        lambda v, rx=rxi: rx.match(str(v)) is not None))
+            frag2 = _rand_text(rng, 6)
+            for rtext in (re.escape(frag) + ".*" + re.escape(frag2),
+                          f"({re.escape(frag)}|{re.escape(frag2)})x?",
+                          re.escape(frag2) + "+"):
+                try:
+                    rr = re.compile(rtext)
+                except re.error:
+                    continue
+                out.append(("regex", rtext,
+                            lambda v, rr=rr: rr.search(str(v)) is not None))
+            out.append(("eq", src, lambda v, s=src: str(v) == s))
+            from greptimedb_tpu.storage.index import ft_predicate
+
+            q = " ".join(frag.split()[:2]) or frag
+            p = ft_predicate("matches", q)
+            out.append(("matches", q, lambda v, p=p: p(str(v))))
+        return out
+
+    def test_parity_random_corpora(self):
+        rng = random.Random(1234)
+        cache = FulltextIndexCache()
+        for round_i in range(6):
+            corpus = [_rand_text(rng) for _ in range(rng.randrange(5, 120))]
+            corpus += ["", "error: conn reset", 'j{"a": 1}',
+                       "İstanbul ıssız ſtraße"]
+            vocab = list(dict.fromkeys(corpus))  # dictionaries are unique
+            table = types.SimpleNamespace(dicts_root=round_i + 1)
+            for kind, text, pred in self._preds(rng, vocab):
+                got = cache.verified_bools(
+                    f"t{round_i}", table, "line", vocab, pred, kind, text)
+                want = _host_verified(vocab, pred)
+                assert got is not None and np.array_equal(got, want), (
+                    kind, text)
+                # memoized second lookup is identical
+                again = cache.verified_bools(
+                    f"t{round_i}", table, "line", vocab, pred, kind, text)
+                assert np.array_equal(again, want)
+
+    def test_parity_across_vocab_extension(self):
+        rng = random.Random(77)
+        cache = FulltextIndexCache()
+        vocab = [_rand_text(rng) for _ in range(60)]
+        table = types.SimpleNamespace(dicts_root=9)
+        preds = self._preds(rng, vocab)
+        for kind, text, pred in preds:
+            got = cache.verified_bools("tx", table, "line", vocab, pred,
+                                       kind, text)
+            assert np.array_equal(got, _host_verified(vocab, pred))
+        # dictionary grows (hot-tail append): only the tail re-verifies,
+        # results must still equal the full host scan
+        vocab = vocab + [_rand_text(rng) for _ in range(40)] + ["errör ☠"]
+        for kind, text, pred in preds:
+            got = cache.verified_bools("tx", table, "line", vocab, pred,
+                                       kind, text)
+            assert np.array_equal(got, _host_verified(vocab, pred)), (
+                kind, text)
+
+    def test_quota_reject_falls_back_without_wrong_results(self):
+        cache = FulltextIndexCache(capacity_bytes=1)  # nothing admits
+        vocab = ["alpha error", "beta", "gamma error"]
+        table = types.SimpleNamespace(dicts_root=3)
+        pred = lambda v: "error" in str(v)  # noqa: E731
+        got = cache.verified_bools("t", table, "line", vocab, pred,
+                                   "contains", "error")
+        assert np.array_equal(got, [True, False, True])
+        assert cache.bytes == 0  # nothing was admitted
+
+    def test_null_coercion_variants_do_not_share_memos(self):
+        # review regression: the SQL path's subject for a None vocabulary
+        # entry is str(None) == "None" while the log-query DSL coerces
+        # None to "" — one shared memo let each path serve the other's
+        # truth for NULL entries.  The variant key must isolate them,
+        # in BOTH warm orders.
+        for first in ("sql", "dsl"):
+            cache = FulltextIndexCache()
+            vocab = [None, "has None inside", "other"]
+            table = types.SimpleNamespace(dicts_root=4)
+            rx = re.compile("None")
+            sql_pred = lambda v: rx.search(str(v)) is not None  # noqa: E731
+            dsl_pred = lambda v: rx.search(  # noqa: E731
+                "" if v is None else str(v)) is not None
+            def run_sql():
+                return cache.verified_bools("t", table, "c", vocab,
+                                            sql_pred, "regex", "None")
+            def run_dsl():
+                return cache.verified_map("t", table, "c", vocab,
+                                          dsl_pred, "regex", "None",
+                                          variant="dsl")
+            if first == "sql":
+                run_sql()
+            else:
+                run_dsl()
+            assert np.array_equal(run_sql(), [True, True, False])
+            assert run_dsl() == {"": False, "has None inside": True,
+                                 "other": False}
+
+    def test_knob_off_returns_none(self, monkeypatch):
+        monkeypatch.setenv("GREPTIME_FULLTEXT", "off")
+        cache = FulltextIndexCache()
+        table = types.SimpleNamespace(dicts_root=1)
+        assert cache.verified_bools("t", table, "c", ["a"], lambda v: True,
+                                    "eq", "a") is None
+        assert cache.line_filter_vector("t", table, "c", ["a"], []) is None
+
+
+# ---------------------------------------------------------------------------
+# SQL-path parity fuzz (LIKE/ILIKE/~/matches on vs off)
+# ---------------------------------------------------------------------------
+
+class TestSqlParityFuzz:
+    def test_sql_text_predicates_on_off(self, monkeypatch):
+        rng = random.Random(4242)
+        db = GreptimeDB()
+        try:
+            db.sql("CREATE TABLE fuzz_logs (app STRING, ts TIMESTAMP TIME "
+                   "INDEX, line STRING, PRIMARY KEY(app)) "
+                   "WITH (append_mode='true')")
+            lines = [_rand_text(rng) for _ in range(220)]
+            lines += ["", "error: conn reset by peer",
+                      "İstanbul ıssız ſtraße", "日誌 テスト 🎉"]
+            # SQL literals: strip quote/backslash (escaping is not under
+            # test), NULL every 17th row
+            for i, l in enumerate(lines):
+                l = l.replace("'", "").replace("\\", "")
+                lit = "NULL" if i % 17 == 13 else f"'{l}'"
+                db.sql(f"INSERT INTO fuzz_logs VALUES "
+                       f"('a{i % 3}', {1700000000000 + i}, {lit})")
+            frags = [l[rng.randrange(max(len(l) - 3, 1)):][:4]
+                     .replace("'", "").replace("\\", "")
+                     for l in lines if len(l) > 4][:12]
+            frags += ["err", "テ", "ıs"]
+            queries = []
+            for f in frags:
+                queries += [
+                    f"SELECT ts FROM fuzz_logs WHERE line LIKE '%{f}%' "
+                    "ORDER BY ts",
+                    f"SELECT ts FROM fuzz_logs WHERE line ILIKE "
+                    f"'%{f.upper()}%' ORDER BY ts",
+                    f"SELECT count(*) FROM fuzz_logs WHERE "
+                    f"matches(line, '{f}')",
+                ]
+                rx = re.escape(f)
+                queries.append(
+                    f"SELECT ts FROM fuzz_logs WHERE line ~ '{rx}' "
+                    "ORDER BY ts")
+            on, off = {}, {}
+            monkeypatch.setenv("GREPTIME_FULLTEXT", "on")
+            for q in queries:
+                on[q] = db.sql(q).rows
+            monkeypatch.setenv("GREPTIME_FULLTEXT", "off")
+            for q in queries:
+                off[q] = db.sql(q).rows
+            for q in queries:
+                assert on[q] == off[q], q
+            from greptimedb_tpu.utils.telemetry import REGISTRY
+
+            assert REGISTRY.value("greptime_fulltext_queries_total",
+                                  ("prefilter",)) > 0
+        finally:
+            db.close()
+
+
+# ---------------------------------------------------------------------------
+# LogQL parser goldens
+# ---------------------------------------------------------------------------
+
+class TestLogQLParserGoldens:
+    GOLDENS = [
+        ('{app="web"}', LogQuery((Matcher("app", "=", "web"),))),
+        ('{app="web", env=~"prod|stage", region!~"eu-.*", x!="y"}',
+         LogQuery((Matcher("app", "=", "web"),
+                   Matcher("env", "=~", "prod|stage"),
+                   Matcher("region", "!~", "eu-.*"),
+                   Matcher("x", "!=", "y")))),
+        ('{app="web"} |= "error" != "debug" |~ "conn.*reset" !~ "noise"',
+         LogQuery((Matcher("app", "=", "web"),),
+                  (LineFilter("|=", "error"), LineFilter("!=", "debug"),
+                   LineFilter("|~", "conn.*reset"),
+                   LineFilter("!~", "noise")))),
+        ('{a="b"} | json | status >= 500',
+         LogQuery((Matcher("a", "=", "b"),),
+                  (ParserStage("json"),
+                   LabelFilter("status", ">=", "500", numeric=True)))),
+        ('{a="b"} | logfmt | level = "error"',
+         LogQuery((Matcher("a", "=", "b"),),
+                  (ParserStage("logfmt"),
+                   LabelFilter("level", "=", "error")))),
+        ('{a="b"} |= "x\\"quoted\\""',
+         LogQuery((Matcher("a", "=", "b"),),
+                  (LineFilter("|=", 'x"quoted"'),))),
+        ('count_over_time({app="web"} |= "err" [5m])',
+         RangeAgg("count_over_time",
+                  LogQuery((Matcher("app", "=", "web"),),
+                           (LineFilter("|=", "err"),)), 300000)),
+        ('rate({a="b"} [1h30m])',
+         RangeAgg("rate", LogQuery((Matcher("a", "=", "b"),)), 5400000)),
+        ('bytes_over_time({a="b"} [30s])',
+         RangeAgg("bytes_over_time", LogQuery((Matcher("a", "=", "b"),)),
+                  30000)),
+        ('sum by (app) (count_over_time({e=~".+"} [1m]))',
+         VectorAgg("sum",
+                   RangeAgg("count_over_time",
+                            LogQuery((Matcher("e", "=~", ".+"),)), 60000),
+                   ("app",), False, True)),
+        ('max without (pod, node) (rate({a="b"} [5m]))',
+         VectorAgg("max",
+                   RangeAgg("rate", LogQuery((Matcher("a", "=", "b"),)),
+                            300000),
+                   ("pod", "node"), True, True)),
+        ('avg(count_over_time({a="b"} [1m])) by (app)',
+         VectorAgg("avg",
+                   RangeAgg("count_over_time",
+                            LogQuery((Matcher("a", "=", "b"),)), 60000),
+                   ("app",), False, True)),
+        ('{}', LogQuery(())),
+    ]
+
+    def test_goldens(self):
+        for text, want in self.GOLDENS:
+            assert parse_logql(text) == want, text
+
+    def test_durations(self):
+        assert parse_duration_ms("5m") == 300000
+        assert parse_duration_ms("1h30m") == 5400000
+        assert parse_duration_ms("250ms") == 250
+        assert parse_duration_ms("1w") == 604800000
+        with pytest.raises(InvalidArguments):
+            parse_duration_ms("5x")
+
+    def test_errors(self):
+        for bad in ("", "{app=web}", '{app="web"', '{app="web"} |= error',
+                    'frobnicate({a="b"} [5m])', '{a="b"} | unknown ~ 3',
+                    'sum(count_over_time({a="b"} [5m])) trailing',
+                    '{a="b"} | json | status =~ 500'):
+            with pytest.raises(InvalidArguments):
+                parse_logql(bad)
+
+
+# ---------------------------------------------------------------------------
+# Loki read API over HTTP (scheduler on: tenant admission via X-Scope-OrgID)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="class")
+def loki_server():
+    from greptimedb_tpu.servers import HttpServer
+
+    db = GreptimeDB()
+    srv = HttpServer(db, port=0)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    streams = [
+        {"stream": {"app": "web", "level": "error"},
+         "values": [["1700000000000000000", "boom conn reset"],
+                    ["1700000001500000000", "boom timeout"],
+                    ["1700000003000000000", "recovered fine"]]},
+        {"stream": {"app": "api", "level": "info"},
+         "values": [["1700000002000000000",
+                     '{"user": "alice", "status": 500, "msg": "boom"}'],
+                    ["1700000004000000000",
+                     '{"user": "bob", "status": 200, "msg": "ok"}']]},
+        {"stream": {"app": "api", "level": "warn"},
+         "values": [["1700000005000000000", "latency=2.5 path=/api ok"]]},
+    ]
+    code, _ = _loki_push(base, streams, {"X-Scope-OrgID": "acme"})
+    assert code == 204
+    yield db, srv, base
+    srv.stop()
+    db.close()
+
+
+class TestLokiReadApi:
+    def _range(self, base, query, **params):
+        qs = {"query": query, "start": "1700000000", "end": "1700000100"}
+        qs.update(params)
+        code, raw = _http(base, "/v1/loki/api/v1/query_range?"
+                          + urllib.parse.urlencode(qs))
+        assert code == 200, raw
+        return json.loads(raw)["data"]
+
+    def test_push_tags_tenant(self, loki_server):
+        db, _srv, base = loki_server
+        code, raw = _http(base, "/v1/sql?" + urllib.parse.urlencode(
+            {"sql": "SELECT DISTINCT tenant FROM loki_logs"}))
+        assert json.loads(raw)["output"][0]["records"]["rows"] == [["acme"]]
+
+    def test_streams_line_filter(self, loki_server):
+        _db, _srv, base = loki_server
+        data = self._range(base, '{app="web"} |= "boom"')
+        assert data["resultType"] == "streams"
+        assert len(data["result"]) == 1
+        vals = data["result"][0]["values"]
+        # newest first (backward default), label set carried through
+        assert [v[1] for v in vals] == ["boom timeout", "boom conn reset"]
+        assert vals[0][0] == "1700000001500000000"
+        assert data["result"][0]["stream"]["app"] == "web"
+        assert data["result"][0]["stream"]["tenant"] == "acme"
+
+    def test_streams_direction_and_limit(self, loki_server):
+        _db, _srv, base = loki_server
+        data = self._range(base, '{app="web"}', direction="forward",
+                           limit="2")
+        vals = data["result"][0]["values"]
+        assert [v[1] for v in vals] == ["boom conn reset", "boom timeout"]
+
+    def test_negated_and_regex_filters(self, loki_server):
+        _db, _srv, base = loki_server
+        data = self._range(base, '{app="web"} != "boom"')
+        assert [v[1] for v in data["result"][0]["values"]] == [
+            "recovered fine"]
+        data = self._range(base, '{app=~"web|api"} |~ "conn.*reset"')
+        assert sum(len(s["values"]) for s in data["result"]) == 1
+
+    def test_json_stage_and_label_filter(self, loki_server):
+        _db, _srv, base = loki_server
+        data = self._range(base, '{app="api"} | json | status >= 500')
+        assert len(data["result"]) == 1
+        vals = data["result"][0]["values"]
+        assert len(vals) == 1 and '"alice"' in vals[0][1]
+        # extracted labels join the stream label set
+        assert data["result"][0]["stream"]["user"] == "alice"
+
+    def test_logfmt_stage(self, loki_server):
+        _db, _srv, base = loki_server
+        data = self._range(base, '{app="api"} | logfmt | path = "/api"')
+        assert sum(len(s["values"]) for s in data["result"]) == 1
+
+    def test_count_over_time_matrix(self, loki_server):
+        _db, _srv, base = loki_server
+        data = self._range(base, 'count_over_time({app="web"} |= "boom" '
+                           '[10s])', start="1700000005", end="1700000015",
+                           step="5")
+        assert data["resultType"] == "matrix"
+        assert len(data["result"]) == 1
+        vals = {v[0]: v[1] for v in data["result"][0]["values"]}
+        # windows are left-exclusive (t-10s, t]: at t=5 both boom lines
+        # (t=0, t=1.5) count; at t=10 the t=0 line falls OUT of (0, 10];
+        # by t=15 no boom line remains in (5, 15]
+        assert vals[1700000005.0] == "2"
+        assert vals[1700000010.0] == "1"
+        assert 1700000015.0 not in vals
+
+    def test_rate_and_sum_by(self, loki_server):
+        _db, _srv, base = loki_server
+        data = self._range(base, 'sum by (app) '
+                           '(count_over_time({level=~".+"} [10s]))',
+                           start="1700000005", end="1700000005", step="5")
+        got = {r["metric"]["app"]: r["values"][0][1]
+               for r in data["result"]}
+        # (t-10, t] at t=5: web rows at 0/1.5/3; api rows at 2/4 and the
+        # right-inclusive one at exactly t=5
+        assert got == {"web": "3", "api": "3"}
+        data = self._range(base, 'rate({app="web"} |= "boom" [10s])',
+                           start="1700000005", end="1700000005", step="5")
+        assert data["result"][0]["values"][0][1] == "0.2"
+
+    def test_bytes_over_time(self, loki_server):
+        _db, _srv, base = loki_server
+        data = self._range(base, 'bytes_over_time({app="web"} |= "boom" '
+                           '[10s])', start="1700000005", end="1700000005",
+                           step="5")
+        want = len(b"boom conn reset") + len(b"boom timeout")
+        assert data["result"][0]["values"][0][1] == str(want)
+
+    def test_instant_vector(self, loki_server):
+        _db, _srv, base = loki_server
+        qs = urllib.parse.urlencode({
+            "query": 'count_over_time({app="web"} [10s])',
+            "time": "1700000005"})
+        code, raw = _http(base, "/v1/loki/api/v1/query?" + qs)
+        assert code == 200
+        data = json.loads(raw)["data"]
+        assert data["resultType"] == "vector"
+        assert data["result"][0]["value"][1] == "3"
+
+    def test_labels_values_series(self, loki_server):
+        _db, _srv, base = loki_server
+        code, raw = _http(base, "/v1/loki/api/v1/labels")
+        assert json.loads(raw)["data"] == ["app", "level", "tenant"]
+        code, raw = _http(base, "/v1/loki/api/v1/label/app/values")
+        assert json.loads(raw)["data"] == ["api", "web"]
+        code, raw = _http(base, "/v1/loki/api/v1/series?"
+                          + urllib.parse.urlencode({"match[]":
+                                                    '{app="api"}'}))
+        got = json.loads(raw)["data"]
+        assert {tuple(sorted(d.items())) for d in got} == {
+            (("app", "api"), ("level", "info"), ("tenant", "acme")),
+            (("app", "api"), ("level", "warn"), ("tenant", "acme")),
+        }
+
+    def test_on_off_parity(self, loki_server, monkeypatch):
+        _db, _srv, base = loki_server
+        queries = ['{app="web"} |= "boom"',
+                   '{app=~".+"} |~ "o{2}m" != "reset"',
+                   'count_over_time({app="web"} |= "boom" [10s])',
+                   'sum by (app) (rate({level=~".+"} [20s]))']
+        on = {q: self._range(base, q, start="1700000002",
+                             end="1700000012", step="5") for q in queries}
+        monkeypatch.setenv("GREPTIME_FULLTEXT", "off")
+        off = {q: self._range(base, q, start="1700000002",
+                              end="1700000012", step="5") for q in queries}
+        monkeypatch.delenv("GREPTIME_FULLTEXT")
+        assert on == off
+
+    def test_bad_queries_are_400(self, loki_server):
+        _db, _srv, base = loki_server
+        for q in ("{app=", 'count_over_time({a="b"})', "nope"):
+            code, _raw = _http(base, "/v1/loki/api/v1/query_range?"
+                               + urllib.parse.urlencode({"query": q}))
+            assert code == 400, q
+
+    def test_unknown_table_is_empty_success(self, loki_server):
+        _db, _srv, base = loki_server
+        data = self._range(base, '{app="web"}', table="absent_logs")
+        assert data == {"resultType": "streams", "result": []}
+
+    def test_scope_orgid_admission(self, loki_server):
+        db, _srv, base = loki_server
+        adm = db.scheduler.admission
+        adm.set_quota("smallorg", mem_bytes=64)
+        code, _ = _loki_push(
+            base, [{"stream": {"app": "x"},
+                    "values": [["1700000000000000000", "x" * 64]]}] * 8,
+            {"X-Scope-OrgID": "smallorg"})
+        assert code == 503
+        adm.set_quota("slowread", qps=0.001, burst=1)
+        qs = urllib.parse.urlencode({"query": '{app="web"}',
+                                     "start": "1700000000",
+                                     "end": "1700000100"})
+        codes = []
+        for _ in range(2):
+            code, _raw = _http(base, "/v1/loki/api/v1/query_range?" + qs,
+                               headers={"X-Scope-OrgID": "slowread"})
+            codes.append(code)
+        assert codes == [200, 429]
+
+
+# ---------------------------------------------------------------------------
+# ingest hot tail: fingerprints extend at push time once resident
+# ---------------------------------------------------------------------------
+
+class TestIngestPrewarm:
+    def test_push_extends_resident_fingerprints(self):
+        from greptimedb_tpu.servers import HttpServer
+
+        db = GreptimeDB()
+        srv = HttpServer(db, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            _loki_push(base, [{"stream": {"app": "a"}, "values": [
+                ["1700000000000000000", f"line number {i}"]
+                for i in range(8)]}])
+            # no fp resident yet → push does not build one
+            ft = db.engine.executor.fulltext_cache
+            assert not any(k[0] == "fp" for k in ft._lru)
+            # a query makes the matrix resident...
+            qs = urllib.parse.urlencode({
+                "query": '{app="a"} |= "number"',
+                "start": "1700000000", "end": "1700000100"})
+            code, raw = _http(base, "/v1/loki/api/v1/query_range?" + qs)
+            assert code == 200
+            assert sum(len(s["values"])
+                       for s in json.loads(raw)["data"]["result"]) == 8
+            entry = next(ft._lru[k] for k in ft._lru if k[0] == "fp")
+            n0 = entry.n
+            assert n0 >= 8
+            # ...and the NEXT push fingerprints its new lines at ingest
+            _loki_push(base, [{"stream": {"app": "a"}, "values": [
+                ["17000001%02d000000000" % i, f"fresh tail {i}"]
+                for i in range(4)]}])
+            entry = next(ft._lru[k] for k in ft._lru if k[0] == "fp")
+            assert entry.n >= n0 + 4
+            # and the warm query sees the new rows, still exact
+            code, raw = _http(base, "/v1/loki/api/v1/query_range?"
+                              + urllib.parse.urlencode({
+                                  "query": '{app="a"} |= "fresh"',
+                                  "start": "1700000000",
+                                  "end": "1700000200"}))
+            assert sum(len(s["values"])
+                       for s in json.loads(raw)["data"]["result"]) == 4
+        finally:
+            srv.stop()
+            db.close()
+
+
+# ---------------------------------------------------------------------------
+# log-query DSL rides the fingerprint route when resident
+# ---------------------------------------------------------------------------
+
+class TestLogQueryDslPrefilter:
+    def test_dsl_parity_and_matches_kind(self):
+        from greptimedb_tpu.servers.logquery import execute_log_query
+
+        db = GreptimeDB()
+        try:
+            db.sql("CREATE TABLE dlogs (app STRING, ts TIMESTAMP TIME "
+                   "INDEX, line STRING, PRIMARY KEY(app)) "
+                   "WITH (append_mode='true')")
+            for i, l in enumerate(["error conn reset", "GET /api ok",
+                                   "warn slow", "error timeout", ""]):
+                db.sql(f"INSERT INTO dlogs VALUES "
+                       f"('a', {1700000000000 + i}, '{l}')")
+            q = {"table": {"table": "dlogs"},
+                 "filters": [{"column": "line",
+                              "filters": [{"contains": "error"}]}],
+                 "columns": ["ts", "line"]}
+            cold = execute_log_query(db, q).rows
+            # make the device table resident → the DSL now probes the
+            # fingerprint-verified map instead of per-row predicates
+            db.sql("SELECT count(*) FROM dlogs")
+            from greptimedb_tpu.utils.telemetry import REGISTRY
+
+            v0 = REGISTRY.value("greptime_fulltext_queries_total",
+                                ("prefilter",))
+            warm = execute_log_query(db, q).rows
+            assert warm == cold
+            assert REGISTRY.value("greptime_fulltext_queries_total",
+                                  ("prefilter",)) > v0
+            # the new `matches` kind (documented spelling of `match`)
+            q2 = {"table": {"table": "dlogs"},
+                  "filters": [{"column": "line",
+                               "filters": [{"matches": "conn reset"}]}],
+                  "columns": ["line"]}
+            assert execute_log_query(db, q2).rows == [
+                ["error conn reset"]]
+            q3 = {"table": {"table": "dlogs"},
+                  "filters": [{"column": "line",
+                               "filters": [{"match": "conn reset"}]}],
+                  "columns": ["line"]}
+            assert execute_log_query(db, q3).rows == \
+                execute_log_query(db, q2).rows
+        finally:
+            db.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: Loki push → LogQL → PromQL joined by trace_id
+# ---------------------------------------------------------------------------
+
+class TestObservabilityRoundTrip:
+    def test_logs_metrics_join_by_trace_id(self):
+        from greptimedb_tpu.servers import HttpServer
+
+        db = GreptimeDB()
+        srv = HttpServer(db, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            # 1. logs with a trace_id stream label
+            _loki_push(base, [
+                {"stream": {"app": "checkout", "trace_id": "t-9f3a"},
+                 "values": [["1700000010000000000",
+                             "payment failed: upstream 503"]]},
+                {"stream": {"app": "checkout", "trace_id": "t-0001"},
+                 "values": [["1700000011000000000", "payment ok"]]},
+            ])
+            # 2. a metric series tagged with the same trace_id
+            db.sql("CREATE TABLE request_latency (app STRING, trace_id "
+                   "STRING, ts TIMESTAMP TIME INDEX, val DOUBLE, "
+                   "PRIMARY KEY(app, trace_id))")
+            db.sql("INSERT INTO request_latency VALUES "
+                   "('checkout', 't-9f3a', 1700000010000, 2.75)")
+            db.sql("INSERT INTO request_latency VALUES "
+                   "('checkout', 't-0001', 1700000011000, 0.05)")
+            # 3. LogQL finds the failing request and carries its trace_id
+            qs = urllib.parse.urlencode({
+                "query": '{app="checkout"} |= "failed"',
+                "start": "1700000000", "end": "1700000100"})
+            code, raw = _http(base, "/v1/loki/api/v1/query_range?" + qs)
+            assert code == 200
+            result = json.loads(raw)["data"]["result"]
+            assert len(result) == 1
+            trace_id = result[0]["stream"]["trace_id"]
+            assert trace_id == "t-9f3a"
+            # 4. PromQL pivots on that trace_id into the metric world
+            qs = urllib.parse.urlencode({
+                "query": f'request_latency{{trace_id="{trace_id}"}}',
+                "time": "1700000012"})
+            code, raw = _http(base,
+                              "/v1/prometheus/api/v1/query?" + qs)
+            assert code == 200
+            prom = json.loads(raw)["data"]["result"]
+            assert len(prom) == 1
+            assert float(prom[0]["value"][1]) == pytest.approx(2.75)
+            # 5. and SQL joins the two workloads on the same key
+            r = db.sql(
+                "SELECT l.line, m.val FROM loki_logs l JOIN "
+                "request_latency m ON l.trace_id = m.trace_id "
+                "WHERE l.line LIKE '%failed%'")
+            assert r.rows == [["payment failed: upstream 503", 2.75]]
+        finally:
+            srv.stop()
+            db.close()
+
+
+# ---------------------------------------------------------------------------
+# telemetry surface
+# ---------------------------------------------------------------------------
+
+class TestFulltextTelemetry:
+    def test_metrics_registered_by_import(self):
+        import greptimedb_tpu.fulltext.resident  # noqa: F401
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+
+        for required in (
+            "greptime_fulltext_candidates_total",
+            "greptime_fulltext_verified_total",
+            "greptime_fulltext_matched_total",
+            "greptime_fulltext_scanned_total",
+            "greptime_fulltext_queries_total",
+            "greptime_fulltext_indexed_values_total",
+            "greptime_fulltext_resident_bytes",
+        ):
+            assert required in REGISTRY._metrics, required

@@ -197,6 +197,21 @@ class TestRegistryStaticCheck:
             "greptime_durability_repaired_total",
         ):
             assert required in REGISTRY._metrics, required
+        # the fulltext fingerprint index: candidates/verified/matched
+        # (false-positive ratio), selectivity, per-path query counts and
+        # resident bytes — the surface bench_logs.py reads
+        import greptimedb_tpu.fulltext.resident  # noqa: F401
+
+        for required in (
+            "greptime_fulltext_candidates_total",
+            "greptime_fulltext_verified_total",
+            "greptime_fulltext_matched_total",
+            "greptime_fulltext_scanned_total",
+            "greptime_fulltext_queries_total",
+            "greptime_fulltext_indexed_values_total",
+            "greptime_fulltext_resident_bytes",
+        ):
+            assert required in REGISTRY._metrics, required
 
     def test_self_export_table_naming(self):
         # the self-import loop (utils/selfmonitor.py) names tables after
