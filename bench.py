@@ -497,6 +497,121 @@ def cold_scan_bench(db) -> None:
     }), flush=True)
 
 
+_COLDSTART_CHILD = r"""
+import json, os, sys, time
+import jax
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+t_boot = time.time()
+from greptimedb_tpu.standalone import GreptimeDB
+from greptimedb_tpu.utils.telemetry import REGISTRY
+
+d = sys.argv[1]
+hosts, steps = int(sys.argv[2]), int(sys.argv[3])
+T0 = 1451606400000
+db = GreptimeDB(d)
+marker = os.path.join(d, "ready")
+if not os.path.exists(marker):
+    import numpy as np
+
+    db.sql(
+        "CREATE TABLE IF NOT EXISTS cs (h STRING, ts TIMESTAMP(3) "
+        "TIME INDEX, v DOUBLE, w DOUBLE, PRIMARY KEY (h))"
+    )
+    region = db._region_of("cs")
+    rng = np.random.default_rng(3)
+    n = hosts * steps
+    region.write({
+        "h": np.repeat([f"host_{i}" for i in range(hosts)], steps),
+        "ts": np.tile(T0 + 10_000 * np.arange(steps, dtype=np.int64),
+                      hosts),
+        "v": rng.uniform(0, 100, n),
+        "w": rng.uniform(0, 100, n),
+    })
+    region.flush()
+    with open(marker, "w") as f:
+        f.write("ok")
+open_ms = (time.time() - t_boot) * 1000
+hours = (steps * 10_000) // 3600_000 or 1
+sql = (
+    "SELECT h, date_trunc('hour', ts) AS hour, avg(v), avg(w) FROM cs "
+    f"WHERE ts >= {T0} AND ts < {T0 + hours * 3600_000} "
+    "GROUP BY h, hour"
+)
+t0 = time.time()
+r = db.sql(sql)
+first_ms = (time.time() - t0) * 1000
+t0 = time.time()
+db.sql(sql)
+warm_ms = (time.time() - t0) * 1000
+print(json.dumps({
+    "open_ms": round(open_ms, 1),
+    "first_query_ms": round(first_ms, 1),
+    "warm_ms": round(warm_ms, 1),
+    "rows": r.num_rows,
+    "xla_builds": int(REGISTRY.value(
+        "greptime_compile_xla_builds_total", ("sql",))),
+    "aot_hits": db.plan_compiler.aot_hits,
+}), flush=True)
+db.close()
+"""
+
+
+def cold_start_bench() -> None:
+    """First-warm-class-query cold-start A/B (compile/ subsystem): three
+    fresh processes over one small dataset — seed (cache on, journals +
+    persists the warm class), cache OFF (every kernel recompiles), cache
+    ON second boot (AOT warmup, zero XLA builds).  Emits one JSON line:
+    ``first_query_ms`` is the served latency of the first warm-class
+    query on the warmed boot; ``first_query_ms_off`` the same query's
+    latency when the process must compile."""
+    import subprocess
+
+    d = os.path.join(DATA_DIR, "coldstart")
+    os.makedirs(d, exist_ok=True)
+    hosts, steps = 64, 360  # ~23k rows: compile cost dominates, not data
+
+    def run(env_extra):
+        env = dict(os.environ, **env_extra)
+        if _backend == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(
+            [sys.executable, "-c", _COLDSTART_CHILD, d, str(hosts),
+             str(steps)],
+            capture_output=True, timeout=600, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        out = r.stdout.decode().strip()
+        if r.returncode != 0 or not out:
+            tail = r.stderr.decode(errors="replace").strip()[-400:]
+            raise RuntimeError(
+                f"cold-start child rc={r.returncode}: {tail}")
+        return json.loads(out.splitlines()[-1])
+
+    try:
+        seed = run({"GREPTIME_COMPILE_CACHE": "on"})
+        off = run({"GREPTIME_COMPILE_CACHE": "off"})
+        on = run({"GREPTIME_COMPILE_CACHE": "on"})
+    except Exception as e:  # noqa: BLE001 — headline already emitted
+        log(f"cold-start bench skipped: {e!r}")
+        return
+    print(json.dumps({
+        "metric": "first_query_ms",
+        "value": on["first_query_ms"],
+        "unit": "ms",
+        "first_query_ms_off": off["first_query_ms"],
+        "speedup": round(
+            off["first_query_ms"] / max(on["first_query_ms"], 1e-9), 2),
+        "open_ms_on": on["open_ms"],
+        "open_ms_off": off["open_ms"],
+        "warm_ms": on["warm_ms"],
+        "xla_builds_on": on["xla_builds"],
+        "xla_builds_off": off["xla_builds"],
+        "aot_hits_on": on["aot_hits"],
+        "seed_first_query_ms": seed["first_query_ms"],
+        "backend": _backend,
+    }), flush=True)
+
+
 def emit_tpu_projection() -> None:
     """When the TPU relay is down (observed: PJRT init hang, every probe
     across rounds 4-5), record the HLO cost-model projection of the
@@ -722,6 +837,12 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — headline already emitted
             log(f"cold-scan bench skipped: {e!r}")
     db.close()
+    # cold-start A/B (round 18): first-warm-class-query latency with the
+    # persistent compile cache on vs off, fresh subprocesses
+    if (not os.environ.get("GREPTIME_BENCH_NO_COLDSTART")
+            and deadline - time.time() > 90):
+        _phase = "cold-start bench"
+        cold_start_bench()
 
     # PromQL north star (BASELINE.md target #2): piggyback on leftover
     # budget so the driver's single bench.py invocation records it too;

@@ -58,10 +58,27 @@ REGISTER_METHODS = {"counter": "counter", "gauge": "gauge",
 # ---------------------------------------------------------------------------
 
 KNOB_DOCS: dict[str, str] = {
+    "GREPTIME_AOT_WARMUP": (
+        "`off` disables AOT warmup; `auto` (default) replays the usage "
+        "journal's top-K shape classes at open + drains the rest on "
+        "scheduler-idle ticks whenever the compile cache is armed."),
+    "GREPTIME_AOT_WARMUP_TOP_K": (
+        "How many journaled shape classes replay synchronously at "
+        "region-open (the rest warm on idle ticks)."),
     "GREPTIME_CHAOS": (
         "Seeded fault-injection spec (`seed=N;point=prob:action[:...]`) "
         "consulted at every remote/disk boundary; unset = disabled "
         "(zero overhead)."),
+    "GREPTIME_COMPILE_CACHE": (
+        "Persistent compile cache: `auto` arms the AOT artifact store + "
+        "usage journal for persistent data homes; `on` forces it (also "
+        "wiring jax's own compilation-cache hook); `off` disables."),
+    "GREPTIME_COMPILE_CACHE_DIR": (
+        "Override location of the AOT artifact store + usage journal "
+        "(default `<data_home>/compile_cache`)."),
+    "GREPTIME_COMPILE_CACHE_QUOTA_BYTES": (
+        "Disk quota for serialized AOT artifacts (`compile_cache` "
+        "workload, kind=disk; oldest artifacts evict first)."),
     "GREPTIME_LOCK_WITNESS": (
         "`on` installs the runtime lock-order witness (records real "
         "acquisition chains, fails on ABBA inversions) for the "
@@ -119,6 +136,10 @@ KNOB_DOCS: dict[str, str] = {
     "GREPTIME_MESH_MIN_ROWS": (
         "Minimum region rows before mesh-sharded dispatch is worth the "
         "collective overhead."),
+    "GREPTIME_PLAN_FUSION": (
+        "`off` restores the multi-kernel PromQL chain (window kernel + "
+        "eager epilogue + eager group reduce) byte-for-byte instead of "
+        "the whole-plan fused single-dispatch programs."),
     "GREPTIME_PREFETCH_THREADS": (
         "S3 scan-readahead fetcher thread count (the read path joins "
         "in-flight prefetches)."),

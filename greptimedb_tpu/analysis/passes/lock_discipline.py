@@ -90,6 +90,19 @@ GUARDED: dict[str, dict[str, dict[str, tuple[str, str]]]] = {
             "_bytes": ("_lock", "mutate"),
         },
     },
+    "compile/service.py": {
+        "PlanCompiler": {
+            "mem_builds": ("_lock", "mutate"),
+            "aot_hits": ("_lock", "mutate"),
+            "persists": ("_lock", "mutate"),
+        },
+    },
+    "compile/journal.py": {
+        "UsageJournal": {
+            "_entries": ("_lock", "mutate"),
+            "_dirty": ("_lock", "rw"),
+        },
+    },
     "fulltext/resident.py": {
         "FulltextIndexCache": {
             "_lru": ("_struct_lock", "mutate"),

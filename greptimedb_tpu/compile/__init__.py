@@ -1,0 +1,60 @@
+"""Query-compiler subsystem: whole-plan fused XLA programs, a persistent
+compilation cache, and AOT warmup.
+
+The three legs of ROADMAP open item 5 (the compile-latency attack):
+
+- **Whole-plan fusion** (``fused.py``): every physical plan is classified
+  into a *shape class* — a canonicalized fingerprint over operator tree,
+  window geometry, dtypes and resident-layout kind (``shape.py``) — and
+  each class executes as ONE jitted XLA program.  The SQL grid paths
+  (bucket-major aligned, dynamic-slice) have been single fused programs
+  since PR 1/PR 3; this subsystem takes ownership of their
+  classification and adds the missing chain: the PromQL
+  selection→window→group pipeline, whose window kernel, rate
+  extrapolation and cross-series aggregation previously ran as one jit
+  plus a tail of eager dispatches with host glue, now lowers to a single
+  program (Data Path Fusion, arXiv 2605.10511: eliminating intermediate
+  materialization between query stages is the next multiplier after
+  caching).  ``GREPTIME_PLAN_FUSION=off`` restores the multi-kernel path
+  byte-for-byte.
+
+- **Persistent compilation cache** (``store.py`` + ``service.py``): AOT
+  artifacts — ``jax.jit(...).lower(...).compile()`` executables
+  serialized via ``jax.experimental.serialize_executable`` — persist on
+  disk in a CRC-enveloped store (the PR-9 GTM1 discipline) keyed by
+  (shape-class fingerprint, jaxlib version, backend, device topology,
+  machine), so a restarted node recompiles nothing it has seen before.
+  ``GREPTIME_COMPILE_CACHE=on`` additionally wires jax's own
+  ``jax_compilation_cache_dir`` hook so non-routed jits persist too.
+
+- **AOT warmup** (``warmup.py`` + ``journal.py``): a per-instance usage
+  journal records each shape class with enough replay context (the
+  plancodec-encoded plan / TQL parameters) to rebuild its kernels in a
+  fresh process.  Region-open warmup precompiles the top-K classes, and
+  a scheduler-idle hook drains the rest, so a restarted node serves fast
+  warm-class queries immediately (TCR, arXiv 2203.01877: plans lower
+  cleanly to reusable accelerator programs).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["fusion_enabled", "PlanCompiler"]
+
+
+def fusion_enabled() -> bool:
+    """GREPTIME_PLAN_FUSION gate for the fused PromQL chain.  ``off``
+    restores the multi-kernel (window kernel + eager epilogue + eager
+    group reduce) path byte-for-byte — the A/B twin every fusion parity
+    test compares against."""
+    return os.environ.get("GREPTIME_PLAN_FUSION", "on").lower() not in (
+        "off", "0", "false")
+
+
+def __getattr__(name):  # lazy: keep `import greptimedb_tpu.compile` light
+    if name == "PlanCompiler":
+        from greptimedb_tpu.compile.service import PlanCompiler
+
+        return PlanCompiler
+    raise AttributeError(name)

@@ -1,0 +1,171 @@
+"""Shape-class usage journal: what a fresh process should precompile.
+
+One CRC-enveloped JSON file per instance (``<store root>/usage.json``)
+mapping class_id → {count, engine, last_ms, replay}.  ``replay`` is
+enough context to re-derive the class's kernels in a fresh process with
+the same data: for SQL a plancodec-encoded Select (the structural wire
+form — no re-parse, no drift) plus the session database; for TQL the
+query text and its (start, end, step, lookback) window.  Classes whose
+statement could not be captured (nested/staged executions, non-codec
+nodes) journal with ``replay: null`` — they still count toward ranking
+but cannot be warmed.
+
+Counts accumulate across boots; ``top(k)`` is the warmup ranking.  A
+corrupt journal (CRC fail, bad JSON) is quarantined and the instance
+starts an empty one — losing warmup history is a performance event, not
+a correctness one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from greptimedb_tpu.compile.store import (
+    atomic_write, decode_envelope, encode_envelope,
+)
+
+_MAGIC = b"GTJ1 "
+_SAVE_EVERY = 8  # dirty notes between persists (plus one at close)
+# journal size bound: WHERE-literal-bearing fingerprints mint a class per
+# distinct ad-hoc filter value, so a long-lived server would otherwise
+# grow usage.json monotonically.  At save time only the top N classes by
+# (count, recency) survive — one-off singletons age out naturally.
+_MAX_CLASSES = 512
+
+
+class UsageJournal:
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict] = {}
+        self._dirty = 0
+        self.corrupt = False
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return
+        body = decode_envelope(data, _MAGIC)
+        doc = None
+        if body is not None:
+            try:
+                doc = json.loads(body)
+            except ValueError:
+                doc = None
+        if doc is None or doc.get("v") != 1:
+            self.corrupt = True
+            try:  # preserve the damaged history for inspection
+                os.replace(self.path, self.path + ".quarantine")
+            except OSError:
+                pass
+            return
+        with self._lock:  # init-only, but keep the guard uniform
+            self._entries = doc.get("classes", {})
+
+    # ------------------------------------------------------------------
+    def note(self, cid: str, engine: str, canon: str | None,
+             replay_fn=None) -> None:
+        """Record one in-process first-use of a shape class.  Counts are
+        per-boot first-compiles, so across restarts they rank classes by
+        how many sessions needed them — exactly the set worth warming.
+        ``replay_fn`` is invoked (once, lazily) only when the entry has
+        no replay yet."""
+        with self._lock:
+            e = self._entries.get(cid)
+            if e is None:
+                e = self._entries[cid] = {
+                    "count": 0, "engine": engine, "replay": None,
+                    "canon": canon,
+                }
+            e["count"] = int(e.get("count", 0)) + 1
+            e["last_ms"] = int(time.time() * 1000)
+            need_replay = e.get("replay") is None and replay_fn is not None
+        if need_replay:
+            try:
+                replay = replay_fn()
+            except Exception:  # noqa: BLE001 — capture is best-effort
+                replay = None
+            if replay is not None:
+                with self._lock:
+                    ent = self._entries.get(cid)
+                    if ent is not None and ent.get("replay") is None:
+                        ent["replay"] = replay
+        with self._lock:
+            self._dirty += 1
+            dirty = self._dirty
+        if dirty >= _SAVE_EVERY:
+            self.save()
+
+    def top(self, k: int | None = None) -> list[tuple[str, dict]]:
+        """Warmable classes ranked by use count (then recency)."""
+        with self._lock:
+            items = [(cid, dict(e)) for cid, e in self._entries.items()
+                     if e.get("replay") is not None and not e.get("dead")]
+        items.sort(key=lambda kv: (-kv[1].get("count", 0),
+                                   -kv[1].get("last_ms", 0)))
+        return items if k is None else items[:k]
+
+    def drop_replay(self, replay: dict) -> None:
+        """Mark every class journaled under ``replay`` dead (its table is
+        gone): warmup stops burning boot budget replaying it.  Tombstoned
+        rather than deleted so the merge-on-save below cannot resurrect
+        it from another instance's snapshot."""
+        with self._lock:
+            for e in self._entries.values():
+                if e.get("replay") == replay:
+                    e["dead"] = True
+                    e["replay"] = None
+            self._dirty += 1
+        self.save()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def save(self) -> None:
+        with self._lock:
+            merged = {cid: dict(e) for cid, e in self._entries.items()}
+            self._dirty = 0
+        # merge with the CURRENT on-disk journal before writing:
+        # instances sharing one cache dir must not erase each other's
+        # history — last-writer-wins per CLASS, never per file.  Dead
+        # tombstones win over live entries on either side.
+        try:
+            with open(self.path, "rb") as f:
+                body = decode_envelope(f.read(), _MAGIC)
+            disk = (json.loads(body).get("classes", {})
+                    if body is not None else {})
+        except (OSError, ValueError):
+            disk = {}
+        for cid, d in disk.items():
+            m = merged.get(cid)
+            if m is None:
+                merged[cid] = d
+                continue
+            m["count"] = max(int(m.get("count", 0)),
+                             int(d.get("count", 0)))
+            m["last_ms"] = max(int(m.get("last_ms", 0)),
+                               int(d.get("last_ms", 0)))
+            if d.get("dead") or m.get("dead"):
+                m["dead"] = True
+                m["replay"] = None
+            elif m.get("replay") is None:
+                m["replay"] = d.get("replay")
+        if len(merged) > _MAX_CLASSES:
+            ranked = sorted(
+                merged.items(),
+                key=lambda kv: (-int(kv[1].get("count", 0)),
+                                -int(kv[1].get("last_ms", 0))))
+            merged = dict(ranked[:_MAX_CLASSES])
+        body = json.dumps({"v": 1, "classes": merged},
+                          separators=(",", ":"), default=str).encode()
+        try:
+            atomic_write(self.path, encode_envelope(body, _MAGIC))
+        except OSError:
+            pass  # journal persistence is best-effort

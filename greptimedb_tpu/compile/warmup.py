@@ -1,0 +1,144 @@
+"""AOT warmup: precompile a fresh process's hot shape classes.
+
+Two hooks, both replaying journaled statements through the normal query
+path (results discarded):
+
+- **region open** (``warm_on_open``, called at the end of standalone
+  init once every local region is open): the top-K classes by use count
+  replay immediately, so the first real query of a warm class finds its
+  kernels — and the resident grids/layouts the replay built — already
+  in place.  With a populated AOT store the replay itself deserializes
+  executables instead of compiling: zero XLA builds on a second boot.
+- **scheduler idle** (``idle_tick``, wired as serving/scheduler.py's
+  ``idle_hook``): the remaining journaled classes drain one statement
+  per idle tick.  Ticks only fire while the queue is empty, so warmup
+  yields between statements; a live query arriving MID-replay waits on
+  the db lock for that one statement like any other writer (bounded by
+  a single compile), and the server's close() unhooks the drain before
+  stopping the scheduler.
+
+Warmup is strictly best-effort: a dropped table, a stale plan, a failed
+compile each count a ``warmup{outcome=error}`` and move on.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+
+from greptimedb_tpu.compile.service import M_WARMUP
+from greptimedb_tpu.errors import TableNotFound
+
+
+class WarmupService:
+    def __init__(self, db, compiler, top_k: int = 8,
+                 open_budget_s: float = 30.0):
+        self.db = db
+        self.compiler = compiler
+        self.top_k = top_k
+        self.open_budget_s = open_budget_s
+        self._pending: collections.deque = collections.deque()
+        self._done: set[str] = set()
+        self.warmed = 0
+        self.errors = 0
+        journal = compiler.journal
+        if journal is not None:
+            # bounded queue: idle drain works through a multiple of the
+            # open-time top-K, not every class the journal ever saw
+            self._pending.extend(journal.top(max(top_k * 8, 64)))
+
+    # ------------------------------------------------------------------
+    def pending(self) -> bool:
+        return bool(self._pending)
+
+    def warm_on_open(self) -> int:
+        """Replay the top-K classes now (budget-capped); the rest stay
+        queued for idle ticks.  Returns the number warmed."""
+        deadline = time.monotonic() + self.open_budget_s
+        warmed = 0
+        for _ in range(min(self.top_k, len(self._pending))):
+            if time.monotonic() > deadline:
+                break
+            if self.idle_tick():
+                warmed += 1
+        return warmed
+
+    def idle_tick(self) -> bool:
+        """Warm ONE pending class; False when the queue is drained (the
+        scheduler then unhooks).  Statement-level dedup: many kernel
+        classes journal the same replay statement, which warms them all
+        in one execution."""
+        while self._pending:
+            cid, entry = self._pending.popleft()
+            replay = entry.get("replay")
+            rkey = repr(sorted((replay or {}).items()))
+            if replay is None or rkey in self._done:
+                continue
+            self._done.add(rkey)
+            try:
+                # suppressed journal counting: the replay's own kernel
+                # builds must not re-increment the classes it warms
+                with self.compiler.warming():
+                    self._replay(replay)
+                self.warmed += 1
+                M_WARMUP.labels("ok").inc()
+            except TableNotFound:
+                # the statement's table is gone: tombstone its classes so
+                # no future boot burns open-budget on it again
+                if self.compiler.journal is not None:
+                    self.compiler.journal.drop_replay(replay)
+                self.errors += 1
+                M_WARMUP.labels("error").inc()
+            except Exception:  # noqa: BLE001 — warmup must never fail boot
+                self.errors += 1
+                M_WARMUP.labels("error").inc()
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    def _replay(self, replay: dict) -> None:
+        try:
+            self._replay_inner(replay)
+        finally:
+            # statement boundary: classes a later non-statement build on
+            # this thread creates must not journal THIS replay
+            self.compiler.clear_replay()
+
+    def _replay_inner(self, replay: dict) -> None:
+        db = self.db
+        kind = replay.get("kind")
+        if kind == "sql_plan":
+            from greptimedb_tpu.query.plancodec import plan_from_json
+
+            sel = plan_from_json(replay["plan"])
+            dbname = replay.get("db")
+            with db._lock:
+                prev = db.current_db
+                if dbname:
+                    db.current_db = dbname
+                try:
+                    db.engine.execute_select(sel)
+                finally:
+                    db.current_db = prev
+        elif kind == "tql":
+            from greptimedb_tpu.query.ast import Tql
+
+            stmt = Tql(
+                command="EVAL",
+                start=float(replay["start"]),
+                end=float(replay["end"]),
+                step=float(replay["step"]),
+                query=str(replay["query"]),
+                lookback=replay.get("lookback"),
+            )
+            dbname = replay.get("db")
+            with db._lock:
+                prev = db.current_db
+                if dbname:
+                    db.current_db = dbname
+                try:
+                    db._execute_tql(stmt)
+                finally:
+                    db.current_db = prev
+        else:
+            raise ValueError(f"unknown replay kind {kind!r}")

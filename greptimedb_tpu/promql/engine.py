@@ -377,11 +377,20 @@ def _window_kernel(p: WindowParams):  # gl: warm-path
             start_ms scalar i64.
     Output dict of [S, T] arrays depending on p.kind.
     """
+    return jax.jit(_window_body(p))
+
+
+def _window_body(p: WindowParams):  # gl: warm-path
+    """The UNJITTED window-stats program for one shape class — the exact
+    function ``_window_kernel`` jits.  Exposed separately so the
+    whole-plan fused programs (compile/fused.py) can compose it with the
+    function epilogue and group reduction inside ONE jit: a single
+    program source means fused and unfused window math can never
+    diverge."""
 
     T = p.num_steps
     S = p.num_sel
 
-    @jax.jit
     def kernel(key_s, ts_s, val_s, tsid_s, valid_s, ts_min, kp, *rest):
         if p.bounds_l is not None:
             series_start, cnt_s, ts_mat, sel_tsids, start_ms = rest
@@ -830,6 +839,12 @@ class PromEvaluator:
         self.lookback_ms = int(lookback_s * 1000)
         self._data: dict[str, SelectorData] = {}
         self._kernels: dict[tuple, object] = {}
+        # NOTE: replay-context hygiene is a statement-boundary concern,
+        # handled where statements end (_sql_locked's finally, the batch
+        # entry, warmup replays) — an evaluator must NOT clear it here:
+        # nested evaluators (subquery operands) are constructed MID-
+        # statement and would strip the outer TQL's replay, leaving its
+        # kernel classes permanently unwarmable.
         # resident-cache event counter for this evaluation (selection /
         # sort / group × hit / miss / reject) — surfaced to bench_promql
         self.cache_events: collections.Counter = collections.Counter()
@@ -844,6 +859,17 @@ class PromEvaluator:
         M_PROMQL_STAGE.labels(name).observe(dt)
         self.stage_ms[name] = round(
             self.stage_ms.get(name, 0.0) + dt * 1000, 3)
+
+    def _compiler(self):
+        """The db's PlanCompiler (persistent AOT store + usage journal),
+        or the process default (memory-only classification) for embedded
+        evaluators without one."""
+        comp = getattr(self.db, "plan_compiler", None)
+        if comp is None:
+            from greptimedb_tpu.compile.service import default_compiler
+
+            comp = default_compiler()
+        return comp
 
     # ---- plumbing -------------------------------------------------------
     def data_for(self, metric: str) -> SelectorData:
@@ -940,8 +966,13 @@ class PromEvaluator:
         kern = _KERNEL_CACHE.get(p)
         jit_miss = kern is None
         if kern is None:
-            kern = _window_kernel(p)
+            kern = self._compiler().get_or_build(
+                "promql", p, lambda: _window_kernel(p), persist=True)
             _KERNEL_CACHE[p] = kern
+        # an AOT-store hit deserializes the executable — no XLA compile
+        # happened, so the first call must not be attributed as one
+        # (the promql twin of physical.aot_kernel_call's discipline)
+        compiling = jit_miss and not getattr(kern, "aot", False)
         t0 = time.perf_counter()
         with TRACER.stage("window_kernel", kind=kind):
             out = kern(*args)
@@ -952,7 +983,7 @@ class PromEvaluator:
                 # call (compile) is worth attributing always; steady-state
                 # evals keep the async dispatch pipeline
                 out = jax.block_until_ready(out)
-        self._stage_mark("xla_compile" if jit_miss else "window_kernel", t0)
+        self._stage_mark("xla_compile" if compiling else "window_kernel", t0)
         out = {k: v[: len(tsids)] for k, v in out.items()}
         if pinned:
             out = {
@@ -990,8 +1021,11 @@ class PromEvaluator:
         kern = _KERNEL_CACHE.get(mk)
         jit_miss = kern is None
         if kern is None:
-            kern = _matrix_kernel(p, lmax, kind)
+            kern = self._compiler().get_or_build(
+                "promql", mk, lambda: _matrix_kernel(p, lmax, kind),
+                persist=True)
             _KERNEL_CACHE[mk] = kern
+        compiling = jit_miss and not getattr(kern, "aot", False)
         ones = jnp.ones(num_steps, jnp.float32)
         a1 = (jnp.broadcast_to(jnp.asarray(extras[0], jnp.float32),
                                (self.num_steps,))[:num_steps]
@@ -1002,7 +1036,8 @@ class PromEvaluator:
         t0 = time.perf_counter()
         with TRACER.stage("window_kernel", kind=kind):
             vals = kern(*args, a1, a2)[: len(tsids)]
-        self._stage_mark("xla_compile" if jit_miss else "window_kernel", t0)
+        self._stage_mark("xla_compile" if compiling else "window_kernel",
+                         t0)
         if pinned:
             vals = jnp.broadcast_to(vals, (vals.shape[0], self.num_steps))
         return vals, labels
@@ -1449,6 +1484,12 @@ class PromEvaluator:
         group-contiguous row permutation used by the segment-sorted
         quantile/topk kernels.
         """
+        return self._group_series_of(e, r.labels, r.num_series)
+
+    def _group_series_of(self, e: Aggregation, labels, n: int):
+        """_group_series over bare (labels, n) — the fused chain
+        (compile/fused.py) groups straight off the selection, before any
+        EvalResult exists.  Same providers, same caches, one definition."""
 
         def group_key(lab: dict) -> tuple:
             if e.without:
@@ -1459,8 +1500,6 @@ class PromEvaluator:
                 keys = []
             return tuple((k, str(lab.get(k, ""))) for k in keys)
 
-        labels = r.labels
-        n = r.num_series
         gspec = ("without" if e.without else "by",
                  tuple(sorted(e.grouping or ())))
         if isinstance(labels, LazySeriesLabels) and n == len(labels.tsids):
@@ -1504,6 +1543,19 @@ class PromEvaluator:
                 seg_start)
 
     def eval_aggregation(self, e: Aggregation) -> EvalResult:
+        from greptimedb_tpu.compile import fusion_enabled
+
+        if fusion_enabled():
+            # whole-plan fusion: selection→window→group as ONE device
+            # dispatch when the chain matches the fused surface
+            # (compile/fused.py); None falls through to the multi-kernel
+            # path below, which GREPTIME_PLAN_FUSION=off also restores
+            # byte-for-byte
+            from greptimedb_tpu.compile.fused import try_fused_aggregation
+
+            fused = try_fused_aggregation(self, e)
+            if fused is not None:
+                return fused
         r = self.eval(e.expr)
         if r.num_series == 0:
             return r
@@ -1828,6 +1880,15 @@ def execute_tql(db, stmt):
         db, stmt.start, stmt.end, stmt.step,
         stmt.lookback or DEFAULT_LOOKBACK_S,
     )
+    comp = getattr(db, "plan_compiler", None)
+    if comp is not None:
+        # shape-class usage journal replay context (compile/journal.py):
+        # captured lazily, only when this statement builds a NEW kernel
+        # class — a fresh process replays the same TQL window to warm it
+        comp.set_replay(lambda: {
+            "kind": "tql", "query": stmt.query, "start": stmt.start,
+            "end": stmt.end, "step": stmt.step, "lookback": stmt.lookback,
+            "db": getattr(db, "current_db", None)})
     if stmt.command in ("EXPLAIN",):
         return QueryResult(["plan"], [[f"PromQL: {expr}"]])
     res = ev.eval(expr)

@@ -51,6 +51,21 @@ def _attach_scan_stats(metrics, seq0: int) -> None:
             metrics[f"scan_{key}"] = s[key]
 
 
+def _encode_replay(sel: Select, dbname: str) -> dict | None:
+    """Usage-journal replay payload for one Select, or None when the
+    plan contains nodes outside the codec registry (decorrelated tuple
+    membership etc.) — such classes still count, they just can't warm.
+    The plan ships in canonical (sorted-key) form so replay equality is
+    byte-stable across processes sharing one journal."""
+    from greptimedb_tpu.query.plancodec import plan_canon
+
+    try:
+        return {"kind": "sql_plan", "plan": plan_canon(sel),
+                "db": dbname}
+    except Exception:  # noqa: BLE001 — capture is best-effort
+        return None
+
+
 @dataclass
 class QueryResult:
     column_names: list[str]
@@ -307,6 +322,22 @@ class QueryEngine:
 
             return execute_join(self, sel)
 
+        # shape-class replay capture (compile/journal.py): lazily encode
+        # this statement (plancodec wire form + session db) so a fresh
+        # process can replay it to warm any kernel class it builds.
+        # Statements executing outside the db provider (staged join
+        # scans, shipped sub-plans) clear the context — their ephemeral
+        # tables don't resolve in a replay.
+        comp = getattr(self.executor, "compiler", None)
+        if comp is not None:
+            dbname = getattr(self.provider, "current_db", None)
+            if dbname is None:
+                comp.clear_replay()
+            else:
+                comp.set_replay(
+                    lambda sel=sel, dbname=dbname: _encode_replay(
+                        sel, dbname))
+
         def mark(name, t0):
             if metrics is not None:
                 metrics[name] = round((_time.perf_counter() - t0) * 1000, 3)
@@ -418,6 +449,13 @@ class QueryEngine:
 
         if len(sels) < 2 or _os.environ.get("GREPTIME_GRID", "auto") == "off":
             return None
+        # the worker thread may still carry the replay context of its
+        # LAST solo statement — batch-built kernel classes (the vmapped
+        # stack) must journal replay-less, not attach an unrelated
+        # statement a warmup boot would then replay for nothing
+        comp = getattr(self.executor, "compiler", None)
+        if comp is not None:
+            comp.clear_replay()
         grid_fn = getattr(self.provider, "grid_table", None)
         if grid_fn is None:
             return None

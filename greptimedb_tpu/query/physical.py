@@ -54,9 +54,12 @@ M_DEVICE_PHASE = REGISTRY.histogram(
 # diagnostics: counts every aggregate dispatch (including kernel-cache
 # hits) by which segment strategy it used; tests assert coverage.
 # "grid_bm" counts grid dispatches served from the resident bucket-major
-# derived layout (a subset of "grid").
+# derived layout (a subset of "grid").  "dispatches" counts every
+# timed_kernel_call — the per-query twin is metrics["device_dispatches"],
+# which EXPLAIN ANALYZE surfaces so the whole-plan-fusion contract (ONE
+# device dispatch per warm query class) is pinned, not assumed.
 DISPATCH_STATS = {"sorted": 0, "scatter": 0, "grid": 0, "grid_bm": 0,
-                  "grid_batch": 0}
+                  "grid_batch": 0, "dispatches": 0}
 
 
 @_dataclasses.dataclass
@@ -108,6 +111,9 @@ def timed_kernel_call(call, miss: bool, metrics: dict | None,
     """
     import time as _time
 
+    DISPATCH_STATS["dispatches"] += 1
+    if metrics is not None:
+        metrics["device_dispatches"] = metrics.get("device_dispatches", 0) + 1
     t0 = _time.perf_counter()
     if miss:
         with TRACER.stage("xla_compile"):
@@ -130,6 +136,18 @@ def timed_kernel_call(call, miss: bool, metrics: dict | None,
         if metrics is not None:
             metrics["device_wait_ms"] = round(
                 metrics.get("device_wait_ms", 0.0) + dt * 1000, 3)
+    return out
+
+
+def aot_kernel_call(kernel, call, miss: bool, metrics: dict | None,
+                    engine: str = "sql"):
+    """timed_kernel_call for compiler-routed kernels: an AOT-store hit
+    (compile/service.py) skips XLA compilation entirely, so its first
+    invocation must not be timed — or reported — as a compile."""
+    aot = miss and getattr(kernel, "aot", False)
+    out = timed_kernel_call(call, miss and not aot, metrics, engine)
+    if aot and metrics is not None:
+        metrics["jit_cache"] = "aot"
     return out
 
 
@@ -275,6 +293,14 @@ class Executor:
         from greptimedb_tpu.fulltext.resident import FulltextIndexCache
 
         self.fulltext_cache = FulltextIndexCache()
+        # query-compiler subsystem (compile/): every kernel-cache miss
+        # below routes through it — shape-class classification + usage
+        # journal always; persistent AOT load/persist once the server
+        # configures a store (standalone.py).  Unconfigured it is
+        # memory-only and adds one dict/hash per BUILD (never per query).
+        from greptimedb_tpu.compile.service import PlanCompiler
+
+        self.compiler = PlanCompiler()
 
     def _fulltext_provider(self, plan, table):
         """ctx.fulltext for one execution, or None (knob off / table
@@ -447,17 +473,27 @@ class Executor:
         kernel = self._cache.get(cache_key)
         jit_miss = kernel is None
         if kernel is None:
-            kernel = self._build_agg_kernel(
-                key_specs, dense_ok, num_groups, cards, where_fn, agg_specs,
-                ts_name, use_sorted, batched,
-            )
+            # never AOT-persisted: the DeviceTable pytree's aux bakes the
+            # dictionary contents AND dicts_version (bumped on every
+            # rebuild) into the executable's arg signature, so a
+            # serialized executable could never be re-entered where jit
+            # correctly RETRACES — these classes are classified/journaled
+            # but served by plain jit
+            kernel = self.compiler.get_or_build(
+                "sql", cache_key,
+                lambda: self._build_agg_kernel(
+                    key_specs, dense_ok, num_groups, cards, where_fn,
+                    agg_specs, ts_name, use_sorted, batched,
+                ),
+                persist=False, metrics=metrics)
             self._cache[cache_key] = kernel
         ts_lo = np.int64(lo) if lo is not None else _I64_MIN
         ts_hi = np.int64(hi) if hi is not None else _I64_MAX
         starts = tuple(np.int64(spec[1][1])
                        for spec in key_specs if spec[0] == "time")
-        out = timed_kernel_call(
-            lambda: kernel(table, ts_lo, ts_hi, starts), jit_miss, metrics)
+        out = aot_kernel_call(
+            kernel, lambda: kernel(table, ts_lo, ts_hi, starts), jit_miss,
+            metrics)
         # gl: allow[GL-H001] -- THE one host materialization per dispatch; everything below operates on these numpy arrays
         out = {k: np.asarray(v) for k, v in out.items()}
 
@@ -739,15 +775,18 @@ class Executor:
             kernel = self._cache.get(bm_key)
             jit_miss = kernel is None
             if kernel is None:
-                kernel = self._build_bm_kernel(
-                    tag_order, [k.column for k in tag_keys], cards_tag,
-                    nbw, step_q,
-                    where_fn if where_series else None,
-                    [(name, op, ci) for name, op, _fn, _nn, ci in specs],
-                )
+                kernel = self.compiler.get_or_build(
+                    "sql", bm_key,
+                    lambda: self._build_bm_kernel(
+                        tag_order, [k.column for k in tag_keys], cards_tag,
+                        nbw, step_q,
+                        where_fn if where_series else None,
+                        [(name, op, ci) for name, op, _fn, _nn, ci in specs],
+                    ),
+                    metrics=metrics)
                 self._cache[bm_key] = kernel
-            out = timed_kernel_call(
-                lambda: kernel(
+            out = aot_kernel_call(
+                kernel, lambda: kernel(
                     layout[0], layout[1],
                     tuple(grid.tag_codes[t] for t in tag_order),
                     np.int32(b_lo), np.int64(int(bts0) + b_lo * step_q),
@@ -762,18 +801,21 @@ class Executor:
             kernel = self._cache.get(cache_key)
             jit_miss = kernel is None
             if kernel is None:
-                kernel = self._build_grid_kernel(
-                    grid.field_names, ts_name, tag_order,
-                    [k.column for k in tag_keys], cards_tag,
-                    g.has_time, r, nbw, w_raw, pad_l, pad_r, step_q,
-                    where_fn, where_series, specs, grid.ts0, g_step,
-                    aligned,
-                )
+                kernel = self.compiler.get_or_build(
+                    "sql", cache_key,
+                    lambda: self._build_grid_kernel(
+                        grid.field_names, ts_name, tag_order,
+                        [k.column for k in tag_keys], cards_tag,
+                        g.has_time, r, nbw, w_raw, pad_l, pad_r, step_q,
+                        where_fn, where_series, specs, grid.ts0, g_step,
+                        aligned,
+                    ),
+                    metrics=metrics)
                 self._cache[cache_key] = kernel
             ts_lo = np.int64(lo) if lo is not None else _I64_MIN
             ts_hi = np.int64(hi) if hi is not None else _I64_MAX
-            out = timed_kernel_call(
-                lambda: kernel(
+            out = aot_kernel_call(
+                kernel, lambda: kernel(
                     grid.values, grid.valid,
                     tuple(grid.tag_codes[t] for t in tag_order),
                     ts_lo, ts_hi, np.int64(int(bts0) + b_lo * step_q),
@@ -819,9 +861,12 @@ class Executor:
         class) into one device dispatch: the bucket-major kernel vmapped
         over its per-window traced arguments (b_lo, bts0).  Eligibility
         is deliberately the tightest warm shape — bucket-aligned windows
-        with no residual WHERE, identical plan fingerprint and window
-        geometry, resident bucket-major layout available — everything
-        else returns None and the scheduler falls back to solo execution.
+        whose WHERE is absent (members fingerprint-identical) or
+        tag-only (members identical up to the tag predicate, each
+        member's filter entering as a traced per-series mask), identical
+        window geometry, resident bucket-major layout available —
+        everything else returns None and the scheduler falls back to
+        solo execution.
         Data Path Fusion's observation (arXiv 2605.10511): once per-query
         kernels are cached, stacking shape-compatible work into one
         dispatch is the remaining multiplier.
@@ -843,9 +888,21 @@ class Executor:
         g0 = geoms[0]
         fp0 = plans[0].fingerprint()
 
+        def plan_sig(p: SelectPlan):
+            # where-independent plan identity: table, group keys and agg
+            # output names — everything the vmapped kernel's output
+            # contract and the host result shaping depend on.  The WHERE
+            # itself may differ per member in tag-filtered mode.
+            return (
+                p.table,
+                tuple((k.kind, str(k.expr), k.name) for k in p.group_keys),
+                tuple(map(str, p.aggs)),
+            )
+
         def sig(g: _GridGeom):
             return (
-                g.aligned, g.has_time, g.where_fn is None, g.r, g.pad_left,
+                g.aligned, g.has_time, g.where_fn is None, g.where_series,
+                g.r, g.pad_left,
                 g.nb, g.nbw, g.step_q, tuple(g.cards_tag), g.tag_order,
                 g.dict_ver,
                 tuple((name, op, ci, nn)
@@ -853,11 +910,26 @@ class Executor:
             )
 
         sig0 = sig(g0)
-        if not (g0.aligned and g0.has_time and g0.where_fn is None):
+        if not (g0.aligned and g0.has_time):
             return None
+        # two batchable WHERE modes: absent (the original PR-7 surface:
+        # members fingerprint-identical) and tag-only (the where_series
+        # extension: members agree on everything EXCEPT the tag
+        # predicate, which rides in as a per-member traced [S] mask —
+        # filtered dashboard panels over different hosts coalesce too)
+        if g0.where_fn is None:
+            filtered = False
+        elif g0.where_series:
+            filtered = True
+        else:
+            return None
+        psig0 = plan_sig(plans[0])
         # gl: allow[GL-H002] -- O(batch members) compatibility probe, bounded by max_batch
         for p, g in zip(plans[1:], geoms[1:]):
-            if p.fingerprint() != fp0 or sig(g) != sig0:
+            if sig(g) != sig0:
+                return None
+            if (plan_sig(p) != psig0) if filtered else (
+                    p.fingerprint() != fp0):
                 return None
         layout = self._aligned_layout(
             grid, g0.r, g0.pad_left, g0.nb, g0.specs, True, True,
@@ -865,6 +937,16 @@ class Executor:
         )
         if layout is None:
             return None
+        tag_arrays = tuple(grid.tag_codes[t] for t in g0.tag_order)
+        smfs = None
+        if filtered:
+            # per-member [S] series masks from each member's OWN where_fn
+            # (tiny cached kernels, one [S]-sized dispatch per distinct
+            # filter); the expensive window reduce stays ONE stacked
+            # dispatch over the traced mask stack
+            smfs = jnp.stack([
+                self._series_mask(p, g, grid, tag_arrays)
+                for p, g in zip(plans, geoms)])
 
         n = len(plans)
         # pow2-pad the stack (duplicating the leader's window) so the
@@ -876,30 +958,42 @@ class Executor:
         bts0s = np.array(  # gl: allow[GL-H001] -- same O(batch) stack
             [g.bts0 + g.b_lo * g.step_q for g in geoms]
             + [g0.bts0 + g0.b_lo * g0.step_q] * (npad - n), np.int64)
+        if smfs is not None and npad > n:
+            # pad the mask stack like the window arguments (leader twin)
+            smfs = jnp.concatenate(
+                [smfs, jnp.broadcast_to(
+                    smfs[:1], (npad - n,) + smfs.shape[1:])])
         vkey = (
-            "grid_bm_vmap", fp0, grid.spad, grid.field_names, g0.r,
+            "grid_bm_vmap", psig0 if filtered else fp0, grid.spad,
+            grid.field_names, g0.r,
             g0.nbw, g0.nb, g0.step_q, tuple(g0.cards_tag), g0.dict_ver,
-            g0.tag_order, npad,
+            g0.tag_order, npad, filtered,
         )
         kernel = self._cache.get(vkey)
         jit_miss = kernel is None
         if kernel is None:
-            fn = self._bm_kernel_fn(
-                g0.tag_order, [k.column for k in g0.tag_keys],
-                g0.cards_tag, g0.nbw, g0.step_q, None,
-                [(name, op, ci) for name, op, _fn, _nn, ci in g0.specs],
-            )
-            kernel = jax.jit(jax.vmap(fn, in_axes=(None, None, None, 0, 0)))
+            in_axes = ((None, None, None, 0, 0, 0) if filtered
+                       else (None, None, None, 0, 0))
+            kernel = self.compiler.get_or_build(
+                "sql", vkey,
+                lambda: jax.jit(jax.vmap(
+                    self._bm_kernel_fn(
+                        g0.tag_order, [k.column for k in g0.tag_keys],
+                        g0.cards_tag, g0.nbw, g0.step_q, None,
+                        [(name, op, ci)
+                         for name, op, _fn, _nn, ci in g0.specs],
+                        take_smf=filtered,
+                    ), in_axes=in_axes)),
+                metrics=metrics)
             self._cache[vkey] = kernel
         DISPATCH_STATS["grid"] += n
         DISPATCH_STATS["grid_bm"] += n
         DISPATCH_STATS["grid_batch"] += 1
-        out = timed_kernel_call(
-            lambda: kernel(
-                layout[0], layout[1],
-                tuple(grid.tag_codes[t] for t in g0.tag_order),
-                b_los, bts0s,
-            ), jit_miss, metrics)
+        call_args = (layout[0], layout[1], tag_arrays, b_los, bts0s)
+        if filtered:
+            call_args = call_args + (smfs,)
+        out = aot_kernel_call(
+            kernel, lambda: kernel(*call_args), jit_miss, metrics)
         # gl: allow[GL-H001] -- THE one host materialization for the whole stacked batch
         out_np = {k: np.asarray(v) for k, v in out.items()}
         if metrics is not None:
@@ -910,6 +1004,30 @@ class Executor:
             out_i = {k: v[i] for k, v in out_np.items()}
             results.append(self._grid_env(p, g.specs, out_i))
         return results
+
+    def _series_mask(self, plan, g: "_GridGeom", grid, tag_arrays):
+        """Per-series WHERE mask [spad] f32 for one stacked-batch member:
+        the member's own compiled tag predicate evaluated by a tiny
+        cached kernel over the grid's tag codes — the exact
+        ``broadcast_to(where_fn(env), (spad,)).astype(f32)`` expression
+        the solo bm kernel computes inline, so a batched member's floats
+        are identical to its solo run."""
+        mkey = ("bm_smf", plan.fingerprint(), grid.spad, g.dict_ver,
+                g.tag_order)
+        fn = self._cache.get(mkey)
+        if fn is None:
+            where_fn = g.where_fn
+            tag_order = g.tag_order
+            spad = grid.spad
+
+            @jax.jit
+            def fn(tag_arrays):
+                env_s = dict(zip(tag_order, tag_arrays))
+                return jnp.broadcast_to(
+                    where_fn(env_s), (spad,)).astype(jnp.float32)
+
+            self._cache[mkey] = fn
+        return fn(tag_arrays)
 
     # ---- resident bucket-major layout (aligned windows) ---------------
     def _aligned_layout(
@@ -1027,7 +1145,8 @@ class Executor:
                         cnts, shardings["cnts"])
                 return sums, cnts
 
-            build = jax.jit(build_fn)
+            build = self.compiler.get_or_build(
+                "sql", key, lambda: jax.jit(build_fn))
             self._cache[key] = build
         sums, cnts = build(grid.values, grid.valid)
         sums.block_until_ready()
@@ -1035,7 +1154,7 @@ class Executor:
 
     def _bm_kernel_fn(  # gl: warm-path
         self, tag_order, tag_cols, cards_tag, nbw, step_q, where_fn,
-        bm_specs,
+        bm_specs, take_smf: bool = False,
     ):
         """Aligned-window kernel over the resident bucket-major partials:
         slice the window's buckets (traced start, static width — rolling
@@ -1054,13 +1173,22 @@ class Executor:
             ngt *= c
         nb = nbw
 
-        def kernel(sums, cnts, tag_arrays, b_lo, bts0):
+        def kernel(sums, cnts, tag_arrays, b_lo, bts0, *rest):
             spad = cnts.shape[0]
             tag_codes = dict(zip(tag_order, tag_arrays))
             s_w = jax.lax.dynamic_slice_in_dim(sums, b_lo, nbw, axis=2)
             c_w = jax.lax.dynamic_slice_in_dim(cnts, b_lo, nbw, axis=1)
             smf = None
-            if where_fn is not None:
+            if take_smf:
+                # stacked dispatch over tag-filtered windows: each
+                # member's per-series WHERE mask arrives as a TRACED
+                # [spad] f32 argument (computed by _series_mask from the
+                # member's own where_fn), applied exactly where the
+                # closure-captured mask is in the solo kernel — the
+                # float math per member is identical to its solo run
+                smf = rest[0]
+                c_w = c_w * smf[:, None]
+            elif where_fn is not None:
                 env_s = {t: codes for t, codes in tag_codes.items()}
                 smf = jnp.broadcast_to(
                     where_fn(env_s), (spad,)
@@ -1830,8 +1958,7 @@ class Executor:
                 k = topk["k"]
                 spec = topk["keys"]  # ((col, asc, nulls_first), ...)
 
-                @jax.jit
-                def kernel(t: DeviceTable, ts_lo, ts_hi):
+                def kernel_fn(t: DeviceTable, ts_lo, ts_hi):
                     env = dict(t.columns)
                     mask = filter_mask(env, t.row_mask, ts_lo, ts_hi)
                     keys = []  # minor → major for lexsort
@@ -1856,8 +1983,7 @@ class Executor:
                     return packed
             else:
 
-                @jax.jit
-                def kernel(t: DeviceTable, ts_lo, ts_hi):
+                def kernel_fn(t: DeviceTable, ts_lo, ts_hi):
                     env = dict(t.columns)
                     mask = filter_mask(env, t.row_mask, ts_lo, ts_hi)
                     sub = {c: env[c] for c in cols}
@@ -1865,6 +1991,11 @@ class Executor:
                     packed["__n__"] = jnp.sum(mask.astype(jnp.int64))
                     return packed
 
+            # DeviceTable-pytree kernel: never AOT-persisted (see the
+            # agg path) — classified and journaled, served by plain jit
+            kernel = self.compiler.get_or_build(
+                "sql", cache_key, lambda: jax.jit(kernel_fn),
+                persist=False)
             self._cache[cache_key] = kernel
         out = kernel(
             table,

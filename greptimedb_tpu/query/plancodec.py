@@ -90,3 +90,13 @@ def plan_to_json(sel: Select) -> str:
 
 def plan_from_json(s: str) -> Select:
     return decode_plan(json.loads(s))
+
+
+def plan_canon(sel: Select) -> str:
+    """Canonical (sorted-key) JSON of a Select: the AOT usage journal's
+    replay payload (query/engine.py _encode_replay).  Same encoding as
+    the wire form — decode_plan reads it unchanged — but with key order
+    normalized, so replay-equality comparisons (journal merge/tombstone,
+    warmup statement dedup) are byte-stable across processes."""
+    return json.dumps(encode_plan(sel), sort_keys=True,
+                      separators=(",", ":"))

@@ -278,6 +278,29 @@ class DistFrontend:
     def _select_attempt(self, sel: Select, raw_sql: str) -> QueryResult:
         if sel.table is None:
             raise Unsupported("tableless SELECT on the distributed frontend")
+        base = sel
+        has_joins = bool(sel.joins)
+        while (isinstance(base, Select)
+               and getattr(base, "from_subquery", None) is not None):
+            base = base.from_subquery
+            if isinstance(base, Select) and base.joins:
+                has_joins = True
+        if base is not sel:
+            # derived table (nested aggregates over RANGE subqueries):
+            # pull the BASE table's rows exactly like a raw select — the
+            # innermost WHERE still pushes its time range into the remote
+            # scan — and run the WHOLE statement on the staging instance,
+            # whose standalone engine owns from_subquery semantics.  A
+            # non-Select inner (set operation) has no single base table;
+            # a JOIN anywhere in the chain refuses BEFORE staging pulls a
+            # full remote scan only to fail locally.
+            if (not isinstance(base, Select) or base.table is None
+                    or has_joins):
+                raise Unsupported(
+                    "distributed derived table without a single base table")
+            info = self.catalog.get_table(self.db, base.table)
+            by_node = self._node_regions(info, for_read=True)
+            return self._select_raw(base, info, by_node, raw_sql)
         info = self.catalog.get_table(self.db, sel.table)
         by_node = self._node_regions(info, for_read=True)
         ts_col = (info.schema.time_index.name

@@ -211,6 +211,12 @@ class QueryScheduler:
         self._threads: list[threading.Thread] = []
         self._started = False
         self._stopping = False
+        # optional idle-capacity hook (AOT warmup, compile/warmup.py):
+        # when set, an idle worker calls it OUTSIDE the condition lock,
+        # one unit of background work per tick; a False return (or any
+        # exception) unhooks it.  None (default) keeps the worker's
+        # indefinite wait exactly as before.
+        self.idle_hook = None
         # local mirrors so /status, EXPLAIN ANALYZE and the bench read
         # pressure without a registry scrape (memory.py discipline)
         self.executed = 0
@@ -236,6 +242,15 @@ class QueryScheduler:
                 t.start()
                 self._threads.append(t)
             self._started = True
+
+    def kick_idle(self) -> None:
+        """Start the worker pool (if not yet) and wake any parked
+        workers: called after installing ``idle_hook`` so background
+        warmup begins on an idle server instead of waiting for the
+        first query to start/wake a worker."""
+        self._ensure_started()
+        with self._cond:
+            self._cond.notify_all()
 
     def stop(self) -> None:
         with self._cond:
@@ -446,14 +461,37 @@ class QueryScheduler:
 
     def _worker_loop(self) -> None:  # gl: warm-path(host)
         while True:
+            idle_work = None
             with self._cond:
                 while not self._stopping:
                     e = self._claim_next()
                     if e is not None:
                         break
-                    self._cond.wait()
+                    hook = self.idle_hook
+                    if hook is None:
+                        self._cond.wait()
+                        continue
+                    # background warmup pending: bounded wait, then (still
+                    # idle) run one tick outside the lock — live queries
+                    # always win the claim
+                    self._cond.wait(timeout=0.05)
+                    e = self._claim_next()
+                    if e is not None:
+                        break
+                    idle_work = hook
+                    break
                 if self._stopping:
                     return
+                if idle_work is not None:
+                    e = None
+            if idle_work is not None:
+                try:
+                    if not idle_work():
+                        self.idle_hook = None  # drained
+                except Exception:  # noqa: BLE001 — warmup must not kill
+                    self.idle_hook = None  # the worker
+                continue
+            with self._cond:
                 group = [e]
                 if self.batching and e.kind in ("sql", "session"):
                     group = self._claim_batch(e)

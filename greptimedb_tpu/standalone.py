@@ -376,6 +376,54 @@ class GreptimeDB(TableProvider):
             usage_fn=_scanmod.staging_bytes,
             policy="reject",
         )
+        # query-compiler subsystem (compile/): persistent AOT store +
+        # shape-class usage journal.  "auto" arms it for persistent data
+        # homes; memory-mode (ephemeral test) instances stay memory-only
+        # unless explicitly forced on.  Explicit "on" ALSO wires jax's
+        # own compilation-cache hook so jits outside the routed kernel
+        # sites persist their XLA artifacts too.
+        self.plan_compiler = self.engine.executor.compiler
+        _cc_mode = os.environ.get("GREPTIME_COMPILE_CACHE", "auto").lower()
+        _cc_forced = _cc_mode in ("on", "1", "true")
+        self._compile_cache_enabled = _cc_mode not in (
+            "off", "0", "false") and (_cc_forced or not self.memory_mode)
+        if self._compile_cache_enabled:
+            _cc_dir = os.environ.get("GREPTIME_COMPILE_CACHE_DIR") or (
+                os.path.join(data_home, "compile_cache"))
+            _cc_quota = os.environ.get("GREPTIME_COMPILE_CACHE_QUOTA_BYTES")
+            _cc_quota = int(_cc_quota) if _cc_quota else None
+            try:
+                self.plan_compiler.configure(_cc_dir, _cc_quota)
+            except OSError:
+                self._compile_cache_enabled = False  # unwritable dir
+            else:
+                _store = self.plan_compiler.store
+                self.memory.register(
+                    "compile_cache", _cc_quota,
+                    # disk, not HBM: serialized executables on local disk
+                    usage_fn=_store.bytes,
+                    reclaim_fn=_store.reclaim,
+                    policy="best_effort",
+                    kind="disk",
+                )
+                # never point the PROCESS-GLOBAL jax cache at a
+                # memory-mode instance's TemporaryDirectory: the dir
+                # dies with the instance and the stale global config
+                # would break cache writes for the rest of the process
+                if _cc_forced and not self.memory_mode \
+                        and _jax.config.jax_compilation_cache_dir is None:
+                    try:
+                        _jax.config.update(
+                            "jax_compilation_cache_dir",
+                            os.path.join(_cc_dir, "xla"))
+                        _jax.config.update(
+                            "jax_persistent_cache_min_compile_time_secs",
+                            0.0)
+                        _jax.config.update(
+                            "jax_persistent_cache_min_entry_size_bytes",
+                            -1)
+                    except Exception:  # noqa: BLE001 — optimisation only
+                        pass
         # nested (sub)queries route through the full statement dispatch so
         # information_schema / pg_catalog subqueries resolve
         self.engine.dispatch = self.execute_statement
@@ -472,6 +520,30 @@ class GreptimeDB(TableProvider):
                 self, interval_s=float(os.environ.get(
                     "GREPTIME_SELF_MONITOR_INTERVAL_S", "30")))
             self.self_monitor.start()
+        # AOT warmup (compile/warmup.py): every local region is open by
+        # now, so replay the usage journal's top-K shape classes — a
+        # restarted node serves its hot query classes with kernels (and
+        # the resident grids the replays build) already warm; with a
+        # populated AOT store the replays deserialize instead of
+        # compiling.  Remaining classes drain through the scheduler's
+        # idle hook, one statement per idle tick.
+        self.warmup = None
+        _wm = os.environ.get("GREPTIME_AOT_WARMUP", "auto").lower()
+        if (self._compile_cache_enabled
+                and _wm not in ("off", "0", "false")
+                and self.plan_compiler.journal is not None
+                and len(self.plan_compiler.journal)):
+            from greptimedb_tpu.compile.warmup import WarmupService
+
+            self.warmup = WarmupService(
+                self, self.plan_compiler,
+                top_k=int(os.environ.get("GREPTIME_AOT_WARMUP_TOP_K", "8")))
+            self.warmup.warm_on_open()
+            if self.scheduler is not None and self.warmup.pending():
+                self.scheduler.idle_hook = self.warmup.idle_tick
+                # wake/start the workers: an idle standby node must
+                # drain its warmup queue without waiting for traffic
+                self.scheduler.kick_idle()
 
     def _flush_largest_memtable(self, needed_bytes: int) -> None:
         """Ingest-quota reclaimer: flush memtables largest-first until the
@@ -496,9 +568,15 @@ class GreptimeDB(TableProvider):
         ``flush=True`` (the graceful SIGTERM server path) also flushes
         dirty regions so a clean restart replays O(hot-tail)."""
         if self.scheduler is not None:
+            # unhook idle warmup first: a tick claimed after this point
+            # would replay statements against a closing instance
+            self.scheduler.idle_hook = None
             self.scheduler.stop()
         if self.self_monitor is not None:
             self.self_monitor.stop()
+        # persist the shape-class usage journal so the next boot warms
+        # what this session actually ran
+        self.plan_compiler.close()
         self.regions.close(flush=flush)
         if hasattr(self.kv, "close"):
             self.kv.close()
@@ -778,6 +856,11 @@ class GreptimeDB(TableProvider):
             finally:
                 if sink is not None:
                     self._proc_local.stage_sink = None
+                # statement boundary: kernel classes built OUTSIDE a
+                # statement (batch paths, background work on this
+                # thread) must journal replay-less, never this
+                # statement's replay
+                self.plan_compiler.clear_replay()
                 elapsed_ms = (_time.perf_counter() - t0) * 1000
                 M_QUERY_DURATION.labels(engine).observe(elapsed_ms / 1000)
             if (

@@ -55,6 +55,10 @@ class Workload:
     usage_fn: Callable[[], int]
     reclaim_fn: Callable[[int], None] | None = None
     policy: str = "reject"  # "reject" | "best_effort"
+    # what the bytes ARE: "hbm" (device-resident tensors), "host" (RAM),
+    # or "disk" (the AOT compile cache's serialized executables) — /status
+    # readers must not sum disk quotas into memory pressure
+    kind: str = "hbm"
     # local mirrors of the prometheus counters so /status and the bench
     # drivers can read per-workload pressure without scraping the registry
     rejected: int = 0
@@ -81,12 +85,15 @@ class WorkloadMemoryManager:
         usage_fn: Callable[[], int],
         reclaim_fn: Callable[[int], None] | None = None,
         policy: str = "reject",
+        kind: str = "hbm",
     ) -> None:
         if policy not in ("reject", "best_effort"):
             raise ValueError(f"unknown memory policy {policy!r}")
+        if kind not in ("hbm", "host", "disk"):
+            raise ValueError(f"unknown workload kind {kind!r}")
         with self._lock:
             self._workloads[name] = Workload(
-                name, quota_bytes, usage_fn, reclaim_fn, policy
+                name, quota_bytes, usage_fn, reclaim_fn, policy, kind=kind
             )
         # weakref through the manager: the registry child must not keep a
         # closed db (usage_fn closes over it) alive across test instances;
@@ -195,6 +202,7 @@ class WorkloadMemoryManager:
                 "used_bytes": int(w.usage_fn()),
                 "quota_bytes": w.quota_bytes,
                 "policy": w.policy,
+                "kind": w.kind,
                 "rejected": w.rejected,
                 "reclaims": w.reclaims,
                 "peak_bytes": int(w.peak_bytes),

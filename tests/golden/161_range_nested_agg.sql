@@ -1,0 +1,6 @@
+-- nested aggregate over RANGE (fused-path coverage): the inner aligned
+-- window lowers to the bucket-major program, the outer folds its rows
+CREATE TABLE rn (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rn VALUES ('a',0,1.0),('b',0,10.0),('a',5000,2.0),('b',5000,20.0),('a',10000,3.0),('b',10000,30.0),('a',15000,4.0),('b',15000,40.0),('a',20000,5.0),('b',20000,50.0),('a',25000,6.0),('b',25000,60.0),('a',30000,7.0),('b',30000,70.0),('a',35000,8.0),('b',35000,80.0);
+SELECT h, max(av) FROM (SELECT h, ts, avg(v) AS av RANGE '10s' FROM rn WHERE ts >= 0 AND ts < 40000 ALIGN '10s' BY (h)) GROUP BY h ORDER BY h;
+SELECT h, max(av) FROM (SELECT h, ts, avg(v) AS av RANGE '10s' FROM rn WHERE ts >= 0 AND ts < 40000 ALIGN '10s' BY (h)) GROUP BY h ORDER BY h

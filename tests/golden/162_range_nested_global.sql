@@ -1,0 +1,5 @@
+-- global aggregates over a RANGE subquery: min/max/avg of window sums
+CREATE TABLE rg (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rg VALUES ('a',0,1.0),('b',0,2.0),('a',10000,3.0),('b',10000,4.0),('a',20000,5.0),('b',20000,6.0),('a',30000,7.0),('b',30000,8.0);
+SELECT max(sv), min(sv) FROM (SELECT h, ts, sum(v) AS sv RANGE '20s' FROM rg WHERE ts >= 0 AND ts < 40000 ALIGN '20s' BY (h));
+SELECT avg(sv) FROM (SELECT h, ts, sum(v) AS sv RANGE '20s' FROM rg WHERE ts >= 0 AND ts < 40000 ALIGN '20s' BY (h))

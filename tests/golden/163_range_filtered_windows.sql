@@ -1,0 +1,7 @@
+-- tag-filtered aligned RANGE windows: the where_series class that the
+-- scheduler's stacked dispatch coalesces; repeats are warm hits
+CREATE TABLE rf (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rf VALUES ('a',0,1.0),('b',0,10.0),('c',0,100.0),('a',5000,2.0),('b',5000,20.0),('c',5000,200.0),('a',10000,3.0),('b',10000,30.0),('c',10000,300.0),('a',15000,4.0),('b',15000,40.0),('c',15000,400.0);
+SELECT h, ts, avg(v) RANGE '10s' FROM rf WHERE h = 'a' AND ts >= 0 AND ts < 20000 ALIGN '10s' BY (h) ORDER BY ts;
+SELECT h, ts, avg(v) RANGE '10s' FROM rf WHERE h = 'b' AND ts >= 0 AND ts < 20000 ALIGN '10s' BY (h) ORDER BY ts;
+SELECT h, ts, avg(v) RANGE '10s' FROM rf WHERE h = 'c' AND ts >= 0 AND ts < 20000 ALIGN '10s' BY (h) ORDER BY ts

@@ -1,0 +1,5 @@
+-- mixed sum/count/avg RANGE aggregates under a tag filter
+CREATE TABLE rm (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rm VALUES ('x',0,1.5),('y',0,-1.5),('x',10000,2.5),('y',10000,-2.5),('x',20000,3.5),('y',20000,-3.5),('x',30000,4.5),('y',30000,-4.5);
+SELECT h, ts, sum(v) RANGE '20s', count(v) RANGE '20s', avg(v) RANGE '20s' FROM rm WHERE h = 'x' AND ts >= 0 AND ts < 40000 ALIGN '20s' BY (h) ORDER BY ts;
+SELECT h, ts, sum(v) RANGE '20s', count(v) RANGE '20s', avg(v) RANGE '20s' FROM rm WHERE h = 'y' AND ts >= 0 AND ts < 40000 ALIGN '20s' BY (h) ORDER BY ts

@@ -1,0 +1,4 @@
+-- two-tag RANGE window feeding an outer per-tag fold
+CREATE TABLE r2 (h STRING, dc STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h, dc));
+INSERT INTO r2 VALUES ('a','e',0,1.0),('a','w',0,2.0),('b','e',0,3.0),('b','w',0,4.0),('a','e',10000,5.0),('a','w',10000,6.0),('b','e',10000,7.0),('b','w',10000,8.0),('a','e',20000,9.0),('a','w',20000,10.0),('b','e',20000,11.0),('b','w',20000,12.0);
+SELECT dc, max(sv) FROM (SELECT h, dc, ts, sum(v) AS sv RANGE '20s' FROM r2 WHERE ts >= 0 AND ts < 40000 ALIGN '20s' BY (h, dc)) GROUP BY dc ORDER BY dc

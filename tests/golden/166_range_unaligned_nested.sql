@@ -1,0 +1,5 @@
+-- UNALIGNED window endpoints (dynamic-slice class) under an outer fold
+CREATE TABLE ru (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO ru VALUES ('a',0,1.0),('b',0,2.0),('a',5000,3.0),('b',5000,4.0),('a',10000,5.0),('b',10000,6.0),('a',15000,7.0),('b',15000,8.0),('a',20000,9.0),('b',20000,10.0);
+SELECT h, ts, sum(v) RANGE '10s' FROM ru WHERE ts >= 3000 AND ts < 18000 ALIGN '10s' BY (h) ORDER BY h, ts;
+SELECT h, min(sv) FROM (SELECT h, ts, sum(v) AS sv RANGE '10s' FROM ru WHERE ts >= 3000 AND ts < 18000 ALIGN '10s' BY (h)) GROUP BY h ORDER BY h

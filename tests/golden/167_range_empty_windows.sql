@@ -1,0 +1,5 @@
+-- sparse data: buckets with zero samples are dropped, not NULL rows
+CREATE TABLE re (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO re VALUES ('a',0,1.0),('a',50000,2.0),('b',10000,3.0);
+SELECT h, ts, count(v) RANGE '10s' FROM re WHERE ts >= 0 AND ts < 60000 ALIGN '10s' BY (h) ORDER BY h, ts;
+SELECT h, ts, avg(v) RANGE '10s' FROM re WHERE ts >= 0 AND ts < 60000 ALIGN '10s' BY (h) ORDER BY h, ts

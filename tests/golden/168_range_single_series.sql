@@ -1,0 +1,5 @@
+-- single-series aligned RANGE: degenerate tag cardinality class
+CREATE TABLE rs (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rs VALUES ('solo',0,2.0),('solo',10000,4.0),('solo',20000,8.0),('solo',30000,16.0);
+SELECT h, ts, sum(v) RANGE '20s' FROM rs WHERE ts >= 0 AND ts < 40000 ALIGN '20s' BY (h) ORDER BY ts;
+SELECT h, ts, sum(v) RANGE '20s' FROM rs WHERE ts >= 0 AND ts < 40000 ALIGN '20s' BY (h) ORDER BY ts

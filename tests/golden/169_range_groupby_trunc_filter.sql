@@ -1,0 +1,6 @@
+-- the warm dashboard class: date_trunc bucket + tag filter (aligned
+-- bucket-major path; the stacked dispatch coalesces these per host)
+CREATE TABLE rt (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rt VALUES ('a',1451606400000,1.0),('b',1451606400000,2.0),('a',1451608200000,3.0),('b',1451608200000,4.0),('a',1451610000000,5.0),('b',1451610000000,6.0),('a',1451611800000,7.0),('b',1451611800000,8.0);
+SELECT h, date_trunc('hour', ts) AS hr, avg(v) FROM rt WHERE h = 'a' AND ts >= 1451606400000 AND ts < 1451613600000 GROUP BY h, hr ORDER BY hr;
+SELECT h, date_trunc('hour', ts) AS hr, avg(v) FROM rt WHERE h = 'b' AND ts >= 1451606400000 AND ts < 1451613600000 GROUP BY h, hr ORDER BY hr

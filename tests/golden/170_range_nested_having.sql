@@ -1,0 +1,4 @@
+-- HAVING over a nested RANGE fold
+CREATE TABLE rh (h STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE, PRIMARY KEY (h));
+INSERT INTO rh VALUES ('a',0,1.0),('b',0,100.0),('a',10000,2.0),('b',10000,200.0),('a',20000,3.0),('b',20000,300.0),('a',30000,4.0),('b',30000,400.0);
+SELECT h, max(sv) AS m FROM (SELECT h, ts, sum(v) AS sv RANGE '20s' FROM rh WHERE ts >= 0 AND ts < 40000 ALIGN '20s' BY (h)) GROUP BY h HAVING max(sv) > 10 ORDER BY h

@@ -1,0 +1,7 @@
+-- fused PromQL chain: sum by (h) (rate(...)) = ONE device dispatch;
+-- the repeat is the warm (cached fused program) run
+CREATE TABLE fm (h STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (h));
+INSERT INTO fm VALUES ('a',0,0.0),('b',0,100.0),('a',10000,5.0),('b',10000,90.0),('a',20000,10.0),('b',20000,80.0),('a',30000,15.0),('b',30000,2.0),('a',40000,20.0),('b',40000,12.0);
+TQL EVAL (20, 40, 10) sum by (h) (rate(fm[20s]));
+TQL EVAL (20, 40, 10) sum by (h) (rate(fm[20s]));
+TQL EVAL (20, 40, 10) sum (increase(fm[20s]))

@@ -1,0 +1,7 @@
+-- fused gauge-window reducers under min/max/avg/count aggregations
+CREATE TABLE fg (h STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (h));
+INSERT INTO fg VALUES ('a',0,1.0),('b',0,4.0),('a',10000,2.0),('b',10000,3.0),('a',20000,3.0),('b',20000,2.0),('a',30000,4.0),('b',30000,1.0);
+TQL EVAL (20, 30, 10) max by (h) (avg_over_time(fg[20s]));
+TQL EVAL (20, 30, 10) min (sum_over_time(fg[20s]));
+TQL EVAL (20, 30, 10) avg by (h) (last_over_time(fg[20s]));
+TQL EVAL (20, 30, 10) count (present_over_time(fg[20s]))

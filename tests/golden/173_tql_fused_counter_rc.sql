@@ -1,0 +1,5 @@
+-- fused resets/changes aggregations (counter_rc kernel kind)
+CREATE TABLE fc (h STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (h));
+INSERT INTO fc VALUES ('a',0,10.0),('a',10000,12.0),('a',20000,3.0),('a',30000,8.0),('b',0,5.0),('b',10000,5.0),('b',20000,7.0),('b',30000,2.0);
+TQL EVAL (30, 30, 10) sum by (h) (resets(fc[30s]));
+TQL EVAL (30, 30, 10) max (changes(fc[30s]))

@@ -1,0 +1,5 @@
+-- fused irate/idelta (instant-pair kernel kind)
+CREATE TABLE fi (h STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (h));
+INSERT INTO fi VALUES ('a',0,0.0),('a',10000,10.0),('a',20000,30.0),('b',0,100.0),('b',10000,95.0),('b',20000,85.0);
+TQL EVAL (20, 20, 10) sum by (h) (irate(fi[20s]));
+TQL EVAL (20, 20, 10) avg (idelta(fi[20s]))

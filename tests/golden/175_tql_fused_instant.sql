@@ -1,0 +1,6 @@
+-- fused instant-selector aggregations (staleness-windowed last sample)
+CREATE TABLE fn (h STRING, dc STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (h, dc));
+INSERT INTO fn VALUES ('a','e',0,1.0),('a','w',0,2.0),('b','e',0,3.0),('b','w',0,4.0),('a','e',10000,5.0),('a','w',10000,6.0),('b','e',10000,7.0),('b','w',10000,8.0);
+TQL EVAL (10, 10, 10) sum by (h) (fn);
+TQL EVAL (10, 10, 10) avg without (h) (fn);
+TQL EVAL (10, 10, 10) count (fn)

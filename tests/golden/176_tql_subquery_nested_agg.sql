@@ -1,0 +1,6 @@
+-- PromQL subquery shapes under aggregation: OUTSIDE the fused surface,
+-- must keep multi-kernel semantics exactly
+CREATE TABLE sqm (h STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (h));
+INSERT INTO sqm VALUES ('a',10000,1.0),('a',20000,3.0),('a',30000,6.0),('a',40000,10.0),('b',10000,2.0),('b',20000,2.0),('b',30000,8.0),('b',40000,8.0);
+TQL EVAL (40, 40, 60) sum by (h) (max_over_time(rate(sqm[20s])[40:10]));
+TQL EVAL (40, 40, 60) max (avg_over_time(sqm[30:10]))

@@ -1,0 +1,5 @@
+-- subquery windows through gauge reducers + aggregation over them
+CREATE TABLE sqg (h STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (h));
+INSERT INTO sqg VALUES ('a',0,2.0),('a',15000,4.0),('a',30000,6.0),('b',0,1.0),('b',15000,3.0),('b',30000,5.0);
+TQL EVAL (30, 30, 30) avg by (h) (sum_over_time(sqg[30:15]));
+TQL EVAL (30, 30, 30) min (last_over_time(sqg[30:15]))

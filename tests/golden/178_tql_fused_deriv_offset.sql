@@ -1,0 +1,6 @@
+-- fused deriv (regression kind) and offset-shifted selectors
+CREATE TABLE fd (h STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (h));
+INSERT INTO fd VALUES ('a',0,0.0),('a',10000,10.0),('a',20000,20.0),('a',30000,30.0),('b',0,100.0),('b',10000,80.0),('b',20000,60.0),('b',30000,40.0);
+TQL EVAL (30, 30, 10) avg by (h) (deriv(fd[30s]));
+TQL EVAL (30, 30, 10) sum by (h) (rate(fd[20s] offset 10s));
+TQL EVAL (30, 30, 10) max (fd offset 10s)

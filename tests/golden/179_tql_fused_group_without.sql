@@ -1,0 +1,6 @@
+-- group/without variants through the fused group reduction
+CREATE TABLE fw (h STRING, dc STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (h, dc));
+INSERT INTO fw VALUES ('a','e',0,1.0),('a','w',0,2.0),('b','e',0,3.0),('b','w',0,4.0),('a','e',20000,5.0),('a','w',20000,6.0),('b','e',20000,7.0),('b','w',20000,8.0);
+TQL EVAL (20, 20, 20) group by (dc) (max_over_time(fw[20s]));
+TQL EVAL (20, 20, 20) sum without (dc) (min_over_time(fw[20s]));
+TQL EVAL (20, 20, 20) group (fw)

@@ -1,0 +1,8 @@
+-- fused and unfusable shapes side by side: quantile/topk stay on the
+-- multi-kernel path while the sums fuse — results must agree with both
+CREATE TABLE fx (h STRING, ts TIMESTAMP(3) TIME INDEX, val DOUBLE, PRIMARY KEY (h));
+INSERT INTO fx VALUES ('a',0,1.0),('b',0,9.0),('c',0,5.0),('a',10000,2.0),('b',10000,8.0),('c',10000,6.0),('a',20000,3.0),('b',20000,7.0),('c',20000,4.0);
+TQL EVAL (20, 20, 10) sum by (h) (avg_over_time(fx[20s]));
+TQL EVAL (20, 20, 10) quantile (0.5, avg_over_time(fx[20s]));
+TQL EVAL (20, 20, 10) topk (2, last_over_time(fx[20s]));
+TQL EVAL (20, 20, 10) min (fx)

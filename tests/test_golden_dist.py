@@ -51,6 +51,19 @@ DIST_CASES = [
     "79_partitioned_agg",
     # aligned/unaligned RANGE windows (the bucket-major layout-cache
     # surface): location-transparent, so the whole block promotes
+    # round-18 fused-path coverage: nested aggregates over RANGE, the
+    # tag-filtered (where_series) stacked-dispatch class, empty/sparse
+    # windows — location-transparent, so the whole block promotes
+    "161_range_nested_agg",
+    "162_range_nested_global",
+    "163_range_filtered_windows",
+    "164_range_count_sum_mix",
+    "165_range_two_tags_nested",
+    "166_range_unaligned_nested",
+    "167_range_empty_windows",
+    "168_range_single_series",
+    "169_range_groupby_trunc_filter",
+    "170_range_nested_having",
     "151_range_aligned_window",
     "152_range_unaligned_window",
     "153_range_by_tags",

@@ -113,6 +113,54 @@ class TestBatchParity:
             "no stacked dispatch formed across 20 saturated rounds")
         assert db.scheduler.largest_batch > 1
 
+    def test_tag_filtered_stacked_dispatch_bit_exact(self, db):
+        """where_series extension: concurrent windows identical up to
+        the tag filter (`hostname = 'host_i'`) coalesce into one stacked
+        dispatch — each member's predicate rides in as a traced
+        per-series mask — and every member's rows stay bit-exact vs its
+        solo run."""
+        sched = db.scheduler
+
+        def q(i):
+            lo = T0
+            hi = lo + 3600_000
+            return (
+                "SELECT hostname, date_trunc('hour', ts) AS hour, "
+                "avg(usage_user), avg(usage_system) FROM cpu "
+                f"WHERE hostname = 'host_{i}' AND ts >= {lo} "
+                f"AND ts < {hi} GROUP BY hostname, hour"
+            )
+
+        from greptimedb_tpu.query.physical import DISPATCH_STATS
+
+        solo = {i: db.sql(q(i)) for i in range(HOSTS)}
+        b0 = DISPATCH_STATS["grid_batch"]
+        results: dict[int, object] = {}
+        errors: list = []
+
+        def client(i):
+            try:
+                results[i] = sched.submit(q(i % HOSTS))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        for _ in range(20):
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors
+            for i, res in results.items():
+                want = solo[i % HOSTS]
+                assert res.column_names == want.column_names
+                assert res.rows == want.rows  # BIT-exact
+            if DISPATCH_STATS["grid_batch"] > b0:
+                break
+        assert DISPATCH_STATS["grid_batch"] > b0, (
+            "no tag-filtered stacked dispatch formed in 20 rounds")
+
     def test_engine_batch_entry_bit_exact(self, db):
         """Direct engine-level parity: execute_select_batch vs
         execute_select on identical Selects, no scheduler timing luck."""

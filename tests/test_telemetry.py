@@ -130,6 +130,7 @@ class TestRegistryStaticCheck:
         # import every metric-registering module, then walk the REGISTRY:
         # no conflicting re-registrations, and every metric/label name
         # follows the Prometheus [a-z_][a-z0-9_]* convention
+        import greptimedb_tpu.compile.service  # noqa: F401
         import greptimedb_tpu.flow.engine  # noqa: F401
         import greptimedb_tpu.meta.cluster  # noqa: F401
         import greptimedb_tpu.meta.migration  # noqa: F401
@@ -170,6 +171,17 @@ class TestRegistryStaticCheck:
             "greptime_scheduler_admitted_total",
             "greptime_scheduler_rejected_total",
             "greptime_scheduler_tenant_inflight",
+        ):
+            assert required in REGISTRY._metrics, required
+        # the query-compiler subsystem's surface (persistent compile
+        # cache hits/misses/persists, AOT warmup outcomes, fused
+        # dispatches) exists by import for the same reason
+        for required in (
+            "greptime_compile_cache_events_total",
+            "greptime_compile_xla_builds_total",
+            "greptime_compile_fused_dispatch_total",
+            "greptime_compile_warmup_total",
+            "greptime_compile_cache_disk_bytes",
         ):
             assert required in REGISTRY._metrics, required
         # the vectorized ingest pipeline's metric surface likewise exists
@@ -381,6 +393,17 @@ class TestSpanTrees:
     def test_promql_stage_spans(self, db, traced):
         db.sql("TQL EVAL (0, 10, '5s') sum by(h) (cpu)")
         names = {s["name"] for s in traced.drain()}
+        # the fused chain (compile/fused.py) replaces the window-kernel +
+        # eager-reduce pair with ONE fused_kernel span; PLAN_FUSION=off
+        # (and every unfusable shape) keeps the window_kernel span
+        assert {"selection", "sort_layout", "group_agg",
+                "label_decode"} <= names
+        assert "fused_kernel" in names or "window_kernel" in names
+
+    def test_promql_stage_spans_unfused(self, db, traced, monkeypatch):
+        monkeypatch.setenv("GREPTIME_PLAN_FUSION", "off")
+        db.sql("TQL EVAL (0, 10, '5s') sum by(h) (cpu)")
+        names = {s["name"] for s in traced.drain()}
         assert {"selection", "sort_layout", "window_kernel", "group_agg",
                 "label_decode"} <= names
 
@@ -419,6 +442,7 @@ class TestSpanTrees:
 
 class TestSlowQueryStages:
     def test_sql_and_tql_stage_breakdown(self, db):
+        db.sql("TQL EVAL (0, 10, '5s') avg(cpu)")  # warm the kernel class
         db.slow_query_threshold_ms = 0.0001
         try:
             db.sql("SELECT h, avg(v) FROM cpu GROUP BY h")
@@ -431,5 +455,8 @@ class TestSlowQueryStages:
             by_query["SELECT h, avg(v) FROM cpu GROUP BY h"])
         assert "plan_ms" in sql_stages and "device_exec_ms" in sql_stages
         tql_stages = json.loads(by_query["TQL EVAL (0, 10, '5s') avg(cpu)"])
-        assert "promql_window_kernel_ms" in tql_stages
+        # fused chain reports its one dispatch as fused_kernel; unfused
+        # (PLAN_FUSION=off, unfusable shapes) keeps window_kernel
+        assert ("promql_fused_kernel_ms" in tql_stages
+                or "promql_window_kernel_ms" in tql_stages)
         assert "promql_selection_ms" in tql_stages
