@@ -83,6 +83,18 @@ KNOB_DOCS: dict[str, str] = {
         "`on` installs the runtime lock-order witness (records real "
         "acquisition chains, fails on ABBA inversions) for the "
         "concurrency/chaos test tiers; unset = witness never imported."),
+    "GREPTIME_FLOW_CKPT_INTERVAL_S": (
+        "Flow checkpoint cadence: GTF1 state+watermark snapshots persist "
+        "at most this often (post-fold and on scheduler-idle ticks; "
+        "0 disables periodic checkpointing, shutdown still saves)."),
+    "GREPTIME_FLOW_DEVICE": (
+        "`off` disables the device flow runtime everywhere: streaming "
+        "flows keep the host dict-of-partials engine byte-for-byte "
+        "(flow/device.py + checkpoint.py never imported)."),
+    "GREPTIME_FLOW_QUOTA_BYTES": (
+        "Memory-manager quota for the `flow` workload (resident "
+        "[G, W] partial-state matrices; reject-to-host-fallback "
+        "admission)."),
     "GREPTIME_FULLTEXT": (
         "`off` disables the fingerprint text index everywhere: "
         "LIKE/MATCHES/regex/LogQL predicates walk their dictionaries "

@@ -103,6 +103,11 @@ GUARDED: dict[str, dict[str, dict[str, tuple[str, str]]]] = {
             "_dirty": ("_lock", "rw"),
         },
     },
+    "flow/device.py": {
+        "FlowDeviceRuntime": {
+            "_kernels": ("_kern_lock", "mutate"),
+        },
+    },
     "fulltext/resident.py": {
         "FulltextIndexCache": {
             "_lru": ("_struct_lock", "mutate"),

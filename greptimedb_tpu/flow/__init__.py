@@ -1,5 +1,17 @@
 """Flow engine: continuous aggregation (reference src/flow, SURVEY.md §2.7).
 
-Batching mode first (time-window-aware re-query — trivially TPU-friendly,
-SURVEY.md §7.2 step 7); the streaming dataflow mode is a later round.
+Three engines behind one FlowEngine facade (flow/engine.py):
+
+- DEVICE streaming (flow/device.py): resident ``[G, W]`` partial-state
+  matrices on the accelerator, one jitted scatter/segment-reduce
+  dispatch per (flow, chunk), mesh-sharded on the group axis —
+  the default for decomposable aggregate flows over plain tables;
+- HOST streaming: the dict-of-partials incremental fold (the
+  ``GREPTIME_FLOW_DEVICE=off`` twin and the fallback for query shapes /
+  quota rejections outside the device surface);
+- BATCHING: dirty-window re-query for non-decomposable queries.
+
+All three checkpoint through flow/checkpoint.py (GTF1 envelopes + exact
+WAL-offset watermarks), so restart and flownode reassignment
+(flow/cluster.py) resume by replaying only the WAL tail.
 """

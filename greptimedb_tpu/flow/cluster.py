@@ -33,12 +33,15 @@ FLOWNODE_STALE_MS = 30_000.0
 
 class Flownode:
     """One flow-executing node (reference flownode role): its engine
-    holds only the flows routed here."""
+    holds only the flows routed here.  ``object_client`` (rpc/client.py
+    Flight object plane) ships checkpoint bytes when two nodes' data
+    homes differ; same-home nodes read the shared checkpoint store."""
 
-    def __init__(self, node_id: int, db):
+    def __init__(self, node_id: int, db, object_client=None):
         self.node_id = node_id
         self.db = db  # frontend handle: source queries + sink writes
         self.engine = FlowEngine(db, restore=False)
+        self.object_client = object_client
         self.alive = True
         self.last_heartbeat_ms = 0.0
 
@@ -112,6 +115,9 @@ class FlowControlPlane:
         if target is None:
             raise GreptimeError("no alive flownode to host the flow")
         target.engine.create_flow(stmt)  # persists durable SQL in kv
+        task = target.engine.flows.get(stmt.name)
+        if task is not None:
+            task.flownode_id = target.node_id
         self.kv.put_json(ROUTE_PREFIX + stmt.name, {"node": target.node_id})
         return target.node_id
 
@@ -127,6 +133,15 @@ class FlowControlPlane:
         else:
             # owner gone: the durable SQL still needs deleting
             self.kv.delete(FlowEngine._KV_PREFIX + name)
+        # drop the checkpoint from EVERY node's store, not just the
+        # owner's: past reassignments shipped copies around, and a stale
+        # one would resurrect the dropped flow's state on a later CREATE
+        # of the same definition routed to that node
+        for n in self.nodes.values():
+            if n.engine.checkpoints is not None:
+                n.engine.checkpoints.delete(name)
+            if n.engine.runtime is not None:
+                n.engine.runtime.drop(name)
         self.kv.delete(ROUTE_PREFIX + name)
 
     # ---- data plane ----------------------------------------------------
@@ -166,20 +181,45 @@ class FlowControlPlane:
                 # flow and survive DROP — but keep the durable SQL,
                 # drop_flow() owns that
                 node.engine.flows.pop(name, None)
+                if node.engine.runtime is not None:
+                    node.engine.runtime.drop(name)
+            self._ship_checkpoint(node, target, name)
             stmt = parse_sql(raw.decode())[0]
             task = target.engine._register(stmt)
-            # reseed: streaming backfills from source automatically;
-            # batching marks the full source range dirty so the next
-            # trigger rebuilds every window (writes during the outage
-            # left no dirty marks anywhere)
-            if task.mode == "streaming":
-                task.needs_backfill = True
-            else:
-                self._mark_full_range_dirty(target, task)
+            task.flownode_id = target.node_id
+            # resume: with checkpoints, _register already restored the
+            # standing state + replayed the WAL tail past the watermark
+            # (no source re-backfill).  Only a missing/stale/unreplayable
+            # checkpoint falls back to the legacy full reseed: streaming
+            # re-backfills from source; batching marks the full source
+            # range dirty (writes during the outage left no marks).
+            if not getattr(task, "restored_from_checkpoint", False):
+                if task.mode == "streaming":
+                    task.needs_backfill = True
+                else:
+                    self._mark_full_range_dirty(target, task)
             self.kv.put_json(ROUTE_PREFIX + name,
                              {"node": target.node_id})
             moved.append(name)
         return moved
+
+    @staticmethod
+    def _ship_checkpoint(src: Flownode | None, dst: Flownode,
+                         name: str) -> None:
+        """Move the flow's latest checkpoint to the new owner's store
+        (PR-6 Flight object plane when data homes differ; a no-op for a
+        shared store)."""
+        if src is None or src.engine.checkpoints is None or \
+                dst.engine.checkpoints is None:
+            return
+        from greptimedb_tpu.flow.checkpoint import ship
+
+        try:
+            ship(src.engine.checkpoints, dst.engine.checkpoints, name,
+                 object_client=dst.object_client)
+        except Exception:  # noqa: BLE001 — shipping is best-effort; a
+            # missing checkpoint just means the legacy reseed below
+            pass
 
     @staticmethod
     def _mark_full_range_dirty(node: Flownode, task) -> None:

@@ -69,6 +69,17 @@ class FlowTask:
     needs_backfill: bool = False
     window_key_pos: int | None = None  # position of the time key in keys
     stage: object = None  # cached (provider, engine) for chunk evaluation
+    # device flow runtime (flow/device.py; all None/untouched when
+    # GREPTIME_FLOW_DEVICE=off keeps the host path byte-for-byte)
+    device_state: object = None
+    device_failed: bool = False
+    watermark: dict = None  # region id -> last folded WAL sequence
+    positions: dict = None  # region id -> consumed append-log position
+    max_ts_folded: dict = field(default_factory=dict)
+    last_tick_ms: int = 0
+    ckpt_dirty: bool = False
+    restored_from_checkpoint: bool = False
+    flownode_id: int | None = None
 
     def mark_dirty(self, ts_values) -> None:
         for t in ts_values:
@@ -135,12 +146,22 @@ class FlowEngine:
     _KV_PREFIX = "__flow/"
 
     def __init__(self, db, restore: bool = True):
+        import os
         import threading
 
         # restore=False: sharded flownodes (flow/cluster.py) register
         # only the flows their routes assign, not the whole key-space
         self.db = db
         self.flows: dict[str, FlowTask] = {}
+        # device flow runtime + checkpoint store (standalone wires both
+        # before constructing the engine; GREPTIME_FLOW_DEVICE=off leaves
+        # them None and every path below is the pre-existing host code)
+        self.runtime = getattr(db, "flow_runtime", None)
+        self.checkpoints = getattr(db, "flow_checkpoints", None)
+        self._ckpt_interval_s = float(os.environ.get(
+            "GREPTIME_FLOW_CKPT_INTERVAL_S", "30"))
+        self._last_ckpt_ms = 0.0
+        self._idle_armed = False
         # serializes incremental-state mutation: HTTP ingest-pool workers
         # (servers/http.py) and the SQL path on the db-executor both call
         # on_write/run_all — two threads folding the same flow's deltas
@@ -196,7 +217,31 @@ class FlowEngine:
             task.needs_backfill = True
         self.flows[stmt.name] = task
         self._ensure_sink(task)
+        if self.checkpoints is not None:
+            task.watermark = {}
+            task.positions = {}
+            self._try_restore(task)
         return task
+
+    def _try_restore(self, task: FlowTask) -> bool:
+        """Resume from the flow's GTF1 checkpoint + WAL-tail replay
+        (flow/checkpoint.py).  A miss / stale / unreplayable checkpoint
+        leaves the legacy seeding in place (backfill / dirty marks)."""
+        import os as _os
+
+        from greptimedb_tpu.flow.checkpoint import apply_payload
+
+        if not _os.path.exists(self.checkpoints.path(task.name)):
+            return False
+        payload = self.checkpoints.load(task.name)
+        if payload is None:
+            return False
+        try:
+            return apply_payload(self, task, payload)
+        except Exception:  # noqa: BLE001 — a restore failure must never
+            # block registration; the flow reseeds from source instead
+            task.needs_backfill = task.mode == "streaming"
+            return False
 
     def create_flow(self, stmt: CreateFlow) -> None:
         if stmt.name in self.flows:
@@ -213,6 +258,10 @@ class FlowEngine:
             raise FlowNotFound(name)
         del self.flows[name]
         self.db.kv.delete(self._KV_PREFIX + name)
+        if self.runtime is not None:
+            self.runtime.drop(name)
+        if self.checkpoints is not None:
+            self.checkpoints.delete(name)
 
     def list_flows(self) -> list[FlowTask]:
         return [self.flows[k] for k in sorted(self.flows)]
@@ -224,10 +273,20 @@ class FlowEngine:
         immediately when the caller provides the full columns AND the
         batch was a pure append; upserts (``appendable=False``) would
         double-count in incremental state, so they force a state reseed.
-        Batching flows (or ts-only callers) mark dirty windows."""
+        Batching flows (or ts-only callers) mark dirty windows.
+
+        With the device runtime armed, streaming flows over plain tables
+        instead PUMP their source regions' append logs (flow/device.py):
+        the fold consumes the logged chunks in WAL-sequence order, which
+        is what makes the checkpoint watermark exact.  Metric-engine
+        logical sources (multiplexed physical regions) keep the
+        data-driven legacy fold."""
         with self._fold_lock:
             for task in list(self.flows.values()):
                 if task.source_table.split(".")[-1] != table.split(".")[-1]:
+                    continue
+                if self.runtime is not None:
+                    self._on_write_pumped(task, ts_values, data, appendable)
                     continue
                 if task.mode == "streaming" and not appendable:
                     task.needs_backfill = True
@@ -237,6 +296,138 @@ class FlowEngine:
                     self._stream_ingest(task, data)
                 else:
                     task.mark_dirty(ts_values)
+        if self.runtime is not None:
+            self._arm_idle_checkpoints()
+
+    # ---- pumped ingest (device runtime armed) -------------------------
+    def _plain_source(self, task: FlowTask) -> bool:
+        """Plain-table sources pump their own append log; metric-engine
+        logical tables share a multiplexed physical region whose log
+        carries other metrics' rows — those keep the data-driven fold."""
+        cached = getattr(task, "_plain_src", None)
+        if cached is not None:
+            return cached
+        try:
+            dbn, tname = self.db._split_name(task.source_table)
+            plain = not self.db.metric_engine.is_logical(dbn, tname)
+        except Exception:  # noqa: BLE001 — undecidable (source missing /
+            # engine mid-init): treat as plain for THIS call but do NOT
+            # cache — the next call re-probes once the table exists
+            return True
+        task._plain_src = plain
+        return plain
+
+    def _on_write_pumped(self, task: FlowTask, ts_values, data,
+                         appendable: bool) -> None:
+        if task.mode == "batching":
+            task.mark_dirty(ts_values)
+            task.ckpt_dirty = True
+            if self._plain_source(task):
+                self.runtime.pump(task)  # watermark advance only
+            return
+        if not self._plain_source(task):
+            # legacy data-driven fold for metric-engine sources (no
+            # checkpoint watermark: their failover re-backfills)
+            if not appendable:
+                task.needs_backfill = True
+            if data is not None and not task.needs_backfill:
+                self._stream_ingest(task, data)
+            else:
+                task.mark_dirty(ts_values)
+            return
+        if not appendable:
+            task.needs_backfill = True
+        if not getattr(task, "device_failed", False) and \
+                self.runtime.pump(task):
+            return
+        self._pump_host_stream(task)
+
+    def _pump_host_stream(self, task: FlowTask) -> None:
+        """The host dict-of-partials fold, fed from the append log like
+        the device path so its checkpoints carry the same exact
+        watermark (device-ineligible / quota-rejected flows)."""
+        from greptimedb_tpu.storage.memtable import SEQ
+
+        try:
+            regions = self.db._regions_of(task.source_table)
+        except Exception:  # noqa: BLE001 — source missing
+            return
+        if task.watermark is None:
+            task.watermark = {}
+            task.positions = {}
+        if task.needs_backfill:
+            self._host_reseed(task, regions)
+            return
+        for region in regions:
+            rid = region.region_id
+            pos = task.positions.get(rid)
+            if pos is None:
+                self._host_reseed(task, regions)
+                return
+            chunks = region.append_chunks_since(pos)
+            if chunks is None:
+                self._host_reseed(task, regions)
+                return
+            wm = task.watermark.get(rid, -1)
+            for chunk in chunks:
+                seq = int(chunk[SEQ][0])
+                pos += 1
+                if seq <= wm:
+                    continue
+                if seq != wm + 1:
+                    # an unlogged write (upsert/delete) holds this seq
+                    self._host_reseed(task, regions)
+                    return
+                self._host_fold_chunk(task, region, chunk)
+                wm = seq
+            task.watermark[rid] = wm
+            task.positions[rid] = pos
+
+    def _host_fold_chunk(self, task: FlowTask, region, chunk) -> None:
+        """Fold one append-log chunk through the legacy streaming path
+        (identical content to the wire batch: the memtable materializes
+        the same columns region.write encoded)."""
+        from greptimedb_tpu.storage.memtable import SEQ
+
+        schema = region.schema
+        data = {k: v for k, v in chunk.items() if schema.has_column(k)}
+        self._stream_ingest(task, data)
+        rid = region.region_id
+        seq = int(chunk[SEQ][0])
+        task.watermark[rid] = max(task.watermark.get(rid, -1), seq)
+        ts = chunk[region.ts_name]
+        if len(ts):
+            task.max_ts_folded[rid] = max(
+                task.max_ts_folded.get(rid, -(1 << 63)), int(ts.max()))
+        task.ckpt_dirty = True
+        task.last_tick_ms = int(time.time() * 1000)
+
+    def _host_reseed(self, task: FlowTask, regions) -> None:
+        """Legacy backfill + exact-enough watermark: sequences snapshot
+        under each region's write lock BEFORE the backfill query, so
+        everything at or below the watermark is covered by the query
+        (rows landing during it may fold twice under concurrent ingest —
+        the pre-existing backfill race — never be lost)."""
+        task._plain_src = None  # re-probe source routing after reseed
+        marks = {}
+        for region in regions:
+            with region._write_lock:
+                marks[region.region_id] = (region.next_seq - 1,
+                                           region.append_pos)
+        with TRACER.stage("run_flow", flow_name=task.name, mode="backfill"):
+            with M_FLOW_TICK.labels(task.name, "streaming").time():
+                self._backfill(task)
+        if task.needs_backfill:
+            return  # backfill failed and kept the flag: retry later
+        for region in regions:
+            rid = region.region_id
+            seq0, pos0 = marks[rid]
+            task.watermark[rid] = seq0
+            task.positions[rid] = pos0
+            b = region.ts_bounds()
+            if b is not None:
+                task.max_ts_folded[rid] = b[1]
+        task.ckpt_dirty = True
 
     # ---- streaming engine ---------------------------------------------
     def _time_key_pos(self, task: FlowTask) -> int | None:
@@ -470,6 +661,16 @@ class FlowEngine:
     def _run_flow_locked(self, task: FlowTask,
                          now_ms: int | None = None) -> int:
         if task.mode == "streaming":
+            if self.runtime is not None and self._plain_source(task):
+                # pumped flows: drain the append log (reseeding if the
+                # state needs it); dirty marks are subsumed by the pump
+                if task.needs_backfill or task.dirty:
+                    task.dirty.clear()
+                    if not getattr(task, "device_failed", False) and \
+                            self.runtime.pump(task):
+                        return 0
+                    self._pump_host_stream(task)
+                return 0
             if task.needs_backfill or task.dirty:
                 task.dirty.clear()
                 with TRACER.stage("run_flow", flow_name=task.name,
@@ -536,7 +737,113 @@ class FlowEngine:
 
     def run_all(self) -> int:
         with self._fold_lock:
-            return sum(self.run_flow(t) for t in list(self.flows.values()))
+            written = sum(self.run_flow(t) for t in list(self.flows.values()))
+        # outside the fold lock: checkpoint_now re-acquires it only for
+        # the state snapshot, keeping fsync off the ingest path
+        if self.checkpoints is not None:
+            self.maybe_checkpoint()
+        return written
+
+    # ---- checkpointing -------------------------------------------------
+    def checkpoint_now(self, name: str | None = None) -> int:
+        """Persist GTF1 checkpoints for dirty flows (all, or one by
+        name); returns how many were saved.  Only the state SNAPSHOT
+        (build_payload — host copies of watermarks + matrices) runs
+        under the fold lock; the pickle + fsync + rename happen outside
+        it, so a multi-MB checkpoint never stalls concurrent ingest
+        folds.  A fold landing between snapshot and save re-dirties the
+        task, and a failed save restores the flag."""
+        if self.checkpoints is None:
+            return 0
+        from greptimedb_tpu.flow.checkpoint import build_payload
+
+        snaps = []
+        with self._fold_lock:
+            for task in list(self.flows.values()):
+                if name is not None and task.name != name:
+                    continue
+                if name is None and not task.ckpt_dirty:
+                    continue
+                payload = build_payload(self, task)
+                if payload is None:
+                    continue
+                task.ckpt_dirty = False
+                snaps.append((task, payload))
+            self._last_ckpt_ms = time.time() * 1000.0
+        saved = 0
+        for task, payload in snaps:
+            if self.checkpoints.save(task.name, payload):
+                saved += 1
+            else:
+                task.ckpt_dirty = True  # retry on the next tick
+        return saved
+
+    def maybe_checkpoint(self) -> int:
+        """Interval-gated checkpoint pass (called post-fold and from the
+        scheduler's idle hook)."""
+        if self.checkpoints is None or self._ckpt_interval_s <= 0:
+            return 0
+        now = time.time() * 1000.0
+        if now - self._last_ckpt_ms < self._ckpt_interval_s * 1000.0:
+            return 0
+        return self.checkpoint_now()
+
+    def _arm_idle_checkpoints(self) -> None:
+        """Drain checkpoints on scheduler idle capacity (PR-7 idle_hook):
+        armed after folds, unhooks itself once no flow is dirty.  The
+        armed flag flips under the fold lock on BOTH sides, so a fold
+        that dirties a flow concurrently with the drain's final tick
+        either keeps the hook alive (tick sees the dirty flow) or
+        re-arms right after (arm sees the cleared flag) — never neither."""
+        if self.checkpoints is None or self._ckpt_interval_s <= 0:
+            return
+        sched = getattr(self.db, "scheduler", None)
+        if sched is None or not hasattr(sched, "add_idle_hook"):
+            return
+        with self._fold_lock:
+            if self._idle_armed:
+                return
+            self._idle_armed = True
+        sched.add_idle_hook(self._ckpt_idle_tick)
+
+    def _ckpt_idle_tick(self) -> bool:
+        self.maybe_checkpoint()
+        with self._fold_lock:
+            pending = any(t.ckpt_dirty for t in self.flows.values())
+            if not pending:
+                self._idle_armed = False
+        return pending
+
+    # ---- state introspection -------------------------------------------
+    def state_keys(self, name: str, now_ms: int | None = None) -> set:
+        """Live (group, window) key tuples of a streaming flow — one
+        probe for both engines (host dict keys / decoded device state)."""
+        task = self.flows[name]
+        st = getattr(task, "device_state", None)
+        if st is not None and self.runtime is not None:
+            return self.runtime.state_keys(task, st, now_ms)
+        return set(task.stream_state)
+
+    def state_bytes(self, task: FlowTask) -> int:
+        st = getattr(task, "device_state", None)
+        if st is not None:
+            return st.nbytes()
+        # host dict-of-partials: slot dicts dominate; a coarse but
+        # monotone estimate is enough for SHOW FLOWS / info_schema
+        ncols = len(task.partial_plan.merge_cols) if task.partial_plan \
+            else 0
+        return len(task.stream_state) * (88 + 56 * max(ncols, 1))
+
+    def watermark_repr(self, task: FlowTask) -> str | None:
+        st = getattr(task, "device_state", None)
+        wm = st.folded if st is not None else getattr(task, "watermark",
+                                                      None)
+        if not wm:
+            return None
+        import json
+
+        return json.dumps({str(k): v for k, v in sorted(wm.items())},
+                          separators=(",", ":"))
 
 
 def handle_flow_statement(db, stmt):
@@ -550,7 +857,20 @@ def handle_flow_statement(db, stmt):
         eng.drop_flow(stmt.name, stmt.if_exists)
         return QueryResult([], [], affected_rows=0)
     if isinstance(stmt, ShowFlows):
-        rows = [[t.name, t.sink_table, str(t.query.table), t.comment]
+        rows = [[t.name, t.sink_table, str(t.query.table), t.comment,
+                 flow_mode(t), t.flownode_id, eng.state_bytes(t),
+                 eng.watermark_repr(t), t.last_tick_ms or None]
                 for t in eng.list_flows()]
-        return QueryResult(["Flow", "Sink", "Source", "Comment"], rows)
+        return QueryResult(
+            ["Flow", "Sink", "Source", "Comment", "Mode", "Flownode",
+             "StateBytes", "Watermark", "LastTick"], rows)
     raise Unsupported(f"flow statement {type(stmt).__name__}")
+
+
+def flow_mode(task: FlowTask) -> str:
+    """Human-readable engine mode: where this flow's folds actually run."""
+    if task.mode != "streaming":
+        return "batching"
+    if getattr(task, "device_state", None) is not None:
+        return "streaming(device)"
+    return "streaming"

@@ -168,20 +168,35 @@ def _partitions(db):
 
 
 def _flows(db):
+    from greptimedb_tpu.flow.engine import flow_mode, select_to_sql
+
+    eng = db.flow_engine
     rows = []
-    for t in db.flow_engine.list_flows():
+    for t in eng.list_flows():
         rows.append({
-            "flow_name": t.name, "flow_id": None, "state_size": None,
-            "table_catalog": "greptime", "flow_definition": None,
+            "flow_name": t.name, "flow_id": None,
+            "state_size": eng.state_bytes(t),
+            "table_catalog": "greptime",
+            "flow_definition": select_to_sql(t.query),
             "comment": t.comment, "expire_after":
                 t.expire_after_ms // 1000 if t.expire_after_ms else None,
             "source_table_names": t.source_table, "sink_table_name": t.sink_table,
             "last_execution_time": t.last_run_ms or None,
+            # device flow runtime columns (flow/device.py): which engine
+            # folds this flow, where it lives, and how far its durable
+            # checkpoint watermark has advanced
+            "mode": flow_mode(t), "flownode_id": t.flownode_id,
+            "checkpoint_watermark": eng.watermark_repr(t),
+            "last_tick": t.last_tick_ms or None,
         })
     names = ["flow_name", "flow_id", "state_size", "table_catalog",
              "flow_definition", "comment", "expire_after",
-             "source_table_names", "sink_table_name", "last_execution_time"]
-    return _columns_of(rows, names), {n: "String" for n in names}
+             "source_table_names", "sink_table_name", "last_execution_time",
+             "mode", "flownode_id", "checkpoint_watermark", "last_tick"]
+    types = {n: "String" for n in names}
+    types.update({"state_size": "UInt64", "flownode_id": "UInt32",
+                  "last_tick": "UInt64", "last_execution_time": "UInt64"})
+    return _columns_of(rows, names), types
 
 
 def _build_info(db):

@@ -153,6 +153,28 @@ def bucket_major_shardings(mesh, spad: int):
     }
 
 
+def flow_state_shardings(mesh):
+    """NamedShardings for the flow runtime's resident ``[G, W]`` partial
+    matrices (flow/device.py): the GROUP axis splits across the mesh —
+    group ids are assigned densely, so placement is contiguous-range by
+    group hash-order, mirroring bucket_major_shardings' series split.
+    The fold kernel's scatter/segment program then runs SPMD under GSPMD
+    (chunk arrays replicate; XLA inserts the collectives at the
+    affected-slot gather feeding the sink upsert).  Returns None on a
+    single device; the caller also keeps the replicated placement while
+    the padded group count does not tile the mesh."""
+    if mesh is None:
+        return None
+    d = mesh.devices.size
+    if d <= 1:
+        return None
+    axis = mesh.axis_names[0]
+    return {
+        "state": NamedSharding(mesh, P(axis, None)),
+        "ndev": d,
+    }
+
+
 def promql_row_shardings(mesh, n: int):
     """NamedShardings for the resident PromQL sort-layout arrays
     (promql/engine.py _build_sort_layout) and padded selection vectors:

@@ -294,6 +294,7 @@ class HttpServer(ThreadedAiohttpApp):
         r.add_get("/health", self.h_health)
         r.add_route("*", "/debug/log_level", self.h_log_level)
         r.add_get("/debug/prof/cpu", self.h_prof_cpu)
+        r.add_route("*", "/debug/prof/mem", self.h_prof_mem)
         r.add_get("/ready", self.h_health)
         r.add_get("/metrics", self.h_metrics)
         r.add_get("/config", self.h_config)
@@ -1592,6 +1593,75 @@ class HttpServer(ThreadedAiohttpApp):
             root.setLevel("WARNING" if level == "WARN" else level)
         return web.json_response(
             {"level": logging.getLevelName(root.level)})
+
+    async def h_prof_mem(self, request):
+        """Heap + HBM memory profile (reference
+        src/servers/src/http/mem_prof.rs, which dumps a jemalloc heap
+        profile; the python analog is tracemalloc).  Actions:
+
+        - ``?action=start``: activate tracemalloc (``frames=N`` stack
+          depth, default 1) and snapshot the baseline;
+        - ``?action=snapshot`` (default): top-N allocation sites
+          (``top=N``, default 20) and, once a baseline exists, the
+          DIFF against it (what grew since start / the last snapshot);
+        - ``?action=stop``: deactivate tracing and drop the baseline.
+
+        Every response also reports the device side: per-workload
+        used/quota/peak bytes from the workload-manager budgets
+        (utils/memory.py) with HBM-kind workloads summed separately —
+        the resident grids, layout caches and flow state live there,
+        invisible to any host allocator profile."""
+        import tracemalloc
+
+        action = request.query.get("action", "snapshot")
+        try:
+            top_n = max(1, min(int(request.query.get("top", "20")), 100))
+            frames = max(1, min(int(request.query.get("frames", "1")), 32))
+        except ValueError:
+            return web.json_response(
+                {"error": "top/frames must be integers"}, status=400)
+
+        def workloads():
+            usage = self.db.memory.usage()
+            hbm = sum(w["used_bytes"] for w in usage.values()
+                      if w["kind"] == "hbm")
+            return {"workloads": usage, "hbm_used_bytes": hbm}
+
+        if action == "start":
+            if not tracemalloc.is_tracing():
+                tracemalloc.start(frames)
+            self._mem_baseline = tracemalloc.take_snapshot()
+            return web.json_response(
+                {"tracing": True, "action": "start", **workloads()})
+        if action == "stop":
+            self._mem_baseline = None
+            if tracemalloc.is_tracing():
+                tracemalloc.stop()
+            return web.json_response(
+                {"tracing": False, "action": "stop", **workloads()})
+        if action != "snapshot":
+            return web.json_response(
+                {"error": f"unknown action {action!r}"}, status=400)
+        payload = {"tracing": tracemalloc.is_tracing(), **workloads()}
+        if tracemalloc.is_tracing():
+            snap = tracemalloc.take_snapshot()
+            traced, peak = tracemalloc.get_traced_memory()
+            payload["traced_bytes"] = traced
+            payload["traced_peak_bytes"] = peak
+            payload["top"] = [
+                {"site": str(s.traceback), "size_bytes": s.size,
+                 "count": s.count}
+                for s in snap.statistics("lineno")[:top_n]
+            ]
+            base = getattr(self, "_mem_baseline", None)
+            if base is not None:
+                payload["diff"] = [
+                    {"site": str(s.traceback), "size_diff": s.size_diff,
+                     "count_diff": s.count_diff}
+                    for s in snap.compare_to(base, "lineno")[:top_n]
+                ]
+            self._mem_baseline = snap
+        return web.json_response(payload)
 
     async def h_prof_cpu(self, request):
         """Statistical CPU profile (reference src/servers/src/http/pprof.rs

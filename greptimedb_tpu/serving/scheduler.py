@@ -252,6 +252,42 @@ class QueryScheduler:
         with self._cond:
             self._cond.notify_all()
 
+    def add_idle_hook(self, fn) -> None:
+        """Compose ``fn`` into the idle-capacity hook.  Multiple
+        background consumers (AOT warmup, flow checkpoint drain) share
+        the single ``idle_hook`` slot through a dispatcher that calls
+        each member per tick, drops drained/failing members, and reports
+        drained (False) only when none remain — preserving the worker
+        loop's unhook-on-False contract for a lone hook."""
+        with self._cond:
+            cur = self.idle_hook
+            if cur is None:
+                self.idle_hook = fn
+            elif getattr(cur, "_gl_hooks", None) is not None:
+                cur._gl_hooks.append(fn)
+            else:
+                hooks = [cur, fn]
+
+                def _multi():
+                    alive = False
+                    for h in list(_multi._gl_hooks):
+                        try:
+                            keep = bool(h())
+                        except Exception:  # noqa: BLE001 — a failing
+                            keep = False  # member must not kill the rest
+                        if keep:
+                            alive = True
+                        else:
+                            try:
+                                _multi._gl_hooks.remove(h)
+                            except ValueError:
+                                pass
+                    return alive
+
+                _multi._gl_hooks = hooks
+                self.idle_hook = _multi
+        self.kick_idle()
+
     def stop(self) -> None:
         with self._cond:
             self._stopping = True
@@ -486,10 +522,20 @@ class QueryScheduler:
                     e = None
             if idle_work is not None:
                 try:
-                    if not idle_work():
-                        self.idle_hook = None  # drained
+                    drained = not idle_work()
                 except Exception:  # noqa: BLE001 — warmup must not kill
-                    self.idle_hook = None  # the worker
+                    drained = True  # the worker
+                if drained:
+                    # unhook under the lock, and only while the hook is
+                    # still the one we ran AND gained no new members —
+                    # add_idle_hook may have extended the dispatcher (or
+                    # replaced a lone hook) concurrently with this tick,
+                    # and clearing blindly would discard that registration
+                    with self._cond:
+                        cur = self.idle_hook
+                        if cur is idle_work and not getattr(
+                                cur, "_gl_hooks", None):
+                            self.idle_hook = None
                 continue
             with self._cond:
                 group = [e]

@@ -436,12 +436,44 @@ class GreptimeDB(TableProvider):
         import threading as _threading
 
         self._lock = _threading.RLock()
-        from greptimedb_tpu.flow.engine import FlowEngine
-
-        self.flow_engine = FlowEngine(self)
+        # before the flow engine: restoring a flow at registration plans
+        # its query (table_context reads the session timezone) and asks
+        # the metric engine whether a source table is logical
+        self.timezone = "UTC"  # SET time_zone / config default_timezone
         from greptimedb_tpu.storage.metric_engine import MetricEngine
 
         self.metric_engine = MetricEngine(self)
+        # device flow runtime (flow/device.py): resident [G, W] partial
+        # state, one-dispatch ingest folds, GTF1 checkpoints with exact
+        # WAL watermarks (flow/checkpoint.py).  GREPTIME_FLOW_DEVICE=off
+        # keeps the host dict-of-partials engine byte-for-byte — the
+        # modules are then never imported.
+        self.flow_runtime = None
+        self.flow_checkpoints = None
+        if os.environ.get("GREPTIME_FLOW_DEVICE", "on").lower() not in (
+                "off", "0", "false"):
+            from greptimedb_tpu.flow.checkpoint import FlowCheckpointStore
+            from greptimedb_tpu.flow.device import FlowDeviceRuntime
+
+            self.flow_runtime = FlowDeviceRuntime(self)
+            try:
+                self.flow_checkpoints = FlowCheckpointStore(
+                    os.path.join(data_home, "flow_ckpt"))
+            except OSError:
+                self.flow_checkpoints = None  # unwritable home
+            _flow_quota = os.environ.get("GREPTIME_FLOW_QUOTA_BYTES")
+            self.memory.register(
+                "flow",
+                int(_flow_quota) if _flow_quota else None,
+                usage_fn=self.flow_runtime.nbytes,
+                policy="reject",
+            )
+            self.flow_runtime.memory_probe = (
+                lambda n: self.memory.try_admit("flow", n)
+            )
+        from greptimedb_tpu.flow.engine import FlowEngine
+
+        self.flow_engine = FlowEngine(self)
         from greptimedb_tpu.utils.auth import StaticUserProvider
 
         self.user_provider = StaticUserProvider()
@@ -450,7 +482,6 @@ class GreptimeDB(TableProvider):
             from greptimedb_tpu.utils.plugins import load_plugins
 
             self.plugins = load_plugins(plugins, db=self)
-        self.timezone = "UTC"  # SET time_zone / config default_timezone
         # slow-query recorder (reference common-event-recorder + the
         # greptime_private.slow_queries system table): queries slower than
         # the threshold are appended to a private table; 0 disables
@@ -540,7 +571,9 @@ class GreptimeDB(TableProvider):
                 top_k=int(os.environ.get("GREPTIME_AOT_WARMUP_TOP_K", "8")))
             self.warmup.warm_on_open()
             if self.scheduler is not None and self.warmup.pending():
-                self.scheduler.idle_hook = self.warmup.idle_tick
+                # add_idle_hook (not direct assignment): the flow
+                # checkpoint drain shares the idle slot
+                self.scheduler.add_idle_hook(self.warmup.idle_tick)
                 # wake/start the workers: an idle standby node must
                 # drain its warmup queue without waiting for traffic
                 self.scheduler.kick_idle()
@@ -572,6 +605,13 @@ class GreptimeDB(TableProvider):
             # would replay statements against a closing instance
             self.scheduler.idle_hook = None
             self.scheduler.stop()
+        if self.flow_checkpoints is not None:
+            # final checkpoints: a clean restart resumes every flow from
+            # its exact watermark with zero tail to replay
+            try:
+                self.flow_engine.checkpoint_now()
+            except Exception:  # noqa: BLE001 — shutdown must not die on
+                pass  # a checkpoint failure; restart reseeds instead
         if self.self_monitor is not None:
             self.self_monitor.stop()
         # persist the shape-class usage journal so the next boot warms

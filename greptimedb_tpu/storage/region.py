@@ -138,6 +138,14 @@ class Region:
         self._series: dict[tuple, int] = {
             tuple(codes): i for i, codes in enumerate(manifest.state.series)
         }
+        # repeated-writer fast paths (the device flow runtime's sink
+        # upserts hit both every fold): per-tag-column DictColumn
+        # vocabulary→region-code maps keyed on the vocabulary array's
+        # identity (vocabularies are append-only — covered entries are
+        # immutable), and the single-tag code→tsid mirror of _series.
+        # Cleared wherever _series/encoders are rebuilt.
+        self._dictcol_memo: dict[str, tuple] = {}
+        self._series_map1: np.ndarray | None = None
         self.generation = 0  # bumped on any data mutation; cache key
         # bumped only on structure changes that can MUTATE row content
         # (upserts/deletes/compaction/ttl/truncate/alter/replay) — flush is
@@ -223,13 +231,51 @@ class Region:
             if isinstance(col, DictColumn):
                 # pre-factorized by the vectorized wire parser: the
                 # (codes, vocabulary) pair IS the factorization — skip
-                # the per-row hash entirely.  Compact to REFERENCED
-                # vocabulary entries first: a sliced column (DictColumn
-                # .take from partition routing / per-measurement splits)
-                # keeps the whole-batch vocabulary, and registering
-                # unreferenced values would grow this region's dictionary
-                # with values that were routed elsewhere, forever
+                # the per-row hash entirely.
+                # Repeated-writer memo: a caller reusing one append-only
+                # vocabulary array across writes (the device flow
+                # runtime's sink upserts, every fold) resolves through a
+                # cached vocab-pos→region-code map — only NEVER-SEEN
+                # referenced entries pay the python registration, once
+                # ever (covered entries are immutable by the dictionary
+                # append-only contract).
+                vbase = col.values if col.values.base is None \
+                    else col.values.base
+                # lazy attrs: region-LIKES (CombinedRegionView, staged
+                # providers) borrow this method without Region.__init__
+                memo_map = getattr(self, "_dictcol_memo", None)
+                if memo_map is None:
+                    memo_map = self._dictcol_memo = {}
+                memo = memo_map.get(name)
+                if memo is not None and memo[0] is vbase:
+                    cmap = memo[1]
+                    if len(cmap) < len(col.values):
+                        cmap = np.concatenate([
+                            cmap, np.full(len(col.values) - len(cmap), -1,
+                                          np.int64)])
+                        memo_map[name] = (vbase, cmap)
+                    col_codes = cmap[col.codes]
+                    need = col_codes < 0
+                    if need.any():
+                        for rc in np.unique(col.codes[need]).tolist():
+                            v = col.values[rc]
+                            if v is None or (isinstance(v, float)
+                                             and v != v):
+                                v = ""  # NULL tags encode as ""
+                            cmap[rc] = enc.get_or_insert(v)
+                        col_codes = cmap[col.codes]
+                    if out_codes is not None:
+                        out_codes[name] = col_codes.astype(np.int32)
+                    code_arrays.append(col_codes)
+                    continue
+                # Compact to REFERENCED vocabulary entries first: a
+                # sliced column (DictColumn .take from partition routing /
+                # per-measurement splits) keeps the whole-batch
+                # vocabulary, and registering unreferenced values would
+                # grow this region's dictionary with values that were
+                # routed elsewhere, forever
                 inv, uniq = col.codes, col.values
+                orig_len = len(uniq)
                 # referenced-code set via bincount (O(n + vocab)) instead
                 # of a sort — codes are small non-negative ints
                 used = (np.flatnonzero(np.bincount(inv, minlength=len(uniq)))
@@ -261,6 +307,18 @@ class Region:
                 count=len(uniq),
             )
             col_codes = codes[inv]
+            if isinstance(col, DictColumn):
+                # seed the repeated-writer memo (referenced entries only
+                # — unreferenced positions stay -1 and register lazily)
+                cmap = np.full(orig_len, -1, np.int64)
+                cmap[used if len(used) < orig_len
+                     else slice(None)] = codes
+                vbase = col.values if col.values.base is None \
+                    else col.values.base
+                memo_map = getattr(self, "_dictcol_memo", None)
+                if memo_map is None:
+                    memo_map = self._dictcol_memo = {}
+                memo_map[name] = (vbase, cmap)
             if out_codes is not None:
                 out_codes[name] = col_codes.astype(np.int32)
             code_arrays.append(col_codes)
@@ -271,19 +329,50 @@ class Region:
         # has many tag columns, so no per-row python fallback is
         # acceptable on the ingest hot path)
         if len(code_arrays) == 1:
+            # single-tag tables resolve through a dense code→tsid mirror
+            # of _series: one gather per write, python only for codes
+            # never seen before (the repeated-writer hot path — flow sink
+            # upserts, single-tag metric tables)
+            codes1 = code_arrays[0]
+            mx = int(codes1.max()) if n else -1
+            smap = getattr(self, "_series_map1", None)
+            if smap is None or mx >= len(smap):
+                grown = np.full(max(16, 2 * (mx + 1)), -1, np.int64)
+                if smap is not None:
+                    grown[: len(smap)] = smap
+                else:
+                    for key, tsid in self._series.items():
+                        if key[0] < len(grown):
+                            grown[key[0]] = tsid
+                smap = self._series_map1 = grown
+            tsids1 = smap[codes1]
+            need = tsids1 < 0
+            if need.any():
+                # FIRST-OCCURRENCE registration order (pd.factorize's):
+                # tsid assignment order is observable via first/last
+                # tie-breaks on equal timestamps (PR-8 discipline)
+                uniq_new, first_idx = np.unique(codes1[need],
+                                                return_index=True)
+                for c in uniq_new[np.argsort(first_idx,
+                                             kind="stable")].tolist():
+                    key = (int(c),)
+                    tsid = self._series.get(key)
+                    if tsid is None:
+                        tsid = len(self._series)
+                        self._series[key] = tsid
+                    smap[c] = tsid
+                tsids1 = smap[codes1]
+            return tsids1
+        widths = [
+            max(int(a.max()) if n else 0, 1).bit_length()
+            for a in code_arrays
+        ]
+        if sum(widths) <= 62:
             packed = code_arrays[0]
-            widths = None
-        else:
-            widths = [
-                max(int(a.max()) if n else 0, 1).bit_length()
-                for a in code_arrays
-            ]
-            if sum(widths) <= 62:
-                packed = code_arrays[0]
-                for a, w in zip(code_arrays[1:], widths[1:]):
-                    packed = (packed << np.int64(w)) | a
-            else:  # astronomically wide key space: exact structured unique
-                packed = None
+            for a, w in zip(code_arrays[1:], widths[1:]):
+                packed = (packed << np.int64(w)) | a
+        else:  # astronomically wide key space: exact structured unique
+            packed = None
         if packed is not None:
             pmax = int(packed.max()) + 1 if n else 0
             if 0 < pmax <= max(1024, 4 * n):
@@ -599,6 +688,8 @@ class Region:
         self._series = {
             key + (empty_code,): tsid for key, tsid in self._series.items()
         }
+        self._dictcol_memo.clear()
+        self._series_map1 = None
         self.schema = new_schema
         self.memtable.schema = new_schema
         self.manifest.commit({"kind": "schema", "schema": new_schema.to_dict()})
@@ -944,6 +1035,8 @@ class Region:
         self._series = {
             tuple(codes): i for i, codes in enumerate(state.series)
         }
+        self._dictcol_memo.clear()
+        self._series_map1 = None
         self.memtable = Memtable(self.schema)
         self.next_seq = max(self.next_seq, state.flushed_seq + 1)
         if take_ownership:
