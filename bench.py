@@ -497,6 +497,78 @@ def cold_scan_bench(db) -> None:
     }), flush=True)
 
 
+def scrub_bench(db, sql) -> None:
+    """Scrubber overhead A/B (round 19 acceptance d): warm query medians
+    with the background integrity scrubber enabled at PRODUCTION pacing
+    (one completed sweep, then interval-gated no-op ticks — the steady
+    state a serving node lives in) vs off, plus the disclosed
+    during-sweep worst case (a sweep actively verifying multi-MB SSTs
+    competes for the container's cores until preemption or the next
+    interval gate)."""
+    import statistics
+    import threading
+
+    from greptimedb_tpu.storage.scrubber import Scrubber
+
+    def median_ms(n=11):
+        times = []
+        for _ in range(n):
+            t0 = time.time()
+            db.sql(sql)
+            times.append((time.time() - t0) * 1000)
+        return statistics.median(times)
+
+    def with_ticker(scrub, fn):
+        stop = threading.Event()
+
+        def ticker():
+            # the production schedule: one bounded batch per idle tick
+            # at the scheduler's 50ms cadence (serving/scheduler.py)
+            while not stop.is_set():
+                scrub.tick()
+                stop.wait(0.05)
+
+        t = threading.Thread(target=ticker, daemon=True)
+        t.start()
+        try:
+            return fn()
+        finally:
+            stop.set()
+            t.join(timeout=30)
+
+    # the bench owns scrub scheduling: the instance's auto-armed
+    # scrubber (standalone.py) must not tick during the OFF baseline
+    # (warmup's kick_idle may have started the worker pool)
+    if getattr(db, "scheduler", None) is not None:
+        db.scheduler.idle_hook = None
+    off_ms = median_ms()
+    # acceptance leg — production steady state: default pacing (a
+    # completed sweep, then GREPTIME_SCRUB_INTERVAL_S of gated no-op
+    # ticks); must be within noise of off
+    scrub = Scrubber(db.regions)
+    scrub._resume_skip = 0  # a partial auto-sweep's cursor would skip items
+    scrub.run_sweep()  # untimed; the next sweep gates 300s away
+    steady_ms = with_ticker(scrub, median_ms)
+    # during-sweep worst case, disclosed: continuous verify competing
+    # for cores (production sees this for one sweep per interval, and
+    # interactive pressure through the scheduler preempts it)
+    active = Scrubber(db.regions, interval_s=0, batch=4)
+    active._resume_skip = 0
+    active_ms = with_ticker(active, median_ms)
+    print(json.dumps({
+        "metric": "scrub_overhead",
+        "warm_ms_scrub_off": round(off_ms, 1),
+        "warm_ms_scrub_on": round(steady_ms, 1),
+        "ratio": round(steady_ms / max(off_ms, 1e-9), 3),
+        "warm_ms_mid_sweep": round(active_ms, 1),
+        "mid_sweep_ratio": round(active_ms / max(off_ms, 1e-9), 3),
+        "sweeps": scrub.sweeps + active.sweeps,
+        "items_verified": scrub.items + active.items,
+        "corrupt_found": scrub.corrupt + active.corrupt,
+        "backend": _backend,
+    }), flush=True)
+
+
 _COLDSTART_CHILD = r"""
 import json, os, sys, time
 import jax
@@ -836,6 +908,15 @@ def main() -> None:
             cold_scan_bench(db)
         except Exception as e:  # noqa: BLE001 — headline already emitted
             log(f"cold-scan bench skipped: {e!r}")
+    # scrubber overhead A/B (round 19): warm medians with the verified
+    # background sweep hammering vs idle — cheap (reuses the warm query)
+    if (not os.environ.get("GREPTIME_BENCH_NO_SCRUB")
+            and deadline - time.time() > 60):
+        _phase = "scrub-overhead bench"
+        try:
+            scrub_bench(db, sql)
+        except Exception as e:  # noqa: BLE001 — headline already emitted
+            log(f"scrub bench skipped: {e!r}")
     db.close()
     # cold-start A/B (round 18): first-warm-class-query latency with the
     # persistent compile cache on vs off, fresh subprocesses

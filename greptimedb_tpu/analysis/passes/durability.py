@@ -19,6 +19,13 @@ Scope: ``storage/``.  Codes:
 - **GL-D002** — ``os.replace``/``os.rename`` in storage code in a
   function that never fsyncs the parent directory (no ``_fsync_dir``
   call).  Owner modules are exempt only where they ARE the helper.
+- **GL-D003** — a manifest or watermark-marker write that bypasses the
+  fenced conditional-put owners (ISSUE 15).  Manifest bytes reach the
+  store only through ``Manifest._write``/``set_fence`` (which verify
+  the leader epoch and CAS version-keyed files); the broker watermark
+  marker only through ``SharedLogBroker._persist_watermarks``.  A
+  plain write anywhere else re-opens the split-brain interleave the
+  fencing closed — baseline-free from day one.
 
 Reference analog: the object-store stack's write-path invariants that
 greptimedb gets from opendal plus its own atomic-write helpers.
@@ -36,6 +43,36 @@ SCOPE_PREFIX = "storage/"
 # modules that OWN the fsync discipline; bare opens are their job
 OPEN_OWNERS = {"storage/wal.py", "storage/object_store.py", "storage/s3.py"}
 WRITE_MODES = set("wax")
+
+# GL-D003 declarative map (ISSUE 15): per fenced-surface module, the
+# store-write call shapes that count as a manifest/watermark write and
+# the owner scopes allowed to perform them.  ``"open"`` additionally
+# matches ANY write-mode open() in the module (the broker's watermark
+# marker is plain file IO).
+FENCED_WRITE_OWNERS: dict[str, tuple[frozenset, frozenset]] = {
+    "storage/manifest.py": (
+        frozenset({"store.write", "store.write_if"}),
+        frozenset({"Manifest._write", "Manifest.set_fence"}),
+    ),
+    "storage/remote_wal.py": (
+        frozenset({"open"}),
+        frozenset({"SharedLogBroker._persist_watermarks"}),
+    ),
+}
+
+
+def _write_mode_open(call: ast.Call) -> bool:
+    """Any-mode writable open() (text or binary — the watermark marker
+    is text json)."""
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if not isinstance(mode, str):
+        return False
+    return bool(WRITE_MODES & set(mode)) or "+" in mode
 
 
 def _binary_write_mode(call: ast.Call) -> bool:
@@ -58,6 +95,8 @@ class DurabilityPass(Pass):
     codes = {
         "GL-D001": "bare binary write open() outside the owner modules",
         "GL-D002": "os.replace/rename without a parent-directory fsync",
+        "GL-D003": "manifest/watermark write bypassing the fenced "
+                   "conditional-put owner",
     }
 
     def run(self, ctx: AnalysisContext) -> list[Finding]:
@@ -118,4 +157,19 @@ class DurabilityPass(Pass):
                          f"{scope!r} — the rename is not durable until "
                          "the directory entry is (use object_store."
                          "_fsync_dir)")
+                fenced = FENCED_WRITE_OWNERS.get(mod.relpath)
+                if fenced is not None:
+                    patterns, owners = fenced
+                    hit = any(
+                        chain == p or chain.endswith("." + p)
+                        for p in patterns if p != "open"
+                    ) or ("open" in patterns and chain == "open"
+                          and _write_mode_open(node))
+                    if hit and scope_of(node) not in owners:
+                        emit("GL-D003", node, ("fenced-write",),
+                             f"manifest/watermark write ({chain}) outside "
+                             f"the fenced conditional-put owner(s) "
+                             f"{sorted(owners)} — plain writes bypass "
+                             "epoch fencing and can interleave two "
+                             "leaders' histories on shared storage")
         return findings

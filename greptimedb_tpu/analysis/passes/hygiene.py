@@ -168,6 +168,11 @@ KNOB_DOCS: dict[str, str] = {
     "GREPTIME_RPC_RETRIES": (
         "Retry budget for transient Flight RPC failures (backoff + "
         "jitter envelope)."),
+    "GREPTIME_S3_FENCING": (
+        "`off` disables leader-epoch fencing of manifest/watermark "
+        "writes on shared object storage (conditional puts under the "
+        "Metasrv-minted epoch; standalone regions never arm a fence "
+        "either way)."),
     "GREPTIME_SCAN_FORCE_LEXSORT": (
         "`1` forces the legacy global lexsort instead of the sorted-run "
         "merge (A/B bit-exactness harness)."),
@@ -200,6 +205,17 @@ KNOB_DOCS: dict[str, str] = {
     "GREPTIME_SCHEDULER_WORKERS": (
         "Scheduler worker pool size (default 1: the db lock serializes "
         "execution anyway)."),
+    "GREPTIME_SCRUB": (
+        "Online integrity scrubber: `auto` (default) arms the verified "
+        "background sweep for persistent data homes on scheduler idle "
+        "capacity; `on` starts sweeping immediately (standby nodes "
+        "scrub too); `off` disables (module never constructed)."),
+    "GREPTIME_SCRUB_BATCH": (
+        "Artifacts verified per scrubber idle tick (the preemption "
+        "granularity: interactive queries wait at most one batch)."),
+    "GREPTIME_SCRUB_INTERVAL_S": (
+        "Pause between completed scrub sweeps (a sweep itself is paced "
+        "by idle ticks and can take much longer)."),
     "GREPTIME_SELF_MONITOR": (
         "`on` starts the self-monitoring loop (own spans/metrics "
         "exported into own tables); module never imported when unset."),
@@ -227,6 +243,11 @@ KNOB_DOCS: dict[str, str] = {
     "GREPTIME_WAL_LINGER_MS": (
         "WAL group-commit linger: how long a contended leader holds the "
         "batch open for joiners (0 = flush immediately)."),
+    "GREPTIME_WAL_REPLICAS": (
+        "Shared-log broker replication factor (default 1 = legacy "
+        "single copy; 3 = majority-quorum appends with read-repair — "
+        "replay survives the loss or corruption of any minority of "
+        "copies)."),
 }
 
 

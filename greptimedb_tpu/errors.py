@@ -136,6 +136,14 @@ class StorageError(GreptimeError):
     status_code = StatusCode.STORAGE_UNAVAILABLE
 
 
+class FencedError(StorageError):
+    """A conditional (epoch-fenced) object-store write lost its CAS: a
+    newer leader epoch owns the target, or the object already exists.
+    The fenced-out writer must STOP — retrying or falling back to a
+    plain write would interleave two leaders' histories on shared
+    storage (split brain)."""
+
+
 class ResourcesExhausted(GreptimeError):
     status_code = StatusCode.RUNTIME_RESOURCES_EXHAUSTED
 

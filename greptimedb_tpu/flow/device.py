@@ -776,36 +776,19 @@ class FlowDeviceRuntime:
             return False
 
     def _pump_device(self, task, st, regions) -> bool:
+        from greptimedb_tpu.flow.pump import drain_append_log
+
         if task.needs_backfill:
             self.reseed(task, st, "seed")
             return True
-        for region in regions:
-            rid = region.region_id
-            pos = st.positions.get(rid)
-            if pos is None:
-                # a region that appeared after the seed (repartition):
-                # its rows were never folded — reseed
-                self.reseed(task, st, "new_region")
-                return True
-            chunks = region.append_chunks_since(pos)
-            if chunks is None:
-                self.reseed(task, st, "trimmed")
-                return True
-            wm = st.folded.get(rid, -1)
-            for chunk in chunks:
-                seq = int(chunk[SEQ][0])
-                pos += 1
-                if seq <= wm:
-                    continue  # covered by the seed scan
-                if seq != wm + 1:
-                    # an unlogged write (upsert/delete) holds this seq:
-                    # incremental state can no longer be trusted
-                    self.reseed(task, st, "gap")
-                    return True
-                self.fold_chunk(task, st, region, chunk)
-                wm = seq
-                st.folded[rid] = wm
-            st.positions[rid] = pos
+        # the SHARED exact-watermark consumer (flow/pump.py): one copy
+        # of the append-log discipline for this and the host pump
+        reason = drain_append_log(
+            regions, st.positions, st.folded,
+            lambda region, chunk: self.fold_chunk(
+                task, st, region, chunk))
+        if reason is not None:
+            self.reseed(task, st, reason)
         return True
 
     def _advance_batching(self, task, regions) -> None:

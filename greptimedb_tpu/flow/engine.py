@@ -343,10 +343,12 @@ class FlowEngine:
         self._pump_host_stream(task)
 
     def _pump_host_stream(self, task: FlowTask) -> None:
-        """The host dict-of-partials fold, fed from the append log like
-        the device path so its checkpoints carry the same exact
-        watermark (device-ineligible / quota-rejected flows)."""
-        from greptimedb_tpu.storage.memtable import SEQ
+        """The host dict-of-partials fold, fed from the append log by
+        the SHARED exact-watermark consumer (flow/pump.py — one copy of
+        the discipline for this and the device pump) so its checkpoints
+        carry the same exact watermark (device-ineligible /
+        quota-rejected flows)."""
+        from greptimedb_tpu.flow.pump import drain_append_log
 
         try:
             regions = self.db._regions_of(task.source_table)
@@ -358,30 +360,12 @@ class FlowEngine:
         if task.needs_backfill:
             self._host_reseed(task, regions)
             return
-        for region in regions:
-            rid = region.region_id
-            pos = task.positions.get(rid)
-            if pos is None:
-                self._host_reseed(task, regions)
-                return
-            chunks = region.append_chunks_since(pos)
-            if chunks is None:
-                self._host_reseed(task, regions)
-                return
-            wm = task.watermark.get(rid, -1)
-            for chunk in chunks:
-                seq = int(chunk[SEQ][0])
-                pos += 1
-                if seq <= wm:
-                    continue
-                if seq != wm + 1:
-                    # an unlogged write (upsert/delete) holds this seq
-                    self._host_reseed(task, regions)
-                    return
-                self._host_fold_chunk(task, region, chunk)
-                wm = seq
-            task.watermark[rid] = wm
-            task.positions[rid] = pos
+        reason = drain_append_log(
+            regions, task.positions, task.watermark,
+            lambda region, chunk: self._host_fold_chunk(
+                task, region, chunk))
+        if reason is not None:
+            self._host_reseed(task, regions)
 
     def _host_fold_chunk(self, task: FlowTask, region, chunk) -> None:
         """Fold one append-log chunk through the legacy streaming path

@@ -27,6 +27,26 @@ from greptimedb_tpu.utils.telemetry import REGISTRY
 
 REGION_LEASE_MS = 20_000.0
 
+
+def mint_epoch(kv: KvBackend, region_id: int) -> int:
+    """Mint the next leader epoch for a region (shared by Metasrv and
+    the dist frontend's initial placement — EVERY leadership grant must
+    carry one, or the first-generation leader would run unfenced and a
+    later failover's zombie could write epoch-less).  A CAS loop, not
+    read-modify-write: two concurrent grants (reconciliation racing a
+    placement) minting the SAME epoch would defeat every fence check —
+    equal epochs pass as 'our own claim'."""
+    key = f"__meta/epoch/region/{region_id}"
+    for _ in range(64):
+        raw = kv.get(key)
+        cur = 0 if raw is None else int(json.loads(raw).get("epoch", 0))
+        epoch = cur + 1
+        if kv.compare_and_put(key, raw,
+                              json.dumps({"epoch": epoch}).encode()):
+            return epoch
+    raise GreptimeError(
+        f"region {region_id}: epoch mint kept losing its CAS")
+
 # Replication lag of follower replicas, published from heartbeats (ISSUE 6:
 # the bounded-staleness read contract reads these through the kv follower
 # routes; /metrics shows the same numbers so the two can never disagree).
@@ -187,6 +207,12 @@ class Datanode:
             self.roles[rid] = role
             if self.roles[rid] == "leader":
                 self.lease_until_ms[rid] = now_ms + REGION_LEASE_MS
+                if instr.get("epoch") is not None:
+                    # storage-level fencing (ISSUE 15): the minted epoch
+                    # claims the shared manifest/broker write surfaces,
+                    # so a fenced-out predecessor's delayed write fails
+                    # loudly even if its clock-based lease lies to it
+                    self.engine.regions[rid].install_fence(instr["epoch"])
             return {"ok": True}
         if kind == "close_region":
             region = self.engine.regions.pop(rid, None)
@@ -212,6 +238,8 @@ class Datanode:
             region.catch_up(take_ownership=True)
             self.roles[rid] = "leader"
             self.lease_until_ms[rid] = now_ms + REGION_LEASE_MS
+            if instr.get("epoch") is not None:
+                region.install_fence(instr["epoch"])
             return {"ok": True}
         if kind == "flush_region":
             region = self.engine.regions.get(rid)
@@ -284,6 +312,15 @@ class Metasrv:
         for k, v in self.kv.range("__meta/route/region/"):
             out[int(k.rsplit("/", 1)[-1])] = json.loads(v)["node"]
         return out
+
+    # ---- leader epochs (storage-level fencing, ISSUE 15) ---------------
+    def mint_epoch(self, region_id: int) -> int:
+        """Mint the next leader epoch for a region — one per leadership
+        grant (open/failover/migration-upgrade).  The new leader claims
+        shared-storage write surfaces under it (Region.install_fence),
+        so a fenced-out predecessor's delayed manifest delta or broker
+        append fails loudly instead of forking history."""
+        return mint_epoch(self.kv, region_id)
 
     # ---- follower routes (read replicas) -------------------------------
     # Follower placement + freshness live in the kv store next to the

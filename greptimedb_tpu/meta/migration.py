@@ -187,10 +187,17 @@ class RegionMigrationProcedure(Procedure):
             # shared) manifest and replays the WAL tail; an already-open
             # follower runs a full ownership catch-up before leadership
             # (cluster.py open_region handler); an already-leader target
-            # (resume after crash) is a no-op
+            # (resume after crash) is a no-op.  The leader EPOCH is
+            # minted once and journaled (a resumed phase re-claims the
+            # SAME epoch — minting twice would fence our own target):
+            # the target claims shared-storage write surfaces under it,
+            # so the fenced-out source's delayed writes fail loudly
+            # (ISSUE 15 — the phi-false-positive split-brain backstop)
+            if s.get("epoch") is None:
+                s["epoch"] = metasrv.mint_epoch(rid)
             dst.handle_instruction(
                 {"kind": "open_region", "region_id": rid, "role": "leader",
-                 "schema": s.get("schema")}, now)
+                 "schema": s.get("schema"), "epoch": s["epoch"]}, now)
             s["phase"] = "update_metadata"
             return Status.executing()
 

@@ -79,14 +79,18 @@ def _reconcile_region(ms, rid: int, schema, now_ms: float) -> list[str]:
         routed = new
 
     if routed not in hosts:
-        instr = {"kind": "open_region", "region_id": rid, "role": "leader"}
+        instr = {"kind": "open_region", "region_id": rid, "role": "leader",
+                 "epoch": ms.mint_epoch(rid)}
         if schema is not None:
             instr["schema"] = schema.to_dict()
         ms.datanodes[routed].handle_instruction(instr, now_ms)
         fixes.append(f"region {rid}: opened as leader on node {routed}")
     elif hosts[routed] != "leader":
+        # promotion is a leadership grant: mint, so the demoted stray
+        # leaders below are storage-fenced, not just role-flipped
         ms.datanodes[routed].handle_instruction(
-            {"kind": "upgrade_region", "region_id": rid}, now_ms)
+            {"kind": "upgrade_region", "region_id": rid,
+             "epoch": ms.mint_epoch(rid)}, now_ms)
         fixes.append(f"region {rid}: promoted on node {routed}")
 
     for nid in leaders:

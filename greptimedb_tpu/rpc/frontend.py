@@ -188,12 +188,19 @@ class DistFrontend:
                     if not self._node_dead(n)]
         if not node_ids:
             raise GreptimeError("no alive datanodes for region placement")
+        from greptimedb_tpu.meta.cluster import mint_epoch
+
         for rid in info.region_ids:
             node = node_ids[self._rr % len(node_ids)]
             self._rr += 1
+            # the FIRST leadership grant mints an epoch too (ISSUE 15):
+            # without it the original leader runs unfenced, and after a
+            # phi-false-positive failover its epoch-less writes would
+            # bypass the new leader's fence
             self.datanodes[node].handle_instruction(
                 {"kind": "open_region", "region_id": rid, "role": "leader",
-                 "schema": schema.to_dict()}, 0.0,
+                 "schema": schema.to_dict(),
+                 "epoch": mint_epoch(self.kv, rid)}, 0.0,
             )
             self.set_region_route(rid, node)
         return QueryResult([], [])

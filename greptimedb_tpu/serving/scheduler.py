@@ -94,6 +94,14 @@ def background_should_yield() -> bool:
     )
 
 
+def interactive_waiting() -> int:
+    """Interactive queries currently queued or executing — idle-capacity
+    consumers that are NOT scheduler workers (the integrity scrubber's
+    preemption check, storage/scrubber.py) skip their tick while this is
+    nonzero, so foreground latency never pays for background verify."""
+    return _interactive_waiting
+
+
 def _install_scan_hook() -> None:
     from greptimedb_tpu.storage import scan as _scan
 
@@ -252,13 +260,17 @@ class QueryScheduler:
         with self._cond:
             self._cond.notify_all()
 
-    def add_idle_hook(self, fn) -> None:
+    def add_idle_hook(self, fn, kick: bool = True) -> None:
         """Compose ``fn`` into the idle-capacity hook.  Multiple
-        background consumers (AOT warmup, flow checkpoint drain) share
-        the single ``idle_hook`` slot through a dispatcher that calls
-        each member per tick, drops drained/failing members, and reports
-        drained (False) only when none remain — preserving the worker
-        loop's unhook-on-False contract for a lone hook."""
+        background consumers (AOT warmup, flow checkpoint drain, the
+        integrity scrubber) share the single ``idle_hook`` slot through
+        a dispatcher that calls each member per tick, drops
+        drained/failing members, and reports drained (False) only when
+        none remain — preserving the worker loop's unhook-on-False
+        contract for a lone hook.  ``kick=False`` registers without
+        starting/waking the worker pool: the hook begins ticking when
+        the instance actually serves traffic (embedded/test instances
+        that never submit never spin workers for it)."""
         with self._cond:
             cur = self.idle_hook
             if cur is None:
@@ -286,7 +298,8 @@ class QueryScheduler:
 
                 _multi._gl_hooks = hooks
                 self.idle_hook = _multi
-        self.kick_idle()
+        if kick:
+            self.kick_idle()
 
     def stop(self) -> None:
         with self._cond:

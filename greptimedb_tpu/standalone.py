@@ -577,6 +577,24 @@ class GreptimeDB(TableProvider):
                 # wake/start the workers: an idle standby node must
                 # drain its warmup queue without waiting for traffic
                 self.scheduler.kick_idle()
+        # online integrity scrubber (storage/scrubber.py, ISSUE 15): a
+        # low-priority verified sweep over cold SSTs / manifest files /
+        # WAL segments / grid snapshots / the S3 read cache on the
+        # scheduler's idle capacity, preempted by interactive queries.
+        # `auto` (default) arms it for persistent data homes but lets
+        # the worker pool start lazily with the first served query;
+        # `on` starts sweeping immediately (a standby node scrubs too).
+        self.scrubber = None
+        _sc = os.environ.get("GREPTIME_SCRUB", "auto").lower()
+        if (_sc not in ("off", "0", "false")
+                and self.scheduler is not None and not self.memory_mode):
+            from greptimedb_tpu.storage.scrubber import Scrubber
+
+            self.scrubber = Scrubber(
+                self.regions,
+                snapshot_dirs=[os.path.join(data_home, "grid_snap")])
+            self.scheduler.add_idle_hook(
+                self.scrubber.tick, kick=_sc in ("on", "1", "true"))
 
     def _flush_largest_memtable(self, needed_bytes: int) -> None:
         """Ingest-quota reclaimer: flush memtables largest-first until the

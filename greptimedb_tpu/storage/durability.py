@@ -43,6 +43,19 @@ M_REPAIRED = REGISTRY.counter(
     "Corruptions repaired, by store and repair source",
     labels=("store", "source"),
 )
+# Epoch fencing on shared object storage (ISSUE 15): claims are the
+# leadership handoffs minted by Metasrv; rejections are fenced-out
+# leaders stopped BEFORE they could fork history.
+M_FENCE_CLAIMS = REGISTRY.counter(
+    "greptime_fence_claims_total",
+    "Leader-epoch fence claims on shared storage",
+    labels=("outcome",),
+)
+M_FENCE_REJECTED = REGISTRY.counter(
+    "greptime_fence_rejected_total",
+    "Writes refused by epoch fencing, by write surface",
+    labels=("surface",),
+)
 
 
 class CorruptionError(StorageError):

@@ -34,18 +34,21 @@ manifest storage):
 from __future__ import annotations
 
 import json
+import os
 import zlib
 from dataclasses import dataclass, field
 
 from greptimedb_tpu.datatypes.schema import Schema
-from greptimedb_tpu.errors import StorageError
+from greptimedb_tpu.errors import FencedError, StorageError
 from greptimedb_tpu.storage.durability import (
     M_CORRUPTION,
+    M_FENCE_CLAIMS,
+    M_FENCE_REJECTED,
     M_QUARANTINED,
     ManifestCorruption,
     RegionQuarantined,
 )
-from greptimedb_tpu.storage.object_store import ObjectStore
+from greptimedb_tpu.storage.object_store import ObjectStore, content_etag
 from greptimedb_tpu.storage.sst import SstMeta
 from greptimedb_tpu.utils.chaos import CHAOS
 
@@ -53,6 +56,17 @@ CHECKPOINT_EVERY = 16
 
 _MAGIC = b"GTM1 "
 _QUARANTINE_MARKER = "QUARANTINED"
+_EPOCH_MARKER = "EPOCH"
+
+
+def fencing_enabled() -> bool:
+    """GREPTIME_S3_FENCING (default on): epoch-fenced conditional puts
+    for manifest/watermark writes on shared object storage.  Off = the
+    pre-fencing plain-write behavior everywhere (A/B twin); standalone
+    regions never arm a fence either way, so the single-node hot path is
+    untouched by the knob."""
+    return os.environ.get("GREPTIME_S3_FENCING", "on").lower() not in (
+        "off", "0", "false")
 
 _KNOWN_KINDS = frozenset(
     {"edit", "schema", "dicts", "reset_dicts", "truncate", "options",
@@ -175,6 +189,142 @@ class Manifest:
         self.version = 0
         self.state = ManifestState()
         self._actions_since_checkpoint = 0
+        # leader epoch this manifest writes under (None = unfenced, the
+        # standalone/local default).  Armed by set_fence at cluster
+        # open/failover/migration-upgrade; every subsequent write routes
+        # through _write's conditional-put discipline.
+        self.fence_epoch: int | None = None
+
+    # ---- epoch fencing (ISSUE 15) --------------------------------------
+    @property
+    def _epoch_path(self) -> str:
+        return f"{self.dir}/{_EPOCH_MARKER}"
+
+    def _read_epoch(self) -> tuple[int | None, bytes | None]:
+        """(epoch, raw bytes) of the shared EPOCH marker; (None, None)
+        when absent, (-1, raw) when unreadably corrupt (scrub repairs;
+        fencing decisions treat it as 'unknown', never as newer)."""
+        if not self.store.exists(self._epoch_path):
+            return None, None
+        try:
+            raw = self.store.read(self._epoch_path)
+        except StorageError:
+            return None, None  # deleted between exists and read
+        rec = _decode_file(raw)
+        if rec is None or "epoch" not in rec:
+            M_CORRUPTION.labels("manifest", "epoch").inc()
+            return -1, raw
+        return int(rec["epoch"]), raw
+
+    def set_fence(self, epoch: int) -> None:
+        """Claim the shared EPOCH marker for ``epoch`` and arm fencing:
+        every later commit/checkpoint verifies the marker and writes
+        deltas create-only, so a fenced-out leader's delayed write fails
+        loudly (FencedError) instead of forking history.  Claiming is
+        itself a CAS — two racing claimants resolve to the higher epoch,
+        and the loser raises here, before it ever writes a delta."""
+        epoch = int(epoch)
+        data = _encode_file({"epoch": epoch})
+        for _ in range(8):
+            cur, raw = self._read_epoch()
+            if cur is not None and cur > epoch:
+                M_FENCE_CLAIMS.labels("lost").inc()
+                raise FencedError(
+                    f"manifest {self.dir}: epoch {epoch} superseded by "
+                    f"{cur}; this leader is fenced out")
+            if cur == epoch:  # our own claim (crash-resume re-open)
+                self.fence_epoch = epoch
+                return
+            try:
+                if raw is None:
+                    self.store.write_if(self._epoch_path, data,
+                                        if_none_match=True)
+                else:
+                    self.store.write_if(self._epoch_path, data,
+                                        if_match=content_etag(raw))
+            except FencedError:
+                continue  # marker moved under us: re-read and re-decide
+            M_FENCE_CLAIMS.labels("won").inc()
+            self.fence_epoch = epoch
+            return
+        M_FENCE_CLAIMS.labels("lost").inc()
+        raise FencedError(
+            f"manifest {self.dir}: could not claim epoch {epoch} "
+            "(marker kept moving)")
+
+    def _verify_fence(self, surface: str) -> None:
+        """Raise FencedError when the shared EPOCH marker shows a newer
+        leader (called before every fenced write).  Covers the window
+        conditional-put alone cannot: after checkpoint GC deleted the
+        deltas, a zombie's create-only write would otherwise succeed
+        against the emptied version space (the ABA shape)."""
+        cur, _raw = self._read_epoch()
+        if cur is not None and self.fence_epoch is not None \
+                and cur > self.fence_epoch:
+            M_FENCE_REJECTED.labels(surface).inc()
+            raise FencedError(
+                f"manifest {self.dir}: write fenced out — epoch "
+                f"{self.fence_epoch} superseded by {cur} ({surface})")
+
+    def _write(self, path: str, data: bytes, *, create: bool = False,
+               surface: str = "manifest") -> None:
+        """THE manifest write path (lint GL-D003 owner: no manifest or
+        marker bytes reach the store except through here).  Unfenced
+        manifests write plainly — byte-for-byte the pre-fencing
+        behavior.  Fenced manifests verify the epoch marker first, and
+        version-keyed files (``create=True``: deltas) are create-only
+        CAS puts, so two leaders racing on one version resolve to one
+        winner."""
+        if self.fence_epoch is None:
+            # epoch-less writer backstop: if ANYONE has claimed an epoch
+            # on this manifest, an unfenced write is a pre-fencing
+            # zombie (its region opened before epochs were minted) and
+            # must refuse — epoch-less writes bypassing the fence would
+            # re-open the interleave.  Standalone manifests never have
+            # the marker: one existence probe per commit.
+            if fencing_enabled() and self.store.exists(self._epoch_path):
+                M_FENCE_REJECTED.labels(surface).inc()
+                raise FencedError(
+                    f"manifest {self.dir}: epoch-less write refused — "
+                    f"a leader epoch is claimed on this manifest "
+                    f"({surface}); this writer predates fencing")
+            self.store.write(path, data)
+            return
+        self._verify_fence(surface)
+        if not create:
+            self.store.write(path, data)
+            return
+        try:
+            self.store.write_if(path, data, if_none_match=True)
+            return
+        except FencedError:
+            pass
+        # conflict under OUR verified epoch: nobody else may write here,
+        # so the existing object is this leader's own orphaned earlier
+        # attempt (the s3.cas crash window — the CAS landed remotely but
+        # the ack never came back).  Identical bytes: the commit already
+        # landed.  Different bytes: clobber the orphan exactly like the
+        # plain-write path always has (it was never applied or acked) —
+        # via a conditional REPLACE keyed on the orphan's etag, so a new
+        # leader claiming the epoch and touching this version between
+        # our verify and the write still loses us the CAS (FencedError)
+        # instead of us silently overwriting its history.
+        self._verify_fence(surface)  # a REAL fence still raises here
+        try:
+            existing = self.store.read(path)
+        except StorageError:
+            existing = None
+        if existing is None:
+            # the orphan vanished between the conflict and the read —
+            # only another writer deletes manifest files; stay loud
+            M_FENCE_REJECTED.labels(surface).inc()
+            raise FencedError(
+                f"manifest {self.dir}: {path} changed under epoch "
+                f"{self.fence_epoch} ({surface})")
+        if _decode_file(existing) is not None \
+                and _decode_file(existing) == _decode_file(data):
+            return
+        self.store.write_if(path, data, if_match=content_etag(existing))
 
     # ---- open/replay ----------------------------------------------------
     @staticmethod
@@ -271,10 +421,13 @@ class Manifest:
 
     def quarantine_region(self, reason: str) -> None:
         """Uncovered loss: move suspects aside AND mark the region so
-        every future open fails loudly until an operator intervenes."""
-        self.store.write(
+        every future open fails loudly until an operator intervenes.
+        Fence-checked like any manifest write — a fenced-out zombie must
+        not poison the new leader's region with a stale marker."""
+        self._write(
             f"{self.dir}/{_QUARANTINE_MARKER}",
-            _encode_file({"reason": reason, "version": self.version}))
+            _encode_file({"reason": reason, "version": self.version}),
+            surface="quarantine")
 
     @property
     def exists(self) -> bool:
@@ -292,9 +445,11 @@ class Manifest:
         # persist FIRST, apply on success: a failed write must leave the
         # in-memory state at the on-disk version, or the next commit
         # would write version+2 over a hole (the open-time gap check
-        # above would then refuse the whole manifest)
-        self.store.write(f"{self.dir}/delta-{self.version + 1:020d}.json",
-                         data)
+        # above would then refuse the whole manifest).  Fenced manifests
+        # write create-only: two split-brain leaders racing on this
+        # version resolve to ONE winner, the loser raises FencedError
+        self._write(f"{self.dir}/delta-{self.version + 1:020d}.json",
+                    data, create=True, surface="delta")
         if after is not None:
             raise after
         self.state.apply(action)
@@ -310,7 +465,11 @@ class Manifest:
         after = None
         if CHAOS.enabled:  # durability-boundary crash point + data faults
             data, after = CHAOS.filter_io("manifest.checkpoint", data)
-        self.store.write(path, data)
+        # fence-verified overwrite (not create-only: a crash between a
+        # landed checkpoint write and its read-back verification retries
+        # the SAME version — and a loser's same-version checkpoint is
+        # byte-deterministic from the delta chain both leaders loaded)
+        self._write(path, data, surface="checkpoint")
         if after is not None:
             raise after
         # read-back verify BEFORE GC: the deltas this checkpoint

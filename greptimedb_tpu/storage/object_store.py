@@ -14,14 +14,42 @@ this path with the zero-overhead-disabled ``CHAOS.enabled`` guard.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import tempfile
+import threading
 
+from greptimedb_tpu.errors import FencedError
 from greptimedb_tpu.utils.chaos import CHAOS
+
+
+def content_etag(data: bytes) -> str:
+    """ETag of an object's content — md5 hex, matching what S3 returns
+    for single-part PUTs, so the same token compares across backends."""
+    return hashlib.md5(data).hexdigest()
 
 
 class ObjectStore:
     def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    # ---- conditional put (epoch fencing, ISSUE 15) --------------------
+    # ``write_if`` is the fenced write surface: exactly one of
+    # ``if_none_match`` (create-only: fail if the object exists — how two
+    # split-brain leaders racing on one delta version resolve to ONE
+    # winner) or ``if_match=<etag>`` (replace-only-if-unchanged: how an
+    # epoch marker advances without clobbering a newer claim).  A lost
+    # CAS raises FencedError; the caller must treat it as a fencing
+    # event, never retry into a plain write.
+    def write_if(self, path: str, data: bytes, *,
+                 if_match: str | None = None,
+                 if_none_match: bool = False) -> None:
+        raise NotImplementedError
+
+    def head(self, path: str) -> dict | None:
+        """Object metadata without the body: ``{"etag", "length"}`` or
+        None when the object does not exist.  The scrubber's cache
+        revalidation and the CAS surface both key off the etag."""
         raise NotImplementedError
 
     def read(self, path: str) -> bytes:
@@ -77,10 +105,28 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+# CAS serialization for disk-backed stores: one lock per REAL root path,
+# shared by every FsObjectStore instance over that root in this process
+# (two engines sharing a data home — the split-brain test shape — must
+# contend on one lock, not two instance locks).
+_FS_CAS_LOCKS: dict[str, threading.Lock] = {}
+_FS_CAS_LOCKS_GUARD = threading.Lock()
+
+
+def _cas_lock_for(root: str) -> threading.Lock:
+    key = os.path.realpath(root)
+    with _FS_CAS_LOCKS_GUARD:
+        lock = _FS_CAS_LOCKS.get(key)
+        if lock is None:
+            lock = _FS_CAS_LOCKS[key] = threading.Lock()
+        return lock
+
+
 class FsObjectStore(ObjectStore):
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
+        self._cas_lock = _cas_lock_for(self.root)
 
     def _abs(self, path: str) -> str:
         p = os.path.normpath(os.path.join(self.root, path.lstrip("/")))
@@ -118,6 +164,41 @@ class FsObjectStore(ObjectStore):
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
+
+    def write_if(self, path: str, data: bytes, *,
+                 if_match: str | None = None,
+                 if_none_match: bool = False) -> None:
+        if if_none_match == (if_match is not None):
+            raise ValueError("write_if needs exactly one of "
+                             "if_match / if_none_match")
+        p = self._abs(path)
+        with self._cas_lock:
+            exists = os.path.exists(p)
+            if if_none_match:
+                if exists:
+                    raise FencedError(
+                        f"conditional put lost: {path} already exists")
+            else:
+                if not exists:
+                    raise FencedError(
+                        f"conditional put lost: {path} is gone "
+                        f"(expected etag {if_match})")
+                with open(p, "rb") as f:
+                    cur = content_etag(f.read())
+                if cur != if_match:
+                    raise FencedError(
+                        f"conditional put lost: {path} etag {cur} != "
+                        f"expected {if_match}")
+            self.write(path, data)
+
+    def head(self, path: str) -> dict | None:
+        p = self._abs(path)
+        try:
+            with open(p, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        return {"etag": content_etag(data), "length": len(data)}
 
     def read(self, path: str) -> bytes:
         with open(self._abs(path), "rb") as f:
@@ -166,9 +247,41 @@ class MemoryObjectStore(ObjectStore):
 
     def __init__(self):
         self._data: dict[str, bytes] = {}
+        self._cas_lock = threading.Lock()
 
     def write(self, path: str, data: bytes) -> None:
         self._data[path.lstrip("/")] = bytes(data)
+
+    def write_if(self, path: str, data: bytes, *,
+                 if_match: str | None = None,
+                 if_none_match: bool = False) -> None:
+        if if_none_match == (if_match is not None):
+            raise ValueError("write_if needs exactly one of "
+                             "if_match / if_none_match")
+        key = path.lstrip("/")
+        with self._cas_lock:
+            cur = self._data.get(key)
+            if if_none_match:
+                if cur is not None:
+                    raise FencedError(
+                        f"conditional put lost: {path} already exists")
+            else:
+                if cur is None:
+                    raise FencedError(
+                        f"conditional put lost: {path} is gone "
+                        f"(expected etag {if_match})")
+                got = content_etag(cur)
+                if got != if_match:
+                    raise FencedError(
+                        f"conditional put lost: {path} etag {got} != "
+                        f"expected {if_match}")
+            self._data[key] = bytes(data)
+
+    def head(self, path: str) -> dict | None:
+        data = self._data.get(path.lstrip("/"))
+        if data is None:
+            return None
+        return {"etag": content_etag(data), "length": len(data)}
 
     def read(self, path: str) -> bytes:
         return self._data[path.lstrip("/")]

@@ -162,6 +162,9 @@ class Region:
         # durability.resync_from_log_store / resync_from_peer_wal.
         self.repair_source = None
         self.wal_resync = None
+        # leader epoch this region's shared-storage writes are fenced
+        # under (ISSUE 15); None = unfenced (standalone / follower)
+        self.fence_epoch: int | None = None
 
     # ------------------------------------------------------------------
     @property
@@ -989,6 +992,178 @@ class Region:
         self.generation += 1
         self._mark_structure_change()
 
+    def install_fence(self, epoch: int) -> None:
+        """Arm leader-epoch fencing (ISSUE 15) on every shared-storage
+        write surface this region owns: manifest deltas/checkpoints go
+        through conditional puts under the epoch claim, and remote-WAL
+        appends/watermark advances carry the epoch to the broker.  The
+        epoch is minted by Metasrv at open/failover/migration-upgrade;
+        a delayed write from a fenced-out predecessor then fails loudly
+        (FencedError) instead of forking history.  No-op when
+        GREPTIME_S3_FENCING=off."""
+        from greptimedb_tpu.storage.manifest import fencing_enabled
+
+        if not fencing_enabled():
+            return
+        self.manifest.set_fence(epoch)
+        set_wal = getattr(self.wal, "set_fence", None)
+        if set_wal is not None:
+            set_wal(epoch)
+        self.fence_epoch = int(epoch)
+
+    # ---- proactive integrity (ISSUE 15, driven by storage/scrubber.py) -
+    def scrub_wal(self) -> dict:
+        """Verify every WAL segment NOW, while every acked row is still
+        recoverable, instead of letting the next crash's replay find the
+        rot.  Damage below the flushed floor just drops (rows live in
+        SSTs; bytes preserved in sidecars).  A lost acked range above
+        the floor resyncs from ``wal_resync`` (remote WAL / follower
+        replica) and re-logs durably; with no covering source the region
+        FLUSHES instead — the live memtable still holds every acked row,
+        so advancing the durable floor past the hole repairs durability
+        with zero loss (the option a crash-time replay no longer has)."""
+        wal = self.wal
+        if not isinstance(wal, FileLogStore):
+            return {"damage": 0, "repaired": 0, "flushed": False}
+        with self._write_lock:
+            damages = wal.verify()
+            if not damages:
+                return {"damage": 0, "repaired": 0, "flushed": False}
+            floor = self.manifest.state.flushed_seq + 1
+            acked_hi = self.next_seq - 1
+            holes: list[tuple[int, int]] = []
+            for d in damages:
+                if d.kind == "torn_tail":
+                    # on a LIVE region the tail is acked data, never
+                    # crash debris: everything up to next_seq-1 was acked
+                    lo = (d.prev_seq + 1) if d.prev_seq is not None else 1
+                    hi = acked_hi
+                else:
+                    r = d.lost_range()
+                    if r is None:
+                        continue  # garbage between consecutive sequences
+                    lo, hi = r
+                    hi = acked_hi if hi is None else hi
+                lo = max(lo, floor)
+                if hi < lo:
+                    continue  # fully below the floor: already in SSTs
+                holes.append((lo, hi))
+            fetched: list[tuple[int, bytes]] = []
+            covered = bool(holes) and self.wal_resync is not None
+            if covered:
+                for lo, hi in holes:
+                    got = sorted(self.wal_resync(lo, hi))
+                    if {s for s, _ in got} != set(range(lo, hi + 1)):
+                        covered = False
+                        break
+                    fetched.extend(got)
+            # SECURE the recovery durably FIRST, drop the damage LAST:
+            # a crash anywhere in between must leave the corruption
+            # loud (triaged at the next open), never a silently-clean
+            # log missing acked rows (the _resync_wal_holes ordering)
+            repaired = 0
+            flushed = False
+            if not holes:
+                wal.drop_damage(damages)  # sub-floor debris only
+            elif covered:
+                if any(d.kind == "torn_tail" for d in damages):
+                    # re-logging INTO a damaged tail would be destroyed
+                    # by the tail truncation below (and truncating first
+                    # would silently clean an unrecovered hole): roll to
+                    # a fresh segment, so the re-logged records survive
+                    # and interim crashes replay the damage as interior
+                    # (valid records follow) — still loud, still triaged
+                    wal._roll()
+                for s, p in fetched:
+                    wal.append(s, p)  # re-log durably
+                wal.drop_damage(damages)
+                repaired = len(fetched)
+                M_REPAIRED.labels("wal", "scrub_resync").inc(repaired)
+            else:
+                # flush advances the durable floor past the hole (the
+                # memtable holds every acked row); only then is the
+                # damage mere sub-floor debris safe to drop
+                self._flush_locked()
+                wal.drop_damage(damages)
+                flushed = True
+                M_REPAIRED.labels("wal", "scrub_flush").inc()
+            return {"damage": len(damages), "repaired": repaired,
+                    "flushed": flushed}
+
+    def scrub_manifest(self) -> dict:
+        """Verify every on-disk manifest file against its CRC envelope.
+        A corrupt file is quarantined, and — because the LIVE in-memory
+        state supersedes the whole on-disk chain — repaired by forcing a
+        fresh read-back-verified checkpoint, whose GC then collapses the
+        damaged history.  The restart that would otherwise have tripped
+        over the rot (possibly quarantining the region) now opens from
+        the clean checkpoint."""
+        from greptimedb_tpu.storage.durability import M_CORRUPTION
+        from greptimedb_tpu.storage.manifest import (
+            _decode_file, _encode_file,
+        )
+
+        checked = 0
+        with self._write_lock:
+            corrupt: list[str] = []
+            epoch_bad = False
+            for p in self.store.list(self.manifest.dir):
+                if "/quarantine/" in p:
+                    # moved-aside corpses: already flagged, preserved,
+                    # never live — re-scrubbing them would re-quarantine
+                    # (a self-rename that DELETES the bytes on rename-
+                    # less remote stores) and alert forever
+                    continue
+                fn = p.rsplit("/", 1)[-1]
+                is_epoch = fn == "EPOCH"
+                if not (fn.startswith("checkpoint-")
+                        or fn.startswith("delta-") or is_epoch):
+                    continue
+                try:
+                    raw = self.store.read(p)
+                except Exception:  # noqa: BLE001 — vanished under GC
+                    continue
+                checked += 1
+                if _decode_file(raw) is None:
+                    M_CORRUPTION.labels("manifest", "scrub").inc()
+                    if is_epoch:
+                        epoch_bad = True
+                    else:
+                        corrupt.append(p)
+            if epoch_bad and self.manifest.fence_epoch is not None:
+                # rewrite the epoch marker from the armed fence — a
+                # rotted marker must not degrade fencing to "unknown"
+                # forever.  CAS on the CORRUPT bytes' etag: if another
+                # leader (re)claimed between our read and this write,
+                # the replace loses instead of rolling its claim back.
+                from greptimedb_tpu.errors import FencedError
+                from greptimedb_tpu.storage.object_store import (
+                    content_etag,
+                )
+
+                _ep, raw = self.manifest._read_epoch()
+                if raw is not None and _decode_file(raw) is None:
+                    try:
+                        self.store.write_if(
+                            self.manifest._epoch_path,
+                            _encode_file(
+                                {"epoch": self.manifest.fence_epoch}),
+                            if_match=content_etag(raw))
+                        M_REPAIRED.labels("manifest", "scrub_epoch").inc()
+                    except FencedError:
+                        pass  # someone else repaired/reclaimed: theirs wins
+            if not corrupt:
+                return {"checked": checked, "corrupt": 1 if epoch_bad
+                        else 0}
+            self.manifest.quarantine_files(corrupt)
+            # the live state is the authority: a fresh verified
+            # checkpoint re-establishes clean on-disk history and GCs
+            # whatever the damaged versions still covered
+            self.manifest.checkpoint()
+            M_REPAIRED.labels("manifest", "scrub_checkpoint").inc()
+            return {"checked": checked,
+                    "corrupt": len(corrupt) + (1 if epoch_bad else 0)}
+
     def catch_up(self, take_ownership: bool = False) -> None:
         """Re-sync this region from shared storage (follower sync, leader
         upgrade after migration — reference handle_catchup.rs): reload the
@@ -1022,6 +1197,19 @@ class Region:
                 mc.manifest.quarantine_files(mc.bad_files)
             M_REPAIRED.labels("manifest", "wal_replay").inc()
             self.manifest = mc.manifest
+        if self.fence_epoch is not None:
+            # the reopened Manifest object starts unfenced: re-arm the
+            # claim this region already holds (idempotent re-claim).  A
+            # SUPERSEDED claim (this node was demoted and is now being
+            # re-promoted under a NEWER minted epoch) must not wedge the
+            # promotion: drop the stale arm — the grant handler installs
+            # the new epoch right after this catch-up.
+            from greptimedb_tpu.errors import FencedError
+
+            try:
+                self.manifest.set_fence(self.fence_epoch)
+            except FencedError:
+                self.fence_epoch = None
         state = self.manifest.state
         # adopt the manifest schema FIRST: the leader may have added tag
         # columns online (add_tag_column), and encoders built from the stale

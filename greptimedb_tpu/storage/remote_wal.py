@@ -10,20 +10,38 @@ write-ahead log lives on shared infrastructure — when a node dies, its
 regions open elsewhere and replay from the shared log; nothing on the
 dead machine is needed.
 
-``SharedLogBroker`` stands in for the Kafka cluster: a directory on
-shared storage holding one segmented CRC-checked log per topic (reusing
-the FileLogStore format), with per-region low watermarks driving
-whole-segment pruning.  Entries are envelopes of
-(region_id, region_sequence, payload) so multiple regions can multiplex
-one topic (the reference's WalEntryDistributor demux,
+``SharedLogBroker`` stands in for the Kafka cluster: one segmented
+CRC-checked log per topic (reusing the FileLogStore format), with
+per-region low watermarks driving whole-segment pruning.  Entries are
+envelopes of (region_id, region_sequence, payload) so multiple regions
+can multiplex one topic (the reference's WalEntryDistributor demux,
 src/mito2/src/wal/).  ``RemoteLogStore`` adapts one (broker, topic,
 region) to the LogStore interface Region already consumes — switching a
 region between local and remote WAL is a construction-time choice.
 
+Broker-side replication (ISSUE 15, the Kafka replication-factor analog):
+``GREPTIME_WAL_REPLICAS=N`` (or the ``replicas`` argument) keeps N
+copies of every topic — replica 0 in the legacy layout, replicas 1..N-1
+under ``.replica<i>/`` — with **acked-quorum appends** (a record is
+durable once ⌈(N+1)/2⌉ replicas fsynced it; a minority of failures is
+counted, not fatal) and **read-repair** on replay (a replica missing
+offsets the others hold — an earlier failed append, or interior CRC
+damage triaged by the segment scanner — is backfilled from a healthy
+donor and its damaged spans healed).  Losing or corrupting any single
+copy therefore never loses an acked record: ``RemoteLogStore.replay``
+serves the union of the surviving quorum.
+
 Single-writer discipline: a topic's append side is the region leader
-(regions default to one topic each); readers always replay with
-repair=False.  A real multi-broker deployment would replace this class
-with a networked client — the interface is the contract.
+(regions default to one topic each); follower readers always replay
+with repair=False (no truncation, no read-repair — only the append
+owner mutates broker state).  **Epoch fencing**: the append owner may
+arm a leader epoch (``RemoteLogStore.set_fence``, minted by Metasrv at
+open/failover/upgrade); appends and watermark advances carrying an
+epoch older than the recorded claim raise FencedError — a fenced-out
+zombie's write is REFUSED (its client sees the failure) instead of
+silently acked into a forked history.  A real multi-broker deployment
+would replace this class with a networked client — the interface is
+the contract.
 """
 
 from __future__ import annotations
@@ -33,24 +51,62 @@ import os
 import struct
 import threading
 
+from greptimedb_tpu.errors import FencedError, StorageError
+from greptimedb_tpu.storage.durability import M_FENCE_REJECTED
 from greptimedb_tpu.storage.object_store import _fsync_dir
 from greptimedb_tpu.storage.wal import FileLogStore, LogStore
+from greptimedb_tpu.utils.telemetry import REGISTRY
 
 _ENV = struct.Struct("<QQ")  # region_id, region sequence
+
+M_BROKER_APPEND = REGISTRY.counter(
+    "greptime_broker_replica_append_total",
+    "Per-replica broker append outcomes (quorum ack tolerates a "
+    "minority of failures)",
+    labels=("outcome",),
+)
+M_BROKER_QUORUM_FAIL = REGISTRY.counter(
+    "greptime_broker_quorum_failures_total",
+    "Broker appends that failed to reach a durable quorum (surfaced to "
+    "the writer, nothing acked)",
+)
+M_BROKER_READ_REPAIR = REGISTRY.counter(
+    "greptime_broker_read_repair_total",
+    "Records backfilled into a lagging/corrupt broker replica from a "
+    "healthy donor during owner replay",
+)
+
+
+def default_replicas() -> int:
+    """GREPTIME_WAL_REPLICAS (default 1 = the unreplicated legacy
+    layout; 3 = Kafka-style majority-quorum replication)."""
+    try:
+        return max(1, int(os.environ.get("GREPTIME_WAL_REPLICAS", "1")))
+    except ValueError:
+        return 1
 
 
 class SharedLogBroker:
     """File-backed shared log service (the 'Kafka cluster')."""
 
-    def __init__(self, root_dir: str, topics_per_node: int | None = None):
+    def __init__(self, root_dir: str, topics_per_node: int | None = None,
+                 replicas: int | None = None):
         self.root = root_dir
         os.makedirs(root_dir, exist_ok=True)
         # None → one topic per region (safe for multi-process writers);
         # an int enables shared-topic multiplexing (single process)
         self.topics_per_node = topics_per_node
-        self._logs: dict[str, FileLogStore] = {}
+        self.replicas = default_replicas() if replicas is None else max(
+            1, int(replicas))
+        self.quorum = self.replicas // 2 + 1
+        self._logs: dict[str, list[FileLogStore | None]] = {}
         self._offsets: dict[str, int] = {}
         self._lock = threading.Lock()
+        # fencing state per topic: {"<rid>": epoch} mirror of the
+        # watermark marker's "_epoch" record, plus the marker mtime it
+        # was read at (cross-process claims re-read on mtime change)
+        self._epochs: dict[str, dict] = {}
+        self._epochs_mtime: dict[str, float] = {}
 
     # ---- topology ------------------------------------------------------
     def topic_for(self, region_id: int) -> str:
@@ -58,61 +114,307 @@ class SharedLogBroker:
             return f"region_{region_id}"
         return f"shared_{region_id % self.topics_per_node}"
 
-    def _log(self, topic: str) -> FileLogStore:
-        log = self._logs.get(topic)
-        if log is None:
-            log = FileLogStore(os.path.join(self.root, topic))
-            self._logs[topic] = log
+    def _replica_dir(self, topic: str, i: int) -> str:
+        # replica 0 keeps the legacy single-copy layout, so raising the
+        # replication factor on an existing broker adopts the old data
+        # as replica 0 and read-repair backfills the new copies
+        if i == 0:
+            return os.path.join(self.root, topic)
+        return os.path.join(self.root, f".replica{i}", topic)
+
+    def _logs_for(self, topic: str) -> list[FileLogStore | None]:
+        logs = self._logs.get(topic)
+        if logs is None:
+            logs = []
             last = self._floor(topic)
-            # append-side owner: REPAIR torn tails here (a SIGKILLed
-            # leader can leave a half-written record; appending after it
-            # would hide every later entry from replay forever)
-            for off, _payload in log.replay(last, repair=True):
-                last = off
+            for i in range(self.replicas):
+                try:
+                    log = FileLogStore(self._replica_dir(topic, i))
+                    # append-side owner: REPAIR torn tails per replica (a
+                    # SIGKILLed leader can leave a half-written record;
+                    # appending after it would hide every later entry
+                    # from replay forever)
+                    for off, _payload in log.replay(last, repair=True):
+                        last = max(last, off)
+                except OSError:
+                    M_BROKER_APPEND.labels("open_failed").inc()
+                    log = None
+                logs.append(log)
+            if not any(l is not None for l in logs):
+                raise StorageError(
+                    f"broker topic {topic}: no readable replica")
+            self._logs[topic] = logs
+            # the append offset resumes past the NEWEST record across
+            # replicas — a lagging replica must not rewind the topic
             self._offsets[topic] = last
-        return log
+        return logs
 
     def acquire(self, topic: str) -> None:
-        """(Re)take append ownership of a topic: drop any cached handle and
-        offset so state re-reads from shared storage.  Called whenever a
-        region (re)opens — leadership may have bounced through another
-        broker instance that appended and pruned in the meantime."""
+        """(Re)take append ownership of a topic: drop any cached handles
+        and offset so state re-reads from shared storage.  Called
+        whenever a region (re)opens — leadership may have bounced
+        through another broker instance that appended and pruned in the
+        meantime."""
         with self._lock:
-            log = self._logs.pop(topic, None)
-            if log is not None:
-                log.close()
+            for log in self._logs.pop(topic, []) or []:
+                if log is not None:
+                    log.close()
             self._offsets.pop(topic, None)
+            self._epochs.pop(topic, None)
+            self._epochs_mtime.pop(topic, None)
+
+    # ---- epoch fencing -------------------------------------------------
+    # Claims are EMPTY FILES named ``.epochs/<topic>.<region>.<epoch>``,
+    # created O_CREAT|O_EXCL and never overwritten: creation is atomic
+    # ACROSS PROCESSES and the recorded epoch is the max over existing
+    # claim files, so claiming is monotone by construction — a zombie's
+    # lower claim can never clobber a newer leader's (a check-then-write
+    # marker field would race exactly there).  The per-append check is
+    # one dir-mtime stat + a cached scan.
+    def _epoch_dir(self) -> str:
+        return os.path.join(self.root, ".epochs")
+
+    def _topic_epochs(self, topic: str) -> dict:
+        """Per-region claimed epochs for ``topic``, re-scanned whenever
+        the claim dir's mtime moved (another broker instance — the new
+        leader's process — may have claimed since)."""
+        d = self._epoch_dir()
+        try:
+            mtime = os.path.getmtime(d)
+        except OSError:
+            mtime = -1.0
+        if (topic not in self._epochs
+                or self._epochs_mtime.get(topic) != mtime):
+            claims: dict[str, int] = {}
+            prefix = f"{topic}."
+            try:
+                names = os.listdir(d)
+            except OSError:
+                names = []
+            for fn in names:
+                if not fn.startswith(prefix):
+                    continue
+                try:
+                    rid, ep = fn[len(prefix):].split(".")
+                    claims[rid] = max(int(claims.get(rid, 0)), int(ep))
+                except ValueError:
+                    continue
+            self._epochs[topic] = claims
+            self._epochs_mtime[topic] = mtime
+        return self._epochs[topic]
+
+    def claim_epoch(self, topic: str, region_id: int, epoch: int) -> None:
+        """Record a leader epoch for (topic, region): later appends or
+        watermark advances carrying an older epoch are refused.  Claims
+        are monotone — a stale claim (zombie re-opening) raises here."""
+        epoch = int(epoch)
+        with self._lock:
+            cur = int(self._topic_epochs(topic).get(str(region_id), 0))
+            if cur > epoch:
+                M_FENCE_REJECTED.labels("broker_claim").inc()
+                raise FencedError(
+                    f"broker topic {topic} region {region_id}: epoch "
+                    f"{epoch} superseded by {cur}")
+            if cur == epoch:
+                return
+            d = self._epoch_dir()
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"{topic}.{region_id}.{epoch}")
+            try:
+                os.close(os.open(path, os.O_CREAT | os.O_EXCL))
+            except FileExistsError:
+                pass  # our own claim from a crashed earlier attempt
+            # the claim must survive power loss, or a fenced zombie
+            # could append after a restart forgot the directory entry
+            _fsync_dir(d)  # gl: allow[GL-L002] -- claims are once-per-leadership-grant, and _lock IS their serialization (same discipline as the watermark marker write)
+            self._epochs.setdefault(topic, {})[str(region_id)] = epoch
+            self._epochs_mtime.pop(topic, None)  # re-stat next check
+
+    def _check_epoch(self, topic: str, region_id: int,
+                     epoch: int | None, surface: str) -> None:
+        # an epoch-less writer (epoch None → 0) is fenced by ANY
+        # recorded claim: a pre-fencing zombie whose region opened
+        # before epochs were minted must not bypass the new leader's
+        # fence.  Unfenced standalone brokers record no claims, so the
+        # epoch-less path stays open for them.
+        cur = int(self._topic_epochs(topic).get(str(region_id), 0))
+        if cur > (0 if epoch is None else int(epoch)):
+            M_FENCE_REJECTED.labels(surface).inc()
+            raise FencedError(
+                f"broker topic {topic} region {region_id}: {surface} "
+                f"with epoch {epoch} fenced out by {cur}")
 
     # ---- data plane ----------------------------------------------------
     def append(self, topic: str, region_id: int, sequence: int,
-               payload: bytes) -> int:
-        """Durable append; returns the topic offset.  Offset assignment
-        and record enqueue happen atomically under the broker lock, but
-        the durability wait runs OUTSIDE it — concurrent appenders (many
-        regions, many topics) enqueue back-to-back and the log's group
-        committer flushes the whole batch with one write + fsync, acking
-        every waiter at once (the Kafka produce-batching analog)."""
+               payload: bytes, epoch: int | None = None) -> int:
+        """Durable quorum append; returns the topic offset.  Offset
+        assignment and record enqueue happen atomically under the broker
+        lock, but the durability wait runs OUTSIDE it — concurrent
+        appenders (many regions, many topics) enqueue back-to-back and
+        each replica log's group committer flushes the whole batch with
+        one write + fsync, acking every waiter at once (the Kafka
+        produce-batching analog).  The append succeeds once a MAJORITY
+        of replicas is durable; a fenced epoch refuses before any byte
+        is written."""
         from greptimedb_tpu.utils.chaos import CHAOS
 
         CHAOS.inject("wal.append")  # broker stall/failure (chaos tier)
+        rec = _ENV.pack(region_id, sequence) + payload
         with self._lock:
-            log = self._log(topic)
+            self._check_epoch(topic, region_id, epoch, "broker_append")
+            logs = self._logs_for(topic)
             offset = self._offsets[topic] + 1
             self._offsets[topic] = offset
-            wait = log.append_async(
-                offset, _ENV.pack(region_id, sequence) + payload)
-        wait()
+            waits = []
+            failed = 0
+            for log in logs:
+                if log is None:
+                    failed += 1
+                    continue
+                try:
+                    if CHAOS.enabled:
+                        # per-replica fault point: error/kill/stall one
+                        # copy's append boundary (the kill-a-replica
+                        # chaos coverage) — quorum must still ack
+                        CHAOS.inject("broker.replica")
+                    waits.append(log.append_async(offset, rec))
+                except BaseException:  # noqa: BLE001 — one replica down
+                    failed += 1       # is a counted, survivable event
+        ok = 0
+        for wait in waits:
+            try:
+                wait()
+                ok += 1
+            except BaseException:  # noqa: BLE001
+                failed += 1
+        if ok:
+            M_BROKER_APPEND.labels("ok").inc(ok)
+        if failed:
+            M_BROKER_APPEND.labels("failed").inc(failed)
+        if ok < self.quorum:
+            M_BROKER_QUORUM_FAIL.inc()
+            # indeterminate, like any distributed write timeout: the
+            # record may live on a minority replica and surface after
+            # read-repair (a torn-tail-survivor analog); the caller's
+            # retry burns a fresh region sequence, so no seq ever
+            # replays twice
+            raise StorageError(
+                f"broker topic {topic}: append reached {ok}/"
+                f"{self.replicas} replicas (quorum {self.quorum}) — "
+                "not acked; durability indeterminate")
         return offset
 
-    def read(self, topic: str, from_offset: int | None = None):
-        """Yield (offset, region_id, sequence, payload); read-only (never
-        repairs — only the append owner may truncate tails)."""
-        log = self._log(topic)
+    def read(self, topic: str, from_offset: int | None = None,
+             repair: bool = False):
+        """Yield (offset, region_id, sequence, payload) merged across
+        replicas: an offset present on ANY valid replica is served, so
+        replay survives the loss or corruption of a minority of copies.
+
+        The read-only path (followers, pruning scans) is a STREAMING
+        k-way merge over the per-replica record iterators — sound
+        because replica files are offset-ordered by construction
+        (appends enqueue under the broker lock in offset order, and
+        read-repair rebuilds a repaired replica in offset order).
+        ``repair=True`` (append owner only) additionally READ-REPAIRS:
+        replicas missing offsets a donor holds, or carrying CRC-damaged
+        spans, are sidecar-preserved and rebuilt from the merged view —
+        follower reads never mutate.
+
+        A record that reached only a MINORITY (a below-quorum append —
+        the writer saw an error, the outcome is INDETERMINATE like any
+        distributed write timeout) survives into the merged view:
+        durable-but-unacked records may surface after repair, exactly
+        like a torn-tail survivor in a local WAL; region sequences are
+        never reused (failed appends burn them), so no seq replays
+        twice."""
         if from_offset is None:
             from_offset = self._floor(topic)
-        for offset, data in log.replay(from_offset, repair=False):
+        logs = self._logs_for(topic)
+        if self.replicas == 1:
+            log = logs[0]
+            for offset, data in log.replay(from_offset, repair=False):
+                rid, seq = _ENV.unpack_from(data, 0)
+                yield offset, rid, seq, data[_ENV.size:]
+            return
+        if not repair:
+            # streaming union: no materialization — a failover replay
+            # over a large unpruned topic must not hold N copies of it
+            import heapq
+
+            iters = [log.replay(from_offset, repair=False)
+                     for log in logs if log is not None]
+            last = None
+            for off, data in heapq.merge(*iters, key=lambda t: t[0]):
+                if off == last:
+                    continue  # the other replicas' copy of one record
+                last = off
+                rid, seq = _ENV.unpack_from(data, 0)
+                yield off, rid, seq, data[_ENV.size:]
+            return
+        per_replica: list[dict[int, bytes] | None] = []
+        merged: dict[int, bytes] = {}
+        damaged: list[int] = []
+        for i, log in enumerate(logs):
+            if log is None:
+                per_replica.append(None)
+                continue
+            recs: dict[int, bytes] = {}
+            for offset, data in log.replay(from_offset, repair=False):
+                recs[offset] = data
+            if any(d.kind == "interior" for d in log.last_triage):
+                damaged.append(i)
+            per_replica.append(recs)
+            for off, data in recs.items():
+                merged.setdefault(off, data)
+        self._read_repair(topic, logs, per_replica, merged, damaged)
+        for off in sorted(merged):
+            data = merged[off]
             rid, seq = _ENV.unpack_from(data, 0)
-            yield offset, rid, seq, data[_ENV.size:]
+            yield off, rid, seq, data[_ENV.size:]
+
+    def _read_repair(self, topic, logs, per_replica, merged,
+                     damaged) -> None:
+        """Backfill lagging replicas and heal CRC-damaged ones from the
+        merged view (the donor the interior-corruption story was
+        missing: any healthy sibling).  Damaged bytes are preserved in
+        ``.quarantine`` sidecars FIRST (the PR-9 discipline — this scan
+        ran repair=False, so replay wrote none), then the replica is
+        rebuilt from the merged view IN OFFSET ORDER — repaired
+        replicas must stay offset-sorted on disk, the streaming merged
+        read depends on it."""
+        for i, recs in enumerate(per_replica):
+            log = logs[i]
+            if log is None or recs is None:
+                continue
+            missing = [off for off in merged if off not in recs]
+            if not missing and i not in damaged:
+                continue
+            for d in log.last_triage:
+                if d.kind != "interior":
+                    continue
+                try:
+                    with open(d.path, "rb") as f:
+                        seg = f.read()
+                    log._write_sidecar(d.path, d.start, seg[d.start:d.end])
+                except OSError:
+                    pass  # segment vanished: nothing left to preserve
+            # rebuild: drop the replica's segments (sidecars are kept —
+            # they are .quarantine files, not .wal) and re-append the
+            # merged view; a crash mid-rebuild leaves a partial replica
+            # the quorum covers and the next owner replay re-repairs
+            log.close()
+            d = self._replica_dir(topic, i)
+            try:
+                for fn in os.listdir(d):
+                    if fn.endswith(".wal"):
+                        os.unlink(os.path.join(d, fn))
+            except OSError:
+                pass
+            new_log = FileLogStore(d)
+            for off in sorted(merged):
+                new_log.append(off, merged[off])
+            logs[i] = new_log
+            M_BROKER_READ_REPAIR.inc(max(len(missing), 1))
 
     # ---- pruning (reference wal_prune procedure) -----------------------
     def _wm_path(self, topic: str) -> str:
@@ -133,52 +435,70 @@ class SharedLogBroker:
         return int(self._load_wm(topic).get("_floor", 0))
 
     def set_low_watermark(self, topic: str, region_id: int,
-                          sequence: int) -> None:
+                          sequence: int, epoch: int | None = None) -> None:
         """Region has flushed everything below ``sequence``; entries older
-        than every region's watermark become prunable."""
+        than every region's watermark become prunable.  A fenced epoch
+        (older than the recorded claim) is refused — a zombie's stale
+        watermark must not prune records the new leader still needs."""
         with self._lock:
+            self._check_epoch(topic, region_id, epoch, "broker_watermark")
             wm = self._load_wm(topic)
             wm[str(region_id)] = max(int(wm.get(str(region_id), 0)), sequence)
             self._prune(topic, wm)
-            # atomic replace + fsync: a crash mid-write must never corrupt
-            # the marker (a broken marker would wedge flush/prune forever),
-            # and the rename must be durable before pruning relies on it
-            path = self._wm_path(topic)
-            tmp = path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(wm, f)
-                f.flush()  # gl: allow[GL-L002] -- _lock IS the watermark-write serialization: a torn interleaving of two markers would wedge flush/prune
-                os.fsync(f.fileno())  # gl: allow[GL-L002] -- same: durability before the prune below relies on it
-            os.replace(tmp, path)
-            # rename durability: prune (above) already dropped segments
-            # this marker accounts for — losing the directory entry at
-            # power loss would replay from a floor below the pruned data
-            _fsync_dir(self.root)  # gl: allow[GL-L002] -- same serialization as the marker write above
+            self._persist_watermarks(topic, wm)
+
+    def _persist_watermarks(self, topic: str, wm: dict) -> None:
+        """THE watermark-marker write path (lint GL-D003 owner; called
+        under self._lock).  Atomic replace + fsync: a crash mid-write
+        must never corrupt the marker (a broken marker would wedge
+        flush/prune forever), and the rename must be durable before
+        pruning relies on it."""
+        path = self._wm_path(topic)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(wm, f)
+            f.flush()  # gl: allow[GL-L002] -- _lock IS the watermark-write serialization: a torn interleaving of two markers would wedge flush/prune
+            os.fsync(f.fileno())  # gl: allow[GL-L002] -- same: durability before the prune relies on it
+        os.replace(tmp, path)
+        # rename durability: prune already dropped segments this marker
+        # accounts for — losing the directory entry at power loss would
+        # replay from a floor below the pruned data
+        _fsync_dir(self.root)  # gl: allow[GL-L002] -- same serialization as the marker write above
 
     def _prune(self, topic: str, wm: dict) -> None:
         """Drop whole segments whose every entry is below its region's
         watermark (the reference prunes Kafka up to the min high
         watermark across regions on the topic).  Scans start at the
         stored floor, not offset 0, so flush cost tracks the UNPRUNED
-        suffix only."""
-        log = self._log(topic)
+        suffix only.  Per-replica streaming with early break (NOT the
+        merged read — this runs on every flush, and materializing the
+        whole unpruned suffix × replicas per flush would tax ingest):
+        the cut is the MIN first-kept offset across replicas, so a
+        record any copy still needs is never pruned anywhere."""
+        logs = self._logs_for(topic)
+        floor = self._floor(topic)
         keep_from: int | None = None
-        for offset, rid, seq, _payload in self.read(topic):
-            if seq >= int(wm.get(str(rid), 0)):
-                keep_from = offset
-                break
-        if keep_from is not None:
-            log.truncate(keep_from)
-            wm["_floor"] = keep_from
-        else:
-            # everything flushed: drop all closed segments
-            end = self._offsets.get(topic, 0) + 1
-            log.truncate(end)
-            wm["_floor"] = end
+        for log in logs:
+            if log is None:
+                continue
+            for offset, data in log.replay(floor, repair=False):
+                rid, seq = _ENV.unpack_from(data, 0)
+                if seq >= int(wm.get(str(rid), 0)):
+                    keep_from = (offset if keep_from is None
+                                 else min(keep_from, offset))
+                    break
+        cut = keep_from if keep_from is not None else (
+            self._offsets.get(topic, 0) + 1)
+        for log in logs:
+            if log is not None:
+                log.truncate(cut)
+        wm["_floor"] = cut
 
     def close(self) -> None:
-        for log in self._logs.values():
-            log.close()
+        for logs in self._logs.values():
+            for log in logs:
+                if log is not None:
+                    log.close()
         self._logs.clear()
 
 
@@ -189,6 +509,9 @@ class RemoteLogStore(LogStore):
         self.broker = broker
         self.region_id = region_id
         self.topic = broker.topic_for(region_id)
+        # leader epoch this store appends under (None = unfenced);
+        # armed via set_fence at leadership grant
+        self.fence_epoch: int | None = None
         # re-take ownership: leadership may have bounced through another
         # broker instance (other process) that appended/pruned meanwhile
         broker.acquire(self.topic)
@@ -204,19 +527,31 @@ class RemoteLogStore(LogStore):
         and corrupt the pruning floor."""
         self.broker.acquire(self.topic)
 
+    def set_fence(self, epoch: int) -> None:
+        """Arm epoch fencing for this region's broker writes: the claim
+        is recorded broker-side, so a fenced-out zombie's append or
+        watermark advance FAILS (its client sees the error) instead of
+        being acked into a forked history."""
+        self.broker.claim_epoch(self.topic, self.region_id, epoch)
+        self.fence_epoch = int(epoch)
+
     def append(self, sequence: int, payload: bytes) -> None:
-        self.broker.append(self.topic, self.region_id, sequence, payload)
+        self.broker.append(self.topic, self.region_id, sequence, payload,
+                           epoch=self.fence_epoch)
 
     def replay(self, from_sequence: int = 0, repair: bool = True):
-        # repair is meaningless here: the shared log is never truncated by
-        # readers (the broker owns its own tail integrity)
-        for _off, rid, seq, payload in self.broker.read(self.topic):
+        # repair here means broker read-repair (owner only): followers
+        # replay the merged replica view read-only; the broker owns its
+        # own tail integrity either way
+        for _off, rid, seq, payload in self.broker.read(
+                self.topic, repair=repair):
             if rid == self.region_id and seq >= from_sequence:
                 yield seq, payload
 
     def truncate(self, up_to_sequence: int) -> None:
         self.broker.set_low_watermark(self.topic, self.region_id,
-                                      up_to_sequence)
+                                      up_to_sequence,
+                                      epoch=self.fence_epoch)
 
     def close(self) -> None:
         pass  # broker lifecycle is owned by the node/deployment

@@ -12,6 +12,20 @@ documented S3 REST protocol directly: AWS Signature Version 4 signing
 (stdlib hmac/hashlib), PUT/GET/HEAD/DELETE object and ListObjectsV2 over
 urllib, path-style addressing (MinIO-compatible).  ``MockS3Server`` is
 an in-process protocol mock for tests.
+
+Shared-storage coherence (ISSUE 15):
+
+- **Conditional put** (``write_if``): ``If-Match``/``If-None-Match``
+  headers on PUT — the fenced write surface manifest deltas/checkpoints
+  ride so two split-brain leaders cannot interleave histories (a lost
+  CAS is HTTP 412 → FencedError, never retried into a plain write).
+  The ``s3.cas`` chaos point fires between the CAS landing remotely and
+  the local cache fill, the crash window recovery must handle.
+- **Cache revalidation**: the per-node write-through cache is safe for
+  immutable objects (SSTs — uuid-named, never rewritten) but NOT for
+  manifest-prefix paths another node may replace or delete remotely.
+  ``read``/``exists`` on those paths revalidate against a remote HEAD
+  (ETag/length) instead of trusting a stale local hit.
 """
 
 from __future__ import annotations
@@ -27,8 +41,8 @@ import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
 
-from greptimedb_tpu.errors import StorageError
-from greptimedb_tpu.storage.object_store import ObjectStore
+from greptimedb_tpu.errors import FencedError, StorageError
+from greptimedb_tpu.storage.object_store import ObjectStore, content_etag
 from greptimedb_tpu.utils.chaos import CHAOS, ChaosError, M_REMOTE_RETRY
 
 
@@ -130,11 +144,17 @@ class S3ObjectStore(ObjectStore):
         return f"{self.prefix}/{path}" if self.prefix else path
 
     def _request(self, method: str, key: str = "", query: str = "",
-                 payload: bytes = b"") -> tuple[int, bytes]:
+                 payload: bytes = b"",
+                 extra_headers: dict[str, str] | None = None,
+                 ) -> tuple[int, bytes, dict]:
         uri = "/" + urllib.parse.quote(f"{self.bucket}/{key}".rstrip("/"))
         url = f"{self.endpoint}{uri}" + (f"?{query}" if query else "")
         headers = sigv4_headers(method, self.host, uri, query, self.region,
                                 self.access_key, self.secret_key, payload)
+        if extra_headers:
+            # conditional headers (If-Match/If-None-Match) ride unsigned:
+            # sigv4 signs only host/content-sha256/date above
+            headers = {**headers, **extra_headers}
         last_err: Exception | None = None
         for attempt in range(self.max_retries + 1):
             req = urllib.request.Request(url, data=payload or None,
@@ -150,10 +170,16 @@ class S3ObjectStore(ObjectStore):
                         # (parquet page checksums, manifest CRCs) must
                         # catch it, not this layer
                         body, _ = CHAOS.filter_io("s3.read.payload", body)
-                    return resp.status, body
+                    return resp.status, body, dict(resp.headers)
             except urllib.error.HTTPError as e:
                 if e.code == 404:
-                    return 404, b""
+                    return 404, b"", dict(e.headers or {})
+                if e.code == 412:
+                    # precondition failed: the conditional write lost its
+                    # CAS — a FENCING event, never a transient to retry
+                    raise FencedError(
+                        f"s3 {method} {key}: precondition failed "
+                        "(If-Match/If-None-Match lost)") from None
                 if e.code < 500:
                     raise StorageError(
                         f"s3 {method} {key}: HTTP {e.code}"
@@ -217,7 +243,7 @@ class S3ObjectStore(ObjectStore):
         while True:
             path = self._prefetch_q.get()
             try:
-                status, body = self._request("GET", self._key(path))
+                status, body, _h = self._request("GET", self._key(path))
                 if status != 404:
                     cp = self._cache_path(path)
                     if cp:
@@ -261,22 +287,93 @@ class S3ObjectStore(ObjectStore):
         if ev is not None:
             ev.wait(timeout=60.0)
 
+    # ---- cache-coherence policy ---------------------------------------
+    @staticmethod
+    def _must_revalidate(path: str) -> bool:
+        """Paths whose objects are REWRITTEN or deleted in place by other
+        nodes (manifest deltas/checkpoints, epoch markers, watermark
+        markers): a local cache hit must be HEAD/ETag-revalidated, never
+        trusted.  Immutable uuid-named SSTs keep the zero-round-trip
+        cache hit."""
+        p = "/" + path.lstrip("/")
+        return "/manifest/" in p or p.endswith(".watermarks.json")
+
+    @staticmethod
+    def _etag_matches(etag: str, data: bytes) -> bool:
+        """Remote ETag vs local bytes.  Single-part ETags are the content
+        md5; multipart ETags (``...-N``) are not — those degrade to the
+        caller's length check."""
+        etag = etag.strip('"')
+        if not etag or "-" in etag:
+            return True  # unverifiable by content hash alone
+        return etag == content_etag(data)
+
     # ---- ObjectStore ---------------------------------------------------
     def write(self, path: str, data: bytes) -> None:
-        status, _body = self._request("PUT", self._key(path), payload=data)
+        status, _body, _h = self._request("PUT", self._key(path),
+                                          payload=data)
         if status not in (200, 201, 204):
             raise StorageError(f"s3 PUT {path}: HTTP {status}")
         cp = self._cache_path(path)
         if cp:  # write-through: subsequent reads are local
             self._cache_fill(cp, data)
 
+    def write_if(self, path: str, data: bytes, *,
+                 if_match: str | None = None,
+                 if_none_match: bool = False) -> None:
+        """Conditional PUT (the epoch-fencing surface): exactly one of
+        ``if_none_match`` (create-only) / ``if_match`` (etag CAS).  A
+        lost precondition raises FencedError (HTTP 412, not retried)."""
+        if if_none_match == (if_match is not None):
+            raise ValueError("write_if needs exactly one of "
+                             "if_match / if_none_match")
+        hdrs = ({"If-None-Match": "*"} if if_none_match
+                else {"If-Match": f'"{if_match}"'})
+        status, _body, _h = self._request("PUT", self._key(path),
+                                          payload=data, extra_headers=hdrs)
+        if status not in (200, 201, 204):
+            raise StorageError(f"s3 conditional PUT {path}: HTTP {status}")
+        # crash window between the CAS landing remotely and the local
+        # cache fill: the chaos tier kills here; recovery must classify
+        # "failed but actually landed" correctly (manifest readback)
+        CHAOS.inject("s3.cas")
+        cp = self._cache_path(path)
+        if cp:
+            self._cache_fill(cp, data)
+
+    def head(self, path: str) -> dict | None:
+        status, _body, hdrs = self._request("HEAD", self._key(path))
+        if status != 200:
+            return None
+        try:
+            length = int(hdrs.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        return {"etag": (hdrs.get("ETag") or "").strip('"'),
+                "length": length}
+
     def read(self, path: str) -> bytes:
         self._wait_inflight(path)
         cp = self._cache_path(path)
         if cp and os.path.exists(cp):
             with open(cp, "rb") as f:
-                return f.read()
-        status, body = self._request("GET", self._key(path))
+                cached = f.read()
+            if not self._must_revalidate(path):
+                return cached
+            h = self.head(path)
+            if h is None:
+                # another node deleted the object: the stale hit must
+                # not resurrect it
+                try:
+                    os.unlink(cp)
+                except OSError:
+                    pass
+                raise StorageError(f"s3 object not found: {path}")
+            if (h["length"] == len(cached)
+                    and self._etag_matches(h["etag"], cached)):
+                return cached
+            # replaced remotely: fall through to a fresh GET + refill
+        status, body, _h = self._request("GET", self._key(path))
         if status == 404:
             raise StorageError(f"s3 object not found: {path}")
         if cp:  # read-through fill
@@ -285,10 +382,15 @@ class S3ObjectStore(ObjectStore):
 
     def exists(self, path: str) -> bool:
         cp = self._cache_path(path)
-        if cp and os.path.exists(cp):
+        if cp and os.path.exists(cp) and not self._must_revalidate(path):
             return True
-        status, _ = self._request("HEAD", self._key(path))
-        return status == 200
+        h = self.head(path)
+        if h is None and cp and os.path.exists(cp):
+            try:  # remote delete: drop the stale cache entry too
+                os.unlink(cp)
+            except OSError:
+                pass
+        return h is not None
 
     def list(self, prefix: str) -> list[str]:
         key_prefix = self._key(prefix)
@@ -304,7 +406,7 @@ class S3ObjectStore(ObjectStore):
                 q + "&" + urllib.parse.urlencode(
                     {"continuation-token": token})
             )
-            status, body = self._request("GET", "", query=qq)
+            status, body, _h = self._request("GET", "", query=qq)
             if status != 200:
                 raise StorageError(f"s3 LIST {prefix}: HTTP {status}")
             root = ET.fromstring(body)
@@ -351,13 +453,19 @@ class S3ObjectStore(ObjectStore):
 
 class MockS3Server:
     """In-process S3 protocol mock (PUT/GET/HEAD/DELETE + ListObjectsV2,
-    path-style) for tests — the role MinIO plays in the reference's CI."""
+    path-style) for tests — the role MinIO plays in the reference's CI.
+
+    Implements the conditional-PUT subset (``If-Match``/``If-None-Match``
+    → 412 on a lost precondition, like real S3 since 2024-11) and serves
+    content-md5 ETags on PUT/GET/HEAD, so the fencing and cache-
+    revalidation paths exercise the same wire semantics in tests."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  require_auth: bool = True):
         import http.server
 
         store: dict[str, bytes] = {}
+        cas_lock = threading.Lock()
         mock = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -385,8 +493,24 @@ class MockS3Server:
                     return
                 key, _q = self._key()
                 n = int(self.headers.get("Content-Length", 0))
-                store[key] = self.rfile.read(n)
+                body = self.rfile.read(n)
+                if_match = self.headers.get("If-Match")
+                if_none = self.headers.get("If-None-Match")
+                with cas_lock:  # CAS decisions + install are atomic
+                    cur = store.get(key)
+                    if if_none is not None and cur is not None:
+                        self.send_response(412)
+                        self.end_headers()
+                        return
+                    if if_match is not None:
+                        want = if_match.strip('"')
+                        if cur is None or content_etag(cur) != want:
+                            self.send_response(412)
+                            self.end_headers()
+                            return
+                    store[key] = body
                 self.send_response(200)
+                self.send_header("ETag", f'"{content_etag(body)}"')
                 self.end_headers()
 
             def do_GET(self):
@@ -412,6 +536,8 @@ class MockS3Server:
                 if key in store:
                     self.send_response(200)
                     self.send_header("Content-Length", str(len(store[key])))
+                    self.send_header("ETag",
+                                     f'"{content_etag(store[key])}"')
                     self.end_headers()
                     self.wfile.write(store[key])
                 else:
@@ -422,7 +548,13 @@ class MockS3Server:
                 if not self._check_auth():
                     return
                 key, _q = self._key()
-                self.send_response(200 if key in store else 404)
+                if key in store:
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(store[key])))
+                    self.send_header("ETag",
+                                     f'"{content_etag(store[key])}"')
+                else:
+                    self.send_response(404)
                 self.end_headers()
 
             def do_DELETE(self):

@@ -483,6 +483,70 @@ class FileLogStore(LogStore):
                 if repair:
                     self._write_sidecar(path, start, data[start:dmg_end])
 
+    def verify(self) -> list[WalDamage]:
+        """Read-only integrity sweep over every segment (the scrubber's
+        entry point, ISSUE 15): classify damage byte-exactly like
+        replay() — including cross-segment lost-range bounding — and
+        preserve the damaged bytes in ``.quarantine`` sidecars, but
+        mutate NOTHING else.  Unlike replay, tail damage is *reported*
+        (kind "torn_tail"), never truncated: on a LIVE region the tail
+        is acked data hit by bit rot, not crash debris, and the caller
+        (Region.scrub_wal) decides between resync and flush-cover."""
+        native = _native()
+        damages: list[WalDamage] = []
+        pending: WalDamage | None = None
+        last_seq: int | None = None
+        segs = self._segments()
+        for idx, seg in enumerate(segs):
+            path = self._seg_path(seg)
+            with open(path, "rb") as f:
+                data = f.read()
+            for ev in _walk(data, native):
+                if ev[0] == "rec":
+                    _, seq, _poff, _ln, _rs, _re = ev
+                    if pending is not None:
+                        pending.next_seq = seq
+                        pending = None
+                    last_seq = seq
+                    continue
+                _, start, dmg_end = ev
+                tail = dmg_end >= len(data) and idx == len(segs) - 1
+                dmg = WalDamage(path, "torn_tail" if tail else "interior",
+                                start, dmg_end, last_seq, None)
+                M_CORRUPTION.labels(
+                    "wal", "scrub_tail" if tail else "scrub_interior").inc()
+                damages.append(dmg)
+                pending = dmg
+                self._write_sidecar(path, start, data[start:dmg_end])
+        return damages
+
+    def drop_damage(self, damages: "list[WalDamage]") -> int:
+        """Remove verified damage from the segments AFTER its bytes are
+        sidecar-preserved and its lost range recovered (resynced or
+        flush-covered): interior spans compact out via heal(); tail
+        damage truncates the segment to its valid prefix (re-opening the
+        active handle).  Returns bytes dropped."""
+        interior_paths = {d.path for d in damages if d.kind == "interior"}
+        dropped = self.heal(damages)
+        for d in damages:
+            if d.kind != "torn_tail" or d.path in interior_paths:
+                # heal's compaction keeps only valid records, so it
+                # already dropped this file's tail span too
+                continue
+            try:
+                size = os.path.getsize(d.path)
+            except OSError:
+                continue
+            if size <= d.start:
+                continue  # already compacted/truncated
+            dropped += size - d.start
+            with open(d.path, "r+b") as f:
+                f.truncate(d.start)
+            if d.path == self._seg_path(self._current_id):
+                self._fh.close()
+                self._fh = open(d.path, "ab")
+        return dropped
+
     def _write_sidecar(self, path: str, start: int, blob: bytes) -> None:
         """Preserve damaged bytes beside the segment (never deleted);
         idempotent per (segment, offset) so repeated failed opens don't

@@ -42,6 +42,9 @@ point                    site                                  actions
 ``manifest.delta``       Manifest.commit delta write           bitflip/error/kill
 ``manifest.checkpoint``  Manifest.checkpoint write             bitflip/error/kill
 ``manifest.gc``          Manifest checkpoint GC delete loop    error/kill
+``s3.cas``               S3 write_if between CAS + cache fill  error/kill
+``scrub.read``           Scrubber per-item verify (scrubber)   error/kill/delay
+``broker.replica``       SharedLogBroker per-replica append    error/kill/stall
 =======================  ===================================== ==========
 
 Local-disk fault shapes (ISSUE 9): ``torn`` persists a PREFIX of the

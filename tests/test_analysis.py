@@ -59,8 +59,8 @@ class TestTier1Gate:
         codes = {c for p in core.all_passes() for c in p.codes}
         for required in ("GL-L001", "GL-L002", "GL-O001", "GL-O002",
                         "GL-H001", "GL-H002", "GL-D001", "GL-D002",
-                        "GL-T001", "GL-T002", "GL-T003", "GL-K001",
-                        "GL-K002"):
+                        "GL-D003", "GL-T001", "GL-T002", "GL-T003",
+                        "GL-K001", "GL-K002"):
             assert required in codes
 
 
@@ -245,6 +245,29 @@ def install(tmp, path):
     _fsync_dir(os.path.dirname(path))
 '''
 
+FENCE_BAD = '''
+class Manifest:
+    def commit(self, action):                # line 3
+        self.store.write("delta", b"x")      # line 4: bypasses _write
+        self.store.write_if("d2", b"x", if_none_match=True)  # line 5
+
+    def _write(self, path, data):
+        self.store.write(path, data)         # owner: clean
+'''
+
+FENCE_BAD_WM = '''
+import json, os
+
+class SharedLogBroker:
+    def set_low_watermark(self, topic, wm):  # line 5
+        with open("marker.tmp", "w") as f:   # line 6: bypasses owner
+            json.dump(wm, f)
+
+    def _persist_watermarks(self, topic, wm):
+        with open("marker.tmp", "w") as f:   # owner: clean
+            json.dump(wm, f)
+'''
+
 HYGIENE_BAD = '''
 from greptimedb_tpu.utils.telemetry import REGISTRY
 
@@ -377,6 +400,29 @@ class TestDurabilityFixtures:
     def test_outside_storage_not_in_scope(self):
         assert analyze_source(DUR_BAD, "meta/x.py",
                               names=["durability"]) == []
+
+    def test_fenced_write_bypass_flags_in_manifest(self):
+        fs = analyze_source(FENCE_BAD, "storage/manifest.py",
+                            names=["durability"])
+        assert codes_at(fs, "GL-D003") == [4, 5]
+
+    def test_fenced_write_bypass_flags_watermark_marker(self):
+        fs = analyze_source(FENCE_BAD_WM, "storage/remote_wal.py",
+                            names=["durability"])
+        assert codes_at(fs, "GL-D003") == [6]
+
+    def test_fenced_write_map_only_covers_mapped_files(self):
+        # the same shapes in an unmapped storage module are not fenced
+        # surfaces (plain ObjectStore writes are GL-D001/2 territory)
+        fs = analyze_source(FENCE_BAD, "storage/x.py",
+                            names=["durability"])
+        assert codes_at(fs, "GL-D003") == []
+
+    def test_current_fenced_surfaces_are_clean(self):
+        # baseline-free from day one: the live manifest/broker modules
+        # route every fenced-surface write through their owners
+        new, _m, _s, _inline = check_package(names=["durability"])
+        assert [f for f in new if f.code == "GL-D003"] == []
 
 
 class TestHygieneFixtures:

@@ -962,3 +962,130 @@ class TestRenameDurability:
         wal._write_sidecar(seg, 0, b"damaged-bytes")
         assert calls == [os.path.dirname(seg)]
         wal.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 crash-point extensions: kills mid-scrub-repair and at
+# broker-replica append boundaries — reopen must be bit-exact vs an
+# uninterrupted twin with zero acked loss (the PR-9 matrix discipline).
+# ---------------------------------------------------------------------------
+
+_SCRUB_KILL_CHILD = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from greptimedb_tpu.storage.region import RegionEngine
+from greptimedb_tpu.storage.scrubber import Scrubber
+from greptimedb_tpu.utils.chaos import CHAOS
+from tests.test_durability import cpu_schema, write_rows
+
+home = sys.argv[1]
+engine = RegionEngine(home)
+region = engine.create_region(1, cpu_schema())
+write_rows(region, n=12)
+region.flush()
+print("acked", flush=True)
+# rot one byte of the cold SST, then scrub with a seeded kill at the
+# repair's manifest commit (mid-repair: file already quarantined, the
+# re-flushed replacement not yet committed)
+meta = region.sst_files[0]
+data = bytearray(engine.store.read(meta.path))
+data[len(data) // 2] ^= 0xFF
+with open(engine.store.local_path(meta.path), "r+b") as f:
+    f.write(bytes(data))
+CHAOS.rule("manifest.delta", 1.0, "kill", at=1)
+Scrubber(engine, interval_s=0, batch=100).run_sweep()
+print("survived", flush=True)  # must never print: the kill fires
+"""
+
+_BROKER_KILL_CHILD = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from greptimedb_tpu.storage.remote_wal import RemoteLogStore, SharedLogBroker
+
+root, ack_path, kill_at = sys.argv[1], sys.argv[2], int(sys.argv[3])
+broker = SharedLogBroker(root, replicas=3)
+store = RemoteLogStore(broker, region_id=9)
+ack = open(ack_path, "a")
+from greptimedb_tpu.utils.chaos import CHAOS
+CHAOS.rule("broker.replica", 1.0, "kill", at=kill_at)
+for seq in range(1, 40):
+    store.append(seq, b"payload-%d" % seq)
+    ack.write(f"{seq}\n"); ack.flush(); os.fsync(ack.fileno())
+print("done", flush=True)
+"""
+
+
+class TestIssue15CrashPoints:
+    pytestmark = pytest.mark.chaos
+
+    def _run_child(self, src, args, extra_env=None, timeout=120):
+        env = dict(os.environ)
+        env.pop("GREPTIME_CHAOS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env.update(extra_env or {})
+        p = subprocess.run([sys.executable, "-c", src, *args],
+                           capture_output=True, text=True, env=env,
+                           timeout=timeout)
+        return p.returncode, p.stdout + p.stderr
+
+    def test_kill_mid_scrub_repair_reopens_bit_exact(self, tmp_path):
+        """The scrubber dies BETWEEN quarantining a rotted SST and
+        committing its re-flushed replacement.  Reopen self-heals
+        through the PR-9 verified-read path: zero acked loss, bit-exact
+        vs the uninterrupted twin."""
+        twin_home = str(tmp_path / "twin")
+        eng = RegionEngine(twin_home)
+        region = eng.create_region(1, cpu_schema())
+        write_rows(region, n=12)
+        region.flush()
+        want = scan_tuples(region)
+        eng.close()
+        victim_home = str(tmp_path / "victim")
+        rc, out = self._run_child(_SCRUB_KILL_CHILD, [victim_home])
+        assert rc == 137, out
+        assert "acked" in out and "survived" not in out
+        eng2 = RegionEngine(victim_home)
+        got = scan_tuples(eng2.open_region(1))
+        assert got == want
+        eng2.close()
+        # and a post-recovery scrub leaves the region permanently clean
+        from greptimedb_tpu.storage.scrubber import Scrubber
+
+        eng3 = RegionEngine(victim_home)
+        eng3.open_region(1)
+        assert Scrubber(eng3, interval_s=0, batch=100).run_sweep()[
+            "corrupt"] == 0
+        eng3.close()
+
+    @pytest.mark.parametrize("kill_at", [7, 8, 9])
+    def test_kill_at_broker_replica_boundaries_zero_acked_loss(
+            self, tmp_path, kill_at):
+        """Kill the writer at each per-replica append boundary of one
+        quorum append (before replica 1/2/3 of the 3rd record): every
+        ACKED sequence must replay from the surviving copies."""
+        from greptimedb_tpu.storage.remote_wal import (
+            RemoteLogStore, SharedLogBroker,
+        )
+
+        root = str(tmp_path / f"broker{kill_at}")
+        ack_path = str(tmp_path / f"acks{kill_at}")
+        rc, out = self._run_child(
+            _BROKER_KILL_CHILD, [root, ack_path, str(kill_at)])
+        assert rc == 137, out
+        acked = [int(x) for x in open(ack_path).read().split()]
+        assert acked, "the kill fired before anything was acked"
+        broker = SharedLogBroker(root, replicas=3)
+        store = RemoteLogStore(broker, region_id=9)
+        replayed = {s: p for s, p in store.replay(0, repair=True)}
+        for seq in acked:  # zero acked loss, bit-exact payloads
+            assert replayed.get(seq) == b"payload-%d" % seq
+        # the topic keeps serving appends after recovery
+        nxt = max(replayed) + 1
+        store.append(nxt, b"post-recovery")
+        assert (nxt, b"post-recovery") in [
+            (s, p) for s, p in store.replay(0)]
+        broker.close()

@@ -1,0 +1,482 @@
+"""Epoch-fenced object-storage writes (ISSUE 15 tentpole 2).
+
+Four layers, bottom-up:
+
+- the **conditional-put surface** (``write_if``/``head``) behaves
+  identically across FsObjectStore / MemoryObjectStore / S3ObjectStore
+  (the Mock server implements the real 412 wire semantics);
+- the **S3 cache revalidation** satellite: a second node's delete or
+  replace of a manifest-prefix object is seen through the first node's
+  write-through cache (two stores, one bucket);
+- **manifest fencing**: two leaders racing on one shared manifest
+  cannot interleave deltas — the loser raises FencedError, the winner's
+  history reopens linear (the PINNED no-interleave test), including the
+  end-to-end phi-false-positive cluster scenario (zombie leader revives
+  after failover, its flush is refused, zero acked loss);
+- the **s3.cas crash window**: a conditional put that lands remotely
+  but errors before the ack ("failed but landed") recovers exactly —
+  the retry classifies its own orphan, never fences the rightful
+  leader.
+"""
+
+import os
+
+import pytest
+
+from greptimedb_tpu.datatypes import (
+    ColumnSchema, ConcreteDataType as T, Schema, SemanticType as S,
+)
+from greptimedb_tpu.errors import FencedError
+from greptimedb_tpu.storage.manifest import Manifest, _decode_file
+from greptimedb_tpu.storage.object_store import (
+    FsObjectStore, MemoryObjectStore, content_etag,
+)
+from greptimedb_tpu.utils.chaos import CHAOS
+
+
+def schema():
+    return Schema((
+        ColumnSchema("h", T.STRING, S.TAG),
+        ColumnSchema("ts", T.TIMESTAMP_MILLISECOND, S.TIMESTAMP),
+        ColumnSchema("v", T.FLOAT64, S.FIELD),
+    ))
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    CHAOS.reset()
+    yield
+    CHAOS.reset()
+
+
+@pytest.fixture()
+def s3_pair(tmp_path):
+    """One mock bucket, two independent S3ObjectStores with their own
+    write-through caches (two datanodes sharing object storage)."""
+    from greptimedb_tpu.storage.s3 import MockS3Server, S3ObjectStore
+
+    srv = MockS3Server()
+    try:
+        a = S3ObjectStore(srv.endpoint, "bkt", access_key="k",
+                          secret_key="s", cache_dir=str(tmp_path / "ca"))
+        b = S3ObjectStore(srv.endpoint, "bkt", access_key="k",
+                          secret_key="s", cache_dir=str(tmp_path / "cb"))
+        yield srv, a, b
+    finally:
+        srv.stop()
+
+
+def _stores(tmp_path):
+    from greptimedb_tpu.storage.s3 import MockS3Server, S3ObjectStore
+
+    fs = FsObjectStore(str(tmp_path / "fs"))
+    mem = MemoryObjectStore()
+    srv = MockS3Server()
+    s3 = S3ObjectStore(srv.endpoint, "bkt", access_key="k", secret_key="s")
+    return [("fs", fs, None), ("memory", mem, None), ("s3", s3, srv)]
+
+
+class TestConditionalPut:
+    def test_cas_semantics_identical_across_backends(self, tmp_path):
+        for name, store, srv in _stores(tmp_path):
+            try:
+                # create-only: first wins, second is fenced
+                store.write_if("x/obj", b"one", if_none_match=True)
+                with pytest.raises(FencedError):
+                    store.write_if("x/obj", b"two", if_none_match=True)
+                assert store.read("x/obj") == b"one", name
+                # etag CAS: matching etag replaces, stale etag is fenced
+                store.write_if("x/obj", b"two",
+                               if_match=content_etag(b"one"))
+                assert store.read("x/obj") == b"two", name
+                with pytest.raises(FencedError):
+                    store.write_if("x/obj", b"three",
+                                   if_match=content_etag(b"one"))
+                # CAS against a missing object is fenced, not created
+                with pytest.raises(FencedError):
+                    store.write_if("x/gone", b"z",
+                                   if_match=content_etag(b"z"))
+                assert not store.exists("x/gone"), name
+                # head: etag + length; None for missing
+                h = store.head("x/obj")
+                assert h == {"etag": content_etag(b"two"), "length": 3}, name
+                assert store.head("x/gone") is None, name
+                # exactly one precondition required
+                with pytest.raises(ValueError):
+                    store.write_if("x/obj", b"w")
+                with pytest.raises(ValueError):
+                    store.write_if("x/obj", b"w", if_match="e",
+                                   if_none_match=True)
+            finally:
+                if srv is not None:
+                    srv.stop()
+
+    def test_racing_creators_resolve_to_one_winner(self, tmp_path):
+        import threading
+
+        store = FsObjectStore(str(tmp_path / "race"))
+        outcomes = []
+
+        def claim(tag):
+            try:
+                store.write_if("m/delta-1", tag, if_none_match=True)
+                outcomes.append(("won", tag))
+            except FencedError:
+                outcomes.append(("lost", tag))
+
+        ts = [threading.Thread(target=claim, args=(f"w{i}".encode(),))
+              for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wins = [o for o in outcomes if o[0] == "won"]
+        assert len(wins) == 1
+        assert store.read("m/delta-1") == wins[0][1]
+
+
+class TestS3CacheRevalidation:
+    """Satellite: exists()/read() must not trust the per-node cache for
+    manifest-prefix paths after another node deleted/replaced the
+    object remotely."""
+
+    def test_remote_replace_is_seen_through_the_cache(self, s3_pair):
+        _srv, a, b = s3_pair
+        path = "region_7/manifest/delta-00000000000000000001.json"
+        a.write(path, b"v1")
+        assert b.read(path) == b"v1"  # b's cache now holds v1
+        a.write(path, b"v2-longer")
+        assert b.read(path) == b"v2-longer"  # revalidated, not stale
+        # same length, different bytes: the ETag (not length) catches it
+        a.write(path, b"v3-longer")
+        assert b.read(path) == b"v3-longer"
+
+    def test_remote_delete_is_seen_through_the_cache(self, s3_pair):
+        from greptimedb_tpu.errors import StorageError
+
+        _srv, a, b = s3_pair
+        path = "region_7/manifest/delta-00000000000000000002.json"
+        a.write(path, b"v1")
+        assert b.exists(path) and b.read(path) == b"v1"
+        a.delete(path)
+        assert not b.exists(path)
+        with pytest.raises(StorageError):
+            b.read(path)
+        # the stale cache file itself was evicted
+        assert not os.path.exists(b._cache_path(path))
+
+    def test_immutable_paths_keep_the_zero_roundtrip_hit(self, s3_pair):
+        """SSTs are uuid-named and never rewritten: their cache hits
+        must stay free (no HEAD per read)."""
+        _srv, a, b = s3_pair
+        path = "region_7/sst/abc123.parquet"
+        a.write(path, b"sstbytes")
+        assert b.read(path) == b"sstbytes"
+        calls = []
+        real = b._request
+        b._request = lambda *a_, **k: (calls.append(a_), real(*a_, **k))[1]
+        assert b.read(path) == b"sstbytes"
+        assert calls == []  # pure cache hit, zero round trips
+        b._request = real
+
+    def test_watermark_marker_also_revalidates(self, s3_pair):
+        _srv, a, b = s3_pair
+        path = "broker/region_5.watermarks.json"
+        a.write(path, b"{}")
+        assert b.read(path) == b"{}"
+        a.write(path, b'{"5": 10}')
+        assert b.read(path) == b'{"5": 10}'
+
+
+class TestManifestFencing:
+    def _open(self, store, rid=1):
+        return Manifest.open(store, f"region_{rid}/manifest")
+
+    def test_pinned_no_interleave_two_leaders_one_store(self, tmp_path):
+        """THE acceptance pin: two leaders racing on shared storage
+        cannot interleave manifest deltas — the fenced loser raises, the
+        winner's history is linear, zero committed actions lost."""
+        store = FsObjectStore(str(tmp_path / "shared"))
+        old = self._open(store)
+        old.set_fence(1)
+        old.commit({"kind": "schema", "schema": schema().to_dict()})
+        old.commit({"kind": "options", "options": {"ttl_ms": 1}})
+        # new leader takes over (reads the old leader's full history)
+        new = self._open(store)
+        new.set_fence(2)
+        new.commit({"kind": "options", "options": {"ttl_ms": 2}})
+        # the zombie's delayed writes are fenced out — BOTH the version
+        # it thinks is next (CAS-create conflict) and any later one
+        # (epoch verify)
+        with pytest.raises(FencedError):
+            old.commit({"kind": "options", "options": {"ttl_ms": 99}})
+        with pytest.raises(FencedError):
+            old.checkpoint()
+        new.commit({"kind": "options", "options": {"ttl_ms": 3}})
+        # winner's history reopens LINEAR: gapless versions, no zombie
+        # action ever applied
+        reopened = self._open(store)
+        assert reopened.version == new.version
+        assert reopened.state.options["ttl_ms"] == 3
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+
+        assert REGISTRY.value("greptime_fence_rejected_total",
+                              ("delta",)) >= 1.0
+
+    def test_zombie_cannot_claim_a_stale_epoch(self, tmp_path):
+        store = FsObjectStore(str(tmp_path / "shared"))
+        m1 = self._open(store)
+        m1.set_fence(5)
+        m2 = self._open(store)
+        with pytest.raises(FencedError):
+            m2.set_fence(4)  # stale mint: fenced at claim time
+        m2b = self._open(store)
+        m2b.set_fence(5)  # idempotent re-claim of OUR epoch (crash)
+        assert m2b.fence_epoch == 5
+
+    def test_gc_ab_window_is_fenced_by_the_epoch_marker(self, tmp_path):
+        """After the new leader checkpoints and GCs, the version space
+        below the checkpoint is EMPTY — a zombie's create-only write
+        would succeed there; the epoch verify must stop it."""
+        import greptimedb_tpu.storage.manifest as mmod
+
+        store = FsObjectStore(str(tmp_path / "shared"))
+        old = self._open(store)
+        old.set_fence(1)
+        old.commit({"kind": "schema", "schema": schema().to_dict()})
+        v_next = old.version + 1  # the version the zombie would write
+        new = self._open(store)
+        new.set_fence(2)
+        orig = mmod.CHECKPOINT_EVERY
+        mmod.CHECKPOINT_EVERY = 2
+        try:
+            new.commit({"kind": "options", "options": {"a": 1}})
+            new.commit({"kind": "options", "options": {"a": 2}})  # + ckpt
+        finally:
+            mmod.CHECKPOINT_EVERY = orig
+        # deltas <= checkpoint version are GC'd — including v_next
+        assert not store.exists(
+            f"region_1/manifest/delta-{v_next:020d}.json")
+        with pytest.raises(FencedError):
+            old.commit({"kind": "options", "options": {"zombie": True}})
+        reopened = self._open(store)
+        assert "zombie" not in reopened.state.options
+
+    def test_fencing_off_knob_restores_plain_writes(self, tmp_path,
+                                                    monkeypatch):
+        from greptimedb_tpu.storage.region import RegionEngine
+
+        monkeypatch.setenv("GREPTIME_S3_FENCING", "off")
+        eng = RegionEngine(str(tmp_path / "home"))
+        region = eng.create_region(1, schema())
+        region.install_fence(7)  # no-op under the knob
+        assert region.fence_epoch is None
+        assert region.manifest.fence_epoch is None
+        region.write({"h": ["a"], "ts": [1000], "v": [1.0]})
+        region.flush()
+
+    def test_unfenced_manifest_behavior_unchanged(self, tmp_path):
+        """Standalone regions never arm a fence: plain writes, no EPOCH
+        marker, no extra reads."""
+        store = FsObjectStore(str(tmp_path / "solo"))
+        m = self._open(store)
+        m.commit({"kind": "schema", "schema": schema().to_dict()})
+        assert not store.exists("region_1/manifest/EPOCH")
+
+
+class TestS3CasCrashWindow:
+    """Satellite crash point: the CAS lands remotely but the ack never
+    comes back (error or kill between CAS and cache fill)."""
+
+    def _fenced_manifest(self, s3_pair):
+        _srv, a, _b = s3_pair
+        m = Manifest.open(a, "region_1/manifest")
+        m.set_fence(1)
+        m.commit({"kind": "schema", "schema": schema().to_dict()})
+        return a, m
+
+    def test_failed_but_landed_commit_recovers(self, s3_pair):
+        store, m = self._fenced_manifest(s3_pair)
+        v = m.version
+        CHAOS.rule("s3.cas", 1.0, "error", at=1)
+        from greptimedb_tpu.utils.chaos import ChaosError
+
+        with pytest.raises(ChaosError):
+            m.commit({"kind": "options", "options": {"n": 1}})
+        # memory stayed at the on-disk-acked version; the delta LANDED
+        assert m.version == v
+        # the retry (same or different action content) must succeed —
+        # the orphan is this leader's own, classified and clobbered
+        m.commit({"kind": "options", "options": {"n": 2}})
+        assert m.version == v + 1
+        reopened = Manifest.open(store, "region_1/manifest")
+        assert reopened.state.options == {"n": 2}
+        assert reopened.version == m.version
+
+    def test_kill_between_cas_and_cache_fill_reopens_exact(
+            self, s3_pair, tmp_path):
+        """Subprocess kill at s3.cas (the PR-9 crash-point matrix shape,
+        extended): the child dies the instant its conditional put lands;
+        a fresh engine over the same bucket must see the landed delta
+        and reopen bit-exact vs an uninterrupted twin."""
+        import subprocess
+        import sys
+
+        srv, _a, _b = s3_pair
+        child = r"""
+import sys
+from greptimedb_tpu.datatypes import (
+    ColumnSchema, ConcreteDataType as T, Schema, SemanticType as S)
+from greptimedb_tpu.storage.region import RegionEngine
+from greptimedb_tpu.storage.s3 import S3ObjectStore
+
+endpoint, cache = sys.argv[1], sys.argv[2]
+store = S3ObjectStore(endpoint, "bkt", access_key="k", secret_key="s",
+                      cache_dir=cache)
+eng = RegionEngine(cache + "_home", store=store)
+schema = Schema((ColumnSchema("h", T.STRING, S.TAG),
+                 ColumnSchema("ts", T.TIMESTAMP_MILLISECOND, S.TIMESTAMP),
+                 ColumnSchema("v", T.FLOAT64, S.FIELD)))
+region = eng.create_region(1, schema)
+region.install_fence(1)
+region.write({"h": ["a", "b"], "ts": [1000, 2000], "v": [1.0, 2.0]})
+print("acked", flush=True)
+region.flush()   # manifest deltas ride conditional puts now
+print("done", flush=True)
+"""
+        env = dict(os.environ)
+        env.pop("GREPTIME_CHAOS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        # twin: uninterrupted
+        out = subprocess.run(
+            [sys.executable, "-c", child, srv.endpoint,
+             str(tmp_path / "twin_cache")],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert out.returncode == 0 and "done" in out.stdout, out.stdout
+        from greptimedb_tpu.storage.region import RegionEngine
+        from greptimedb_tpu.storage.s3 import S3ObjectStore
+
+        twin_store = S3ObjectStore(srv.endpoint, "bkt", prefix="twin",
+                                   access_key="k", secret_key="s")
+        # (twin used the same bucket root — snapshot its rows first)
+        twin_eng = RegionEngine(
+            str(tmp_path / "twin_ro"),
+            store=S3ObjectStore(srv.endpoint, "bkt", access_key="k",
+                                secret_key="s"))
+        twin_rows = twin_eng.open_region(1).scan_host()
+        want = sorted(zip(twin_rows["h"].tolist(),
+                          twin_rows["ts"].tolist(),
+                          twin_rows["v"].tolist()))
+        # victim: fresh bucket state, kill at the flush's EDIT-delta CAS
+        # (call 1 = the EPOCH claim, 2 = the dicts delta, 3 = the edit
+        # delta that makes the flushed SST part of history) — the
+        # data-bearing conditional put lands remotely, the ack never
+        # comes back
+        for k in list(srv.store):
+            del srv.store[k]
+        env["GREPTIME_CHAOS"] = "s3.cas=1:kill:at=3"
+        out = subprocess.run(
+            [sys.executable, "-c", child, srv.endpoint,
+             str(tmp_path / "victim_cache")],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert out.returncode == 137, out.stdout + out.stderr
+        assert "acked" in out.stdout
+        # reopen over the same bucket: the landed CAS delta is part of
+        # history; acked rows replay bit-exact vs the twin
+        eng = RegionEngine(
+            str(tmp_path / "reopen"),
+            store=S3ObjectStore(srv.endpoint, "bkt", access_key="k",
+                                secret_key="s"))
+        rows = eng.open_region(1).scan_host()
+        got = sorted(zip(rows["h"].tolist(), rows["ts"].tolist(),
+                         rows["v"].tolist()))
+        assert got == want
+
+
+class TestClusterEpochFencing:
+    """End-to-end: the PR-6 phi-false-positive scenario, now backstopped
+    at the STORAGE layer."""
+
+    def test_failover_mints_epoch_and_fences_the_zombie(self, tmp_path):
+        """The original leader here is EPOCH-LESS (opened before any
+        mint — the worst case): the failover's minted claim must fence
+        it anyway, via the epoch-less-writer backstops on both the
+        manifest and the broker."""
+        from tests.test_meta import (
+            _migration_cluster, _seed_migration_region,
+        )
+
+        ms, nodes, kv = _migration_cluster(tmp_path, shared_home=True)
+        rid = _seed_migration_region(ms, nodes)
+        assert nodes[0].engine.regions[rid].fence_epoch is None
+        acked = nodes[0].engine.regions[rid].scan_host()
+        # the leader "dies" (phi false positive: really a partition/GC
+        # pause — the process is still running and will come back)
+        nodes[0].alive = False
+        out = ms.failover_region(rid, now_ms=50.0)
+        assert out["to_node"] == 1
+        new_region = nodes[1].engine.regions[rid]
+        assert new_region.fence_epoch is not None
+        # zero acked loss: everything the old leader acked is served
+        host = new_region.scan_host()
+        assert sorted(host["h"].tolist()) == sorted(acked["h"].tolist())
+        # the zombie revives believing it still leads; BOTH its write
+        # surfaces are fenced: the broker append refuses (its client
+        # sees the failure instead of a false ack — the shared log is
+        # the durability truth), and a flush's manifest commit refuses
+        nodes[0].alive = True
+        zombie = nodes[0].engine.regions[rid]
+        with pytest.raises(FencedError):
+            zombie.write({"h": ["zz"], "ts": [9000], "v": [9.0]})
+        with pytest.raises(FencedError):
+            zombie.flush()  # pre-failover memtable tail: commit fenced
+        # the new leader's history stays linear and serves writes
+        nodes[1].write(rid, {"h": ["d"], "ts": [5000], "v": [5.0]}, 60.0)
+        assert "zz" not in nodes[1].engine.regions[rid].scan_host(
+            )["h"].tolist()
+
+    def test_broker_append_fences_stale_epoch(self, tmp_path):
+        from greptimedb_tpu.storage.remote_wal import (
+            RemoteLogStore, SharedLogBroker,
+        )
+
+        broker = SharedLogBroker(str(tmp_path / "broker"))
+        old = RemoteLogStore(broker, 5)
+        old.set_fence(1)
+        old.append(1, b"one")
+        new = RemoteLogStore(broker, 5)
+        new.set_fence(2)
+        new.append(2, b"two")
+        # the zombie's append is REFUSED — its client sees the failure
+        # instead of a false ack into a forked history
+        with pytest.raises(FencedError):
+            old.append(3, b"zombie")
+        with pytest.raises(FencedError):
+            old.truncate(2)  # stale watermark must not prune
+        assert [s for s, _ in new.replay(0, repair=True)] == [1, 2]
+
+    def test_broker_fencing_across_instances(self, tmp_path):
+        """Two broker INSTANCES over one directory (separate processes
+        in production): the claim persists in the watermark marker, and
+        the zombie's instance re-reads it on mtime change."""
+        from greptimedb_tpu.storage.remote_wal import (
+            RemoteLogStore, SharedLogBroker,
+        )
+
+        b1 = SharedLogBroker(str(tmp_path / "broker"))
+        old = RemoteLogStore(b1, 5)
+        old.set_fence(1)
+        old.append(1, b"one")
+        b2 = SharedLogBroker(str(tmp_path / "broker"))
+        new = RemoteLogStore(b2, 5)
+        new.set_fence(2)
+        with pytest.raises(FencedError):
+            old.append(2, b"zombie")
+
+    def test_mint_epoch_monotone(self, tmp_path):
+        from greptimedb_tpu.meta.kv import MemoryKv
+        from greptimedb_tpu.meta.cluster import Metasrv
+
+        ms = Metasrv(MemoryKv())
+        assert [ms.mint_epoch(1) for _ in range(3)] == [1, 2, 3]
+        assert ms.mint_epoch(2) == 1  # per-region counters

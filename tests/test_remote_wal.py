@@ -112,6 +112,22 @@ class TestMultiplexedTopics:
         assert [seq for seq, _p in r1.replay(6)] == []
         assert [seq for seq, _p in r2.replay(4)] == [4, 5]
 
+    def test_replication_multiplexed_prune_intact(self, tmp_path,
+                                                  small_segments):
+        """Replication composes with topic multiplexing: quorum appends,
+        per-region watermark pruning, per-region replay."""
+        root = str(tmp_path / "broker")
+        broker = SharedLogBroker(root, topics_per_node=1, replicas=3)
+        r1 = RemoteLogStore(broker, region_id=1)
+        r2 = RemoteLogStore(broker, region_id=2)
+        for seq in range(1, 6):
+            r1.append(seq, b"r1-%d" % seq)
+            r2.append(seq, b"r2-%d" % seq)
+        r1.truncate(6)
+        assert [seq for seq, _p in r2.replay(0)] == [1, 2, 3, 4, 5]
+        r2.truncate(4)
+        assert [seq for seq, _p in r2.replay(4)] == [4, 5]
+
     def test_promotion_reacquires_topic_end(self, tmp_path,
                                             small_segments):
         """A second broker instance (the follower's) caches the topic end
@@ -133,3 +149,194 @@ class TestMultiplexedTopics:
         # offsets stayed monotone: pruning by watermark keeps exactness
         follower.truncate(3)
         assert [seq for seq, _p in follower.replay(0)] == [3]
+
+
+# ---------------------------------------------------------------------------
+# Broker-side replication (ISSUE 15 tentpole 3): quorum appends,
+# survive-any-single-copy replay, read-repair, chaos coverage.
+# ---------------------------------------------------------------------------
+
+
+def _replica_topic_dir(root, topic, i):
+    return (os.path.join(root, topic) if i == 0
+            else os.path.join(root, f".replica{i}", topic))
+
+
+def _corrupt_middle(path):
+    """Flip bytes in the middle of a segment (interior corruption)."""
+    with open(path, "r+b") as f:
+        data = bytearray(f.read())
+        mid = len(data) // 2
+        for i in range(mid, min(mid + 8, len(data))):
+            data[i] ^= 0xFF
+        f.seek(0)
+        f.write(bytes(data))
+
+
+class TestBrokerReplication:
+    def _seed(self, root, n=8, replicas=3):
+        broker = SharedLogBroker(root, replicas=replicas)
+        store = RemoteLogStore(broker, region_id=9)
+        for seq in range(1, n + 1):
+            store.append(seq, b"payload-%d" % seq)
+        return broker, store
+
+    def test_replicas_hold_identical_records(self, tmp_path):
+        root = str(tmp_path / "broker")
+        broker, store = self._seed(root)
+        for i in range(3):
+            d = _replica_topic_dir(root, store.topic, i)
+            assert os.path.isdir(d) and any(
+                f.endswith(".wal") for f in os.listdir(d)), i
+        from greptimedb_tpu.storage.wal import FileLogStore
+
+        views = []
+        for i in range(3):
+            log = FileLogStore(_replica_topic_dir(root, store.topic, i))
+            views.append(list(log.replay(0, repair=False)))
+            log.close()
+        assert views[0] == views[1] == views[2]
+        assert len(views[0]) == 8
+
+    def test_replay_survives_losing_any_single_replica(self, tmp_path):
+        import shutil
+
+        for lost in range(3):
+            root = str(tmp_path / f"broker{lost}")
+            broker, store = self._seed(root)
+            broker.close()
+            shutil.rmtree(_replica_topic_dir(root, store.topic, lost))
+            broker2 = SharedLogBroker(root, replicas=3)
+            store2 = RemoteLogStore(broker2, region_id=9)
+            assert [s for s, _p in store2.replay(0, repair=False)] == list(
+                range(1, 9)), f"lost replica {lost}"
+            broker2.close()
+
+    def test_replay_survives_corrupting_any_single_replica(self, tmp_path):
+        for victim in range(3):
+            root = str(tmp_path / f"broker{victim}")
+            broker, store = self._seed(root)
+            broker.close()
+            d = _replica_topic_dir(root, store.topic, victim)
+            for fn in os.listdir(d):
+                if fn.endswith(".wal"):
+                    _corrupt_middle(os.path.join(d, fn))
+            broker2 = SharedLogBroker(root, replicas=3)
+            store2 = RemoteLogStore(broker2, region_id=9)
+            got = [(s, p) for s, p in store2.replay(0, repair=True)]
+            assert [s for s, _ in got] == list(range(1, 9)), (
+                f"corrupt replica {victim}")
+            assert got[0][1] == b"payload-1"
+            broker2.close()
+
+    def test_read_repair_backfills_lagging_replica(self, tmp_path):
+        import shutil
+
+        root = str(tmp_path / "broker")
+        broker, store = self._seed(root)
+        broker.close()
+        victim_dir = _replica_topic_dir(root, store.topic, 2)
+        shutil.rmtree(victim_dir)
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+
+        before = REGISTRY.value("greptime_broker_read_repair_total") or 0.0
+        broker2 = SharedLogBroker(root, replicas=3)
+        store2 = RemoteLogStore(broker2, region_id=9)
+        assert len(list(store2.replay(0, repair=True))) == 8
+        assert REGISTRY.value("greptime_broker_read_repair_total") >= (
+            before + 8)
+        # the repaired replica now holds the full history on its own
+        from greptimedb_tpu.storage.wal import FileLogStore
+
+        log = FileLogStore(victim_dir)
+        assert len(list(log.replay(0, repair=False))) == 8
+        log.close()
+        broker2.close()
+
+    def test_follower_read_never_repairs(self, tmp_path):
+        import shutil
+
+        root = str(tmp_path / "broker")
+        broker, store = self._seed(root)
+        broker.close()
+        victim_dir = _replica_topic_dir(root, store.topic, 1)
+        shutil.rmtree(victim_dir)
+        broker2 = SharedLogBroker(root, replicas=3)
+        follower = RemoteLogStore(broker2, region_id=9)
+        assert len(list(follower.replay(0, repair=False))) == 8
+        # read-only replay backfilled NOTHING (well, _logs_for recreated
+        # the empty dir — but no records were written into it)
+        from greptimedb_tpu.storage.wal import FileLogStore
+
+        log = FileLogStore(victim_dir)
+        assert list(log.replay(0, repair=False)) == []
+        log.close()
+        broker2.close()
+
+    def test_quorum_append_tolerates_one_failing_replica(self, tmp_path):
+        from greptimedb_tpu.utils.chaos import CHAOS
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+
+        root = str(tmp_path / "broker")
+        broker = SharedLogBroker(root, replicas=3)
+        store = RemoteLogStore(broker, region_id=9)
+        store.append(1, b"ok")
+        try:
+            # the append's SECOND replica call errors: exactly one
+            # replica misses the record, the 2/3 quorum still acks
+            CHAOS.rule("broker.replica", 1.0, "error", at=2)
+            store.append(2, b"with-one-down")
+        finally:
+            CHAOS.reset()
+        store.append(3, b"healed-next")
+        assert [s for s, _p in store.replay(0, repair=True)] == [1, 2, 3]
+        assert REGISTRY.value("greptime_broker_replica_append_total",
+                              ("failed",)) >= 1.0
+
+    def test_append_fails_loudly_below_quorum(self, tmp_path):
+        from greptimedb_tpu.errors import StorageError
+        from greptimedb_tpu.utils.chaos import CHAOS
+
+        root = str(tmp_path / "broker")
+        broker = SharedLogBroker(root, replicas=3)
+        store = RemoteLogStore(broker, region_id=9)
+        store.append(1, b"ok")
+        try:
+            CHAOS.rule("broker.replica", 1.0, "error")  # ALL replicas
+            with pytest.raises(StorageError):
+                store.append(2, b"nobody-heard-this")
+        finally:
+            CHAOS.reset()
+        # nothing acked, nothing half-visible after the quorum failure
+        store.append(2, b"retried")
+        assert [p for _s, p in store.replay(0, repair=True)] == [
+            b"ok", b"retried"]
+
+    def test_single_replica_keeps_legacy_layout(self, tmp_path):
+        root = str(tmp_path / "broker")
+        broker = SharedLogBroker(root, replicas=1)
+        store = RemoteLogStore(broker, region_id=9)
+        store.append(1, b"x")
+        assert os.path.isdir(os.path.join(root, store.topic))
+        assert not os.path.isdir(os.path.join(root, ".replica1"))
+        broker.close()
+
+    def test_raising_replication_factor_adopts_legacy_data(self, tmp_path):
+        """replicas=1 history becomes replica 0; read-repair backfills
+        the new copies on the first owner replay."""
+        root = str(tmp_path / "broker")
+        b1 = SharedLogBroker(root, replicas=1)
+        s1 = RemoteLogStore(b1, region_id=9)
+        for seq in (1, 2, 3):
+            s1.append(seq, b"old-%d" % seq)
+        b1.close()
+        b3 = SharedLogBroker(root, replicas=3)
+        s3 = RemoteLogStore(b3, region_id=9)
+        assert [s for s, _p in s3.replay(0, repair=True)] == [1, 2, 3]
+        s3.append(4, b"new-4")
+        from greptimedb_tpu.storage.wal import FileLogStore
+
+        log = FileLogStore(_replica_topic_dir(root, s3.topic, 2))
+        assert len(list(log.replay(0, repair=False))) == 4
+        log.close()
+        b3.close()

@@ -1,0 +1,456 @@
+"""Online background integrity scrubber (ISSUE 15 tentpole 1).
+
+The acceptance story: seeded bit rot in a COLD artifact (SST, manifest
+file, WAL segment, grid snapshot, S3 cache entry) is found and repaired
+by the scrubber BEFORE any query or restart trips over it —
+scrub-then-query serves correct bytes, ``greptime_durability_
+repaired_total`` increments, restarts that would have quarantined or
+silently truncated now open clean.  Pacing pins: the scrubber is
+idle-capacity work that yields to interactive queries and resumes
+mid-sweep across restarts via its persisted cursor.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.storage.region import RegionEngine
+from greptimedb_tpu.storage.scrubber import Scrubber
+from greptimedb_tpu.utils.chaos import CHAOS
+from greptimedb_tpu.utils.telemetry import REGISTRY
+
+from tests.test_durability import (  # shared PR-9 fixtures
+    cpu_schema, record_offsets, scan_tuples, wal_segment, write_rows,
+    _REC_HDR,
+)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    CHAOS.reset()
+    yield
+    CHAOS.reset()
+
+
+def _flip_sst_bytes(store, meta):
+    """One flipped byte mid-file: silent rot a read would detect, the
+    scrubber must find first."""
+    data = bytearray(store.read(meta.path))
+    data[len(data) // 2] ^= 0xFF
+    # bypass the write discipline on purpose: rot, not a write
+    with open(store.local_path(meta.path), "r+b") as f:
+        f.write(bytes(data))
+
+
+class TestSstScrub:
+    def test_cold_sst_rot_repaired_before_any_query(self, tmp_data_dir):
+        """THE acceptance pin (a): scrub-then-query serves correct
+        bytes; the repair counter increments; no query ever saw the
+        corruption."""
+        engine = RegionEngine(tmp_data_dir)
+        region = engine.create_region(1, cpu_schema())
+        write_rows(region, n=12)
+        region.flush()
+        expect = scan_tuples(region)
+        meta = region.sst_files[0]
+        _flip_sst_bytes(engine.store, meta)
+        r0 = REGISTRY.value("greptime_durability_repaired_total",
+                            ("sst", "wal")) or 0.0
+        scrub = Scrubber(engine, interval_s=0, batch=100)
+        out = scrub.run_sweep()
+        assert out["corrupt"] == 1
+        # repaired from the WAL re-flush (the records are still in the
+        # active segment) — BEFORE any query read the region
+        assert REGISTRY.value("greptime_durability_repaired_total",
+                              ("sst", "wal")) == r0 + 1
+        assert scan_tuples(region) == expect
+        # the rotted original is preserved, never deleted
+        assert any(p.endswith(".quarantine")
+                   for p in engine.store.list("region_1/sst"))
+        # a second sweep over the repaired region is clean
+        assert scrub.run_sweep()["corrupt"] == 0
+        engine.close()
+
+    def test_clean_region_sweeps_clean(self, tmp_data_dir):
+        engine = RegionEngine(tmp_data_dir)
+        region = engine.create_region(1, cpu_schema())
+        write_rows(region, n=8)
+        region.flush()
+        write_rows(region, n=8, t0=100_000)
+        scrub = Scrubber(engine, interval_s=0, batch=100)
+        out = scrub.run_sweep()
+        assert out["corrupt"] == 0 and out["items"] >= 3
+        assert scrub.sweeps == 1
+        engine.close()
+
+
+class TestManifestScrub:
+    def test_rotted_delta_repaired_before_restart_needs_it(
+            self, tmp_data_dir):
+        """Without the scrubber this rot is found at the NEXT OPEN —
+        possibly quarantining the region.  The scrubber repairs it from
+        live state: quarantine + forced verified checkpoint, and the
+        restart opens clean."""
+        engine = RegionEngine(tmp_data_dir)
+        region = engine.create_region(1, cpu_schema())
+        write_rows(region, n=6)
+        region.flush()
+        expect = scan_tuples(region)
+        deltas = [p for p in engine.store.list("region_1/manifest")
+                  if "/delta-" in p]
+        victim = engine.store.local_path(deltas[-1])
+        data = bytearray(open(victim, "rb").read())
+        data[len(data) // 2] ^= 0x10
+        open(victim, "wb").write(bytes(data))
+        r0 = REGISTRY.value(
+            "greptime_durability_repaired_total",
+            ("manifest", "scrub_checkpoint")) or 0.0
+        scrub = Scrubber(engine, interval_s=0, batch=100)
+        assert scrub.run_sweep()["corrupt"] >= 1
+        assert REGISTRY.value(
+            "greptime_durability_repaired_total",
+            ("manifest", "scrub_checkpoint")) == r0 + 1
+        # suspect preserved under quarantine/
+        assert any("/quarantine/" in p
+                   for p in engine.store.list("region_1/manifest"))
+        # the quarantined corpse is NOT re-flagged: later sweeps are
+        # clean (no perpetual repair/alert loop, bytes stay preserved)
+        assert scrub.run_sweep()["corrupt"] == 0
+        assert any("/quarantine/" in p
+                   for p in engine.store.list("region_1/manifest"))
+        engine.close()
+        # restart opens CLEAN from the fresh checkpoint — no
+        # ManifestCorruption, no region quarantine, bit-exact rows
+        engine2 = RegionEngine(tmp_data_dir)
+        assert scan_tuples(engine2.open_region(1)) == expect
+        engine2.close()
+
+
+class TestWalScrub:
+    def _region_with_wal_tail(self, home, batches=5):
+        engine = RegionEngine(home)
+        region = engine.create_region(1, cpu_schema())
+        for b in range(batches):
+            write_rows(region, n=6, t0=b * 100_000, v0=b * 10.0)
+        return engine, region
+
+    def _corrupt_seq(self, home, seq):
+        seg = wal_segment(os.path.join(home, "region_1", "wal"))
+        data = bytearray(open(seg, "rb").read())
+        off, _ln = record_offsets(bytes(data))[seq]
+        data[off + _REC_HDR + 5] ^= 0x08
+        open(seg, "wb").write(bytes(data))
+        return seg
+
+    def test_interior_rot_flush_covered_zero_loss(self, tmp_data_dir):
+        """No resync source: the scrubber flushes — the memtable still
+        holds every acked row, so the damaged log becomes irrelevant.
+        Without the scrub, the next crash's replay would raise WalHole
+        (uncovered acked loss)."""
+        engine, region = self._region_with_wal_tail(tmp_data_dir)
+        expect = scan_tuples(region)
+        self._corrupt_seq(tmp_data_dir, seq=3)
+        out = region.scrub_wal()
+        assert out["damage"] == 1 and out["flushed"]
+        assert scan_tuples(region) == expect
+        engine.close(flush=False)
+        # restart replays clean — zero acked loss, no WalHole
+        engine2 = RegionEngine(tmp_data_dir)
+        assert scan_tuples(engine2.open_region(1)) == expect
+        engine2.close()
+
+    def test_interior_rot_resynced_without_flush(self, tmp_data_dir):
+        """With a resync source (follower WAL / remote broker) the lost
+        range re-logs in place — no forced flush, no structure change."""
+        import shutil
+
+        from greptimedb_tpu.storage.durability import resync_from_log_store
+        from greptimedb_tpu.storage.wal import FileLogStore
+
+        engine, region = self._region_with_wal_tail(tmp_data_dir)
+        expect = scan_tuples(region)
+        wal_dir = os.path.join(tmp_data_dir, "region_1", "wal")
+        pristine = str(tmp_data_dir) + "_pristine"
+        region.wal._fh.flush()
+        shutil.copytree(wal_dir, pristine)
+        self._corrupt_seq(tmp_data_dir, seq=3)
+        follower = FileLogStore(pristine)
+        region.wal_resync = resync_from_log_store(follower)
+        out = region.scrub_wal()
+        assert out == {"damage": 1, "repaired": 1, "flushed": False}
+        assert region.sst_files == []  # no forced flush
+        follower.close()
+        engine.close(flush=False)
+        engine2 = RegionEngine(tmp_data_dir)
+        assert scan_tuples(engine2.open_region(1)) == expect
+        engine2.close()
+
+    def test_tail_rot_resynced_into_fresh_segment(self, tmp_data_dir):
+        """Tail rot + a covering resync source: the re-logged records
+        must survive the tail truncation (fresh segment), and the
+        recovery is durable BEFORE the damage drops — a crash anywhere
+        mid-scrub leaves the corruption loud, never silently clean."""
+        import shutil
+
+        from greptimedb_tpu.storage.durability import resync_from_log_store
+        from greptimedb_tpu.storage.wal import FileLogStore
+
+        engine, region = self._region_with_wal_tail(tmp_data_dir,
+                                                    batches=4)
+        expect = scan_tuples(region)
+        wal_dir = os.path.join(tmp_data_dir, "region_1", "wal")
+        pristine = str(tmp_data_dir) + "_pristine"
+        region.wal._fh.flush()
+        shutil.copytree(wal_dir, pristine)
+        self._corrupt_seq(tmp_data_dir, seq=4)  # the newest record
+        follower = FileLogStore(pristine)
+        region.wal_resync = resync_from_log_store(follower)
+        out = region.scrub_wal()
+        assert out == {"damage": 1, "repaired": 1, "flushed": False}
+        assert region.sst_files == []  # repaired in the log, no flush
+        follower.close()
+        engine.close(flush=False)
+        engine2 = RegionEngine(tmp_data_dir)
+        region2 = engine2.open_region(1)
+        assert scan_tuples(region2) == expect  # zero acked loss
+        assert not region2.wal.last_triage  # and the log is clean
+        engine2.close()
+
+    def test_tail_rot_is_acked_loss_not_debris(self, tmp_data_dir):
+        """Bit rot in the LAST record: a crash-time replay would
+        truncate it as torn-tail debris — silently losing an acked
+        batch.  The live scrubber knows everything in the log was
+        acked and flush-covers it instead."""
+        engine, region = self._region_with_wal_tail(tmp_data_dir,
+                                                    batches=4)
+        expect = scan_tuples(region)
+        self._corrupt_seq(tmp_data_dir, seq=4)  # the newest record
+        out = region.scrub_wal()
+        assert out["damage"] == 1 and out["flushed"]
+        engine.close(flush=False)
+        engine2 = RegionEngine(tmp_data_dir)
+        assert scan_tuples(engine2.open_region(1)) == expect  # zero loss
+        engine2.close()
+
+    def test_scrub_wal_noop_on_clean_log(self, tmp_data_dir):
+        engine, region = self._region_with_wal_tail(tmp_data_dir)
+        gen = region.generation
+        assert region.scrub_wal() == {"damage": 0, "repaired": 0,
+                                      "flushed": False}
+        assert region.generation == gen  # zero side effects
+        engine.close()
+
+
+class TestSnapshotScrub:
+    def test_corrupt_snapshot_quarantined(self, tmp_path, tmp_data_dir):
+        from greptimedb_tpu.storage.grid import GridTable, save_grid_snapshot
+
+        engine = RegionEngine(tmp_data_dir)
+        region = engine.create_region(1, cpu_schema())
+        write_rows(region, n=4)
+        table = GridTable(
+            values=np.zeros((1, 3, 4), dtype=np.float32),
+            valid=np.ones((3, 4), dtype=bool),
+            tag_codes={"hostname": np.zeros(3, dtype=np.int32)},
+            ts0=0, step=1000, nt=4, num_series=3,
+            field_names=("v",), dicts={"hostname": ["h0", "h1", "h2"]},
+            no_nan=(True,), dicts_version=1, region_id=1,
+        )
+        snap = str(tmp_path / "grid_snap")
+        save_grid_snapshot(table, region, snap)
+        # rot the tensor container (truncated npz = BadZipFile shape)
+        with open(os.path.join(snap, "tags.npz"), "r+b") as f:
+            f.truncate(10)
+        scrub = Scrubber(engine, interval_s=0, batch=100,
+                         snapshot_dirs=[snap])
+        out = scrub.run_sweep()
+        assert out["corrupt"] == 1
+        assert os.path.exists(os.path.join(snap, "meta.json.quarantine"))
+        # load now refuses instead of crashing → SST-build fallback
+        from greptimedb_tpu.storage.grid import load_grid_snapshot
+
+        assert load_grid_snapshot(snap, region) is None
+        engine.close()
+
+
+class TestS3CacheScrub:
+    def test_stale_cache_entries_evicted(self, tmp_path):
+        from greptimedb_tpu.storage.s3 import MockS3Server, S3ObjectStore
+
+        srv = MockS3Server()
+        try:
+            writer = S3ObjectStore(srv.endpoint, "bkt", access_key="k",
+                                   secret_key="s")
+            cache = str(tmp_path / "cache")
+            store = S3ObjectStore(srv.endpoint, "bkt", access_key="k",
+                                  secret_key="s", cache_dir=cache)
+            store.write("region_1/sst/aaa.parquet", b"old-bytes")
+            store.write("region_1/sst/bbb.parquet", b"keep-bytes")
+            # another node replaces one object and deletes nothing
+            writer.write("region_1/sst/aaa.parquet", b"new-bytes!")
+            engine = RegionEngine(str(tmp_path / "home"), store=store)
+            scrub = Scrubber(engine, interval_s=0, batch=100)
+            out = scrub.run_sweep()
+            assert out["corrupt"] == 1  # the stale entry, evicted
+            assert not os.path.exists(
+                store._cache_path("region_1/sst/aaa.parquet"))
+            assert os.path.exists(
+                store._cache_path("region_1/sst/bbb.parquet"))
+            # next read refetches the fresh remote bytes
+            assert store.read("region_1/sst/aaa.parquet") == b"new-bytes!"
+        finally:
+            srv.stop()
+
+
+class TestPacing:
+    def _engine_with_ssts(self, home, n=4):
+        from greptimedb_tpu.storage.region import RegionOptions
+
+        engine = RegionEngine(home)
+        # compaction off: these tests count exactly n live SST items
+        region = engine.create_region(
+            1, cpu_schema(), RegionOptions(compaction_trigger_files=999))
+        for b in range(n):
+            write_rows(region, n=4, t0=b * 100_000)
+            region.flush()
+        return engine, region
+
+    def test_preemption_pin_zero_items_while_interactive_waits(
+            self, tmp_data_dir):
+        """Acceptance pin (d): interactive pressure preempts the
+        scrubber — a tick under load verifies NOTHING."""
+        engine, _region = self._engine_with_ssts(tmp_data_dir)
+        waiting = [True]
+        scrub = Scrubber(engine, interval_s=0, batch=100,
+                         should_yield=lambda: waiting[0])
+        y0 = REGISTRY.value("greptime_scrub_yield_total") or 0.0
+        assert scrub.tick() is True  # stays hooked
+        assert scrub.items == 0 and scrub.sweeps == 0
+        assert REGISTRY.value("greptime_scrub_yield_total") == y0 + 1
+        # pressure gone: the same tick machinery makes progress
+        waiting[0] = False
+        while scrub.sweeps == 0:
+            scrub.tick()
+        assert scrub.items > 0
+        engine.close()
+
+    def test_yield_mid_batch(self, tmp_data_dir):
+        """Preemption is per-ITEM, not per-tick: pressure arriving mid
+        batch stops the batch."""
+        engine, _region = self._engine_with_ssts(tmp_data_dir)
+        calls = []
+        scrub = Scrubber(engine, interval_s=0, batch=100,
+                         should_yield=lambda: len(calls) >= 2)
+        real = scrub._scrub_item
+        scrub._scrub_item = lambda it: (calls.append(it), real(it))[1]
+        scrub.tick()
+        assert len(calls) == 2  # batch of 100 stopped after 2 items
+        engine.close()
+
+    def test_interval_gates_resweeps(self, tmp_data_dir):
+        engine, _region = self._engine_with_ssts(tmp_data_dir, n=1)
+        scrub = Scrubber(engine, interval_s=3600, batch=100)
+        while scrub.sweeps == 0:
+            scrub.tick()
+        items = scrub.items
+        for _ in range(5):
+            scrub.tick()  # within the interval: no new sweep starts
+        assert scrub.sweeps == 1 and scrub.items == items
+        engine.close()
+
+    def test_cursor_resumes_mid_sweep_across_restart(self, tmp_data_dir):
+        engine, _region = self._engine_with_ssts(tmp_data_dir, n=10)
+        scrub = Scrubber(engine, interval_s=0, batch=1)
+        for _ in range(9):  # 9 of 12 items (manifest + wal + 10 ssts)
+            scrub.tick()
+        assert scrub.sweeps == 0
+        cur = json.loads(engine.store.read(scrub._cursor_path).decode())
+        assert cur["index"] == 8  # persisted every 8 items
+        # "restart": a fresh scrubber resumes past the persisted cursor
+        # (the cursor path is per data home, so nodes sharing a bucket
+        # never clobber each other's position)
+        scrub2 = Scrubber(engine, interval_s=0, batch=100)
+        assert scrub2._cursor_path == scrub._cursor_path
+        assert scrub2._resume_skip == 8
+        out = scrub2.run_sweep()
+        assert out["items"] == 12 - 8  # only the unscrubbed suffix
+        assert not engine.store.exists(scrub._cursor_path)  # cleared
+        engine.close()
+
+    def test_chaos_scrub_read_error_does_not_kill_sweep(
+            self, tmp_data_dir):
+        engine, _region = self._engine_with_ssts(tmp_data_dir)
+        CHAOS.rule("scrub.read", 1.0, "error", limit=2)
+        scrub = Scrubber(engine, interval_s=0, batch=100)
+        out = scrub.run_sweep()
+        # two items errored (counted), the rest verified, sweep finished
+        assert scrub.sweeps == 1
+        assert out["items"] >= 4
+        engine.close()
+
+
+class TestStandaloneWiring:
+    def test_auto_arms_for_persistent_homes(self, tmp_path, monkeypatch):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        monkeypatch.delenv("GREPTIME_SCRUB", raising=False)
+        db = GreptimeDB(str(tmp_path / "home"))
+        try:
+            assert db.scrubber is not None
+            assert db.scheduler.idle_hook is not None
+            # auto mode must NOT spin the worker pool for embedders
+            assert not db.scheduler._started
+        finally:
+            db.close()
+
+    def test_off_and_memory_mode_disable(self, tmp_path, monkeypatch):
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        monkeypatch.setenv("GREPTIME_SCRUB", "off")
+        db = GreptimeDB(str(tmp_path / "home"))
+        try:
+            assert db.scrubber is None
+        finally:
+            db.close()
+        monkeypatch.delenv("GREPTIME_SCRUB", raising=False)
+        db = GreptimeDB()  # memory mode
+        try:
+            assert db.scrubber is None
+        finally:
+            db.close()
+
+    def test_scrub_on_serving_instance_end_to_end(self, tmp_path,
+                                                  monkeypatch):
+        """GREPTIME_SCRUB=on + seeded SST rot: the serving instance's
+        own idle loop finds and repairs it, and SQL over the repaired
+        region is correct (scrub-then-query)."""
+        import time as _time
+
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        monkeypatch.setenv("GREPTIME_SCRUB", "on")
+        monkeypatch.setenv("GREPTIME_SCRUB_INTERVAL_S", "0")
+        home = str(tmp_path / "home")
+        db = GreptimeDB(home)
+        try:
+            db.sql("CREATE TABLE m (h STRING, ts TIMESTAMP(3) TIME "
+                   "INDEX, v DOUBLE, PRIMARY KEY (h))")
+            db.sql("INSERT INTO m VALUES " + ",".join(
+                f"('h{i%3}',{1000 + i},{float(i)})" for i in range(12)))
+            region = db._region_of("m")
+            region.flush()
+            want = db.sql("SELECT h, ts, v FROM m ORDER BY ts, h").rows
+            _flip_sst_bytes(db.regions.store, region.sst_files[0])
+            deadline = _time.time() + 30
+            while db.scrubber.corrupt == 0 and _time.time() < deadline:
+                _time.sleep(0.05)
+            assert db.scrubber.corrupt >= 1, "idle loop never found rot"
+            assert db.sql("SELECT h, ts, v FROM m ORDER BY ts, h"
+                          ).rows == want
+        finally:
+            db.close()

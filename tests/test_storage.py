@@ -1071,14 +1071,16 @@ class TestS3ObjectStore:
         real = store._request
         import urllib.parse as up
 
-        def paged(method, key="", query="", payload=b""):
+        def paged(method, key="", query="", payload=b"",
+                  extra_headers=None):
             if "list-type" not in query:
-                return real(method, key, query, payload)
+                return real(method, key, query, payload,
+                            extra_headers=extra_headers)
             q = dict(up.parse_qsl(query))
             start = int(q.get("continuation-token", 0))
-            status, body = real(method, key,
-                                up.urlencode({"list-type": "2",
-                                              "prefix": q["prefix"]}))
+            status, body, _h = real(method, key,
+                                    up.urlencode({"list-type": "2",
+                                                  "prefix": q["prefix"]}))
             import re as _re
 
             keys = _re.findall(r"<Key>(.*?)</Key>", body.decode())
@@ -1090,7 +1092,7 @@ class TestS3ObjectStore:
             if trunc:
                 xml += f"<NextContinuationToken>{start+3}</NextContinuationToken>"
             xml += "</ListBucketResult>"
-            return 200, xml.encode()
+            return 200, xml.encode(), {}
 
         store._request = paged
         assert len(store.list("pg")) == 7
