@@ -221,6 +221,63 @@ KNOB_DOCS: dict[str, str] = {
         "exported into own tables); module never imported when unset."),
     "GREPTIME_SELF_MONITOR_INTERVAL_S": (
         "Flush interval of the self-monitoring export loop."),
+    "GREPTIME_SLO": (
+        "`off` disables the SLO observatory AND the budgeted idle "
+        "economy (serving/slo.py + serving/idle.py never imported; the "
+        "legacy chained idle hook and static deadlines serve "
+        "byte-for-byte); default on."),
+    "GREPTIME_SLO_ALPHA": (
+        "Relative-error bound of the DDSketch-style latency sketches "
+        "(smaller = more buckets = tighter quantiles)."),
+    "GREPTIME_SLO_SLOT_S": (
+        "Burn-rate ring-buffer slot width in seconds; the 5m/30m/1h/6h "
+        "windows are fixed slot COUNTS, so shrinking this compresses "
+        "every window proportionally (bench_soak uses that)."),
+    "GREPTIME_SLO_THRESHOLD_MS": (
+        "Default per-request latency objective for the interactive "
+        "class; normal/background scale it by 4x/20x."),
+    "GREPTIME_SLO_OBJECTIVE": (
+        "Default availability objective (fraction of requests that "
+        "must meet the threshold; 1-objective is the error budget)."),
+    "GREPTIME_SLO_OVERRIDES": (
+        "Per-tenant objective overrides, "
+        "`tenant=threshold_ms:objective,...`."),
+    "GREPTIME_SLO_FAST_BURN": (
+        "Burn-rate multiplier that fires the fast (1h/5m) alert pair — "
+        "and throttles every idle consumer while firing."),
+    "GREPTIME_SLO_SLOW_BURN": (
+        "Burn-rate multiplier that fires the slow (6h/30m) alert "
+        "pair."),
+    "GREPTIME_SLO_MIN_SAMPLES": (
+        "Minimum short-window sample count before an alert pair may "
+        "fire (thin traffic cannot page)."),
+    "GREPTIME_SLO_ADMIT_MS": (
+        "Background-admission allowance at FULL error budget; the "
+        "journal-estimated cost of background work must fit the "
+        "budget-scaled fraction of this."),
+    "GREPTIME_SLO_DEADLINE_FACTOR": (
+        "Adaptive per-class deadline = observed p99 x this factor "
+        "(replaces the static GREPTIME_SCHEDULER_TIMEOUT_S once "
+        "enough samples exist)."),
+    "GREPTIME_SLO_DEADLINE_FLOOR_S": (
+        "Lower bound of the adaptive deadline (a fast p99 must not "
+        "strangle occasional legitimate slow queries)."),
+    "GREPTIME_SLO_ROTATE_S": (
+        "Sketch two-generation rotation period: adaptive deadlines and "
+        "linger read the live+previous generations, so old latency "
+        "regimes age out."),
+    "GREPTIME_IDLE_QUANTUM_MS": (
+        "Idle-economy accounting quantum: a consumer tick costs "
+        "max(1, elapsed/quantum) credits, so long ticks auto-yield "
+        "future grants."),
+    "GREPTIME_IDLE_STARVE_TICKS": (
+        "Starvation bound: a consumer passed over this many eligible "
+        "ticks wins the next grant outright (counted in "
+        "greptime_idle_starved_total — nonzero means misconfigured "
+        "weights)."),
+    "GREPTIME_IDLE_WEIGHTS": (
+        "Idle-economy weight overrides, `name=weight,...` (substring "
+        "match on the consumer name)."),
     "GREPTIME_SORTED_SEGMENTS": (
         "Segment-reduction strategy: `auto` picks scatter on CPU / "
         "sorted on TPU; `force`/`off` override for A/B."),

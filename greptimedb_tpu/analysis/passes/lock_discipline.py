@@ -100,12 +100,27 @@ GUARDED: dict[str, dict[str, dict[str, tuple[str, str]]]] = {
     "compile/journal.py": {
         "UsageJournal": {
             "_entries": ("_lock", "mutate"),
+            "_costs": ("_lock", "mutate"),
             "_dirty": ("_lock", "rw"),
         },
     },
     "flow/device.py": {
         "FlowDeviceRuntime": {
             "_kernels": ("_kern_lock", "mutate"),
+        },
+    },
+    "serving/slo.py": {
+        "SloEngine": {
+            "_keys": ("_lock", "mutate"),
+            "_exec_cls": ("_lock", "mutate"),
+            "_wait_cls": ("_lock", "mutate"),
+            "_alerts": ("_lock", "rw"),
+            "_overrides": ("_lock", "mutate"),
+        },
+    },
+    "serving/idle.py": {
+        "IdleEconomy": {
+            "_consumers": ("_lock", "mutate"),
         },
     },
     "fulltext/resident.py": {

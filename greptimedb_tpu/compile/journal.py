@@ -41,6 +41,12 @@ class UsageJournal:
         self.path = path
         self._lock = threading.Lock()
         self._entries: dict[str, dict] = {}
+        # statement-cost table (ISSUE 18): digit-normalized SQL
+        # fingerprint → EWMA execution cost in ms.  This is what closes
+        # the loop — the scheduler's background admission compares a
+        # statement's estimated cost against the remaining error-budget
+        # headroom BEFORE running it (serving/scheduler.py).
+        self._costs: dict[str, float] = {}
         self._dirty = 0
         self.corrupt = False
         self._load()
@@ -67,6 +73,11 @@ class UsageJournal:
             return
         with self._lock:  # init-only, but keep the guard uniform
             self._entries = doc.get("classes", {})
+            try:
+                self._costs = {str(k): float(v) for k, v
+                               in doc.get("costs", {}).items()}
+            except (TypeError, ValueError):
+                self._costs = {}
 
     # ------------------------------------------------------------------
     def note(self, cid: str, engine: str, canon: str | None,
@@ -102,6 +113,25 @@ class UsageJournal:
         if dirty >= _SAVE_EVERY:
             self.save()
 
+    def note_cost(self, fp: str, ms: float) -> None:
+        """Fold one measured execution into the statement fingerprint's
+        cost EWMA (alpha 0.3: adapts in a few runs, forgets a one-off
+        cold-cache outlier just as fast)."""
+        with self._lock:
+            cur = self._costs.get(fp)
+            self._costs[fp] = (ms if cur is None
+                               else 0.7 * cur + 0.3 * ms)
+            self._dirty += 1
+            dirty = self._dirty
+        if dirty >= _SAVE_EVERY:
+            self.save()
+
+    def estimate_ms(self, fp: str) -> float | None:
+        """Estimated execution cost for a statement fingerprint; None
+        when this shape has never been measured."""
+        with self._lock:
+            return self._costs.get(fp)
+
     def top(self, k: int | None = None) -> list[tuple[str, dict]]:
         """Warmable classes ranked by use count (then recency)."""
         with self._lock:
@@ -131,6 +161,7 @@ class UsageJournal:
     def save(self) -> None:
         with self._lock:
             merged = {cid: dict(e) for cid, e in self._entries.items()}
+            costs = dict(self._costs)
             self._dirty = 0
         # merge with the CURRENT on-disk journal before writing:
         # instances sharing one cache dir must not erase each other's
@@ -139,10 +170,12 @@ class UsageJournal:
         try:
             with open(self.path, "rb") as f:
                 body = decode_envelope(f.read(), _MAGIC)
-            disk = (json.loads(body).get("classes", {})
-                    if body is not None else {})
+            doc = json.loads(body) if body is not None else {}
+            disk = doc.get("classes", {})
+            disk_costs = doc.get("costs", {})
         except (OSError, ValueError):
             disk = {}
+            disk_costs = {}
         for cid, d in disk.items():
             m = merged.get(cid)
             if m is None:
@@ -163,7 +196,17 @@ class UsageJournal:
                 key=lambda kv: (-int(kv[1].get("count", 0)),
                                 -int(kv[1].get("last_ms", 0))))
             merged = dict(ranked[:_MAX_CLASSES])
-        body = json.dumps({"v": 1, "classes": merged},
+        # costs merge take-ours-else-theirs (ours is strictly fresher —
+        # an EWMA already folds history), same size bound as classes
+        for fp, v in disk_costs.items():
+            if fp not in costs:
+                try:
+                    costs[fp] = float(v)
+                except (TypeError, ValueError):
+                    continue
+        if len(costs) > _MAX_CLASSES:
+            costs = dict(sorted(costs.items())[:_MAX_CLASSES])
+        body = json.dumps({"v": 1, "classes": merged, "costs": costs},
                           separators=(",", ":"), default=str).encode()
         try:
             atomic_write(self.path, encode_envelope(body, _MAGIC))

@@ -40,6 +40,7 @@ import zlib
 import numpy as np
 
 from greptimedb_tpu.compile.store import atomic_write
+from greptimedb_tpu.errors import FencedError
 from greptimedb_tpu.storage.memtable import OP, SEQ
 from greptimedb_tpu.storage.object_store import _fsync_dir
 from greptimedb_tpu.utils.telemetry import REGISTRY
@@ -61,8 +62,21 @@ def flow_sql_hash(task) -> str:
     return hashlib.sha256(ident.encode()).hexdigest()[:16]
 
 
+_EPOCH_FILE = "EPOCH"
+
+
 class FlowCheckpointStore:
-    """One checkpoint file per flow under ``<data_home>/flow_ckpt``."""
+    """One checkpoint file per flow under ``<data_home>/flow_ckpt``.
+
+    Epoch fencing (ISSUE 18, the manifest EPOCH discipline applied to
+    flow checkpoints): when flownodes share a checkpoint root, the
+    failover winner claims a monotonically increasing epoch in the
+    shared ``EPOCH`` marker.  Destructive operations (``delete``) from
+    a holder of an OLDER epoch — a fenced-out zombie replaying a stale
+    drop/reassign plan — refuse with FencedError instead of destroying
+    the new owner's checkpoint.  Epoch-less deletes stay unconditional,
+    byte-for-byte the pre-fencing behavior (standalone engines never
+    mint the marker)."""
 
     def __init__(self, root: str):
         self.root = root
@@ -70,9 +84,37 @@ class FlowCheckpointStore:
         self.saves = 0
         self.loads = 0
         self.corrupt = 0
+        self.epoch: int | None = None  # this holder's claimed epoch
 
     def path(self, name: str) -> str:
         return os.path.join(self.root, f"{name}.ckpt")
+
+    # ---- epoch fencing -------------------------------------------------
+    def current_epoch(self) -> int | None:
+        """The shared marker's epoch, or None when never claimed (or
+        unreadable — fencing treats 'unknown' as 'not newer', matching
+        the manifest's corrupt-marker stance)."""
+        try:
+            with open(os.path.join(self.root, _EPOCH_FILE), "rb") as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def claim(self, epoch: int) -> None:
+        """Claim the marker for ``epoch`` and arm fencing on this store.
+        A claim below the marker's current value loses — the claimant is
+        already fenced out and must not touch checkpoints here."""
+        epoch = int(epoch)
+        cur = self.current_epoch()
+        if cur is not None and cur > epoch:
+            M_CKPT.labels("fenced_claim").inc()
+            raise FencedError(
+                f"flow checkpoints {self.root}: epoch {epoch} superseded "
+                f"by {cur}; this claimant is fenced out")
+        if cur != epoch:
+            atomic_write(os.path.join(self.root, _EPOCH_FILE),
+                         str(epoch).encode())
+        self.epoch = epoch
 
     def save(self, name: str, payload: dict) -> bool:
         blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
@@ -134,7 +176,20 @@ class FlowCheckpointStore:
         except OSError:
             pass
 
-    def delete(self, name: str) -> None:
+    def delete(self, name: str, *, epoch: int | None = None) -> None:
+        """Remove one flow's checkpoint.  With ``epoch`` (or a claimed
+        ``self.epoch``) the delete is FENCED: it refuses when the shared
+        marker shows a newer claimant — a zombie's stale drop plan must
+        not destroy the checkpoint the new owner restores from."""
+        if epoch is None:
+            epoch = self.epoch
+        if epoch is not None:
+            cur = self.current_epoch()
+            if cur is not None and cur > epoch:
+                M_CKPT.labels("fenced_delete").inc()
+                raise FencedError(
+                    f"flow checkpoints {self.root}: delete of {name!r} "
+                    f"fenced out — epoch {epoch} superseded by {cur}")
         try:
             os.unlink(self.path(name))
             _fsync_dir(self.root)

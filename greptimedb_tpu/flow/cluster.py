@@ -21,7 +21,7 @@ from __future__ import annotations
 import json
 import time
 
-from greptimedb_tpu.errors import FlowNotFound, GreptimeError
+from greptimedb_tpu.errors import FencedError, FlowNotFound, GreptimeError
 from greptimedb_tpu.flow.engine import FlowEngine, flow_to_sql
 from greptimedb_tpu.query.ast import CreateFlow
 
@@ -139,7 +139,15 @@ class FlowControlPlane:
         # of the same definition routed to that node
         for n in self.nodes.values():
             if n.engine.checkpoints is not None:
-                n.engine.checkpoints.delete(name)
+                try:
+                    n.engine.checkpoints.delete(
+                        name, epoch=n.engine.ckpt_epoch)
+                except FencedError:
+                    # node holds a fenced-out token (failed over away):
+                    # the shared-root checkpoint now belongs to a newer
+                    # claimant's pass in this same loop — skip, never
+                    # retry into an unfenced delete
+                    pass
             if n.engine.runtime is not None:
                 n.engine.runtime.drop(name)
         self.kv.delete(ROUTE_PREFIX + name)
@@ -184,6 +192,7 @@ class FlowControlPlane:
                 if node.engine.runtime is not None:
                     node.engine.runtime.drop(name)
             self._ship_checkpoint(node, target, name)
+            self._claim_ckpt_epoch(target)
             stmt = parse_sql(raw.decode())[0]
             task = target.engine._register(stmt)
             task.flownode_id = target.node_id
@@ -202,6 +211,25 @@ class FlowControlPlane:
                              {"node": target.node_id})
             moved.append(name)
         return moved
+
+    @staticmethod
+    def _claim_ckpt_epoch(target: Flownode) -> None:
+        """Arm checkpoint-delete fencing for the failover winner: claim
+        the next epoch in the store's shared marker and hand the token
+        to the target's engine.  The fenced-out previous owner keeps its
+        older token (if it ever held one), so its delayed drop/GC plan
+        loses the fence instead of destroying the checkpoint the new
+        owner just restored from.  Best-effort: a lost claim race means
+        someone newer owns the root — the target simply stays unarmed."""
+        st = target.engine.checkpoints
+        if st is None:
+            return
+        try:
+            epoch = (st.current_epoch() or 0) + 1
+            st.claim(epoch)
+            target.engine.ckpt_epoch = epoch
+        except FencedError:
+            pass
 
     @staticmethod
     def _ship_checkpoint(src: Flownode | None, dst: Flownode,

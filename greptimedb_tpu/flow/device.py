@@ -1083,7 +1083,13 @@ class FlowDeviceRuntime:
             jnp.asarray(ts_p), tuple(jnp.asarray(v) for v in vals),
             tuple(jnp.asarray(m) for m in vvalids),
             jnp.asarray(aff_g), jnp.asarray(aff_w))
-        new_state, outs = timed_kernel_call(call, miss, None, engine="flow")
+        # with the SLO observatory on, folds SYNC so greptime_flow_tick
+        # and the idle economy's elapsed debit cover the real device
+        # time (an async dispatch returns before the fold runs, and the
+        # economy would grant interactive-contending work for free);
+        # GREPTIME_SLO=off keeps the fully-async hot path byte-for-byte
+        sink = {} if getattr(self.db, "slo", None) is not None else None
+        new_state, outs = timed_kernel_call(call, miss, sink, engine="flow")
         st.slots = list(new_state)
         st.folds += 1
         self.fold_dispatches += 1

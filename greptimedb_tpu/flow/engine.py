@@ -158,6 +158,12 @@ class FlowEngine:
         # them None and every path below is the pre-existing host code)
         self.runtime = getattr(db, "flow_runtime", None)
         self.checkpoints = getattr(db, "flow_checkpoints", None)
+        # this engine's fencing token for checkpoint deletes: flownodes
+        # can SHARE one checkpoint store object (shared data home), so
+        # the epoch a failover winner claims lives per-engine — a
+        # fenced-out zombie engine keeps its older token and its stale
+        # drop plan loses (flow/cluster.py tick sets this on the target)
+        self.ckpt_epoch: int | None = None
         self._ckpt_interval_s = float(os.environ.get(
             "GREPTIME_FLOW_CKPT_INTERVAL_S", "30"))
         self._last_ckpt_ms = 0.0
@@ -261,7 +267,10 @@ class FlowEngine:
         if self.runtime is not None:
             self.runtime.drop(name)
         if self.checkpoints is not None:
-            self.checkpoints.delete(name)
+            # fenced by this engine's epoch token: a zombie engine whose
+            # flows were failed over away raises FencedError here instead
+            # of destroying the new owner's checkpoint
+            self.checkpoints.delete(name, epoch=self.ckpt_epoch)
 
     def list_flows(self) -> list[FlowTask]:
         return [self.flows[k] for k in sorted(self.flows)]

@@ -427,6 +427,38 @@ def _self_monitor(db):
     return _columns_of(rows, names), types
 
 
+def _slo_status(db):
+    """Closed-loop SLO observatory rows (ISSUE 18, serving/slo.py): one
+    row per (tenant, priority class, protocol) latency sketch with its
+    declared objective, error-budget remainder, burn rates and any
+    firing alert — the SQL face of ``/v1/slo``."""
+    slo = getattr(db, "slo", None)
+    rows = []
+    if slo is not None:
+        for r in slo.status_rows():
+            rows.append({
+                "tenant": r["tenant"], "class": r["class"],
+                "protocol": r["protocol"],
+                "threshold_ms": float(r["threshold_ms"]),
+                "objective": float(r["objective"]),
+                "total": int(r["total"]), "breached": int(r["breached"]),
+                "p50_ms": float(r["p50_ms"]), "p99_ms": float(r["p99_ms"]),
+                "budget_remaining": float(r["budget_remaining"]),
+                "burn_5m": float(r["burn_5m"]),
+                "burn_1h": float(r["burn_1h"]),
+                "burn_6h": float(r["burn_6h"]),
+                "alert": r["alert"],
+            })
+    names = ["tenant", "class", "protocol", "threshold_ms", "objective",
+             "total", "breached", "p50_ms", "p99_ms", "budget_remaining",
+             "burn_5m", "burn_1h", "burn_6h", "alert"]
+    types = {n: "Float64" for n in names}
+    types.update({"tenant": "String", "class": "String",
+                  "protocol": "String", "alert": "String",
+                  "total": "Int64", "breached": "Int64"})
+    return _columns_of(rows, names), types
+
+
 def _views(db):
     """Reference src/catalog/src/system_schema/information_schema/views.rs."""
     rows = []
@@ -552,6 +584,7 @@ _TABLES = {
     "procedure_info": _procedure_info,
     "runtime_metrics": _runtime_metrics,
     "self_monitor": _self_monitor,
+    "slo_status": _slo_status,
     "views": _views,
     "triggers": _triggers,
     "table_constraints": _table_constraints,

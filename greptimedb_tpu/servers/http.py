@@ -299,6 +299,7 @@ class HttpServer(ThreadedAiohttpApp):
         r.add_get("/metrics", self.h_metrics)
         r.add_get("/config", self.h_config)
         r.add_get("/status", self.h_status)
+        r.add_get("/v1/slo", self.h_slo)
         r.add_get("/dashboard", self.h_dashboard)
         r.add_get("/dashboard/", self.h_dashboard)
         return app
@@ -410,6 +411,7 @@ class HttpServer(ThreadedAiohttpApp):
         t0 = time.perf_counter()
         sql = await self._param(request, "sql")
         ctx = _request_trace_context(request)
+        hold: list = []  # caller-held SLO sample (see scheduler._finish)
         with M_LATENCY.labels("/v1/sql").time():
             if not sql:
                 M_REQUESTS.labels("/v1/sql", "400").inc()
@@ -421,24 +423,44 @@ class HttpServer(ThreadedAiohttpApp):
                 # lock only) so they never queue behind the statement
                 # they target on the single-worker db executor
                 res = self.db.try_fast_sql(sql)
+                timed = res is None
                 if res is None:
                     sched = self.db.scheduler
-                    with M_PROTOCOL_QUERY.labels("http").time():
-                        if sched is not None:
-                            tenant = self._tenant(request)
-                            prio = self._priority(request)
-                            client = request.remote or ""
-                            res = await self._call_query(
-                                lambda: sched.submit(
-                                    sql, tenant=tenant, priority=prio,
-                                    client=client, trace_ctx=ctx))
-                        else:
-                            res = await self._call(
-                                self._traced_sql, sql, ctx)
+                    if sched is not None:
+                        tenant = self._tenant(request)
+                        prio = self._priority(request)
+                        client = request.remote or ""
+                        res = await self._call_query(
+                            lambda: sched.submit(
+                                sql, tenant=tenant, priority=prio,
+                                client=client, trace_ctx=ctx,
+                                protocol="http", slo_hold=hold))
+                    else:
+                        res = await self._call(
+                            self._traced_sql, sql, ctx)
+                # serialize BEFORE observing (ISSUE 18 fix): the JSON
+                # envelope build is part of what the client waits for,
+                # and the histogram previously closed at submit-return —
+                # under-reporting exactly the rows-heavy responses.  The
+                # scheduler's SLO sample is caller-held over the same
+                # span (record_held below), so sketch and histogram
+                # agree by construction.
+                body = _result_to_json(res, t0)
+                if timed:
+                    M_PROTOCOL_QUERY.labels("http").observe(
+                        time.perf_counter() - t0)
+                    sched = self.db.scheduler
+                    if sched is not None and hold:
+                        sched.record_held(hold)
                 M_REQUESTS.labels("/v1/sql", "200").inc()
-                return web.json_response(_result_to_json(res, t0),
+                return web.json_response(body,
                                          headers=_trace_headers(ctx))
             except Exception as e:  # noqa: BLE001
+                sched = self.db.scheduler
+                if sched is not None and hold:
+                    # serialization failed after a clean execution: the
+                    # held sample still records (exactly-one invariant)
+                    sched.record_held(hold)
                 body, status = _error_json(e)
                 M_REQUESTS.labels("/v1/sql", str(status)).inc()
                 return web.json_response(body, status=status,
@@ -469,7 +491,8 @@ class HttpServer(ThreadedAiohttpApp):
             # dedupe the heavy state)
             return await self._call_query(
                 lambda: sched.submit_fn(run, tenant=tenant,
-                                        label=query[:256]))
+                                        label=query[:256],
+                                        protocol="prometheus"))
         return await self._call(run)
 
     async def h_prom_range(self, request: web.Request) -> web.Response:
@@ -1062,7 +1085,7 @@ class HttpServer(ThreadedAiohttpApp):
                         lambda: sched.submit_fn(
                             run, tenant=tenant,
                             label=f"logql: {params.get('query', path)}"
-                            [:256]))
+                            [:256], protocol="loki"))
                 else:
                     payload = await self._call(run)
             M_REQUESTS.labels(path, "200").inc()
@@ -1544,6 +1567,26 @@ class HttpServer(ThreadedAiohttpApp):
         ft = getattr(ft, "fulltext_cache", None)
         if ft is not None and len(ft):
             payload["fulltext"] = ft.stats()
+        return web.json_response(payload)
+
+    async def h_slo(self, request: web.Request) -> web.Response:
+        """Closed-loop SLO observatory (ISSUE 18): per-(tenant, class,
+        protocol) sketch status, firing burn-rate alerts, and the idle
+        economy's consumer ledgers — the same rows as
+        ``information_schema.slo_status``."""
+        slo = getattr(self.db, "slo", None)
+        if slo is None:
+            return web.json_response(
+                {"enabled": False,
+                 "hint": "set GREPTIME_SLO=on (default) with the "
+                         "scheduler enabled"})
+        eco = getattr(self.db, "idle_economy", None)
+        payload = {
+            "enabled": True,
+            "status": slo.status_rows(),
+            "alerts": slo.alerts(),
+            "idle": eco.consumers() if eco is not None else [],
+        }
         return web.json_response(payload)
 
     async def h_promql(self, request: web.Request) -> web.Response:

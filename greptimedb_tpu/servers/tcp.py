@@ -75,7 +75,7 @@ class ThreadedTcpServer:
                 return sched.submit_session(
                     query, dbname, timezone,
                     tenant=user or "default", client=self.protocol,
-                    trace_ctx=ctx)
+                    trace_ctx=ctx, protocol=self.protocol)
             with TRACER.trace_context(ctx):
                 return self.db.sql_in_db(query, dbname, timezone)
 

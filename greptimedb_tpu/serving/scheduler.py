@@ -125,6 +125,14 @@ class _Entry:
     dbname: str | None = None
     timezone: str | None = None
     trace_ctx: tuple | None = None
+    protocol: str = "sql"  # SLO sketch key axis: http/mysql/postgres/...
+    # caller-held SLO sample (ISSUE 18 satellite): when set, a clean
+    # finish appends (tenant, priority, protocol, enqueued) here instead
+    # of recording — the submitter records AFTER response serialization
+    # so the sketch and the per-protocol histogram agree.  Error/shed
+    # paths still record here (serialization never happens for them).
+    slo_hold: list | None = None
+    _slo_done: bool = False
     deadline: float | None = None  # monotonic
     est_bytes: int = 0
     ticket: object = None
@@ -225,6 +233,14 @@ class QueryScheduler:
         # exception) unhooks it.  None (default) keeps the worker's
         # indefinite wait exactly as before.
         self.idle_hook = None
+        # closed-loop observability (ISSUE 18), armed by standalone when
+        # GREPTIME_SLO is on: ``slo`` (serving/slo.py) receives exactly
+        # one sample per completed entry and feeds adaptive deadlines,
+        # adaptive linger and background admission; ``idle_economy``
+        # (serving/idle.py) takes over add_idle_hook registrations.
+        # Both None (=off) keeps every code path byte-for-byte legacy.
+        self.slo = None
+        self.idle_economy = None
         # local mirrors so /status, EXPLAIN ANALYZE and the bench read
         # pressure without a registry scrape (memory.py discipline)
         self.executed = 0
@@ -260,17 +276,31 @@ class QueryScheduler:
         with self._cond:
             self._cond.notify_all()
 
-    def add_idle_hook(self, fn, kick: bool = True) -> None:
-        """Compose ``fn`` into the idle-capacity hook.  Multiple
-        background consumers (AOT warmup, flow checkpoint drain, the
-        integrity scrubber) share the single ``idle_hook`` slot through
-        a dispatcher that calls each member per tick, drops
-        drained/failing members, and reports drained (False) only when
-        none remain — preserving the worker loop's unhook-on-False
-        contract for a lone hook.  ``kick=False`` registers without
-        starting/waking the worker pool: the hook begins ticking when
-        the instance actually serves traffic (embedded/test instances
-        that never submit never spin workers for it)."""
+    def add_idle_hook(self, fn, kick: bool = True, *,
+                      name: str | None = None,
+                      weight: float | None = None) -> None:
+        """Compose ``fn`` into the idle-capacity hook.  With the idle
+        economy armed (GREPTIME_SLO on), registrations become weighted
+        consumers and the economy's deficit-round-robin tick IS the
+        hook — one grant per tick, fairness and throttling applied
+        (serving/idle.py).  Otherwise multiple background consumers
+        (AOT warmup, flow checkpoint drain, the integrity scrubber)
+        share the single ``idle_hook`` slot through a dispatcher that
+        calls each member per tick, drops drained/failing members, and
+        reports drained (False) only when none remain — preserving the
+        worker loop's unhook-on-False contract for a lone hook.
+        ``kick=False`` registers without starting/waking the worker
+        pool: the hook begins ticking when the instance actually serves
+        traffic (embedded/test instances that never submit never spin
+        workers for it)."""
+        eco = self.idle_economy
+        if eco is not None:
+            eco.register(fn, name=name, weight=weight)
+            with self._cond:
+                self.idle_hook = eco.tick
+            if kick:
+                self.kick_idle()
+            return
         with self._cond:
             cur = self.idle_hook
             if cur is None:
@@ -307,7 +337,7 @@ class QueryScheduler:
             for q in self._queues.values():
                 for e in q:
                     e.error = Cancelled("scheduler shutting down")
-                    e.done.set()
+                    self._finish(e)
                     _note_waiting(e.priority, -1)
                 q.clear()
             self._cond.notify_all()
@@ -336,33 +366,40 @@ class QueryScheduler:
     def submit(self, sql: str, *, tenant: str = "default",
                priority: str | None = None, client: str = "",
                trace_ctx: tuple | None = None,
-               timeout_s: float | None = None):
+               timeout_s: float | None = None,
+               protocol: str = "http", slo_hold: list | None = None):
         """HTTP /v1/sql entry: execute under the instance default
         session; returns the QueryResult (or raises)."""
         e = self._make_sql_entry(sql, None, None, tenant, priority, client,
                                  trace_ctx, timeout_s)
+        e.protocol = protocol
+        e.slo_hold = slo_hold
         return self._enqueue_and_wait(e)
 
     def submit_session(self, sql: str, dbname: str,
                        timezone: str | None = None, *,
                        tenant: str = "default", priority: str | None = None,
                        client: str = "", trace_ctx: tuple | None = None,
-                       timeout_s: float | None = None):
+                       timeout_s: float | None = None,
+                       protocol: str = "sql"):
         """Wire-protocol entry (MySQL/PostgreSQL session semantics):
         returns (result, session_db, session_tz) like db.sql_in_db."""
         e = self._make_sql_entry(sql, dbname, timezone, tenant, priority,
                                  client, trace_ctx, timeout_s)
         e.kind = "session"
+        e.protocol = protocol
         return self._enqueue_and_wait(e)
 
     def submit_fn(self, fn, *, tenant: str = "default",
                   priority: str = "interactive", client: str = "",
                   trace_ctx: tuple | None = None,
-                  timeout_s: float | None = None, label: str = ""):
+                  timeout_s: float | None = None, label: str = "",
+                  protocol: str = "fn"):
         """Non-SQL query work (PromQL evaluation, log queries): admission
         + priority + shedding apply; batching does not."""
         e = _Entry(kind="fn", fn=fn, sql=label, tenant=tenant,
-                   priority=priority, client=client, trace_ctx=trace_ctx)
+                   priority=priority, client=client, trace_ctx=trace_ctx,
+                   protocol=protocol)
         self._set_deadline(e, timeout_s)
         return self._enqueue_and_wait(e)
 
@@ -384,13 +421,99 @@ class QueryScheduler:
 
     def _set_deadline(self, e: _Entry, timeout_s: float | None) -> None:
         t = timeout_s if timeout_s is not None else self.default_timeout_s
+        if t is None and self.slo is not None:
+            # no configured timeout: derive one from the class's OBSERVED
+            # p99 (x factor, generously floored) instead of running
+            # unbounded — None again below the sample floor, so a fresh
+            # instance sheds nothing on thin evidence (serving/slo.py)
+            t = self.slo.adaptive_timeout_s(e.priority)
         if t is not None and t > 0:
             e.deadline = time.monotonic() + t
+
+    # ---- closed-loop accounting (ISSUE 18; no-ops with slo unarmed) ----
+    def _finish(self, e: _Entry) -> None:
+        """Deliver ``e`` to its waiter, recording EXACTLY one SLO sample
+        per entry: shed/cancelled work records as a breach (budget was
+        consumed without an answer), ordinary errors record their true
+        latency, and a clean finish with a caller-held sample defers to
+        the submitter (response serialization still ahead)."""
+        slo = self.slo
+        if slo is not None and not e._slo_done:
+            e._slo_done = True
+            try:
+                if e.error is None and e.slo_hold is not None:
+                    e.slo_hold.append(
+                        (e.tenant, e.priority, e.protocol, e.enqueued))
+                else:
+                    slo.record(
+                        e.tenant, e.priority, e.protocol,
+                        time.monotonic() - e.enqueued,
+                        bad=isinstance(e.error,
+                                       (DeadlineExceeded, Cancelled)))
+            except Exception:  # noqa: BLE001 — accounting must never
+                pass          # block delivery
+        e.done.set()
+
+    def record_held(self, hold: list) -> None:
+        """Record caller-held samples (servers/http.py calls this after
+        serializing the response, so the sketch covers the full
+        submit→bytes-ready span)."""
+        slo = self.slo
+        if slo is not None:
+            now = time.monotonic()
+            for tenant, priority, protocol, enqueued in hold:
+                slo.record(tenant, priority, protocol, now - enqueued)
+        hold.clear()
+
+    def _estimate_cost_ms(self, e: _Entry) -> float:
+        """PR-13 usage-journal cost estimate for this statement shape
+        (digit-normalized fingerprint, the batch-key normalization); 0
+        when unknown — unknown work is admitted, only DEMONSTRABLY
+        expensive work is held to the budget."""
+        if e.kind == "fn" or not e.sql:
+            return 0.0
+        pc = getattr(self.db, "plan_compiler", None)
+        j = getattr(pc, "journal", None) if pc is not None else None
+        if j is None:
+            return 0.0
+        try:
+            return j.estimate_ms(_DIGITS.sub("#", e.sql)) or 0.0
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+    def _note_cost(self, sqls, dt_s: float) -> None:
+        """Feed measured execution time back into the journal's
+        per-class cost EWMA — the estimate the admission check reads."""
+        if self.slo is None:
+            return
+        pc = getattr(self.db, "plan_compiler", None)
+        j = getattr(pc, "journal", None) if pc is not None else None
+        if j is None:
+            return
+        try:
+            ms = dt_s * 1000.0
+            for s in sqls:
+                if s:
+                    j.note_cost(_DIGITS.sub("#", s), ms)
+        except Exception:  # noqa: BLE001 — accounting is best-effort
+            pass
 
     def _enqueue_and_wait(self, e: _Entry):
         if e.priority not in PRIORITIES:
             raise ValueError(f"unknown priority {e.priority!r}")
         self._ensure_started()
+        if e.priority == "background" and self.slo is not None:
+            est = self._estimate_cost_ms(e)
+            ok, allowance = self.slo.admit_background(est)
+            if not ok:
+                from greptimedb_tpu.serving.admission import M_REJECTED
+
+                M_REJECTED.labels(e.tenant, "slo_budget").inc()
+                raise ResourcesExhausted(
+                    f"background work rejected: estimated cost "
+                    f"{est:.0f} ms exceeds the error-budget headroom "
+                    f"({allowance:.0f} ms); retry once the budget "
+                    "recovers")
         e.est_bytes = self.query_est_bytes
         self.admission.admit(e.tenant, e.est_bytes)
         counted = False
@@ -426,13 +549,22 @@ class QueryScheduler:
             if e.deadline is not None:
                 timeout = max(0.0, e.deadline - time.monotonic()) + 30.0
             if not e.done.wait(timeout):
+                removed = False
                 with self._cond:
                     if not e.claimed:
                         try:
                             self._queues[e.priority].remove(e)
                             _note_waiting(e.priority, -1)
+                            removed = True
                         except ValueError:
                             pass
+                # abandoned-before-claim is a breach the workers never
+                # see: record it here (claimed entries reach _finish)
+                if removed and self.slo is not None and not e._slo_done:
+                    e._slo_done = True
+                    self.slo.record(e.tenant, e.priority, e.protocol,
+                                    time.monotonic() - e.enqueued,
+                                    bad=True)
                 raise DeadlineExceeded(
                     f"query abandoned after deadline: {e.sql[:128]!r}")
             if e.error is not None:
@@ -505,7 +637,18 @@ class QueryScheduler:
         pending = self._sqlish_inflight[priority] - group_len
         if pending <= 0:
             return 0.0
-        return (self.linger_ms / 1000.0) * min(
+        ceil_ms = self.linger_ms
+        if self.slo is not None:
+            # linger adapts to the MEASURED queue-wait sketch: when this
+            # class already waits w at p95, fishing for batch mates up to
+            # ~2w is latency noise (stacking pays for itself); when waits
+            # are near zero, a lightly loaded server must not pay the
+            # full configured ceiling for a mate that may never come
+            w = self.slo.wait_quantile(priority, 0.95)
+            if w is not None:
+                ceil_ms = min(self.linger_ms,
+                              max(self.linger_ms * 0.25, w * 2000.0))
+        return (ceil_ms / 1000.0) * min(
             1.0, pending / max(1, self.max_batch))
 
     def _worker_loop(self) -> None:  # gl: warm-path(host)
@@ -581,20 +724,22 @@ class QueryScheduler:
             for e in group:
                 e.wait_ms = (now - e.enqueued) * 1000.0
                 M_WAIT.labels(e.priority).observe(e.wait_ms / 1000.0)
+                if self.slo is not None:
+                    self.slo.record_wait(e.priority, e.wait_ms / 1000.0)
                 if e.deadline is not None and now > e.deadline:
                     self.shed += 1
                     M_SHED.labels(e.priority).inc()
                     e.error = DeadlineExceeded(
                         f"query shed after waiting "
                         f"{e.wait_ms:.0f} ms: {e.sql[:128]!r}")
-                    e.done.set()
+                    self._finish(e)
                     continue
                 if e.ticket is not None:
                     try:
                         e.ticket.check()
                     except GreptimeError as kill:
                         e.error = kill
-                        e.done.set()
+                        self._finish(e)
                         continue
                 live.append(e)
             if not live:
@@ -617,6 +762,7 @@ class QueryScheduler:
         M_BATCH.observe(1)
         self.executed += 1
         M_EXECUTED.labels(e.priority).inc()
+        t0 = time.monotonic()
         try:
             db._proc_local.sched_info = self._sched_info(e, 1)
             db._proc_local.ticket = e.ticket
@@ -636,7 +782,9 @@ class QueryScheduler:
         finally:
             db._proc_local.ticket = None
             db._proc_local.sched_info = None
-            e.done.set()
+            if e.error is None and e.kind != "fn":
+                self._note_cost((e.sql,), time.monotonic() - t0)
+            self._finish(e)
 
     def _execute_batch(self, group: list[_Entry]) -> None:  # gl: warm-path(host)
         """One stacked device dispatch for the whole group when the
@@ -668,6 +816,7 @@ class QueryScheduler:
             assign.append(idx)
 
         results = None
+        t0 = time.monotonic()
         try:
             db._proc_local.sched_info = self._sched_info(leader, n)
             with TRACER.trace_context(leader.trace_ctx):
@@ -698,7 +847,7 @@ class QueryScheduler:
             # raise it N times under the db lock)
             for e in group:
                 e.error = ex
-                e.done.set()
+                self._finish(e)
             M_BATCHES.labels("error").inc()
             return
         finally:
@@ -716,13 +865,14 @@ class QueryScheduler:
         M_BATCHED_QUERIES.inc(n)
         self.executed += n
         M_EXECUTED.labels(leader.priority).inc(n)
+        self._note_cost([e.sql for e in unique], time.monotonic() - t0)
         for e, idx in zip(group, assign):
             r = results[idx]
             if e.kind == "session":
                 e.result = (r, e.dbname, e.timezone or db.timezone)
             else:
                 e.result = r
-            e.done.set()
+            self._finish(e)
 
     # ------------------------------------------------------------------
     def stats(self) -> dict:

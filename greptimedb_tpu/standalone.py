@@ -507,6 +507,27 @@ class GreptimeDB(TableProvider):
             from greptimedb_tpu.serving import QueryScheduler
 
             self.scheduler = QueryScheduler(self)
+        # closed-loop SLO observatory (ISSUE 18, serving/slo.py +
+        # serving/idle.py): per-(tenant, class, protocol) latency
+        # sketches, error budgets and burn-rate alerts, plus the
+        # budgeted idle economy that arbitrates the scheduler's idle
+        # capacity between warmup / flow checkpoints / scrubbing /
+        # journal drains.  GREPTIME_SLO=off restores today's behavior
+        # byte-for-byte — neither module is imported, the scheduler's
+        # slo/idle_economy stay None, and every consumer below falls
+        # back to the legacy chained idle hook.
+        self.slo = None
+        self.idle_economy = None
+        if (self.scheduler is not None
+                and os.environ.get("GREPTIME_SLO", "on").lower() not in (
+                    "off", "0", "false")):
+            from greptimedb_tpu.serving.idle import IdleEconomy
+            from greptimedb_tpu.serving.slo import SloEngine
+
+            self.slo = SloEngine()
+            self.idle_economy = IdleEconomy(slo=self.slo)
+            self.scheduler.slo = self.slo
+            self.scheduler.idle_economy = self.idle_economy
         # persistent procedure manager (repartition etc.): one instance so
         # table locks are process-wide; RUNNING journals from a crashed
         # process resume here at startup
@@ -595,6 +616,28 @@ class GreptimeDB(TableProvider):
                 snapshot_dirs=[os.path.join(data_home, "grid_snap")])
             self.scheduler.add_idle_hook(
                 self.scrubber.tick, kick=_sc in ("on", "1", "true"))
+        # journal/cache drain as a WEIGHTED idle consumer: with the idle
+        # economy armed, usage-journal persistence stops riding the
+        # note() call's save-every-8 hiccup exclusively and instead
+        # drains on granted idle ticks like every other background
+        # consumer (cheap, so low weight)
+        if (self.idle_economy is not None
+                and getattr(self.plan_compiler, "journal", None)
+                is not None):
+            self.scheduler.add_idle_hook(
+                self._journal_drain_tick, kick=False,
+                name="journal_drain", weight=0.5)
+
+    def _journal_drain_tick(self) -> bool:
+        """Idle-economy consumer: persist the usage journal when it has
+        unsaved notes; drained (False) once clean."""
+        j = getattr(self.plan_compiler, "journal", None)
+        if j is None:
+            return False
+        if getattr(j, "_dirty", 0) > 0:
+            j.save()
+            return True
+        return False
 
     def _flush_largest_memtable(self, needed_bytes: int) -> None:
         """Ingest-quota reclaimer: flush memtables largest-first until the

@@ -481,13 +481,43 @@ class Manifest:
                 f"checkpoint {path} failed read-back verification; "
                 "superseded deltas retained")
         self._actions_since_checkpoint = 0
-        # GC superseded deltas/checkpoints (never the quarantine corner)
+        self._gc_superseded()
+
+    def _gc_superseded(self) -> None:
+        """GC deltas/checkpoints the current checkpoint supersedes (never
+        the quarantine corner).  Unfenced manifests delete plainly —
+        byte-for-byte the pre-fencing behavior.  Fenced manifests verify
+        the epoch marker first and then delete conditionally
+        (``delete_if`` keyed on each file's observed etag), so a
+        fenced-out zombie replaying a stale GC plan loses the CAS
+        instead of destroying files a newer leader re-minted under the
+        same version numbers (the ABA shape conditional PUT alone does
+        not cover on the delete side).  A lost CAS SKIPS the file —
+        never falls back to a plain delete."""
         CHAOS.inject("manifest.gc")
+        fenced = self.fence_epoch is not None
+        if fenced:
+            self._verify_fence("gc")
         for p in self.store.list(self.dir):
             if "/quarantine/" in p or p.endswith(_QUARANTINE_MARKER):
                 continue
             fn = p.rsplit("/", 1)[-1]
             if fn.startswith("delta-") and int(fn[6:-5]) <= self.version:
+                pass
+            elif fn.startswith("checkpoint-") and \
+                    int(fn[11:-5]) < self.version:
+                pass
+            else:
+                continue
+            if not fenced:
                 self.store.delete(p)
-            elif fn.startswith("checkpoint-") and int(fn[11:-5]) < self.version:
-                self.store.delete(p)
+                continue
+            meta = self.store.head(p)
+            if meta is None:
+                continue  # raced with another GC: already gone
+            try:
+                self.store.delete_if(p, if_match=meta["etag"])
+            except FencedError:
+                # file changed between head and delete — a newer leader
+                # owns this version space now; leave its bytes alone
+                M_FENCE_REJECTED.labels("gc").inc()

@@ -64,6 +64,16 @@ class ObjectStore:
     def delete(self, path: str) -> None:
         raise NotImplementedError
 
+    # ---- conditional delete (checkpoint GC fencing, ISSUE 18) ---------
+    # ``delete_if`` is the fenced HALF of garbage collection: delete the
+    # object only while its content is still the version the caller
+    # decided to GC (``if_match=<etag>``).  A fenced-out zombie leader
+    # replaying a stale GC plan loses the CAS (FencedError) instead of
+    # destroying a newer leader's checkpoint; the caller must treat the
+    # loss as a fencing event, never retry into a plain delete.
+    def delete_if(self, path: str, *, if_match: str) -> None:
+        raise NotImplementedError
+
     def rename(self, src: str, dst: str) -> None:
         """Move an object (quarantine uses this: bytes must be PRESERVED
         under the new name, never deleted).  Default is copy+delete —
@@ -223,6 +233,22 @@ class FsObjectStore(ObjectStore):
         if os.path.exists(p):
             os.unlink(p)
 
+    def delete_if(self, path: str, *, if_match: str) -> None:
+        p = self._abs(path)
+        with self._cas_lock:  # CAS check + unlink are atomic per root
+            try:
+                with open(p, "rb") as f:
+                    cur = content_etag(f.read())
+            except OSError:
+                raise FencedError(
+                    f"conditional delete lost: {path} is gone "
+                    f"(expected etag {if_match})") from None
+            if cur != if_match:
+                raise FencedError(
+                    f"conditional delete lost: {path} etag {cur} != "
+                    f"expected {if_match}")
+            os.unlink(p)
+
     def rename(self, src: str, dst: str) -> None:
         s, d = self._abs(src), self._abs(dst)
         os.makedirs(os.path.dirname(d), exist_ok=True)
@@ -302,6 +328,20 @@ class MemoryObjectStore(ObjectStore):
 
     def delete(self, path: str) -> None:
         self._data.pop(path.lstrip("/"), None)
+
+    def delete_if(self, path: str, *, if_match: str) -> None:
+        key = path.lstrip("/")
+        with self._cas_lock:
+            cur = self._data.get(key)
+            if cur is None:
+                raise FencedError(
+                    f"conditional delete lost: {path} is gone "
+                    f"(expected etag {if_match})")
+            if content_etag(cur) != if_match:
+                raise FencedError(
+                    f"conditional delete lost: {path} etag "
+                    f"{content_etag(cur)} != expected {if_match}")
+            del self._data[key]
 
     def rename(self, src: str, dst: str) -> None:
         self._data[dst.lstrip("/")] = self._data.pop(src.lstrip("/"))

@@ -435,6 +435,20 @@ class S3ObjectStore(ObjectStore):
         if cp and os.path.exists(cp):
             os.unlink(cp)
 
+    def delete_if(self, path: str, *, if_match: str) -> None:
+        """Conditional DELETE (checkpoint-GC fencing): the object dies
+        only while its etag still matches — a 412 surfaces as
+        FencedError via _request.  The local cache copy dies with it."""
+        status, _body, _h = self._request(
+            "DELETE", self._key(path),
+            extra_headers={"If-Match": f'"{if_match}"'})
+        if status not in (200, 202, 204):
+            raise StorageError(f"s3 conditional DELETE {path}: "
+                               f"HTTP {status}")
+        cp = self._cache_path(path)
+        if cp and os.path.exists(cp):
+            os.unlink(cp)
+
     def local_path(self, path: str) -> str | None:
         """Serve Parquet mmap reads from the write-through cache,
         fetching on demand (the reference file cache's read path)."""
@@ -561,7 +575,16 @@ class MockS3Server:
                 if not self._check_auth():
                     return
                 key, _q = self._key()
-                store.pop(key, None)
+                if_match = self.headers.get("If-Match")
+                with cas_lock:  # conditional check + pop are atomic
+                    if if_match is not None:
+                        cur = store.get(key)
+                        want = if_match.strip('"')
+                        if cur is None or content_etag(cur) != want:
+                            self.send_response(412)
+                            self.end_headers()
+                            return
+                    store.pop(key, None)
                 self.send_response(204)
                 self.end_headers()
 

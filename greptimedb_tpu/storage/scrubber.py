@@ -104,6 +104,13 @@ class Scrubber:
         self._next_sweep = 0.0         # monotonic; first sweep is due now
         self._resume_skip = 0
         self._aborted = False          # last _step hit an enumeration race
+        # mid-ITEM preemption (ISSUE 18 satellite): a large SST verifies
+        # row group by row group; when interactive queries arrive between
+        # groups the partially-drained verify generator stashes here and
+        # the item re-enters on the next idle tick — the verify resumes
+        # where it left off instead of restarting the whole decode
+        self._pending_item = None
+        self._sst_gen = None           # ((region_id, file_id), generator)
         # per-INSTANCE cursor object: nodes sharing one bucket must not
         # clobber each other's sweep position (keyed by the engine's
         # data home, which is unique per node)
@@ -169,7 +176,7 @@ class Scrubber:
                     yield ("s3_cache", None, rel)
 
     # ---- per-kind verification ----------------------------------------
-    def _scrub_item(self, item) -> str:
+    def _scrub_item(self, item, force: bool = False) -> str:
         kind, rid, payload = item
         CHAOS.inject("scrub.read")  # chaos tier: error/kill mid-sweep
         if kind in ("manifest", "wal", "sst"):
@@ -182,30 +189,51 @@ class Scrubber:
             if kind == "wal":
                 out = region.scrub_wal()
                 return "corrupt" if out.get("damage") else "ok"
-            return self._scrub_sst(region, payload)
+            return self._scrub_sst(region, payload, force=force)
         if kind == "grid_snapshot":
             return self._scrub_snapshot(payload)
         if kind == "s3_cache":
             return self._scrub_s3_cache(payload)
         return "skipped"
 
-    def _scrub_sst(self, region, file_id: str) -> str:
+    def _scrub_sst(self, region, file_id: str, *,
+                   force: bool = False) -> str:
         from greptimedb_tpu.storage.durability import (
             M_CORRUPTION, SstCorruption,
         )
-        from greptimedb_tpu.storage.sst import verify_sst_bytes
+        from greptimedb_tpu.storage.sst import iter_verify_sst_bytes
 
         meta = region.manifest.state.files.get(file_id)
         if meta is None:
+            self._sst_gen = None  # a stashed verify of a dead file
             return "skipped"  # compacted/dropped since enumeration
-        try:
-            data = region.store.read(meta.path)
-        except Exception:  # noqa: BLE001 — a transport blip (S3 5xx
-            # storm, timeout) must NOT quarantine a healthy file: skip;
-            # a genuinely missing object still fails the query-time
-            # verified read, which routes into the same repair machinery
-            return "error"
-        if verify_sst_bytes(data):
+        key = (region.region_id, file_id)
+        gen = None
+        if self._sst_gen is not None and self._sst_gen[0] == key:
+            gen = self._sst_gen[1]  # resume the stashed partial verify
+        self._sst_gen = None
+        if gen is None:
+            try:
+                data = region.store.read(meta.path)
+            except Exception:  # noqa: BLE001 — a transport blip (S3 5xx
+                # storm, timeout) must NOT quarantine a healthy file:
+                # skip; a genuinely missing object still fails the query-
+                # time verified read, routing into the same repair path
+                return "error"
+            gen = iter_verify_sst_bytes(data)
+        ok = True
+        for good in gen:
+            if not good:
+                ok = False
+                break
+            # between row groups: give way to interactive queries — the
+            # half-verified generator (it holds the bytes) stashes and
+            # this item re-enters on the next idle tick.  The force path
+            # (run_sweep, admin tooling) never yields mid-item.
+            if not force and self._yielding():
+                self._sst_gen = (key, gen)
+                return "pending"
+        if ok:
             return "ok"
         M_CORRUPTION.labels("sst", "scrub").inc()
         # we HOLD the bytes and they fail the checksummed decode: route
@@ -320,35 +348,48 @@ class Scrubber:
             if not force and self._yielding():
                 M_SCRUB_YIELD.inc()
                 return
-            try:
-                item = next(self._work, None)
-            except Exception:  # noqa: BLE001 — enumeration racing a
-                # concurrent drop/compaction must abort THIS sweep, not
-                # unhook the scrubber forever (the idle-hook dispatcher
-                # drops members whose call raises).  Aborted ≠ completed:
-                # the sweep counter/last-sweep gauge must not report a
-                # 3-of-1000-items sweep as healthy coverage, and the
-                # resume cursor survives for the retry (shortly — not a
-                # full interval away, but never a hot loop either)
-                self._work = None
-                self._aborted = True
-                self._next_sweep = time.monotonic() + min(
-                    self.interval_s, 5.0)
-                return
-            if item is None:
-                self._finish_sweep()
-                return
-            self._index += 1
-            if self._resume_skip > 0:
-                # fast-forward past items a prior process already
-                # verified this sweep (restart resumes mid-sweep)
-                self._resume_skip -= 1
-                continue
+            item = self._pending_item  # mid-item preemption re-entry
+            if item is not None:
+                self._pending_item = None  # _index already counted it
+            else:
+                try:
+                    item = next(self._work, None)
+                except Exception:  # noqa: BLE001 — enumeration racing a
+                    # concurrent drop/compaction must abort THIS sweep,
+                    # not unhook the scrubber forever (the idle-hook
+                    # dispatcher drops members whose call raises).
+                    # Aborted ≠ completed: the sweep counter/last-sweep
+                    # gauge must not report a 3-of-1000-items sweep as
+                    # healthy coverage, and the resume cursor survives
+                    # for the retry (shortly — not a full interval away,
+                    # but never a hot loop either)
+                    self._work = None
+                    self._aborted = True
+                    self._sst_gen = None
+                    self._next_sweep = time.monotonic() + min(
+                        self.interval_s, 5.0)
+                    return
+                if item is None:
+                    self._finish_sweep()
+                    return
+                self._index += 1
+                if self._resume_skip > 0:
+                    # fast-forward past items a prior process already
+                    # verified this sweep (restart resumes mid-sweep)
+                    self._resume_skip -= 1
+                    continue
             done += 1
             try:
-                outcome = self._scrub_item(item)
+                outcome = self._scrub_item(item, force=force)
             except Exception:  # noqa: BLE001 — one bad item must not
                 outcome = "error"  # kill the sweep (chaos tier pins this)
+            if outcome == "pending":
+                # preempted mid-SST: the partial verify is stashed; this
+                # item re-enters first on the next idle tick.  NOT
+                # counted — the item has not finished verifying.
+                self._pending_item = item
+                M_SCRUB_YIELD.inc()
+                return
             M_SCRUB_ITEMS.labels(item[0], outcome).inc()
             self.items += 1
             self._sweep_counts["items"] += 1

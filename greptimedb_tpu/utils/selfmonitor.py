@@ -92,6 +92,16 @@ class SelfMonitor:
         from greptimedb_tpu.servers.http import _ingest_columns
         from greptimedb_tpu.servers.otlp import _norm
 
+        # the SLO engine's pull gauges (greptime_slo_*) evaluate at the
+        # scrape below; rotate its adaptive sketch generations first so
+        # what self-imports is current (ISSUE 18 — the DB PromQL-queries
+        # its own burn rates from these tables)
+        slo = getattr(self.db, "slo", None)
+        if slo is not None:
+            try:
+                slo.advance()
+            except Exception:  # noqa: BLE001 — export must not die on it
+                pass
         now_ms = int(time.time() * 1000)
         tables: dict[str, list[tuple[dict, float]]] = {}
         for name, labels, value in REGISTRY.export_samples():

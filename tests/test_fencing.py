@@ -480,3 +480,181 @@ class TestClusterEpochFencing:
         ms = Metasrv(MemoryKv())
         assert [ms.mint_epoch(1) for _ in range(3)] == [1, 2, 3]
         assert ms.mint_epoch(2) == 1  # per-region counters
+
+
+class TestConditionalDelete:
+    """ISSUE 18 satellite: ``delete_if`` — the fenced half of checkpoint
+    GC.  Same CAS contract on every backend: the object dies only while
+    its etag still matches; a lost precondition raises FencedError and
+    PRESERVES the bytes."""
+
+    def test_delete_if_semantics_identical_across_backends(self, tmp_path):
+        for name, store, srv in _stores(tmp_path):
+            try:
+                store.write("g/obj", b"v1")
+                et = content_etag(b"v1")
+                # stale etag: fenced, object survives untouched
+                with pytest.raises(FencedError):
+                    store.delete_if("g/obj", if_match=content_etag(b"v2"))
+                assert store.read("g/obj") == b"v1", name
+                # matching etag: gone
+                store.delete_if("g/obj", if_match=et)
+                assert not store.exists("g/obj"), name
+                # missing object: fenced (someone else won the GC race),
+                # NOT a silent no-op — the caller must notice
+                with pytest.raises(FencedError):
+                    store.delete_if("g/obj", if_match=et)
+            finally:
+                if srv is not None:
+                    srv.stop()
+
+    def test_s3_delete_if_drops_the_cache_copy(self, s3_pair):
+        _srv, a, _b = s3_pair
+        a.write("m/ckpt", b"data")
+        assert a.read("m/ckpt") == b"data"  # cache filled
+        a.delete_if("m/ckpt", if_match=content_etag(b"data"))
+        assert not a.exists("m/ckpt")
+
+    def test_fenced_gc_skips_files_a_newer_leader_reminted(self, tmp_path):
+        """The manifest-GC half: a zombie's GC plan computed before a
+        newer leader re-minted a version-keyed file must SKIP that file
+        (lost CAS), never plain-delete it."""
+        import greptimedb_tpu.storage.manifest as mmod
+
+        store = FsObjectStore(str(tmp_path / "shared"))
+        m = Manifest.open(store, "region_1/manifest")
+        m.set_fence(1)
+        m.commit({"kind": "schema", "schema": schema().to_dict()})
+        m.commit({"kind": "options", "options": {"a": 1}})
+        victim = f"region_1/manifest/delta-{m.version:020d}.json"
+        assert store.exists(victim)
+        # simulate the A-B window: between the GC's etag PROBE and its
+        # conditional DELETE, another writer replaces the file's content
+        orig_head = store.head
+
+        def head_and_swap(path):
+            meta = orig_head(path)
+            if path == victim and meta is not None:
+                store.write(victim, b'{"swapped": true}')
+            return meta  # the STALE etag the zombie's plan will use
+
+        store.head = head_and_swap
+        try:
+            m.checkpoint()  # GC runs with the swap injected mid-plan
+        finally:
+            store.head = orig_head
+        # the re-minted file survived the zombie's GC; everything else
+        # superseded is gone
+        assert store.read(victim) == b'{"swapped": true}'
+        from greptimedb_tpu.utils.telemetry import REGISTRY
+
+        assert REGISTRY.value("greptime_fence_rejected_total",
+                              ("gc",)) >= 1.0
+
+    def test_unfenced_gc_still_plain_deletes(self, tmp_path):
+        """Byte-for-byte legacy: without a fence epoch the GC path stays
+        unconditional — no head() probes, no CAS, deltas just die."""
+        import greptimedb_tpu.storage.manifest as mmod
+
+        store = FsObjectStore(str(tmp_path / "solo"))
+        m = Manifest.open(store, "region_1/manifest")
+        m.commit({"kind": "schema", "schema": schema().to_dict()})
+        orig = mmod.CHECKPOINT_EVERY
+        mmod.CHECKPOINT_EVERY = 2
+        try:
+            m.commit({"kind": "options", "options": {"a": 1}})
+        finally:
+            mmod.CHECKPOINT_EVERY = orig
+        assert not any("delta-" in p for p in store.list("region_1/manifest"))
+        assert not store.exists("region_1/manifest/EPOCH")
+
+
+class TestFlowCheckpointFencing:
+    """ISSUE 18 satellite: the EPOCH marker discipline applied to flow
+    checkpoints — a failed-over zombie's stale drop plan cannot destroy
+    the checkpoint the new owner restores from."""
+
+    def _store(self, tmp_path):
+        from greptimedb_tpu.flow.checkpoint import FlowCheckpointStore
+
+        return FlowCheckpointStore(str(tmp_path / "flow_ckpt"))
+
+    def test_epochless_delete_is_unconditional(self, tmp_path):
+        st = self._store(tmp_path)
+        st.save("f1", {"x": 1})
+        st.delete("f1")  # legacy: no marker, no fence, no error
+        assert st.load("f1") is None
+        assert st.current_epoch() is None
+
+    def test_stale_epoch_delete_is_fenced(self, tmp_path):
+        st = self._store(tmp_path)
+        st.save("f1", {"x": 1})
+        st.claim(1)
+        st.claim(2)  # failover winner bumps the shared marker
+        with pytest.raises(FencedError):
+            st.delete("f1", epoch=1)  # zombie's stale token loses
+        assert st.load("f1") == {"x": 1}  # checkpoint PRESERVED
+        st.delete("f1", epoch=2)  # current owner's delete proceeds
+        assert st.load_bytes("f1") is None
+
+    def test_claim_below_marker_is_fenced(self, tmp_path):
+        st = self._store(tmp_path)
+        st.claim(3)
+        with pytest.raises(FencedError):
+            st.claim(2)
+        st.claim(3)  # idempotent re-claim of OUR epoch (crash-resume)
+        assert st.epoch == 3
+
+    def test_failover_arms_fencing_against_the_zombie_engine(
+            self, tmp_path):
+        """End-to-end through the control plane: after tick() fails a
+        flow over, the previous owner's engine (zombie, resurrected)
+        cannot delete the new owner's checkpoint via drop."""
+        import time as _time
+
+        from greptimedb_tpu.flow.cluster import FlowControlPlane, Flownode
+        from greptimedb_tpu.query.parser import parse_sql
+        from greptimedb_tpu.standalone import GreptimeDB
+
+        db = GreptimeDB(str(tmp_path / "d"))
+        try:
+            db.sql("CREATE TABLE src (h STRING, ts TIMESTAMP TIME INDEX, "
+                   "v DOUBLE, PRIMARY KEY(h))")
+            if getattr(db, "flow_checkpoints", None) is None:
+                pytest.skip("flow checkpoints disabled in this config")
+            plane = FlowControlPlane(db.kv)
+            nodes = [Flownode(i, db) for i in range(2)]
+            for n in nodes:
+                plane.register_flownode(n)
+            t0 = _time.time() * 1000.0
+            for n in nodes:
+                n.heartbeat(t0)
+            plane.create_flow(parse_sql(
+                "CREATE FLOW f SINK TO agg AS SELECT count(v) FROM src")[0])
+            owner = plane.nodes[plane.route("f")]
+            other = next(n for n in plane.nodes.values() if n is not owner)
+            db.sql("INSERT INTO src VALUES ('a', 1000, 1.0)")
+            plane.run_all()
+            owner.engine.checkpoint_now()
+            # owner dies; tick reassigns and the target claims an epoch
+            owner.alive = False
+            moved = plane.tick(t0 + 1000)
+            assert moved == ["f"]
+            assert other.engine.ckpt_epoch is not None
+            assert db.flow_checkpoints.current_epoch() == \
+                other.engine.ckpt_epoch
+            # zombie revives with a STALER token and replays its drop:
+            # the checkpoint file must survive
+            owner.alive = True
+            owner.engine.ckpt_epoch = other.engine.ckpt_epoch - 1
+            owner.engine.flows["f"] = object()  # revived registration
+            with pytest.raises(FencedError):
+                owner.engine.drop_flow("f")
+            assert db.flow_checkpoints.load_bytes("f") is not None
+            # the control plane's authoritative drop still works, even
+            # with the zombie's fenced store in the node set
+            plane.nodes[owner.node_id] = owner
+            plane.drop_flow("f")
+            assert db.flow_checkpoints.load_bytes("f") is None
+        finally:
+            db.close()

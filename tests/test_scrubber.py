@@ -344,7 +344,8 @@ class TestPacing:
         scrub = Scrubber(engine, interval_s=0, batch=100,
                          should_yield=lambda: len(calls) >= 2)
         real = scrub._scrub_item
-        scrub._scrub_item = lambda it: (calls.append(it), real(it))[1]
+        scrub._scrub_item = lambda it, force=False: (
+            calls.append(it), real(it, force=force))[1]
         scrub.tick()
         assert len(calls) == 2  # batch of 100 stopped after 2 items
         engine.close()
@@ -377,6 +378,87 @@ class TestPacing:
         out = scrub2.run_sweep()
         assert out["items"] == 12 - 8  # only the unscrubbed suffix
         assert not engine.store.exists(scrub._cursor_path)  # cleared
+        engine.close()
+
+    def _regroup_sst(self, engine, meta, rows_per_group=2):
+        """Rewrite one SST's bytes with tiny row groups (same rows, same
+        page checksums) so chunked verify has multiple steps."""
+        import io
+
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(io.BytesIO(engine.store.read(meta.path)))
+        sink = io.BytesIO()
+        pq.write_table(table, sink, row_group_size=rows_per_group,
+                       write_page_checksum=True)
+        with open(engine.store.local_path(meta.path), "wb") as f:
+            f.write(sink.getvalue())
+        return pq.ParquetFile(
+            io.BytesIO(sink.getvalue())).metadata.num_row_groups
+
+    def test_preemption_mid_sst_resumes_between_row_groups(
+            self, tmp_data_dir):
+        """ISSUE 18 satellite pin: a large SST verifies row group by row
+        group; interactive pressure arriving MID-FILE stashes the
+        half-drained verify and the next idle tick resumes it — without
+        re-reading the bytes or restarting the decode."""
+        engine, region = self._engine_with_ssts(tmp_data_dir, n=1)
+        meta = region.sst_files[0]
+        groups = self._regroup_sst(engine, meta)
+        assert groups >= 2
+        state = {"armed": False, "calls": 0}
+
+        def should_yield():
+            if not state["armed"]:
+                return False
+            state["calls"] += 1
+            # the tick-start and loop-top probes pass; the first BETWEEN-
+            # ROW-GROUPS probe inside the sst verify fires the preempt
+            return state["calls"] > 2
+
+        scrub = Scrubber(engine, interval_s=0, batch=1,
+                         should_yield=should_yield)
+        reads = []
+        real_read = engine.store.read
+
+        def counting_read(path):
+            if path == meta.path:
+                reads.append(path)
+            return real_read(path)
+
+        engine.store.read = counting_read
+        try:
+            while scrub.items < 2:  # manifest + wal verified
+                scrub.tick()
+            state["armed"] = True
+            scrub.tick()  # starts the sst, preempts after one row group
+            assert scrub._pending_item is not None
+            assert scrub._pending_item[0] == "sst"
+            assert scrub._sst_gen is not None
+            assert scrub.items == 2  # the half-verified sst NOT counted
+            assert len(reads) == 1   # bytes read exactly once so far
+            # pressure clears: the stashed verify resumes where it left
+            # off — no second read of the file, the item completes
+            state["armed"] = False
+            scrub.tick()
+            assert scrub.items == 3
+            assert scrub._pending_item is None and scrub._sst_gen is None
+            assert len(reads) == 1
+        finally:
+            engine.store.read = real_read
+        engine.close()
+
+    def test_force_sweep_never_yields_mid_item(self, tmp_data_dir):
+        """run_sweep (admin/tests) drains whole items even under
+        pressure: the force path skips the between-groups preempt."""
+        engine, region = self._engine_with_ssts(tmp_data_dir, n=1)
+        meta = region.sst_files[0]
+        self._regroup_sst(engine, meta)
+        scrub = Scrubber(engine, interval_s=0, batch=100,
+                         should_yield=lambda: True)  # max pressure
+        out = scrub.run_sweep()
+        assert scrub.sweeps == 1 and out["corrupt"] == 0
+        assert scrub._pending_item is None and scrub._sst_gen is None
         engine.close()
 
     def test_chaos_scrub_read_error_does_not_kill_sweep(
@@ -454,3 +536,52 @@ class TestStandaloneWiring:
                           ).rows == want
         finally:
             db.close()
+
+
+class TestChunkedVerify:
+    """iter_verify_sst_bytes (ISSUE 18 satellite): row-group-granular
+    checksummed verify — the unit the scrubber preempts between."""
+
+    def _bytes(self, n_rows=8, rows_per_group=2):
+        import io
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        table = pa.table({"ts": list(range(n_rows)),
+                          "v": [float(i) for i in range(n_rows)]})
+        sink = io.BytesIO()
+        pq.write_table(table, sink, row_group_size=rows_per_group,
+                       write_page_checksum=True)
+        return sink.getvalue()
+
+    def test_clean_file_yields_one_true_per_row_group(self):
+        from greptimedb_tpu.storage.sst import (
+            iter_verify_sst_bytes, verify_sst_bytes,
+        )
+
+        data = self._bytes(n_rows=8, rows_per_group=2)
+        assert list(iter_verify_sst_bytes(data)) == [True] * 4
+        assert verify_sst_bytes(data)
+
+    def test_corrupt_group_stops_iteration_with_false(self):
+        from greptimedb_tpu.storage.sst import (
+            iter_verify_sst_bytes, verify_sst_bytes,
+        )
+
+        data = bytearray(self._bytes(n_rows=64, rows_per_group=8))
+        # flip a byte in the data region (past the magic, before the
+        # footer): some group fails its page checksum
+        data[len(data) // 3] ^= 0xFF
+        out = list(iter_verify_sst_bytes(bytes(data)))
+        assert out[-1] is False
+        assert all(out[:-1])
+        assert not verify_sst_bytes(bytes(data))
+
+    def test_garbage_bytes_yield_single_false(self):
+        from greptimedb_tpu.storage.sst import (
+            iter_verify_sst_bytes, verify_sst_bytes,
+        )
+
+        assert list(iter_verify_sst_bytes(b"not a parquet file")) == [False]
+        assert not verify_sst_bytes(b"not a parquet file")

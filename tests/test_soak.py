@@ -1,0 +1,50 @@
+"""Slow-tier gate for the closed-loop SLO soak (bench_soak.py).
+
+Runs the soak as a subprocess at reduced scale and asserts every gate
+in its json summary line holds: exact SLO accounting, burn-rate alerts
+that fire under an induced storm and clear after it, background
+admission closed while burning, a live idle economy with no starvation,
+mid-soak flow failover, and the GREPTIME_SLO=off A/B warm-median pin.
+
+Excluded from tier-1 (slow); run with ``-m slow``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench_soak.py")
+
+
+def test_soak_all_gates(tmp_path):
+    out = tmp_path / "soak.json"
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "GREPTIME_BENCH_OUT": str(out),
+        # reduced scale: the gates, not the load, are under test
+        "GREPTIME_BENCH_SOAK_S": "4",
+        "GREPTIME_BENCH_STORM_S": "2.5",
+        "GREPTIME_BENCH_SCALE": "6",
+        "GREPTIME_BENCH_CLIENTS": "2",
+    })
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True,
+        text=True, timeout=480)
+    assert proc.returncode == 0, (
+        f"soak failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    line = json.loads(out.read_text())
+    failed = [k for k, v in line["gates"].items() if not v]
+    assert not failed, f"soak gates failed: {failed}\n{line}"
+    # the accounting gate is the observatory's core invariant — assert
+    # it explicitly so a gate-dict rename can't silently drop it
+    assert line["recorded"] == line["submitted_recorded"] > 0
+    assert line["gates"]["alert_fired"] and line["gates"]["alert_cleared"]

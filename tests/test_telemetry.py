@@ -224,6 +224,23 @@ class TestRegistryStaticCheck:
             "greptime_fulltext_resident_bytes",
         ):
             assert required in REGISTRY._metrics, required
+        # the SLO observatory + idle economy (serving/slo.py, serving/
+        # idle.py): sketches, error budgets, burn rates, and the
+        # idle-grant ledger — the surface the self-monitor loop and
+        # bench_soak.py gate on
+        import greptimedb_tpu.serving.idle  # noqa: F401
+        import greptimedb_tpu.serving.slo  # noqa: F401
+
+        for required in (
+            "greptime_slo_latency",
+            "greptime_slo_budget_remaining",
+            "greptime_slo_burn_rate",
+            "greptime_idle_granted_total",
+            "greptime_idle_elapsed_seconds_total",
+            "greptime_idle_starved_total",
+            "greptime_idle_throttled_total",
+        ):
+            assert required in REGISTRY._metrics, required
 
     def test_self_export_table_naming(self):
         # the self-import loop (utils/selfmonitor.py) names tables after
